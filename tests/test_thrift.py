@@ -1,0 +1,267 @@
+"""Compact-protocol + metadata struct tests.
+
+Round-trips our own structs and cross-checks against pyarrow as the
+independent thrift oracle: a pyarrow-written file's footer must parse, and
+our re-encoded footer must describe the same file.
+"""
+
+import io
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from tpuparquet.format import (
+    CompactReader,
+    CompactWriter,
+    FormatError,
+    read_file_metadata,
+)
+from tpuparquet.format.metadata import (
+    ColumnMetaData,
+    CompressionCodec,
+    ConvertedType,
+    DataPageHeader,
+    Encoding,
+    FieldRepetitionType,
+    FileMetaData,
+    KeyValue,
+    LogicalType,
+    PageHeader,
+    PageType,
+    RowGroup,
+    SchemaElement,
+    Statistics,
+    StringType,
+    TimestampType,
+    TimeUnit,
+    MicroSeconds,
+    Type,
+)
+
+
+def roundtrip(obj):
+    blob = obj.to_bytes()
+    back = type(obj).from_bytes(blob)
+    assert back == obj, f"{obj!r} != {back!r}"
+    return blob
+
+
+class TestPrimitives:
+    def test_varint_zigzag(self):
+        w = CompactWriter()
+        vals = [0, 1, -1, 63, -64, 64, 127, 128, 2**31 - 1, -(2**31), 2**62]
+        for v in vals:
+            w.write_zigzag(v)
+        r = CompactReader(w.getvalue())
+        for v in vals:
+            assert r.read_zigzag() == v
+
+    def test_binary(self):
+        w = CompactWriter()
+        w.write_binary(b"")
+        w.write_binary(b"hello" * 100)
+        r = CompactReader(w.getvalue())
+        assert r.read_binary() == b""
+        assert r.read_binary() == b"hello" * 100
+
+    def test_truncated_raises(self):
+        from tpuparquet.format import ThriftError
+
+        r = CompactReader(b"\x80")  # varint continuation with no next byte
+        with pytest.raises(ThriftError):
+            r.read_varint()
+
+
+class TestStructRoundtrip:
+    def test_statistics(self):
+        roundtrip(
+            Statistics(
+                max=b"\x01\x02",
+                min=b"\x00",
+                null_count=5,
+                distinct_count=17,
+                max_value=b"zz",
+                min_value=b"aa",
+            )
+        )
+
+    def test_schema_element_with_logical_type(self):
+        lt = LogicalType(
+            TIMESTAMP=TimestampType(
+                isAdjustedToUTC=True, unit=TimeUnit(MICROS=MicroSeconds())
+            )
+        )
+        se = SchemaElement(
+            type=Type.INT64,
+            repetition_type=FieldRepetitionType.OPTIONAL,
+            name="ts",
+            converted_type=ConvertedType.TIMESTAMP_MICROS,
+            logicalType=lt,
+        )
+        roundtrip(se)
+        assert lt.set_member()[0] == "TIMESTAMP"
+
+    def test_page_header(self):
+        ph = PageHeader(
+            type=PageType.DATA_PAGE,
+            uncompressed_page_size=1234,
+            compressed_page_size=567,
+            data_page_header=DataPageHeader(
+                num_values=1000,
+                encoding=Encoding.RLE_DICTIONARY,
+                definition_level_encoding=Encoding.RLE,
+                repetition_level_encoding=Encoding.RLE,
+                statistics=Statistics(null_count=3),
+            ),
+        )
+        roundtrip(ph)
+
+    def test_file_metadata(self):
+        meta = FileMetaData(
+            version=1,
+            schema=[
+                SchemaElement(name="root", num_children=1),
+                SchemaElement(
+                    type=Type.DOUBLE,
+                    repetition_type=FieldRepetitionType.REQUIRED,
+                    name="x",
+                ),
+            ],
+            num_rows=42,
+            row_groups=[
+                RowGroup(
+                    columns=[],
+                    total_byte_size=100,
+                    num_rows=42,
+                )
+            ],
+            key_value_metadata=[KeyValue(key="k", value="v")],
+            created_by="tpuparquet",
+        )
+        roundtrip(meta)
+
+    def test_unknown_field_skipped(self):
+        # Encode a KeyValue plus a bogus extra field id; decode must tolerate.
+        w = CompactWriter()
+        from tpuparquet.format.compact import CT
+
+        w.write_field_header(CT.BINARY, 1, 0)
+        w.write_binary(b"key")
+        w.write_field_header(CT.I64, 99, 1)
+        w.write_zigzag(12345)
+        w.write_field_header(CT.STRUCT, 100, 99)
+        w.write_field_header(CT.TRUE, 1, 0)
+        w.write_stop()
+        w.write_stop()
+        kv = KeyValue.from_bytes(w.getvalue())
+        assert kv.key == "key" and kv.value is None
+
+    def test_unknown_map_field_with_bool_values(self):
+        # Container bools occupy one byte; skipping an unknown map<i32,bool>
+        # must stay in sync with the stream.
+        from tpuparquet.format.compact import CT
+
+        w = CompactWriter()
+        w.write_field_header(CT.MAP, 3, 0)  # unknown field 3 on KeyValue
+        w.write_varint(2)  # 2 entries
+        w.write_byte((CT.I32 << 4) | CT.TRUE)  # key=i32, value=bool
+        w.write_zigzag(7)
+        w.write_byte(CT.TRUE)
+        w.write_zigzag(8)
+        w.write_byte(CT.FALSE)
+        w.write_field_header(CT.BINARY, 1, 3)  # field_id 1 via long form
+        w.write_binary(b"key")
+        w.write_stop()
+        kv = KeyValue.from_bytes(w.getvalue())
+        assert kv.key == "key"
+
+    def test_wire_type_mismatch_skipped(self):
+        # Field 1 of KeyValue is declared binary; send i64 on the wire.
+        # Decoder must consume by wire type and leave the field unset.
+        from tpuparquet.format.compact import CT
+
+        w = CompactWriter()
+        w.write_field_header(CT.I64, 1, 0)
+        w.write_zigzag(600)
+        w.write_field_header(CT.BINARY, 2, 1)
+        w.write_binary(b"val")
+        w.write_stop()
+        kv = KeyValue.from_bytes(w.getvalue())
+        assert kv.key is None and kv.value == "val"
+
+    def test_field_id_long_form(self):
+        # A field-id jump > 15 forces the long-form header.
+        cm = ColumnMetaData(type=Type.INT32, bloom_filter_offset=999)
+        blob = roundtrip(cm)
+        back = ColumnMetaData.from_bytes(blob)
+        assert back.bloom_filter_offset == 999
+
+
+def _pyarrow_file(tmp_path, compression="NONE"):
+    table = pa.table(
+        {
+            "a": pa.array([1, 2, None, 4], type=pa.int64()),
+            "b": pa.array(["x", "y", "z", None], type=pa.string()),
+            "c": pa.array([1.5, 2.5, 3.5, 4.5], type=pa.float64()),
+        }
+    )
+    path = tmp_path / "t.parquet"
+    pq.write_table(table, path, compression=compression)
+    return path, table
+
+
+class TestPyarrowFooter:
+    def test_parse_pyarrow_footer(self, tmp_path):
+        path, table = _pyarrow_file(tmp_path)
+        with open(path, "rb") as f:
+            meta = read_file_metadata(f)
+        assert meta.num_rows == 4
+        assert meta.schema[0].num_children == 3
+        names = [se.name for se in meta.schema[1:]]
+        assert names == ["a", "b", "c"]
+        assert meta.schema[1].type == Type.INT64
+        assert meta.schema[2].type == Type.BYTE_ARRAY
+        assert meta.schema[2].converted_type == ConvertedType.UTF8
+        assert meta.schema[3].type == Type.DOUBLE
+        assert len(meta.row_groups) == 1
+        rg = meta.row_groups[0]
+        assert rg.num_rows == 4
+        assert len(rg.columns) == 3
+        cm = rg.columns[0].meta_data
+        assert cm.type == Type.INT32 or cm.type == Type.INT64
+        assert cm.num_values == 4
+        assert cm.codec == CompressionCodec.UNCOMPRESSED
+
+    def test_reencode_matches_fields(self, tmp_path):
+        """decode -> encode -> decode must be a fixpoint."""
+        path, _ = _pyarrow_file(tmp_path, compression="SNAPPY")
+        with open(path, "rb") as f:
+            meta = read_file_metadata(f)
+        again = FileMetaData.from_bytes(meta.to_bytes())
+        assert again == meta
+
+    def test_parse_pyarrow_page_header(self, tmp_path):
+        path, _ = _pyarrow_file(tmp_path)
+        with open(path, "rb") as f:
+            meta = read_file_metadata(f)
+            cm = meta.row_groups[0].columns[2].meta_data  # plain float64 col
+            off = cm.data_page_offset
+            if cm.dictionary_page_offset is not None:
+                off = min(off, cm.dictionary_page_offset)
+            f.seek(off)
+            buf = f.read(cm.total_compressed_size)
+        r = CompactReader(buf)
+        from tpuparquet.format.metadata import decode_struct
+
+        ph = decode_struct(PageHeader, r)
+        assert ph.type in (PageType.DATA_PAGE, PageType.DATA_PAGE_V2,
+                           PageType.DICTIONARY_PAGE)
+        assert ph.compressed_page_size > 0
+
+    def test_bad_magic(self, tmp_path):
+        p = tmp_path / "bad.bin"
+        p.write_bytes(b"NOPE" + b"\x00" * 16 + b"NOPE")
+        with open(p, "rb") as f:
+            with pytest.raises(FormatError):
+                read_file_metadata(f)

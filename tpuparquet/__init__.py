@@ -1,0 +1,19 @@
+"""tpuparquet — a TPU-native Apache Parquet framework.
+
+A from-scratch reimplementation of the capabilities of fraugster/parquet-go
+(the reference at ``/root/reference``), designed TPU-first: plain-Python host
+side (thrift metadata, schema tree, orchestration), NumPy CPU oracle codecs,
+and a JAX/Pallas batch-decode data plane that stages column-chunk pages to
+HBM and decodes them in parallel, sharding row groups across a device mesh.
+"""
+
+__version__ = "0.1.0"
+
+from .format import (  # noqa: F401
+    CompressionCodec,
+    ConvertedType,
+    Encoding,
+    FieldRepetitionType,
+    PageType,
+    Type,
+)

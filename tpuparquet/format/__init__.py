@@ -1,0 +1,5 @@
+"""Host-side Parquet format layer: thrift metadata, footer framing, schema."""
+
+from .compact import CompactReader, CompactWriter, ThriftError  # noqa: F401
+from .footer import MAGIC, FormatError, read_file_metadata, write_footer  # noqa: F401
+from .metadata import *  # noqa: F401,F403

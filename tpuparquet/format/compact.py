@@ -1,0 +1,256 @@
+"""Thrift compact-protocol primitives.
+
+Parquet metadata (file footer ``FileMetaData`` and per-page ``PageHeader``)
+is serialized with the Thrift *compact* protocol.  The reference implementation
+uses the generated apache/thrift Go runtime (see ``/root/reference/parquet/``
+and ``/root/reference/helpers.go:101-117`` which selects ``TCompactProtocol``);
+we instead hand-roll the protocol: it is small, and a declarative schema system
+(see :mod:`tpuparquet.format.metadata`) keeps the struct definitions readable
+and auditable against ``parquet.thrift``.
+
+Wire format summary (Thrift compact protocol spec):
+
+* varint: unsigned LEB128 (7 bits per byte, MSB = continuation).
+* zigzag: signed -> unsigned mapping ``(n << 1) ^ (n >> 63)``.
+* i16/i32/i64: zigzag varint.  i8: single byte.  double: 8-byte LE IEEE754.
+* binary/string: varint byte-length + raw bytes.
+* struct: sequence of field headers, terminated by a 0x00 STOP byte.  A field
+  header is one byte ``(delta << 4) | compact_type`` when the field-id delta
+  from the previous field is in 1..15, otherwise ``compact_type`` alone
+  followed by the zigzag-varint field id.
+* bool fields encode the value *in the type nibble* (1 = true, 2 = false);
+  bool list elements are one byte each.
+* list/set: one byte ``(size << 4) | elem_type`` when size < 15, else
+  ``0xF0 | elem_type`` followed by varint size.
+* map: varint size (a single 0x00 for the empty map) then one byte
+  ``(key_type << 4) | value_type`` and alternating key/value payloads.
+"""
+
+from __future__ import annotations
+
+import struct as _struct
+
+__all__ = [
+    "CT",
+    "CompactReader",
+    "CompactWriter",
+    "ThriftError",
+]
+
+
+class ThriftError(ValueError):
+    """Raised on malformed compact-protocol input."""
+
+
+class CT:
+    """Compact-protocol type ids (the low nibble of a field header)."""
+
+    STOP = 0
+    TRUE = 1
+    FALSE = 2
+    I8 = 3
+    I16 = 4
+    I32 = 5
+    I64 = 6
+    DOUBLE = 7
+    BINARY = 8
+    LIST = 9
+    SET = 10
+    MAP = 11
+    STRUCT = 12
+
+
+def _zigzag_encode(n: int) -> int:
+    return (n << 1) ^ (n >> 63) if n < 0 else n << 1
+
+
+def _zigzag_decode(u: int) -> int:
+    return (u >> 1) ^ -(u & 1)
+
+
+class CompactReader:
+    """Pull-parser over a bytes-like object.
+
+    Tracks its own offset so callers can parse a thrift struct embedded in a
+    larger buffer (page headers inside a column chunk) and learn how many
+    bytes the struct consumed — the reference does this with a byte-counting
+    reader (``offsetReader``, ``/root/reference/helpers.go:37-62``).
+    """
+
+    __slots__ = ("buf", "pos", "end")
+
+    def __init__(self, buf, pos: int = 0, end: int | None = None):
+        self.buf = memoryview(buf)
+        self.pos = pos
+        self.end = len(self.buf) if end is None else end
+
+    def _need(self, n: int) -> None:
+        if self.pos + n > self.end:
+            raise ThriftError(
+                f"truncated thrift data: need {n} bytes at offset {self.pos}, "
+                f"have {self.end - self.pos}"
+            )
+
+    def read_byte(self) -> int:
+        self._need(1)
+        b = self.buf[self.pos]
+        self.pos += 1
+        return b
+
+    def read_varint(self) -> int:
+        result = 0
+        shift = 0
+        while True:
+            b = self.read_byte()
+            result |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return result
+            shift += 7
+            if shift > 70:
+                raise ThriftError("varint too long")
+
+    def read_zigzag(self) -> int:
+        return _zigzag_decode(self.read_varint())
+
+    def read_double(self) -> float:
+        self._need(8)
+        (v,) = _struct.unpack_from("<d", self.buf, self.pos)
+        self.pos += 8
+        return v
+
+    def read_binary(self) -> bytes:
+        n = self.read_varint()
+        if n < 0 or self.pos + n > self.end:
+            raise ThriftError(f"binary length {n} out of bounds")
+        v = bytes(self.buf[self.pos : self.pos + n])
+        self.pos += n
+        return v
+
+    # -- struct scaffolding ------------------------------------------------
+
+    def read_field_header(self, last_fid: int) -> tuple[int, int]:
+        """Return ``(compact_type, field_id)``; type STOP ends the struct."""
+        b = self.read_byte()
+        if b == CT.STOP:
+            return CT.STOP, 0
+        ctype = b & 0x0F
+        delta = (b & 0xF0) >> 4
+        if delta:
+            fid = last_fid + delta
+        else:
+            fid = self.read_zigzag()
+        return ctype, fid
+
+    def read_list_header(self) -> tuple[int, int]:
+        b = self.read_byte()
+        etype = b & 0x0F
+        size = (b & 0xF0) >> 4
+        if size == 15:
+            size = self.read_varint()
+        return etype, size
+
+    def read_map_header(self) -> tuple[int, int, int]:
+        size = self.read_varint()
+        if size == 0:
+            return 0, 0, 0
+        b = self.read_byte()
+        return (b & 0xF0) >> 4, b & 0x0F, size
+
+    def skip(self, ctype: int) -> None:
+        """Skip a value of the given compact type (unknown-field tolerance)."""
+        if ctype in (CT.TRUE, CT.FALSE):
+            return  # value lived in the field header
+        if ctype == CT.I8:
+            self.read_byte()
+        elif ctype in (CT.I16, CT.I32, CT.I64):
+            self.read_varint()
+        elif ctype == CT.DOUBLE:
+            self._need(8)
+            self.pos += 8
+        elif ctype == CT.BINARY:
+            n = self.read_varint()
+            self._need(n)
+            self.pos += n
+        elif ctype in (CT.LIST, CT.SET):
+            etype, size = self.read_list_header()
+            for _ in range(size):
+                self._skip_elem(etype)
+        elif ctype == CT.MAP:
+            ktype, vtype, size = self.read_map_header()
+            for _ in range(size):
+                self._skip_elem(ktype)
+                self._skip_elem(vtype)
+        elif ctype == CT.STRUCT:
+            last = 0
+            while True:
+                ft, fid = self.read_field_header(last)
+                if ft == CT.STOP:
+                    return
+                self.skip(ft)
+                last = fid
+        else:
+            raise ThriftError(f"cannot skip unknown compact type {ctype}")
+
+    def _skip_elem(self, etype: int) -> None:
+        """Skip a container element; bools occupy one byte inside containers
+        (unlike struct fields, where the value lives in the header nibble)."""
+        if etype in (CT.TRUE, CT.FALSE):
+            self.read_byte()
+        else:
+            self.skip(etype)
+
+
+class CompactWriter:
+    """Append-only compact-protocol emitter into a ``bytearray``."""
+
+    __slots__ = ("out",)
+
+    def __init__(self):
+        self.out = bytearray()
+
+    def getvalue(self) -> bytes:
+        return bytes(self.out)
+
+    def write_byte(self, b: int) -> None:
+        self.out.append(b & 0xFF)
+
+    def write_varint(self, n: int) -> None:
+        if n < 0:
+            raise ThriftError("varint must be non-negative")
+        out = self.out
+        while True:
+            b = n & 0x7F
+            n >>= 7
+            if n:
+                out.append(b | 0x80)
+            else:
+                out.append(b)
+                return
+
+    def write_zigzag(self, n: int) -> None:
+        self.write_varint(_zigzag_encode(n))
+
+    def write_double(self, v: float) -> None:
+        self.out += _struct.pack("<d", v)
+
+    def write_binary(self, v: bytes) -> None:
+        self.write_varint(len(v))
+        self.out += v
+
+    def write_field_header(self, ctype: int, fid: int, last_fid: int) -> None:
+        delta = fid - last_fid
+        if 0 < delta <= 15:
+            self.write_byte((delta << 4) | ctype)
+        else:
+            self.write_byte(ctype)
+            self.write_zigzag(fid)
+
+    def write_stop(self) -> None:
+        self.write_byte(CT.STOP)
+
+    def write_list_header(self, etype: int, size: int) -> None:
+        if size < 15:
+            self.write_byte((size << 4) | etype)
+        else:
+            self.write_byte(0xF0 | etype)
+            self.write_varint(size)

@@ -1,0 +1,88 @@
+"""Parquet file framing: magic bytes + footer read/write.
+
+Layout (same checks as the reference's ``/root/reference/file_meta.go:14-62``):
+
+    "PAR1" | row groups ... | thrift(FileMetaData) | footer_len:int32 LE | "PAR1"
+
+``read_file_metadata`` validates the magic at both ends, reads the 4-byte
+little-endian footer length at EOF-8, then compact-thrift-decodes
+``FileMetaData``.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+from .compact import CompactReader, CompactWriter, ThriftError
+from .metadata import FileMetaData, encode_struct
+
+MAGIC = b"PAR1"
+
+__all__ = ["MAGIC", "read_file_metadata", "write_footer", "FormatError"]
+
+
+class FormatError(ValueError):
+    """Raised when the file framing is malformed (bad magic, bad sizes)."""
+
+
+def _file_size(f) -> int:
+    pos = f.tell()
+    size = f.seek(0, os.SEEK_END)
+    f.seek(pos)
+    return size
+
+
+def read_file_metadata(f) -> FileMetaData:
+    """Read and validate the footer of a seekable binary file object."""
+    size = _file_size(f)
+    if size < len(MAGIC) * 2 + 4:
+        raise FormatError(f"file too small to be parquet ({size} bytes)")
+
+    f.seek(0)
+    if f.read(4) != MAGIC:
+        raise FormatError("invalid magic at file head")
+
+    f.seek(size - 8)
+    tail = f.read(8)
+    if tail[4:] != MAGIC:
+        raise FormatError("invalid magic at file tail")
+    (footer_len,) = struct.unpack("<I", tail[:4])
+    footer_start = size - 8 - footer_len
+    if footer_len <= 0 or footer_start < 4:
+        raise FormatError(f"invalid footer length {footer_len}")
+
+    f.seek(footer_start)
+    buf = f.read(footer_len)
+    if len(buf) != footer_len:
+        raise FormatError("short read of footer")
+    try:
+        meta = FileMetaData.from_bytes(buf)
+    except ThriftError as e:
+        raise FormatError(f"corrupt footer thrift: {e}") from e
+    # Required-field validation: compact thrift is permissive enough that
+    # corrupt bytes can decode to an empty struct, so enforce the fields
+    # parquet.thrift marks `required` before trusting the result.
+    if (
+        meta.version is None
+        or not meta.schema
+        or meta.num_rows is None
+        or meta.row_groups is None
+    ):
+        raise FormatError("footer missing required FileMetaData fields")
+    return meta
+
+
+def write_footer(f, meta: FileMetaData) -> int:
+    """Append thrift(FileMetaData) + length + magic; returns bytes written.
+
+    The caller is responsible for having written the leading magic already
+    (the writer does so on the first row-group flush, mirroring
+    ``/root/reference/file_writer.go:184``)."""
+    w = CompactWriter()
+    encode_struct(meta, w)
+    blob = w.getvalue()
+    f.write(blob)
+    f.write(struct.pack("<I", len(blob)))
+    f.write(MAGIC)
+    return len(blob) + 8
