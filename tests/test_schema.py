@@ -1,0 +1,363 @@
+"""Schema DSL parser/printer/validator + runtime tree tests.
+
+The accept/reject table mirrors the rule coverage of the reference's
+``schema_parser_test.go``; level computation is cross-checked against
+pyarrow's independently computed max definition/repetition levels.
+"""
+
+import datetime
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from tpuparquet.format.dsl import (
+    SchemaDefinition,
+    SchemaParseError,
+    SchemaValidationError,
+    parse_schema_definition,
+)
+from tpuparquet.format.metadata import ConvertedType, FieldRepetitionType, Type
+from tpuparquet.format.schema import Schema
+
+ACCEPT = [
+    "message foo {}",
+    "message foo { required int64 bar; }",
+    "message foo { optional int64 bar; }",
+    "message foo { repeated int64 bar; }",
+    "message foo { required int32 a; required int64 b; required float c; "
+    "required double d; required boolean e; required binary f; "
+    "required int96 g; required fixed_len_byte_array(12) h; }",
+    "message foo { optional binary s (STRING); }",
+    "message foo { optional binary s (UTF8); }",
+    "message foo { optional binary s (JSON); optional binary t (BSON); "
+    "optional binary u (ENUM); }",
+    "message foo { optional int32 d (DATE); }",
+    "message foo { optional int32 t (TIME(MILLIS, true)); }",
+    "message foo { optional int64 t (TIME(MICROS, false)); }",
+    "message foo { optional int64 t (TIME(NANOS, true)); }",
+    "message foo { optional int64 t (TIMESTAMP(MILLIS, true)); }",
+    "message foo { optional int64 t (TIMESTAMP(NANOS, false)); }",
+    "message foo { optional int32 t (TIME_MILLIS); }",
+    "message foo { optional int64 t (TIMESTAMP_MICROS); }",
+    "message foo { optional int32 i (INT(8, true)); optional int32 j (INT(16, true)); "
+    "optional int32 k (INT(32, false)); optional int64 l (INT(64, true)); }",
+    "message foo { optional int32 i (INT_8); optional int32 j (UINT_16); "
+    "optional int64 k (INT_64); }",
+    "message foo { optional int32 d (DECIMAL(9, 2)); }",
+    "message foo { optional int64 d (DECIMAL(18, 4)); }",
+    "message foo { optional fixed_len_byte_array(16) d (DECIMAL(22, 2)); }",
+    "message foo { optional binary d (DECIMAL(100, 2)); }",
+    "message foo { optional fixed_len_byte_array(16) d (DECIMAL(38, 10)); }",
+    "message foo { required fixed_len_byte_array(16) u (UUID); }",
+    "message foo { required fixed_len_byte_array(12) i (INTERVAL); }",
+    "message foo { required int64 f = 42; }",
+    # proper LIST
+    "message foo { optional group l (LIST) { repeated group list "
+    "{ optional int64 element; } } }",
+    "message foo { required group l (LIST) { repeated group list "
+    "{ required binary element (STRING); } } }",
+    # LIST backward-compat forms (non-strict)
+    "message foo { optional group l (LIST) { repeated int64 item; } }",
+    "message foo { optional group l (LIST) { repeated group array "
+    "{ required int64 a; } } }",
+    "message foo { optional group l (LIST) { repeated group l_tuple "
+    "{ required int64 a; required int64 b; } } }",
+    # proper MAP
+    "message foo { optional group m (MAP) { repeated group key_value "
+    "{ required binary key (STRING); optional int64 value; } } }",
+    # MAP_KEY_VALUE legacy
+    "message foo { optional group m (MAP) { repeated group map "
+    "{ required binary key; optional int32 value; } } }",
+    # nesting
+    "message foo { required group a { required group b { required int64 c; } } }",
+    "message foo { repeated group a { optional int64 b; } }",
+]
+
+REJECT = [
+    "",  # no message
+    "message foo",  # no body
+    "message foo {",  # unterminated
+    "message foo { required int64 bar }",  # missing semicolon
+    "message foo { int64 bar; }",  # missing repetition
+    "message foo { mandatory int64 bar; }",  # bad repetition
+    "message foo { required int17 bar; }",  # bad type
+    "message foo { required int64; }",  # missing name
+    "message foo { required binary s (NOPE); }",  # unknown annotation
+    "message foo { required binary t (TIME(MILLIS)); }",  # missing utc flag
+    "message foo { required int32 t (INT(12, true)); }",  # bad bit width
+    "message foo { required int64 f = x; }",  # bad field id
+    "message foo { required fixed_len_byte_array bar; }",  # missing length
+    # validation failures (parse OK, semantics bad)
+    "message foo { optional int64 s (STRING); }",  # STRING on non-binary
+    "message foo { optional int64 d (DATE); }",  # DATE on int64
+    "message foo { optional int32 t (TIME(MICROS, true)); }",  # MICROS on int32
+    "message foo { optional int32 t (TIMESTAMP(MILLIS, true)); }",
+    "message foo { optional int64 i (INT(32, true)); }",  # width/type mismatch
+    "message foo { optional int32 d (DECIMAL(12, 2)); }",  # precision > 9
+    "message foo { optional fixed_len_byte_array(2) u (UUID); }",  # not 16
+    "message foo { optional fixed_len_byte_array(11) i (INTERVAL); }",
+    "message foo { optional fixed_len_byte_array(16) d (DECIMAL(39, 10)); }",
+    # bad annotation inside backward-compat LIST form must still be caught
+    "message foo { optional group l (LIST) { repeated binary item (DATE); } }",
+    "message foo { optional int64 l (LIST); }",  # LIST on non-group
+    "message foo { repeated group l (LIST) { repeated group list "
+    "{ optional int64 element; } } }",  # LIST itself repeated
+    "message foo { optional group l (LIST) { repeated group list "
+    "{ optional int64 element; } repeated group list2 { optional int64 e; } } }",
+    "message foo { optional group l (LIST) { repeated group list "
+    "{ optional int64 element; optional int64 other; } } }",  # 2 children of list
+    "message foo { optional group m (MAP) { repeated group key_value "
+    "{ required binary key; } } }",  # map kv with 1 child
+    "message foo { optional group m (MAP) { required group key_value "
+    "{ required binary key; optional int64 value; } } }",  # kv not repeated
+    "message foo { required group g { } }",  # group with no children
+]
+
+
+@pytest.mark.parametrize("text", ACCEPT)
+def test_accept(text):
+    sd = parse_schema_definition(text)
+    assert sd is not None
+
+
+@pytest.mark.parametrize("text", REJECT)
+def test_reject(text):
+    with pytest.raises((SchemaParseError, SchemaValidationError)):
+        parse_schema_definition(text)
+
+
+def test_parse_error_carries_line_number():
+    try:
+        parse_schema_definition("message foo {\n  required int64 bar\n}")
+    except SchemaParseError as e:
+        assert "line 3" in str(e)
+    else:
+        pytest.fail("expected SchemaParseError")
+
+
+class TestPrinterFixpoint:
+    SCHEMAS = [
+        "message foo {\n  required int64 foo;\n}\n",
+        (
+            "message foo {\n"
+            "  required binary the_id (STRING) = 1;\n"
+            "  required binary client (STRING) = 2;\n"
+            "  required group data_enriched (MAP) {\n"
+            "    repeated group key_value (MAP_KEY_VALUE) {\n"
+            "      required binary key = 5;\n"
+            "      required binary value = 6;\n"
+            "    }\n"
+            "  }\n"
+            "  optional boolean is_fraud = 7;\n"
+            "}\n"
+        ),
+        (
+            "message foo {\n"
+            "  required group ids (LIST) {\n"
+            "    repeated group list {\n"
+            "      required int64 element;\n"
+            "    }\n"
+            "  }\n"
+            "}\n"
+        ),
+        (
+            "message foo {\n"
+            "  required fixed_len_byte_array(16) theid (UUID);\n"
+            "  optional binary data;\n"
+            "}\n"
+            ),
+        (
+            "message foo {\n"
+            "  optional int64 ts (TIMESTAMP(NANOS, true));\n"
+            "  optional int32 t (TIME(MILLIS, false));\n"
+            "  optional int32 i (INT(16, false));\n"
+            "  optional int64 d (DECIMAL(18, 5));\n"
+            "}\n"
+        ),
+    ]
+
+    @pytest.mark.parametrize("text", SCHEMAS)
+    def test_parse_print_parse_fixpoint(self, text):
+        sd1 = parse_schema_definition(text)
+        printed = str(sd1)
+        sd2 = parse_schema_definition(printed)
+        assert str(sd2) == printed
+        assert sd2 == sd1
+
+    def test_print_exact(self):
+        # whitespace-normalized input prints in canonical 2-space form
+        sd = parse_schema_definition(
+            "message foo{required int64 a;optional group g{repeated binary b(STRING);}}"
+        )
+        assert str(sd) == (
+            "message foo {\n"
+            "  required int64 a;\n"
+            "  optional group g {\n"
+            "    repeated binary b (STRING);\n"
+            "  }\n"
+            "}\n"
+        )
+
+
+class TestSchemaDefinitionAPI:
+    def test_sub_schema(self):
+        sd = parse_schema_definition(
+            "message foo { required group a { required int64 b; } }"
+        )
+        sub = sd.sub_schema("a")
+        assert sub is not None
+        assert sub.root.name == "a"
+        assert sd.sub_schema("nope") is None
+
+    def test_schema_elements_roundtrip(self):
+        sd = parse_schema_definition(
+            "message foo { required group a { required int64 b; } "
+            "optional binary c (STRING); }"
+        )
+        elems = sd.to_schema_elements()
+        assert [e.name for e in elems] == ["foo", "a", "b", "c"]
+        assert elems[0].num_children == 2
+        assert elems[1].num_children == 1
+        back = SchemaDefinition.from_schema_elements(elems)
+        assert back == sd
+
+    def test_validate_strict_rejects_legacy(self):
+        legacy = parse_schema_definition(
+            "message foo { optional group l (LIST) { repeated int64 item; } }"
+        )
+        with pytest.raises(SchemaValidationError):
+            legacy.validate_strict()
+        proper = parse_schema_definition(
+            "message foo { optional group l (LIST) { repeated group list "
+            "{ optional int64 element; } } }"
+        )
+        proper.validate_strict()
+
+    def test_strict_map_rules(self):
+        bad_key = parse_schema_definition(
+            "message foo { optional group m (MAP) { repeated group key_value "
+            "{ optional binary key; optional int64 value; } } }"
+        )
+        with pytest.raises(SchemaValidationError):
+            bad_key.validate_strict()
+
+
+class TestLevels:
+    def test_flat(self):
+        s = Schema.from_string(
+            "message m { required int64 a; optional int64 b; repeated int64 c; }"
+        )
+        lv = {n.flat_name: (n.max_rep_level, n.max_def_level) for n in s.leaves}
+        assert lv == {"a": (0, 0), "b": (0, 1), "c": (1, 1)}
+
+    def test_nested(self):
+        # the Dremel paper's document schema shape
+        s = Schema.from_string(
+            "message doc {"
+            "  required int64 docid;"
+            "  optional group links {"
+            "    repeated int64 backward;"
+            "    repeated int64 forward;"
+            "  }"
+            "  repeated group name {"
+            "    repeated group language {"
+            "      required binary code;"
+            "      optional binary country;"
+            "    }"
+            "    optional binary url;"
+            "  }"
+            "}"
+        )
+        lv = {n.flat_name: (n.max_rep_level, n.max_def_level) for n in s.leaves}
+        assert lv == {
+            "docid": (0, 0),
+            "links.backward": (1, 2),
+            "links.forward": (1, 2),
+            "name.language.code": (2, 2),
+            "name.language.country": (2, 3),
+            "name.url": (1, 2),
+        }
+
+    def test_levels_match_pyarrow(self, tmp_path):
+        table = pa.table(
+            {
+                "a": pa.array([1], type=pa.int64()),
+                "tags": pa.array([["x", "y"]]),
+                "m": pa.array(
+                    [[("k", 1)]], type=pa.map_(pa.string(), pa.int64())
+                ),
+                "nested": pa.array(
+                    [{"u": 1, "v": [1.5]}],
+                    type=pa.struct(
+                        [("u", pa.int64()), ("v", pa.list_(pa.float64()))]
+                    ),
+                ),
+            }
+        )
+        path = tmp_path / "t.parquet"
+        pq.write_table(table, path)
+        from tpuparquet.format import read_file_metadata
+
+        with open(path, "rb") as f:
+            meta = read_file_metadata(f)
+        s = Schema.from_elements(meta.schema)
+        pqs = pq.ParquetFile(path).schema
+        assert len(s.leaves) == len(pqs)
+        for i, leaf in enumerate(s.leaves):
+            col = pqs.column(i)
+            assert leaf.max_def_level == col.max_definition_level, leaf.flat_name
+            assert leaf.max_rep_level == col.max_repetition_level, leaf.flat_name
+            assert leaf.flat_name == col.path.replace(".list.element", ".list.element")
+
+
+class TestProjection:
+    def _schema(self):
+        return Schema.from_string(
+            "message m { required int64 a; "
+            "optional group g { optional int64 x; optional int64 y; } "
+            "optional int64 b; }"
+        )
+
+    def test_select_all_by_default(self):
+        s = self._schema()
+        assert all(s.is_selected(leaf) for leaf in s.leaves)
+
+    def test_select_leaf(self):
+        s = self._schema()
+        s.set_selected_columns("g.x")
+        sel = {n.flat_name: s.is_selected(n) for n in s.leaves}
+        assert sel == {"a": False, "g.x": True, "g.y": False, "b": False}
+        # group ancestor stays selected for structure
+        assert s.is_selected("g")
+
+    def test_select_group_selects_subtree(self):
+        s = self._schema()
+        s.set_selected_columns("g")
+        sel = {n.flat_name: s.is_selected(n) for n in s.leaves}
+        assert sel == {"a": False, "g.x": True, "g.y": True, "b": False}
+
+    def test_select_unknown_raises(self):
+        s = self._schema()
+        with pytest.raises(SchemaValidationError):
+            s.set_selected_columns("nope")
+
+
+class TestProgrammaticBuild:
+    def test_add_nodes(self):
+        from tpuparquet.format.dsl import ColumnDefinition
+        from tpuparquet.format.metadata import SchemaElement
+
+        s = Schema.empty("msg")
+        s.add_node("", ColumnDefinition(SchemaElement(
+            name="a", type=Type.INT64,
+            repetition_type=FieldRepetitionType.REQUIRED)))
+        s.add_node("", ColumnDefinition(SchemaElement(
+            name="g", repetition_type=FieldRepetitionType.OPTIONAL)))
+        s.add_node("g", ColumnDefinition(SchemaElement(
+            name="x", type=Type.BYTE_ARRAY,
+            repetition_type=FieldRepetitionType.REPEATED,
+            converted_type=ConvertedType.UTF8)))
+        lv = {n.flat_name: (n.max_rep_level, n.max_def_level) for n in s.leaves}
+        assert lv == {"a": (0, 0), "g.x": (1, 2)}
+        assert "repeated binary x (UTF8);" in str(s)

@@ -1,0 +1,775 @@
+"""Textual schema DSL: parser, printer, validator.
+
+Capability parity with the reference's ``parquetschema`` package
+(``/root/reference/parquetschema/schema_parser.go`` and
+``schema_def.go:31-94`` define the grammar):
+
+    message ::= 'message' <identifier> '{' <column-definition>* '}'
+    column-definition ::= ('required'|'optional'|'repeated')
+                          ( 'group' <name> [ '(' <converted-type> ')' ] '{' ... '}'
+                          | <type> <name> [ '(' <annotation> ')' ] [ '=' <field-id> ] ';' )
+
+Annotations on fields are either new-style logical types (STRING, DATE,
+TIMESTAMP(unit, utc), TIME(unit, utc), INT(width, signed),
+DECIMAL(precision, scale), UUID, ENUM, JSON, BSON) — which also set the
+backward-compatible converted type where one exists — or bare converted-type
+names (UTF8, MAP, LIST, TIME_MILLIS, INT_8, ...).
+
+Validation implements the LIST/MAP shape rules (incl. the four
+backward-compatibility LIST forms accepted by non-strict mode) and the
+physical-type checks for every logical/converted annotation, mirroring
+``schema_parser.go:715-1044``.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+
+from .metadata import (
+    BsonType,
+    ConvertedType,
+    DateType,
+    DecimalType,
+    EnumType,
+    FieldRepetitionType,
+    IntType,
+    JsonType,
+    ListType,
+    LogicalType,
+    MapType,
+    MicroSeconds,
+    MilliSeconds,
+    NanoSeconds,
+    NullType,
+    SchemaElement,
+    StringType,
+    TimestampType,
+    TimeType,
+    TimeUnit,
+    Type,
+    UUIDType,
+)
+
+__all__ = [
+    "ColumnDefinition",
+    "SchemaDefinition",
+    "SchemaParseError",
+    "SchemaValidationError",
+    "parse_schema_definition",
+]
+
+
+class SchemaParseError(ValueError):
+    def __init__(self, msg, line=None):
+        super().__init__(f"line {line}: {msg}" if line else msg)
+        self.line = line
+
+
+class SchemaValidationError(ValueError):
+    pass
+
+
+_TYPE_NAMES = {
+    "binary": Type.BYTE_ARRAY,
+    "float": Type.FLOAT,
+    "double": Type.DOUBLE,
+    "boolean": Type.BOOLEAN,
+    "int32": Type.INT32,
+    "int64": Type.INT64,
+    "int96": Type.INT96,
+    "fixed_len_byte_array": Type.FIXED_LEN_BYTE_ARRAY,
+}
+_TYPE_PRINT = {v: k for k, v in _TYPE_NAMES.items()}
+
+
+# --------------------------------------------------------------------------
+# Definition model
+# --------------------------------------------------------------------------
+
+class ColumnDefinition:
+    """One node of a schema definition: a SchemaElement + children."""
+
+    __slots__ = ("element", "children")
+
+    def __init__(self, element: SchemaElement, children=None):
+        self.element = element
+        self.children = children or []
+
+    @property
+    def name(self) -> str:
+        return self.element.name
+
+    def __eq__(self, other):
+        if not isinstance(other, ColumnDefinition):
+            return NotImplemented
+        return self.element == other.element and self.children == other.children
+
+    def __repr__(self):
+        return f"ColumnDefinition({self.element!r}, children={len(self.children)})"
+
+
+class SchemaDefinition:
+    """A parsed schema: wraps the root ColumnDefinition.
+
+    API parity with the reference's ``SchemaDefinition`` (``schema_def.go``):
+    ``__str__`` prints the DSL back out (parse->print->parse is a fixpoint),
+    ``sub_schema`` returns a direct child as its own definition, ``validate``
+    and ``validate_strict`` check structural rules.
+    """
+
+    __slots__ = ("root",)
+
+    def __init__(self, root: ColumnDefinition):
+        self.root = root
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_schema_elements(cls, elems: list[SchemaElement]) -> "SchemaDefinition":
+        """Build from the flat depth-first SchemaElement list of a footer."""
+        if not elems:
+            raise SchemaValidationError("empty schema element list")
+        pos = 0
+
+        def build() -> ColumnDefinition:
+            nonlocal pos
+            if pos >= len(elems):
+                raise SchemaValidationError("schema element list truncated")
+            se = elems[pos]
+            pos += 1
+            col = ColumnDefinition(se)
+            n = se.num_children or 0
+            for _ in range(n):
+                col.children.append(build())
+            return col
+
+        root = build()
+        if pos != len(elems):
+            raise SchemaValidationError(
+                f"schema element list has {len(elems) - pos} trailing elements"
+            )
+        return cls(root)
+
+    def to_schema_elements(self) -> list[SchemaElement]:
+        """Flatten back to the depth-first list stored in the footer."""
+        out: list[SchemaElement] = []
+
+        def walk(col: ColumnDefinition):
+            se = col.element
+            se.num_children = len(col.children) if col.children else None
+            out.append(se)
+            for c in col.children:
+                walk(c)
+
+        walk(self.root)
+        return out
+
+    # -- navigation --------------------------------------------------------
+
+    def sub_schema(self, name: str) -> "SchemaDefinition | None":
+        for c in self.root.children:
+            if c.name == name:
+                return SchemaDefinition(c)
+        return None
+
+    def schema_element(self) -> SchemaElement | None:
+        return self.root.element if self.root else None
+
+    # -- validation --------------------------------------------------------
+
+    def validate(self) -> None:
+        _validate_column(self.root, is_root=True, strict=False)
+
+    def validate_strict(self) -> None:
+        _validate_column(self.root, is_root=True, strict=True)
+
+    # -- printing ----------------------------------------------------------
+
+    def __str__(self) -> str:
+        if self.root is None:
+            return "message empty {\n}\n"
+        out = [f"message {self.root.name} {{\n"]
+        _print_cols(out, self.root.children, 2)
+        out.append("}\n")
+        return "".join(out)
+
+    def __eq__(self, other):
+        if not isinstance(other, SchemaDefinition):
+            return NotImplemented
+        return self.root == other.root
+
+
+def _print_cols(out: list, cols: list, indent: int) -> None:
+    for col in cols:
+        se = col.element
+        pad = " " * indent
+        rep = FieldRepetitionType(se.repetition_type).name.lower()
+        if se.type is None:
+            out.append(f"{pad}{rep} group {se.name}")
+            if se.converted_type is not None:
+                out.append(f" ({ConvertedType(se.converted_type).name})")
+            out.append(" {\n")
+            _print_cols(out, col.children, indent + 2)
+            out.append(f"{pad}}}\n")
+        else:
+            tname = _TYPE_PRINT[Type(se.type)]
+            if se.type == Type.FIXED_LEN_BYTE_ARRAY:
+                tname = f"fixed_len_byte_array({se.type_length})"
+            out.append(f"{pad}{rep} {tname} {se.name}")
+            if se.logicalType is not None:
+                out.append(f" ({_print_logical(se.logicalType)})")
+            elif se.converted_type is not None:
+                out.append(f" ({ConvertedType(se.converted_type).name})")
+            if se.field_id is not None:
+                out.append(f" = {se.field_id}")
+            out.append(";\n")
+
+
+def _unit_name(unit: TimeUnit) -> str:
+    if unit.NANOS is not None:
+        return "NANOS"
+    if unit.MICROS is not None:
+        return "MICROS"
+    return "MILLIS"
+
+
+def _print_logical(lt: LogicalType) -> str:
+    name, val = lt.set_member()
+    if name == "STRING":
+        return "STRING"
+    if name == "DATE":
+        return "DATE"
+    if name == "TIMESTAMP":
+        utc = "true" if val.isAdjustedToUTC else "false"
+        return f"TIMESTAMP({_unit_name(val.unit)}, {utc})"
+    if name == "TIME":
+        utc = "true" if val.isAdjustedToUTC else "false"
+        return f"TIME({_unit_name(val.unit)}, {utc})"
+    if name == "UUID":
+        return "UUID"
+    if name == "ENUM":
+        return "ENUM"
+    if name == "JSON":
+        return "JSON"
+    if name == "BSON":
+        return "BSON"
+    if name == "DECIMAL":
+        return f"DECIMAL({val.precision}, {val.scale})"
+    if name == "INTEGER":
+        signed = "true" if val.isSigned else "false"
+        return f"INT({val.bitWidth}, {signed})"
+    return name or "UNKNOWN"
+
+
+# --------------------------------------------------------------------------
+# Tokenizer + recursive-descent parser
+# --------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+)
+  | (?P<number>\d+)
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_.\-]*)
+  | (?P<punct>[(){}=;,])
+    """,
+    re.VERBOSE,
+)
+
+
+class _Tok:
+    __slots__ = ("kind", "val", "line")
+
+    def __init__(self, kind, val, line):
+        self.kind = kind  # 'number' | 'ident' | the punct char | 'eof'
+        self.val = val
+        self.line = line
+
+
+def _tokenize(text: str) -> list[_Tok]:
+    toks = []
+    pos = 0
+    line = 1
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if m is None:
+            raise SchemaParseError(f"unexpected character {text[pos]!r}", line)
+        if m.lastgroup == "ws":
+            line += m.group().count("\n")
+        elif m.lastgroup == "punct":
+            toks.append(_Tok(m.group(), m.group(), line))
+        else:
+            toks.append(_Tok(m.lastgroup, m.group(), line))
+        pos = m.end()
+    toks.append(_Tok("eof", "", line))
+    return toks
+
+
+class _Parser:
+    def __init__(self, text: str):
+        self.toks = _tokenize(text)
+        self.i = 0
+
+    @property
+    def tok(self) -> _Tok:
+        return self.toks[self.i]
+
+    def advance(self) -> _Tok:
+        t = self.tok
+        if t.kind != "eof":
+            self.i += 1
+        return t
+
+    def error(self, msg: str):
+        raise SchemaParseError(msg, self.tok.line)
+
+    def expect(self, kind: str, what: str = "") -> _Tok:
+        if self.tok.kind != kind:
+            self.error(f"expected {what or kind}, got {self.tok.val!r}")
+        return self.advance()
+
+    def expect_keyword(self, word: str) -> None:
+        if not (self.tok.kind == "ident" and self.tok.val == word):
+            self.error(f"expected {word!r}, got {self.tok.val!r}")
+        self.advance()
+
+    # grammar --------------------------------------------------------------
+
+    def parse_message(self) -> SchemaDefinition:
+        self.expect_keyword("message")
+        name = self.expect("ident", "message name").val
+        root = ColumnDefinition(SchemaElement(name=name))
+        self.expect("{")
+        while self.tok.kind != "}":
+            root.children.append(self.parse_column())
+        self.expect("}")
+        if self.tok.kind != "eof":
+            self.error(f"trailing content after schema: {self.tok.val!r}")
+        _fix_num_children(root)
+        return SchemaDefinition(root)
+
+    def parse_column(self) -> ColumnDefinition:
+        rep_tok = self.expect("ident", "repetition type")
+        try:
+            rep = FieldRepetitionType[rep_tok.val.upper()]
+        except KeyError:
+            raise SchemaParseError(
+                f"invalid field repetition type {rep_tok.val!r}", rep_tok.line
+            )
+        if self.tok.kind == "ident" and self.tok.val == "group":
+            self.advance()
+            name = self.expect("ident", "group name").val
+            se = SchemaElement(name=name, repetition_type=rep)
+            col = ColumnDefinition(se)
+            if self.tok.kind == "(":
+                self.advance()
+                ct_tok = self.expect("ident", "converted type")
+                try:
+                    se.converted_type = ConvertedType[ct_tok.val]
+                except KeyError:
+                    raise SchemaParseError(
+                        f"invalid converted type {ct_tok.val!r}", ct_tok.line
+                    )
+                self.expect(")")
+            self.expect("{")
+            while self.tok.kind != "}":
+                col.children.append(self.parse_column())
+            self.expect("}")
+            return col
+
+        # primitive field
+        type_tok = self.expect("ident", "type")
+        ptype = _TYPE_NAMES.get(type_tok.val)
+        if ptype is None:
+            raise SchemaParseError(f"invalid type {type_tok.val!r}", type_tok.line)
+        se = SchemaElement(type=ptype, repetition_type=rep)
+        if ptype == Type.FIXED_LEN_BYTE_ARRAY:
+            self.expect("(")
+            se.type_length = int(self.expect("number", "byte length").val)
+            self.expect(")")
+        se.name = self.expect("ident", "field name").val
+        if self.tok.kind == "(":
+            self.parse_annotation(se)
+        if self.tok.kind == "=":
+            self.advance()
+            se.field_id = int(self.expect("number", "field id").val)
+        self.expect(";")
+        return ColumnDefinition(se)
+
+    def parse_annotation(self, se: SchemaElement) -> None:
+        """Parse ``( ... )`` after a field: logical or converted type.
+
+        New-style logical types also populate the matching converted type
+        (format v1 forward compatibility), exactly as the reference does
+        (``schema_parser.go:483-698``)."""
+        self.expect("(")
+        name_tok = self.expect("ident", "annotation")
+        name = name_tok.val.upper()
+        lt = LogicalType()
+        ct = None
+        if name == "STRING":
+            lt.STRING = StringType()
+            ct = ConvertedType.UTF8
+        elif name == "DATE":
+            lt.DATE = DateType()
+            ct = ConvertedType.DATE
+        elif name == "UUID":
+            lt.UUID = UUIDType()
+        elif name == "ENUM":
+            lt.ENUM = EnumType()
+            ct = ConvertedType.ENUM
+        elif name == "JSON":
+            lt.JSON = JsonType()
+            ct = ConvertedType.JSON
+        elif name == "BSON":
+            lt.BSON = BsonType()
+            ct = ConvertedType.BSON
+        elif name == "TIMESTAMP":
+            unit, utc = self.parse_unit_bool("TIMESTAMP")
+            lt.TIMESTAMP = TimestampType(isAdjustedToUTC=utc, unit=unit)
+            if unit.MILLIS is not None:
+                ct = ConvertedType.TIMESTAMP_MILLIS
+            elif unit.MICROS is not None:
+                ct = ConvertedType.TIMESTAMP_MICROS
+        elif name == "TIME":
+            unit, utc = self.parse_unit_bool("TIME")
+            lt.TIME = TimeType(isAdjustedToUTC=utc, unit=unit)
+            if unit.MILLIS is not None:
+                ct = ConvertedType.TIME_MILLIS
+            elif unit.MICROS is not None:
+                ct = ConvertedType.TIME_MICROS
+        elif name == "INT":
+            self.expect("(")
+            width = int(self.expect("number", "bit width").val)
+            if width not in (8, 16, 32, 64):
+                self.error(f"INT: unsupported bitwidth {width}")
+            self.expect(",")
+            signed = self.parse_bool("INT")
+            self.expect(")")
+            lt.INTEGER = IntType(bitWidth=width, isSigned=signed)
+            ct = ConvertedType[("INT_" if signed else "UINT_") + str(width)]
+        elif name == "DECIMAL":
+            self.expect("(")
+            precision = int(self.expect("number", "precision").val)
+            self.expect(",")
+            scale = int(self.expect("number", "scale").val)
+            self.expect(")")
+            lt.DECIMAL = DecimalType(scale=scale, precision=precision)
+            se.scale = scale
+            se.precision = precision
+        else:
+            # Bare converted-type annotation (UTF8, LIST, TIME_MILLIS, ...)
+            try:
+                se.converted_type = ConvertedType[name]
+            except KeyError:
+                self.error(
+                    f"unsupported logical type or converted type {name_tok.val!r}"
+                )
+            self.expect(")")
+            return
+        se.logicalType = lt
+        if ct is not None:
+            se.converted_type = ct
+        self.expect(")")
+
+    def parse_unit_bool(self, what: str) -> tuple[TimeUnit, bool]:
+        self.expect("(")
+        unit_tok = self.expect("ident", "time unit")
+        unit = TimeUnit()
+        if unit_tok.val == "MILLIS":
+            unit.MILLIS = MilliSeconds()
+        elif unit_tok.val == "MICROS":
+            unit.MICROS = MicroSeconds()
+        elif unit_tok.val == "NANOS":
+            unit.NANOS = NanoSeconds()
+        else:
+            raise SchemaParseError(
+                f"unknown unit annotation {unit_tok.val!r} for {what}",
+                unit_tok.line,
+            )
+        self.expect(",")
+        utc = self.parse_bool(what)
+        self.expect(")")
+        return unit, utc
+
+    def parse_bool(self, what: str) -> bool:
+        tok = self.expect("ident", "boolean")
+        if tok.val == "true":
+            return True
+        if tok.val == "false":
+            return False
+        raise SchemaParseError(
+            f"invalid boolean {tok.val!r} for {what}", tok.line
+        )
+
+
+def _fix_num_children(col: ColumnDefinition) -> None:
+    if col.children:
+        col.element.num_children = len(col.children)
+    for c in col.children:
+        _fix_num_children(c)
+
+
+def parse_schema_definition(text: str) -> SchemaDefinition:
+    """Parse the textual DSL; raises SchemaParseError with a line number."""
+    sd = _Parser(text).parse_message()
+    sd.validate()
+    return sd
+
+
+# --------------------------------------------------------------------------
+# Validation (shape rules for LIST/MAP + type checks per annotation)
+# --------------------------------------------------------------------------
+
+def _lt_member(se: SchemaElement) -> str | None:
+    if se.logicalType is None:
+        return None
+    return se.logicalType.set_member()[0]
+
+
+def _validate_column(col: ColumnDefinition, is_root: bool, strict: bool) -> None:
+    se = col.element
+    if se is None:
+        raise SchemaValidationError("column has no schema element")
+    if not se.name:
+        raise SchemaValidationError("column has no name")
+    if not is_root and not col.children and se.type is None:
+        raise SchemaValidationError(
+            f"field {se.name} has neither children nor a type"
+        )
+    if se.type is not None and col.children:
+        raise SchemaValidationError(
+            f"field {se.name} has a type but also children"
+        )
+
+    lt = _lt_member(se)
+    ct = se.converted_type
+    ptype = se.type
+
+    def type_check(cond: bool, msg: str):
+        if not cond:
+            raise SchemaValidationError(f"field {se.name} {msg}")
+
+    if lt == "LIST" or ct == ConvertedType.LIST:
+        _validate_list(col, strict)
+    elif lt == "MAP" or ct in (ConvertedType.MAP, ConvertedType.MAP_KEY_VALUE):
+        _validate_map(col, strict)
+    elif lt == "DATE" or ct == ConvertedType.DATE:
+        type_check(ptype == Type.INT32, "is annotated as DATE but is not an int32")
+    elif lt == "TIMESTAMP":
+        type_check(
+            ptype in (Type.INT64, Type.INT96),
+            "is annotated as TIMESTAMP but is not an int64/int96",
+        )
+    elif lt == "TIME":
+        t = se.logicalType.TIME
+        if t.unit.MILLIS is not None:
+            type_check(ptype == Type.INT32,
+                       "is annotated as TIME(MILLIS) but is not an int32")
+        else:
+            type_check(ptype == Type.INT64,
+                       "is annotated as TIME(MICROS|NANOS) but is not an int64")
+    elif lt == "UUID":
+        type_check(
+            ptype == Type.FIXED_LEN_BYTE_ARRAY and se.type_length == 16,
+            "is annotated as UUID but is not a fixed_len_byte_array(16)",
+        )
+    elif lt == "ENUM":
+        type_check(ptype == Type.BYTE_ARRAY,
+                   "is annotated as ENUM but is not a binary")
+    elif lt == "JSON":
+        type_check(ptype == Type.BYTE_ARRAY,
+                   "is annotated as JSON but is not a binary")
+    elif lt == "BSON":
+        type_check(ptype == Type.BYTE_ARRAY,
+                   "is annotated as BSON but is not a binary")
+    elif lt == "DECIMAL":
+        _validate_decimal(col)
+    elif lt == "INTEGER":
+        it = se.logicalType.INTEGER
+        want = Type.INT64 if it.bitWidth == 64 else Type.INT32
+        type_check(
+            ptype == want,
+            f"is annotated as INT({it.bitWidth}, ...) but element type is "
+            f"{ptype}",
+        )
+    elif ct == ConvertedType.UTF8:
+        type_check(ptype == Type.BYTE_ARRAY,
+                   "is annotated as UTF8 but is not binary")
+    elif ct == ConvertedType.TIME_MILLIS:
+        type_check(ptype == Type.INT32,
+                   "is annotated as TIME_MILLIS but is not int32")
+    elif ct == ConvertedType.TIME_MICROS:
+        type_check(ptype == Type.INT64,
+                   "is annotated as TIME_MICROS but is not int64")
+    elif ct == ConvertedType.TIMESTAMP_MILLIS:
+        type_check(ptype == Type.INT64,
+                   "is annotated as TIMESTAMP_MILLIS but is not int64")
+    elif ct == ConvertedType.TIMESTAMP_MICROS:
+        type_check(ptype == Type.INT64,
+                   "is annotated as TIMESTAMP_MICROS but is not int64")
+    elif ct in (
+        ConvertedType.UINT_8, ConvertedType.UINT_16, ConvertedType.UINT_32,
+        ConvertedType.INT_8, ConvertedType.INT_16, ConvertedType.INT_32,
+    ):
+        type_check(
+            ptype == Type.INT32,
+            f"is annotated as {ConvertedType(ct).name} but is not int32",
+        )
+    elif ct in (ConvertedType.UINT_64, ConvertedType.INT_64):
+        type_check(
+            ptype == Type.INT64,
+            f"is annotated as {ConvertedType(ct).name} but is not int64",
+        )
+    elif ct == ConvertedType.INTERVAL:
+        type_check(
+            ptype == Type.FIXED_LEN_BYTE_ARRAY and se.type_length == 12,
+            "is annotated as INTERVAL but is not a fixed_len_byte_array(12)",
+        )
+    else:
+        for c in col.children:
+            _validate_column(c, is_root=False, strict=strict)
+
+
+def _validate_list(col: ColumnDefinition, strict: bool) -> None:
+    se = col.element
+    if se.type is not None:
+        raise SchemaValidationError(
+            f"field {se.name} is not a group but annotated as LIST"
+        )
+    rep = se.repetition_type
+    if rep not in (FieldRepetitionType.OPTIONAL, FieldRepetitionType.REQUIRED):
+        raise SchemaValidationError(
+            f"field {se.name} is a LIST but has repetition type {rep}"
+        )
+    if len(col.children) != 1:
+        raise SchemaValidationError(
+            f"field {se.name} is a LIST but has {len(col.children)} children"
+        )
+    child = col.children[0]
+    if child.name != "list":
+        if strict:
+            raise SchemaValidationError(
+                f'field {se.name} is a LIST but its child is not named "list"'
+            )
+        # Backward-compatibility forms (LogicalTypes.md rules 1-4):
+        #  1. repeated primitive field     -> field type is the element type
+        #  2. repeated group, >1 children  -> the group is the element type
+        #  3. repeated group named "array"/"<name>_tuple"/"bag", 1 child
+        #  4. otherwise, repeated group with 1 child is the element itself
+        if child.element.type is None and not child.children:
+            raise SchemaValidationError(
+                f"field {se.name} is a LIST but the repeated group inside it "
+                'is not called "list" and contains no fields'
+            )
+    else:
+        if (child.element.type is not None
+                or child.element.repetition_type != FieldRepetitionType.REPEATED):
+            raise SchemaValidationError(
+                f"field {se.name} is a LIST but its child is not a repeated group"
+            )
+        if len(child.children) != 1:
+            raise SchemaValidationError(
+                f"field {se.name}.list has {len(child.children)} children"
+            )
+        elem = child.children[0]
+        if elem.name != "element":
+            raise SchemaValidationError(
+                f'{se.name}.list has a child but it\'s called '
+                f'{elem.name!r}, not "element"'
+            )
+        erep = elem.element.repetition_type
+        if erep not in (FieldRepetitionType.OPTIONAL, FieldRepetitionType.REQUIRED):
+            raise SchemaValidationError(
+                f"{se.name}.list.element has disallowed repetition type {erep}"
+            )
+    # Validate the repeated child itself (covers backward-compat form 1,
+    # where the element is a repeated primitive and has no children of its
+    # own) — annotations on it must still type-check.
+    _validate_column(child, is_root=False, strict=strict)
+
+
+def _validate_map(col: ColumnDefinition, strict: bool) -> None:
+    se = col.element
+    if strict and se.converted_type == ConvertedType.MAP_KEY_VALUE:
+        raise SchemaValidationError(
+            f"field {se.name} is incorrectly annotated as MAP_KEY_VALUE"
+        )
+    if se.type is not None:
+        raise SchemaValidationError(
+            f"field {se.name} is not a group but annotated as MAP"
+        )
+    if len(col.children) != 1:
+        raise SchemaValidationError(
+            f"field {se.name} is a MAP but has {len(col.children)} children"
+        )
+    kv = col.children[0]
+    if (kv.element.type is not None
+            or kv.element.repetition_type != FieldRepetitionType.REPEATED):
+        raise SchemaValidationError(
+            f"field {se.name} is a MAP but its child is not a repeated group"
+        )
+    if strict:
+        if kv.name != "key_value":
+            raise SchemaValidationError(
+                f'field {se.name} is a MAP but its child is not named "key_value"'
+            )
+        found_key = found_value = False
+        for c in kv.children:
+            if c.name == "key":
+                if c.element.repetition_type != FieldRepetitionType.REQUIRED:
+                    raise SchemaValidationError(
+                        f'field {se.name}.key_value.key is not of repetition '
+                        'type "required"'
+                    )
+                found_key = True
+            elif c.name == "value":
+                found_value = True
+            else:
+                raise SchemaValidationError(
+                    f"field {se.name} is a MAP so {se.name}.key_value.{c.name} "
+                    "is not allowed"
+                )
+        if not found_key:
+            raise SchemaValidationError(
+                f"field {se.name} is missing {se.name}.key_value.key"
+            )
+        if not found_value:
+            raise SchemaValidationError(
+                f"field {se.name} is missing {se.name}.key_value.value"
+            )
+    else:
+        if len(kv.children) != 2:
+            raise SchemaValidationError(
+                f"field {se.name} is a MAP but {se.name}.{kv.name} contains "
+                f"{len(kv.children)} children (expected 2)"
+            )
+    for c in kv.children:
+        _validate_column(c, is_root=False, strict=strict)
+
+
+def _validate_decimal(col: ColumnDefinition) -> None:
+    se = col.element
+    dec = se.logicalType.DECIMAL
+    ptype = se.type
+    if ptype == Type.INT32:
+        lo, hi = 1, 9
+    elif ptype == Type.INT64:
+        lo, hi = 1, 18
+    elif ptype == Type.FIXED_LEN_BYTE_ARRAY:
+        # Spec: precision <= floor(log10(2^(8n-1) - 1)); for n=16 that is 38
+        # (decimal128, as pyarrow/Spark emit).  floor(log10(x)) == digits-1.
+        n = se.type_length or 0
+        lo, hi = 1, len(str(2 ** (8 * n - 1) - 1)) - 1 if n else 0
+    elif ptype == Type.BYTE_ARRAY:
+        lo, hi = 1, None
+    else:
+        raise SchemaValidationError(
+            f"field {se.name} is annotated as DECIMAL but type {ptype} is "
+            "unsupported"
+        )
+    if dec.precision < lo or (hi is not None and dec.precision > hi):
+        raise SchemaValidationError(
+            f"field {se.name} is annotated as DECIMAL but precision "
+            f"{dec.precision} is out of bounds"
+        )

@@ -1,0 +1,229 @@
+"""Runtime schema tree: Dremel repetition/definition levels, projection.
+
+The reader/writer-facing counterpart of the reference's ``Column`` tree
+(``/root/reference/schema.go:23-135`` accessors, ``recursiveFix`` :585 for
+level assignment, ``setSelectedColumns``/``isSelected`` :292-312 for column
+projection).  A ``SchemaNode`` wraps one thrift ``SchemaElement``; levels
+follow the Dremel rules:
+
+* ``max_def_level`` = count of non-REQUIRED ancestors including self
+  (root excluded),
+* ``max_rep_level`` = count of REPEATED ancestors including self.
+"""
+
+from __future__ import annotations
+
+from .dsl import (
+    ColumnDefinition,
+    SchemaDefinition,
+    SchemaValidationError,
+    parse_schema_definition,
+)
+from .metadata import FieldRepetitionType, SchemaElement, Type
+
+__all__ = ["SchemaNode", "Schema"]
+
+
+def _build_node(cd: ColumnDefinition, parent: "SchemaNode | None") -> "SchemaNode":
+    node = SchemaNode(cd.element, parent)
+    for child in cd.children:
+        node.children.append(_build_node(child, node))
+    return node
+
+
+class SchemaNode:
+    """One node of the runtime schema tree."""
+
+    __slots__ = (
+        "element", "children", "parent", "path",
+        "max_rep_level", "max_def_level", "store",
+    )
+
+    def __init__(self, element: SchemaElement, parent: "SchemaNode | None" = None):
+        self.element = element
+        self.children: list[SchemaNode] = []
+        self.parent = parent
+        self.path: tuple[str, ...] = ()
+        self.max_rep_level = 0
+        self.max_def_level = 0
+        # Attached by the I/O layer: per-leaf column store (None on groups).
+        self.store = None
+
+    # -- accessors (Column accessor parity, schema.go:23-135) --------------
+
+    @property
+    def name(self) -> str:
+        return self.element.name
+
+    @property
+    def flat_name(self) -> str:
+        return ".".join(self.path)
+
+    @property
+    def type(self) -> Type | None:
+        return self.element.type
+
+    @property
+    def repetition_type(self) -> FieldRepetitionType | None:
+        return self.element.repetition_type
+
+    @property
+    def is_leaf(self) -> bool:
+        return self.element.type is not None
+
+    @property
+    def is_repeated(self) -> bool:
+        return self.element.repetition_type == FieldRepetitionType.REPEATED
+
+    @property
+    def is_required(self) -> bool:
+        return self.element.repetition_type == FieldRepetitionType.REQUIRED
+
+    def __repr__(self):
+        kind = "leaf" if self.is_leaf else "group"
+        return (
+            f"SchemaNode({self.flat_name or '<root>'}, {kind}, "
+            f"maxR={self.max_rep_level}, maxD={self.max_def_level})"
+        )
+
+
+class Schema:
+    """Schema tree + column projection.
+
+    Construction from a footer's flat element list, from a parsed DSL
+    definition, or programmatically by adding nodes.  ``leaves`` lists data
+    columns in depth-first order — the same order column chunks appear in a
+    row group.
+    """
+
+    def __init__(self, root: SchemaNode):
+        self.root = root
+        self.leaves: list[SchemaNode] = []
+        self.selected: list[tuple[str, ...]] = []  # empty = all selected
+        self._refresh()
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_elements(cls, elems: list[SchemaElement]) -> "Schema":
+        sd = SchemaDefinition.from_schema_elements(elems)
+        return cls.from_definition(sd)
+
+    @classmethod
+    def from_definition(cls, sd: SchemaDefinition) -> "Schema":
+        return cls(_build_node(sd.root, None))
+
+    @classmethod
+    def from_string(cls, text: str) -> "Schema":
+        return cls.from_definition(parse_schema_definition(text))
+
+    @classmethod
+    def empty(cls, name: str = "msg") -> "Schema":
+        return cls(SchemaNode(SchemaElement(name=name)))
+
+    def add_node(self, parent_path: str, cd: ColumnDefinition) -> SchemaNode:
+        """Programmatic schema building (≙ AddGroup/AddColumn,
+        ``schema.go:569-583``): attach a column definition subtree under the
+        group identified by dotted ``parent_path`` ('' = root)."""
+        parent = self.root if not parent_path else self._node_at(parent_path)
+        if parent is None:
+            raise SchemaValidationError(f"no such group: {parent_path!r}")
+        if parent.is_leaf:
+            raise SchemaValidationError(
+                f"{parent_path!r} is a data column, cannot add children"
+            )
+        node = _build_node(cd, parent)
+        parent.children.append(node)
+        self._refresh()
+        return node
+
+    # -- maintenance -------------------------------------------------------
+
+    def _refresh(self) -> None:
+        """Recompute paths, levels and the leaf list (≙ recursiveFix)."""
+        self.leaves = []
+
+        def walk(node: SchemaNode, path: tuple, d: int, r: int):
+            node.path = path
+            node.max_def_level = d
+            node.max_rep_level = r
+            if node.is_leaf:
+                self.leaves.append(node)
+            num = len(node.children)
+            node.element.num_children = num if num else None
+            for child in node.children:
+                cd = d + (0 if child.is_required else 1)
+                cr = r + (1 if child.is_repeated else 0)
+                walk(child, path + (child.name,), cd, cr)
+
+        if self.root.is_leaf:
+            raise SchemaValidationError("schema root cannot be a data column")
+        walk(self.root, (), 0, 0)
+
+    # -- navigation --------------------------------------------------------
+
+    def _node_at(self, flat_name: str) -> SchemaNode | None:
+        parts = flat_name.split(".")
+        node = self.root
+        for p in parts:
+            for c in node.children:
+                if c.name == p:
+                    node = c
+                    break
+            else:
+                return None
+        return node
+
+    def leaf(self, flat_name: str) -> SchemaNode | None:
+        node = self._node_at(flat_name)
+        return node if node is not None and node.is_leaf else None
+
+    def to_elements(self) -> list[SchemaElement]:
+        out: list[SchemaElement] = []
+
+        def walk(node: SchemaNode):
+            out.append(node.element)
+            for c in node.children:
+                walk(c)
+
+        walk(self.root)
+        return out
+
+    def definition(self) -> SchemaDefinition:
+        """Return the DSL view (≙ GetSchemaDefinition)."""
+        def build(node: SchemaNode) -> ColumnDefinition:
+            return ColumnDefinition(node.element, [build(c) for c in node.children])
+
+        return SchemaDefinition(build(self.root))
+
+    # -- projection (≙ setSelectedColumns/isSelected) ----------------------
+
+    def set_selected_columns(self, *flat_names: str) -> None:
+        """Restrict reading to the given dotted paths (and their subtrees).
+        No arguments = select everything."""
+        sel = []
+        for fn in flat_names:
+            if self._node_at(fn) is None:
+                raise SchemaValidationError(f"column {fn!r} is not in the schema")
+            sel.append(tuple(fn.split(".")))
+        self.selected = sel
+
+    def is_selected(self, node_or_path) -> bool:
+        """A node is selected if the selection is empty, or if any selected
+        path is a prefix of the node's path (subtree selection) or the node's
+        path is a prefix of a selected path (ancestors stay for structure)."""
+        if not self.selected:
+            return True
+        path = (
+            node_or_path.path
+            if isinstance(node_or_path, SchemaNode)
+            else tuple(node_or_path.split("."))
+        )
+        for sel in self.selected:
+            n = min(len(sel), len(path))
+            if sel[:n] == path[:n]:
+                return True
+        return False
+
+    def __str__(self) -> str:
+        return str(self.definition())
