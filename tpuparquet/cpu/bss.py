@@ -1,0 +1,27 @@
+"""BYTE_STREAM_SPLIT codec (NumPy): scatter value bytes into K streams.
+
+In the Encoding enum (``parquet.thrift:468``) but unimplemented by the
+reference; trivial as a transpose here, and it measurably improves the
+compressibility of float columns."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["encode_byte_stream_split", "decode_byte_stream_split"]
+
+
+def encode_byte_stream_split(values: np.ndarray) -> bytes:
+    v = np.ascontiguousarray(values)
+    k = v.dtype.itemsize
+    return v.view(np.uint8).reshape(-1, k).T.tobytes()
+
+
+def decode_byte_stream_split(data, count: int, dtype) -> np.ndarray:
+    dt = np.dtype(dtype)
+    k = dt.itemsize
+    need = count * k
+    if len(data) < need:
+        raise ValueError("BYTE_STREAM_SPLIT: input too short")
+    streams = np.frombuffer(data, dtype=np.uint8, count=need).reshape(k, count)
+    return np.ascontiguousarray(streams.T).reshape(-1).view(dt)

@@ -1,0 +1,109 @@
+"""RLE_DICTIONARY index codec + dictionary build/gather (NumPy).
+
+Wire format (``/root/reference/type_dict.go:22-59,161-196``): a data page of
+dictionary-encoded values is one byte of index bit-width followed by an
+unprefixed hybrid RLE/bit-packed stream of dictionary indices.  Dictionary
+*pages* hold the distinct values PLAIN-encoded (handled by the page layer).
+
+The write-side dictionary is built with ``np.unique`` in one shot at flush
+time instead of the reference's per-value interning hash map
+(``type_dict.go:93-143``) — same result, vectorized.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .hybrid import decode_hybrid, encode_hybrid
+from .plain import ByteArrayColumn
+
+__all__ = [
+    "decode_dict_indices",
+    "encode_dict_indices",
+    "gather",
+    "build_dictionary",
+]
+
+
+def decode_dict_indices(data, count: int) -> np.ndarray:
+    """Decode (bit_width byte + hybrid stream) to int32 indices."""
+    if count == 0:
+        return np.empty(0, dtype=np.int32)
+    if len(data) < 1:
+        raise ValueError("empty dictionary-index stream")
+    width = data[0]
+    if width > 32:
+        raise ValueError(f"dictionary index bit width {width} > 32")
+    if width == 0:
+        return np.zeros(count, dtype=np.int32)
+    return decode_hybrid(data, count, width, pos=1).astype(np.int32)
+
+
+def encode_dict_indices(indices, dict_size: int) -> bytes:
+    """Encode int indices as (bit_width byte + hybrid stream)."""
+    width = max(int(dict_size - 1).bit_length(), 1) if dict_size > 1 else 1
+    return bytes([width]) + encode_hybrid(
+        np.asarray(indices, dtype=np.uint32), width
+    )
+
+
+def gather(dictionary, indices: np.ndarray):
+    """Materialize values from dictionary + indices.
+
+    ndarray dictionaries gather with fancy indexing; ByteArrayColumn
+    dictionaries gather into a new offsets+data pair (the same shape the
+    Pallas dict-gather kernel produces on device)."""
+    idx = np.asarray(indices)
+    if isinstance(dictionary, ByteArrayColumn):
+        if idx.size and (idx.min() < 0 or idx.max() >= len(dictionary)):
+            raise ValueError("dictionary index out of range")
+        lens = dictionary.lengths()[idx]
+        offsets = np.zeros(idx.size + 1, dtype=np.int64)
+        np.cumsum(lens, out=offsets[1:])
+        out = np.empty(int(offsets[-1]), dtype=np.uint8)
+        src_off = dictionary.offsets
+        data = dictionary.data
+        for i, j in enumerate(idx):
+            out[offsets[i] : offsets[i + 1]] = data[src_off[j] : src_off[j + 1]]
+        return ByteArrayColumn(offsets, out)
+    arr = np.asarray(dictionary)
+    if idx.size and (idx.min() < 0 or idx.max() >= len(arr)):
+        raise ValueError("dictionary index out of range")
+    return arr[idx]
+
+
+def build_dictionary(values):
+    """Return (dictionary, indices) preserving first-occurrence order.
+
+    First-occurrence order matches what an interning writer produces, so
+    files we write look like the reference's (and parquet-mr's) output.
+    """
+    if isinstance(values, (list, tuple)):
+        # np.asarray on a list of bytes coerces to a fixed 'S' dtype that
+        # strips trailing NULs — go through ByteArrayColumn instead.
+        values = ByteArrayColumn.from_list(values)
+    if isinstance(values, ByteArrayColumn):
+        vals = values.to_list()
+        seen: dict = {}
+        indices = np.empty(len(vals), dtype=np.int32)
+        for i, v in enumerate(vals):
+            j = seen.get(v)
+            if j is None:
+                j = len(seen)
+                seen[v] = j
+            indices[i] = j
+        return ByteArrayColumn.from_list(list(seen)), indices
+    arr = np.asarray(values)
+    if arr.ndim == 2:  # FIXED_LEN_BYTE_ARRAY / INT96 rows
+        uniq, first_idx, inv = np.unique(
+            arr, axis=0, return_index=True, return_inverse=True
+        )
+    else:
+        uniq, first_idx, inv = np.unique(
+            arr, return_index=True, return_inverse=True
+        )
+    # np.unique sorts; remap to first-occurrence order.
+    order = np.argsort(first_idx, kind="stable")
+    rank = np.empty_like(order)
+    rank[order] = np.arange(order.size)
+    return uniq[order], rank[inv].astype(np.int32)

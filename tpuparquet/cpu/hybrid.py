@@ -1,0 +1,140 @@
+"""RLE / bit-packed hybrid encoding (NumPy).
+
+Wire format (same as decoded by ``/root/reference/hybrid_decoder.go:143-166``):
+a stream of runs, each headed by a uvarint ``h``:
+
+* ``h & 1 == 1``: bit-packed run of ``(h >> 1) * 8`` values, LSB-first.
+* ``h & 1 == 0``: RLE run of ``h >> 1`` copies of one value stored in
+  ``ceil(width / 8)`` little-endian bytes.
+
+The level-stream/dict-index form is prefixed with a 4-byte LE total length
+(``hybrid_decoder.go:57``, ``initSize``).
+
+Unlike the reference's value-at-a-time ``next()`` (and its encoder, which
+only ever emits bit-packed runs — ``hybrid_encoder.go:55-70``), decode
+parses the run structure once into a run table and expands each run with
+vectorized ops; encode chooses RLE for long constant stretches, which is
+both legal and smaller.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from ..varint import read_uvarint, write_uvarint
+from .bitpack import pack, unpack
+
+__all__ = [
+    "decode_hybrid",
+    "decode_hybrid_prefixed",
+    "encode_hybrid",
+    "encode_hybrid_prefixed",
+]
+
+
+def decode_hybrid(data, count: int, width: int, pos: int = 0) -> np.ndarray:
+    """Decode exactly ``count`` values of the given bit ``width``.
+
+    Trailing bytes after the needed runs are ignored (pages may pad)."""
+    if width == 0:
+        return np.zeros(count, dtype=np.uint32)
+    dtype = np.uint64 if width > 32 else np.uint32
+    out = np.empty(count, dtype=dtype)
+    filled = 0
+    vbytes = (width + 7) // 8
+    buf = data if isinstance(data, (bytes, bytearray, memoryview)) else bytes(data)
+    while filled < count:
+        h, pos = read_uvarint(buf, pos)
+        if h & 1:
+            n = (h >> 1) * 8
+            nbytes = (n * width + 7) // 8
+            if pos + nbytes > len(buf):
+                raise ValueError("truncated bit-packed run")
+            vals = unpack(buf[pos : pos + nbytes], n, width)
+            pos += nbytes
+            take = min(n, count - filled)
+            out[filled : filled + take] = vals[:take]
+            filled += take
+        else:
+            n = h >> 1
+            if n == 0:
+                raise ValueError("zero-length RLE run")
+            if pos + vbytes > len(buf):
+                raise ValueError("truncated RLE run value")
+            v = int.from_bytes(buf[pos : pos + vbytes], "little")
+            pos += vbytes
+            take = min(n, count - filled)
+            out[filled : filled + take] = v
+            filled += take
+    return out
+
+
+def decode_hybrid_prefixed(data, count: int, width: int, pos: int = 0):
+    """Decode the 4-byte-length-prefixed form; returns (values, end_pos)."""
+    if pos + 4 > len(data):
+        raise ValueError("truncated hybrid length prefix")
+    (size,) = struct.unpack_from("<I", data, pos)
+    pos += 4
+    end = pos + size
+    if end > len(data):
+        raise ValueError(f"hybrid stream length {size} exceeds buffer")
+    return decode_hybrid(data[pos:end], count, width), end
+
+
+_MIN_RLE_RUN = 8  # break even vs bit-packing for typical widths
+
+
+def encode_hybrid(values, width: int) -> bytes:
+    """Encode values with RLE for constant stretches >= 8, else bit-packing.
+
+    Bit-packed runs cover groups of 8 values; the final partial group is
+    padded with zeros (readers stop at the value count)."""
+    v = np.asarray(values, dtype=np.uint64)
+    out = bytearray()
+    if width == 0 or v.size == 0:
+        return bytes(out)
+    vbytes = (width + 7) // 8
+
+    # Find constant runs via change points, then consider only the runs
+    # long enough for RLE — random data has ~n runs and looping them all
+    # in Python would dominate encode time.
+    change = np.nonzero(np.diff(v))[0] + 1
+    starts = np.concatenate(([0], change))
+    ends = np.concatenate((change, [v.size]))
+    long_runs = np.nonzero(ends - starts >= _MIN_RLE_RUN)[0]
+
+    def emit_bitpacked(lo: int, hi: int) -> None:
+        n = hi - lo
+        if n <= 0:
+            return
+        groups = (n + 7) // 8
+        padded = np.zeros(groups * 8, dtype=np.uint64)
+        padded[:n] = v[lo:hi]
+        write_uvarint(out, (groups << 1) | 1)
+        out.extend(pack(padded, width))
+
+    # Greedily emit: RLE for long constant runs, bit-packed for the rest.
+    # Bit-packed runs must cover a multiple of 8 values, so the boundary
+    # in front of an RLE run is rounded to the pending-group edge and the
+    # overhang carved off the front of the RLE run.
+    pending = 0  # start of the current not-yet-emitted bit-packed region
+    for ri in long_runs:
+        s = int(starts[ri])
+        e = int(ends[ri])
+        flush_end = s
+        if (flush_end - pending) % 8:
+            flush_end = min(pending + ((s - pending + 7) // 8) * 8, e)
+        emit_bitpacked(pending, flush_end)
+        if e - flush_end >= 1:
+            write_uvarint(out, (e - flush_end) << 1)
+            out.extend(int(v[s]).to_bytes(vbytes, "little"))
+        pending = e
+    emit_bitpacked(pending, v.size)
+    return bytes(out)
+
+
+def encode_hybrid_prefixed(values, width: int) -> bytes:
+    body = encode_hybrid(values, width)
+    return struct.pack("<I", len(body)) + body

@@ -1,0 +1,95 @@
+"""Definition/repetition level codecs + null-mask derivation (NumPy).
+
+Levels are hybrid-RLE-encoded: V1 data pages carry a 4-byte length prefix
+per level stream (``helpers.go:260-271`` / ``page_v1.go:27-55``); V2 pages
+store the streams raw with their byte lengths in the page header
+(``page_v2.go:73-129``, ``helpers.go:272-282``).  A column with
+``max_level == 0`` has no stream at all — every level is 0
+(``constDecoder``, ``helpers.go:208``).
+
+``decode_levels`` also returns what the fused TPU kernel produces: the
+non-null count (values with ``def == max_def`` are present —
+``decodePackedArray``, ``helpers.go:131-147``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .bitpack import unpack_msb
+from .hybrid import (
+    decode_hybrid,
+    decode_hybrid_prefixed,
+    encode_hybrid,
+    encode_hybrid_prefixed,
+)
+
+__all__ = [
+    "bit_width",
+    "decode_levels_v1",
+    "decode_levels_raw",
+    "decode_levels_bitpacked",
+    "encode_levels_v1",
+    "encode_levels_v2",
+    "null_mask",
+]
+
+
+def bit_width(max_level: int) -> int:
+    """Bits needed for levels 0..max_level (``bits.Len16`` equivalent)."""
+    return int(max_level).bit_length()
+
+
+def decode_levels_v1(data, count: int, max_level: int, pos: int = 0):
+    """Length-prefixed RLE level stream; returns (levels, end_pos)."""
+    if max_level == 0:
+        return np.zeros(count, dtype=np.int32), pos
+    vals, pos = decode_hybrid_prefixed(data, count, bit_width(max_level), pos)
+    return _check(vals, max_level), pos
+
+
+def decode_levels_raw(data, count: int, max_level: int):
+    """Unprefixed RLE level stream (V2 pages; byte length known from the
+    page header, so ``data`` is exactly the stream)."""
+    if max_level == 0:
+        return np.zeros(count, dtype=np.int32)
+    return _check(decode_hybrid(data, count, bit_width(max_level)), max_level)
+
+
+def decode_levels_bitpacked(data, count: int, max_level: int):
+    """Deprecated BIT_PACKED (MSB-first) level encoding."""
+    if max_level == 0:
+        return np.zeros(count, dtype=np.int32)
+    return _check(unpack_msb(data, count, bit_width(max_level)), max_level)
+
+
+def _check(vals, max_level: int) -> np.ndarray:
+    out = vals.astype(np.int32)
+    if out.size and out.max() > max_level:
+        raise ValueError(
+            f"level value {int(out.max())} exceeds max level {max_level}"
+        )
+    return out
+
+
+def encode_levels_v1(levels, max_level: int) -> bytes:
+    if max_level == 0:
+        return b""
+    return encode_hybrid_prefixed(
+        np.asarray(levels, dtype=np.uint32), bit_width(max_level)
+    )
+
+
+def encode_levels_v2(levels, max_level: int) -> bytes:
+    if max_level == 0:
+        return b""
+    return encode_hybrid(
+        np.asarray(levels, dtype=np.uint32), bit_width(max_level)
+    )
+
+
+def null_mask(def_levels: np.ndarray, max_def: int) -> np.ndarray:
+    """True where a value is present (non-null) at this leaf."""
+    if max_def == 0:
+        return np.ones(len(def_levels), dtype=bool)
+    return np.asarray(def_levels) == max_def

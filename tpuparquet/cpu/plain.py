@@ -1,0 +1,205 @@
+"""PLAIN codecs for the 8 physical types, plus the Arrow-style
+variable-length column representation used throughout the framework.
+
+Value representation choices (TPU-first, per SURVEY.md §7 "hard parts"):
+
+* fixed-width types decode straight to NumPy arrays via buffer reinterpret
+  (little-endian on the wire == native on every platform we target);
+* BOOLEAN plain is 1 bit/value LSB-first (``type_boolean.go:54-98``);
+* INT96 decodes to an ``(N, 3)`` uint32 array (12 bytes/value, the
+  low 8 bytes are nanoseconds-in-day, the top 4 the Julian day —
+  ``type_int96.go:21-66``, ``int96_time.go``);
+* BYTE_ARRAY decodes to offsets+data (:class:`ByteArrayColumn`) rather than
+  per-value objects — columnar consumers and the device path want Arrow
+  layout, not boxed values (``type_bytearray.go:24-55`` materializes
+  per-value slices instead);
+* FIXED_LEN_BYTE_ARRAY decodes to an ``(N, L)`` uint8 matrix.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..format.metadata import Type
+from .bitpack import pack as bitpack_pack
+from .bitpack import unpack as bitpack_unpack
+
+__all__ = ["ByteArrayColumn", "decode_plain", "encode_plain", "PHYSICAL_DTYPES"]
+
+PHYSICAL_DTYPES = {
+    Type.BOOLEAN: np.dtype(np.bool_),
+    Type.INT32: np.dtype("<i4"),
+    Type.INT64: np.dtype("<i8"),
+    Type.FLOAT: np.dtype("<f4"),
+    Type.DOUBLE: np.dtype("<f8"),
+}
+
+
+class ByteArrayColumn:
+    """Arrow-style variable-length binary column: int32 offsets + byte data.
+
+    ``offsets`` has ``N + 1`` entries; value ``i`` is
+    ``data[offsets[i]:offsets[i+1]]``.
+    """
+
+    __slots__ = ("offsets", "data")
+
+    def __init__(self, offsets: np.ndarray, data: np.ndarray):
+        self.offsets = np.asarray(offsets, dtype=np.int64)
+        self.data = np.asarray(data, dtype=np.uint8)
+
+    def __len__(self) -> int:
+        return len(self.offsets) - 1
+
+    def __getitem__(self, i: int) -> bytes:
+        return self.data[self.offsets[i] : self.offsets[i + 1]].tobytes()
+
+    def lengths(self) -> np.ndarray:
+        return np.diff(self.offsets)
+
+    def to_list(self) -> list[bytes]:
+        data = self.data.tobytes()
+        offs = self.offsets
+        return [data[offs[i] : offs[i + 1]] for i in range(len(self))]
+
+    @classmethod
+    def from_list(cls, values) -> "ByteArrayColumn":
+        lengths = np.fromiter(
+            (len(v) for v in values), dtype=np.int64, count=len(values)
+        )
+        offsets = np.zeros(len(values) + 1, dtype=np.int64)
+        np.cumsum(lengths, out=offsets[1:])
+        data = np.frombuffer(b"".join(bytes(v) for v in values), dtype=np.uint8)
+        return cls(offsets, data)
+
+    def __eq__(self, other):
+        if not isinstance(other, ByteArrayColumn):
+            return NotImplemented
+        return (
+            np.array_equal(self.offsets, other.offsets)
+            and np.array_equal(self.data, other.data)
+        )
+
+    def __repr__(self):
+        return f"ByteArrayColumn(n={len(self)}, nbytes={self.data.size})"
+
+
+def decode_plain(ptype: Type, data, count: int, type_length: int | None = None):
+    """Decode ``count`` PLAIN-encoded values; returns an ndarray or
+    ByteArrayColumn.  ``data`` may carry trailing bytes (ignored).
+
+    Fixed-width results are **zero-copy views** over ``data`` (the point of
+    the Arrow-layout design).  Callers that pass a *mutable* buffer they
+    intend to reuse (a decompression scratch ``bytearray``) must copy; the
+    page layer hands each page a freshly-allocated immutable buffer."""
+    buf = memoryview(data) if not isinstance(data, memoryview) else data
+    if ptype == Type.BOOLEAN:
+        return bitpack_unpack(buf, count, 1).astype(np.bool_)
+    if ptype in (Type.INT32, Type.INT64, Type.FLOAT, Type.DOUBLE):
+        dt = PHYSICAL_DTYPES[ptype]
+        need = count * dt.itemsize
+        if len(buf) < need:
+            raise ValueError(
+                f"PLAIN {ptype.name}: need {need} bytes for {count} values, "
+                f"have {len(buf)}"
+            )
+        return np.frombuffer(buf[:need], dtype=dt)
+    if ptype == Type.INT96:
+        need = count * 12
+        if len(buf) < need:
+            raise ValueError("PLAIN INT96: input too short")
+        return np.frombuffer(buf[:need], dtype="<u4").reshape(count, 3)
+    if ptype == Type.FIXED_LEN_BYTE_ARRAY:
+        if not type_length:
+            raise ValueError("FIXED_LEN_BYTE_ARRAY requires type_length")
+        need = count * type_length
+        if len(buf) < need:
+            raise ValueError("PLAIN FIXED_LEN_BYTE_ARRAY: input too short")
+        return np.frombuffer(buf[:need], dtype=np.uint8).reshape(
+            count, type_length
+        )
+    if ptype == Type.BYTE_ARRAY:
+        return _decode_plain_byte_array(buf, count)
+    raise ValueError(f"unsupported physical type {ptype}")
+
+
+def _decode_plain_byte_array(buf: memoryview, count: int) -> ByteArrayColumn:
+    """Parse ``count`` (u32-LE length, bytes) records into offsets+data.
+
+    The length prefixes sit at data-dependent positions, so this is a scan;
+    it runs at Python speed per *value* only for the offsets — the payload
+    copy is one slice per value.  (The device path replaces this wholesale.)
+    """
+    offsets = np.zeros(count + 1, dtype=np.int64)
+    positions = np.zeros(count, dtype=np.int64)
+    raw = np.frombuffer(buf, dtype=np.uint8)
+    pos = 0
+    total = 0
+    n = len(buf)
+    for i in range(count):
+        if pos + 4 > n:
+            raise ValueError(
+                f"PLAIN BYTE_ARRAY: truncated length prefix at value {i}"
+            )
+        ln = int(raw[pos]) | int(raw[pos + 1]) << 8 | int(raw[pos + 2]) << 16 \
+            | int(raw[pos + 3]) << 24
+        pos += 4
+        if ln < 0 or pos + ln > n:
+            raise ValueError(
+                f"PLAIN BYTE_ARRAY: length {ln} out of bounds at value {i}"
+            )
+        positions[i] = pos
+        total += ln
+        offsets[i + 1] = total
+        pos += ln
+    data = np.empty(total, dtype=np.uint8)
+    for i in range(count):
+        start = offsets[i]
+        end = offsets[i + 1]
+        data[start:end] = raw[positions[i] : positions[i] + (end - start)]
+    return ByteArrayColumn(offsets, data)
+
+
+def encode_plain(ptype: Type, values, type_length: int | None = None) -> bytes:
+    """PLAIN-encode values (ndarray / ByteArrayColumn / list of bytes)."""
+    if ptype == Type.BOOLEAN:
+        v = np.asarray(values, dtype=np.bool_).astype(np.uint8)
+        return bitpack_pack(v, 1)
+    if ptype in (Type.INT32, Type.INT64, Type.FLOAT, Type.DOUBLE):
+        dt = PHYSICAL_DTYPES[ptype]
+        return np.ascontiguousarray(np.asarray(values, dtype=dt)).tobytes()
+    if ptype == Type.INT96:
+        v = np.asarray(values, dtype="<u4")
+        if v.ndim != 2 or v.shape[1] != 3:
+            raise ValueError("INT96 values must have shape (N, 3) uint32")
+        return np.ascontiguousarray(v).tobytes()
+    if ptype == Type.FIXED_LEN_BYTE_ARRAY:
+        if isinstance(values, ByteArrayColumn):
+            values = values.to_list()
+        if isinstance(values, np.ndarray):
+            v = np.asarray(values, dtype=np.uint8)
+            if not type_length or v.shape[-1] != type_length:
+                raise ValueError("FIXED_LEN_BYTE_ARRAY length mismatch")
+            return np.ascontiguousarray(v).tobytes()
+        out = bytearray()
+        for b in values:
+            if type_length is not None and len(b) != type_length:
+                raise ValueError(
+                    f"FIXED_LEN_BYTE_ARRAY: value length {len(b)} != "
+                    f"{type_length}"
+                )
+            out += bytes(b)
+        return bytes(out)
+    if ptype == Type.BYTE_ARRAY:
+        if not isinstance(values, ByteArrayColumn):
+            values = ByteArrayColumn.from_list(values)
+        lengths = values.lengths().astype("<u4")
+        out = bytearray()
+        data = values.data.tobytes()
+        offs = values.offsets
+        lb = lengths.tobytes()
+        for i in range(len(values)):
+            out += lb[i * 4 : i * 4 + 4]
+            out += data[offs[i] : offs[i + 1]]
+        return bytes(out)
+    raise ValueError(f"unsupported physical type {ptype}")

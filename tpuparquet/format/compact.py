@@ -30,6 +30,8 @@ from __future__ import annotations
 
 import struct as _struct
 
+from ..varint import read_uvarint, write_uvarint, zigzag_decode, zigzag_encode
+
 __all__ = [
     "CT",
     "CompactReader",
@@ -58,14 +60,6 @@ class CT:
     SET = 10
     MAP = 11
     STRUCT = 12
-
-
-def _zigzag_encode(n: int) -> int:
-    return (n << 1) ^ (n >> 63) if n < 0 else n << 1
-
-
-def _zigzag_decode(u: int) -> int:
-    return (u >> 1) ^ -(u & 1)
 
 
 class CompactReader:
@@ -98,19 +92,14 @@ class CompactReader:
         return b
 
     def read_varint(self) -> int:
-        result = 0
-        shift = 0
-        while True:
-            b = self.read_byte()
-            result |= (b & 0x7F) << shift
-            if not b & 0x80:
-                return result
-            shift += 7
-            if shift > 70:
-                raise ThriftError("varint too long")
+        try:
+            v, self.pos = read_uvarint(self.buf[: self.end], self.pos)
+        except ValueError as e:
+            raise ThriftError(str(e)) from None
+        return v
 
     def read_zigzag(self) -> int:
-        return _zigzag_decode(self.read_varint())
+        return zigzag_decode(self.read_varint())
 
     def read_double(self) -> float:
         self._need(8)
@@ -215,20 +204,10 @@ class CompactWriter:
         self.out.append(b & 0xFF)
 
     def write_varint(self, n: int) -> None:
-        if n < 0:
-            raise ThriftError("varint must be non-negative")
-        out = self.out
-        while True:
-            b = n & 0x7F
-            n >>= 7
-            if n:
-                out.append(b | 0x80)
-            else:
-                out.append(b)
-                return
+        write_uvarint(self.out, n)
 
     def write_zigzag(self, n: int) -> None:
-        self.write_varint(_zigzag_encode(n))
+        self.write_varint(zigzag_encode(n))
 
     def write_double(self, v: float) -> None:
         self.out += _struct.pack("<d", v)

@@ -1,0 +1,65 @@
+"""Shared LEB128 varint + zigzag primitives.
+
+Single home for the wire-level integer coding used by both the thrift
+compact protocol (:mod:`tpuparquet.format.compact`) and the data codecs
+(hybrid RLE, DELTA_BINARY_PACKED headers).
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "read_uvarint",
+    "write_uvarint",
+    "zigzag_encode",
+    "zigzag_decode",
+    "read_zigzag",
+    "write_zigzag",
+]
+
+
+def read_uvarint(buf, pos: int) -> tuple[int, int]:
+    """Return (value, new_pos); raises ValueError on truncation/overlength."""
+    result = 0
+    shift = 0
+    n = len(buf)
+    while True:
+        if pos >= n:
+            raise ValueError("truncated uvarint")
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 70:
+            raise ValueError("uvarint too long")
+
+
+def write_uvarint(out: bytearray, n: int) -> None:
+    if n < 0:
+        raise ValueError("uvarint must be non-negative")
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def zigzag_encode(n: int) -> int:
+    return (n << 1) ^ (n >> 63) if n < 0 else n << 1
+
+
+def zigzag_decode(u: int) -> int:
+    return (u >> 1) ^ -(u & 1)
+
+
+def read_zigzag(buf, pos: int) -> tuple[int, int]:
+    u, pos = read_uvarint(buf, pos)
+    return zigzag_decode(u), pos
+
+
+def write_zigzag(out: bytearray, n: int) -> None:
+    write_uvarint(out, zigzag_encode(n))
