@@ -1,0 +1,135 @@
+"""Compression registry + codec tests; pyarrow is the snappy byte oracle."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from tpuparquet.compress import (
+    BlockCompressor,
+    CompressionError,
+    compress_block,
+    decompress_block,
+    get_block_compressor,
+    register_block_compressor,
+    registered_codecs,
+    snappy_compress,
+    snappy_decompress,
+)
+from tpuparquet.format.metadata import CompressionCodec
+
+rng = np.random.default_rng(3)
+
+PAYLOADS = [
+    b"",
+    b"x",
+    b"hello world, " * 1000,
+    b"\x00" * 50_000,
+    rng.integers(0, 255, 100_000, dtype=np.uint8).tobytes(),
+    np.arange(20_000, dtype=np.int64).tobytes(),
+    b"ab" * 30_000,
+]
+
+
+class TestRegistry:
+    def test_builtins_registered(self):
+        codecs = registered_codecs()
+        assert CompressionCodec.UNCOMPRESSED in codecs
+        assert CompressionCodec.GZIP in codecs
+        assert CompressionCodec.SNAPPY in codecs
+        assert CompressionCodec.ZSTD in codecs  # zstandard is in this image
+
+    def test_unregistered_raises(self):
+        with pytest.raises(CompressionError, match="LZO.*not.*registered"):
+            get_block_compressor(CompressionCodec.LZO)
+
+    def test_register_custom(self):
+        class Rot13(BlockCompressor):
+            def compress_block(self, b):
+                return bytes((x + 13) % 256 for x in b)
+
+            def decompress_block(self, b, n):
+                return bytes((x - 13) % 256 for x in b)
+
+        register_block_compressor(CompressionCodec.LZ4, Rot13())
+        try:
+            data = b"pluggable"
+            c = compress_block(CompressionCodec.LZ4, data)
+            assert decompress_block(CompressionCodec.LZ4, c, len(data)) == data
+        finally:
+            import tpuparquet.compress as m
+
+            with m._registry_lock:
+                m._registry.pop(int(CompressionCodec.LZ4), None)
+
+    def test_size_mismatch_raises(self):
+        c = compress_block(CompressionCodec.GZIP, b"hello")
+        with pytest.raises(CompressionError):
+            decompress_block(CompressionCodec.GZIP, c, 99)
+
+
+@pytest.mark.parametrize(
+    "codec",
+    [
+        CompressionCodec.UNCOMPRESSED,
+        CompressionCodec.GZIP,
+        CompressionCodec.SNAPPY,
+        CompressionCodec.ZSTD,
+    ],
+)
+@pytest.mark.parametrize("payload", PAYLOADS, ids=range(len(PAYLOADS)))
+def test_roundtrip(codec, payload):
+    c = compress_block(codec, payload)
+    out = decompress_block(codec, c, len(payload))
+    assert out == payload
+
+
+class TestSnappyCrossImpl:
+    @pytest.mark.parametrize("payload", PAYLOADS, ids=range(len(PAYLOADS)))
+    def test_ours_to_pyarrow(self, payload):
+        ours = snappy_compress(payload)
+        theirs = bytes(
+            pa.decompress(ours, decompressed_size=len(payload), codec="snappy")
+        )
+        assert theirs == payload
+
+    @pytest.mark.parametrize("payload", PAYLOADS, ids=range(len(PAYLOADS)))
+    def test_pyarrow_to_ours(self, payload):
+        theirs = bytes(pa.compress(payload, codec="snappy"))
+        assert snappy_decompress(theirs, len(payload)) == payload
+
+    def test_compression_actually_happens(self):
+        data = b"hello world, " * 1000
+        assert len(snappy_compress(data)) < len(data) // 10
+
+
+class TestSnappyMalformed:
+    def test_empty_block_raises_compression_error(self):
+        # varint errors from the size header must surface as CompressionError
+        with pytest.raises(CompressionError):
+            snappy_decompress(b"", 0)
+        with pytest.raises(CompressionError):
+            snappy_decompress(b"\xff" * 11, None)
+
+    def test_truncated_literal(self):
+        with pytest.raises(CompressionError):
+            snappy_decompress(bytes([10, 5 << 2, 1, 2]), None)
+
+    def test_copy_before_start(self):
+        # copy-2 with offset 100 at output position 0
+        with pytest.raises(CompressionError):
+            snappy_decompress(bytes([4, 0x02, 100, 0]), None)
+
+    def test_zero_offset(self):
+        with pytest.raises(CompressionError):
+            snappy_decompress(bytes([8, 0x00, ord("a"), 0x02, 0, 0]), None)
+
+    def test_size_header_mismatch(self):
+        good = snappy_compress(b"abcdef")
+        with pytest.raises(CompressionError):
+            snappy_decompress(good, 5)
+
+    def test_output_overrun_vs_header(self):
+        # header says 1 byte but literal emits 3
+        blob = bytes([1, 2 << 2, ord("a"), ord("b"), ord("c")])
+        with pytest.raises(CompressionError):
+            snappy_decompress(blob, None)

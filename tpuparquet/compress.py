@@ -1,0 +1,326 @@
+"""Block compression registry + codecs (UNCOMPRESSED, SNAPPY, GZIP, ZSTD).
+
+API parity with the reference's ``compress.go``: a process-wide registry of
+:class:`BlockCompressor` objects keyed by ``CompressionCodec``, with
+``register_block_compressor`` as the public extension hook
+(``compress.go:130``) and built-ins registered at import
+(``compress.go:152-156``).  ``decompress_block`` validates the decoded size
+like ``newBlockReader`` (``compress.go:102-122``).
+
+Snappy is implemented from scratch (the Python image has no snappy
+library): the format is a varint uncompressed-length header followed by
+literal/copy tokens.  The decoder parses the token stream into (literal,
+copy) operations and resolves copies — the same two-pass structure the
+TPU-side decompressor uses (token parse on host, copy resolution on
+device), per SURVEY.md §7 stage 5d.
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+
+import numpy as np
+
+from .format.metadata import CompressionCodec
+from .varint import read_uvarint, write_uvarint
+
+__all__ = [
+    "BlockCompressor",
+    "register_block_compressor",
+    "get_block_compressor",
+    "registered_codecs",
+    "compress_block",
+    "decompress_block",
+    "snappy_compress",
+    "snappy_decompress",
+    "snappy_parse_tokens",
+    "CompressionError",
+]
+
+
+class CompressionError(ValueError):
+    pass
+
+
+class BlockCompressor:
+    """One whole-block codec; subclasses implement both directions."""
+
+    def compress_block(self, block: bytes) -> bytes:
+        raise NotImplementedError
+
+    def decompress_block(self, block: bytes, decompressed_size: int) -> bytes:
+        raise NotImplementedError
+
+
+_registry: dict[int, BlockCompressor] = {}
+_registry_lock = threading.Lock()
+
+
+def register_block_compressor(codec: CompressionCodec, c: BlockCompressor) -> None:
+    with _registry_lock:
+        _registry[int(codec)] = c
+
+
+def get_block_compressor(codec: CompressionCodec) -> BlockCompressor:
+    with _registry_lock:
+        c = _registry.get(int(codec))
+    if c is None:
+        raise CompressionError(
+            f"compression codec {CompressionCodec(codec).name} is not "
+            "registered (register_block_compressor to plug one in)"
+        )
+    return c
+
+
+def registered_codecs() -> list[CompressionCodec]:
+    with _registry_lock:
+        return [CompressionCodec(k) for k in sorted(_registry)]
+
+
+def compress_block(codec: CompressionCodec, block: bytes) -> bytes:
+    return get_block_compressor(codec).compress_block(bytes(block))
+
+
+def decompress_block(
+    codec: CompressionCodec, block, decompressed_size: int
+) -> bytes:
+    out = get_block_compressor(codec).decompress_block(
+        bytes(block), decompressed_size
+    )
+    if len(out) != decompressed_size:
+        raise CompressionError(
+            f"decompressed size {len(out)} != expected {decompressed_size}"
+        )
+    return out
+
+
+# --------------------------------------------------------------------------
+# Built-in codecs
+# --------------------------------------------------------------------------
+
+class _Uncompressed(BlockCompressor):
+    def compress_block(self, block):
+        return block
+
+    def decompress_block(self, block, decompressed_size):
+        return block
+
+
+class _Gzip(BlockCompressor):
+    def compress_block(self, block):
+        co = zlib.compressobj(wbits=16 + zlib.MAX_WBITS)  # gzip framing
+        return co.compress(block) + co.flush()
+
+    def decompress_block(self, block, decompressed_size):
+        try:
+            return zlib.decompress(block, wbits=16 + zlib.MAX_WBITS)
+        except zlib.error as e:
+            raise CompressionError(f"gzip: {e}") from e
+
+
+class _Zstd(BlockCompressor):
+    def __init__(self):
+        import zstandard
+
+        self._zstd = zstandard
+        # ZstdCompressor/ZstdDecompressor contexts are documented as not
+        # shareable across concurrent calls; keep them thread-local.
+        self._local = threading.local()
+
+    def _ctx(self):
+        if not hasattr(self._local, "c"):
+            self._local.c = self._zstd.ZstdCompressor()
+            self._local.d = self._zstd.ZstdDecompressor()
+        return self._local
+
+    def compress_block(self, block):
+        return self._ctx().c.compress(block)
+
+    def decompress_block(self, block, decompressed_size):
+        try:
+            return self._ctx().d.decompress(
+                block, max_output_size=decompressed_size
+            )
+        except Exception as e:
+            raise CompressionError(f"zstd: {e}") from e
+
+
+# --------------------------------------------------------------------------
+# Snappy (from scratch)
+# --------------------------------------------------------------------------
+
+def snappy_parse_tokens(block: bytes):
+    """Parse a snappy block into ``(total_len, ops)``.
+
+    ``ops`` is a list of ``(dst, length, src)`` triples: ``src >= 0`` is a
+    copy from absolute output offset ``src``; ``src == -1`` is a literal
+    whose bytes start at ``dst_literal_pos`` (stored in a parallel slot as
+    ``(dst, length, -1 - input_pos)``).  This op list is exactly what the
+    device copy-resolution kernel consumes.
+    """
+    try:
+        total, pos = read_uvarint(block, pos=0)
+    except ValueError as e:
+        raise CompressionError(f"snappy: bad size header: {e}") from None
+    n = len(block)
+    ops = []
+    out_pos = 0
+    while pos < n:
+        tag = block[pos]
+        kind = tag & 3
+        pos += 1
+        if kind == 0:  # literal
+            ln = tag >> 2
+            if ln >= 60:
+                extra = ln - 59
+                if pos + extra > n:
+                    raise CompressionError("snappy: truncated literal length")
+                ln = int.from_bytes(block[pos : pos + extra], "little")
+                pos += extra
+            ln += 1
+            if pos + ln > n:
+                raise CompressionError("snappy: literal overruns input")
+            ops.append((out_pos, ln, -1 - pos))
+            pos += ln
+            out_pos += ln
+            continue
+        if kind == 1:  # copy with 1-byte offset extension
+            if pos >= n:
+                raise CompressionError("snappy: truncated copy-1")
+            ln = ((tag >> 2) & 0x7) + 4
+            off = ((tag >> 5) << 8) | block[pos]
+            pos += 1
+        elif kind == 2:  # 2-byte offset
+            if pos + 2 > n:
+                raise CompressionError("snappy: truncated copy-2")
+            ln = (tag >> 2) + 1
+            off = int.from_bytes(block[pos : pos + 2], "little")
+            pos += 2
+        else:  # 4-byte offset
+            if pos + 4 > n:
+                raise CompressionError("snappy: truncated copy-4")
+            ln = (tag >> 2) + 1
+            off = int.from_bytes(block[pos : pos + 4], "little")
+            pos += 4
+        if off == 0 or off > out_pos:
+            raise CompressionError(
+                f"snappy: copy offset {off} out of range at output {out_pos}"
+            )
+        ops.append((out_pos, ln, out_pos - off))
+        out_pos += ln
+    if out_pos != total:
+        raise CompressionError(
+            f"snappy: stream produced {out_pos} bytes, header says {total}"
+        )
+    return total, ops
+
+
+def snappy_decompress(block: bytes, expected_size: int | None = None) -> bytes:
+    total, ops = snappy_parse_tokens(block)
+    if expected_size is not None and total != expected_size:
+        raise CompressionError(
+            f"snappy: header size {total} != expected {expected_size}"
+        )
+    out = np.empty(total, dtype=np.uint8)
+    src_buf = np.frombuffer(block, dtype=np.uint8)
+    for dst, ln, src in ops:
+        if src < 0:  # literal from input
+            ip = -1 - src
+            out[dst : dst + ln] = src_buf[ip : ip + ln]
+        elif src + ln <= dst:  # non-overlapping copy
+            out[dst : dst + ln] = out[src : src + ln]
+        else:
+            # Overlapping copy: byte-sequential semantics make it a periodic
+            # extension of the bytes between src and dst, so tile the period.
+            period = dst - src
+            reps = -(-ln // period)
+            out[dst : dst + ln] = np.tile(out[src:dst], reps)[:ln]
+    return out.tobytes()
+
+
+def _emit_literal(out: bytearray, data, lo: int, hi: int) -> None:
+    n = hi - lo
+    while n > 0:
+        chunk = min(n, 65536)  # keep extension lengths <= 2 bytes
+        ln = chunk - 1
+        if ln < 60:
+            out.append(ln << 2)
+        elif ln < 256:
+            out.append(60 << 2)
+            out.append(ln)
+        else:
+            out.append(61 << 2)
+            out += ln.to_bytes(2, "little")
+        out += data[lo : lo + chunk]
+        lo += chunk
+        n -= chunk
+
+
+def _emit_copy(out: bytearray, offset: int, ln: int) -> None:
+    # 2-byte-offset copies (tag 0b10) cover offset <= 65535, len 1..64.
+    off = offset.to_bytes(2, "little")
+    while ln > 64:
+        out.append((63 << 2) | 2)
+        out += off
+        ln -= 64
+    out.append(((ln - 1) << 2) | 2)
+    out += off
+
+
+def snappy_compress(data: bytes) -> bytes:
+    """Greedy hash-match snappy encoder (golang-snappy style, with the
+    standard miss-skip acceleration).  Output is valid snappy that any
+    implementation (incl. pyarrow's) decodes back to ``data``."""
+    data = bytes(data)
+    n = len(data)
+    out = bytearray()
+    write_uvarint(out, n)
+    if n < 4:
+        if n:
+            _emit_literal(out, data, 0, n)
+        return bytes(out)
+    table: dict[int, int] = {}
+    pos = 0
+    lit_start = 0
+    misses = 0
+    while pos + 4 <= n:
+        key = int.from_bytes(data[pos : pos + 4], "little")
+        cand = table.get(key)
+        table[key] = pos
+        if cand is not None and pos - cand <= 65535:
+            # hash hit is exact (key is the literal 4 bytes)
+            length = 4
+            limit = n - pos
+            while (
+                length < limit
+                and data[cand + length] == data[pos + length]
+            ):
+                length += 1
+            _emit_literal(out, data, lit_start, pos)
+            _emit_copy(out, pos - cand, length)
+            pos += length
+            lit_start = pos
+            misses = 0
+        else:
+            misses += 1
+            pos += 1 + (misses >> 5)  # skip faster through incompressible data
+    _emit_literal(out, data, lit_start, n)
+    return bytes(out)
+
+
+class _Snappy(BlockCompressor):
+    def compress_block(self, block):
+        return snappy_compress(block)
+
+    def decompress_block(self, block, decompressed_size):
+        return snappy_decompress(block, decompressed_size)
+
+
+register_block_compressor(CompressionCodec.UNCOMPRESSED, _Uncompressed())
+register_block_compressor(CompressionCodec.GZIP, _Gzip())
+register_block_compressor(CompressionCodec.SNAPPY, _Snappy())
+try:
+    register_block_compressor(CompressionCodec.ZSTD, _Zstd())
+except ImportError:  # zstandard not in this environment: stay pluggable
+    pass
