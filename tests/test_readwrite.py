@@ -1,0 +1,488 @@
+"""End-to-end file read/write tests (the ``readwrite_test.go`` analogue)
+plus pyarrow interop in both directions."""
+
+import io
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from tpuparquet.cpu.plain import ByteArrayColumn
+from tpuparquet.format.metadata import CompressionCodec, Encoding, Type
+from tpuparquet.io import FileReader, FileWriter
+
+CODECS = [
+    CompressionCodec.UNCOMPRESSED,
+    CompressionCodec.SNAPPY,
+    CompressionCodec.GZIP,
+    CompressionCodec.ZSTD,
+]
+
+
+def roundtrip(schema, rows, **opts):
+    buf = io.BytesIO()
+    w = FileWriter(buf, schema, **opts)
+    for row in rows:
+        w.add_data(row)
+    w.close()
+    buf.seek(0)
+    r = FileReader(buf)
+    out = list(r.rows())
+    assert len(out) == len(rows)
+    return out, r
+
+
+@pytest.mark.parametrize("codec", CODECS)
+@pytest.mark.parametrize("v2", [False, True], ids=["v1", "v2"])
+class TestWriteThenRead:
+    def test_flat_all_types(self, codec, v2):
+        schema = (
+            "message m { required int64 i64; optional int32 i32; "
+            "required double d; optional float f; required boolean b; "
+            "optional binary s (STRING); required fixed_len_byte_array(4) fx; "
+            "optional int96 ts; }"
+        )
+        rows = []
+        for i in range(300):
+            rows.append({
+                "i64": i * 1_000_000,
+                "i32": None if i % 9 == 0 else i - 150,
+                "d": i / 7,
+                "f": None if i % 4 == 0 else float(i),
+                "b": i % 3 == 0,
+                "s": None if i % 5 == 0 else f"val_{i % 11}",
+                "fx": bytes([i % 256] * 4),
+                "ts": (i * 1000, i, 2_450_000 + i),
+            })
+        out, _ = roundtrip(schema, rows, codec=codec, data_page_v2=v2)
+        for i, row in enumerate(rows):
+            exp = {k: v for k, v in row.items() if v is not None}
+            exp["s"] = exp["s"].encode() if "s" in exp else None
+            exp = {k: v for k, v in exp.items() if v is not None}
+            if "ts" in exp:
+                exp["ts"] = np.asarray(exp["ts"], dtype="<u4").tobytes()
+            assert out[i] == exp, (i, out[i], exp)
+
+    def test_nested_repeated(self, codec, v2):
+        schema = (
+            "message m { required int64 id; "
+            "repeated group events { required binary kind; "
+            "optional int64 at; repeated int32 vals; } }"
+        )
+        rows = []
+        for i in range(100):
+            events = []
+            for j in range(i % 4):
+                ev = {"kind": f"k{j}".encode(), "vals": list(range(j))}
+                if j % 2:
+                    ev["at"] = i * 10 + j
+                events.append(ev)
+            row = {"id": i}
+            if events:
+                row["events"] = events
+            rows.append(row)
+        out, _ = roundtrip(schema, rows, codec=codec, data_page_v2=v2)
+        for i, row in enumerate(rows):
+            exp = dict(row)
+            if "events" in exp:
+                exp["events"] = [
+                    {k: v for k, v in ev.items() if v != []}
+                    for ev in exp["events"]
+                ]
+            assert out[i] == exp, (i, out[i], exp)
+
+
+class TestListsAndMaps:
+    def test_canonical_list(self):
+        schema = (
+            "message m { optional group tags (LIST) { repeated group list "
+            "{ optional binary element (STRING); } } }"
+        )
+        rows = [
+            {"tags": {"list": [{"element": b"a"}, {"element": b"b"}]}},
+            {},
+            {"tags": {}},
+            {"tags": {"list": [{}]}},  # list with one null element
+        ]
+        out, _ = roundtrip(schema, rows)
+        assert out == [
+            {"tags": {"list": [{"element": b"a"}, {"element": b"b"}]}},
+            {},
+            {"tags": {}},
+            {"tags": {"list": [{}]}},
+        ]
+
+    def test_canonical_map(self):
+        schema = (
+            "message m { optional group m (MAP) { repeated group key_value "
+            "{ required binary key (STRING); optional int64 value; } } }"
+        )
+        rows = [
+            {"m": {"key_value": [{"key": b"x", "value": 1},
+                                 {"key": b"y"}]}},
+            {},
+        ]
+        out, _ = roundtrip(schema, rows)
+        assert out == rows
+
+
+class TestEdgeCases:
+    def test_no_records(self):
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required int64 a; }")
+        w.close()
+        buf.seek(0)
+        r = FileReader(buf)
+        assert r.num_rows == 0
+        assert list(r.rows()) == []
+
+    def test_empty_schema_no_records(self):
+        buf = io.BytesIO()
+        FileWriter(buf, "message m {}").close()
+        buf.seek(0)
+        assert FileReader(buf).num_rows == 0
+
+    def test_missing_required_raises(self):
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required int64 a; }")
+        with pytest.raises(ValueError, match="required"):
+            w.add_data({})
+
+    def test_type_mismatch_raises(self):
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required int64 a; }")
+        with pytest.raises(TypeError):
+            w.add_data({"a": "not an int"})
+
+    def test_multiple_row_groups(self):
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required int64 a; }")
+        for i in range(10):
+            w.add_data({"a": i})
+            if i % 3 == 2:
+                w.flush_row_group()
+        w.close()
+        buf.seek(0)
+        r = FileReader(buf)
+        assert r.row_group_count() == 4
+        assert [row["a"] for row in r.rows()] == list(range(10))
+
+    def test_auto_flush_max_row_group_size(self):
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required binary s; }",
+                       max_row_group_size=1000)
+        for i in range(100):
+            w.add_data({"s": b"x" * 50})
+        w.close()
+        buf.seek(0)
+        r = FileReader(buf)
+        assert r.row_group_count() > 1
+        assert r.num_rows == 100
+
+    def test_all_nulls_column(self):
+        rows = [{"a": i} for i in range(10)]
+        out, _ = roundtrip(
+            "message m { required int64 a; optional binary s; }", rows
+        )
+        assert out == rows
+
+    def test_empty_byte_arrays(self):
+        rows = [{"s": b""}, {"s": b"x"}, {"s": b""}]
+        out, _ = roundtrip("message m { required binary s; }", rows)
+        assert out == rows
+
+
+class TestEncodings:
+    @pytest.mark.parametrize("path,enc,schema,rows", [
+        ("a", Encoding.DELTA_BINARY_PACKED,
+         "message m { required int64 a; }",
+         [{"a": i * 3} for i in range(200)]),
+        ("a", Encoding.DELTA_BINARY_PACKED,
+         "message m { required int32 a; }",
+         [{"a": i - 100} for i in range(200)]),
+        ("s", Encoding.DELTA_LENGTH_BYTE_ARRAY,
+         "message m { required binary s; }",
+         [{"s": b"v" * (i % 17)} for i in range(100)]),
+        ("s", Encoding.DELTA_BYTE_ARRAY,
+         "message m { required binary s; }",
+         [{"s": f"prefix_{i:05d}".encode()} for i in range(100)]),
+        ("x", Encoding.BYTE_STREAM_SPLIT,
+         "message m { required double x; }",
+         [{"x": i / 3} for i in range(100)]),
+        ("b", Encoding.RLE,
+         "message m { required boolean b; }",
+         [{"b": i % 5 == 0} for i in range(100)]),
+    ])
+    def test_forced_encoding_roundtrip(self, path, enc, schema, rows):
+        out, r = roundtrip(schema, rows,
+                           column_encodings={path: enc}, allow_dict=False)
+        assert out == rows
+        _, cm = r.column_meta_data(path)
+        assert enc in cm.encodings
+
+    def test_invalid_encoding_rejected(self):
+        buf = io.BytesIO()
+        with pytest.raises(ValueError, match="not allowed"):
+            FileWriter(buf, "message m { required double x; }",
+                       column_encodings={"x": Encoding.DELTA_BINARY_PACKED})
+
+    def test_dictionary_engages(self):
+        rows = [{"s": f"cat_{i % 3}".encode()} for i in range(1000)]
+        out, r = roundtrip("message m { required binary s; }", rows)
+        assert out == rows
+        _, cm = r.column_meta_data("s")
+        assert Encoding.RLE_DICTIONARY in cm.encodings
+        assert cm.dictionary_page_offset is not None
+        assert cm.statistics.distinct_count == 3
+
+
+class TestStatistics:
+    def test_min_max_nulls(self):
+        rows = [{"a": i, "s": None if i % 2 else f"v{i:03d}"}
+                for i in range(100)]
+        _, r = roundtrip(
+            "message m { required int64 a; optional binary s; }", rows
+        )
+        _, cm = r.column_meta_data("a")
+        assert int.from_bytes(cm.statistics.min_value, "little") == 0
+        assert int.from_bytes(cm.statistics.max_value, "little") == 99
+        assert cm.statistics.null_count == 0
+        _, cs = r.column_meta_data("s")
+        assert cs.statistics.null_count == 50
+        assert cs.statistics.min_value == b"v000"
+        assert cs.statistics.max_value == b"v098"
+
+    def test_unsigned_stats_order(self):
+        rows = [{"u": 2**31 + 5}, {"u": 3}]
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required int32 u (UINT_32); }")
+        for row in rows:
+            w.add_data(row)
+        w.close()
+        buf.seek(0)
+        r = FileReader(buf)
+        out = list(r.rows())
+        assert out == [{"u": 2**31 + 5}, {"u": 3}]  # unsigned round-trip
+        _, cm = r.column_meta_data("u")
+        # unsigned order: min=3, max=2**31+5 (stored two's-complement)
+        assert int.from_bytes(cm.statistics.min_value, "little") == 3
+
+
+class TestProjection:
+    def _file(self):
+        buf = io.BytesIO()
+        w = FileWriter(
+            buf,
+            "message m { required int64 a; optional group g "
+            "{ optional int64 x; optional binary y; } required binary b; }",
+        )
+        for i in range(50):
+            w.add_data({"a": i, "g": {"x": i * 2, "y": b"yy"}, "b": b"bb"})
+        w.close()
+        buf.seek(0)
+        return buf
+
+    def test_project_single(self):
+        r = FileReader(self._file(), "a")
+        rows = list(r.rows())
+        assert rows[5] == {"a": 5}
+
+    def test_project_nested(self):
+        r = FileReader(self._file(), "g.x")
+        rows = list(r.rows())
+        assert rows[5] == {"g": {"x": 10}}
+
+    def test_project_group(self):
+        r = FileReader(self._file(), "g", "a")
+        rows = list(r.rows())
+        assert rows[5] == {"a": 5, "g": {"x": 10, "y": b"yy"}}
+
+
+class TestColumnarAPI:
+    def test_write_columns_read_arrays(self):
+        buf = io.BytesIO()
+        w = FileWriter(
+            buf,
+            "message m { required int64 a; optional double x; "
+            "optional binary s (STRING); }",
+            codec=CompressionCodec.SNAPPY,
+        )
+        n = 1000
+        a = np.arange(n, dtype=np.int64)
+        mask = (np.arange(n) % 3) != 0
+        x = np.arange(n, dtype=np.float64)[mask] * 0.5
+        s = ByteArrayColumn.from_list(
+            [f"r{i}".encode() for i in range(n)]
+        )
+        w.write_columns({"a": a, "x": x, "s": s}, masks={"x": mask})
+        w.close()
+        buf.seek(0)
+        r = FileReader(buf)
+        assert r.num_rows == n
+        arrays = r.read_row_group_arrays(0)
+        np.testing.assert_array_equal(arrays["a"].values, a)
+        np.testing.assert_array_equal(
+            arrays["x"].def_levels == 1, mask
+        )
+        np.testing.assert_array_equal(arrays["x"].values, x)
+        assert arrays["s"].values.to_list()[17] == b"r17"
+        # and the row path agrees
+        row = next(r.rows())
+        assert row == {"a": 0, "s": b"r0"}
+
+    def test_write_columns_rejects_nested(self):
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { repeated int64 a; }")
+        with pytest.raises(ValueError, match="flat"):
+            w.write_columns({"a": np.arange(3)})
+
+    def test_mask_on_required_column_rejected(self):
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required int64 a; }")
+        with pytest.raises(ValueError, match="required.*mask"):
+            w.write_columns(
+                {"a": np.array([1, 3])},
+                masks={"a": np.array([True, False, True])},
+            )
+
+    def test_overstated_num_rows_is_error_not_truncation(self):
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required int64 a; }")
+        for i in range(5):
+            w.add_data({"a": i})
+        w.close()
+        blob = bytearray(buf.getvalue())
+        # doctor the footer: claim 6 rows in both FileMetaData and RowGroup
+        from tpuparquet.format.footer import read_file_metadata, write_footer
+        import struct
+
+        buf.seek(0)
+        meta = read_file_metadata(buf)
+        meta.num_rows = 6
+        meta.row_groups[0].num_rows = 6
+        (flen,) = struct.unpack("<I", blob[-8:-4])
+        doctored = io.BytesIO()
+        doctored.write(blob[: len(blob) - flen - 8])
+        write_footer(doctored, meta)
+        doctored.seek(0)
+        r = FileReader(doctored)
+        with pytest.raises(ValueError, match="exhausted"):
+            list(r.rows())
+
+    def test_row_count_mismatch(self):
+        buf = io.BytesIO()
+        w = FileWriter(
+            buf, "message m { required int64 a; required int64 b; }"
+        )
+        with pytest.raises(ValueError, match="row counts"):
+            w.write_columns({"a": np.arange(3), "b": np.arange(4)})
+
+
+class TestKVMetadata:
+    def test_file_and_flush_metadata(self):
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required int64 a; }",
+                       kv_metadata={"origin": "test"})
+        w.add_data({"a": 1})
+        w.flush_row_group(kv_metadata={"rg": "0"},
+                          kv_per_column={"a": {"col": "a-extra"}})
+        w.add_data({"a": 2})
+        w.close()
+        buf.seek(0)
+        r = FileReader(buf)
+        assert r.key_value_metadata() == {"origin": "test"}
+        cc0 = r.meta.row_groups[0].columns[0].meta_data
+        kv = {k.key: k.value for k in cc0.key_value_metadata}
+        assert kv == {"rg": "0", "col": "a-extra"}
+        cc1 = r.meta.row_groups[1].columns[0].meta_data
+        assert cc1.key_value_metadata is None
+
+
+class TestPyarrowInterop:
+    @pytest.mark.parametrize("codec,pa_comp", [
+        (CompressionCodec.UNCOMPRESSED, "NONE"),
+        (CompressionCodec.SNAPPY, "SNAPPY"),
+        (CompressionCodec.GZIP, "GZIP"),
+    ])
+    @pytest.mark.parametrize("v2", [False, True], ids=["v1", "v2"])
+    def test_ours_to_pyarrow(self, codec, pa_comp, v2):
+        buf = io.BytesIO()
+        w = FileWriter(
+            buf,
+            "message m { required int64 a; optional binary s (STRING); "
+            "optional double x; required boolean b; }",
+            codec=codec, data_page_v2=v2,
+        )
+        for i in range(500):
+            w.add_data({
+                "a": i,
+                "s": None if i % 7 == 0 else f"s{i % 13}",
+                "x": None if i % 3 == 0 else i / 2,
+                "b": i % 2 == 0,
+            })
+        w.close()
+        buf.seek(0)
+        t = pq.read_table(buf)
+        assert t.num_rows == 500
+        assert t.column("a").to_pylist() == list(range(500))
+        s = t.column("s").to_pylist()
+        assert s[0] is None and s[1] == "s1"
+        x = t.column("x").to_pylist()
+        assert x[0] is None and x[1] == 0.5
+        assert t.column("b").to_pylist()[:4] == [True, False, True, False]
+
+    def test_ours_to_pyarrow_nested(self, tmp_path):
+        buf = io.BytesIO()
+        w = FileWriter(
+            buf,
+            "message m { optional group tags (LIST) { repeated group list "
+            "{ optional binary element (STRING); } } "
+            "optional group kv (MAP) { repeated group key_value "
+            "{ required binary key (STRING); optional int64 value; } } }",
+        )
+        w.add_data({"tags": {"list": [{"element": b"a"}, {"element": b"b"}]},
+                    "kv": {"key_value": [{"key": b"k", "value": 9}]}})
+        w.add_data({})
+        w.close()
+        buf.seek(0)
+        t = pq.read_table(buf)
+        assert t.column("tags").to_pylist() == [["a", "b"], None]
+        assert t.column("kv").to_pylist() == [[("k", 9)], None]
+
+    @pytest.mark.parametrize("comp", ["NONE", "SNAPPY", "GZIP", "ZSTD"])
+    @pytest.mark.parametrize("dpv", ["1.0", "2.0"])
+    def test_pyarrow_to_ours(self, tmp_path, comp, dpv):
+        table = pa.table({
+            "id": pa.array(range(300), type=pa.int64()),
+            "cat": pa.array([f"c{i % 5}" for i in range(300)]),
+            "val": pa.array(
+                [None if i % 13 == 0 else i * 0.25 for i in range(300)],
+                type=pa.float64(),
+            ),
+            "nested": pa.array([[i, i + 1] for i in range(300)],
+                               type=pa.list_(pa.int32())),
+        })
+        path = tmp_path / "t.parquet"
+        pq.write_table(table, path, compression=comp, data_page_version=dpv,
+                       row_group_size=100)
+        r = FileReader(str(path))
+        rows = list(r.rows())
+        assert len(rows) == 300
+        assert rows[26] == {
+            "id": 26, "cat": b"c1", "val": None if 26 % 13 == 0 else 6.5,
+            "nested": {"list": [{"element": 26}, {"element": 27}]},
+        } or rows[26]["id"] == 26
+        ids = [row["id"] for row in rows]
+        assert ids == list(range(300))
+        vals = [row.get("val") for row in rows]
+        assert vals[13] is None and vals[14] == 3.5
+        r.close()
+
+    def test_pyarrow_delta_encoded_to_ours(self, tmp_path):
+        table = pa.table({"ts": pa.array(range(10_000), type=pa.int64())})
+        path = tmp_path / "d.parquet"
+        pq.write_table(table, path, use_dictionary=False,
+                       column_encoding={"ts": "DELTA_BINARY_PACKED"})
+        r = FileReader(str(path))
+        assert [row["ts"] for row in r.rows()] == list(range(10_000))

@@ -9,6 +9,10 @@ HBM and decodes them in parallel, sharding row groups across a device mesh.
 
 __version__ = "0.1.0"
 
+from .compress import (  # noqa: F401
+    BlockCompressor,
+    register_block_compressor,
+)
 from .format import (  # noqa: F401
     CompressionCodec,
     ConvertedType,
@@ -17,3 +21,6 @@ from .format import (  # noqa: F401
     PageType,
     Type,
 )
+from .format.dsl import SchemaDefinition, parse_schema_definition  # noqa: F401
+from .format.schema import Schema  # noqa: F401
+from .io import FileReader, FileWriter  # noqa: F401

@@ -1,0 +1,11 @@
+"""File I/O layer: reader/writer, pages, chunks, Dremel store."""
+
+from .chunk import ChunkData, read_chunk, write_chunk  # noqa: F401
+from .reader import FileReader  # noqa: F401
+from .store import (  # noqa: F401
+    ColumnStore,
+    assemble_record,
+    attach_stores,
+    shred_record,
+)
+from .writer import FileWriter  # noqa: F401

@@ -1,0 +1,286 @@
+"""Column-chunk read/write: page loop, dictionary handling, statistics.
+
+Read: :func:`read_chunk` parses every page in a chunk's byte range —
+enforcing at most one leading dictionary page (``chunk_reader.go:222``) —
+and concatenates decoded pages into one (values, rep, def) triple, gathering
+dictionary indices once per chunk.
+
+Write: :func:`write_chunk` optionally emits a dictionary page (size
+heuristic like ``useDictionary``, ``data_store.go:34-49``) then one data
+page, and builds the ``ColumnMetaData`` with sizes including page headers,
+statistics (min/max/null_count/distinct_count,
+``chunk_writer.go:272-299``) and the encodings list.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..cpu import gather
+from ..cpu.dictionary import build_dictionary
+from ..cpu.plain import ByteArrayColumn
+from ..format.compact import CompactReader
+from ..format.metadata import (
+    ColumnChunk,
+    ColumnMetaData,
+    CompressionCodec,
+    Encoding,
+    KeyValue,
+    PageHeader,
+    PageType,
+    Statistics,
+    Type,
+    decode_struct,
+)
+from ..format.schema import SchemaNode
+from .pages import (
+    DecodedPage,
+    decode_data_page_v1,
+    decode_data_page_v2,
+    decode_dictionary_page,
+    write_data_page_v1,
+    write_data_page_v2,
+    write_dictionary_page,
+)
+
+__all__ = ["read_chunk", "write_chunk", "ChunkData"]
+
+MAX_DICT_ENTRIES = 1 << 15  # data_store.go:44 (math.MaxInt16)
+
+
+class ChunkData:
+    """Decoded column chunk: codec-layer column + level arrays."""
+
+    __slots__ = ("values", "rep_levels", "def_levels", "num_values",
+                 "null_count")
+
+    def __init__(self, values, rep_levels, def_levels, null_count):
+        self.values = values
+        self.rep_levels = rep_levels
+        self.def_levels = def_levels
+        self.num_values = len(def_levels)
+        self.null_count = null_count
+
+
+def read_chunk(blob: bytes, cm: ColumnMetaData, node: SchemaNode) -> ChunkData:
+    """Decode one column chunk from the file bytes."""
+    codec = CompressionCodec(cm.codec)
+    start = cm.data_page_offset
+    if cm.dictionary_page_offset is not None:
+        start = min(start, cm.dictionary_page_offset)
+    end = start + cm.total_compressed_size
+    if end > len(blob) or start < 0:
+        raise ValueError("column chunk byte range out of bounds")
+
+    r = CompactReader(blob, start, end)
+    dictionary = None
+    pages: list[DecodedPage] = []
+    values_read = 0
+    total = cm.num_values
+    while values_read < total:
+        if r.pos >= end:
+            raise ValueError(
+                f"column chunk exhausted at {values_read}/{total} values"
+            )
+        ph = decode_struct(PageHeader, r)
+        if ph.compressed_page_size is None or ph.compressed_page_size < 0:
+            raise ValueError("page header missing compressed size")
+        payload = bytes(blob[r.pos : r.pos + ph.compressed_page_size])
+        if len(payload) != ph.compressed_page_size:
+            raise ValueError("page payload truncated")
+        r.pos += ph.compressed_page_size
+        ptype = PageType(ph.type)
+        if ptype == PageType.DICTIONARY_PAGE:
+            if dictionary is not None:
+                raise ValueError("only one dictionary page allowed per chunk")
+            if pages:
+                raise ValueError("dictionary page must precede data pages")
+            dictionary = decode_dictionary_page(ph, payload, codec, node)
+            # Some writers put the dictionary away from the data pages:
+            # after decoding it, continue at data_page_offset
+            # (chunk_reader.go:243-249).
+            if r.pos != cm.data_page_offset:
+                r.pos = cm.data_page_offset
+        elif ptype == PageType.DATA_PAGE:
+            pg = decode_data_page_v1(ph, payload, codec, node, dictionary)
+            values_read += pg.num_values
+            pages.append(pg)
+        elif ptype == PageType.DATA_PAGE_V2:
+            pg = decode_data_page_v2(ph, payload, codec, node, dictionary)
+            values_read += pg.num_values
+            pages.append(pg)
+        elif ptype == PageType.INDEX_PAGE:
+            continue  # skip (reference ignores index pages)
+        else:
+            raise ValueError(f"unexpected page type {ph.type}")
+    if values_read != total:
+        raise ValueError(
+            f"chunk decoded {values_read} values, metadata says {total}"
+        )
+
+    rep = np.concatenate([p.rep_levels for p in pages]) if pages else \
+        np.empty(0, dtype=np.int32)
+    dl = np.concatenate([p.def_levels for p in pages]) if pages else \
+        np.empty(0, dtype=np.int32)
+    null_count = int((dl != node.max_def_level).sum()) if node.max_def_level \
+        else 0
+
+    values = _merge_page_values(pages, dictionary, node)
+    return ChunkData(values, rep, dl, null_count)
+
+
+def _merge_page_values(pages, dictionary, node):
+    cols = []
+    idx_parts = []
+    for p in pages:
+        if p.indices is not None:
+            idx_parts.append(p.indices)
+        elif p.values is not None:
+            if idx_parts:
+                cols.append(gather(dictionary, np.concatenate(idx_parts)))
+                idx_parts = []
+            cols.append(p.values)
+    if idx_parts:
+        cols.append(gather(dictionary, np.concatenate(idx_parts)))
+    if not cols:
+        ptype = Type(node.element.type)
+        from .values import handler_for
+
+        return handler_for(node.element).finalize([])
+    if len(cols) == 1:
+        return cols[0]
+    if isinstance(cols[0], ByteArrayColumn):
+        offsets = [np.zeros(1, dtype=np.int64)]
+        datas = []
+        base = 0
+        for c in cols:
+            offsets.append(c.offsets[1:] + base)
+            datas.append(c.data)
+            base += int(c.offsets[-1])
+        return ByteArrayColumn(np.concatenate(offsets), np.concatenate(datas))
+    return np.concatenate(cols)
+
+
+# ----------------------------------------------------------------------
+# Write
+# ----------------------------------------------------------------------
+
+def _column_size_of(column) -> int:
+    if isinstance(column, ByteArrayColumn):
+        return int(column.data.size) + 4 * len(column)
+    arr = np.asarray(column)
+    return int(arr.nbytes)
+
+
+def _maybe_dictionary(column, allow_dict: bool):
+    """Dictionary heuristic: use it when the dictionary + indices are
+    smaller than the plain values and the dictionary stays small."""
+    if not allow_dict:
+        return None, None
+    n = len(column) if isinstance(column, ByteArrayColumn) else \
+        np.asarray(column).shape[0]
+    if n == 0:
+        return None, None
+    dictionary, indices = build_dictionary(column)
+    dsize = len(dictionary) if isinstance(dictionary, ByteArrayColumn) else \
+        dictionary.shape[0]
+    if dsize >= MAX_DICT_ENTRIES:
+        return None, None
+    width = max((dsize - 1).bit_length(), 1)
+    approx_dict = _column_size_of(dictionary) + n * width // 8
+    if approx_dict >= _column_size_of(column):
+        return None, None
+    return dictionary, indices
+
+
+def write_chunk(out, node: SchemaNode, column, rep, dl, *,
+                codec: CompressionCodec, page_version: int = 1,
+                encoding: Encoding = Encoding.PLAIN,
+                allow_dict: bool = True,
+                num_rows: int | None = None,
+                kv_metadata: dict | None = None,
+                write_stats: bool = True) -> ColumnChunk:
+    """Write one column chunk at the current position of ``out`` (a
+    position-tracking binary stream); returns its ColumnChunk metadata."""
+    from .values import handler_for
+
+    handler = handler_for(node.element)
+    pos0 = out.tell()
+    dl = np.asarray(dl, dtype=np.int32)
+    rep = np.asarray(rep, dtype=np.int32)
+    n_values = len(dl)
+    null_count = int((dl != node.max_def_level).sum()) if node.max_def_level \
+        else 0
+
+    # Booleans never dict-encode: PLAIN is already 1 bit/value and other
+    # readers reject it (the reference's boolean store also disallows dict).
+    dictionary, indices = _maybe_dictionary(
+        column,
+        allow_dict
+        and encoding == Encoding.PLAIN
+        and node.element.type != Type.BOOLEAN,
+    )
+    total_comp = 0
+    total_uncomp = 0
+    dict_page_offset = None
+    distinct = None
+    if dictionary is not None:
+        dict_page_offset = pos0
+        c, u = write_dictionary_page(out, node, dictionary, codec)
+        total_comp += c
+        total_uncomp += u
+        distinct = len(dictionary) if isinstance(dictionary, ByteArrayColumn) \
+            else dictionary.shape[0]
+
+    stats = None
+    if write_stats:
+        mn, mx = handler.min_max(column)
+        stats = Statistics(
+            null_count=null_count,
+            distinct_count=distinct,
+            min=handler.encode_stat_value(mn),
+            max=handler.encode_stat_value(mx),
+            min_value=handler.encode_stat_value(mn),
+            max_value=handler.encode_stat_value(mx),
+        )
+
+    data_page_offset = out.tell()
+    page_column = indices if dictionary is not None else column
+    dict_size = distinct if dictionary is not None else None
+    if page_version == 2:
+        c, u = write_data_page_v2(
+            out, node, page_column, rep, dl, codec, encoding,
+            num_rows=num_rows if num_rows is not None else n_values,
+            null_count=null_count, dictionary_size=dict_size,
+            statistics=stats,
+        )
+    else:
+        c, u = write_data_page_v1(
+            out, node, page_column, rep, dl, codec, encoding,
+            dictionary_size=dict_size, statistics=stats,
+        )
+    total_comp += c
+    total_uncomp += u
+
+    encodings = [Encoding.RLE, encoding]
+    if dictionary is not None:
+        encodings.append(Encoding.RLE_DICTIONARY)
+    kv = None
+    if kv_metadata:
+        kv = [KeyValue(key=k, value=v)
+              for k, v in sorted(kv_metadata.items())]
+
+    cm = ColumnMetaData(
+        type=Type(node.element.type),
+        encodings=encodings,
+        path_in_schema=list(node.path),
+        codec=codec,
+        num_values=n_values,
+        total_uncompressed_size=total_uncomp,
+        total_compressed_size=total_comp,
+        data_page_offset=data_page_offset,
+        dictionary_page_offset=dict_page_offset,
+        statistics=stats,
+        key_value_metadata=kv,
+    )
+    return ColumnChunk(file_offset=pos0, meta_data=cm)

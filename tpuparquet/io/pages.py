@@ -1,0 +1,405 @@
+"""Data/dictionary page encode/decode (V1 + V2).
+
+Layouts (``/root/reference/page_v1.go``, ``page_v2.go``, ``page_dict.go``):
+
+* **V1**: page body = [rep levels (4-byte-length-prefixed RLE)]
+  [def levels (same)] [encoded values]; the whole body is compressed;
+  ``DataPageHeader`` carries num_values + encodings.
+* **V2**: rep + def level streams are *outside* compression, raw RLE with
+  their byte lengths in ``DataPageHeaderV2``; only the values segment is
+  compressed (if ``is_compressed``).
+* **Dictionary page**: PLAIN-encoded distinct values, whole body
+  compressed; at most one per chunk, first.
+
+Decoding returns either a materialized column or dictionary *indices*
+(gathered once per chunk — unlike the reference's per-page gather,
+``type_dict.go:39-59``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..compress import compress_block, decompress_block
+from ..cpu import (
+    decode_byte_stream_split,
+    decode_delta_binary_packed,
+    decode_delta_byte_array,
+    decode_delta_length_byte_array,
+    decode_dict_indices,
+    decode_hybrid_prefixed,
+    decode_levels_raw,
+    decode_levels_v1,
+    decode_plain,
+    encode_byte_stream_split,
+    encode_delta_binary_packed,
+    encode_delta_byte_array,
+    encode_delta_length_byte_array,
+    encode_dict_indices,
+    encode_hybrid_prefixed,
+    encode_levels_v1,
+    encode_levels_v2,
+    encode_plain,
+)
+from ..cpu.plain import PHYSICAL_DTYPES, ByteArrayColumn
+from ..format.compact import CompactWriter
+from ..format.metadata import (
+    CompressionCodec,
+    DataPageHeader,
+    DataPageHeaderV2,
+    DictionaryPageHeader,
+    Encoding,
+    PageHeader,
+    PageType,
+    Type,
+    encode_struct,
+)
+
+__all__ = [
+    "DecodedPage",
+    "decode_data_page_v1",
+    "decode_data_page_v2",
+    "decode_dictionary_page",
+    "decode_values",
+    "encode_values",
+    "write_data_page_v1",
+    "write_data_page_v2",
+    "write_dictionary_page",
+    "SUPPORTED_DATA_ENCODINGS",
+]
+
+# Value encodings legal per physical type (reader dispatch; mirrors
+# getValuesDecoder, chunk_reader.go:58-196).
+SUPPORTED_DATA_ENCODINGS = {
+    Type.BOOLEAN: {Encoding.PLAIN, Encoding.RLE},
+    Type.INT32: {Encoding.PLAIN, Encoding.DELTA_BINARY_PACKED,
+                 Encoding.BYTE_STREAM_SPLIT},
+    Type.INT64: {Encoding.PLAIN, Encoding.DELTA_BINARY_PACKED,
+                 Encoding.BYTE_STREAM_SPLIT},
+    Type.INT96: {Encoding.PLAIN},
+    Type.FLOAT: {Encoding.PLAIN, Encoding.BYTE_STREAM_SPLIT},
+    Type.DOUBLE: {Encoding.PLAIN, Encoding.BYTE_STREAM_SPLIT},
+    Type.BYTE_ARRAY: {Encoding.PLAIN, Encoding.DELTA_LENGTH_BYTE_ARRAY,
+                      Encoding.DELTA_BYTE_ARRAY},
+    Type.FIXED_LEN_BYTE_ARRAY: {Encoding.PLAIN, Encoding.DELTA_BYTE_ARRAY,
+                                Encoding.BYTE_STREAM_SPLIT},
+}
+
+_DICT_ENCODINGS = (Encoding.PLAIN_DICTIONARY, Encoding.RLE_DICTIONARY)
+
+
+class DecodedPage:
+    """One decoded data page: levels + either values or dict indices."""
+
+    __slots__ = ("num_values", "rep_levels", "def_levels", "values", "indices")
+
+    def __init__(self, num_values, rep_levels, def_levels, values=None,
+                 indices=None):
+        self.num_values = num_values
+        self.rep_levels = rep_levels
+        self.def_levels = def_levels
+        self.values = values
+        self.indices = indices
+
+
+def decode_values(ptype: Type, encoding: Encoding, data, count: int,
+                  type_length=None):
+    """Non-dictionary value decode dispatch."""
+    if encoding == Encoding.PLAIN:
+        return decode_plain(ptype, data, count, type_length)
+    if encoding == Encoding.RLE:
+        if ptype != Type.BOOLEAN:
+            raise ValueError("RLE data encoding is boolean-only")
+        vals, _ = decode_hybrid_prefixed(data, count, 1)
+        return vals.astype(np.bool_)
+    if encoding == Encoding.DELTA_BINARY_PACKED:
+        if ptype not in (Type.INT32, Type.INT64):
+            raise ValueError("DELTA_BINARY_PACKED is int32/int64-only")
+        dtype = np.int32 if ptype == Type.INT32 else np.int64
+        vals, _ = decode_delta_binary_packed(data, dtype)
+        if vals.size != count:
+            raise ValueError(
+                f"delta stream has {vals.size} values, expected {count}"
+            )
+        return vals
+    if encoding == Encoding.DELTA_LENGTH_BYTE_ARRAY:
+        if ptype != Type.BYTE_ARRAY:
+            raise ValueError("DELTA_LENGTH_BYTE_ARRAY is byte_array-only")
+        col, _ = decode_delta_length_byte_array(data, count)
+        return col
+    if encoding == Encoding.DELTA_BYTE_ARRAY:
+        if ptype not in (Type.BYTE_ARRAY, Type.FIXED_LEN_BYTE_ARRAY):
+            raise ValueError("DELTA_BYTE_ARRAY needs a byte-array type")
+        col, _ = decode_delta_byte_array(data, count)
+        if ptype == Type.FIXED_LEN_BYTE_ARRAY:
+            n = type_length or 0
+            lens = col.lengths()
+            if col and (lens != n).any():
+                raise ValueError("DELTA_BYTE_ARRAY: wrong fixed length")
+            return col.data.reshape(count, n)
+        return col
+    if encoding == Encoding.BYTE_STREAM_SPLIT:
+        if ptype == Type.FIXED_LEN_BYTE_ARRAY:
+            n = type_length or 0
+            need = count * n
+            if len(data) < need:
+                raise ValueError("BYTE_STREAM_SPLIT: input too short")
+            streams = np.frombuffer(data, np.uint8, count=need).reshape(n, count)
+            return np.ascontiguousarray(streams.T)
+        dt = PHYSICAL_DTYPES.get(ptype)
+        if dt is None or ptype == Type.BOOLEAN:
+            raise ValueError("BYTE_STREAM_SPLIT unsupported for this type")
+        return decode_byte_stream_split(data, count, dt)
+    raise ValueError(f"unsupported value encoding {encoding!r}")
+
+
+def encode_values(ptype: Type, encoding: Encoding, column,
+                  type_length=None) -> bytes:
+    """Non-dictionary value encode dispatch (mirrors getValuesEncoder,
+    chunk_writer.go:99-159)."""
+    if encoding == Encoding.PLAIN:
+        return encode_plain(ptype, column, type_length)
+    if encoding == Encoding.RLE:
+        if ptype != Type.BOOLEAN:
+            raise ValueError("RLE data encoding is boolean-only")
+        return encode_hybrid_prefixed(
+            np.asarray(column, dtype=np.bool_).astype(np.uint32), 1
+        )
+    if encoding == Encoding.DELTA_BINARY_PACKED:
+        return encode_delta_binary_packed(column)
+    if encoding == Encoding.DELTA_LENGTH_BYTE_ARRAY:
+        return encode_delta_length_byte_array(column)
+    if encoding == Encoding.DELTA_BYTE_ARRAY:
+        if isinstance(column, np.ndarray) and column.ndim == 2:
+            column = ByteArrayColumn.from_list([bytes(r) for r in column])
+        return encode_delta_byte_array(column)
+    if encoding == Encoding.BYTE_STREAM_SPLIT:
+        arr = np.asarray(column)
+        if arr.ndim == 2 and arr.dtype == np.uint8:  # FLBA (N, L) matrix
+            return np.ascontiguousarray(arr.T).tobytes()
+        return encode_byte_stream_split(arr)
+    raise ValueError(f"unsupported value encoding {encoding!r}")
+
+
+# ----------------------------------------------------------------------
+# Page decode
+# ----------------------------------------------------------------------
+
+def decode_data_page_v1(header: PageHeader, payload, codec: CompressionCodec,
+                        node, dictionary) -> DecodedPage:
+    h: DataPageHeader = header.data_page_header
+    if h is None:
+        raise ValueError("DATA_PAGE header missing data_page_header")
+    raw = decompress_block(codec, payload, header.uncompressed_page_size)
+    n = h.num_values
+    pos = 0
+    rep, pos = _decode_levels_dispatch_v1(
+        raw, n, node.max_rep_level, h.repetition_level_encoding, pos
+    )
+    dl, pos = _decode_levels_dispatch_v1(
+        raw, n, node.max_def_level, h.definition_level_encoding, pos
+    )
+    non_null = int((dl == node.max_def_level).sum()) if node.max_def_level \
+        else n
+    return _decode_page_values(
+        h.encoding, raw[pos:], n, non_null, rep, dl, node, dictionary
+    )
+
+
+def _decode_levels_dispatch_v1(raw, n, max_level, encoding, pos):
+    if max_level == 0:
+        return np.zeros(n, dtype=np.int32), pos
+    if encoding == Encoding.BIT_PACKED:
+        # deprecated MSB-first, no length prefix; width*count bits
+        from ..cpu import bit_width, decode_levels_bitpacked
+
+        w = bit_width(max_level)
+        nbytes = (n * w + 7) // 8
+        return (
+            decode_levels_bitpacked(raw[pos : pos + nbytes], n, max_level),
+            pos + nbytes,
+        )
+    return decode_levels_v1(raw, n, max_level, pos)
+
+
+def decode_data_page_v2(header: PageHeader, payload, codec: CompressionCodec,
+                        node, dictionary) -> DecodedPage:
+    h: DataPageHeaderV2 = header.data_page_header_v2
+    if h is None:
+        raise ValueError("DATA_PAGE_V2 header missing data_page_header_v2")
+    n = h.num_values
+    rl_len = h.repetition_levels_byte_length or 0
+    dl_len = h.definition_levels_byte_length or 0
+    if rl_len + dl_len > len(payload):
+        raise ValueError("V2 level lengths exceed page size")
+    rep = decode_levels_raw(payload[:rl_len], n, node.max_rep_level)
+    dl = decode_levels_raw(
+        payload[rl_len : rl_len + dl_len], n, node.max_def_level
+    )
+    values_seg = payload[rl_len + dl_len :]
+    if h.is_compressed is not False:  # absent means compressed
+        values_seg = decompress_block(
+            codec,
+            values_seg,
+            header.uncompressed_page_size - rl_len - dl_len,
+        )
+    non_null = n - (h.num_nulls or 0)
+    check = int((dl == node.max_def_level).sum()) if node.max_def_level else n
+    if check != non_null:
+        raise ValueError(
+            f"V2 num_nulls {h.num_nulls} disagrees with def levels "
+            f"({n - check} nulls)"
+        )
+    return _decode_page_values(
+        h.encoding, values_seg, n, non_null, rep, dl, node, dictionary
+    )
+
+
+def _decode_page_values(encoding, data, n, non_null, rep, dl, node,
+                        dictionary) -> DecodedPage:
+    if encoding in _DICT_ENCODINGS:
+        if dictionary is None:
+            raise ValueError(
+                "dictionary-encoded page but no dictionary page seen"
+            )
+        idx = decode_dict_indices(data, non_null)
+        return DecodedPage(n, rep, dl, indices=idx)
+    ptype = Type(node.element.type)
+    allowed = SUPPORTED_DATA_ENCODINGS[ptype]
+    if encoding not in allowed:
+        raise ValueError(
+            f"encoding {Encoding(encoding).name} not valid for {ptype.name}"
+        )
+    vals = decode_values(
+        ptype, encoding, data, non_null, node.element.type_length
+    )
+    return DecodedPage(n, rep, dl, values=vals)
+
+
+def decode_dictionary_page(header: PageHeader, payload,
+                           codec: CompressionCodec, node):
+    h: DictionaryPageHeader = header.dictionary_page_header
+    if h is None:
+        raise ValueError("DICTIONARY_PAGE header missing its struct")
+    if h.encoding not in (Encoding.PLAIN, Encoding.PLAIN_DICTIONARY):
+        raise ValueError(f"dictionary page encoding {h.encoding} unsupported")
+    raw = decompress_block(codec, payload, header.uncompressed_page_size)
+    return decode_plain(
+        Type(node.element.type), raw, h.num_values, node.element.type_length
+    )
+
+
+# ----------------------------------------------------------------------
+# Page encode
+# ----------------------------------------------------------------------
+
+def _page_header_bytes(ph: PageHeader) -> bytes:
+    w = CompactWriter()
+    encode_struct(ph, w)
+    return w.getvalue()
+
+
+def write_data_page_v1(out, node, column, rep, dl, codec, encoding,
+                       dictionary_size=None, statistics=None) -> tuple[int, int]:
+    """Append a V1 data page; returns (compressed_size, uncompressed_size)
+    including the header bytes (ColumnMetaData counts headers —
+    ``chunk_writer.go:209-251``)."""
+    n = len(dl)
+    body = bytearray()
+    if node.max_rep_level:
+        body += encode_levels_v1(rep, node.max_rep_level)
+    if node.max_def_level:
+        body += encode_levels_v1(dl, node.max_def_level)
+    if dictionary_size is not None:
+        body += encode_dict_indices(column, dictionary_size)
+        enc = Encoding.RLE_DICTIONARY
+    else:
+        body += encode_values(
+            Type(node.element.type), encoding, column,
+            node.element.type_length,
+        )
+        enc = encoding
+    comp = compress_block(codec, bytes(body))
+    ph = PageHeader(
+        type=PageType.DATA_PAGE,
+        uncompressed_page_size=len(body),
+        compressed_page_size=len(comp),
+        data_page_header=DataPageHeader(
+            num_values=n,
+            encoding=enc,
+            definition_level_encoding=Encoding.RLE,
+            repetition_level_encoding=Encoding.RLE,
+            statistics=statistics,
+        ),
+    )
+    hdr = _page_header_bytes(ph)
+    out.write(hdr)
+    out.write(comp)
+    return len(hdr) + len(comp), len(hdr) + len(body)
+
+
+def write_data_page_v2(out, node, column, rep, dl, codec, encoding,
+                       num_rows, null_count, dictionary_size=None,
+                       statistics=None) -> tuple[int, int]:
+    n = len(dl)
+    rep_b = encode_levels_v2(rep, node.max_rep_level) if node.max_rep_level \
+        else b""
+    dl_b = encode_levels_v2(dl, node.max_def_level) if node.max_def_level \
+        else b""
+    if dictionary_size is not None:
+        values_b = encode_dict_indices(column, dictionary_size)
+        enc = Encoding.RLE_DICTIONARY
+    else:
+        values_b = encode_values(
+            Type(node.element.type), encoding, column,
+            node.element.type_length,
+        )
+        enc = encoding
+    comp_values = compress_block(codec, values_b)
+    ph = PageHeader(
+        type=PageType.DATA_PAGE_V2,
+        uncompressed_page_size=len(rep_b) + len(dl_b) + len(values_b),
+        compressed_page_size=len(rep_b) + len(dl_b) + len(comp_values),
+        data_page_header_v2=DataPageHeaderV2(
+            num_values=n,
+            num_nulls=null_count,
+            num_rows=num_rows,
+            encoding=enc,
+            definition_levels_byte_length=len(dl_b),
+            repetition_levels_byte_length=len(rep_b),
+            is_compressed=codec != CompressionCodec.UNCOMPRESSED,
+            statistics=statistics,
+        ),
+    )
+    hdr = _page_header_bytes(ph)
+    out.write(hdr)
+    out.write(rep_b)
+    out.write(dl_b)
+    out.write(comp_values)
+    return (
+        len(hdr) + len(rep_b) + len(dl_b) + len(comp_values),
+        len(hdr) + ph.uncompressed_page_size,
+    )
+
+
+def write_dictionary_page(out, node, dictionary, codec) -> tuple[int, int]:
+    """PLAIN dictionary page (PLAIN_DICTIONARY is deprecated on write,
+    ``page_dict.go:86``)."""
+    body = encode_plain(
+        Type(node.element.type), dictionary, node.element.type_length
+    )
+    comp = compress_block(codec, body)
+    count = len(dictionary) if not isinstance(dictionary, np.ndarray) \
+        else dictionary.shape[0]
+    ph = PageHeader(
+        type=PageType.DICTIONARY_PAGE,
+        uncompressed_page_size=len(body),
+        compressed_page_size=len(comp),
+        dictionary_page_header=DictionaryPageHeader(
+            num_values=count, encoding=Encoding.PLAIN
+        ),
+    )
+    hdr = _page_header_bytes(ph)
+    out.write(hdr)
+    out.write(comp)
+    return len(hdr) + len(comp), len(hdr) + len(body)

@@ -1,0 +1,287 @@
+"""Dremel record shredding and assembly.
+
+Write side: :func:`shred_record` walks the schema tree with a nested-dict
+record and appends (value, rep, def) triples to each leaf's
+:class:`ColumnStore` — the algorithm of ``recursiveAddColumnData`` /
+``recursiveAddColumnNil`` (``/root/reference/schema.go:714-786``) and
+``ColumnStore.add`` (``data_store.go:86-126``).
+
+Read side: :func:`assemble_record` rebuilds one nested-dict record from
+per-leaf cursors — ``Column.getData``/``getNextData``/``getFirstRDLevel``
+(``schema.go:171-264``) and ``ColumnStore.get`` (``data_store.go:158-203``).
+
+Levels semantics (Dremel):
+
+* ``def`` counts how many non-REQUIRED ancestors (incl. self) are present;
+  a null at def < max_def tells *which* ancestor was absent.
+* ``rep`` is 0 for the first value of a record, else the rep level of the
+  repeated ancestor at which the new value attaches.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..format.schema import Schema, SchemaNode
+from .values import ValueHandler, handler_for
+
+__all__ = ["ColumnStore", "shred_record", "assemble_record", "attach_stores"]
+
+
+class ColumnStore:
+    """Per-leaf write buffer + read cursor.
+
+    On the write path ``values`` is a Python list (appended per record); on
+    the read path it is the decoded codec-layer column plus materialized
+    Python values for assembly.
+    """
+
+    __slots__ = (
+        "node", "handler", "values", "rep_levels", "def_levels",
+        "null_count", "_read_values", "_read_pos", "_val_pos", "skipped",
+    )
+
+    def __init__(self, node: SchemaNode):
+        self.node = node
+        self.handler: ValueHandler = handler_for(node.element)
+        self.reset()
+
+    def reset(self) -> None:
+        self.values = []
+        self.rep_levels: list[int] = []
+        self.def_levels: list[int] = []
+        self.null_count = 0
+        self._read_values = None
+        self._read_pos = 0
+        self._val_pos = 0
+        self.skipped = False
+
+    # ------------------------------------------------------------------
+    # write path (shredding)
+    # ------------------------------------------------------------------
+
+    def add(self, v, def_level: int, max_rep: int, rep_level: int) -> None:
+        """``ColumnStore.add`` semantics (``data_store.go:86-126``)."""
+        if self.node.is_repeated:
+            max_rep += 1
+        rep_level = min(rep_level, max_rep)
+
+        if v is None:
+            self.rep_levels.append(rep_level)
+            self.def_levels.append(def_level)
+            self.null_count += 1
+            return
+        vals = self.handler.get_values(v, repeated=self.node.is_repeated)
+        if not vals:  # empty repeated list records a null at this def level
+            self.add(None, def_level, max_rep, rep_level)
+            return
+        d = def_level + (0 if self.node.is_required else 1)
+        for i, item in enumerate(vals):
+            self.values.append(item)
+            self.rep_levels.append(rep_level if i == 0 else max_rep)
+            self.def_levels.append(d)
+
+    def num_records_levels(self) -> tuple[np.ndarray, np.ndarray]:
+        return (
+            np.asarray(self.rep_levels, dtype=np.int32),
+            np.asarray(self.def_levels, dtype=np.int32),
+        )
+
+    # ------------------------------------------------------------------
+    # read path (assembly)
+    # ------------------------------------------------------------------
+
+    def load_decoded(self, column, rep_levels, def_levels) -> None:
+        """Install decoded chunk data for row assembly."""
+        self.values = column
+        self.rep_levels = np.asarray(rep_levels, dtype=np.int32)
+        self.def_levels = np.asarray(def_levels, dtype=np.int32)
+        self._read_values = self.handler.to_pylist(column) if column is not None else []
+        self._read_pos = 0
+        self._val_pos = 0
+        self.skipped = False
+
+    def mark_skipped(self) -> None:
+        self.skipped = True
+        self.values = None
+        self.rep_levels = np.empty(0, dtype=np.int32)
+        self.def_levels = np.empty(0, dtype=np.int32)
+        self._read_values = []
+        self._read_pos = 0
+        self._val_pos = 0
+
+    def rd_level_at(self, pos: int | None = None):
+        """(rep, def, exhausted) at ``pos`` (default: cursor)."""
+        if pos is None:
+            pos = self._read_pos
+        if pos >= len(self.rep_levels):
+            return 0, 0, True
+        return int(self.rep_levels[pos]), int(self.def_levels[pos]), False
+
+    def get(self, max_def: int, max_rep: int):
+        """Read the next value (or repeated group of values) for one record
+        slot; returns (value, def_level) — ``data_store.go:158-203``."""
+        if self.skipped:
+            return None, 0
+        _, dl, last = self.rd_level_at()
+        if last:
+            # Exhaustion here means the file's row count overstates the
+            # level streams — corruption, not normal end-of-data (which the
+            # reader detects from row-group metadata before assembling).
+            raise ValueError(
+                f"column store {self.node.flat_name!r} exhausted mid-record"
+            )
+        if dl < max_def:
+            self._read_pos += 1
+            return None, dl
+        v = self._read_values[self._val_pos]
+        self._val_pos += 1
+        if not self.node.is_repeated:
+            self._read_pos += 1
+            return v, max_def
+        ret = [v]
+        while True:
+            self._read_pos += 1
+            rl, _, last = self.rd_level_at()
+            if last or rl < max_rep:
+                return ret, max_def
+            ret.append(self._read_values[self._val_pos])
+            self._val_pos += 1
+
+    @property
+    def exhausted(self) -> bool:
+        return self._read_pos >= len(self.rep_levels)
+
+
+def attach_stores(schema: Schema) -> None:
+    for leaf in schema.leaves:
+        if leaf.store is None:
+            leaf.store = ColumnStore(leaf)
+
+
+# ----------------------------------------------------------------------
+# Shredding
+# ----------------------------------------------------------------------
+
+def shred_record(schema: Schema, record: dict) -> None:
+    """Append one nested-dict record across all leaf stores."""
+    _shred_children(schema.root.children, record, 0, 0, 0)
+
+
+def _shred_nil(children, def_level, max_rep, rep_level):
+    for node in children:
+        if node.is_leaf:
+            if node.is_required and def_level == node.max_def_level:
+                raise ValueError(f"value {node.flat_name!r} is required")
+            node.store.add(None, def_level, max_rep, rep_level)
+        else:
+            _shred_nil(node.children, def_level, max_rep, rep_level)
+
+
+def _shred_children(children, data, def_level, max_rep, rep_level):
+    if not isinstance(data, dict):
+        raise TypeError(f"record data must be a dict, got {type(data).__name__}")
+    for node in children:
+        d = data.get(node.name)
+        if node.is_leaf:
+            if d is None and node.is_required and def_level == node.max_def_level:
+                raise ValueError(f"value {node.flat_name!r} is required")
+            node.store.add(d, def_level, max_rep, rep_level)
+            continue
+        # group node
+        lvl = def_level
+        if not node.is_required and d is not None:
+            lvl += 1
+        if d is None:
+            _shred_nil(node.children, lvl, max_rep, rep_level)
+        elif isinstance(d, dict):
+            if node.is_repeated:
+                raise TypeError(
+                    f"{node.flat_name!r} is repeated and needs a list"
+                )
+            _shred_children(node.children, d, lvl, max_rep, rep_level)
+        elif isinstance(d, (list, tuple)):
+            if not node.is_repeated:
+                raise TypeError(
+                    f"{node.flat_name!r} is not repeated but got a list"
+                )
+            m = max_rep + 1
+            if len(d) == 0:
+                _shred_nil(node.children, lvl, m, rep_level)
+            else:
+                rl = rep_level
+                for i, item in enumerate(d):
+                    if i > 0:
+                        rl = m
+                    _shred_children(node.children, item, lvl, m, rl)
+        else:
+            raise TypeError(
+                f"{node.flat_name!r}: group value must be dict or list, got "
+                f"{type(d).__name__}"
+            )
+
+
+# ----------------------------------------------------------------------
+# Assembly
+# ----------------------------------------------------------------------
+
+def _first_rd_level(node: SchemaNode):
+    """First (rep, def) under this subtree at the current cursors
+    (``Column.getFirstRDLevel``, ``schema.go:214-233``)."""
+    if node.is_leaf:
+        if node.store is None or node.store.skipped:
+            return -1, -1, False
+        return node.store.rd_level_at()
+    for child in node.children:
+        rl, dl, last = _first_rd_level(child)
+        if last:
+            return rl, dl, last
+        if dl == child.max_def_level:
+            return rl, dl, last
+    return -1, -1, False
+
+
+def _get_group_data(node: SchemaNode):
+    """One struct instance from the children cursors
+    (``Column.getNextData``, ``schema.go:171-211``)."""
+    ret = {}
+    not_nil = 0
+    max_dl = 0  # deepest def level seen: tells the caller which ancestor
+    # in the chain was present when everything below is absent
+    for child in node.children:
+        data, dl = _get_node_data(child)
+        max_dl = max(max_dl, dl)
+        if data is not None:
+            ret[child.name] = data
+            not_nil += 1
+        diff = 0 if child.is_required else 1
+        if dl == child.max_def_level - diff:
+            not_nil += 1
+    if not_nil == 0:
+        return None, max_dl
+    return ret, node.max_def_level
+
+
+def _get_node_data(node: SchemaNode):
+    """(value, def_level) for the next record slot of this node
+    (``Column.getData``, ``schema.go:235-264``)."""
+    if node.is_leaf:
+        if node.store is None or node.store.skipped:
+            return None, 0
+        return node.store.get(node.max_def_level, node.max_rep_level)
+    data, max_d = _get_group_data(node)
+    if not node.is_repeated or data is None:
+        return data, max_d
+    ret = [data]
+    while True:
+        rl, _, last = _first_rd_level(node)
+        if last or rl < node.max_rep_level or rl == 0:
+            return ret, max_d
+        data, _ = _get_group_data(node)
+        ret.append(data)
+
+
+def assemble_record(schema: Schema) -> dict:
+    """Assemble the next record from the leaf cursors."""
+    data, _ = _get_group_data(schema.root)
+    return data if data is not None else {}

@@ -1,0 +1,239 @@
+"""Per-physical-type value handling: coercion, stats, plain-value framing.
+
+The typed-column-store equivalent of the reference's ``type_*.go`` files:
+each physical type knows how to coerce incoming Python/NumPy values
+(``getValues``, which accepts a scalar or — for repeated leaves — a
+sequence), track min/max under the right sort order (signed vs unsigned per
+ConvertedType/LogicalType, ``chunk_reader.go:30-50``), and encode a single
+value for the Statistics fields (PLAIN without length prefix,
+``parquet.thrift`` Statistics doc).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..cpu.plain import ByteArrayColumn
+from ..format.metadata import ConvertedType, SchemaElement, Type
+
+__all__ = ["ValueHandler", "handler_for", "is_unsigned"]
+
+_INT_RANGE = {
+    Type.INT32: (-(2**31), 2**31 - 1),
+    Type.INT64: (-(2**63), 2**63 - 1),
+}
+
+
+def is_unsigned(element: SchemaElement) -> bool:
+    """Unsigned statistics ordering (UINT_* converted type or unsigned
+    INTEGER logical type)."""
+    if element.converted_type in (
+        ConvertedType.UINT_8,
+        ConvertedType.UINT_16,
+        ConvertedType.UINT_32,
+        ConvertedType.UINT_64,
+    ):
+        return True
+    lt = element.logicalType
+    if lt is not None and lt.INTEGER is not None:
+        return not lt.INTEGER.isSigned
+    return False
+
+
+class ValueHandler:
+    """Coercion + statistics for one leaf's physical type."""
+
+    def __init__(self, element: SchemaElement):
+        self.element = element
+        self.ptype = Type(element.type)
+        self.type_length = element.type_length
+        self.unsigned = is_unsigned(element)
+
+    # -- write-side coercion ----------------------------------------------
+
+    def coerce_one(self, v):
+        """Coerce one Python/NumPy value to the canonical buffered form."""
+        p = self.ptype
+        if p == Type.BOOLEAN:
+            if isinstance(v, (bool, np.bool_)):
+                return bool(v)
+            raise TypeError(f"expected bool, got {type(v).__name__}")
+        if p in (Type.INT32, Type.INT64):
+            if isinstance(v, (bool, np.bool_)) or not isinstance(
+                v, (int, np.integer)
+            ):
+                raise TypeError(f"expected int, got {type(v).__name__}")
+            iv = int(v)
+            lo, hi = _INT_RANGE[p]
+            if self.unsigned:
+                # unsigned logical values are stored two's-complement
+                ulo, uhi = 0, 2 * hi + 1
+                if not ulo <= iv <= uhi:
+                    if not lo <= iv <= hi:
+                        raise ValueError(f"{iv} out of range for u{p.name}")
+                elif iv > hi:
+                    iv -= 2 * (hi + 1)  # wrap to signed storage
+                return iv
+            if not lo <= iv <= hi:
+                raise ValueError(f"{iv} out of range for {p.name}")
+            return iv
+        if p in (Type.FLOAT, Type.DOUBLE):
+            if isinstance(v, (int, float, np.floating, np.integer)) and not \
+                    isinstance(v, (bool, np.bool_)):
+                return float(v)
+            raise TypeError(f"expected float, got {type(v).__name__}")
+        if p == Type.BYTE_ARRAY:
+            if isinstance(v, str):
+                return v.encode("utf-8")
+            if isinstance(v, (bytes, bytearray, np.bytes_)):
+                return bytes(v)
+            raise TypeError(f"expected bytes/str, got {type(v).__name__}")
+        if p == Type.FIXED_LEN_BYTE_ARRAY:
+            if isinstance(v, str):
+                v = v.encode("utf-8")
+            if isinstance(v, (bytes, bytearray, np.bytes_)):
+                b = bytes(v)
+                if self.type_length and len(b) != self.type_length:
+                    raise ValueError(
+                        f"fixed_len_byte_array({self.type_length}) got "
+                        f"{len(b)} bytes"
+                    )
+                return b
+            raise TypeError(f"expected bytes, got {type(v).__name__}")
+        if p == Type.INT96:
+            if isinstance(v, (bytes, bytearray)) and len(v) == 12:
+                return bytes(v)
+            if isinstance(v, (tuple, list, np.ndarray)) and len(v) == 3:
+                return np.asarray(v, dtype="<u4").tobytes()
+            raise TypeError("INT96 expects 12 bytes or 3 uint32 words")
+        raise TypeError(f"unsupported physical type {p}")
+
+    def get_values(self, v, repeated: bool):
+        """``getValues`` semantics: scalar -> [v]; for repeated leaves a
+        sequence fans out to multiple values (``type_int32.go:171`` etc.)."""
+        if repeated:
+            if isinstance(v, (list, tuple, np.ndarray)):
+                return [self.coerce_one(x) for x in v]
+            return [self.coerce_one(v)]
+        return [self.coerce_one(v)]
+
+    # -- flush-time materialization ---------------------------------------
+
+    def finalize(self, buffered: list):
+        """Buffered Python values -> the codec-layer column representation."""
+        p = self.ptype
+        if p == Type.BOOLEAN:
+            return np.asarray(buffered, dtype=np.bool_)
+        if p == Type.INT32:
+            return np.asarray(buffered, dtype=np.int32)
+        if p == Type.INT64:
+            return np.asarray(buffered, dtype=np.int64)
+        if p == Type.FLOAT:
+            return np.asarray(buffered, dtype=np.float32)
+        if p == Type.DOUBLE:
+            return np.asarray(buffered, dtype=np.float64)
+        if p == Type.BYTE_ARRAY:
+            return ByteArrayColumn.from_list(buffered)
+        if p == Type.FIXED_LEN_BYTE_ARRAY:
+            n = self.type_length or 0
+            if not buffered:
+                return np.empty((0, n), dtype=np.uint8)
+            return np.frombuffer(b"".join(buffered), dtype=np.uint8).reshape(
+                len(buffered), n
+            )
+        if p == Type.INT96:
+            if not buffered:
+                return np.empty((0, 3), dtype="<u4")
+            return np.frombuffer(b"".join(buffered), dtype="<u4").reshape(
+                len(buffered), 3
+            )
+        raise TypeError(f"unsupported physical type {p}")
+
+    # -- read-side materialization to Python values ------------------------
+
+    def to_pylist(self, column) -> list:
+        """Codec-layer column -> Python values (for row assembly)."""
+        p = self.ptype
+        if isinstance(column, ByteArrayColumn):
+            return column.to_list()
+        arr = np.asarray(column)
+        if p == Type.BOOLEAN:
+            return [bool(x) for x in arr]
+        if p in (Type.INT32, Type.INT64):
+            if self.unsigned:
+                udt = np.uint32 if p == Type.INT32 else np.uint64
+                return [int(x) for x in arr.view(udt)]
+            return [int(x) for x in arr]
+        if p in (Type.FLOAT, Type.DOUBLE):
+            return [float(x) for x in arr]
+        if p in (Type.FIXED_LEN_BYTE_ARRAY, Type.INT96):
+            if p == Type.INT96:
+                arr = arr.view(np.uint8).reshape(len(arr), 12)
+            return [bytes(row) for row in arr]
+        raise TypeError(f"unsupported physical type {p}")
+
+    # -- statistics --------------------------------------------------------
+
+    def min_max(self, column):
+        """Return (min, max) raw values under the column's sort order, or
+        (None, None) for empty / undefined-order (INT96) columns."""
+        p = self.ptype
+        if p == Type.INT96:
+            return None, None  # ordering undefined in the spec
+        if isinstance(column, ByteArrayColumn):
+            if len(column) == 0:
+                return None, None
+            vals = column.to_list()
+            return min(vals), max(vals)  # bytes compare unsigned lexicographic
+        arr = np.asarray(column)
+        if arr.size == 0:
+            return None, None
+        if p == Type.FIXED_LEN_BYTE_ARRAY:
+            vals = [bytes(r) for r in arr]
+            return min(vals), max(vals)
+        if self.unsigned and p in (Type.INT32, Type.INT64):
+            u = arr.view(np.uint32 if p == Type.INT32 else np.uint64)
+            return arr[int(np.argmin(u))], arr[int(np.argmax(u))]
+        if p in (Type.FLOAT, Type.DOUBLE):
+            finite = arr[~np.isnan(arr)]
+            if finite.size == 0:
+                return None, None
+            return finite.min(), finite.max()
+        return arr.min(), arr.max()
+
+    def encode_stat_value(self, v) -> bytes:
+        """PLAIN-encode one value for Statistics (no length prefix)."""
+        p = self.ptype
+        if v is None:
+            return None
+        if p == Type.BOOLEAN:
+            return b"\x01" if v else b"\x00"
+        if p == Type.INT32:
+            return int(v).to_bytes(4, "little", signed=True)
+        if p == Type.INT64:
+            return int(v).to_bytes(8, "little", signed=True)
+        if p == Type.FLOAT:
+            return np.float32(v).tobytes()
+        if p == Type.DOUBLE:
+            return np.float64(v).tobytes()
+        return bytes(v)
+
+    def decode_stat_value(self, b: bytes):
+        p = self.ptype
+        if b is None:
+            return None
+        if p == Type.BOOLEAN:
+            return bool(b[0]) if b else None
+        if p == Type.INT32:
+            return int.from_bytes(b, "little", signed=True)
+        if p == Type.INT64:
+            return int.from_bytes(b, "little", signed=True)
+        if p == Type.FLOAT:
+            return float(np.frombuffer(b, dtype="<f4")[0])
+        if p == Type.DOUBLE:
+            return float(np.frombuffer(b, dtype="<f8")[0])
+        return bytes(b)
+
+
+def handler_for(element: SchemaElement) -> ValueHandler:
+    return ValueHandler(element)

@@ -1,0 +1,325 @@
+"""FileWriter: row-dict and columnar write paths.
+
+API parity with the reference's ``FileWriter`` (``file_writer.go``):
+keyword options mirror the functional options (``FileVersion``,
+``WithCreator``, ``WithCompressionCodec``, ``WithMetaData``,
+``WithMaxRowGroupSize`` auto-flush, ``WithSchemaDefinition``,
+``WithDataPageV2``), ``add_data`` buffers + shreds one row,
+``flush_row_group`` accepts per-flush key/value metadata (global and
+per-column, ``file_writer.go:148-175``), ``close`` writes the footer.
+
+TPU-first addition: :meth:`write_columns` takes whole column arrays +
+validity masks and skips per-row shredding entirely — the natural writing
+shape for columnar/JAX producers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..format.dsl import SchemaDefinition, parse_schema_definition
+from ..format.footer import MAGIC, write_footer
+from ..format.metadata import (
+    ColumnChunk,
+    CompressionCodec,
+    Encoding,
+    FileMetaData,
+    KeyValue,
+    RowGroup,
+)
+from ..format.schema import Schema
+from .chunk import write_chunk
+from .pages import SUPPORTED_DATA_ENCODINGS
+from .store import attach_stores, shred_record
+from .values import handler_for
+
+__all__ = ["FileWriter"]
+
+
+class FileWriter:
+    """Streaming Parquet writer.
+
+    ``schema`` may be a :class:`Schema`, a :class:`SchemaDefinition`, or a
+    DSL string.  Use as a context manager or call :meth:`close`.
+    """
+
+    def __init__(
+        self,
+        f,
+        schema=None,
+        *,
+        version: int = 1,
+        created_by: str = "tpuparquet",
+        codec: CompressionCodec = CompressionCodec.UNCOMPRESSED,
+        kv_metadata: dict | None = None,
+        max_row_group_size: int | None = None,
+        data_page_v2: bool = False,
+        column_encodings: dict | None = None,
+        allow_dict: bool = True,
+        write_stats: bool = True,
+    ):
+        self._f = f
+        self._pos = 0
+        self.version = version
+        self.created_by = created_by
+        self.codec = CompressionCodec(codec)
+        self.kv_metadata = dict(kv_metadata or {})
+        self.max_row_group_size = max_row_group_size
+        self.page_version = 2 if data_page_v2 else 1
+        self.column_encodings = {
+            k: Encoding(v) for k, v in (column_encodings or {}).items()
+        }
+        self.allow_dict = allow_dict
+        self.write_stats = write_stats
+
+        if schema is None:
+            self.schema = Schema.empty()
+        elif isinstance(schema, Schema):
+            self.schema = schema
+        elif isinstance(schema, SchemaDefinition):
+            self.schema = Schema.from_definition(schema)
+        elif isinstance(schema, str):
+            self.schema = Schema.from_definition(parse_schema_definition(schema))
+        else:
+            raise TypeError(f"unsupported schema type {type(schema).__name__}")
+        attach_stores(self.schema)
+        self._validate_column_encodings()
+
+        self.row_groups: list[RowGroup] = []
+        self.total_rows = 0
+        self._buffered_rows = 0
+        self._approx_size = 0
+        self._closed = False
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _write(self, data: bytes) -> None:
+        self._f.write(data)
+        self._pos += len(data)
+
+    def tell(self) -> int:
+        return self._pos
+
+    def write(self, data: bytes) -> None:  # stream interface for chunk layer
+        self._write(data)
+
+    def _validate_column_encodings(self) -> None:
+        for path, enc in self.column_encodings.items():
+            leaf = self.schema.leaf(path)
+            if leaf is None:
+                raise ValueError(f"no such column {path!r}")
+            allowed = SUPPORTED_DATA_ENCODINGS[leaf.type]
+            if enc not in allowed:
+                raise ValueError(
+                    f"encoding {enc.name} not allowed for column {path!r} "
+                    f"({leaf.type.name})"
+                )
+
+    # -- row path ----------------------------------------------------------
+
+    def add_data(self, row: dict) -> None:
+        """Shred one nested-dict record into the column buffers; auto-flush
+        when the buffered size crosses ``max_row_group_size``."""
+        if self._closed:
+            raise ValueError("writer is closed")
+        shred_record(self.schema, row)
+        self._buffered_rows += 1
+        self._approx_size += _approx_record_size(row)
+        if (
+            self.max_row_group_size is not None
+            and self._approx_size >= self.max_row_group_size
+        ):
+            self.flush_row_group()
+
+    def current_row_group_size(self) -> int:
+        """Approximate byte size of the buffered row group
+        (≙ ``CurrentRowGroupSize``)."""
+        return self._approx_size
+
+    def current_file_size(self) -> int:
+        return self._pos
+
+    # -- columnar path (TPU-first) ----------------------------------------
+
+    def write_columns(
+        self,
+        columns: dict,
+        *,
+        masks: dict | None = None,
+        kv_metadata: dict | None = None,
+        kv_per_column: dict | None = None,
+    ) -> None:
+        """Write one row group directly from column arrays.
+
+        Only flat schemas (no repeated/group nesting beyond optional
+        leaves).  ``columns`` maps leaf name -> array/ByteArrayColumn/list
+        of **non-null** values; ``masks`` maps leaf name -> bool validity
+        array (required for optional columns containing nulls).
+        """
+        if self._closed:
+            raise ValueError("writer is closed")
+        if self._buffered_rows:
+            raise ValueError("cannot mix write_columns with buffered rows")
+        leaves = self.schema.leaves
+        n_rows = None
+        prepared = []
+        for leaf in leaves:
+            if len(leaf.path) != 1 or leaf.max_rep_level:
+                raise ValueError(
+                    "write_columns supports flat schemas only; use add_data"
+                )
+            if leaf.name not in columns:
+                raise ValueError(f"missing column {leaf.name!r}")
+            vals = columns[leaf.name]
+            mask = (masks or {}).get(leaf.name)
+            handler = handler_for(leaf.element)
+            if isinstance(vals, list):
+                vals = handler.finalize([handler.coerce_one(v) for v in vals])
+            if mask is not None and leaf.max_def_level == 0:
+                raise ValueError(
+                    f"column {leaf.name!r} is required; a validity mask "
+                    "is not allowed"
+                )
+            if mask is not None:
+                mask = np.asarray(mask, dtype=bool)
+                rows = len(mask)
+                nn = int(mask.sum())
+                if _column_len(vals) == rows and rows != nn:
+                    raise ValueError(
+                        f"column {leaf.name!r}: pass only non-null values "
+                        "with a mask (got full-length values)"
+                    )
+                if _column_len(vals) != nn:
+                    raise ValueError(
+                        f"column {leaf.name!r}: {_column_len(vals)} values "
+                        f"vs {nn} valid mask entries"
+                    )
+                dl = mask.astype(np.int32) * leaf.max_def_level
+            else:
+                rows = _column_len(vals)
+                if leaf.max_def_level:
+                    dl = np.full(rows, leaf.max_def_level, dtype=np.int32)
+                else:
+                    dl = np.zeros(rows, dtype=np.int32)
+            if n_rows is None:
+                n_rows = rows
+            elif n_rows != rows:
+                raise ValueError("column row counts differ")
+            prepared.append((leaf, vals, dl))
+        self._flush_prepared(
+            prepared, n_rows or 0, kv_metadata or {}, kv_per_column or {}
+        )
+
+    # -- flush -------------------------------------------------------------
+
+    def flush_row_group(self, *, kv_metadata: dict | None = None,
+                        kv_per_column: dict | None = None) -> None:
+        """Flush buffered rows as one row group (no-op when empty, like the
+        reference when rows==0 — ``file_writer.go:180-182``)."""
+        if self._buffered_rows == 0:
+            return
+        prepared = []
+        for leaf in self.schema.leaves:
+            store = leaf.store
+            column = store.handler.finalize(store.values)
+            rep, dl = store.num_records_levels()
+            prepared.append((leaf, column, dl, rep))
+        n_rows = self._buffered_rows
+        # reset buffers before writing so errors don't double-write
+        for leaf in self.schema.leaves:
+            leaf.store.reset()
+        self._buffered_rows = 0
+        self._approx_size = 0
+        self._flush_prepared(
+            [(l, c, d) for (l, c, d, _r) in prepared],
+            n_rows,
+            kv_metadata or {},
+            kv_per_column or {},
+            reps={l.flat_name: r for (l, _c, _d, r) in prepared},
+        )
+
+    def _flush_prepared(self, prepared, n_rows, kv_global, kv_per_column,
+                        reps=None) -> None:
+        if self._pos == 0:
+            self._write(MAGIC)
+        chunks: list[ColumnChunk] = []
+        total_bytes = 0
+        total_comp = 0
+        for entry in prepared:
+            leaf, column, dl = entry[0], entry[1], entry[2]
+            rep = (reps or {}).get(
+                leaf.flat_name, np.zeros(len(dl), dtype=np.int32)
+            )
+            kv = dict(kv_global)
+            kv.update(kv_per_column.get(leaf.flat_name, {}))
+            enc = self.column_encodings.get(
+                leaf.flat_name, Encoding.PLAIN
+            )
+            cc = write_chunk(
+                self, leaf, column, rep, dl,
+                codec=self.codec,
+                page_version=self.page_version,
+                encoding=enc,
+                allow_dict=self.allow_dict,
+                num_rows=n_rows,
+                kv_metadata=kv or None,
+                write_stats=self.write_stats,
+            )
+            total_bytes += cc.meta_data.total_uncompressed_size
+            total_comp += cc.meta_data.total_compressed_size
+            chunks.append(cc)
+        self.row_groups.append(
+            RowGroup(
+                columns=chunks,
+                total_byte_size=total_bytes,
+                num_rows=n_rows,
+                total_compressed_size=total_comp,
+                ordinal=len(self.row_groups),
+            )
+        )
+        self.total_rows += n_rows
+
+    # -- close -------------------------------------------------------------
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self.flush_row_group()
+        if self._pos == 0:
+            self._write(MAGIC)  # valid empty file still needs framing
+        kv = [KeyValue(key=k, value=v)
+              for k, v in sorted(self.kv_metadata.items())] or None
+        meta = FileMetaData(
+            version=self.version,
+            schema=self.schema.to_elements(),
+            num_rows=self.total_rows,
+            row_groups=self.row_groups,
+            key_value_metadata=kv,
+            created_by=self.created_by,
+        )
+        write_footer(self, meta)
+        self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self.close()
+
+
+def _column_len(vals) -> int:
+    try:
+        return len(vals)
+    except TypeError:
+        return np.asarray(vals).shape[0]
+
+
+def _approx_record_size(row) -> int:
+    if isinstance(row, dict):
+        return sum(_approx_record_size(v) + 8 for v in row.values())
+    if isinstance(row, (list, tuple)):
+        return sum(_approx_record_size(v) for v in row)
+    if isinstance(row, (bytes, bytearray, str)):
+        return len(row)
+    return 8
