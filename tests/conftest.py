@@ -3,14 +3,23 @@
 Multi-chip sharding tests run against
 ``--xla_force_host_platform_device_count=8`` on the CPU backend, as
 SURVEY.md §4 prescribes; real-TPU benchmarking happens in ``bench.py`` only.
-Must be set before jax is imported anywhere in the test process.
+
+This environment's sitecustomize registers the "axon" TPU-tunnel backend
+and forces ``jax_platforms="axon,cpu"`` via jax config (so plain
+JAX_PLATFORMS env handling is already overridden by the time conftest
+runs).  Backend *initialization* is lazy, so overriding the config back to
+"cpu" here keeps tests off the tunnel entirely.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,225 @@
+"""Device kernel parity tests: every device decode result must be
+bit-exact with the CPU oracle (run on the CPU backend; conftest pins
+JAX_PLATFORMS=cpu with 8 virtual devices)."""
+
+import io
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpuparquet.cpu import decode_hybrid, encode_hybrid, pack
+from tpuparquet.cpu.plain import ByteArrayColumn
+from tpuparquet.format.metadata import CompressionCodec, Encoding
+from tpuparquet.io import FileReader, FileWriter
+from tpuparquet.kernels import (
+    decode_hybrid_device,
+    read_row_group_device,
+    unpack_u32,
+    unpack_u32_pallas,
+)
+from tpuparquet.kernels.bitunpack import pad_to_words
+from tpuparquet.kernels.decode import (
+    expand_delta_i32,
+    levels_to_validity,
+    plan_delta_i32,
+    scatter_to_dense,
+)
+
+rng = np.random.default_rng(11)
+
+
+class TestBitUnpackDevice:
+    @pytest.mark.parametrize("width", [1, 2, 3, 5, 7, 8, 11, 16, 17, 24, 31, 32])
+    def test_matches_cpu(self, width):
+        hi = (1 << width) - 1
+        vals = rng.integers(0, hi, size=1000, endpoint=True, dtype=np.uint64)
+        packed = pack(vals, width)
+        words = pad_to_words(np.frombuffer(packed, np.uint8), width, 1000)
+        out = np.asarray(unpack_u32(jnp.asarray(words), width, 1000))
+        np.testing.assert_array_equal(out, vals.astype(np.uint32))
+
+    @pytest.mark.parametrize("width", [3, 8, 20])
+    def test_pallas_interpret_matches(self, width):
+        hi = (1 << width) - 1
+        vals = rng.integers(0, hi, size=500, endpoint=True, dtype=np.uint64)
+        packed = pack(vals, width)
+        words = jnp.asarray(
+            pad_to_words(np.frombuffer(packed, np.uint8), width, 500)
+        )
+        a = np.asarray(unpack_u32(words, width, 500))
+        b = np.asarray(
+            unpack_u32_pallas(words, width, 500, interpret=True)
+        )
+        np.testing.assert_array_equal(a, b)
+
+    def test_count_not_multiple_of_32(self):
+        vals = rng.integers(0, 7, size=37, endpoint=True, dtype=np.uint64)
+        words = pad_to_words(np.frombuffer(pack(vals, 3), np.uint8), 3, 37)
+        out = np.asarray(unpack_u32(jnp.asarray(words), 3, 37))
+        np.testing.assert_array_equal(out, vals.astype(np.uint32))
+
+
+class TestHybridDevice:
+    @pytest.mark.parametrize("width", [1, 3, 8, 15, 20])
+    def test_random(self, width):
+        hi = (1 << width) - 1
+        vals = rng.integers(0, hi, size=777, endpoint=True, dtype=np.uint64)
+        enc = encode_hybrid(vals, width)
+        dev = np.asarray(decode_hybrid_device(enc, 777, width))
+        cpu = decode_hybrid(enc, 777, width)
+        np.testing.assert_array_equal(dev, cpu.astype(np.uint32))
+
+    def test_rle_heavy(self):
+        vals = np.repeat([5, 0, 3, 3, 1], [500, 3, 250, 2, 1000]).astype(
+            np.uint64
+        )
+        enc = encode_hybrid(vals, 3)
+        dev = np.asarray(decode_hybrid_device(enc, vals.size, 3))
+        np.testing.assert_array_equal(dev, vals.astype(np.uint32))
+
+    def test_mixed_runs_wire(self):
+        # RLE(8x4) then one bit-packed group 0..7 at width 3
+        blob = bytes([0x10, 0x04, 0x03, 0x88, 0xC6, 0xFA])
+        dev = np.asarray(decode_hybrid_device(blob, 16, 3))
+        np.testing.assert_array_equal(
+            dev, np.concatenate([np.full(8, 4), np.arange(8)])
+        )
+
+
+class TestDeltaDevice:
+    @pytest.mark.parametrize("n", [1, 2, 100, 128, 129, 1000])
+    def test_matches_cpu(self, n):
+        vals = rng.integers(-(2**31), 2**31 - 1, size=n, dtype=np.int64)
+        from tpuparquet.cpu import encode_delta_binary_packed
+
+        enc = encode_delta_binary_packed(vals.astype(np.int32))
+        plan = plan_delta_i32(enc)
+        dev = np.asarray(expand_delta_i32(plan))
+        np.testing.assert_array_equal(
+            dev.view(np.int32), vals.astype(np.int32)
+        )
+
+    def test_extremes(self):
+        vals = np.array([-(2**31), 2**31 - 1, 0, -1, 1], dtype=np.int32)
+        from tpuparquet.cpu import encode_delta_binary_packed
+
+        enc = encode_delta_binary_packed(vals)
+        dev = np.asarray(expand_delta_i32(plan_delta_i32(enc)))
+        np.testing.assert_array_equal(dev.view(np.int32), vals)
+
+
+class TestValidity:
+    def test_mask_positions_scatter(self):
+        dl = jnp.asarray(np.array([2, 1, 2, 0, 2, 2, 1], dtype=np.int32))
+        mask, pos = levels_to_validity(dl, 2)
+        np.testing.assert_array_equal(
+            np.asarray(mask), [1, 0, 1, 0, 1, 1, 0]
+        )
+        packed = jnp.asarray(np.array([10, 20, 30, 40], dtype=np.uint32))
+        dense = np.asarray(scatter_to_dense(packed, mask, pos))
+        np.testing.assert_array_equal(dense, [10, 0, 20, 0, 30, 40, 0])
+
+
+def _parity_check(reader):
+    """Device decode of every chunk must equal the CPU oracle's."""
+    for rg_idx in range(reader.row_group_count()):
+        cpu = reader.read_row_group_arrays(rg_idx)
+        dev = read_row_group_device(reader, rg_idx)
+        assert set(cpu) == set(dev)
+        for path, c in cpu.items():
+            dv, drep, ddl = dev[path].block_until_ready().to_numpy()
+            np.testing.assert_array_equal(drep, c.rep_levels, err_msg=path)
+            np.testing.assert_array_equal(ddl, c.def_levels, err_msg=path)
+            if isinstance(c.values, ByteArrayColumn):
+                assert isinstance(dv, ByteArrayColumn)
+                assert dv == c.values, path
+            else:
+                np.testing.assert_array_equal(
+                    np.asarray(dv).reshape(-1),
+                    np.asarray(c.values).reshape(-1),
+                    err_msg=path,
+                )
+
+
+class TestChunkDeviceParity:
+    @pytest.mark.parametrize("codec", [
+        CompressionCodec.UNCOMPRESSED,
+        CompressionCodec.SNAPPY,
+        CompressionCodec.GZIP,
+    ])
+    @pytest.mark.parametrize("v2", [False, True], ids=["v1", "v2"])
+    def test_our_files(self, codec, v2):
+        buf = io.BytesIO()
+        w = FileWriter(
+            buf,
+            "message m { required int64 a; optional int32 b; "
+            "optional double x; optional binary s (STRING); "
+            "required boolean f; required fixed_len_byte_array(6) fx; }",
+            codec=codec, data_page_v2=v2,
+        )
+        for i in range(2000):
+            w.add_data({
+                "a": int(rng.integers(-(2**60), 2**60)),
+                "b": None if i % 9 == 0 else i - 1000,
+                "x": None if i % 5 == 0 else i / 7,
+                "s": f"cat_{i % 23}",
+                "f": i % 3 == 0,
+                "fx": bytes([i % 256] * 6),
+            })
+        w.flush_row_group()
+        for i in range(500):
+            w.add_data({"a": i, "s": "only", "f": False,
+                        "fx": b"zzzzzz"})
+        w.close()
+        buf.seek(0)
+        r = FileReader(buf)
+        _parity_check(r)
+
+    def test_delta_i32_device(self):
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required int32 t; }",
+                       column_encodings={"t": Encoding.DELTA_BINARY_PACKED},
+                       allow_dict=False)
+        for i in range(3000):
+            w.add_data({"t": i * 3 - 4000})
+        w.close()
+        buf.seek(0)
+        r = FileReader(buf)
+        _parity_check(r)
+
+    def test_pyarrow_file_device(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        table = pa.table({
+            "id": pa.array(range(3000), type=pa.int64()),
+            "name": pa.array([f"u{i % 41}" for i in range(3000)]),
+            "v": pa.array(
+                [None if i % 7 == 0 else i / 3 for i in range(3000)],
+                type=pa.float64(),
+            ),
+            "tags": pa.array([[j for j in range(i % 4)] for i in range(3000)],
+                             type=pa.list_(pa.int32())),
+        })
+        path = tmp_path / "t.parquet"
+        pq.write_table(table, path, compression="SNAPPY",
+                       row_group_size=1000)
+        r = FileReader(str(path))
+        _parity_check(r)
+
+    def test_repeated_levels_device(self):
+        buf = io.BytesIO()
+        w = FileWriter(
+            buf,
+            "message m { repeated group g { repeated int64 v; } }",
+        )
+        for i in range(300):
+            w.add_data({
+                "g": [{"v": list(range(j))} for j in range(i % 5)]
+            })
+        w.close()
+        buf.seek(0)
+        r = FileReader(buf)
+        _parity_check(r)

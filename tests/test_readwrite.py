@@ -337,6 +337,26 @@ class TestColumnarAPI:
         with pytest.raises(ValueError, match="flat"):
             w.write_columns({"a": np.arange(3)})
 
+    def test_array_dtype_mismatch_rejected(self):
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required int32 a; }")
+        with pytest.raises(TypeError, match="integer"):
+            w.write_columns({"a": np.array([1.9, -2.9, 3.5])})
+        with pytest.raises(ValueError, match="range"):
+            w.write_columns({"a": np.array([2**40], dtype=np.int64)})
+
+    def test_unsigned_column_omits_deprecated_minmax(self):
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required int32 u (UINT_32); }")
+        w.add_data({"u": 2**31 + 5})
+        w.add_data({"u": 3})
+        w.close()
+        buf.seek(0)
+        r = FileReader(buf)
+        _, cm = r.column_meta_data("u")
+        assert cm.statistics.min is None and cm.statistics.max is None
+        assert cm.statistics.min_value is not None
+
     def test_mask_on_required_column_rejected(self):
         buf = io.BytesIO()
         w = FileWriter(buf, "message m { required int64 a; }")

@@ -91,7 +91,13 @@ def encode_delta_binary_packed(
     values, block_size: int = 128, n_miniblocks: int = 4
 ) -> bytes:
     """Encode int32/int64 values; overflow-safe via uint64 delta arithmetic."""
-    v = np.asarray(values).astype(np.int64, copy=False)
+    v0 = np.asarray(values)
+    # int32 columns must wrap deltas at 32 bits: otherwise values spanning
+    # the full int32 range produce 33-bit miniblock widths, which int32
+    # delta decoders (parquet-mr, our device kernel) reject.  The wrapped
+    # deltas reconstruct identically modulo 2^32.
+    is32 = v0.dtype in (np.dtype(np.int32), np.dtype(np.uint32))
+    v = v0.astype(np.int64, copy=False)
     out = bytearray()
     write_uvarint(out, block_size)
     write_uvarint(out, n_miniblocks)
@@ -103,6 +109,8 @@ def encode_delta_binary_packed(
     write_zigzag(out, int(v[0]))
     # Two's-complement-safe deltas (wraparound matches decode's uint64 sum).
     deltas = np.diff(v.view(np.uint64)).view(np.int64)
+    if is32:
+        deltas = deltas.astype(np.int32).astype(np.int64)
     for blk_start in range(0, deltas.size, block_size):
         blk = deltas[blk_start : blk_start + block_size]
         min_delta = int(blk.min())

@@ -143,7 +143,6 @@ def _merge_page_values(pages, dictionary, node):
     if idx_parts:
         cols.append(gather(dictionary, np.concatenate(idx_parts)))
     if not cols:
-        ptype = Type(node.element.type)
         from .values import handler_for
 
         return handler_for(node.element).finalize([])
@@ -238,11 +237,18 @@ def write_chunk(out, node: SchemaNode, column, rep, dl, *,
         stats = Statistics(
             null_count=null_count,
             distinct_count=distinct,
-            min=handler.encode_stat_value(mn),
-            max=handler.encode_stat_value(mx),
             min_value=handler.encode_stat_value(mn),
             max_value=handler.encode_stat_value(mx),
         )
+        # The deprecated min/max fields are defined under SIGNED comparison
+        # only (parquet.thrift Statistics doc); writing them for
+        # unsigned-ordered or byte-wise-ordered columns can make legacy
+        # readers mis-prune (min > max two's-complement).
+        if not handler.unsigned and node.element.type not in (
+            Type.BYTE_ARRAY, Type.FIXED_LEN_BYTE_ARRAY
+        ):
+            stats.min = stats.min_value
+            stats.max = stats.max_value
 
     data_page_offset = out.tell()
     page_column = indices if dictionary is not None else column

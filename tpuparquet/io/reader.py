@@ -92,6 +92,14 @@ class FileReader:
             )
         rg = self.meta.row_groups[rg_index]
         out = {}
+        for path, node, cm, blob, start in self.iter_selected_chunks(rg):
+            out[path] = read_chunk(memoryview(blob), _rebase(cm, start), node)
+        return out
+
+    def iter_selected_chunks(self, rg):
+        """Yield (path, node, cm, chunk_bytes, start_offset) for each
+        selected chunk of a row group — the shared slurp used by both the
+        CPU and device decode paths."""
         for cc in rg.columns:
             cm = cc.meta_data
             path = ".".join(cm.path_in_schema)
@@ -104,11 +112,7 @@ class FileReader:
             if cm.dictionary_page_offset is not None:
                 start = min(start, cm.dictionary_page_offset)
             self._f.seek(start)
-            blob = self._f.read(cm.total_compressed_size)
-            out[path] = read_chunk(
-                memoryview(blob), _rebase(cm, start), node
-            )
-        return out
+            yield path, node, cm, self._f.read(cm.total_compressed_size), start
 
     def pre_load(self) -> None:
         """Eagerly load the next row group (≙ ``PreLoad``)."""

@@ -117,6 +117,44 @@ class ValueHandler:
             return [self.coerce_one(v)]
         return [self.coerce_one(v)]
 
+    def validate_array(self, arr):
+        """Validate an ndarray/ByteArrayColumn for the columnar write path.
+
+        Lists go through :meth:`coerce_one`; arrays would otherwise be
+        silently cast by the encoder (1.9 -> 1 into an int32 column), so
+        enforce dtype compatibility and integer range here."""
+        p = self.ptype
+        if isinstance(arr, ByteArrayColumn):
+            if p not in (Type.BYTE_ARRAY, Type.FIXED_LEN_BYTE_ARRAY):
+                raise TypeError(f"{p.name} column cannot take byte values")
+            return arr
+        a = np.asarray(arr)
+        if p == Type.BOOLEAN:
+            if a.dtype != np.bool_:
+                raise TypeError(f"BOOLEAN column needs bool array, got {a.dtype}")
+        elif p in (Type.INT32, Type.INT64):
+            if not np.issubdtype(a.dtype, np.integer) or a.dtype == np.bool_:
+                raise TypeError(f"{p.name} column needs an integer array, "
+                                f"got {a.dtype}")
+            lo, hi = _INT_RANGE[p]
+            if self.unsigned:
+                lo, hi = min(lo, 0), 2 * hi + 1
+            if a.size and (int(a.min()) < lo or int(a.max()) > hi):
+                raise ValueError(f"values out of range for {p.name}")
+        elif p in (Type.FLOAT, Type.DOUBLE):
+            if not (np.issubdtype(a.dtype, np.floating)
+                    or np.issubdtype(a.dtype, np.integer)):
+                raise TypeError(f"{p.name} column needs a numeric array, "
+                                f"got {a.dtype}")
+        elif p in (Type.FIXED_LEN_BYTE_ARRAY, Type.INT96):
+            want = self.type_length if p == Type.FIXED_LEN_BYTE_ARRAY else \
+                (3 if a.dtype.itemsize == 4 else 12)
+            if a.ndim != 2 or a.shape[1] != want:
+                raise TypeError(f"{p.name} column needs shape (N, {want})")
+        else:
+            raise TypeError(f"{p.name} column cannot take ndarray values")
+        return arr
+
     # -- flush-time materialization ---------------------------------------
 
     def finalize(self, buffered: list):

@@ -175,6 +175,8 @@ class FileWriter:
             handler = handler_for(leaf.element)
             if isinstance(vals, list):
                 vals = handler.finalize([handler.coerce_one(v) for v in vals])
+            else:
+                vals = handler.validate_array(vals)
             if mask is not None and leaf.max_def_level == 0:
                 raise ValueError(
                     f"column {leaf.name!r} is required; a validity mask "

@@ -1,0 +1,17 @@
+"""Device (JAX/XLA/Pallas) decode kernels and orchestration."""
+
+from .bitunpack import unpack_u32, unpack_u32_pallas, pad_to_words  # noqa: F401
+from .decode import (  # noqa: F401
+    dict_gather_bytes,
+    dict_gather_fixed,
+    expand_delta_i32,
+    levels_to_validity,
+    plan_delta_i32,
+    scatter_to_dense,
+)
+from .device import (  # noqa: F401
+    DeviceColumn,
+    decode_chunk_device,
+    read_row_group_device,
+)
+from .hybrid import decode_hybrid_device, expand_hybrid, plan_hybrid  # noqa: F401

@@ -1,0 +1,151 @@
+"""Device bit-unpacking: the core decode primitive (jnp + Pallas).
+
+Replaces the CPU `unpack8*` function tables for the device path.  The
+formulation is chosen for TPU vector units: for a static width ``w``, a
+block of 32 consecutive values occupies exactly ``w`` u32 words of the
+packed stream, and the (word-index, bit-shift) pattern of the 32 values
+within those words depends only on ``w`` — so the decode is
+
+    words:  (n_blocks, w) u32
+    lo    = words[:, WIDX[w]]            # static fancy index
+    hi    = words[:, WIDX2[w]]
+    out   = ((lo >> SHIFT[w]) | (hi << (32 - SHIFT[w]))) & mask
+
+with zero data-dependent gathers — pure reshapes, static selects and
+shifts, which XLA vectorizes onto the VPU and which is equally valid
+inside a Pallas kernel.  Widths 1..32 are supported (dict indices, levels
+and delta miniblocks never exceed 32; 64-bit lanes decode as two passes).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["unpack_u32", "unpack_u32_pallas", "pad_to_words", "plan_tables"]
+
+
+@functools.lru_cache(maxsize=None)
+def plan_tables(width: int):
+    """Static (word_idx, word_idx2, shift) tables for one width."""
+    i = np.arange(32)
+    bit = i * width
+    widx = bit // 32
+    shift = bit % 32
+    # The value's high bits live in the next word when shift + width > 32.
+    widx2 = np.minimum(widx + 1, width - 1)
+    return (
+        tuple(widx.tolist()),
+        tuple(widx2.tolist()),
+        tuple(shift.tolist()),
+    )
+
+
+def pad_to_words(data: bytes | np.ndarray, width: int, count: int) -> np.ndarray:
+    """Host-side staging: pad the packed byte stream so it covers whole
+    32-value blocks, and return it as little-endian u32 words."""
+    buf = np.frombuffer(data, dtype=np.uint8) if not isinstance(
+        data, np.ndarray
+    ) else data
+    n_blocks = (count + 31) // 32
+    need_bytes = n_blocks * width * 4
+    if len(buf) < need_bytes:
+        padded = np.zeros(need_bytes, dtype=np.uint8)
+        padded[: len(buf)] = buf
+        buf = padded
+    else:
+        buf = buf[:need_bytes]
+    return buf.view("<u4").reshape(n_blocks, width)
+
+
+def _unpack_block_math(words, width: int):
+    """(n_blocks, width) u32 -> (n_blocks, 32) u32.  Shared by the jnp and
+    Pallas implementations."""
+    if width == 32:
+        return words
+    widx, widx2, shift = plan_tables(width)
+    widx = jnp.asarray(widx, dtype=jnp.int32)
+    widx2 = jnp.asarray(widx2, dtype=jnp.int32)
+    shift = jnp.asarray(shift, dtype=jnp.uint32)
+    lo = words[:, widx]
+    hi = words[:, widx2]
+    mask = jnp.uint32((1 << width) - 1)
+    # hi contributes only when the value straddles a word boundary;
+    # (32 - shift) == 32 is UB, so gate it with where().
+    straddle = (shift + width) > 32
+    hi_part = jnp.where(
+        straddle,
+        hi << jnp.where(straddle, 32 - shift.astype(jnp.int32), 0).astype(
+            jnp.uint32
+        ),
+        jnp.uint32(0),
+    )
+    return ((lo >> shift) | hi_part) & mask
+
+
+@functools.partial(jax.jit, static_argnames=("width", "count"))
+def unpack_u32(words: jax.Array, width: int, count: int) -> jax.Array:
+    """Unpack LSB-first ``width``-bit values (device, jnp path).
+
+    ``words``: (n_blocks, width) u32 from :func:`pad_to_words`.
+    Returns (count,) u32."""
+    if width == 0:
+        return jnp.zeros((count,), dtype=jnp.uint32)
+    out = _unpack_block_math(words.astype(jnp.uint32), width)
+    return out.reshape(-1)[:count]
+
+
+def _unpack_block_unrolled(words, width: int):
+    """Same math as :func:`_unpack_block_math` but with the per-lane index
+    tables unrolled into static Python ints — Pallas kernels may not
+    capture array constants, and 32 static shift/or ops map straight onto
+    the VPU anyway."""
+    if width == 32:
+        return words
+    widx, widx2, shift = plan_tables(width)
+    mask = np.uint32((1 << width) - 1)
+    cols = []
+    for i in range(32):
+        sh = shift[i]
+        lo = words[:, widx[i]] >> np.uint32(sh)
+        if sh + width > 32:
+            lo = lo | (words[:, widx2[i]] << np.uint32(32 - sh))
+        cols.append(lo & mask)
+    return jnp.stack(cols, axis=1)
+
+
+def _unpack_kernel(words_ref, out_ref, *, width: int):
+    out_ref[:] = _unpack_block_unrolled(words_ref[:], width)
+
+
+def unpack_u32_pallas(words: jax.Array, width: int, count: int,
+                      block_rows: int = 512, interpret: bool = False):
+    """Pallas version: grid over row-blocks of the words matrix, VPU
+    shift/mask math in VMEM.  Semantics identical to :func:`unpack_u32`."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if width == 0:
+        return jnp.zeros((count,), dtype=jnp.uint32)
+    n_blocks = words.shape[0]
+    rows = min(block_rows, max(n_blocks, 1))
+    grid = (pl.cdiv(n_blocks, rows),)
+    padded_blocks = grid[0] * rows
+    if padded_blocks != n_blocks:
+        words = jnp.pad(words, ((0, padded_blocks - n_blocks), (0, 0)))
+    out = pl.pallas_call(
+        functools.partial(_unpack_kernel, width=width),
+        out_shape=jax.ShapeDtypeStruct((padded_blocks, 32), jnp.uint32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((rows, width), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((rows, 32), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(words.astype(jnp.uint32))
+    return out.reshape(-1)[:count]

@@ -1,0 +1,206 @@
+"""Device value-decode kernels: PLAIN staging, levels→validity, dictionary
+gather (fixed and variable width), DELTA_BINARY_PACKED int32.
+
+All kernels follow the same shape discipline: hosts stage *padded,
+fixed-shape* buffers (page bytes as u32 words, run/plan tables as arrays)
+and devices run pure vectorized expansion under ``jit`` — no
+data-dependent Python control flow crosses the boundary (SURVEY.md §7).
+Dynamic output sizes (variable-length gathers) are padded to power-of-two
+buckets so XLA compiles one kernel per bucket, not per page.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..varint import read_uvarint, read_zigzag
+from .bitunpack import pad_to_words, unpack_u32
+
+__all__ = [
+    "stage_u32",
+    "plain_fixed_to_lanes",
+    "levels_to_validity",
+    "scatter_to_dense",
+    "dict_gather_fixed",
+    "dict_gather_bytes",
+    "plan_delta_i32",
+    "expand_delta_i32",
+    "bucket",
+]
+
+
+def bucket(n: int) -> int:
+    """Round up to a power-of-two bucket (min 32) to bound recompilation."""
+    b = 32
+    while b < n:
+        b <<= 1
+    return b
+
+
+def stage_u32(data, n_words: int) -> np.ndarray:
+    """Host staging: raw little-endian bytes -> padded u32 word array."""
+    buf = np.frombuffer(data, dtype=np.uint8) if not isinstance(
+        data, np.ndarray
+    ) else data
+    need = n_words * 4
+    if len(buf) < need:
+        out = np.zeros(need, dtype=np.uint8)
+        out[: len(buf)] = buf[:need]
+        buf = out
+    return buf[:need].view("<u4")
+
+
+@functools.partial(jax.jit, static_argnames=("count", "lanes"))
+def plain_fixed_to_lanes(words: jax.Array, count: int, lanes: int):
+    """PLAIN fixed-width values staged as u32 words -> (count, lanes) u32.
+
+    lanes=1: int32/float32; lanes=2: int64/double (lo, hi); lanes=3: int96.
+    The 'decode' of PLAIN on device is a reinterpret — the point is that
+    the bytes are already in HBM and never round-trip through host."""
+    return words[: count * lanes].reshape(count, lanes)
+
+
+@functools.partial(jax.jit, static_argnames=("max_def",))
+def levels_to_validity(def_levels: jax.Array, max_def: int):
+    """Def levels -> (validity mask, packed-value position per slot).
+
+    The fused kernel of SURVEY §2.8: mask = (def == max_def), and
+    positions[i] = how many non-null values precede slot i — the gather
+    index used to inflate packed values to record slots."""
+    mask = def_levels == jnp.int32(max_def)
+    positions = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    return mask, jnp.maximum(positions, 0)
+
+
+@jax.jit
+def scatter_to_dense(packed: jax.Array, mask: jax.Array,
+                     positions: jax.Array):
+    """Inflate packed non-null values to one-per-slot dense form (null
+    slots get 0); works on (n,) or (n, lanes) packed arrays."""
+    gathered = packed[positions]
+    if gathered.ndim > mask.ndim:
+        m = mask[:, None]
+    else:
+        m = mask
+    return jnp.where(m, gathered, jnp.zeros_like(gathered))
+
+
+@jax.jit
+def dict_gather_fixed(dictionary: jax.Array, indices: jax.Array):
+    """Fixed-width dictionary gather ((D,) or (D, lanes) u32)."""
+    return dictionary[indices]
+
+
+@functools.partial(jax.jit, static_argnames=("total_bytes",))
+def dict_gather_bytes(dict_offsets: jax.Array, dict_data: jax.Array,
+                      indices: jax.Array, out_offsets: jax.Array,
+                      total_bytes: int):
+    """Variable-length dictionary gather -> (out_offsets, out_data).
+
+    For every output byte position, locate its value via searchsorted over
+    the output offsets, then its source byte in the dictionary blob —
+    the device analogue of the reference's per-value dict gather
+    (``type_dict.go:39-59``), vectorized at byte granularity."""
+    b = jnp.arange(total_bytes, dtype=jnp.int32)
+    val = jnp.searchsorted(out_offsets[1:], b, side="right").astype(jnp.int32)
+    val = jnp.minimum(val, indices.shape[0] - 1)
+    within = b - out_offsets[val]
+    src = dict_offsets[indices[val]] + within
+    src = jnp.clip(src, 0, dict_data.shape[0] - 1)
+    return dict_data[src]
+
+
+# ----------------------------------------------------------------------
+# DELTA_BINARY_PACKED (int32) — host plan + device expand
+# ----------------------------------------------------------------------
+
+class DeltaPlan:
+    __slots__ = (
+        "groups",        # list of (width, words_np, positions_np, n_vals)
+        "min_deltas",    # per-delta min_delta contribution (host-expanded)
+        "first", "total",
+    )
+
+    def __init__(self, groups, min_deltas, first, total):
+        self.groups = groups
+        self.min_deltas = min_deltas
+        self.first = first
+        self.total = total
+
+
+def plan_delta_i32(data, pos: int = 0) -> DeltaPlan:
+    """Parse DELTA_BINARY_PACKED headers; group miniblock payloads by bit
+    width so the device unpacks each width class in one static-shape call."""
+    block_size, pos = read_uvarint(data, pos)
+    n_miniblocks, pos = read_uvarint(data, pos)
+    if block_size <= 0 or block_size % 128 or n_miniblocks <= 0 \
+            or block_size % n_miniblocks:
+        raise ValueError("invalid delta header")
+    mb_size = block_size // n_miniblocks
+    total, pos = read_uvarint(data, pos)
+    first, pos = read_zigzag(data, pos)
+    n_deltas = max(total - 1, 0)
+
+    by_width: dict[int, list] = {}
+    min_deltas = np.zeros(n_deltas, dtype=np.int64)
+    got = 0
+    while got < n_deltas:
+        min_delta, pos = read_zigzag(data, pos)
+        widths = bytes(data[pos : pos + n_miniblocks])
+        pos += n_miniblocks
+        for w in widths:
+            if got >= n_deltas:
+                break
+            if w > 32:
+                raise ValueError(
+                    f"delta miniblock width {w} > 32 (int64 path is CPU)"
+                )
+            nbytes = mb_size * w // 8
+            take = min(mb_size, n_deltas - got)
+            min_deltas[got : got + take] = min_delta
+            seg = np.frombuffer(data, np.uint8, nbytes, pos)
+            by_width.setdefault(w, []).append((seg, got, take))
+            pos += nbytes
+            got += take
+
+    groups = []
+    for w, segs in by_width.items():
+        if w == 0:
+            continue  # deltas are all zero; min_delta carries the value
+        packed = np.concatenate([s for s, _, _ in segs])
+        n_vals = mb_size * len(segs)
+        words = pad_to_words(packed, w, n_vals)
+        positions = np.concatenate([
+            np.arange(start, start + take, dtype=np.int32)
+            for _, start, take in segs
+        ])
+        keep = np.concatenate([
+            np.arange(i * mb_size, i * mb_size + take, dtype=np.int32)
+            for i, (_, _, take) in enumerate(segs)
+        ])
+        groups.append((w, words, positions, keep, n_vals))
+    return DeltaPlan(groups, min_deltas, first, total)
+
+
+def expand_delta_i32(plan: DeltaPlan) -> jax.Array:
+    """Device: unpack each width class, scatter into the delta stream, add
+    min_delta, prefix-sum (int32 two's-complement wrap)."""
+    n_deltas = max(plan.total - 1, 0)
+    deltas = jnp.zeros((max(n_deltas, 1),), dtype=jnp.uint32)
+    for w, words, positions, keep, n_vals in plan.groups:
+        vals = unpack_u32(jnp.asarray(words), w, n_vals)
+        deltas = deltas.at[jnp.asarray(positions)].set(
+            vals[jnp.asarray(keep)]
+        )
+    if plan.total == 0:
+        return jnp.zeros((0,), dtype=jnp.uint32)
+    first = jnp.asarray(np.uint32(plan.first & 0xFFFFFFFF))
+    if n_deltas == 0:
+        return first[None]
+    md = jnp.asarray((plan.min_deltas & 0xFFFFFFFF).astype(np.uint32))
+    full = deltas[:n_deltas] + md  # u32 wraparound == two's complement
+    return jnp.concatenate([first[None], first + jnp.cumsum(full)])

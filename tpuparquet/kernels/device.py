@@ -1,0 +1,399 @@
+"""Device decode orchestration: column chunks -> device-resident columns.
+
+The cuDF-style batch-decode backend of BASELINE.json: raw page bytes are
+staged to device memory and decoded by vectorized kernels; the host only
+parses headers and builds plan tables.  Output is Arrow-layout
+:class:`DeviceColumn` objects (packed values + validity + levels), which
+``to_numpy()`` materializes in exactly the CPU oracle's representation for
+bit-exact parity checks.
+
+Current device coverage (the rest falls back to the CPU oracle per value
+segment, still staged into the same DeviceColumn):
+
+* PLAIN int32/int64/float/double/int96/FLBA (reinterpret staging)
+* PLAIN boolean (width-1 unpack)
+* RLE_DICTIONARY indices (run-table expand) + dictionary gather,
+  fixed-width and variable-width (byte-level gather)
+* definition/repetition levels (run-table expand) + validity fusion
+* DELTA_BINARY_PACKED int32
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..compress import decompress_block
+from ..cpu import decode_plain
+from ..cpu.plain import ByteArrayColumn
+from ..format.compact import CompactReader
+from ..format.metadata import (
+    ColumnMetaData,
+    CompressionCodec,
+    Encoding,
+    PageHeader,
+    PageType,
+    Type,
+    decode_struct,
+)
+from ..format.schema import SchemaNode
+from .bitunpack import pad_to_words, unpack_u32
+from .decode import (
+    dict_gather_bytes,
+    dict_gather_fixed,
+    expand_delta_i32,
+    levels_to_validity,
+    plain_fixed_to_lanes,
+    plan_delta_i32,
+    stage_u32,
+)
+from .hybrid import decode_hybrid_device
+
+__all__ = ["DeviceColumn", "decode_chunk_device", "read_row_group_device"]
+
+_LANES = {
+    Type.INT32: 1, Type.FLOAT: 1, Type.INT64: 2, Type.DOUBLE: 2,
+    Type.INT96: 3,
+}
+
+_DICT_ENCODINGS = (Encoding.PLAIN_DICTIONARY, Encoding.RLE_DICTIONARY)
+
+
+class DeviceColumn:
+    """Device-resident decoded column (Arrow layout).
+
+    ``data``: (n_non_null, lanes) u32 for fixed-width types, or u8 bytes
+    with ``offsets`` for BYTE_ARRAY.  ``mask``/``positions`` map record
+    slots to packed values; ``rep_levels``/``def_levels`` preserve nesting.
+    """
+
+    __slots__ = ("ptype", "type_length", "data", "offsets", "mask",
+                 "positions", "rep_levels", "def_levels", "num_values")
+
+    def __init__(self, ptype, type_length, data, offsets, mask, positions,
+                 rep_levels, def_levels, num_values):
+        self.ptype = ptype
+        self.type_length = type_length
+        self.data = data
+        self.offsets = offsets
+        self.mask = mask
+        self.positions = positions
+        self.rep_levels = rep_levels
+        self.def_levels = def_levels
+        self.num_values = num_values
+
+    def block_until_ready(self):
+        for x in (self.data, self.offsets, self.mask, self.rep_levels,
+                  self.def_levels):
+            if x is not None:
+                x.block_until_ready()
+        return self
+
+    def to_numpy(self):
+        """Materialize to the CPU oracle's chunk representation:
+        (values, rep_levels, def_levels)."""
+        rep = np.asarray(self.rep_levels, dtype=np.int32)
+        dl = np.asarray(self.def_levels, dtype=np.int32)
+        if self.offsets is not None:
+            offs = np.asarray(self.offsets, dtype=np.int64)
+            data = np.asarray(self.data, dtype=np.uint8)[: int(offs[-1])]
+            return ByteArrayColumn(offs, data), rep, dl
+        lanes = np.asarray(self.data, dtype=np.uint32)
+        if self.ptype == Type.BOOLEAN:
+            return lanes.reshape(-1).astype(bool), rep, dl
+        if self.ptype == Type.INT32:
+            return lanes.reshape(-1).view(np.int32), rep, dl
+        if self.ptype == Type.FLOAT:
+            return lanes.reshape(-1).view(np.float32), rep, dl
+        if self.ptype == Type.INT64:
+            return lanes.reshape(-1).view(np.uint8).view("<i8"), rep, dl
+        if self.ptype == Type.DOUBLE:
+            return lanes.reshape(-1).view(np.uint8).view("<f8"), rep, dl
+        if self.ptype == Type.INT96:
+            return lanes.reshape(-1, 3), rep, dl
+        if self.ptype == Type.FIXED_LEN_BYTE_ARRAY:
+            n = self.type_length
+            return (
+                lanes.reshape(-1).view(np.uint8).reshape(-1, 4 * lanes.shape[1])[:, :n],
+                rep, dl,
+            )
+        raise TypeError(f"unsupported type {self.ptype}")
+
+
+def _stage_fixed_plain(raw: bytes, count: int, ptype: Type,
+                       type_length) -> jax.Array:
+    if ptype == Type.BOOLEAN:
+        words = pad_to_words(np.frombuffer(raw, np.uint8), 1, count)
+        return unpack_u32(jnp.asarray(words), 1, count)[:, None]
+    if ptype == Type.FIXED_LEN_BYTE_ARRAY:
+        lanes = (type_length + 3) // 4
+        # pad each value to a whole number of u32 lanes
+        arr = np.frombuffer(raw, np.uint8, count * type_length).reshape(
+            count, type_length
+        )
+        padded = np.zeros((count, lanes * 4), dtype=np.uint8)
+        padded[:, :type_length] = arr
+        return jnp.asarray(padded.reshape(count, lanes, 4).view("<u4")[..., 0])
+    lanes = _LANES[ptype]
+    words = stage_u32(raw, count * lanes)
+    return plain_fixed_to_lanes(jnp.asarray(words), count, lanes)
+
+
+def _flba_lanes(type_length: int) -> int:
+    return (type_length + 3) // 4
+
+
+def decode_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
+                        base: int = 0) -> DeviceColumn:
+    """Decode one column chunk to a DeviceColumn.
+
+    ``blob`` holds the chunk's byte range; offsets in ``cm`` are absolute
+    minus ``base``.  Host work: page-header walk, block decompression
+    (until the device snappy path lands), plan building.
+    """
+    codec = CompressionCodec(cm.codec)
+    ptype = Type(node.element.type)
+    start = cm.data_page_offset
+    if cm.dictionary_page_offset is not None:
+        start = min(start, cm.dictionary_page_offset)
+    start -= base
+    end = start + cm.total_compressed_size
+    r = CompactReader(blob, start, end)
+
+    dict_fixed = None      # staged (D, lanes) u32
+    dict_offsets = None    # staged byte-array dictionary
+    dict_data = None
+    dict_lens_np = None
+    dict_np = None
+
+    val_parts = []         # device arrays, (n, lanes) u32
+    bytes_parts = []       # (lens_np, device u8 data) per page for BYTE_ARRAY
+    rep_parts = []
+    def_parts = []
+    values_read = 0
+    total = cm.num_values
+
+    while values_read < total:
+        ph = decode_struct(PageHeader, r)
+        payload = bytes(blob[r.pos : r.pos + ph.compressed_page_size])
+        r.pos += ph.compressed_page_size
+        ptype_page = PageType(ph.type)
+
+        if ptype_page == PageType.DICTIONARY_PAGE:
+            raw = decompress_block(codec, payload, ph.uncompressed_page_size)
+            dict_np = decode_plain(
+                ptype, raw, ph.dictionary_page_header.num_values,
+                node.element.type_length,
+            )
+            if isinstance(dict_np, ByteArrayColumn):
+                dict_offsets = jnp.asarray(dict_np.offsets, dtype=jnp.int32)
+                dict_data = jnp.asarray(dict_np.data)
+                dict_lens_np = dict_np.lengths()
+            else:
+                arr = np.asarray(dict_np)
+                if arr.dtype == np.bool_:
+                    staged = arr.astype(np.uint32)[:, None]
+                elif arr.dtype in (np.dtype("<i4"), np.dtype("<f4")):
+                    staged = arr.view("<u4")[:, None]
+                elif arr.dtype in (np.dtype("<i8"), np.dtype("<f8")):
+                    staged = arr.view("<u4").reshape(-1, 2)
+                elif ptype == Type.INT96:
+                    staged = arr.astype("<u4")
+                else:  # FLBA (D, L) u8
+                    lanes = _flba_lanes(node.element.type_length)
+                    padded = np.zeros((arr.shape[0], lanes * 4), np.uint8)
+                    padded[:, : arr.shape[1]] = arr
+                    staged = padded.reshape(-1, lanes, 4).view("<u4")[..., 0]
+                dict_fixed = jnp.asarray(staged)
+            if r.pos != cm.data_page_offset - base:
+                r.pos = cm.data_page_offset - base
+            continue
+
+        if ptype_page == PageType.DATA_PAGE:
+            h = ph.data_page_header
+            raw = decompress_block(codec, payload, ph.uncompressed_page_size)
+            n = h.num_values
+            pos = 0
+            rep_dev, pos = _levels_v1_device(
+                raw, n, node.max_rep_level, pos,
+                h.repetition_level_encoding,
+            )
+            dl_dev, pos = _levels_v1_device(
+                raw, n, node.max_def_level, pos,
+                h.definition_level_encoding,
+            )
+            values_seg = raw[pos:]
+            enc = h.encoding
+        elif ptype_page == PageType.DATA_PAGE_V2:
+            h = ph.data_page_header_v2
+            n = h.num_values
+            rl_len = h.repetition_levels_byte_length or 0
+            dl_len = h.definition_levels_byte_length or 0
+            rep_dev = _levels_raw_device(
+                payload[:rl_len], n, node.max_rep_level
+            )
+            dl_dev = _levels_raw_device(
+                payload[rl_len : rl_len + dl_len], n, node.max_def_level
+            )
+            values_seg = payload[rl_len + dl_len :]
+            if h.is_compressed is not False:
+                values_seg = decompress_block(
+                    codec, values_seg,
+                    ph.uncompressed_page_size - rl_len - dl_len,
+                )
+            enc = h.encoding
+        else:
+            continue
+
+        if node.max_def_level:
+            dl_host = np.asarray(dl_dev)
+            non_null = int((dl_host == node.max_def_level).sum())
+        else:
+            non_null = n
+        rep_parts.append(rep_dev)
+        def_parts.append(dl_dev)
+        values_read += n
+
+        if enc in _DICT_ENCODINGS:
+            width = values_seg[0] if len(values_seg) else 0
+            idx = decode_hybrid_device(values_seg, non_null, width, pos=1) \
+                if width else jnp.zeros((non_null,), jnp.uint32)
+            idx = idx.astype(jnp.int32)
+            if dict_fixed is not None:
+                val_parts.append(dict_gather_fixed(dict_fixed, idx))
+            elif dict_offsets is not None:
+                idx_np = np.asarray(idx)
+                lens = dict_lens_np[idx_np]
+                out_offsets = np.zeros(non_null + 1, dtype=np.int32)
+                np.cumsum(lens, out=out_offsets[1:])
+                total_b = int(out_offsets[-1])
+                from .decode import bucket
+
+                cap = bucket(max(total_b, 1))
+                data = dict_gather_bytes(
+                    dict_offsets, dict_data, idx,
+                    jnp.asarray(out_offsets), cap,
+                )
+                bytes_parts.append((out_offsets, data, total_b))
+            else:
+                raise ValueError("dict-encoded page without dictionary")
+        elif enc == Encoding.PLAIN:
+            if ptype == Type.BYTE_ARRAY:
+                col = decode_plain(ptype, values_seg, non_null)  # host scan
+                offs = col.offsets.astype(np.int32)
+                bytes_parts.append(
+                    (offs, jnp.asarray(col.data), int(col.data.size))
+                )
+            else:
+                val_parts.append(
+                    _stage_fixed_plain(values_seg, non_null, ptype,
+                                       node.element.type_length)
+                )
+        elif enc == Encoding.DELTA_BINARY_PACKED and ptype == Type.INT32:
+            plan = plan_delta_i32(values_seg)
+            val_parts.append(expand_delta_i32(plan)[:non_null, None])
+        else:
+            # CPU fallback for the remaining encodings; stage the result.
+            col = decode_values_cpu(ptype, enc, values_seg, non_null,
+                                    node.element.type_length)
+            if isinstance(col, ByteArrayColumn):
+                bytes_parts.append(
+                    (col.offsets.astype(np.int32), jnp.asarray(col.data),
+                     int(col.data.size))
+                )
+            else:
+                val_parts.append(_stage_numpy_fixed(col, ptype))
+
+    rep = jnp.concatenate(rep_parts) if rep_parts else jnp.zeros(0, jnp.int32)
+    dl = jnp.concatenate(def_parts) if def_parts else jnp.zeros(0, jnp.int32)
+    mask, positions = levels_to_validity(dl.astype(jnp.int32),
+                                         node.max_def_level) \
+        if node.max_def_level else (
+            jnp.ones(total, dtype=bool),
+            jnp.arange(total, dtype=jnp.int32),
+        )
+
+    if bytes_parts:
+        # merge per-page byte columns: rebase offsets, concat data
+        all_offs = [np.zeros(1, dtype=np.int64)]
+        datas = []
+        base_off = 0
+        for offs, data, nbytes in bytes_parts:
+            all_offs.append(np.asarray(offs[1:], dtype=np.int64) + base_off)
+            datas.append(jnp.asarray(data)[:nbytes])
+            base_off += nbytes
+        offsets = jnp.asarray(np.concatenate(all_offs))
+        data = jnp.concatenate(datas) if datas else jnp.zeros(0, jnp.uint8)
+        return DeviceColumn(ptype, node.element.type_length, data, offsets,
+                            mask, positions, rep, dl, total)
+
+    if val_parts:
+        data = jnp.concatenate(val_parts)
+    else:
+        data = jnp.zeros((0, 1), dtype=jnp.uint32)
+    return DeviceColumn(ptype, node.element.type_length, data, None, mask,
+                        positions, rep, dl, total)
+
+
+def read_row_group_device(reader, rg_index: int) -> dict[str, DeviceColumn]:
+    """Decode the selected columns of one row group onto the device.
+
+    The device-path sibling of ``FileReader.read_row_group_arrays``: same
+    selection semantics, device-resident results."""
+    rg = reader.meta.row_groups[rg_index]
+    out = {}
+    for path, node, cm, blob, start in reader.iter_selected_chunks(rg):
+        out[path] = decode_chunk_device(memoryview(blob), cm, node,
+                                        base=start)
+    return out
+
+
+def decode_values_cpu(ptype, enc, data, count, type_length):
+    from ..io.pages import decode_values
+
+    return decode_values(ptype, enc, data, count, type_length)
+
+
+def _stage_numpy_fixed(col, ptype: Type) -> jax.Array:
+    arr = np.asarray(col)
+    if arr.dtype == np.bool_:
+        return jnp.asarray(arr.astype(np.uint32)[:, None])
+    if arr.dtype.itemsize == 4:
+        return jnp.asarray(arr.view("<u4").reshape(-1, 1))
+    if arr.dtype.itemsize == 8:
+        return jnp.asarray(arr.view("<u4").reshape(-1, 2))
+    if arr.ndim == 2:  # FLBA / int96 byte matrices
+        lanes = (arr.shape[1] + 3) // 4
+        padded = np.zeros((arr.shape[0], lanes * 4), np.uint8)
+        padded[:, : arr.shape[1]] = arr.view(np.uint8).reshape(arr.shape[0], -1)
+        return jnp.asarray(padded.reshape(-1, lanes, 4).view("<u4")[..., 0])
+    raise TypeError(f"cannot stage {arr.dtype} for {ptype}")
+
+
+def _levels_v1_device(raw, n, max_level, pos, encoding=Encoding.RLE):
+    if max_level == 0:
+        return jnp.zeros((n,), dtype=jnp.int32), pos
+    width = max_level.bit_length()
+    if encoding == Encoding.BIT_PACKED:
+        # Legacy MSB-first levels (old parquet-mr writers): decode on host
+        # via the oracle and stage — rare enough not to warrant a kernel.
+        from ..cpu import decode_levels_bitpacked
+
+        nbytes = (n * width + 7) // 8
+        vals = decode_levels_bitpacked(raw[pos : pos + nbytes], n, max_level)
+        return jnp.asarray(vals, dtype=jnp.int32), pos + nbytes
+    import struct
+
+    (size,) = struct.unpack_from("<I", raw, pos)
+    body = raw[pos + 4 : pos + 4 + size]
+    vals = decode_hybrid_device(body, n, width)
+    return vals.astype(jnp.int32), pos + 4 + size
+
+
+def _levels_raw_device(raw, n, max_level):
+    if max_level == 0:
+        return jnp.zeros((n,), dtype=jnp.int32)
+    width = max_level.bit_length()
+    return decode_hybrid_device(raw, n, width).astype(jnp.int32)

@@ -1,0 +1,135 @@
+"""Device hybrid RLE/bit-packed decode: host run-table plan + device expand.
+
+The sequential uvarint-chained run structure (SURVEY.md §7 "hard parts") is
+resolved in a cheap host pass over the run *headers* only (a few bytes per
+run); the values themselves are never touched on host.  The plan is:
+
+* ``bp_words``: all bit-packed segments concatenated, staged as u32 words;
+* ``run_ends``: cumulative output counts per run (searchsorted key);
+* ``run_is_rle`` / ``run_value``: RLE runs' fill values;
+* ``run_bp_start``: for BP runs, the value offset into the unpacked stream.
+
+Device expansion is then fully parallel: unpack all BP segments in one
+shot, and for every output slot pick either its RLE fill value or its
+unpacked value via a vectorized ``searchsorted`` over run boundaries.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..varint import read_uvarint
+from .bitunpack import pad_to_words, unpack_u32
+
+__all__ = ["plan_hybrid", "expand_hybrid", "decode_hybrid_device", "HybridPlan"]
+
+
+class HybridPlan:
+    """Host-built run table (static shapes per stream)."""
+
+    __slots__ = (
+        "bp_words", "run_ends", "run_is_rle", "run_value", "run_bp_start",
+        "count", "width", "n_bp_values",
+    )
+
+    def __init__(self, bp_words, run_ends, run_is_rle, run_value,
+                 run_bp_start, count, width, n_bp_values):
+        self.bp_words = bp_words
+        self.run_ends = run_ends
+        self.run_is_rle = run_is_rle
+        self.run_value = run_value
+        self.run_bp_start = run_bp_start
+        self.count = count
+        self.width = width
+        self.n_bp_values = n_bp_values
+
+
+def plan_hybrid(data, count: int, width: int, pos: int = 0) -> HybridPlan:
+    """Parse run headers into a run table (host, metadata-sized work)."""
+    vbytes = (width + 7) // 8
+    buf = data if isinstance(data, (bytes, bytearray, memoryview)) else bytes(data)
+    ends = []
+    is_rle = []
+    values = []
+    bp_starts = []
+    bp_segments = []
+    filled = 0
+    n_bp = 0
+    while filled < count:
+        h, pos = read_uvarint(buf, pos)
+        if h & 1:
+            n = (h >> 1) * 8
+            nbytes = (n * width + 7) // 8
+            if pos + nbytes > len(buf):
+                raise ValueError("truncated bit-packed run")
+            bp_segments.append(np.frombuffer(buf, np.uint8, nbytes, pos))
+            bp_starts.append(n_bp)
+            values.append(0)
+            is_rle.append(False)
+            pos += nbytes
+            take = min(n, count - filled)
+            # the unpacked stream keeps the full n values; consumers index
+            # through run_bp_start so padding values are never selected
+            n_bp += n
+            filled += take
+        else:
+            n = h >> 1
+            if n == 0:
+                raise ValueError("zero-length RLE run")
+            if pos + vbytes > len(buf):
+                raise ValueError("truncated RLE run value")
+            v = int.from_bytes(buf[pos : pos + vbytes], "little")
+            pos += vbytes
+            values.append(v)
+            is_rle.append(True)
+            bp_starts.append(n_bp)
+            take = min(n, count - filled)
+            filled += take
+        ends.append(filled)
+    if not ends:
+        ends, is_rle, values, bp_starts = [0], [True], [0], [0]
+    if bp_segments:
+        packed = np.concatenate(bp_segments)
+    else:
+        packed = np.zeros(0, dtype=np.uint8)
+    bp_words = pad_to_words(packed, max(width, 1), max(n_bp, 1))
+    return HybridPlan(
+        bp_words=bp_words,
+        run_ends=np.asarray(ends, dtype=np.int32),
+        run_is_rle=np.asarray(is_rle, dtype=bool),
+        run_value=np.asarray(values, dtype=np.uint32),
+        run_bp_start=np.asarray(bp_starts, dtype=np.int32),
+        count=count,
+        width=width,
+        n_bp_values=max(n_bp, 1),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("count", "width", "n_bp"))
+def expand_hybrid(bp_words, run_ends, run_is_rle, run_value, run_bp_start,
+                  count: int, width: int, n_bp: int) -> jax.Array:
+    """Vectorized run expansion on device; returns (count,) u32."""
+    if count == 0:
+        return jnp.zeros((0,), dtype=jnp.uint32)
+    unpacked = unpack_u32(bp_words, max(width, 1), n_bp)
+    idx = jnp.arange(count, dtype=jnp.int32)
+    run = jnp.searchsorted(run_ends, idx, side="right").astype(jnp.int32)
+    run = jnp.minimum(run, run_ends.shape[0] - 1)
+    run_start = jnp.where(run > 0, run_ends[run - 1], 0)
+    within = idx - run_start
+    bp_pos = jnp.clip(run_bp_start[run] + within, 0, n_bp - 1)
+    return jnp.where(run_is_rle[run], run_value[run], unpacked[bp_pos])
+
+
+def decode_hybrid_device(data, count: int, width: int, pos: int = 0):
+    """End-to-end: host plan + device expand (convenience wrapper)."""
+    p = plan_hybrid(data, count, width, pos)
+    return expand_hybrid(
+        jnp.asarray(p.bp_words), jnp.asarray(p.run_ends),
+        jnp.asarray(p.run_is_rle), jnp.asarray(p.run_value),
+        jnp.asarray(p.run_bp_start), p.count, p.width, p.n_bp_values,
+    )
