@@ -1,0 +1,136 @@
+"""Benchmark: decoded values/sec on a NYC-Taxi-like table (Snappy + dict).
+
+BASELINE.md config 2: int32/int64 columns, RLE/bit-packed hybrid +
+dictionary encoding, Snappy block compression.  The baseline is this
+framework's own CPU oracle path (the reference publishes no numbers —
+SURVEY.md §6), measured in the same process; the reported value is the
+device batch-decode path's throughput, parity-checked bit-exact against
+the CPU path before timing.
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": N, "unit": "values/sec", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import sys
+import time
+
+import numpy as np
+
+N_ROWS = 200_000
+N_GROUPS = 4
+REPS = 3
+
+
+def build_file() -> io.BytesIO:
+    """Write a NYC-Taxi-shaped table with our own writer."""
+    from tpuparquet import CompressionCodec, FileWriter
+
+    rng = np.random.default_rng(42)
+    buf = io.BytesIO()
+    w = FileWriter(
+        buf,
+        """message taxi {
+            required int64 pickup_ts;
+            required int32 passenger_count;
+            required int32 rate_code;
+            required int64 trip_distance_mm;
+            optional int32 payment_type;
+        }""",
+        codec=CompressionCodec.SNAPPY,
+    )
+    per = N_ROWS // N_GROUPS
+    base_ts = 1_700_000_000_000
+    for g in range(N_GROUPS):
+        ts = base_ts + rng.integers(0, 3_600_000, size=per).cumsum()
+        pc = rng.integers(1, 7, size=per)
+        rc = rng.integers(1, 6, size=per)
+        dist = rng.integers(100, 50_000, size=per)
+        pay = rng.integers(0, 5, size=per)
+        pay_null = rng.random(per) < 0.05
+        for i in range(per):
+            w.add_data({
+                "pickup_ts": int(ts[i]),
+                "passenger_count": int(pc[i]),
+                "rate_code": int(rc[i]),
+                "trip_distance_mm": int(dist[i]),
+                "payment_type": None if pay_null[i] else int(pay[i]),
+            })
+        w.flush_row_group()
+    w.close()
+    buf.seek(0)
+    return buf
+
+
+def total_values(reader) -> int:
+    return sum(
+        cc.meta_data.num_values
+        for rg in reader.meta.row_groups
+        for cc in rg.columns
+    )
+
+
+def run_cpu(reader) -> float:
+    """CPU oracle decode of every row group; returns seconds."""
+    t0 = time.perf_counter()
+    for rg in range(reader.row_group_count()):
+        reader.read_row_group_arrays(rg)
+    return time.perf_counter() - t0
+
+
+def run_device(reader) -> float:
+    from tpuparquet.kernels.device import read_row_group_device
+
+    t0 = time.perf_counter()
+    cols = []
+    for rg in range(reader.row_group_count()):
+        cols.append(read_row_group_device(reader, rg))
+    for d in cols:
+        for c in d.values():
+            c.block_until_ready()
+    return time.perf_counter() - t0
+
+
+def parity(reader) -> None:
+    from tpuparquet.kernels.device import read_row_group_device
+
+    for rg in range(reader.row_group_count()):
+        cpu = reader.read_row_group_arrays(rg)
+        dev = read_row_group_device(reader, rg)
+        for path, cd in cpu.items():
+            vals, rep, dl = dev[path].to_numpy()
+            np.testing.assert_array_equal(vals, np.asarray(cd.values))
+            np.testing.assert_array_equal(rep, cd.rep_levels)
+            np.testing.assert_array_equal(dl, cd.def_levels)
+
+
+def main() -> None:
+    from tpuparquet import FileReader
+
+    buf = build_file()
+    reader = FileReader(buf)
+    n_values = total_values(reader)
+
+    parity(reader)  # bit-exact or we don't report at all
+
+    run_cpu(reader)  # warm caches
+    cpu_s = min(run_cpu(reader) for _ in range(REPS))
+
+    run_device(reader)  # compile warmup
+    dev_s = min(run_device(reader) for _ in range(REPS))
+
+    cpu_vps = n_values / cpu_s
+    dev_vps = n_values / dev_s
+    print(json.dumps({
+        "metric": "decoded values/sec/chip, NYC-Taxi-like (Snappy+dict)",
+        "value": round(dev_vps, 1),
+        "unit": "values/sec",
+        "vs_baseline": round(dev_vps / cpu_vps, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,7 +25,10 @@ import numpy as np
 from ..varint import read_uvarint
 from .bitunpack import pad_to_words, unpack_u32
 
-__all__ = ["plan_hybrid", "expand_hybrid", "decode_hybrid_device", "HybridPlan"]
+__all__ = [
+    "plan_hybrid", "pad_plan", "expand_hybrid", "expand_hybrid_core",
+    "decode_hybrid_device", "decode_hybrid_device_padded", "HybridPlan",
+]
 
 
 class HybridPlan:
@@ -109,14 +112,14 @@ def plan_hybrid(data, count: int, width: int, pos: int = 0) -> HybridPlan:
     )
 
 
-@functools.partial(jax.jit, static_argnames=("count", "width", "n_bp"))
-def expand_hybrid(bp_words, run_ends, run_is_rle, run_value, run_bp_start,
-                  count: int, width: int, n_bp: int) -> jax.Array:
-    """Vectorized run expansion on device; returns (count,) u32."""
-    if count == 0:
-        return jnp.zeros((0,), dtype=jnp.uint32)
+def expand_hybrid_core(bp_words, run_ends, run_is_rle, run_value,
+                       run_bp_start, idx, width: int, n_bp: int) -> jax.Array:
+    """Run expansion for an arbitrary set of output positions ``idx``.
+
+    Pure traceable core shared by :func:`expand_hybrid`, the vmapped batch
+    variant, and the shard_map sequence-parallel step (each shard passes
+    its own slice of positions)."""
     unpacked = unpack_u32(bp_words, max(width, 1), n_bp)
-    idx = jnp.arange(count, dtype=jnp.int32)
     run = jnp.searchsorted(run_ends, idx, side="right").astype(jnp.int32)
     run = jnp.minimum(run, run_ends.shape[0] - 1)
     run_start = jnp.where(run > 0, run_ends[run - 1], 0)
@@ -125,11 +128,51 @@ def expand_hybrid(bp_words, run_ends, run_is_rle, run_value, run_bp_start,
     return jnp.where(run_is_rle[run], run_value[run], unpacked[bp_pos])
 
 
+@functools.partial(jax.jit, static_argnames=("count", "width", "n_bp"))
+def expand_hybrid(bp_words, run_ends, run_is_rle, run_value, run_bp_start,
+                  count: int, width: int, n_bp: int) -> jax.Array:
+    """Vectorized run expansion on device; returns (count,) u32."""
+    if count == 0:
+        return jnp.zeros((0,), dtype=jnp.uint32)
+    idx = jnp.arange(count, dtype=jnp.int32)
+    return expand_hybrid_core(bp_words, run_ends, run_is_rle, run_value,
+                              run_bp_start, idx, width, n_bp)
+
+
+def pad_plan(p: HybridPlan):
+    """Pad one plan's dynamic dims (run count, bp count, output count) to
+    power-of-two buckets so jitted expands cache on buckets, not exact
+    per-page sizes.  Returns (staged array tuple, cnt, width, n_bp)."""
+    from .decode import bucket
+
+    cnt = bucket(p.count)
+    R = bucket(len(p.run_ends))
+    n_bp = bucket(p.n_bp_values)
+    n_blocks = (n_bp + 31) // 32
+    w = max(p.width, 1)
+    bp_words = np.zeros((n_blocks, w), dtype=np.uint32)
+    bp_words[: p.bp_words.shape[0], : p.bp_words.shape[1]] = p.bp_words
+    # padding runs end at cnt (monotone, never selected for idx < count)
+    run_ends = np.full(R, cnt, dtype=np.int32)
+    run_ends[: len(p.run_ends)] = p.run_ends
+    run_is_rle = np.ones(R, dtype=bool)
+    run_is_rle[: len(p.run_is_rle)] = p.run_is_rle
+    run_value = np.zeros(R, dtype=np.uint32)
+    run_value[: len(p.run_value)] = p.run_value
+    run_bp_start = np.zeros(R, dtype=np.int32)
+    run_bp_start[: len(p.run_bp_start)] = p.run_bp_start
+    return (bp_words, run_ends, run_is_rle, run_value,
+            run_bp_start), cnt, p.width, n_bp
+
+
+def decode_hybrid_device_padded(data, count: int, width: int, pos: int = 0):
+    """Host plan + device expand, returning the bucket-padded output
+    (shape (bucket(count),), tail zeros) — callers that feed another
+    padded kernel can skip the slice/re-pad round trip."""
+    args, cnt, w, n_bp = pad_plan(plan_hybrid(data, count, width, pos))
+    return expand_hybrid(*(jnp.asarray(a) for a in args), cnt, w, n_bp)
+
+
 def decode_hybrid_device(data, count: int, width: int, pos: int = 0):
     """End-to-end: host plan + device expand (convenience wrapper)."""
-    p = plan_hybrid(data, count, width, pos)
-    return expand_hybrid(
-        jnp.asarray(p.bp_words), jnp.asarray(p.run_ends),
-        jnp.asarray(p.run_is_rle), jnp.asarray(p.run_value),
-        jnp.asarray(p.run_bp_start), p.count, p.width, p.n_bp_values,
-    )
+    return decode_hybrid_device_padded(data, count, width, pos)[:count]

@@ -1,0 +1,19 @@
+"""Multi-chip sharding: row-group data parallelism over a ``jax.sharding.Mesh``.
+
+The reference is single-process (SURVEY.md §2.8 — no goroutine fan-out,
+no distributed layer); this package is the TPU-native scale-out that takes
+its place: (file × row-group) units shard across the mesh, each chip
+decodes its shard with the device kernels, and decoded columns are
+exchanged with XLA collectives over ICI (``all_gather``) rather than any
+NCCL/MPI-style backend.
+"""
+
+from .mesh import (  # noqa: F401
+    BatchedHybridPlan,
+    assign_units,
+    decode_step_spmd,
+    make_mesh,
+    sharded_dict_decode,
+    stack_hybrid_plans,
+)
+from .scan import ShardedScan, gather_column, scan_units  # noqa: F401

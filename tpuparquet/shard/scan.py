@@ -1,0 +1,106 @@
+"""Multi-file sharded scan driver.
+
+The scan unit is (file, row-group) — the reference's outer loop
+(``file_reader.go:51-57``) turned into a work list, sharded round-robin
+over the mesh devices (SURVEY.md §5 "distributed communication backend").
+Each unit decodes entirely on its assigned device via the kernel path;
+cross-device exchange happens only at :func:`gather_column`, as one XLA
+all-gather of the decoded column shards.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..io.reader import FileReader
+from ..kernels.decode import scatter_to_dense
+from ..kernels.device import DeviceColumn, read_row_group_device
+
+__all__ = ["ShardedScan", "scan_units", "gather_column"]
+
+
+def scan_units(readers: list[FileReader]) -> list[tuple[int, int]]:
+    """Flatten files into (file_index, row_group_index) work units."""
+    return [
+        (fi, rgi)
+        for fi, r in enumerate(readers)
+        for rgi in range(r.row_group_count())
+    ]
+
+
+class ShardedScan:
+    """Decode many files' row groups data-parallel across a mesh.
+
+    ``sources`` are paths or file objects; ``columns`` optionally project.
+    :meth:`run` decodes every unit on its round-robin device and returns
+    per-unit ``{path: DeviceColumn}`` dicts; results stay device-resident
+    and sharded until explicitly gathered.
+    """
+
+    def __init__(self, sources, *columns: str, mesh=None):
+        from .mesh import make_mesh
+
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.readers = [FileReader(s, *columns) for s in sources]
+        self.units = scan_units(self.readers)
+        self.devices = list(self.mesh.devices.flat)
+
+    def device_for(self, unit_index: int):
+        return self.devices[unit_index % len(self.devices)]
+
+    def run(self) -> list[dict[str, DeviceColumn]]:
+        out = []
+        for i, (fi, rgi) in enumerate(self.units):
+            with jax.default_device(self.device_for(i)):
+                out.append(read_row_group_device(self.readers[fi], rgi))
+        return out
+
+    def close(self):
+        for r in self.readers:
+            r.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+
+
+def gather_column(mesh, results: list[dict[str, DeviceColumn]], path: str):
+    """All-gather one fixed-width column across the mesh.
+
+    Builds a (U, L, lanes) global array sharded unit-wise over the "rg"
+    axis from the per-device results (null slots zero-filled, units
+    padded to a common length L), then runs one jitted identity with
+    replicated output sharding — which XLA lowers to the all-gather
+    collective over ICI.  Returns (values (U, L, lanes) ndarray,
+    per-unit true counts); callers unpad with the counts.
+    """
+    cols = [r[path] for r in results]
+    if any(c.offsets is not None for c in cols):
+        raise TypeError("gather_column handles fixed-width columns; "
+                        "BYTE_ARRAY shards stay per-device")
+    dense = [
+        scatter_to_dense(
+            c.data if c.data.ndim > 1 else c.data[:, None],
+            c.mask, c.positions,
+        )
+        for c in cols
+    ]
+    counts = np.asarray([d.shape[0] for d in dense], dtype=np.int64)
+    L = int(counts.max()) if len(counts) else 0
+    lanes = dense[0].shape[1] if dense else 1
+    n_dev = len(list(mesh.devices.flat))
+    U = max(len(dense), 1)
+    U = ((U + n_dev - 1) // n_dev) * n_dev
+    stacked = jnp.zeros((U, L, lanes), dtype=jnp.uint32)
+    for i, d in enumerate(dense):
+        stacked = stacked.at[i, : d.shape[0]].set(d.astype(jnp.uint32))
+    sharded = jax.device_put(stacked, NamedSharding(mesh, P("rg")))
+    gathered = jax.jit(
+        lambda x: x, out_shardings=NamedSharding(mesh, P())
+    )(sharded)
+    return np.asarray(gathered)[: len(dense)], counts
