@@ -506,3 +506,61 @@ class TestPyarrowInterop:
                        column_encoding={"ts": "DELTA_BINARY_PACKED"})
         r = FileReader(str(path))
         assert [row["ts"] for row in r.rows()] == list(range(10_000))
+
+
+class TestReviewRegressions:
+    """Regressions for issues found in code review (columnar write path)."""
+
+    def test_unsigned_int32_array_wraps_to_signed_storage(self):
+        import pyarrow.parquet as pq
+
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required int32 u (UINT_32); }")
+        w.write_columns({"u": np.array([3, 2**31 + 5, 2**32 - 1],
+                                       dtype=np.int64)})
+        w.close()
+        buf.seek(0)
+        t = pq.read_table(buf)
+        assert t.column("u").to_pylist() == [3, 2**31 + 5, 2**32 - 1]
+
+    def test_int64_dtype_into_int32_delta_column(self):
+        from tpuparquet.kernels.device import read_row_group_device
+
+        buf = io.BytesIO()
+        w = FileWriter(
+            buf, "message m { required int32 t; }",
+            column_encodings={"t": Encoding.DELTA_BINARY_PACKED},
+            allow_dict=False,
+        )
+        w.write_columns({"t": np.array([-(2**31), 2**31 - 1],
+                                       dtype=np.int64)})
+        w.close()
+        buf.seek(0)
+        r = FileReader(buf)
+        cd = r.read_row_group_arrays(0)["t"]
+        np.testing.assert_array_equal(
+            np.asarray(cd.values), np.array([-(2**31), 2**31 - 1], np.int32)
+        )
+        dev = read_row_group_device(r, 0)["t"]
+        vals, _, _ = dev.to_numpy()
+        np.testing.assert_array_equal(
+            vals, np.array([-(2**31), 2**31 - 1], np.int32)
+        )
+
+    def test_int32_array_out_of_range_rejected(self):
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required int32 a; }")
+        with pytest.raises(ValueError):
+            w.write_columns({"a": np.array([2**40], dtype=np.int64)})
+
+    def test_device_delta_plan_rejects_bad_miniblock_size(self):
+        from tpuparquet.kernels.decode import plan_delta_i32
+        from tpuparquet.varint import write_uvarint, write_zigzag
+
+        out = bytearray()
+        write_uvarint(out, 128)   # block size
+        write_uvarint(out, 64)    # miniblocks -> mb_size 2, not mult of 32
+        write_uvarint(out, 5)     # total values
+        write_zigzag(out, 0)
+        with pytest.raises(ValueError):
+            plan_delta_i32(bytes(out))

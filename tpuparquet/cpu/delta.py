@@ -88,15 +88,20 @@ def decode_delta_binary_packed(data, dtype=np.int64, pos: int = 0):
 
 
 def encode_delta_binary_packed(
-    values, block_size: int = 128, n_miniblocks: int = 4
+    values, block_size: int = 128, n_miniblocks: int = 4,
+    is32: bool | None = None,
 ) -> bytes:
-    """Encode int32/int64 values; overflow-safe via uint64 delta arithmetic."""
+    """Encode int32/int64 values; overflow-safe via uint64 delta arithmetic.
+
+    ``is32`` should be passed by callers that know the column's physical
+    type; when None it is inferred from the array dtype."""
     v0 = np.asarray(values)
     # int32 columns must wrap deltas at 32 bits: otherwise values spanning
     # the full int32 range produce 33-bit miniblock widths, which int32
     # delta decoders (parquet-mr, our device kernel) reject.  The wrapped
     # deltas reconstruct identically modulo 2^32.
-    is32 = v0.dtype in (np.dtype(np.int32), np.dtype(np.uint32))
+    if is32 is None:
+        is32 = v0.dtype in (np.dtype(np.int32), np.dtype(np.uint32))
     v = v0.astype(np.int64, copy=False)
     out = bytearray()
     write_uvarint(out, block_size)

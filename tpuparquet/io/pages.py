@@ -166,7 +166,7 @@ def encode_values(ptype: Type, encoding: Encoding, column,
             np.asarray(column, dtype=np.bool_).astype(np.uint32), 1
         )
     if encoding == Encoding.DELTA_BINARY_PACKED:
-        return encode_delta_binary_packed(column)
+        return encode_delta_binary_packed(column, is32=(ptype == Type.INT32))
     if encoding == Encoding.DELTA_LENGTH_BYTE_ARRAY:
         return encode_delta_length_byte_array(column)
     if encoding == Encoding.DELTA_BYTE_ARRAY:

@@ -137,10 +137,20 @@ class ValueHandler:
                 raise TypeError(f"{p.name} column needs an integer array, "
                                 f"got {a.dtype}")
             lo, hi = _INT_RANGE[p]
+            store = np.int32 if p == Type.INT32 else np.int64
             if self.unsigned:
-                lo, hi = min(lo, 0), 2 * hi + 1
+                # accept either the signed-storage or the logical unsigned
+                # range, then wrap to two's-complement signed storage (the
+                # array analogue of coerce_one above)
+                if a.size and (int(a.min()) < lo or int(a.max()) > 2 * hi + 1):
+                    raise ValueError(f"values out of range for u{p.name}")
+                if a.dtype == store:
+                    return a
+                udt = np.uint32 if p == Type.INT32 else np.uint64
+                return a.astype(udt, copy=False).view(store)
             if a.size and (int(a.min()) < lo or int(a.max()) > hi):
                 raise ValueError(f"values out of range for {p.name}")
+            return a if a.dtype == store else a.astype(store)
         elif p in (Type.FLOAT, Type.DOUBLE):
             if not (np.issubdtype(a.dtype, np.floating)
                     or np.issubdtype(a.dtype, np.integer)):

@@ -141,6 +141,10 @@ def plan_delta_i32(data, pos: int = 0) -> DeltaPlan:
             or block_size % n_miniblocks:
         raise ValueError("invalid delta header")
     mb_size = block_size // n_miniblocks
+    if mb_size % 32:
+        # same constraint the CPU oracle enforces (cpu/delta.py): the
+        # whole-word miniblock layout this planner assumes requires it
+        raise ValueError(f"miniblock size {mb_size} not a multiple of 32")
     total, pos = read_uvarint(data, pos)
     first, pos = read_zigzag(data, pos)
     n_deltas = max(total - 1, 0)

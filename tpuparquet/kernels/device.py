@@ -128,14 +128,11 @@ def _stage_fixed_plain(raw: bytes, count: int, ptype: Type,
         words = pad_to_words(np.frombuffer(raw, np.uint8), 1, count)
         return unpack_u32(jnp.asarray(words), 1, count)[:, None]
     if ptype == Type.FIXED_LEN_BYTE_ARRAY:
-        lanes = (type_length + 3) // 4
-        # pad each value to a whole number of u32 lanes
-        arr = np.frombuffer(raw, np.uint8, count * type_length).reshape(
-            count, type_length
+        return _stage_byte_rows(
+            np.frombuffer(raw, np.uint8, count * type_length).reshape(
+                count, type_length
+            )
         )
-        padded = np.zeros((count, lanes * 4), dtype=np.uint8)
-        padded[:, :type_length] = arr
-        return jnp.asarray(padded.reshape(count, lanes, 4).view("<u4")[..., 0])
     lanes = _LANES[ptype]
     words = stage_u32(raw, count * lanes)
     return plain_fixed_to_lanes(jnp.asarray(words), count, lanes)
@@ -143,6 +140,35 @@ def _stage_fixed_plain(raw: bytes, count: int, ptype: Type,
 
 def _flba_lanes(type_length: int) -> int:
     return (type_length + 3) // 4
+
+
+def _stage_byte_rows(arr: np.ndarray) -> jax.Array:
+    """(N, L) u8 rows -> (N, lanes) u32, zero-padding each row to whole
+    little-endian u32 lanes (shared FLBA/int96 staging)."""
+    rows = arr.view(np.uint8).reshape(arr.shape[0], -1)
+    lanes = _flba_lanes(rows.shape[1])
+    padded = np.zeros((rows.shape[0], lanes * 4), dtype=np.uint8)
+    padded[:, : rows.shape[1]] = rows
+    return jnp.asarray(padded.reshape(-1, lanes, 4).view("<u4")[..., 0])
+
+
+def _levels_host(data, n: int, max_level: int, enc: str) -> np.ndarray:
+    """Host-side def-level decode, used only to count non-nulls without a
+    device->host sync.  Delegates to the CPU oracle's level decoders
+    (incl. their level-range validation).  ``enc``: "v1_rle"
+    (length-prefixed hybrid), "bit_packed" (legacy MSB-first), or
+    "v2_raw" (unprefixed hybrid)."""
+    from ..cpu.levels import (
+        decode_levels_bitpacked,
+        decode_levels_raw,
+        decode_levels_v1,
+    )
+
+    if enc == "bit_packed":
+        return decode_levels_bitpacked(data, n, max_level)
+    if enc == "v1_rle":
+        return decode_levels_v1(data, n, max_level)[0]
+    return decode_levels_raw(data, n, max_level)
 
 
 def decode_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
@@ -202,10 +228,7 @@ def decode_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 elif ptype == Type.INT96:
                     staged = arr.astype("<u4")
                 else:  # FLBA (D, L) u8
-                    lanes = _flba_lanes(node.element.type_length)
-                    padded = np.zeros((arr.shape[0], lanes * 4), np.uint8)
-                    padded[:, : arr.shape[1]] = arr
-                    staged = padded.reshape(-1, lanes, 4).view("<u4")[..., 0]
+                    staged = _stage_byte_rows(arr)
                 dict_fixed = jnp.asarray(staged)
             if r.pos != cm.data_page_offset - base:
                 r.pos = cm.data_page_offset - base
@@ -216,14 +239,17 @@ def decode_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
             raw = decompress_block(codec, payload, ph.uncompressed_page_size)
             n = h.num_values
             pos = 0
-            rep_dev, pos = _levels_v1_device(
+            rep_dev, pos, _ = _levels_v1_device(
                 raw, n, node.max_rep_level, pos,
                 h.repetition_level_encoding,
             )
-            dl_dev, pos = _levels_v1_device(
+            dl_start = pos
+            dl_dev, pos, dl_host = _levels_v1_device(
                 raw, n, node.max_def_level, pos,
                 h.definition_level_encoding,
             )
+            level_bytes = raw[dl_start:pos]
+            level_enc = "v1_rle"
             values_seg = raw[pos:]
             enc = h.encoding
         elif ptype_page == PageType.DATA_PAGE_V2:
@@ -234,9 +260,10 @@ def decode_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
             rep_dev = _levels_raw_device(
                 payload[:rl_len], n, node.max_rep_level
             )
-            dl_dev = _levels_raw_device(
-                payload[rl_len : rl_len + dl_len], n, node.max_def_level
-            )
+            level_bytes = payload[rl_len : rl_len + dl_len]
+            level_enc = "v2_raw"
+            dl_host = None
+            dl_dev = _levels_raw_device(level_bytes, n, node.max_def_level)
             values_seg = payload[rl_len + dl_len :]
             if h.is_compressed is not False:
                 values_seg = decompress_block(
@@ -247,34 +274,62 @@ def decode_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
         else:
             continue
 
-        if node.max_def_level:
-            dl_host = np.asarray(dl_dev)
-            non_null = int((dl_host == node.max_def_level).sum())
-        else:
+        if not node.max_def_level:
             non_null = n
+        elif (ptype_page == PageType.DATA_PAGE_V2
+              and h.num_nulls is not None):
+            non_null = n - h.num_nulls
+        else:
+            # count non-nulls from the host-side level bytes (cheap,
+            # vectorized) rather than syncing the device expansion back —
+            # device->host round-trips serialize the page pipeline
+            if dl_host is None:
+                dl_host = _levels_host(level_bytes, n, node.max_def_level,
+                                       level_enc)
+            non_null = int((dl_host == node.max_def_level).sum())
         rep_parts.append(rep_dev)
         def_parts.append(dl_dev)
         values_read += n
 
         if enc in _DICT_ENCODINGS:
             width = values_seg[0] if len(values_seg) else 0
-            idx = decode_hybrid_device(values_seg, non_null, width, pos=1) \
-                if width else jnp.zeros((non_null,), jnp.uint32)
-            idx = idx.astype(jnp.int32)
             if dict_fixed is not None:
+                idx = decode_hybrid_device(
+                    values_seg, non_null, width, pos=1
+                ).astype(jnp.int32) if width else jnp.zeros(
+                    (non_null,), jnp.int32
+                )
                 val_parts.append(dict_gather_fixed(dict_fixed, idx))
             elif dict_offsets is not None:
-                idx_np = np.asarray(idx)
+                # host-side index decode (vectorized, no device sync) just
+                # to size the output; the gather uses the device indices
+                from ..cpu.hybrid import decode_hybrid
+                from .decode import bucket
+                from .hybrid import decode_hybrid_device_padded
+
+                idx_np = (
+                    decode_hybrid(values_seg, non_null, width, pos=1)
+                    .astype(np.int32)
+                    if width else np.zeros(non_null, np.int32)
+                )
                 lens = dict_lens_np[idx_np]
                 out_offsets = np.zeros(non_null + 1, dtype=np.int32)
                 np.cumsum(lens, out=out_offsets[1:])
                 total_b = int(out_offsets[-1])
-                from .decode import bucket
-
+                # every dynamic input stays at its bucket size so the jit
+                # cache keys on buckets, not exact per-page counts
                 cap = bucket(max(total_b, 1))
+                idx_pad = decode_hybrid_device_padded(
+                    values_seg, non_null, width, pos=1
+                ).astype(jnp.int32) if width else jnp.zeros(
+                    (bucket(max(non_null, 1)),), jnp.int32
+                )
+                offs_pad = np.full(idx_pad.shape[0] + 1, total_b,
+                                   dtype=np.int32)
+                offs_pad[: non_null + 1] = out_offsets
                 data = dict_gather_bytes(
-                    dict_offsets, dict_data, idx,
-                    jnp.asarray(out_offsets), cap,
+                    dict_offsets, dict_data, idx_pad,
+                    jnp.asarray(offs_pad), cap,
                 )
                 bytes_parts.append((out_offsets, data, total_b))
             else:
@@ -365,16 +420,16 @@ def _stage_numpy_fixed(col, ptype: Type) -> jax.Array:
     if arr.dtype.itemsize == 8:
         return jnp.asarray(arr.view("<u4").reshape(-1, 2))
     if arr.ndim == 2:  # FLBA / int96 byte matrices
-        lanes = (arr.shape[1] + 3) // 4
-        padded = np.zeros((arr.shape[0], lanes * 4), np.uint8)
-        padded[:, : arr.shape[1]] = arr.view(np.uint8).reshape(arr.shape[0], -1)
-        return jnp.asarray(padded.reshape(-1, lanes, 4).view("<u4")[..., 0])
+        return _stage_byte_rows(arr)
     raise TypeError(f"cannot stage {arr.dtype} for {ptype}")
 
 
 def _levels_v1_device(raw, n, max_level, pos, encoding=Encoding.RLE):
+    """Returns (device levels, end pos, host levels | None).  Host levels
+    are populated when the decode already happened on host (BIT_PACKED),
+    so callers never decode the same bytes twice."""
     if max_level == 0:
-        return jnp.zeros((n,), dtype=jnp.int32), pos
+        return jnp.zeros((n,), dtype=jnp.int32), pos, None
     width = max_level.bit_length()
     if encoding == Encoding.BIT_PACKED:
         # Legacy MSB-first levels (old parquet-mr writers): decode on host
@@ -383,13 +438,13 @@ def _levels_v1_device(raw, n, max_level, pos, encoding=Encoding.RLE):
 
         nbytes = (n * width + 7) // 8
         vals = decode_levels_bitpacked(raw[pos : pos + nbytes], n, max_level)
-        return jnp.asarray(vals, dtype=jnp.int32), pos + nbytes
+        return jnp.asarray(vals, dtype=jnp.int32), pos + nbytes, vals
     import struct
 
     (size,) = struct.unpack_from("<I", raw, pos)
     body = raw[pos + 4 : pos + 4 + size]
     vals = decode_hybrid_device(body, n, width)
-    return vals.astype(jnp.int32), pos + 4 + size
+    return vals.astype(jnp.int32), pos + 4 + size, None
 
 
 def _levels_raw_device(raw, n, max_level):
