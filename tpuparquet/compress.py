@@ -310,10 +310,35 @@ def snappy_compress(data: bytes) -> bytes:
 
 
 class _Snappy(BlockCompressor):
+    """Snappy with the native C fast path and a pure-Python fallback.
+
+    The native codec (tpuparquet/native/snappy.c) is loaded lazily on
+    first use; both implement the same wire format, so files are
+    interchangeable either way."""
+
+    def __init__(self):
+        self._native = False  # not resolved yet
+
+    def _nat(self):
+        if self._native is False:
+            from .native import snappy_native
+
+            self._native = snappy_native()
+        return self._native
+
     def compress_block(self, block):
+        nat = self._nat()
+        if nat is not None:
+            return nat.compress(bytes(block))
         return snappy_compress(block)
 
     def decompress_block(self, block, decompressed_size):
+        nat = self._nat()
+        if nat is not None:
+            try:
+                return nat.decompress(bytes(block), decompressed_size)
+            except ValueError as e:
+                raise CompressionError(str(e)) from None
         return snappy_decompress(block, decompressed_size)
 
 

@@ -1,0 +1,127 @@
+"""Native host-runtime components (C, loaded via ctypes).
+
+The compute plane is JAX/XLA; the host runtime around it (block codecs,
+byte-stream scanning) is native C where a Python loop would dominate —
+the TPU-build counterpart of the reference keeping its codecs in compiled
+Go.  The shared library is built from the checked-in sources with the
+system compiler on first import and cached next to them; every consumer
+must degrade gracefully to its pure-Python fallback when no compiler is
+available (``snappy_native() is None``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+__all__ = ["snappy_native", "NativeSnappy"]
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "snappy.c")
+_SO = os.path.join(_DIR, "_tpq_snappy.so")
+
+_lock = threading.Lock()
+_cached: "NativeSnappy | None | bool" = False  # False = not tried yet
+
+
+def _build() -> bool:
+    """(Re)build the shared library if stale; returns success."""
+    try:
+        if os.path.exists(_SO) and (
+            os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+        ):
+            return True
+        # per-process temp name: concurrent builders must not interleave
+        # writes into one file and then promote the garbage via replace
+        tmp = f"{_SO}.{os.getpid()}.tmp"
+        for cc in ("cc", "gcc", "clang"):
+            try:
+                subprocess.run(
+                    [cc, "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
+                    check=True, capture_output=True, timeout=120,
+                )
+                os.replace(tmp, _SO)
+                return True
+            except (FileNotFoundError, subprocess.CalledProcessError,
+                    subprocess.TimeoutExpired):
+                continue
+        return False
+    except OSError:
+        return False
+
+
+class NativeSnappy:
+    """ctypes bindings over the C snappy block codec."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        lib.tpq_snappy_decompress.restype = ctypes.c_int
+        lib.tpq_snappy_decompress.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.tpq_snappy_compress.restype = ctypes.c_int
+        lib.tpq_snappy_compress.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.tpq_snappy_uncompressed_length.restype = ctypes.c_int
+        lib.tpq_snappy_uncompressed_length.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.tpq_snappy_max_compressed_length.restype = ctypes.c_uint64
+        lib.tpq_snappy_max_compressed_length.argtypes = [ctypes.c_uint64]
+
+    def uncompressed_length(self, block: bytes) -> int:
+        out = ctypes.c_uint64()
+        rc = self._lib.tpq_snappy_uncompressed_length(
+            block, len(block), ctypes.byref(out)
+        )
+        if rc != 0:
+            raise ValueError("snappy: bad size header")
+        return out.value
+
+    def decompress(self, block: bytes, expected_size: int | None = None):
+        total = self.uncompressed_length(block)
+        if expected_size is not None and total != expected_size:
+            raise ValueError(
+                f"snappy: header size {total} != expected {expected_size}"
+            )
+        buf = ctypes.create_string_buffer(max(total, 1))
+        produced = ctypes.c_size_t()
+        rc = self._lib.tpq_snappy_decompress(
+            block, len(block), buf, total, ctypes.byref(produced)
+        )
+        if rc != 0:
+            raise ValueError(f"snappy: corrupt block (rc={rc})")
+        return ctypes.string_at(buf, produced.value)
+
+    def compress(self, data: bytes) -> bytes:
+        cap = self._lib.tpq_snappy_max_compressed_length(len(data))
+        buf = ctypes.create_string_buffer(cap)
+        produced = ctypes.c_size_t()
+        rc = self._lib.tpq_snappy_compress(
+            data, len(data), buf, cap, ctypes.byref(produced)
+        )
+        if rc != 0:
+            raise ValueError(f"snappy: compress failed (rc={rc})")
+        return ctypes.string_at(buf, produced.value)
+
+
+def snappy_native() -> NativeSnappy | None:
+    """The process-wide native codec, or None if unbuildable."""
+    global _cached
+    with _lock:
+        if _cached is False:
+            _cached = None
+            if _build():
+                try:
+                    _cached = NativeSnappy(ctypes.CDLL(_SO))
+                except OSError:
+                    _cached = None
+        return _cached

@@ -1,0 +1,250 @@
+/* Snappy block codec, from scratch, for the tpuparquet host runtime.
+ *
+ * The reference keeps its hot path in Go with golang/snappy
+ * (compress.go:46-48); our host runtime is Python, where a per-token
+ * interpreter loop dominates whole-file decode time, so the block codec
+ * lives here in C behind a ctypes boundary.  Wire format implemented
+ * from the public snappy format description: a uvarint uncompressed
+ * length followed by literal/copy tags (2-bit type, 1/2/4-byte offsets).
+ *
+ * API (all lengths in bytes, return 0 on success, negative error codes):
+ *   tpq_snappy_uncompressed_length(in, n, &len)
+ *   tpq_snappy_decompress(in, n, out, out_cap, &produced)
+ *   tpq_snappy_max_compressed_length(n)
+ *   tpq_snappy_compress(in, n, out, out_cap, &produced)
+ */
+
+#include <stddef.h>
+#include <stdint.h>
+#include <string.h>
+
+#define TPQ_OK 0
+#define TPQ_ERR_CORRUPT (-1)
+#define TPQ_ERR_TOO_BIG (-2)
+#define TPQ_ERR_BUFFER (-3)
+
+/* ------------------------------------------------------------------ */
+/* uvarint                                                            */
+/* ------------------------------------------------------------------ */
+
+static int read_uvarint(const uint8_t *in, size_t n, size_t *pos,
+                        uint64_t *out) {
+  uint64_t v = 0;
+  int shift = 0;
+  while (*pos < n && shift < 64) {
+    uint8_t b = in[(*pos)++];
+    v |= (uint64_t)(b & 0x7f) << shift;
+    if (!(b & 0x80)) {
+      *out = v;
+      return TPQ_OK;
+    }
+    shift += 7;
+  }
+  return TPQ_ERR_CORRUPT;
+}
+
+int tpq_snappy_uncompressed_length(const uint8_t *in, size_t n,
+                                   uint64_t *len) {
+  size_t pos = 0;
+  return read_uvarint(in, n, &pos, len);
+}
+
+/* ------------------------------------------------------------------ */
+/* decompress                                                         */
+/* ------------------------------------------------------------------ */
+
+int tpq_snappy_decompress(const uint8_t *in, size_t n, uint8_t *out,
+                          size_t out_cap, size_t *produced) {
+  size_t pos = 0;
+  uint64_t total;
+  int rc = read_uvarint(in, n, &pos, &total);
+  if (rc != TPQ_OK) return rc;
+  if (total > out_cap) return TPQ_ERR_BUFFER;
+
+  size_t op = 0;
+  while (pos < n) {
+    uint8_t tag = in[pos++];
+    uint32_t kind = tag & 3;
+    size_t len, off;
+    if (kind == 0) { /* literal */
+      len = tag >> 2;
+      if (len >= 60) {
+        size_t extra = len - 59;
+        if (pos + extra > n) return TPQ_ERR_CORRUPT;
+        len = 0;
+        for (size_t i = 0; i < extra; i++)
+          len |= (size_t)in[pos + i] << (8 * i);
+        pos += extra;
+      }
+      len += 1;
+      if (pos + len > n || op + len > total) return TPQ_ERR_CORRUPT;
+      memcpy(out + op, in + pos, len);
+      pos += len;
+      op += len;
+      continue;
+    }
+    if (kind == 1) {
+      if (pos >= n) return TPQ_ERR_CORRUPT;
+      len = ((tag >> 2) & 0x7) + 4;
+      off = ((size_t)(tag >> 5) << 8) | in[pos];
+      pos += 1;
+    } else if (kind == 2) {
+      if (pos + 2 > n) return TPQ_ERR_CORRUPT;
+      len = (tag >> 2) + 1;
+      off = (size_t)in[pos] | ((size_t)in[pos + 1] << 8);
+      pos += 2;
+    } else {
+      if (pos + 4 > n) return TPQ_ERR_CORRUPT;
+      len = (tag >> 2) + 1;
+      off = (size_t)in[pos] | ((size_t)in[pos + 1] << 8) |
+            ((size_t)in[pos + 2] << 16) | ((size_t)in[pos + 3] << 24);
+      pos += 4;
+    }
+    if (off == 0 || off > op || op + len > total) return TPQ_ERR_CORRUPT;
+    if (off >= len) {
+      memcpy(out + op, out + op - off, len);
+    } else {
+      /* overlapping copy: byte-sequential semantics */
+      uint8_t *dst = out + op;
+      const uint8_t *src = out + op - off;
+      for (size_t i = 0; i < len; i++) dst[i] = src[i];
+    }
+    op += len;
+  }
+  if (op != total) return TPQ_ERR_CORRUPT;
+  *produced = op;
+  return TPQ_OK;
+}
+
+/* ------------------------------------------------------------------ */
+/* compress                                                           */
+/* ------------------------------------------------------------------ */
+
+uint64_t tpq_snappy_max_compressed_length(uint64_t n) {
+  /* worst case: varint header + one literal token set per 2^16 chunk */
+  return 32 + n + n / 6;
+}
+
+static size_t emit_uvarint(uint8_t *out, uint64_t v) {
+  size_t i = 0;
+  while (v >= 0x80) {
+    out[i++] = (uint8_t)(v | 0x80);
+    v >>= 7;
+  }
+  out[i++] = (uint8_t)v;
+  return i;
+}
+
+static size_t emit_literal(uint8_t *out, const uint8_t *data, size_t len) {
+  size_t i = 0;
+  size_t l = len - 1;
+  if (l < 60) {
+    out[i++] = (uint8_t)(l << 2);
+  } else if (l < 256) {
+    out[i++] = 60 << 2;
+    out[i++] = (uint8_t)l;
+  } else if (l < 65536) {
+    out[i++] = 61 << 2;
+    out[i++] = (uint8_t)l;
+    out[i++] = (uint8_t)(l >> 8);
+  } else if (l < (1u << 24)) {
+    out[i++] = 62 << 2;
+    out[i++] = (uint8_t)l;
+    out[i++] = (uint8_t)(l >> 8);
+    out[i++] = (uint8_t)(l >> 16);
+  } else {
+    out[i++] = 63 << 2;
+    out[i++] = (uint8_t)l;
+    out[i++] = (uint8_t)(l >> 8);
+    out[i++] = (uint8_t)(l >> 16);
+    out[i++] = (uint8_t)(l >> 24);
+  }
+  memcpy(out + i, data, len);
+  return i + len;
+}
+
+static size_t emit_copy(uint8_t *out, size_t off, size_t len) {
+  size_t i = 0;
+  /* long matches: peel 64-byte 2-byte-offset copies */
+  while (len >= 68) {
+    out[i++] = (63 << 2) | 2;
+    out[i++] = (uint8_t)off;
+    out[i++] = (uint8_t)(off >> 8);
+    len -= 64;
+  }
+  if (len > 64) { /* leave >= 4 for the final copy */
+    out[i++] = (59 << 2) | 2;
+    out[i++] = (uint8_t)off;
+    out[i++] = (uint8_t)(off >> 8);
+    len -= 60;
+  }
+  if (len >= 12 || off >= 2048) {
+    out[i++] = (uint8_t)(((len - 1) << 2) | 2);
+    out[i++] = (uint8_t)off;
+    out[i++] = (uint8_t)(off >> 8);
+  } else {
+    out[i++] = (uint8_t)(((off >> 8) << 5) | ((len - 4) << 2) | 1);
+    out[i++] = (uint8_t)off;
+  }
+  return i;
+}
+
+#define HASH_BITS 14
+#define HASH_SIZE (1u << HASH_BITS)
+
+static inline uint32_t load32(const uint8_t *p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+
+static inline uint32_t hash32(uint32_t v) {
+  return (v * 0x1e35a7bdu) >> (32 - HASH_BITS);
+}
+
+int tpq_snappy_compress(const uint8_t *in, size_t n, uint8_t *out,
+                        size_t out_cap, size_t *produced) {
+  if (out_cap < tpq_snappy_max_compressed_length(n)) return TPQ_ERR_BUFFER;
+  size_t op = emit_uvarint(out, n);
+  if (n < 4) {
+    if (n) op += emit_literal(out + op, in, n);
+    *produced = op;
+    return TPQ_OK;
+  }
+
+  uint32_t table[HASH_SIZE];
+  memset(table, 0xff, sizeof(table)); /* 0xffffffff = empty */
+
+  size_t pos = 0, lit_start = 0;
+  size_t limit = n - 4;
+  uint32_t skip = 32; /* golang-style acceleration: skip>>5 per miss */
+  while (pos <= limit) {
+    uint32_t key = load32(in + pos);
+    uint32_t h = hash32(key);
+    uint32_t cand = table[h];
+    table[h] = (uint32_t)pos;
+    if (cand != 0xffffffffu && pos - cand <= 65535 &&
+        load32(in + cand) == key) {
+      size_t len = 4;
+      size_t max = n - pos;
+      while (len < max && in[cand + len] == in[pos + len]) len++;
+      if (pos > lit_start)
+        op += emit_literal(out + op, in + lit_start, pos - lit_start);
+      op += emit_copy(out + op, pos - cand, len);
+      /* seed the table inside the match so long runs keep matching */
+      size_t end = pos + len;
+      if (end <= limit) {
+        size_t seed = end - 1;
+        table[hash32(load32(in + seed))] = (uint32_t)seed;
+      }
+      pos = end;
+      lit_start = pos;
+      skip = 32;
+    } else {
+      pos += 1 + (skip++ >> 5);
+    }
+  }
+  if (n > lit_start) op += emit_literal(out + op, in + lit_start, n - lit_start);
+  *produced = op;
+  return TPQ_OK;
+}
