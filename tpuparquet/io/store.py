@@ -207,7 +207,9 @@ def _shred_children(children, data, def_level, max_rep, rep_level):
                 )
             m = max_rep + 1
             if len(d) == 0:
-                _shred_nil(node.children, lvl, m, rep_level)
+                # An empty repeated group contributes no def level of its
+                # own — presence (+1) is per element in Dremel.
+                _shred_nil(node.children, def_level, m, rep_level)
             else:
                 rl = rep_level
                 for i, item in enumerate(d):
@@ -236,7 +238,7 @@ def _first_rd_level(node: SchemaNode):
         rl, dl, last = _first_rd_level(child)
         if last:
             return rl, dl, last
-        if dl == child.max_def_level:
+        if rl >= 0 or dl >= 0:
             return rl, dl, last
     return -1, -1, False
 

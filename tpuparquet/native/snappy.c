@@ -204,6 +204,8 @@ static inline uint32_t hash32(uint32_t v) {
 
 int tpq_snappy_compress(const uint8_t *in, size_t n, uint8_t *out,
                         size_t out_cap, size_t *produced) {
+  if (n > 0xffffffffu) return TPQ_ERR_TOO_BIG; /* hash table + literal
+    length encoding hold positions/lengths as uint32 */
   if (out_cap < tpq_snappy_max_compressed_length(n)) return TPQ_ERR_BUFFER;
   size_t op = emit_uvarint(out, n);
   if (n < 4) {
@@ -241,7 +243,9 @@ int tpq_snappy_compress(const uint8_t *in, size_t n, uint8_t *out,
       lit_start = pos;
       skip = 32;
     } else {
-      pos += 1 + (skip++ >> 5);
+      size_t step = skip >> 5;
+      pos += step;
+      skip += (uint32_t)step;
     }
   }
   if (n > lit_start) op += emit_literal(out + op, in + lit_start, n - lit_start);
