@@ -1,0 +1,331 @@
+"""floor object-mapper tests (≙ floor/reader_test.go, writer_test.go,
+floor/time.go semantics, int96_time.go round trip)."""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import io
+import uuid
+from dataclasses import dataclass, field
+from typing import Optional
+
+import pytest
+
+from tpuparquet import FileReader, FileWriter, floor
+from tpuparquet.floor import (
+    Time,
+    new_file_reader,
+    new_file_writer,
+    schema_of,
+    time_from_microseconds,
+    time_from_milliseconds,
+    time_from_nanoseconds,
+)
+from tpuparquet.int96_time import datetime_to_int96, int96_to_datetime
+
+
+class TestTime:
+    def test_construct_and_accessors(self):
+        t = Time(13, 37, 42, 123_456_789)
+        assert (t.hour, t.minute, t.second, t.nanosecond) == (
+            13, 37, 42, 123_456_789)
+
+    @pytest.mark.parametrize("kw", [
+        {"hours": 24}, {"minutes": 60}, {"seconds": 61},
+        {"nanoseconds": 10**9},
+    ])
+    def test_range_validation(self, kw):
+        with pytest.raises(ValueError):
+            Time(**kw)
+
+    def test_unit_conversions(self):
+        t = Time(1, 2, 3, 456_789_000)
+        ns = ((1 * 3600 + 2 * 60 + 3) * 10**9) + 456_789_000
+        assert t.nanoseconds() == ns
+        assert t.microseconds() == ns // 1000
+        assert t.milliseconds() == ns // 10**6
+        assert time_from_nanoseconds(ns) == t
+        assert time_from_microseconds(ns // 1000).nanoseconds() == (
+            ns // 1000 * 1000)
+        assert time_from_milliseconds(ns // 10**6).milliseconds() == (
+            ns // 10**6)
+
+    def test_datetime_time_round_trip(self):
+        dt = datetime.time(23, 59, 58, 999_999)
+        assert Time.from_datetime_time(dt).to_datetime_time() == dt
+
+
+class TestInt96:
+    def test_round_trip(self):
+        dt = datetime.datetime(2024, 2, 29, 12, 34, 56, 789_000)
+        assert int96_to_datetime(datetime_to_int96(dt)) == dt
+
+    def test_epoch(self):
+        b = datetime_to_int96(datetime.datetime(1970, 1, 1))
+        assert b == (0).to_bytes(8, "little") + (2440588).to_bytes(4, "little")
+
+    def test_bad_length(self):
+        with pytest.raises(ValueError):
+            int96_to_datetime(b"short")
+
+
+@dataclass
+class Inner:
+    x: int
+    y: Optional[str] = None
+
+
+@dataclass
+class Record:
+    ident: int
+    name: str
+    score: float
+    ok: bool
+    raw: bytes
+    maybe: Optional[int] = None
+    tags: Optional[list[str]] = None
+    attrs: Optional[dict[str, int]] = None
+    inner: Optional[Inner] = None
+    born: Optional[datetime.date] = None
+    seen: Optional[datetime.datetime] = None
+    at: Optional[Time] = None
+    uid: Optional[uuid.UUID] = None
+
+
+def sample_records():
+    return [
+        Record(
+            ident=1, name="alpha", score=1.5, ok=True, raw=b"\x00\x01",
+            maybe=7, tags=["a", "b"], attrs={"k": 1, "j": 2},
+            inner=Inner(x=10, y="deep"),
+            born=datetime.date(1999, 12, 31),
+            seen=datetime.datetime(2024, 5, 4, 3, 2, 1, 654_321),
+            at=Time(12, 30, 15, 250_000_000),
+            uid=uuid.UUID("12345678-1234-5678-1234-567812345678"),
+        ),
+        Record(ident=2, name="beta", score=-2.25, ok=False, raw=b""),
+    ]
+
+
+class TestReflectionRoundTrip:
+    def test_derive_schema_parses(self):
+        from tpuparquet.format.dsl import parse_schema_definition
+
+        sd = parse_schema_definition(schema_of(Record))
+        names = [c.name for c in sd.root.children]
+        assert names == ["ident", "name", "score", "ok", "raw", "maybe",
+                         "tags", "attrs", "inner", "born", "seen", "at",
+                         "uid"]
+
+    def test_write_read_objects(self, tmp_path):
+        p = str(tmp_path / "floor.parquet")
+        recs = sample_records()
+        with new_file_writer(p, cls=Record) as w:
+            w.write_many(recs)
+        with new_file_reader(p, Record) as r:
+            got = list(r)
+        assert got == recs
+
+    def test_scan_to_plain_dict(self, tmp_path):
+        p = str(tmp_path / "floor2.parquet")
+        with new_file_writer(p, cls=Record) as w:
+            w.write(sample_records()[0])
+        with new_file_reader(p) as r:
+            assert r.next()
+            d = r.scan()
+        assert d["name"] == "alpha"
+        assert d["tags"] == ["a", "b"]
+        assert d["attrs"] == {"k": 1, "j": 2}
+        assert d["born"] == datetime.date(1999, 12, 31)
+        assert isinstance(d["at"], Time)
+        assert d["uid"] == uuid.UUID("12345678-1234-5678-1234-567812345678")
+
+    def test_explicit_schema_with_time_units(self, tmp_path):
+        schema = """message m {
+            required int32 tms (TIME(MILLIS, true));
+            required int64 tus (TIME(MICROS, true));
+            required int64 tns (TIME(NANOS, true));
+            required int64 ts_ms (TIMESTAMP(MILLIS, true));
+            required int64 ts_ns (TIMESTAMP(NANOS, true));
+        }"""
+
+        @dataclass
+        class T:
+            tms: Time
+            tus: Time
+            tns: Time
+            ts_ms: datetime.datetime
+            ts_ns: datetime.datetime
+
+        t = Time(6, 7, 8, 123_000_000)
+        rec = T(tms=t, tus=t, tns=t,
+                ts_ms=datetime.datetime(2020, 1, 2, 3, 4, 5, 678_000),
+                ts_ns=datetime.datetime(2020, 1, 2, 3, 4, 5, 678_901))
+        p = str(tmp_path / "tu.parquet")
+        with new_file_writer(p, schema) as w:
+            w.write(rec)
+        with new_file_reader(p, T) as r:
+            (got,) = list(r)
+        assert got.tms.milliseconds() == t.milliseconds()
+        assert got.tus.microseconds() == t.microseconds()
+        assert got.tns == t
+        assert got.ts_ms == rec.ts_ms
+        assert got.ts_ns == rec.ts_ns
+
+    def test_parquet_field_name_metadata(self, tmp_path):
+        @dataclass
+        class Tagged:
+            py_name: int = field(metadata={"parquet": "wire_name"})
+
+        p = str(tmp_path / "tag.parquet")
+        with new_file_writer(p, "message m { required int64 wire_name; }") \
+                as w:
+            w.write(Tagged(py_name=42))
+        with FileReader(p) as fr:
+            assert list(fr.rows()) == [{"wire_name": 42}]
+        with new_file_reader(p, Tagged) as r:
+            assert list(r) == [Tagged(py_name=42)]
+
+    def test_custom_marshaller_hooks(self, tmp_path):
+        class Custom:
+            def __init__(self, a=None):
+                self.a = a
+
+            def marshal_parquet(self):
+                return {"a": self.a * 2}
+
+            def unmarshal_parquet(self, row):
+                self.a = row["a"] + 1
+
+        p = str(tmp_path / "hook.parquet")
+        with new_file_writer(p, "message m { required int64 a; }") as w:
+            w.write(Custom(a=5))
+        with new_file_reader(p) as r:
+            assert r.next()
+            obj = r.scan(Custom())
+        assert obj.a == 11  # 5*2 on write, +1 on read
+
+    def test_uuid_wrong_length_rejected(self, tmp_path):
+        @dataclass
+        class U:
+            u: uuid.UUID
+
+        from tpuparquet.format.dsl import SchemaValidationError
+
+        # The DSL validator rejects UUID on a non-16-byte FLBA outright.
+        with pytest.raises(SchemaValidationError):
+            new_file_writer(
+                io.BytesIO(),
+                "message m { required fixed_len_byte_array(8) u (UUID); }")
+
+    def test_missing_required_field_raises(self):
+        buf = io.BytesIO()
+        w = new_file_writer(buf, "message m { required int64 a; }")
+        with pytest.raises((ValueError, TypeError)):
+            w.write({"a": None})
+
+    def test_int96_timestamp_round_trip(self, tmp_path):
+        @dataclass
+        class Ev:
+            when: datetime.datetime
+
+        p = str(tmp_path / "i96.parquet")
+        dt = datetime.datetime(2023, 7, 14, 9, 8, 7, 654_321)
+        with new_file_writer(p, "message m { required int96 when; }") as w:
+            w.write(Ev(when=dt))
+        with new_file_reader(p, Ev) as r:
+            (got,) = list(r)
+        assert got.when == dt
+
+    def test_pyarrow_reads_floor_file(self, tmp_path):
+        pa = pytest.importorskip("pyarrow")
+        import pyarrow.parquet as pq
+
+        p = str(tmp_path / "fa.parquet")
+        with new_file_writer(p, cls=Record) as w:
+            w.write_many(sample_records())
+        t = pq.read_table(p)
+        rows = t.to_pylist()
+        assert rows[0]["name"] == "alpha"
+        assert rows[0]["born"] == datetime.date(1999, 12, 31)
+        assert rows[0]["seen"] == datetime.datetime(
+            2024, 5, 4, 3, 2, 1, 654_321, tzinfo=datetime.timezone.utc)
+        assert rows[0]["tags"] == ["a", "b"]
+        assert rows[1]["maybe"] is None
+
+    def test_pep604_optional_hints(self, tmp_path):
+        @dataclass
+        class P:
+            a: int
+            b: "int | None" = None
+            t: "datetime.time | None" = None
+
+        p = str(tmp_path / "604.parquet")
+        rec = P(a=1, b=None, t=datetime.time(10, 20, 30))
+        with new_file_writer(p, cls=P) as w:
+            w.write(rec)
+        with new_file_reader(p, P) as r:
+            (got,) = list(r)
+        assert got == rec
+        assert isinstance(got.t, datetime.time)
+
+    def test_legacy_list_names(self, tmp_path):
+        """LIST groups with non-compliant inner names (bag/item) and
+        2-level legacy layout (repeated leaf directly under LIST)."""
+        @dataclass
+        class L:
+            xs: list[int]
+            ys: list[int]
+
+        schema = """message m {
+            optional group xs (LIST) { repeated group bag {
+                optional int64 item; } }
+            optional group ys (LIST) { repeated int64 ys_tuple; }
+        }"""
+        p = str(tmp_path / "legacy.parquet")
+        with new_file_writer(p, schema) as w:
+            w.write(L(xs=[1, 2, 3], ys=[4, 5]))
+        with new_file_reader(p, L) as r:
+            (got,) = list(r)
+        assert got.xs == [1, 2, 3]
+        assert got.ys == [4, 5]
+
+    def test_scan_with_hook_class_builds_instance(self, tmp_path):
+        @dataclass
+        class H:
+            a: int = 0
+
+            def unmarshal_parquet(self, row):  # pragma: no cover
+                raise AssertionError("hook must not fire for a class")
+
+        p = str(tmp_path / "hookcls.parquet")
+        with new_file_writer(p, "message m { required int64 a; }") as w:
+            w.write({"a": 3})
+        with new_file_reader(p) as r:
+            assert r.next()
+            got = r.scan(H)
+        assert isinstance(got, H) and got.a == 3
+
+    def test_writer_closes_file_on_bad_schema(self, tmp_path):
+        p = tmp_path / "pre.parquet"
+        p.write_bytes(b"PREEXISTING")
+        with pytest.raises(Exception):
+            new_file_writer(
+                str(p),
+                "message m { required fixed_len_byte_array(8) u (UUID); }")
+        # handle was closed (no ResourceWarning); file truncated is accepted
+
+    def test_repeated_leaf_legacy(self, tmp_path):
+        @dataclass
+        class R:
+            vals: list[int]
+
+        p = str(tmp_path / "rep.parquet")
+        with new_file_writer(p, "message m { repeated int64 vals; }") as w:
+            w.write(R(vals=[1, 2, 3]))
+            w.write(R(vals=[]))
+        with new_file_reader(p, R) as r:
+            got = list(r)
+        assert got[0].vals == [1, 2, 3]
+        assert got[1].vals in ([], None)

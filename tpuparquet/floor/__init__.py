@@ -1,0 +1,15 @@
+"""floor — the object mapper (``/root/reference/floor/``), Python-native.
+
+Write dataclasses (or anything with ``marshal_parquet``) straight to
+Parquet and scan rows back into typed objects.
+"""
+
+from .reader import Reader, new_file_reader  # noqa: F401
+from .reflect import field_name, from_row, schema_of, to_row  # noqa: F401
+from .time import (  # noqa: F401
+    Time,
+    time_from_microseconds,
+    time_from_milliseconds,
+    time_from_nanoseconds,
+)
+from .writer import Writer, new_file_writer  # noqa: F401

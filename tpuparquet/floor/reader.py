@@ -1,0 +1,75 @@
+"""floor.Reader: read Parquet rows back into Python objects.
+
+Parity with ``floor.NewFileReader``/``Reader.Next``/``Scan``
+(``/root/reference/floor/reader.go:18-91``): iterate rows and fill
+dataclass instances, honoring an ``unmarshal_parquet(row)`` hook when
+the target provides one.
+"""
+
+from __future__ import annotations
+
+from ..io.reader import FileReader
+from .reflect import decode_row, from_row
+
+__all__ = ["Reader", "new_file_reader"]
+
+
+class Reader:
+    """Typed row iteration over a low-level :class:`FileReader`."""
+
+    def __init__(self, fr: FileReader, cls=None):
+        self._fr = fr
+        self._cls = cls
+        self._row = None
+
+    @property
+    def file_reader(self) -> FileReader:
+        return self._fr
+
+    def next(self) -> bool:
+        """Advance to the next row; False at end of file
+        (``floor/reader.go:65-78``)."""
+        try:
+            self._row = self._fr.next_row()
+            return True
+        except EOFError:
+            self._row = None
+            return False
+
+    def scan(self, target=None):
+        """Deserialize the current row.
+
+        * ``target`` with an ``unmarshal_parquet(row)`` method: the hook
+          receives the raw row (``floor/reader.go:84-87``), returns target.
+        * ``target`` a dataclass type (or the reader's bound ``cls``):
+          returns a new instance via reflection.
+        * no target: returns a logical-type-decoded plain dict.
+        """
+        if self._row is None:
+            raise RuntimeError("scan before next(), or past end of file")
+        if (target is not None and not isinstance(target, type)
+                and callable(getattr(target, "unmarshal_parquet", None))):
+            target.unmarshal_parquet(self._row)
+            return target
+        cls = target or self._cls
+        if cls is None:
+            return decode_row(self._row, self._fr.schema)
+        return from_row(self._row, cls, self._fr.schema)
+
+    def __iter__(self):
+        while self.next():
+            yield self.scan()
+
+    def close(self) -> None:
+        self._fr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+
+
+def new_file_reader(path, cls=None, *columns: str) -> Reader:
+    """Open ``path`` for object reading (``floor.NewFileReader``)."""
+    return Reader(FileReader(path, *columns), cls=cls)
