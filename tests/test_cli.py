@@ -1,0 +1,241 @@
+"""CLI tests (≙ cmd/parquet-tool helpers_test.go + cmd/csv2parquet
+main_test.go)."""
+
+from __future__ import annotations
+
+import io
+import os
+
+import pytest
+
+from tpuparquet import CompressionCodec, FileReader, FileWriter
+from tpuparquet.cli import csv2parquet as c2p
+from tpuparquet.cli import parquet_tool as pt
+
+
+@pytest.fixture()
+def sample_file(tmp_path):
+    p = str(tmp_path / "sample.parquet")
+    schema = """message m {
+        required int64 id;
+        optional binary name (STRING);
+        optional group tags (LIST) { repeated group list {
+            optional binary element (STRING); } }
+    }"""
+    with open(p, "wb") as f:
+        w = FileWriter(f, schema, codec=CompressionCodec.SNAPPY)
+        for i in range(25):
+            w.add_data({
+                "id": i,
+                "name": f"name-{i}".encode() if i % 5 else None,
+                "tags": {"list": [{"element": b"t%d" % i}]},
+            })
+        w.close()
+    return p
+
+
+class TestHumanToBytes:
+    @pytest.mark.parametrize("s,expect", [
+        ("1024", 1024),
+        ("1KB", 1000),
+        ("1KiB", 1024),
+        ("100MB", 100 * 1000**2),
+        ("2GiB", 2 * 1024**3),
+        (" 5MB ", 5 * 1000**2),
+    ])
+    def test_ok(self, s, expect):
+        assert pt.human_to_bytes(s) == expect
+
+    @pytest.mark.parametrize("s", ["", "abc", "12XB"])
+    def test_bad(self, s):
+        with pytest.raises(ValueError):
+            pt.human_to_bytes(s)
+
+
+class TestParquetTool:
+    def run(self, *argv):
+        out = io.StringIO()
+        import contextlib
+        with contextlib.redirect_stdout(out):
+            rc = pt.main(list(argv))
+        return rc, out.getvalue()
+
+    def test_rowcount(self, sample_file):
+        rc, out = self.run("rowcount", sample_file)
+        assert rc == 0
+        assert "Total RowCount: 25" in out
+
+    def test_schema(self, sample_file):
+        rc, out = self.run("schema", sample_file)
+        assert rc == 0
+        assert "message" in out and "required int64 id;" in out
+
+    def test_cat(self, sample_file):
+        rc, out = self.run("cat", sample_file)
+        assert rc == 0
+        assert "id = 0" in out and "id = 24" in out
+        assert "name = name-1" in out
+        assert ".element = t3" in out
+
+    def test_head_n(self, sample_file):
+        rc, out = self.run("head", "-n", "2", sample_file)
+        assert rc == 0
+        assert "id = 1" in out and "id = 2" not in out
+
+    def test_meta(self, sample_file):
+        rc, out = self.run("meta", sample_file)
+        assert rc == 0
+        assert "R:0 D:0" in out      # required id
+        assert "R:1 D:3" in out      # list element
+        assert "rows: 25" in out
+        assert "SNAPPY" in out
+
+    def test_split(self, sample_file, tmp_path):
+        target = tmp_path / "parts"
+        target.mkdir()
+        rc, out = self.run("split", "-s", "600", "-t", str(target),
+                           "-c", "none", sample_file)
+        assert rc == 0
+        parts = sorted(os.listdir(target))
+        assert len(parts) > 1
+        total = []
+        for part in parts:
+            with FileReader(str(target / part)) as r:
+                total.extend(row["id"] for row in r.rows())
+        assert total == list(range(25))
+
+    def test_split_no_trailing_empty_part(self, sample_file, tmp_path):
+        target = tmp_path / "parts"
+        target.mkdir()
+        # Threshold of 1 byte triggers after every row: one part per row,
+        # and no empty trailing part.
+        rc, _ = self.run("split", "-s", "1", "-t", str(target),
+                         "-c", "none", sample_file)
+        assert rc == 0
+        parts = sorted(os.listdir(target))
+        assert len(parts) == 25
+        for part in parts:
+            with FileReader(str(target / part)) as r:
+                assert r.num_rows == 1
+
+    def test_missing_file_errors(self, tmp_path):
+        rc, _ = self.run("rowcount", str(tmp_path / "nope.parquet"))
+        assert rc == 1
+
+
+CSV = """id,name,score,flag,blob
+1,alpha,1.5,true,{"a": 1}
+2,beta,2.5,false,{"b": 2}
+3,,3.5,true,
+"""
+
+
+class TestCsv2Parquet:
+    def test_round_trip(self, tmp_path):
+        src = tmp_path / "in.csv"
+        src.write_text(CSV)
+        dst = str(tmp_path / "out.parquet")
+        rc = c2p.main([
+            "--input", str(src), "--output", dst,
+            "--typehints", "id=int64,score=double,flag=boolean,blob=json",
+        ])
+        assert rc == 0
+        with FileReader(dst) as r:
+            rows = list(r.rows())
+        assert rows[0] == {"id": 1, "name": b"alpha", "score": 1.5,
+                           "flag": True, "blob": b'{"a": 1}'}
+        assert rows[2] == {"id": 3, "score": 3.5, "flag": True}
+
+    def test_all_strings_without_hints(self, tmp_path):
+        src = tmp_path / "in.csv"
+        src.write_text("a,b\nx,y\n")
+        dst = str(tmp_path / "o.parquet")
+        assert c2p.main(["--input", str(src), "--output", dst]) == 0
+        with FileReader(dst) as r:
+            assert list(r.rows()) == [{"a": b"x", "b": b"y"}]
+
+    def test_delimiter(self, tmp_path):
+        src = tmp_path / "in.csv"
+        src.write_text("a;b\n1;2\n")
+        dst = str(tmp_path / "o.parquet")
+        rc = c2p.main(["--input", str(src), "--output", dst,
+                       "--delimiter", ";", "--typehints", "a=int32,b=int32"])
+        assert rc == 0
+        with FileReader(dst) as r:
+            assert list(r.rows()) == [{"a": 1, "b": 2}]
+
+    @pytest.mark.parametrize("typ,raw", [
+        ("int8", "128"), ("uint8", "-1"), ("int16", "40000"),
+        ("uint32", "-5"), ("boolean", "maybe"), ("json", "{bad"),
+    ])
+    def test_bad_values_rejected(self, tmp_path, typ, raw):
+        src = tmp_path / "in.csv"
+        src.write_text(f"c\n{raw}\n")
+        dst = str(tmp_path / "o.parquet")
+        rc = c2p.main(["--input", str(src), "--output", dst,
+                       "--typehints", f"c={typ}"])
+        assert rc == 1
+
+    def test_unknown_hint_type(self):
+        with pytest.raises(ValueError):
+            c2p.parse_type_hints("a=decimal128")
+
+    def test_hint_for_missing_column(self, tmp_path):
+        src = tmp_path / "in.csv"
+        src.write_text("a\n1\n")
+        rc = c2p.main(["--input", str(src),
+                       "--output", str(tmp_path / "o.parquet"),
+                       "--typehints", "zz=int64"])
+        assert rc == 1
+
+    def test_field_count_mismatch(self, tmp_path):
+        src = tmp_path / "in.csv"
+        src.write_text("a,b\n1\n")
+        rc = c2p.main(["--input", str(src),
+                       "--output", str(tmp_path / "o.parquet")])
+        assert rc == 1
+
+    def test_duplicate_header_rejected(self, tmp_path):
+        src = tmp_path / "in.csv"
+        src.write_text("a,a\n1,2\n")
+        rc = c2p.main(["--input", str(src),
+                       "--output", str(tmp_path / "o.parquet")])
+        assert rc == 1
+
+    def test_non_identifier_header_rejected(self, tmp_path):
+        src = tmp_path / "in.csv"
+        src.write_text("a b,c\n1,2\n")
+        rc = c2p.main(["--input", str(src),
+                       "--output", str(tmp_path / "o.parquet")])
+        assert rc == 1
+
+    def test_multichar_delimiter_clean_error(self, tmp_path):
+        src = tmp_path / "in.csv"
+        src.write_text("a\n1\n")
+        rc = c2p.main(["--input", str(src),
+                       "--output", str(tmp_path / "o.parquet"),
+                       "--delimiter", "||"])
+        assert rc == 1
+
+    def test_failed_convert_removes_output(self, tmp_path):
+        src = tmp_path / "in.csv"
+        src.write_text("c\nnotanint\n")
+        dst = tmp_path / "o.parquet"
+        rc = c2p.main(["--input", str(src), "--output", str(dst),
+                       "--typehints", "c=int64"])
+        assert rc == 1
+        assert not dst.exists()
+
+    def test_pyarrow_reads_output(self, tmp_path):
+        pq = pytest.importorskip("pyarrow.parquet")
+        src = tmp_path / "in.csv"
+        src.write_text(CSV)
+        dst = str(tmp_path / "out.parquet")
+        rc = c2p.main([
+            "--input", str(src), "--output", dst,
+            "--typehints", "id=int64,score=double,flag=boolean",
+        ])
+        assert rc == 0
+        t = pq.read_table(dst)
+        assert t.column("id").to_pylist() == [1, 2, 3]
+        assert t.column("name").to_pylist() == ["alpha", "beta", None]

@@ -1,0 +1,11 @@
+"""Command-line tools (≙ ``cmd/parquet-tool`` and ``cmd/csv2parquet``)."""
+
+from ..format.metadata import CompressionCodec
+
+#: Shared --compression flag values for both CLIs.
+CODECS = {
+    "snappy": CompressionCodec.SNAPPY,
+    "gzip": CompressionCodec.GZIP,
+    "zstd": CompressionCodec.ZSTD,
+    "none": CompressionCodec.UNCOMPRESSED,
+}

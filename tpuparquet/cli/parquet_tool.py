@@ -1,0 +1,242 @@
+"""parquet-tool: inspect and split Parquet files.
+
+Subcommand parity with the reference's cobra tool
+(``/root/reference/cmd/parquet-tool/cmds/``): ``cat``, ``head``,
+``meta``, ``schema``, ``rowcount``, ``split``.
+
+Run as ``python -m tpuparquet.cli.parquet_tool <cmd> <file>``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from ..io.reader import FileReader
+from ..io.writer import FileWriter
+from . import CODECS as _CODECS
+
+# ``humanToByte`` table (``cmd/parquet-tool/cmds/helpers.go:9-20``) —
+# the reference maps *B to binary and *iB to decimal multiples; we keep
+# the conventional meaning instead (KB=1000, KiB=1024).
+_SUFFIX = {
+    "KB": 1000, "KiB": 1024,
+    "MB": 1000**2, "MiB": 1024**2,
+    "GB": 1000**3, "GiB": 1024**3,
+    "TB": 1000**4, "TiB": 1024**4,
+    "PB": 1000**5, "PiB": 1024**5,
+}
+
+
+def human_to_bytes(s: str) -> int:
+    s = s.strip()
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    for suf, mult in _SUFFIX.items():
+        if s.endswith(suf):
+            return int(s[: -len(suf)].strip()) * mult
+    raise ValueError(f"invalid size {s!r}")
+
+
+# ----------------------------------------------------------------------
+# Row printing (``readfile.go printData``: flat "name = value" lines,
+# nested groups as "name:" with dot-prefixed children)
+# ----------------------------------------------------------------------
+
+def _print_value(out, indent: str, name: str, v) -> None:
+    if isinstance(v, dict):
+        print(f"{indent}{name}:", file=out)
+        _print_row(out, v, indent + ".")
+    elif isinstance(v, (list, tuple)):
+        for item in v:
+            if isinstance(item, dict):
+                print(f"{indent}{name}:", file=out)
+                _print_row(out, item, indent + ".")
+            else:
+                _print_value(out, indent, name, item)
+    elif isinstance(v, bytes):
+        print(f"{indent}{name} = {v.decode('utf-8', 'replace')}", file=out)
+    else:
+        print(f"{indent}{name} = {v}", file=out)
+
+
+def _print_row(out, row: dict, indent: str = "") -> None:
+    for name, v in row.items():
+        _print_value(out, indent, name, v)
+
+
+def cmd_cat(args, out=None) -> int:
+    out = out or sys.stdout
+    return _cat(args.file, -1, out)
+
+
+def cmd_head(args, out=None) -> int:
+    out = out or sys.stdout
+    return _cat(args.file, args.n, out)
+
+
+def _cat(path: str, n: int, out) -> int:
+    with FileReader(path) as r:
+        for i, row in enumerate(r.rows()):
+            if n != -1 and i >= n:
+                break
+            _print_row(out, row)
+            print(file=out)
+    return 0
+
+
+def cmd_meta(args, out=None) -> int:
+    """Flat schema with repetition + R/D levels (``readfile.go:75-104``)."""
+    out = out or sys.stdout
+    with FileReader(args.file) as r:
+        _print_flat(out, r.schema.root, 0)
+        print(file=out)
+        meta = r.metadata()
+        print(f"rows: {meta.num_rows}  row groups: "
+              f"{len(meta.row_groups)}  created by: {meta.created_by}",
+              file=out)
+        for i, rg in enumerate(meta.row_groups):
+            print(f"row group {i}: {rg.num_rows} rows, "
+                  f"{rg.total_byte_size} bytes", file=out)
+            for cc in rg.columns:
+                cm = cc.meta_data
+                print(f"  {'.'.join(cm.path_in_schema)}: "
+                      f"{cm.type.name} {cm.codec.name} "
+                      f"values={cm.num_values} "
+                      f"compressed={cm.total_compressed_size} "
+                      f"uncompressed={cm.total_uncompressed_size}",
+                      file=out)
+    return 0
+
+
+def _print_flat(out, node, lvl: int) -> None:
+    dot = "." * lvl
+    for child in node.children:
+        rep = child.repetition_type.name if child.repetition_type is not None else "?"
+        if child.is_leaf:
+            print(f"{dot}{child.name}:\t\t{rep} {child.type.name} "
+                  f"R:{child.max_rep_level} D:{child.max_def_level}",
+                  file=out)
+        else:
+            print(f"{dot}{child.name}:\t\t{rep} F:{len(child.children)}",
+                  file=out)
+            _print_flat(out, child, lvl + 1)
+
+
+def cmd_schema(args, out=None) -> int:
+    out = out or sys.stdout
+    with FileReader(args.file) as r:
+        print(r.get_schema_definition(), file=out)
+    return 0
+
+
+def cmd_rowcount(args, out=None) -> int:
+    out = out or sys.stdout
+    with FileReader(args.file) as r:
+        print(f"Total RowCount: {r.num_rows}", file=out)
+    return 0
+
+
+def cmd_split(args, out=None) -> int:
+    """Re-shard into multiple files of ~--file-size each
+    (``split.go:33-122``)."""
+    out = out or sys.stdout
+    target = human_to_bytes(args.file_size)
+    rg_size = human_to_bytes(args.row_group_size)
+    codec = _CODECS[args.compression.lower()]
+    folder = args.target_folder or os.path.dirname(os.path.abspath(args.file))
+    base = os.path.splitext(os.path.basename(args.file))[0]
+
+    with FileReader(args.file) as r:
+        schema_text = str(r.get_schema_definition())
+        part = 0
+        w = None
+        f = None
+
+        def open_part():
+            nonlocal part, w, f
+            name = os.path.join(folder, f"{base}_{part:03d}.parquet")
+            f = open(name, "wb")
+            w = FileWriter(f, schema_text, codec=codec,
+                           max_row_group_size=rg_size or None,
+                           created_by="parquet-tool split")
+            print(f"writing {name}", file=out)
+            part += 1
+
+        def close_part():
+            nonlocal w, f
+            w.close()
+            f.close()
+            w = f = None
+
+        # Parts open lazily so a threshold hit on the last row doesn't
+        # leave a trailing empty file.
+        for row in r.rows():
+            if w is None:
+                open_part()
+            w.add_data(row)
+            if w.current_file_size() + w.current_row_group_size() >= target:
+                close_part()
+        if w is not None:
+            close_part()
+        elif part == 0:  # empty input: still emit one valid (empty) file
+            open_part()
+            close_part()
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="parquet-tool", description="Tool to manage parquet files")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    c = sub.add_parser("cat", help="print the parquet file content")
+    c.add_argument("file")
+    c.set_defaults(fn=cmd_cat)
+
+    h = sub.add_parser("head", help="print the first N records")
+    h.add_argument("-n", type=int, default=5,
+                   help="number of records to print")
+    h.add_argument("file")
+    h.set_defaults(fn=cmd_head)
+
+    m = sub.add_parser("meta", help="print the file metadata")
+    m.add_argument("file")
+    m.set_defaults(fn=cmd_meta)
+
+    s = sub.add_parser("schema", help="print the file schema definition")
+    s.add_argument("file")
+    s.set_defaults(fn=cmd_schema)
+
+    rc = sub.add_parser("rowcount", help="print the total row count")
+    rc.add_argument("file")
+    rc.set_defaults(fn=cmd_rowcount)
+
+    sp = sub.add_parser("split", help="split into multiple parquet files")
+    sp.add_argument("-s", "--file-size", default="100MB",
+                    help="target output file size")
+    sp.add_argument("-t", "--target-folder", default="",
+                    help="target folder (default: source folder)")
+    sp.add_argument("-r", "--row-group-size", default="128MB",
+                    help="uncompressed row group size")
+    sp.add_argument("-c", "--compression", default="snappy",
+                    choices=sorted(_CODECS), help="compression codec")
+    sp.add_argument("file")
+    sp.set_defaults(fn=cmd_split)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"parquet-tool: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
