@@ -181,22 +181,25 @@ def main(argv=None) -> int:
                    help="enable verbose logging")
     args = p.parse_args(argv)
 
+    created_output = False
     try:
         hints = parse_type_hints(args.typehints)
-        with open(args.input, newline="") as in_f, \
-                open(args.output, "wb") as out_f:
-            convert(in_f, out_f, hints=hints,
-                    codec=_CODECS[args.compression],
-                    rowgroup_size=args.rowgroup_size,
-                    delimiter=args.delimiter,
-                    created_by=args.created_by,
-                    verbose=args.verbose)
+        with open(args.input, newline="") as in_f:
+            with open(args.output, "wb") as out_f:
+                created_output = True
+                convert(in_f, out_f, hints=hints,
+                        codec=_CODECS[args.compression],
+                        rowgroup_size=args.rowgroup_size,
+                        delimiter=args.delimiter,
+                        created_by=args.created_by,
+                        verbose=args.verbose)
     except (OSError, ValueError) as e:
         print(f"csv2parquet: {e}", file=sys.stderr)
-        try:  # don't leave a truncated, footer-less parquet behind
-            os.unlink(args.output)
-        except OSError:
-            pass
+        if created_output:
+            try:  # don't leave a truncated, footer-less parquet behind
+                os.unlink(args.output)
+            except OSError:
+                pass
         return 1
     return 0
 

@@ -151,19 +151,20 @@ def cmd_split(args, out=None) -> int:
     base = os.path.splitext(os.path.basename(args.file))[0]
 
     with FileReader(args.file) as r:
-        schema_text = str(r.get_schema_definition())
+        schema_def = r.get_schema_definition()
         part = 0
         w = None
         f = None
+        current = None
 
         def open_part():
-            nonlocal part, w, f
-            name = os.path.join(folder, f"{base}_{part:03d}.parquet")
-            f = open(name, "wb")
-            w = FileWriter(f, schema_text, codec=codec,
+            nonlocal part, w, f, current
+            current = os.path.join(folder, f"{base}_{part:03d}.parquet")
+            f = open(current, "wb")
+            w = FileWriter(f, schema_def, codec=codec,
                            max_row_group_size=rg_size or None,
                            created_by="parquet-tool split")
-            print(f"writing {name}", file=out)
+            print(f"writing {current}", file=out)
             part += 1
 
         def close_part():
@@ -172,19 +173,30 @@ def cmd_split(args, out=None) -> int:
             f.close()
             w = f = None
 
-        # Parts open lazily so a threshold hit on the last row doesn't
-        # leave a trailing empty file.
-        for row in r.rows():
-            if w is None:
-                open_part()
-            w.add_data(row)
-            if w.current_file_size() + w.current_row_group_size() >= target:
+        try:
+            # Parts open lazily so a threshold hit on the last row
+            # doesn't leave a trailing empty file.
+            for row in r.rows():
+                if w is None:
+                    open_part()
+                w.add_data(row)
+                if (w.current_file_size()
+                        + w.current_row_group_size() >= target):
+                    close_part()
+            if w is not None:
                 close_part()
-        if w is not None:
-            close_part()
-        elif part == 0:  # empty input: still emit one valid (empty) file
-            open_part()
-            close_part()
+            elif part == 0:  # empty input: emit one valid (empty) file
+                open_part()
+                close_part()
+        except BaseException:
+            # Don't leave a footer-less, truncated part behind.
+            if f is not None:
+                f.close()
+                try:
+                    os.unlink(current)
+                except OSError:
+                    pass
+            raise
     return 0
 
 

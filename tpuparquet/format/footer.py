@@ -70,6 +70,26 @@ def read_file_metadata(f) -> FileMetaData:
         or meta.row_groups is None
     ):
         raise FormatError("footer missing required FileMetaData fields")
+    for rg in meta.row_groups:
+        if rg.columns is None or rg.num_rows is None:
+            raise FormatError("row group missing required fields")
+        for cc in rg.columns:
+            cm = cc.meta_data
+            if cm is None:
+                raise FormatError("column chunk missing metadata")
+            if (
+                cm.type is None
+                or cm.codec is None
+                or not cm.path_in_schema
+                or cm.num_values is None
+                or cm.data_page_offset is None
+                or cm.total_compressed_size is None
+            ):
+                raise FormatError(
+                    "column metadata missing required fields")
+            if cm.num_values < 0 or cm.total_compressed_size < 0 \
+                    or cm.data_page_offset < 0:
+                raise FormatError("negative sizes in column metadata")
     return meta
 
 
