@@ -114,13 +114,17 @@ def main() -> None:
     reader = FileReader(buf)
     n_values = total_values(reader)
 
-    parity(reader)  # bit-exact or we don't report at all
-
     run_cpu(reader)  # warm caches
     cpu_s = min(run_cpu(reader) for _ in range(REPS))
 
     run_device(reader)  # compile warmup
     dev_s = min(run_device(reader) for _ in range(REPS))
+
+    # Parity AFTER timing: the first device->host transfer drops the
+    # runtime into synchronous dispatch (observed on the TPU tunnel), so
+    # any pre-timing readback would poison the measurement.  The report
+    # below is still gated on it — a mismatch raises before printing.
+    parity(reader)  # bit-exact or we don't report at all
 
     cpu_vps = n_values / cpu_s
     dev_vps = n_values / dev_s

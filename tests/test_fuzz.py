@@ -135,10 +135,12 @@ class TestCodecProperties:
 def _clean(excinfo_value) -> bool:
     """Corrupt input must surface as a domain error, not a raw
     IndexError/KeyError/struct.error/AttributeError crash."""
+    import struct as _struct
+
     return not isinstance(
         excinfo_value,
         (IndexError, KeyError, AttributeError, ZeroDivisionError,
-         RecursionError, UnboundLocalError))
+         RecursionError, UnboundLocalError, _struct.error))
 
 
 class TestCorruptStreams:

@@ -223,3 +223,45 @@ class TestChunkDeviceParity:
         buf.seek(0)
         r = FileReader(buf)
         _parity_check(r)
+
+
+class TestDeviceRegressions:
+    def test_device_rejects_level_above_max(self):
+        """Device path must reject def levels > max_def like the CPU
+        oracle's _check (silently-null disagreement otherwise)."""
+        import pytest as _pytest
+
+        from tpuparquet.cpu.hybrid import encode_hybrid_prefixed
+        from tpuparquet.cpu.hybrid import scan_hybrid
+        from tpuparquet.kernels.hybrid import count_eq_scan
+
+        # levels with a 3 where max_def=2 (fits the 2-bit width)
+        import numpy as _np
+        lv = _np.array([2, 2, 3, 1, 0, 2] * 10, dtype=_np.uint32)
+        body = encode_hybrid_prefixed(lv, 2)[4:]
+        sc = scan_hybrid(body, len(lv), 2)
+        with _pytest.raises(ValueError):
+            count_eq_scan(sc, 2, 2, validate_max=True)
+
+    def test_byte_array_data_property_full_buffer(self):
+        import io as _io
+
+        import numpy as _np
+
+        from tpuparquet import FileWriter, FileReader
+        from tpuparquet.kernels.device import read_row_group_device
+
+        buf = _io.BytesIO()
+        w = FileWriter(buf, "message m { required binary s; }",
+                       allow_dict=False)
+        vals = [b"hello", b"", b"world!!", b"xy"]
+        for v in vals:
+            w.add_data({"s": v})
+        w.close()
+        buf.seek(0)
+        col = read_row_group_device(FileReader(buf), 0)["s"]
+        data = _np.asarray(col.data)
+        offs = _np.asarray(col.offsets)
+        assert data.shape[0] == offs[-1] == sum(len(v) for v in vals)
+        got = [bytes(data[offs[i]:offs[i + 1]]) for i in range(len(vals))]
+        assert got == vals

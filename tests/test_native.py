@@ -80,3 +80,58 @@ class TestNativeSnappy:
         r = FileReader(buf)
         vals = np.asarray(r.read_row_group_arrays(0)["a"].values)
         np.testing.assert_array_equal(vals, np.arange(20_000) * 11)
+
+
+class TestNativeHybridScan:
+    """Native C run scanner vs the pure-Python scanner (oracle)."""
+
+    def _nat(self):
+        from tpuparquet.native import hybrid_native
+
+        nat = hybrid_native()
+        if nat is None:
+            pytest.skip("no C compiler available")
+        return nat
+
+    @pytest.mark.parametrize("width", [1, 2, 3, 7, 8, 13, 20, 32])
+    def test_scan_parity_random(self, width):
+        from tpuparquet.cpu.hybrid import _scan_hybrid_py, encode_hybrid
+
+        nat = self._nat()
+        rng = np.random.default_rng(width)
+        n = 5000
+        # mix of constant stretches (RLE) and noise (bit-packed)
+        vals = rng.integers(0, 1 << width, size=n, dtype=np.uint64)
+        run_starts = rng.choice(n, size=40, replace=False)
+        for s in run_starts:
+            vals[s : s + int(rng.integers(5, 60))] = vals[s]
+        enc = encode_hybrid(vals, width)
+        got = nat.scan(enc, n, width, 0)
+        exp = _scan_hybrid_py(enc, n, width, 0)
+        for g, e in zip(got, exp):
+            if isinstance(g, np.ndarray):
+                np.testing.assert_array_equal(g, np.asarray(e))
+            else:
+                assert g == e
+
+    def test_scan_errors(self):
+        nat = self._nat()
+        with pytest.raises(ValueError):
+            nat.scan(b"\x03", 8, 4, 0)        # truncated BP run
+        with pytest.raises(ValueError):
+            nat.scan(b"\x00\x01", 4, 4, 0)    # zero-length RLE
+        with pytest.raises(ValueError):
+            nat.scan(b"\x04", 2, 4, 0)        # truncated RLE value
+        with pytest.raises(ValueError):
+            nat.scan(b"\x04\xff", 2, 4, 0)    # RLE value exceeds width
+
+    def test_decode_uses_native_and_matches(self):
+        from tpuparquet.cpu.hybrid import decode_hybrid, encode_hybrid
+
+        self._nat()
+        rng = np.random.default_rng(0)
+        vals = np.repeat(rng.integers(0, 32, size=300, dtype=np.uint64),
+                         rng.integers(1, 30, size=300))
+        enc = encode_hybrid(vals, 5)
+        got = decode_hybrid(enc, len(vals), 5)
+        np.testing.assert_array_equal(got.astype(np.uint64), vals)

@@ -27,6 +27,7 @@ from ..varint import read_uvarint, write_uvarint
 from .bitpack import pack, unpack
 
 __all__ = [
+    "scan_hybrid",
     "decode_hybrid",
     "decode_hybrid_prefixed",
     "encode_hybrid",
@@ -34,17 +35,32 @@ __all__ = [
 ]
 
 
-def decode_hybrid(data, count: int, width: int, pos: int = 0) -> np.ndarray:
-    """Decode exactly ``count`` values of the given bit ``width``.
+def scan_hybrid(data, count: int, width: int, pos: int = 0):
+    """Pass 1 of the two-pass decode: parse run headers into a run table.
 
-    Trailing bytes after the needed runs are ignored (pages may pad)."""
-    if width == 0:
-        return np.zeros(count, dtype=np.uint32)
-    dtype = np.uint64 if width > 32 else np.uint32
-    out = np.empty(count, dtype=dtype)
-    filled = 0
+    Returns ``(run_ends, run_is_rle, run_value, run_bp_start, bp_bytes,
+    n_bp_values, end_pos)`` where ``run_ends`` is the cumulative output
+    count per run, ``bp_bytes`` the concatenated bit-packed segments and
+    ``run_bp_start`` each run's value offset into that stream.  Uses the
+    native C scanner when available (``native/hybrid.c``)."""
+    buf = data if isinstance(data, (bytes, bytearray, memoryview)) \
+        else bytes(data)
+    if width <= 32:
+        from ..native import hybrid_native
+
+        nat = hybrid_native()
+        if nat is not None:
+            return nat.scan(buf, count, width, pos)
+    return _scan_hybrid_py(buf, count, width, pos)
+
+
+def _scan_hybrid_py(buf, count: int, width: int, pos: int = 0):
+    """Pure-Python fallback scanner (also the >32-bit-width path)."""
     vbytes = (width + 7) // 8
-    buf = data if isinstance(data, (bytes, bytearray, memoryview)) else bytes(data)
+    vmask = (1 << width) - 1 if width else 0
+    ends, is_rle, values, bp_starts, bp_segments = [], [], [], [], []
+    filled = 0
+    n_bp = 0
     while filled < count:
         h, pos = read_uvarint(buf, pos)
         if h & 1:
@@ -52,10 +68,15 @@ def decode_hybrid(data, count: int, width: int, pos: int = 0) -> np.ndarray:
             nbytes = (n * width + 7) // 8
             if pos + nbytes > len(buf):
                 raise ValueError("truncated bit-packed run")
-            vals = unpack(buf[pos : pos + nbytes], n, width)
+            bp_segments.append(np.frombuffer(buf, np.uint8, nbytes, pos))
+            bp_starts.append(n_bp)
+            values.append(0)
+            is_rle.append(False)
             pos += nbytes
             take = min(n, count - filled)
-            out[filled : filled + take] = vals[:take]
+            # the unpacked stream keeps the full n values; consumers index
+            # through run_bp_start so padding values are never selected
+            n_bp += n
             filled += take
         else:
             n = h >> 1
@@ -64,11 +85,60 @@ def decode_hybrid(data, count: int, width: int, pos: int = 0) -> np.ndarray:
             if pos + vbytes > len(buf):
                 raise ValueError("truncated RLE run value")
             v = int.from_bytes(buf[pos : pos + vbytes], "little")
+            if v & ~vmask:
+                raise ValueError("RLE run value exceeds bit width")
             pos += vbytes
+            values.append(v)
+            is_rle.append(True)
+            bp_starts.append(n_bp)
             take = min(n, count - filled)
-            out[filled : filled + take] = v
             filled += take
-    return out
+        ends.append(filled)
+    bp_bytes = (np.concatenate(bp_segments) if bp_segments
+                else np.zeros(0, dtype=np.uint8))
+    vdtype = np.uint64 if width > 32 else np.uint32
+    return (
+        np.asarray(ends, dtype=np.int32),
+        np.asarray(is_rle, dtype=bool),
+        np.asarray(values, dtype=vdtype),
+        np.asarray(bp_starts, dtype=np.int32),
+        bp_bytes,
+        n_bp,
+        pos,
+    )
+
+
+def expand_scan(run_ends, run_is_rle, run_value, run_bp_start, bp_bytes,
+                n_bp: int, count: int, width: int) -> np.ndarray:
+    """Pass 2 (vectorized): expand a run table to ``count`` values."""
+    dtype = np.uint64 if width > 32 else np.uint32
+    if count == 0 or len(run_ends) == 0:
+        return np.zeros(count, dtype=dtype)
+    unpacked = (unpack(bp_bytes, n_bp, width) if n_bp
+                else np.zeros(1, dtype=dtype))
+    idx = np.arange(count, dtype=np.int64)
+    run = np.searchsorted(run_ends, idx, side="right")
+    run = np.minimum(run, len(run_ends) - 1)
+    run_start = np.where(run > 0, run_ends[run - 1], 0)
+    bp_pos = np.minimum(run_bp_start[run] + (idx - run_start),
+                        max(n_bp - 1, 0))
+    return np.where(run_is_rle[run], run_value[run],
+                    unpacked[bp_pos]).astype(dtype, copy=False)
+
+
+def decode_hybrid(data, count: int, width: int, pos: int = 0) -> np.ndarray:
+    """Decode exactly ``count`` values of the given bit ``width``.
+
+    Trailing bytes after the needed runs are ignored (pages may pad).
+    Two-pass: run-header scan (native C when available) + vectorized
+    expand."""
+    if width == 0:
+        return np.zeros(count, dtype=np.uint32)
+    ends, is_rle, value, bp_start, bp_bytes, n_bp, _ = scan_hybrid(
+        data, count, width, pos
+    )
+    return expand_scan(ends, is_rle, value, bp_start, bp_bytes, n_bp,
+                       count, width)
 
 
 def decode_hybrid_prefixed(data, count: int, width: int, pos: int = 0):

@@ -95,6 +95,57 @@ def dict_gather_fixed(dictionary: jax.Array, indices: jax.Array):
     return dictionary[indices]
 
 
+# ----------------------------------------------------------------------
+# Fused per-page kernels: one dispatch per data page.  Decoding a page is
+# index-expand + gather (+ level expand); issuing them as one jit lets
+# XLA fuse everything and — more importantly on a remote-attached TPU —
+# collapses N dispatches into one.
+# ----------------------------------------------------------------------
+
+def _expand_core(bp, ends, rle, val, start, cnt: int, w: int, nbp: int):
+    from .hybrid import expand_hybrid_core
+
+    idx = jnp.arange(cnt, dtype=jnp.int32)
+    return expand_hybrid_core(bp, ends, rle, val, start, idx, w, nbp)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("icnt", "iw", "inbp"))
+def page_dict_fixed(dictionary, i_bp, i_ends, i_rle, i_val, i_start,
+                    icnt: int, iw: int, inbp: int):
+    """Dict page decode, no def levels: index expand + gather."""
+    idx = _expand_core(i_bp, i_ends, i_rle, i_val, i_start, icnt, iw,
+                       inbp).astype(jnp.int32)
+    return dictionary[jnp.minimum(idx, dictionary.shape[0] - 1)]
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "dcnt", "dw", "dnbp", "icnt", "iw", "inbp"))
+def page_dict_fixed_levels(dictionary,
+                           d_bp, d_ends, d_rle, d_val, d_start,
+                           i_bp, i_ends, i_rle, i_val, i_start,
+                           dcnt: int, dw: int, dnbp: int,
+                           icnt: int, iw: int, inbp: int):
+    """Dict page decode fused with def-level expand: one dispatch."""
+    dl = _expand_core(d_bp, d_ends, d_rle, d_val, d_start, dcnt, dw,
+                      dnbp).astype(jnp.int32)
+    idx = _expand_core(i_bp, i_ends, i_rle, i_val, i_start, icnt, iw,
+                       inbp).astype(jnp.int32)
+    vals = dictionary[jnp.minimum(idx, dictionary.shape[0] - 1)]
+    return vals, dl
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "count", "lanes", "dcnt", "dw", "dnbp"))
+def page_plain_fixed_levels(words, d_bp, d_ends, d_rle, d_val, d_start,
+                            count: int, lanes: int,
+                            dcnt: int, dw: int, dnbp: int):
+    """PLAIN fixed-width page staging fused with def-level expand."""
+    dl = _expand_core(d_bp, d_ends, d_rle, d_val, d_start, dcnt, dw,
+                      dnbp).astype(jnp.int32)
+    return words[: count * lanes].reshape(count, lanes), dl
+
+
 @functools.partial(jax.jit, static_argnames=("total_bytes",))
 def dict_gather_bytes(dict_offsets: jax.Array, dict_data: jax.Array,
                       indices: jax.Array, out_offsets: jax.Array,

@@ -67,40 +67,106 @@ class DeviceColumn:
     ``data``: (n_non_null, lanes) u32 for fixed-width types, or u8 bytes
     with ``offsets`` for BYTE_ARRAY.  ``mask``/``positions`` map record
     slots to packed values; ``rep_levels``/``def_levels`` preserve nesting.
+
+    Buffers are stored *bucket-padded* (the shape the fused page kernels
+    emit) with logical lengths ``num_values`` (record slots) and
+    ``n_packed`` (non-null values); the public accessors slice lazily and
+    materialize implicit streams (all-zero levels, all-valid masks) on
+    demand, so the common flat-required case costs zero extra dispatches.
     """
 
-    __slots__ = ("ptype", "type_length", "data", "offsets", "mask",
-                 "positions", "rep_levels", "def_levels", "num_values")
+    __slots__ = ("ptype", "type_length", "offsets", "num_values",
+                 "n_packed", "n_bytes", "_data_p", "_mask_p", "_pos_p",
+                 "_rep_p", "_def_p", "_cache")
 
     def __init__(self, ptype, type_length, data, offsets, mask, positions,
-                 rep_levels, def_levels, num_values):
+                 rep_levels, def_levels, num_values, n_packed=None,
+                 n_bytes=None):
         self.ptype = ptype
         self.type_length = type_length
-        self.data = data
+        self._data_p = data
         self.offsets = offsets
-        self.mask = mask
-        self.positions = positions
-        self.rep_levels = rep_levels
-        self.def_levels = def_levels
+        self._mask_p = mask
+        self._pos_p = positions
+        self._rep_p = rep_levels
+        self._def_p = def_levels
         self.num_values = num_values
+        self.n_packed = (
+            n_packed if n_packed is not None
+            else (None if data is None else data.shape[0])
+        )
+        self.n_bytes = n_bytes  # BYTE_ARRAY only: logical data length
+        self._cache = {}
+
+    # -- lazy exact-shape accessors ---------------------------------------
+
+    def _sliced(self, key, padded, n, fill):
+        got = self._cache.get(key)
+        if got is None:
+            if padded is None:
+                got = fill()
+            elif padded.shape[0] == n:
+                got = padded
+            else:
+                got = padded[:n]
+            self._cache[key] = got
+        return got
+
+    @property
+    def data(self):
+        if self.offsets is not None:
+            # BYTE_ARRAY: the buffer axis is bytes, not values
+            return self._sliced(
+                "data", self._data_p, self.n_bytes,
+                lambda: jnp.zeros((0,), dtype=jnp.uint8))
+        return self._sliced(
+            "data", self._data_p, self.n_packed,
+            lambda: jnp.zeros((0, 1), dtype=jnp.uint32))
+
+    @property
+    def mask(self):
+        return self._sliced(
+            "mask", self._mask_p, self.num_values,
+            lambda: jnp.ones((self.num_values,), dtype=bool))
+
+    @property
+    def positions(self):
+        return self._sliced(
+            "pos", self._pos_p, self.num_values,
+            lambda: jnp.arange(self.num_values, dtype=jnp.int32))
+
+    @property
+    def rep_levels(self):
+        return self._sliced(
+            "rep", self._rep_p, self.num_values,
+            lambda: jnp.zeros((self.num_values,), dtype=jnp.int32))
+
+    @property
+    def def_levels(self):
+        return self._sliced(
+            "def", self._def_p, self.num_values,
+            lambda: jnp.zeros((self.num_values,), dtype=jnp.int32))
 
     def block_until_ready(self):
-        for x in (self.data, self.offsets, self.mask, self.rep_levels,
-                  self.def_levels):
+        for x in (self._data_p, self.offsets, self._mask_p, self._rep_p,
+                  self._def_p):
             if x is not None:
                 x.block_until_ready()
         return self
 
     def to_numpy(self):
         """Materialize to the CPU oracle's chunk representation:
-        (values, rep_levels, def_levels)."""
-        rep = np.asarray(self.rep_levels, dtype=np.int32)
-        dl = np.asarray(self.def_levels, dtype=np.int32)
+        (values, rep_levels, def_levels).  Slices padding host-side."""
+        n = self.num_values
+        rep = (np.zeros(n, dtype=np.int32) if self._rep_p is None
+               else np.asarray(self._rep_p, dtype=np.int32)[:n])
+        dl = (np.zeros(n, dtype=np.int32) if self._def_p is None
+              else np.asarray(self._def_p, dtype=np.int32)[:n])
         if self.offsets is not None:
             offs = np.asarray(self.offsets, dtype=np.int64)
-            data = np.asarray(self.data, dtype=np.uint8)[: int(offs[-1])]
+            data = np.asarray(self._data_p, dtype=np.uint8)[: int(offs[-1])]
             return ByteArrayColumn(offs, data), rep, dl
-        lanes = np.asarray(self.data, dtype=np.uint32)
+        lanes = np.asarray(self._data_p, dtype=np.uint32)[: self.n_packed]
         if self.ptype == Type.BOOLEAN:
             return lanes.reshape(-1).astype(bool), rep, dl
         if self.ptype == Type.INT32:
@@ -152,25 +218,6 @@ def _stage_byte_rows(arr: np.ndarray) -> jax.Array:
     return jnp.asarray(padded.reshape(-1, lanes, 4).view("<u4")[..., 0])
 
 
-def _levels_host(data, n: int, max_level: int, enc: str) -> np.ndarray:
-    """Host-side def-level decode, used only to count non-nulls without a
-    device->host sync.  Delegates to the CPU oracle's level decoders
-    (incl. their level-range validation).  ``enc``: "v1_rle"
-    (length-prefixed hybrid), "bit_packed" (legacy MSB-first), or
-    "v2_raw" (unprefixed hybrid)."""
-    from ..cpu.levels import (
-        decode_levels_bitpacked,
-        decode_levels_raw,
-        decode_levels_v1,
-    )
-
-    if enc == "bit_packed":
-        return decode_levels_bitpacked(data, n, max_level)
-    if enc == "v1_rle":
-        return decode_levels_v1(data, n, max_level)[0]
-    return decode_levels_raw(data, n, max_level)
-
-
 def decode_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                         base: int = 0) -> DeviceColumn:
     """Decode one column chunk to a DeviceColumn.
@@ -194,12 +241,14 @@ def decode_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
     dict_lens_np = None
     dict_np = None
 
-    val_parts = []         # device arrays, (n, lanes) u32
-    bytes_parts = []       # (lens_np, device u8 data) per page for BYTE_ARRAY
-    rep_parts = []
-    def_parts = []
+    val_parts = []         # [(device (n,lanes) u32 possibly padded, n)]
+    bytes_parts = []       # (offsets_np, device u8 data, total_bytes)
+    rep_parts = []         # [(device i32 possibly padded, n)] — only maxR>0
+    def_parts = []         # [(device i32 possibly padded, n)] — only maxD>0
     values_read = 0
     total = cm.num_values
+    max_def = node.max_def_level
+    dwidth = max_def.bit_length()
 
     while values_read < total:
         ph = decode_struct(PageHeader, r)
@@ -239,17 +288,15 @@ def decode_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
             raw = decompress_block(codec, payload, ph.uncompressed_page_size)
             n = h.num_values
             pos = 0
-            rep_dev, pos, _ = _levels_v1_device(
-                raw, n, node.max_rep_level, pos,
-                h.repetition_level_encoding,
+            if node.max_rep_level:
+                rep_dev, pos, _, _ = _levels_v1_device(
+                    raw, n, node.max_rep_level, pos,
+                    h.repetition_level_encoding,
+                )
+                rep_parts.append((rep_dev, n))
+            dl_scan, dl_host, pos = _scan_levels_v1(
+                raw, n, max_def, pos, h.definition_level_encoding
             )
-            dl_start = pos
-            dl_dev, pos, dl_host = _levels_v1_device(
-                raw, n, node.max_def_level, pos,
-                h.definition_level_encoding,
-            )
-            level_bytes = raw[dl_start:pos]
-            level_enc = "v1_rle"
             values_seg = raw[pos:]
             enc = h.encoding
         elif ptype_page == PageType.DATA_PAGE_V2:
@@ -257,13 +304,18 @@ def decode_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
             n = h.num_values
             rl_len = h.repetition_levels_byte_length or 0
             dl_len = h.definition_levels_byte_length or 0
-            rep_dev = _levels_raw_device(
-                payload[:rl_len], n, node.max_rep_level
-            )
-            level_bytes = payload[rl_len : rl_len + dl_len]
-            level_enc = "v2_raw"
-            dl_host = None
-            dl_dev = _levels_raw_device(level_bytes, n, node.max_def_level)
+            if node.max_rep_level:
+                rep_dev, _ = _levels_raw_device(
+                    payload[:rl_len], n, node.max_rep_level
+                )
+                rep_parts.append((rep_dev, n))
+            dl_scan, dl_host = (None, None)
+            if max_def:
+                from ..cpu.hybrid import scan_hybrid
+
+                dl_scan = scan_hybrid(
+                    payload[rl_len : rl_len + dl_len], n, dwidth
+                )
             values_seg = payload[rl_len + dl_len :]
             if h.is_compressed is not False:
                 values_seg = decompress_block(
@@ -274,32 +326,81 @@ def decode_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
         else:
             continue
 
-        if not node.max_def_level:
+        if not max_def:
             non_null = n
         elif (ptype_page == PageType.DATA_PAGE_V2
               and h.num_nulls is not None):
             non_null = n - h.num_nulls
+        elif dl_scan is not None:
+            # count non-nulls from the run table (RLE arithmetic + one
+            # vectorized unpack) rather than syncing the device expansion
+            # back — device->host round-trips serialize the page pipeline
+            from .hybrid import count_eq_scan
+
+            non_null = count_eq_scan(dl_scan, dwidth, max_def,
+                                     validate_max=True)
         else:
-            # count non-nulls from the host-side level bytes (cheap,
-            # vectorized) rather than syncing the device expansion back —
-            # device->host round-trips serialize the page pipeline
-            if dl_host is None:
-                dl_host = _levels_host(level_bytes, n, node.max_def_level,
-                                       level_enc)
-            non_null = int((dl_host == node.max_def_level).sum())
-        rep_parts.append(rep_dev)
-        def_parts.append(dl_dev)
+            non_null = int((dl_host == max_def).sum())
         values_read += n
+
+        # Def-level plan, padded for the fused page kernels.  A page
+        # whose value path can't fuse expands it standalone below.
+        dl_args = dl_cnt = dl_nbp = None
+        if dl_scan is not None:
+            from .hybrid import pad_plan, plan_from_scan
+
+            dl_args, dl_cnt, _, dl_nbp = pad_plan(
+                plan_from_scan(dl_scan, n, dwidth)
+            )
+        elif dl_host is not None:
+            def_parts.append((jnp.asarray(dl_host, dtype=jnp.int32), n))
+
+        def _def_standalone():
+            """Expand the def plan on its own (non-fused value paths)."""
+            if dl_args is not None:
+                from .hybrid import expand_hybrid
+
+                dl_dev = expand_hybrid(
+                    *jax.device_put(dl_args), dl_cnt, dwidth, dl_nbp
+                ).astype(jnp.int32)
+                def_parts.append((dl_dev, n))
 
         if enc in _DICT_ENCODINGS:
             width = values_seg[0] if len(values_seg) else 0
             if dict_fixed is not None:
-                idx = decode_hybrid_device(
-                    values_seg, non_null, width, pos=1
-                ).astype(jnp.int32) if width else jnp.zeros(
-                    (non_null,), jnp.int32
-                )
-                val_parts.append(dict_gather_fixed(dict_fixed, idx))
+                from .decode import page_dict_fixed, page_dict_fixed_levels
+                from .hybrid import pad_plan as _pp, plan_from_scan as _pf
+                from ..cpu.hybrid import scan_hybrid
+
+                i_sc = scan_hybrid(values_seg, non_null, width, pos=1) \
+                    if width else None
+                if i_sc is None:
+                    idx_args = None
+                else:
+                    idx_args, i_cnt, _, i_nbp = _pp(
+                        _pf(i_sc, non_null, width)
+                    )
+                if dl_args is not None and idx_args is not None:
+                    staged = jax.device_put((dl_args, idx_args))
+                    vals, dl_dev = page_dict_fixed_levels(
+                        dict_fixed, *staged[0], *staged[1],
+                        dl_cnt, dwidth, dl_nbp, i_cnt, width, i_nbp,
+                    )
+                    def_parts.append((dl_dev, n))
+                    val_parts.append((vals, non_null))
+                else:
+                    _def_standalone()
+                    if idx_args is None:
+                        idx = jnp.zeros((non_null,), jnp.int32)
+                        val_parts.append(
+                            (dict_gather_fixed(dict_fixed, idx), non_null)
+                        )
+                    else:
+                        vals = page_dict_fixed(
+                            dict_fixed, *jax.device_put(idx_args),
+                            i_cnt, width, i_nbp,
+                        )
+                        val_parts.append((vals, non_null))
             elif dict_offsets is not None:
                 # host-side index decode (vectorized, no device sync) just
                 # to size the output; the gather uses the device indices
@@ -307,6 +408,7 @@ def decode_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 from .decode import bucket
                 from .hybrid import decode_hybrid_device_padded
 
+                _def_standalone()
                 idx_np = (
                     decode_hybrid(values_seg, non_null, width, pos=1)
                     .astype(np.int32)
@@ -336,21 +438,42 @@ def decode_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 raise ValueError("dict-encoded page without dictionary")
         elif enc == Encoding.PLAIN:
             if ptype == Type.BYTE_ARRAY:
+                _def_standalone()
                 col = decode_plain(ptype, values_seg, non_null)  # host scan
                 offs = col.offsets.astype(np.int32)
                 bytes_parts.append(
                     (offs, jnp.asarray(col.data), int(col.data.size))
                 )
-            else:
-                val_parts.append(
-                    _stage_fixed_plain(values_seg, non_null, ptype,
-                                       node.element.type_length)
+            elif (dl_args is not None
+                  and ptype not in (Type.BOOLEAN,
+                                    Type.FIXED_LEN_BYTE_ARRAY)):
+                from .decode import page_plain_fixed_levels
+
+                lanes = _LANES[ptype]
+                words = stage_u32(values_seg, non_null * lanes)
+                staged = jax.device_put((words, dl_args))
+                vals, dl_dev = page_plain_fixed_levels(
+                    staged[0], *staged[1], non_null, lanes,
+                    dl_cnt, dwidth, dl_nbp,
                 )
+                def_parts.append((dl_dev, n))
+                val_parts.append((vals, non_null))
+            else:
+                _def_standalone()
+                val_parts.append((
+                    _stage_fixed_plain(values_seg, non_null, ptype,
+                                       node.element.type_length),
+                    non_null,
+                ))
         elif enc == Encoding.DELTA_BINARY_PACKED and ptype == Type.INT32:
+            _def_standalone()
             plan = plan_delta_i32(values_seg)
-            val_parts.append(expand_delta_i32(plan)[:non_null, None])
+            val_parts.append(
+                (expand_delta_i32(plan)[:non_null, None], non_null)
+            )
         else:
             # CPU fallback for the remaining encodings; stage the result.
+            _def_standalone()
             col = decode_values_cpu(ptype, enc, values_seg, non_null,
                                     node.element.type_length)
             if isinstance(col, ByteArrayColumn):
@@ -359,18 +482,22 @@ def decode_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                      int(col.data.size))
                 )
             else:
-                val_parts.append(_stage_numpy_fixed(col, ptype))
+                val_parts.append((_stage_numpy_fixed(col, ptype), non_null))
 
-    rep = jnp.concatenate(rep_parts) if rep_parts else jnp.zeros(0, jnp.int32)
-    dl = jnp.concatenate(def_parts) if def_parts else jnp.zeros(0, jnp.int32)
-    mask, positions = levels_to_validity(dl.astype(jnp.int32),
-                                         node.max_def_level) \
-        if node.max_def_level else (
-            jnp.ones(total, dtype=bool),
-            jnp.arange(total, dtype=jnp.int32),
-        )
+    rep, _ = _merge_parts(rep_parts)
+    dl, _ = _merge_parts(def_parts)
+    if max_def and dl is not None:
+        mask, positions = levels_to_validity(dl, max_def)
+    else:
+        mask = positions = None
 
     if bytes_parts:
+        if len(bytes_parts) == 1:
+            offs_np, data, nbytes = bytes_parts[0]
+            offsets = jnp.asarray(offs_np.astype(np.int64))
+            return DeviceColumn(ptype, node.element.type_length, data,
+                                offsets, mask, positions, rep, dl, total,
+                                n_packed=len(offs_np) - 1, n_bytes=nbytes)
         # merge per-page byte columns: rebase offsets, concat data
         all_offs = [np.zeros(1, dtype=np.int64)]
         datas = []
@@ -382,14 +509,26 @@ def decode_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
         offsets = jnp.asarray(np.concatenate(all_offs))
         data = jnp.concatenate(datas) if datas else jnp.zeros(0, jnp.uint8)
         return DeviceColumn(ptype, node.element.type_length, data, offsets,
-                            mask, positions, rep, dl, total)
+                            mask, positions, rep, dl, total,
+                            n_packed=sum(len(o) for o in all_offs) - 1,
+                            n_bytes=base_off)
 
-    if val_parts:
-        data = jnp.concatenate(val_parts)
-    else:
-        data = jnp.zeros((0, 1), dtype=jnp.uint32)
+    data, n_packed = _merge_parts(val_parts)
     return DeviceColumn(ptype, node.element.type_length, data, None, mask,
-                        positions, rep, dl, total)
+                        positions, rep, dl, total, n_packed=n_packed or 0)
+
+
+def _merge_parts(parts):
+    """Merge [(padded device array, logical n)] -> (array, total n).
+
+    Single-part chunks keep their padding (consumers slice lazily);
+    multi-part chunks slice then concatenate."""
+    if not parts:
+        return None, 0
+    if len(parts) == 1:
+        return parts[0]
+    arrs = [a if a.shape[0] == m else a[:m] for a, m in parts]
+    return jnp.concatenate(arrs), sum(m for _, m in parts)
 
 
 def read_row_group_device(reader, rg_index: int) -> dict[str, DeviceColumn]:
@@ -424,12 +563,37 @@ def _stage_numpy_fixed(col, ptype: Type) -> jax.Array:
     raise TypeError(f"cannot stage {arr.dtype} for {ptype}")
 
 
-def _levels_v1_device(raw, n, max_level, pos, encoding=Encoding.RLE):
-    """Returns (device levels, end pos, host levels | None).  Host levels
-    are populated when the decode already happened on host (BIT_PACKED),
-    so callers never decode the same bytes twice."""
+def _scan_levels_v1(raw, n, max_level, pos, encoding=Encoding.RLE):
+    """Scan a V1 def-level stream without expanding it.
+
+    Returns (scan | None, host levels | None, end pos); expansion happens
+    inside the fused page kernel (or standalone for non-fused paths)."""
     if max_level == 0:
-        return jnp.zeros((n,), dtype=jnp.int32), pos, None
+        return None, None, pos
+    width = max_level.bit_length()
+    if encoding == Encoding.BIT_PACKED:
+        from ..cpu import decode_levels_bitpacked
+
+        nbytes = (n * width + 7) // 8
+        vals = decode_levels_bitpacked(raw[pos : pos + nbytes], n, max_level)
+        return None, vals, pos + nbytes
+    import struct
+
+    from ..cpu.hybrid import scan_hybrid
+
+    (size,) = struct.unpack_from("<I", raw, pos)
+    sc = scan_hybrid(raw[pos + 4 : pos + 4 + size], n, width)
+    return sc, None, pos + 4 + size
+
+
+def _levels_v1_device(raw, n, max_level, pos, encoding=Encoding.RLE):
+    """Returns (device levels, end pos, scan | None, host levels | None).
+
+    The scan (run table) is returned so callers can count non-nulls from
+    it without re-decoding; host levels are populated instead when the
+    decode already happened on host (BIT_PACKED)."""
+    if max_level == 0:
+        return jnp.zeros((n,), dtype=jnp.int32), pos, None, None
     width = max_level.bit_length()
     if encoding == Encoding.BIT_PACKED:
         # Legacy MSB-first levels (old parquet-mr writers): decode on host
@@ -438,17 +602,27 @@ def _levels_v1_device(raw, n, max_level, pos, encoding=Encoding.RLE):
 
         nbytes = (n * width + 7) // 8
         vals = decode_levels_bitpacked(raw[pos : pos + nbytes], n, max_level)
-        return jnp.asarray(vals, dtype=jnp.int32), pos + nbytes, vals
+        return jnp.asarray(vals, dtype=jnp.int32), pos + nbytes, None, vals
     import struct
+
+    from ..cpu.hybrid import scan_hybrid
+    from .hybrid import expand_plan_padded, plan_from_scan
 
     (size,) = struct.unpack_from("<I", raw, pos)
     body = raw[pos + 4 : pos + 4 + size]
-    vals = decode_hybrid_device(body, n, width)
-    return vals.astype(jnp.int32), pos + 4 + size, None
+    sc = scan_hybrid(body, n, width)
+    vals = expand_plan_padded(plan_from_scan(sc, n, width))[:n]
+    return vals.astype(jnp.int32), pos + 4 + size, sc, None
 
 
 def _levels_raw_device(raw, n, max_level):
+    """Returns (device levels, scan | None) for V2 unprefixed levels."""
     if max_level == 0:
-        return jnp.zeros((n,), dtype=jnp.int32)
+        return jnp.zeros((n,), dtype=jnp.int32), None
     width = max_level.bit_length()
-    return decode_hybrid_device(raw, n, width).astype(jnp.int32)
+    from ..cpu.hybrid import scan_hybrid
+    from .hybrid import expand_plan_padded, plan_from_scan
+
+    sc = scan_hybrid(raw, n, width)
+    vals = expand_plan_padded(plan_from_scan(sc, n, width))[:n]
+    return vals.astype(jnp.int32), sc

@@ -22,11 +22,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..varint import read_uvarint
 from .bitunpack import pad_to_words, unpack_u32
 
 __all__ = [
-    "plan_hybrid", "pad_plan", "expand_hybrid", "expand_hybrid_core",
+    "plan_hybrid", "plan_from_scan", "count_eq_scan", "pad_plan",
+    "expand_hybrid", "expand_hybrid_core", "expand_plan_padded",
     "decode_hybrid_device", "decode_hybrid_device_padded", "HybridPlan",
 ]
 
@@ -52,54 +52,27 @@ class HybridPlan:
 
 
 def plan_hybrid(data, count: int, width: int, pos: int = 0) -> HybridPlan:
-    """Parse run headers into a run table (host, metadata-sized work)."""
-    vbytes = (width + 7) // 8
-    buf = data if isinstance(data, (bytes, bytearray, memoryview)) else bytes(data)
-    ends = []
-    is_rle = []
-    values = []
-    bp_starts = []
-    bp_segments = []
-    filled = 0
-    n_bp = 0
-    while filled < count:
-        h, pos = read_uvarint(buf, pos)
-        if h & 1:
-            n = (h >> 1) * 8
-            nbytes = (n * width + 7) // 8
-            if pos + nbytes > len(buf):
-                raise ValueError("truncated bit-packed run")
-            bp_segments.append(np.frombuffer(buf, np.uint8, nbytes, pos))
-            bp_starts.append(n_bp)
-            values.append(0)
-            is_rle.append(False)
-            pos += nbytes
-            take = min(n, count - filled)
-            # the unpacked stream keeps the full n values; consumers index
-            # through run_bp_start so padding values are never selected
-            n_bp += n
-            filled += take
-        else:
-            n = h >> 1
-            if n == 0:
-                raise ValueError("zero-length RLE run")
-            if pos + vbytes > len(buf):
-                raise ValueError("truncated RLE run value")
-            v = int.from_bytes(buf[pos : pos + vbytes], "little")
-            pos += vbytes
-            values.append(v)
-            is_rle.append(True)
-            bp_starts.append(n_bp)
-            take = min(n, count - filled)
-            filled += take
-        ends.append(filled)
-    if not ends:
-        ends, is_rle, values, bp_starts = [0], [True], [0], [0]
-    if bp_segments:
-        packed = np.concatenate(bp_segments)
-    else:
-        packed = np.zeros(0, dtype=np.uint8)
-    bp_words = pad_to_words(packed, max(width, 1), max(n_bp, 1))
+    """Parse run headers into a run table (host, metadata-sized work).
+
+    Delegates the scan to the shared (native-C-accelerated) pass-1
+    scanner and stages the bit-packed bytes as padded u32 words."""
+    from ..cpu.hybrid import scan_hybrid
+
+    return plan_from_scan(scan_hybrid(data, count, width, pos),
+                          count, width)
+
+
+def plan_from_scan(scan, count: int, width: int) -> HybridPlan:
+    """Build a device plan from a :func:`scan_hybrid` result (lets the
+    caller reuse one scan for both the plan and host-side counting)."""
+    ends, is_rle, values, bp_starts, bp_bytes, n_bp, _ = scan
+    if len(ends) == 0:
+        ends = np.zeros(1, dtype=np.int32)
+        is_rle = np.ones(1, dtype=bool)
+        values = np.zeros(1, dtype=np.uint32)
+        bp_starts = np.zeros(1, dtype=np.int32)
+    bp_words = pad_to_words(np.asarray(bp_bytes, dtype=np.uint8),
+                            max(width, 1), max(n_bp, 1))
     return HybridPlan(
         bp_words=bp_words,
         run_ends=np.asarray(ends, dtype=np.int32),
@@ -110,6 +83,46 @@ def plan_hybrid(data, count: int, width: int, pos: int = 0) -> HybridPlan:
         width=width,
         n_bp_values=max(n_bp, 1),
     )
+
+
+def count_eq_scan(scan, width: int, target: int,
+                  validate_max: bool = False) -> int:
+    """Count occurrences of ``target`` from a scan's run table without a
+    full expand: RLE runs are arithmetic, bit-packed segments get one
+    vectorized unpack.  Used to count non-null values (def == max_def)
+    without a device sync or a second decode.
+
+    ``validate_max`` additionally rejects any level above ``target``
+    (the level-range check of ``cpu/levels._check``; values above
+    max_def would otherwise silently read as null)."""
+    from ..cpu.bitpack import unpack
+
+    ends, is_rle, values, bp_starts, bp_bytes, n_bp, _ = scan
+    if len(ends) == 0:
+        return 0
+    lens = np.diff(ends, prepend=np.int32(0))
+    live = lens > 0
+    if validate_max and bool((values[is_rle & live] > target).any()):
+        raise ValueError(
+            f"level value {int(values[is_rle & live].max())} exceeds "
+            f"max level {target}"
+        )
+    cnt = int(lens[is_rle & (values == target)].sum())
+    bp = ~is_rle
+    if bp.any() and n_bp:
+        unpacked = unpack(bp_bytes, n_bp, width)
+        delta = np.zeros(n_bp + 1, dtype=np.int64)
+        starts = bp_starts[bp].astype(np.int64)
+        np.add.at(delta, starts, 1)
+        np.add.at(delta, starts + lens[bp], -1)
+        active = np.cumsum(delta[:-1]) > 0
+        if validate_max and bool((unpacked[active] > target).any()):
+            raise ValueError(
+                f"level value {int(unpacked[active].max())} exceeds "
+                f"max level {target}"
+            )
+        cnt += int(((unpacked == target) & active).sum())
+    return cnt
 
 
 def expand_hybrid_core(bp_words, run_ends, run_is_rle, run_value,
@@ -165,12 +178,17 @@ def pad_plan(p: HybridPlan):
             run_bp_start), cnt, p.width, n_bp
 
 
+def expand_plan_padded(p: HybridPlan):
+    """Device expand of an existing plan, bucket-padded output."""
+    args, cnt, w, n_bp = pad_plan(p)
+    return expand_hybrid(*(jnp.asarray(a) for a in args), cnt, w, n_bp)
+
+
 def decode_hybrid_device_padded(data, count: int, width: int, pos: int = 0):
     """Host plan + device expand, returning the bucket-padded output
     (shape (bucket(count),), tail zeros) — callers that feed another
     padded kernel can skip the slice/re-pad round trip."""
-    args, cnt, w, n_bp = pad_plan(plan_hybrid(data, count, width, pos))
-    return expand_hybrid(*(jnp.asarray(a) for a in args), cnt, w, n_bp)
+    return expand_plan_padded(plan_hybrid(data, count, width, pos))
 
 
 def decode_hybrid_device(data, count: int, width: int, pos: int = 0):

@@ -16,21 +16,23 @@ import os
 import subprocess
 import threading
 
-__all__ = ["snappy_native", "NativeSnappy"]
+import numpy as np
+
+__all__ = ["snappy_native", "NativeSnappy", "hybrid_native", "NativeHybrid"]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "snappy.c")
-_SO = os.path.join(_DIR, "_tpq_snappy.so")
+_SRCS = [os.path.join(_DIR, "snappy.c"), os.path.join(_DIR, "hybrid.c")]
+_SO = os.path.join(_DIR, "_tpq_native.so")
 
 _lock = threading.Lock()
-_cached: "NativeSnappy | None | bool" = False  # False = not tried yet
+_cached: "ctypes.CDLL | None | bool" = False  # False = not tried yet
 
 
 def _build() -> bool:
     """(Re)build the shared library if stale; returns success."""
     try:
-        if os.path.exists(_SO) and (
-            os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+        if os.path.exists(_SO) and all(
+            os.path.getmtime(_SO) >= os.path.getmtime(src) for src in _SRCS
         ):
             return True
         # per-process temp name: concurrent builders must not interleave
@@ -39,7 +41,7 @@ def _build() -> bool:
         for cc in ("cc", "gcc", "clang"):
             try:
                 subprocess.run(
-                    [cc, "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
+                    [cc, "-O3", "-shared", "-fPIC", "-o", tmp, *_SRCS],
                     check=True, capture_output=True, timeout=120,
                 )
                 os.replace(tmp, _SO)
@@ -50,6 +52,19 @@ def _build() -> bool:
         return False
     except OSError:
         return False
+
+
+def _lib() -> "ctypes.CDLL | None":
+    global _cached
+    with _lock:
+        if _cached is False:
+            _cached = None
+            if _build():
+                try:
+                    _cached = ctypes.CDLL(_SO)
+                except OSError:
+                    _cached = None
+        return _cached
 
 
 class NativeSnappy:
@@ -113,15 +128,82 @@ class NativeSnappy:
         return ctypes.string_at(buf, produced.value)
 
 
+class NativeHybrid:
+    """ctypes bindings over the C hybrid RLE/BP run scanner."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._scan = lib.tpq_hybrid_scan
+        self._scan.restype = ctypes.c_int
+        self._scan.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_size_t,
+            ctypes.c_int64, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_size_t), ctypes.POINTER(ctypes.c_size_t),
+        ]
+
+    def scan(self, buf, count: int, width: int, pos: int = 0):
+        """Parse run headers; returns (run_ends, run_is_rle, run_value,
+        run_bp_start, bp_bytes, n_bp_values, end_pos) — numpy arrays plus
+        the concatenated bit-packed segment bytes."""
+        data = bytes(buf)
+        # every run consumes >= 1 header byte, so runs are bounded by the
+        # stream's byte length as well as by the value count
+        cap_runs = max(min(count, max(len(data) - pos, 0)) + 1, 1)
+        bp_cap = max(len(data) - pos, 1)
+        ends = np.empty(cap_runs, dtype=np.int32)
+        is_rle = np.empty(cap_runs, dtype=np.uint8)
+        value = np.empty(cap_runs, dtype=np.uint32)
+        bp_start = np.empty(cap_runs, dtype=np.int32)
+        bp_out = np.empty(bp_cap, dtype=np.uint8)
+        n_runs = ctypes.c_int64()
+        n_bp = ctypes.c_int64()
+        bp_len = ctypes.c_size_t()
+        end_pos = ctypes.c_size_t()
+        rc = self._scan(
+            data, len(data), pos, count, width,
+            ends.ctypes.data, is_rle.ctypes.data, value.ctypes.data,
+            bp_start.ctypes.data, cap_runs,
+            bp_out.ctypes.data, bp_cap,
+            ctypes.byref(n_runs), ctypes.byref(n_bp),
+            ctypes.byref(bp_len), ctypes.byref(end_pos),
+        )
+        if rc == -1:
+            raise ValueError("truncated hybrid run")
+        if rc == -2:
+            raise ValueError("zero-length RLE run")
+        if rc == -6:
+            raise ValueError("RLE run value exceeds bit width")
+        if rc != 0:
+            raise ValueError(f"hybrid scan failed (rc={rc})")
+        r = int(n_runs.value)
+        return (ends[:r], is_rle[:r].astype(bool), value[:r], bp_start[:r],
+                bp_out[: bp_len.value], int(n_bp.value), int(end_pos.value))
+
+
+_snappy_inst: "NativeSnappy | None" = None
+_hybrid_inst: "NativeHybrid | None" = None
+
+
 def snappy_native() -> NativeSnappy | None:
-    """The process-wide native codec, or None if unbuildable."""
-    global _cached
-    with _lock:
-        if _cached is False:
-            _cached = None
-            if _build():
-                try:
-                    _cached = NativeSnappy(ctypes.CDLL(_SO))
-                except OSError:
-                    _cached = None
-        return _cached
+    """The process-wide native snappy codec, or None if unbuildable."""
+    global _snappy_inst
+    lib = _lib()
+    if lib is None:
+        return None
+    if _snappy_inst is None:
+        _snappy_inst = NativeSnappy(lib)
+    return _snappy_inst
+
+
+def hybrid_native() -> NativeHybrid | None:
+    """The process-wide native hybrid scanner, or None if unbuildable."""
+    global _hybrid_inst
+    lib = _lib()
+    if lib is None:
+        return None
+    if _hybrid_inst is None:
+        _hybrid_inst = NativeHybrid(lib)
+    return _hybrid_inst

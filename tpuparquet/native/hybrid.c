@@ -1,0 +1,101 @@
+/* RLE/bit-packed hybrid stream scanner.
+ *
+ * Parses the uvarint-chained run headers of a Parquet hybrid stream
+ * (levels, dictionary indices, boolean RLE) into a flat run table plus
+ * the concatenated bit-packed segment bytes.  This is the host-side
+ * "pass 1" of the two-pass decode: the run table is metadata-sized, and
+ * both the CPU oracle (vectorized numpy expand) and the TPU kernels
+ * (device expand) consume it.  Replaces a per-run Python loop that
+ * dominated decode profiles on streams with thousands of runs.
+ */
+
+#include <stddef.h>
+#include <stdint.h>
+#include <string.h>
+
+#define TPQ_OK 0
+#define TPQ_ERR_TRUNCATED (-1)
+#define TPQ_ERR_ZERO_RLE (-2)
+#define TPQ_ERR_RUN_CAP (-3)
+#define TPQ_ERR_BP_CAP (-4)
+#define TPQ_ERR_WIDTH (-5)
+#define TPQ_ERR_VALUE (-6)
+
+/* Read one unsigned LEB128 varint; returns new position or 0 on error. */
+static size_t read_uvarint(const uint8_t *buf, size_t len, size_t pos,
+                           uint64_t *out) {
+  uint64_t v = 0;
+  int shift = 0;
+  while (pos < len && shift < 64) {
+    uint8_t b = buf[pos++];
+    v |= (uint64_t)(b & 0x7f) << shift;
+    if (!(b & 0x80)) {
+      *out = v;
+      return pos;
+    }
+    shift += 7;
+  }
+  return 0;
+}
+
+int tpq_hybrid_scan(const uint8_t *buf, size_t buflen, size_t pos,
+                    int64_t count, int width,
+                    int32_t *run_ends, uint8_t *run_is_rle,
+                    uint32_t *run_value, int32_t *run_bp_start,
+                    int64_t cap_runs, uint8_t *bp_out, size_t bp_cap,
+                    int64_t *n_runs, int64_t *n_bp_values,
+                    size_t *bp_len, size_t *end_pos) {
+  if (width < 0 || width > 32) return TPQ_ERR_WIDTH;
+  size_t vbytes = (size_t)(width + 7) / 8;
+  uint32_t vmask =
+      width >= 32 ? 0xffffffffu : ((1u << width) - 1u);
+  int64_t filled = 0, runs = 0, bp_values = 0;
+  size_t bp_used = 0;
+
+  while (filled < count) {
+    uint64_t h;
+    size_t np = read_uvarint(buf, buflen, pos, &h);
+    if (np == 0) return TPQ_ERR_TRUNCATED;
+    pos = np;
+    if (runs >= cap_runs) return TPQ_ERR_RUN_CAP;
+    /* A 9-byte varint header can encode group counts whose value count
+     * would overflow int64 arithmetic; any such run is necessarily
+     * longer than the buffer, so reject it up front. */
+    if ((h >> 1) > ((uint64_t)1 << 40)) return TPQ_ERR_TRUNCATED;
+    if (h & 1) {
+      int64_t n = (int64_t)(h >> 1) * 8;
+      size_t nbytes = ((size_t)n * (size_t)width + 7) / 8;
+      if (pos + nbytes > buflen) return TPQ_ERR_TRUNCATED;
+      if (bp_used + nbytes > bp_cap) return TPQ_ERR_BP_CAP;
+      memcpy(bp_out + bp_used, buf + pos, nbytes);
+      bp_used += nbytes;
+      pos += nbytes;
+      run_is_rle[runs] = 0;
+      run_value[runs] = 0;
+      run_bp_start[runs] = (int32_t)bp_values;
+      int64_t take = n < count - filled ? n : count - filled;
+      bp_values += n; /* full groups stay; consumers index via bp_start */
+      filled += take;
+    } else {
+      int64_t n = (int64_t)(h >> 1);
+      if (n == 0) return TPQ_ERR_ZERO_RLE;
+      if (pos + vbytes > buflen) return TPQ_ERR_TRUNCATED;
+      uint32_t v = 0;
+      for (size_t i = 0; i < vbytes; i++)
+        v |= (uint32_t)buf[pos + i] << (8 * i);
+      pos += vbytes;
+      if (v & ~vmask) return TPQ_ERR_VALUE; /* corrupt: exceeds width */
+      run_is_rle[runs] = 1;
+      run_value[runs] = v;
+      run_bp_start[runs] = (int32_t)bp_values;
+      int64_t take = n < count - filled ? n : count - filled;
+      filled += take;
+    }
+    run_ends[runs++] = (int32_t)filled;
+  }
+  *n_runs = runs;
+  *n_bp_values = bp_values;
+  *bp_len = bp_used;
+  *end_pos = pos;
+  return TPQ_OK;
+}
