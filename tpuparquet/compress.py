@@ -336,7 +336,12 @@ class _Snappy(BlockCompressor):
         nat = self._nat()
         if nat is not None:
             try:
-                return nat.decompress(bytes(block), decompressed_size)
+                # memoryview over a numpy buffer: bytes-like (compares
+                # equal to bytes, slices, unpacks) and the decode path
+                # avoids two whole-buffer copies per page
+                return memoryview(
+                    nat.decompress_np(bytes(block), decompressed_size)
+                )
             except ValueError as e:
                 raise CompressionError(str(e)) from None
         return snappy_decompress(block, decompressed_size)

@@ -43,8 +43,9 @@ def scan_hybrid(data, count: int, width: int, pos: int = 0):
     count per run, ``bp_bytes`` the concatenated bit-packed segments and
     ``run_bp_start`` each run's value offset into that stream.  Uses the
     native C scanner when available (``native/hybrid.c``)."""
-    buf = data if isinstance(data, (bytes, bytearray, memoryview)) \
-        else bytes(data)
+    buf = data if isinstance(
+        data, (bytes, bytearray, memoryview, np.ndarray)
+    ) else bytes(data)
     if width <= 32:
         from ..native import hybrid_native
 
@@ -56,6 +57,8 @@ def scan_hybrid(data, count: int, width: int, pos: int = 0):
 
 def _scan_hybrid_py(buf, count: int, width: int, pos: int = 0):
     """Pure-Python fallback scanner (also the >32-bit-width path)."""
+    if isinstance(buf, np.ndarray):
+        buf = buf.tobytes()
     vbytes = (width + 7) // 8
     vmask = (1 << width) - 1 if width else 0
     ends, is_rle, values, bp_starts, bp_segments = [], [], [], [], []

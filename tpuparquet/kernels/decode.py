@@ -109,6 +109,46 @@ def _expand_core(bp, ends, rle, val, start, cnt: int, w: int, nbp: int):
     return expand_hybrid_core(bp, ends, rle, val, start, idx, w, nbp)
 
 
+def _expand_tbl(bp, table, cnt: int, w: int, nbp: int):
+    """Expand from a packed (4, R) u32 run table (see hybrid.pack_plan)."""
+    return _expand_core(
+        bp, table[0].astype(jnp.int32), table[1] != 0, table[2],
+        table[3].astype(jnp.int32), cnt, w, nbp,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("cnt", "w", "nbp"))
+def expand_tbl(bp, table, cnt: int, w: int, nbp: int):
+    return _expand_tbl(bp, table, cnt, w, nbp)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "dcnt", "dw", "dnbp", "icnt", "iw", "inbp"))
+def page_dict_fixed_levels_tbl(dictionary, d_bp, d_tbl, i_bp, i_tbl,
+                               dcnt: int, dw: int, dnbp: int,
+                               icnt: int, iw: int, inbp: int):
+    """Packed-table variant of :func:`page_dict_fixed_levels`."""
+    dl = _expand_tbl(d_bp, d_tbl, dcnt, dw, dnbp).astype(jnp.int32)
+    idx = _expand_tbl(i_bp, i_tbl, icnt, iw, inbp).astype(jnp.int32)
+    vals = dictionary[jnp.minimum(idx, dictionary.shape[0] - 1)]
+    return vals, dl
+
+
+@functools.partial(jax.jit, static_argnames=("icnt", "iw", "inbp"))
+def page_dict_fixed_tbl(dictionary, i_bp, i_tbl,
+                        icnt: int, iw: int, inbp: int):
+    idx = _expand_tbl(i_bp, i_tbl, icnt, iw, inbp).astype(jnp.int32)
+    return dictionary[jnp.minimum(idx, dictionary.shape[0] - 1)]
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "count", "lanes", "dcnt", "dw", "dnbp"))
+def page_plain_fixed_levels_tbl(words, d_bp, d_tbl, count: int, lanes: int,
+                                dcnt: int, dw: int, dnbp: int):
+    dl = _expand_tbl(d_bp, d_tbl, dcnt, dw, dnbp).astype(jnp.int32)
+    return words[: count * lanes].reshape(count, lanes), dl
+
+
 @functools.partial(jax.jit,
                    static_argnames=("icnt", "iw", "inbp"))
 def page_dict_fixed(dictionary, i_bp, i_ends, i_rle, i_val, i_start,

@@ -178,6 +178,32 @@ def pad_plan(p: HybridPlan):
             run_bp_start), cnt, p.width, n_bp
 
 
+def pack_plan(p: HybridPlan):
+    """Pad like :func:`pad_plan` but pack the four run-table columns into
+    ONE (4, R) u32 array — halving the per-stream transfer count (each
+    host->device array has fixed per-array overhead on a remote TPU).
+
+    Rows: 0=run_ends, 1=is_rle, 2=value, 3=bp_start.  Returns
+    ((bp_words, table), cnt, width, n_bp)."""
+    from .decode import bucket
+
+    cnt = bucket(p.count)
+    R = bucket(len(p.run_ends))
+    n_bp = bucket(p.n_bp_values)
+    n_blocks = (n_bp + 31) // 32
+    w = max(p.width, 1)
+    bp_words = np.zeros((n_blocks, w), dtype=np.uint32)
+    bp_words[: p.bp_words.shape[0], : p.bp_words.shape[1]] = p.bp_words
+    table = np.zeros((4, R), dtype=np.uint32)
+    table[0, :] = cnt  # padding runs end at cnt (monotone)
+    table[0, : len(p.run_ends)] = p.run_ends.astype(np.uint32)
+    table[1, :] = 1    # padding runs are RLE of 0
+    table[1, : len(p.run_is_rle)] = p.run_is_rle.astype(np.uint32)
+    table[2, : len(p.run_value)] = p.run_value
+    table[3, : len(p.run_bp_start)] = p.run_bp_start.astype(np.uint32)
+    return (bp_words, table), cnt, p.width, n_bp
+
+
 def expand_plan_padded(p: HybridPlan):
     """Device expand of an existing plan, bucket-padded output."""
     args, cnt, w, n_bp = pad_plan(p)

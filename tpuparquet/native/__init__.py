@@ -101,20 +101,26 @@ class NativeSnappy:
             raise ValueError("snappy: bad size header")
         return out.value
 
-    def decompress(self, block: bytes, expected_size: int | None = None):
+    def decompress_np(self, block: bytes,
+                      expected_size: int | None = None) -> np.ndarray:
+        """Decompress into a numpy buffer (no intermediate copies)."""
         total = self.uncompressed_length(block)
         if expected_size is not None and total != expected_size:
             raise ValueError(
                 f"snappy: header size {total} != expected {expected_size}"
             )
-        buf = ctypes.create_string_buffer(max(total, 1))
+        out = np.empty(max(total, 1), dtype=np.uint8)
         produced = ctypes.c_size_t()
         rc = self._lib.tpq_snappy_decompress(
-            block, len(block), buf, total, ctypes.byref(produced)
+            block, len(block), out.ctypes.data_as(ctypes.c_char_p), total,
+            ctypes.byref(produced),
         )
         if rc != 0:
             raise ValueError(f"snappy: corrupt block (rc={rc})")
-        return ctypes.string_at(buf, produced.value)
+        return out[: produced.value]
+
+    def decompress(self, block: bytes, expected_size: int | None = None):
+        return self.decompress_np(block, expected_size).tobytes()
 
     def compress(self, data: bytes) -> bytes:
         cap = self._lib.tpq_snappy_max_compressed_length(len(data))
@@ -148,11 +154,14 @@ class NativeHybrid:
         """Parse run headers; returns (run_ends, run_is_rle, run_value,
         run_bp_start, bp_bytes, n_bp_values, end_pos) — numpy arrays plus
         the concatenated bit-packed segment bytes."""
-        data = bytes(buf)
+        if isinstance(buf, np.ndarray):
+            data = np.ascontiguousarray(buf.view(np.uint8))
+        else:
+            data = np.frombuffer(buf, dtype=np.uint8)  # zero-copy
         # every run consumes >= 1 header byte, so runs are bounded by the
         # stream's byte length as well as by the value count
-        cap_runs = max(min(count, max(len(data) - pos, 0)) + 1, 1)
-        bp_cap = max(len(data) - pos, 1)
+        cap_runs = max(min(count, max(data.size - pos, 0)) + 1, 1)
+        bp_cap = max(data.size - pos, 1)
         ends = np.empty(cap_runs, dtype=np.int32)
         is_rle = np.empty(cap_runs, dtype=np.uint8)
         value = np.empty(cap_runs, dtype=np.uint32)
@@ -163,7 +172,8 @@ class NativeHybrid:
         bp_len = ctypes.c_size_t()
         end_pos = ctypes.c_size_t()
         rc = self._scan(
-            data, len(data), pos, count, width,
+            data.ctypes.data_as(ctypes.c_char_p), data.size, pos, count,
+            width,
             ends.ctypes.data, is_rle.ctypes.data, value.ctypes.data,
             bp_start.ctypes.data, cap_runs,
             bp_out.ctypes.data, bp_cap,
