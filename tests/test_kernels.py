@@ -265,3 +265,68 @@ class TestDeviceRegressions:
         assert data.shape[0] == offs[-1] == sum(len(v) for v in vals)
         got = [bytes(data[offs[i]:offs[i + 1]]) for i in range(len(vals))]
         assert got == vals
+
+
+class TestPallasPath:
+    def test_single_bp_detection(self):
+        from tpuparquet.cpu.hybrid import encode_hybrid, scan_hybrid
+        from tpuparquet.kernels.hybrid import single_bp_scan
+
+        import numpy as _np
+        rnd = _np.random.default_rng(0).integers(0, 32, 200, dtype=_np.uint64)
+        assert single_bp_scan(scan_hybrid(encode_hybrid(rnd, 5), 200, 5))
+        const = _np.zeros(200, dtype=_np.uint64)
+        assert not single_bp_scan(
+            scan_hybrid(encode_hybrid(const, 5), 200, 5))  # RLE run
+
+    def test_expand_single_matches_table_path(self):
+        import numpy as _np
+        import jax.numpy as _jnp
+
+        from tpuparquet.cpu.hybrid import encode_hybrid, scan_hybrid
+        from tpuparquet.kernels.decode import expand_tbl
+        from tpuparquet.kernels.hybrid import pack_plan, plan_from_scan
+
+        rnd = _np.random.default_rng(1).integers(0, 1 << 13, 5000,
+                                                 dtype=_np.uint64)
+        enc = encode_hybrid(rnd, 13)
+        sc = scan_hybrid(enc, 5000, 13)
+        (bp, tbl), cnt, w, nbp = pack_plan(plan_from_scan(sc, 5000, 13))
+        a = _np.asarray(expand_tbl(_jnp.asarray(bp), _jnp.asarray(tbl),
+                                   cnt, w, nbp, single=False))[:5000]
+        b = _np.asarray(expand_tbl(_jnp.asarray(bp), _jnp.asarray(tbl),
+                                   cnt, w, nbp, single=True))[:5000]
+        _np.testing.assert_array_equal(a, b)
+        _np.testing.assert_array_equal(a, rnd)
+
+    def test_device_read_with_pallas_env(self, monkeypatch):
+        """Full device read with TPQ_PALLAS=interpret (the interpreter
+        path for CPU test runs; TPQ_PALLAS=1 compiles for real on TPU
+        and is ignored on other backends)."""
+        import io as _io
+
+        import numpy as _np
+
+        monkeypatch.setenv("TPQ_PALLAS", "interpret")
+        from tpuparquet import FileReader, FileWriter
+        from tpuparquet.kernels.device import read_row_group_device
+
+        buf = _io.BytesIO()
+        w = FileWriter(buf, "message m { required int64 a; "
+                            "optional int32 b; }")
+        rng = _np.random.default_rng(3)
+        rows = [{"a": int(rng.integers(0, 50)),
+                 **({} if i % 6 == 0 else {"b": int(rng.integers(0, 9))})}
+                for i in range(4000)]
+        for row in rows:
+            w.add_data(row)
+        w.close()
+        buf.seek(0)
+        r = FileReader(buf)
+        cpu = r.read_row_group_arrays(0)
+        dev = read_row_group_device(r, 0)
+        for path, cd in cpu.items():
+            vals, rep, dl = dev[path].to_numpy()
+            _np.testing.assert_array_equal(
+                _np.asarray(vals), _np.asarray(cd.values))
+            _np.testing.assert_array_equal(dl, cd.def_levels)

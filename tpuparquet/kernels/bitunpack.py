@@ -130,6 +130,8 @@ def unpack_u32_pallas(words: jax.Array, width: int, count: int,
 
     if width == 0:
         return jnp.zeros((count,), dtype=jnp.uint32)
+    if not interpret and jax.default_backend() != "tpu":
+        interpret = True  # Mosaic only compiles for TPU
     n_blocks = words.shape[0]
     rows = min(block_rows, max(n_blocks, 1))
     grid = (pl.cdiv(n_blocks, rows),)

@@ -117,72 +117,93 @@ def _expand_tbl(bp, table, cnt: int, w: int, nbp: int):
     )
 
 
-@functools.partial(jax.jit, static_argnames=("cnt", "w", "nbp"))
-def expand_tbl(bp, table, cnt: int, w: int, nbp: int):
+def pallas_expand_enabled() -> bool:
+    """Opt-in (TPQ_PALLAS=1): route single-run unpacks in the fused page
+    kernels through the Pallas kernel instead of the XLA formulation.
+
+    Both are bit-exact and compile on TPU; measured end-to-end on the
+    NYC-Taxi bench the two were within noise (the workload is
+    host-dispatch-bound), so XLA is the default and the Pallas kernel
+    stays selectable for device-compute-bound workloads.  The opt-in is
+    honored on TPU backends only (Mosaic compiles for TPU; elsewhere the
+    interpreter would silently crawl) — except TPQ_PALLAS=interpret,
+    which forces the interpreter for testing.  Resolved on HOST at op
+    build time and passed as a static jit arg, so flipping the env var
+    mid-process takes effect (trace-time reads would freeze into the jit
+    cache)."""
+    import os
+
+    env = os.environ.get("TPQ_PALLAS")
+    if env == "interpret":
+        return True
+    if env in ("1", "true", "on"):
+        try:
+            return jax.default_backend() == "tpu"
+        except Exception:  # pragma: no cover
+            return False
+    return False
+
+
+def _expand_stream(bp, table, cnt: int, w: int, nbp: int, single: bool,
+                   use_pallas: bool = False):
+    """Stream expansion with a static fast path: a single bit-packed run
+    (what our encoder and most writers emit for levels and dict indices)
+    needs no run search at all — it is a pure tiled bit-unpack, which
+    can run as the Pallas VPU kernel (SURVEY.md §2.8 "Pallas hybrid
+    RLE/BP decode kernel"; ``bitunpack.unpack_u32_pallas``).  ``single``
+    and ``use_pallas`` are decided on host and are part of the jit key."""
+    if single and w:
+        from .bitunpack import unpack_u32, unpack_u32_pallas
+
+        if use_pallas:
+            return unpack_u32_pallas(bp, w, cnt)
+        return unpack_u32(bp, w, cnt)
     return _expand_tbl(bp, table, cnt, w, nbp)
 
 
+@functools.partial(jax.jit, static_argnames=("cnt", "w", "nbp",
+                                             "single", "use_pallas"))
+def expand_tbl(bp, table, cnt: int, w: int, nbp: int,
+               single: bool = False, use_pallas: bool = False):
+    return _expand_stream(bp, table, cnt, w, nbp, single, use_pallas)
+
+
 @functools.partial(jax.jit, static_argnames=(
-    "dcnt", "dw", "dnbp", "icnt", "iw", "inbp"))
+    "dcnt", "dw", "dnbp", "icnt", "iw", "inbp", "dsingle", "isingle",
+    "use_pallas"))
 def page_dict_fixed_levels_tbl(dictionary, d_bp, d_tbl, i_bp, i_tbl,
                                dcnt: int, dw: int, dnbp: int,
-                               icnt: int, iw: int, inbp: int):
-    """Packed-table variant of :func:`page_dict_fixed_levels`."""
-    dl = _expand_tbl(d_bp, d_tbl, dcnt, dw, dnbp).astype(jnp.int32)
-    idx = _expand_tbl(i_bp, i_tbl, icnt, iw, inbp).astype(jnp.int32)
+                               icnt: int, iw: int, inbp: int,
+                               dsingle: bool = False,
+                               isingle: bool = False,
+                               use_pallas: bool = False):
+    """Fused dict-page decode from packed run tables (one dispatch)."""
+    dl = _expand_stream(d_bp, d_tbl, dcnt, dw, dnbp,
+                        dsingle, use_pallas).astype(jnp.int32)
+    idx = _expand_stream(i_bp, i_tbl, icnt, iw, inbp,
+                         isingle, use_pallas).astype(jnp.int32)
     vals = dictionary[jnp.minimum(idx, dictionary.shape[0] - 1)]
     return vals, dl
 
 
-@functools.partial(jax.jit, static_argnames=("icnt", "iw", "inbp"))
+@functools.partial(jax.jit, static_argnames=("icnt", "iw", "inbp",
+                                             "isingle", "use_pallas"))
 def page_dict_fixed_tbl(dictionary, i_bp, i_tbl,
-                        icnt: int, iw: int, inbp: int):
-    idx = _expand_tbl(i_bp, i_tbl, icnt, iw, inbp).astype(jnp.int32)
+                        icnt: int, iw: int, inbp: int,
+                        isingle: bool = False, use_pallas: bool = False):
+    idx = _expand_stream(i_bp, i_tbl, icnt, iw, inbp,
+                         isingle, use_pallas).astype(jnp.int32)
     return dictionary[jnp.minimum(idx, dictionary.shape[0] - 1)]
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "count", "lanes", "dcnt", "dw", "dnbp"))
+    "count", "lanes", "dcnt", "dw", "dnbp", "dsingle", "use_pallas"))
 def page_plain_fixed_levels_tbl(words, d_bp, d_tbl, count: int, lanes: int,
-                                dcnt: int, dw: int, dnbp: int):
-    dl = _expand_tbl(d_bp, d_tbl, dcnt, dw, dnbp).astype(jnp.int32)
-    return words[: count * lanes].reshape(count, lanes), dl
-
-
-@functools.partial(jax.jit,
-                   static_argnames=("icnt", "iw", "inbp"))
-def page_dict_fixed(dictionary, i_bp, i_ends, i_rle, i_val, i_start,
-                    icnt: int, iw: int, inbp: int):
-    """Dict page decode, no def levels: index expand + gather."""
-    idx = _expand_core(i_bp, i_ends, i_rle, i_val, i_start, icnt, iw,
-                       inbp).astype(jnp.int32)
-    return dictionary[jnp.minimum(idx, dictionary.shape[0] - 1)]
-
-
-@functools.partial(jax.jit, static_argnames=(
-    "dcnt", "dw", "dnbp", "icnt", "iw", "inbp"))
-def page_dict_fixed_levels(dictionary,
-                           d_bp, d_ends, d_rle, d_val, d_start,
-                           i_bp, i_ends, i_rle, i_val, i_start,
-                           dcnt: int, dw: int, dnbp: int,
-                           icnt: int, iw: int, inbp: int):
-    """Dict page decode fused with def-level expand: one dispatch."""
-    dl = _expand_core(d_bp, d_ends, d_rle, d_val, d_start, dcnt, dw,
-                      dnbp).astype(jnp.int32)
-    idx = _expand_core(i_bp, i_ends, i_rle, i_val, i_start, icnt, iw,
-                       inbp).astype(jnp.int32)
-    vals = dictionary[jnp.minimum(idx, dictionary.shape[0] - 1)]
-    return vals, dl
-
-
-@functools.partial(jax.jit, static_argnames=(
-    "count", "lanes", "dcnt", "dw", "dnbp"))
-def page_plain_fixed_levels(words, d_bp, d_ends, d_rle, d_val, d_start,
-                            count: int, lanes: int,
-                            dcnt: int, dw: int, dnbp: int):
-    """PLAIN fixed-width page staging fused with def-level expand."""
-    dl = _expand_core(d_bp, d_ends, d_rle, d_val, d_start, dcnt, dw,
-                      dnbp).astype(jnp.int32)
+                                dcnt: int, dw: int, dnbp: int,
+                                dsingle: bool = False,
+                                use_pallas: bool = False):
+    dl = _expand_stream(d_bp, d_tbl, dcnt, dw, dnbp,
+                        dsingle, use_pallas).astype(jnp.int32)
     return words[: count * lanes].reshape(count, lanes), dl
 
 

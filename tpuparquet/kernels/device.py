@@ -45,6 +45,7 @@ from .decode import (
     dict_gather_fixed,
     expand_delta_i32,
     levels_to_validity,
+    pallas_expand_enabled,
     plain_fixed_to_lanes,
     plan_delta_i32,
     stage_u32,
@@ -391,14 +392,15 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
         # Def-level plan, padded for the fused page kernels.  A page
         # whose value path can't fuse expands it standalone via
         # _defer_levels below.
-        dl_ref = None  # (handles, cnt, nbp) when fusable
+        dl_ref = None  # (handles, cnt, nbp, single) when fusable
         if dl_scan is not None:
-            from .hybrid import pack_plan, plan_from_scan
+            from .hybrid import pack_plan, plan_from_scan, single_bp_scan
 
             dl_args, dl_cnt, _, dl_nbp = pack_plan(
                 plan_from_scan(dl_scan, n, dwidth)
             )
-            dl_ref = (stager.add_many(dl_args), dl_cnt, dl_nbp)
+            dl_ref = (stager.add_many(dl_args), dl_cnt, dl_nbp,
+                      single_bp_scan(dl_scan))
         elif dl_host is not None:
             hh = stager.add(np.asarray(dl_host, dtype=np.int32))
             ops.append(lambda s, p, _h=hh, _n=n:
@@ -409,11 +411,13 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
             if dl_ref is not None:
                 from .decode import expand_tbl
 
-                hs, cnt, nbp = dl_ref
+                hs, cnt, nbp = dl_ref[:3]
 
-                def op(s, p, _hs=hs, _cnt=cnt, _nbp=nbp, _n=n):
+                def op(s, p, _hs=hs, _cnt=cnt, _nbp=nbp, _n=n,
+                       _sg=dl_ref[3], _upl=pallas_expand_enabled()):
                     dl_dev = expand_tbl(
-                        s[_hs[0]], s[_hs[1]], _cnt, dwidth, _nbp
+                        s[_hs[0]], s[_hs[1]], _cnt, dwidth, _nbp,
+                        single=_sg, use_pallas=_upl,
                     ).astype(jnp.int32)
                     p["def"].append((dl_dev, _n))
 
@@ -425,6 +429,8 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 from ..cpu.hybrid import scan_hybrid
                 from .hybrid import pack_plan as _pp, plan_from_scan as _pf
 
+                from .hybrid import single_bp_scan
+
                 i_sc = scan_hybrid(values_seg, non_null, width, pos=1) \
                     if width else None
                 idx_ref = None
@@ -432,17 +438,21 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                     idx_args, i_cnt, _, i_nbp = _pp(
                         _pf(i_sc, non_null, width)
                     )
-                    idx_ref = (stager.add_many(idx_args), i_cnt, i_nbp)
+                    idx_ref = (stager.add_many(idx_args), i_cnt, i_nbp,
+                               single_bp_scan(i_sc))
                 if dl_ref is not None and idx_ref is not None:
                     from .decode import page_dict_fixed_levels_tbl
 
                     def op(s, p, _d=dl_ref, _i=idx_ref, _n=n,
-                           _nn=non_null, _w=width, _dh=dict_fixed_h):
+                           _nn=non_null, _w=width, _dh=dict_fixed_h,
+                           _upl=pallas_expand_enabled()):
                         vals, dl_dev = page_dict_fixed_levels_tbl(
                             s[_dh],
                             s[_d[0][0]], s[_d[0][1]],
                             s[_i[0][0]], s[_i[0][1]],
                             _d[1], dwidth, _d[2], _i[1], _w, _i[2],
+                            dsingle=_d[3], isingle=_i[3],
+                            use_pallas=_upl,
                         )
                         p["def"].append((dl_dev, _n))
                         p["val"].append((vals, _nn))
@@ -462,10 +472,12 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                         from .decode import page_dict_fixed_tbl
 
                         def op(s, p, _i=idx_ref, _nn=non_null, _w=width,
-                               _dh=dict_fixed_h):
+                               _dh=dict_fixed_h,
+                               _upl=pallas_expand_enabled()):
                             vals = page_dict_fixed_tbl(
                                 s[_dh], s[_i[0][0]], s[_i[0][1]],
-                                _i[1], _w, _i[2],
+                                _i[1], _w, _i[2], isingle=_i[3],
+                                use_pallas=_upl,
                             )
                             p["val"].append((vals, _nn))
 
@@ -495,18 +507,23 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 # cache keys on buckets, not exact per-page counts
                 cap = bucket(max(total_b, 1))
                 if i_sc is not None:
+                    from .hybrid import single_bp_scan
+
                     i_args, i_cnt, _, i_nbp = _pp(_pf(i_sc, non_null,
                                                       width))
                     idx_hs = stager.add_many(i_args)
+                    i_single = single_bp_scan(i_sc)
                 else:
                     idx_hs = None
                     i_cnt = bucket(max(non_null, 1))
+                    i_single = False
                 offs_pad = np.full(i_cnt + 1, total_b, dtype=np.int32)
                 offs_pad[: non_null + 1] = out_offsets
                 offs_h = stager.add(offs_pad)
 
                 def op(s, p, _ih=idx_hs, _icnt=i_cnt,
                        _inbp=(i_nbp if width else 0), _w=width,
+                       _isg=i_single, _upl=pallas_expand_enabled(),
                        _oh=offs_h, _cap=cap, _oo=out_offsets,
                        _tb=total_b, _doh=dict_offsets_h,
                        _ddh=dict_data_h):
@@ -516,7 +533,8 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                         from .decode import expand_tbl
 
                         idx_pad = expand_tbl(
-                            s[_ih[0]], s[_ih[1]], _icnt, _w, _inbp
+                            s[_ih[0]], s[_ih[1]], _icnt, _w, _inbp,
+                            single=_isg, use_pallas=_upl,
                         ).astype(jnp.int32)
                     data = dict_gather_bytes(
                         s[_doh], s[_ddh], idx_pad, s[_oh], _cap
@@ -545,10 +563,11 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 wh = stager.add(stage_u32(values_seg, non_null * lanes))
 
                 def op(s, p, _wh=wh, _d=dl_ref, _nn=non_null, _n=n,
-                       _lanes=lanes):
+                       _lanes=lanes, _upl=pallas_expand_enabled()):
                     vals, dl_dev = page_plain_fixed_levels_tbl(
                         s[_wh], s[_d[0][0]], s[_d[0][1]], _nn, _lanes,
-                        _d[1], dwidth, _d[2],
+                        _d[1], dwidth, _d[2], dsingle=_d[3],
+                        use_pallas=_upl,
                     )
                     p["def"].append((dl_dev, _n))
                     p["val"].append((vals, _nn))
@@ -653,12 +672,19 @@ def _defer_levels(ops, stager, kind, scan, host_vals, n, width,
             count_eq_scan(scan, width, max_level, validate_max=True)
         args, cnt, _, nbp = pack_plan(plan_from_scan(scan, n, width))
         hs = stager.add_many(args)
+        from .hybrid import single_bp_scan
 
-        def op(s, p, _hs=hs, _cnt=cnt, _nbp=nbp, _n=n, _w=width):
-            from .decode import expand_tbl
+        sg = single_bp_scan(scan)
 
+        def op(s, p, _hs=hs, _cnt=cnt, _nbp=nbp, _n=n, _w=width, _sg=sg,
+               _upl=None):
+            from .decode import expand_tbl, pallas_expand_enabled
+
+            if _upl is None:
+                _upl = pallas_expand_enabled()
             dev = expand_tbl(
-                s[_hs[0]], s[_hs[1]], _cnt, _w, _nbp
+                s[_hs[0]], s[_hs[1]], _cnt, _w, _nbp, single=_sg,
+                use_pallas=_upl,
             ).astype(jnp.int32)
             p[kind].append((dev, _n))
 

@@ -220,3 +220,11 @@ def decode_hybrid_device_padded(data, count: int, width: int, pos: int = 0):
 def decode_hybrid_device(data, count: int, width: int, pos: int = 0):
     """End-to-end: host plan + device expand (convenience wrapper)."""
     return decode_hybrid_device_padded(data, count, width, pos)[:count]
+
+
+def single_bp_scan(scan) -> bool:
+    """True when a scan is exactly one bit-packed run — expansion then
+    degenerates to a pure tiled bit-unpack (no run search), which the
+    fused kernels run as the Pallas unpack on TPU."""
+    ends, is_rle = scan[0], scan[1]
+    return len(ends) == 1 and not bool(is_rle[0])
