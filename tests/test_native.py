@@ -135,3 +135,66 @@ class TestNativeHybridScan:
         enc = encode_hybrid(vals, 5)
         got = decode_hybrid(enc, len(vals), 5)
         np.testing.assert_array_equal(got.astype(np.uint64), vals)
+
+
+class TestDeviceSnappy:
+    """Device (token-table + pointer-doubling) snappy vs host C oracle."""
+
+    def _nat(self):
+        from tpuparquet.native import snappy_native
+
+        nat = snappy_native()
+        if nat is None:
+            pytest.skip("no C compiler available")
+        return nat
+
+    def cases(self):
+        rng = np.random.default_rng(0)
+        text = b"the quick brown fox jumps over the lazy dog. " * 500
+        return {
+            "random": bytes(rng.integers(0, 256, 10_000, dtype=np.uint8)),
+            "text": text,
+            "rle": b"\xab" * 50_000,           # offset-1 overlap chains
+            "mixed": text + b"\x00" * 10_000 + text[:1000],
+            "tiny": b"xy",
+            "empty": b"",
+        }
+
+    def test_parity_all_cases(self):
+        from tpuparquet.kernels.snappy import decompress_device
+
+        nat = self._nat()
+        for name, data in self.cases().items():
+            block = nat.compress(data)
+            got = np.asarray(decompress_device(block, len(data)))
+            assert got.tobytes() == data, name
+
+    def test_parity_pyarrow_block(self):
+        pa = pytest.importorskip("pyarrow")
+        from tpuparquet.kernels.snappy import decompress_device
+
+        self._nat()
+        data = (b"abcabcabc" * 3000) + bytes(range(256)) * 40
+        block = pa.compress(data, codec="snappy", asbytes=True)
+        got = np.asarray(decompress_device(block))
+        assert got.tobytes() == data
+
+    def test_scan_tokens_shape(self):
+        nat = self._nat()
+        data = b"hello world, hello world, hello world!"
+        tok_end, tok_src, lits, out_len = nat.scan_tokens(nat.compress(data))
+        assert out_len == len(data)
+        assert tok_end[-1] == len(data)
+        assert (np.diff(tok_end) > 0).all()
+        # at least one literal and (for this input) one copy token
+        assert (tok_src < 0).any() and (tok_src >= 0).any()
+
+    def test_corrupt_rejected(self):
+        from tpuparquet.kernels.snappy import decompress_device
+
+        nat = self._nat()
+        good = nat.compress(b"hello world, hello world")
+        with pytest.raises(ValueError):
+            decompress_device(good[:-2])
+        with pytest.raises(ValueError):
+            decompress_device(good, expected_size=5)

@@ -127,7 +127,9 @@ def pallas_expand_enabled() -> bool:
     stays selectable for device-compute-bound workloads.  The opt-in is
     honored on TPU backends only (Mosaic compiles for TPU; elsewhere the
     interpreter would silently crawl) — except TPQ_PALLAS=interpret,
-    which forces the interpreter for testing.  Resolved on HOST at op
+    which forces the interpreter on any backend (returned as the string
+    "interpret", threaded through to ``pallas_call``).  Resolved on HOST
+    at op
     build time and passed as a static jit arg, so flipping the env var
     mid-process takes effect (trace-time reads would freeze into the jit
     cache)."""
@@ -135,7 +137,7 @@ def pallas_expand_enabled() -> bool:
 
     env = os.environ.get("TPQ_PALLAS")
     if env == "interpret":
-        return True
+        return "interpret"
     if env in ("1", "true", "on"):
         try:
             return jax.default_backend() == "tpu"
@@ -156,7 +158,8 @@ def _expand_stream(bp, table, cnt: int, w: int, nbp: int, single: bool,
         from .bitunpack import unpack_u32, unpack_u32_pallas
 
         if use_pallas:
-            return unpack_u32_pallas(bp, w, cnt)
+            return unpack_u32_pallas(
+                bp, w, cnt, interpret=(use_pallas == "interpret"))
         return unpack_u32(bp, w, cnt)
     return _expand_tbl(bp, table, cnt, w, nbp)
 

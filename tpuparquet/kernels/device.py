@@ -427,9 +427,11 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
             width = values_seg[0] if len(values_seg) else 0
             if dict_fixed_h is not None:
                 from ..cpu.hybrid import scan_hybrid
-                from .hybrid import pack_plan as _pp, plan_from_scan as _pf
-
-                from .hybrid import single_bp_scan
+                from .hybrid import (
+                    pack_plan as _pp,
+                    plan_from_scan as _pf,
+                    single_bp_scan,
+                )
 
                 i_sc = scan_hybrid(values_seg, non_null, width, pos=1) \
                     if width else None
@@ -507,8 +509,6 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 # cache keys on buckets, not exact per-page counts
                 cap = bucket(max(total_b, 1))
                 if i_sc is not None:
-                    from .hybrid import single_bp_scan
-
                     i_args, i_cnt, _, i_nbp = _pp(_pf(i_sc, non_null,
                                                       width))
                     idx_hs = stager.add_many(i_args)
@@ -677,11 +677,9 @@ def _defer_levels(ops, stager, kind, scan, host_vals, n, width,
         sg = single_bp_scan(scan)
 
         def op(s, p, _hs=hs, _cnt=cnt, _nbp=nbp, _n=n, _w=width, _sg=sg,
-               _upl=None):
-            from .decode import expand_tbl, pallas_expand_enabled
+               _upl=pallas_expand_enabled()):
+            from .decode import expand_tbl
 
-            if _upl is None:
-                _upl = pallas_expand_enabled()
             dev = expand_tbl(
                 s[_hs[0]], s[_hs[1]], _cnt, _w, _nbp, single=_sg,
                 use_pallas=_upl,

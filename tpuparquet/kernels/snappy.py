@@ -1,0 +1,99 @@
+"""Device snappy block decompression (SURVEY.md §2.8: "Pallas Snappy
+block decompressor" slot; §7 "hard parts" — byte-granular LZ copies).
+
+Two-pass design: the host C scanner (``native/snappy.c
+tpq_snappy_scan_tokens``) parses the tag stream into a token table plus
+the concatenated literal bytes — O(#tokens) host work, no output
+materialization — and the device resolves copies in parallel:
+
+1. token lookup: each output byte finds its token via ``searchsorted``
+   over cumulative token ends;
+2. source map: literal bytes point (negatively) into the literal
+   buffer, copy bytes point at a strictly-earlier output position
+   (``i - offset``), so overlapping/RLE copies form chains;
+3. pointer doubling: ``log2(n)`` rounds of ``m = m[m]`` shrink every
+   chain to its literal root — data-independent trip count, pure
+   gathers, XLA-friendly;
+4. one final gather from the literal buffer.
+
+Transfers ship only tokens + literals (<= compressed size), not the
+decompressed output.  The architectural caveat: pages whose *planning*
+happens on host (levels/dict-index run scans) still need host-side
+bytes, so this kernel serves fully-device paths (PLAIN value segments)
+and standalone device decompression; the codec registry keeps the C
+host path as default.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .decode import bucket
+
+__all__ = ["snappy_scan_tokens", "decompress_device", "expand_tokens"]
+
+
+def snappy_scan_tokens(block: bytes):
+    """Host pass 1: (tok_out_end, tok_src, literals, out_len)."""
+    from ..native import snappy_native
+
+    nat = snappy_native()
+    if nat is None:
+        raise RuntimeError("native scanner unavailable (no C compiler)")
+    return nat.scan_tokens(bytes(block))
+
+
+@functools.partial(jax.jit, static_argnames=("out_cap", "steps"))
+def expand_tokens(tok_end, tok_src, lits, out_cap: int, steps: int):
+    """Device pass 2: resolve the copy graph; returns (out_cap,) u8
+    (caller slices to the real length).  int32 throughout — parquet
+    pages are far below 2 GiB."""
+    i = jnp.arange(out_cap, dtype=jnp.int32)
+    t = jnp.searchsorted(tok_end, i, side="right")
+    t = jnp.minimum(t, tok_end.shape[0] - 1)
+    start = jnp.where(t > 0, tok_end[t - 1], 0)
+    within = i - start
+    src = tok_src[t]
+    # m[i]: immediate source — negative = -(literal index)-1 (resolved),
+    # >= 0 = earlier output position (unresolved copy link)
+    m = jnp.where(src < 0, src - within, src + within)
+
+    def round_(_, mm):
+        nxt = mm[jnp.clip(mm, 0, out_cap - 1)]
+        return jnp.where(mm >= 0, nxt, mm)
+
+    m = jax.lax.fori_loop(0, steps, round_, m)
+    lit_idx = jnp.clip(-(m + 1), 0, lits.shape[0] - 1)
+    return lits[lit_idx]
+
+
+def decompress_device(block: bytes, expected_size: int | None = None):
+    """Decompress one snappy block to a device-resident u8 array."""
+    tok_end, tok_src, lits, out_len = snappy_scan_tokens(block)
+    if expected_size is not None and out_len != expected_size:
+        raise ValueError(
+            f"snappy: header size {out_len} != expected {expected_size}"
+        )
+    if out_len == 0:
+        return jnp.zeros((0,), dtype=jnp.uint8)
+    out_cap = bucket(out_len)
+    if out_cap >= 1 << 31:  # int32 token table would wrap
+        raise ValueError("device snappy: block too large for int32 path")
+    # pad the token table so positions >= out_len resolve to literal 0
+    T = bucket(len(tok_end))
+    te = np.full(T, out_cap, dtype=np.int32)
+    te[: len(tok_end)] = tok_end
+    ts = np.full(T, -1, dtype=np.int32)
+    ts[: len(tok_src)] = tok_src
+    lp = np.zeros(bucket(max(len(lits), 1)), dtype=np.uint8)
+    lp[: len(lits)] = lits
+    # chains shorten by >= 1 output position per unresolved hop, and
+    # every hop at least doubles resolved coverage: ceil(log2(n)) rounds
+    steps = max(int(np.ceil(np.log2(max(out_len, 2)))), 1)
+    staged = jax.device_put((te, ts, lp))
+    out = expand_tokens(*staged, out_cap, steps)
+    return out[:out_len]

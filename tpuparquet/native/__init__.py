@@ -101,6 +101,42 @@ class NativeSnappy:
             raise ValueError("snappy: bad size header")
         return out.value
 
+    def scan_tokens(self, block: bytes):
+        """Parse the tag stream into (tok_out_end, tok_src, literals,
+        out_len) for the device copy-resolution kernel — host cost is
+        O(#tokens + literal bytes), no output materialization."""
+        if not hasattr(self._lib, "tpq_snappy_scan_tokens"):
+            raise RuntimeError("native library too old; rebuild")
+        fn = self._lib.tpq_snappy_scan_tokens
+        if not getattr(fn, "_tpq_bound", False):
+            fn.restype = ctypes.c_int
+            fn.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_size_t),
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+            fn._tpq_bound = True
+        cap_tokens = max(len(block), 1)  # every token needs >= 1 input byte
+        tok_end = np.empty(cap_tokens, dtype=np.int64)
+        tok_src = np.empty(cap_tokens, dtype=np.int64)
+        lits = np.empty(max(len(block), 1), dtype=np.uint8)
+        n_tok = ctypes.c_int64()
+        lit_len = ctypes.c_size_t()
+        out_len = ctypes.c_uint64()
+        rc = fn(block, len(block),
+                tok_end.ctypes.data, tok_src.ctypes.data, cap_tokens,
+                lits.ctypes.data, lits.size,
+                ctypes.byref(n_tok), ctypes.byref(lit_len),
+                ctypes.byref(out_len))
+        if rc != 0:
+            raise ValueError(f"snappy: corrupt block (rc={rc})")
+        t = int(n_tok.value)
+        return (tok_end[:t], tok_src[:t], lits[: lit_len.value],
+                int(out_len.value))
+
     def decompress_np(self, block: bytes,
                       expected_size: int | None = None) -> np.ndarray:
         """Decompress into a numpy buffer (no intermediate copies)."""
