@@ -1,0 +1,82 @@
+"""Decode statistics (SURVEY §5 observability)."""
+
+from __future__ import annotations
+
+import contextlib
+import io
+
+import tpuparquet
+from tpuparquet import CompressionCodec, FileReader, FileWriter, collect_stats
+
+
+def _file(rows=100, groups=2):
+    buf = io.BytesIO()
+    w = FileWriter(buf, "message m { required int64 a; optional binary s; }",
+                   codec=CompressionCodec.SNAPPY)
+    per = rows // groups
+    for g in range(groups):
+        for i in range(per):
+            w.add_data({"a": i, "s": b"x" * (i % 5)})
+        w.flush_row_group()
+    w.close()
+    buf.seek(0)
+    return buf
+
+
+class TestStats:
+    def test_cpu_path_counters(self):
+        r = FileReader(_file())
+        with collect_stats() as st:
+            for rg in range(r.row_group_count()):
+                r.read_row_group_arrays(rg)
+        assert st.row_groups == 2
+        assert st.chunks == 4          # 2 columns x 2 row groups
+        assert st.pages >= 4
+        assert st.values == 200        # 100 rows x 2 columns
+        assert st.bytes_compressed > 0
+        assert st.bytes_uncompressed >= st.bytes_compressed // 2
+        assert st.wall_s > 0
+        assert st.values_per_sec > 0
+        assert "values/s" in st.summary()
+        assert st.as_dict()["values"] == 200
+
+    def test_device_path_counters(self):
+        from tpuparquet.kernels.device import read_row_group_device
+
+        r = FileReader(_file())
+        with collect_stats() as st:
+            for rg in range(r.row_group_count()):
+                read_row_group_device(r, rg)
+        assert st.row_groups == 2
+        assert st.chunks == 4
+        assert st.values == 200
+
+    def test_zero_overhead_when_inactive(self):
+        from tpuparquet.stats import current_stats
+
+        assert current_stats() is None
+        r = FileReader(_file())
+        r.read_row_group_arrays(0)
+        assert current_stats() is None
+
+    def test_nesting_restores_previous(self):
+        with collect_stats() as outer:
+            with collect_stats() as inner:
+                r = FileReader(_file(rows=10, groups=1))
+                r.read_row_group_arrays(0)
+            assert inner.row_groups == 1
+            # outer was shadowed during inner scope
+            assert outer.row_groups == 0
+
+    def test_cli_trace_flag(self, tmp_path, capsys):
+        from tpuparquet.cli import parquet_tool as pt
+
+        p = str(tmp_path / "t.parquet")
+        with open(p, "wb") as f:
+            f.write(_file().getvalue())
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = pt.main(["cat", "--trace", p])
+        assert rc == 0
+        err = capsys.readouterr().err
+        assert "values/s" in err

@@ -24,3 +24,4 @@ from .format import (  # noqa: F401
 from .format.dsl import SchemaDefinition, parse_schema_definition  # noqa: F401
 from .format.schema import Schema  # noqa: F401
 from .io import FileReader, FileWriter  # noqa: F401
+from .stats import DecodeStats, collect_stats, trace  # noqa: F401

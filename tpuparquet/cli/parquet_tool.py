@@ -70,21 +70,29 @@ def _print_row(out, row: dict, indent: str = "") -> None:
 
 def cmd_cat(args, out=None) -> int:
     out = out or sys.stdout
-    return _cat(args.file, -1, out)
+    return _cat(args.file, -1, out, trace=getattr(args, "trace", False))
 
 
 def cmd_head(args, out=None) -> int:
     out = out or sys.stdout
-    return _cat(args.file, args.n, out)
+    return _cat(args.file, args.n, out,
+                trace=getattr(args, "trace", False))
 
 
-def _cat(path: str, n: int, out) -> int:
-    with FileReader(path) as r:
+def _cat(path: str, n: int, out, trace: bool = False) -> int:
+    import contextlib
+
+    from ..stats import collect_stats
+
+    ctx = collect_stats() if trace else contextlib.nullcontext()
+    with ctx as st, FileReader(path) as r:
         for i, row in enumerate(r.rows()):
             if n != -1 and i >= n:
                 break
             _print_row(out, row)
             print(file=out)
+    if trace and st is not None:
+        print(st.summary(), file=sys.stderr)
     return 0
 
 
@@ -206,10 +214,14 @@ def build_parser() -> argparse.ArgumentParser:
     sub = p.add_subparsers(dest="cmd", required=True)
 
     c = sub.add_parser("cat", help="print the parquet file content")
+    c.add_argument("--trace", action="store_true",
+                   help="print decode statistics to stderr")
     c.add_argument("file")
     c.set_defaults(fn=cmd_cat)
 
     h = sub.add_parser("head", help="print the first N records")
+    h.add_argument("--trace", action="store_true",
+                   help="print decode statistics to stderr")
     h.add_argument("-n", type=int, default=5,
                    help="number of records to print")
     h.add_argument("file")

@@ -72,11 +72,19 @@ def read_chunk(blob: bytes, cm: ColumnMetaData, node: SchemaNode) -> ChunkData:
     if end > len(blob) or start < 0:
         raise ValueError("column chunk byte range out of bounds")
 
+    from ..stats import current_stats
+
     r = CompactReader(blob, start, end)
     dictionary = None
     pages: list[DecodedPage] = []
     values_read = 0
     total = cm.num_values
+    st = current_stats()
+    if st is not None:
+        st.chunks += 1
+        st.bytes_compressed += cm.total_compressed_size
+        st.bytes_uncompressed += cm.total_uncompressed_size or 0
+        st.values += total
     while values_read < total:
         if r.pos >= end:
             raise ValueError(
@@ -105,10 +113,14 @@ def read_chunk(blob: bytes, cm: ColumnMetaData, node: SchemaNode) -> ChunkData:
             pg = decode_data_page_v1(ph, payload, codec, node, dictionary)
             values_read += pg.num_values
             pages.append(pg)
+            if st is not None:
+                st.pages += 1
         elif ptype == PageType.DATA_PAGE_V2:
             pg = decode_data_page_v2(ph, payload, codec, node, dictionary)
             values_read += pg.num_values
             pages.append(pg)
+            if st is not None:
+                st.pages += 1
         elif ptype == PageType.INDEX_PAGE:
             continue  # skip (reference ignores index pages)
         else:

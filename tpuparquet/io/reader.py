@@ -90,6 +90,11 @@ class FileReader:
                 f"row group {rg_index} out of range "
                 f"(file has {len(self.meta.row_groups)})"
             )
+        from ..stats import current_stats
+
+        st = current_stats()
+        if st is not None:
+            st.row_groups += 1
         rg = self.meta.row_groups[rg_index]
         out = {}
         for path, node, cm, blob, start in self.iter_selected_chunks(rg):

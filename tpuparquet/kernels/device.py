@@ -263,8 +263,16 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
     ``blob`` holds the chunk's byte range; offsets in ``cm`` are absolute
     minus ``base``.
     """
+    from ..stats import current_stats
+
     codec = CompressionCodec(cm.codec)
     ptype = Type(node.element.type)
+    _st = current_stats()
+    if _st is not None:
+        _st.chunks += 1
+        _st.bytes_compressed += cm.total_compressed_size
+        _st.bytes_uncompressed += cm.total_uncompressed_size or 0
+        _st.values += cm.num_values
     start = cm.data_page_offset
     if cm.dictionary_page_offset is not None:
         start = min(start, cm.dictionary_page_offset)
@@ -366,6 +374,8 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
             enc = h.encoding
         else:
             continue
+        if _st is not None:
+            _st.pages += 1
 
         if not max_def:
             non_null = n
@@ -714,6 +724,11 @@ def read_row_group_device(reader, rg_index: int) -> dict[str, DeviceColumn]:
     page kernels dispatch.  (A thread-pooled plan phase was measured
     slower at realistic page sizes — per-chunk host work is sub-ms and
     pool overhead dominates.)"""
+    from ..stats import current_stats
+
+    _cs = current_stats()
+    if _cs is not None:
+        _cs.row_groups += 1
     rg = reader.meta.row_groups[rg_index]
     st = _Stager()
     planned = []

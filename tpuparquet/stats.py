@@ -1,0 +1,103 @@
+"""Decode statistics and tracing (SURVEY.md §5 "metrics / logging").
+
+The reference exposes introspection only through footer metadata; the
+TPU build adds first-class decode-throughput counters — the BASELINE
+metric (values/sec/chip) as a library feature:
+
+    with tpuparquet.collect_stats() as st:
+        reader.read_row_group_arrays(0)
+    print(st.summary())
+
+Counters are plain Python ints collected only while a collector is
+active (zero overhead otherwise).  ``trace()`` wraps a scope in a JAX
+profiler trace for TensorBoard.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+
+__all__ = ["DecodeStats", "collect_stats", "current_stats", "trace"]
+
+
+@dataclasses.dataclass
+class DecodeStats:
+    """Counters for one collection scope."""
+
+    row_groups: int = 0
+    chunks: int = 0
+    pages: int = 0
+    values: int = 0
+    bytes_compressed: int = 0
+    bytes_uncompressed: int = 0
+    wall_s: float = 0.0
+    _t0: float = dataclasses.field(default=0.0, repr=False)
+
+    @property
+    def values_per_sec(self) -> float:
+        return self.values / self.wall_s if self.wall_s > 0 else 0.0
+
+    @property
+    def compression_ratio(self) -> float:
+        if self.bytes_compressed == 0:
+            return 1.0
+        return self.bytes_uncompressed / self.bytes_compressed
+
+    def as_dict(self) -> dict:
+        return {
+            "row_groups": self.row_groups,
+            "chunks": self.chunks,
+            "pages": self.pages,
+            "values": self.values,
+            "bytes_compressed": self.bytes_compressed,
+            "bytes_uncompressed": self.bytes_uncompressed,
+            "wall_s": round(self.wall_s, 6),
+            "values_per_sec": round(self.values_per_sec, 1),
+            "compression_ratio": round(self.compression_ratio, 3),
+        }
+
+    def summary(self) -> str:
+        d = self.as_dict()
+        return (
+            f"decoded {d['values']:,} values in {d['pages']} pages / "
+            f"{d['chunks']} chunks / {d['row_groups']} row groups; "
+            f"{d['bytes_compressed']:,}B -> {d['bytes_uncompressed']:,}B "
+            f"(x{d['compression_ratio']}); "
+            f"{d['wall_s']:.4f}s = {d['values_per_sec']:,.0f} values/s"
+        )
+
+
+_active: DecodeStats | None = None
+
+
+def current_stats() -> DecodeStats | None:
+    """The active collector, or None (the hot path checks this)."""
+    return _active
+
+
+@contextlib.contextmanager
+def collect_stats():
+    """Collect decode counters for the enclosed scope."""
+    global _active
+    prev = _active
+    st = DecodeStats()
+    st._t0 = time.perf_counter()
+    _active = st
+    try:
+        yield st
+    finally:
+        st.wall_s = time.perf_counter() - st._t0
+        _active = prev
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """JAX profiler trace of the enclosed scope (view in TensorBoard /
+    Perfetto).  Device-side kernel timings come from the profiler; the
+    counters above stay host-side and cheap."""
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
