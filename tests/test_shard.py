@@ -149,3 +149,78 @@ class TestShardedScan:
         with ShardedScan([buf], "b", mesh=mesh) as scan:
             results = scan.run()
         assert set(results[0].keys()) == {"b"}
+
+
+class TestDistributed:
+    """Multi-host driver, exercised single-process (process_count==1) —
+    the same code path a pod runs with jax.distributed initialized."""
+
+    def _files(self, tmp_path, n=3):
+        import numpy as _np
+
+        from tpuparquet import CompressionCodec, FileWriter
+
+        paths = []
+        for f in range(n):
+            p = str(tmp_path / f"f{f}.parquet")
+            with open(p, "wb") as fh:
+                w = FileWriter(fh, "message m { required int64 a; }",
+                               codec=CompressionCodec.SNAPPY)
+                for g in range(2):
+                    for i in range(50):
+                        w.add_data({"a": f * 1000 + g * 100 + i})
+                    w.flush_row_group()
+                w.close()
+            paths.append(p)
+        return paths
+
+    def test_process_units_striding(self):
+        units = [(0, 0), (0, 1), (1, 0), (1, 1), (2, 0)]
+        from tpuparquet.shard import process_units
+
+        a = process_units(units, process_index=0, process_count=2)
+        b = process_units(units, process_index=1, process_count=2)
+        assert a == [(0, 0), (1, 0), (2, 0)]
+        assert b == [(0, 1), (1, 1)]
+        assert sorted(a + b) == units
+
+    def test_multi_host_scan_single_process(self, tmp_path):
+        import numpy as _np
+
+        from tpuparquet.shard import MultiHostScan
+
+        scan = MultiHostScan(self._files(tmp_path))
+        assert len(scan.global_units) == 6
+        assert scan.local_units == scan.global_units  # one process
+        results = scan.run()
+        assert len(results) == 6
+        vals = sorted(
+            int(v)
+            for r, (fi, gi) in zip(results, scan.local_units)
+            for v in _np.asarray(r["a"].to_numpy()[0])
+        )
+        expected = sorted(
+            f * 1000 + g * 100 + i
+            for f in range(3) for g in range(2) for i in range(50)
+        )
+        assert vals == expected
+
+    def test_counts_allgather(self, tmp_path):
+        from tpuparquet.shard import MultiHostScan
+
+        scan = MultiHostScan(self._files(tmp_path))
+        counts = scan.counts_allgather()
+        assert list(counts) == [50] * 6
+
+    def test_allgather_host_identity(self):
+        import numpy as _np
+
+        from tpuparquet.shard import allgather_host
+
+        x = _np.arange(5)
+        _np.testing.assert_array_equal(allgather_host(x), x)
+
+    def test_initialize_noop_single_process(self):
+        from tpuparquet.shard.distributed import initialize
+
+        initialize()  # no cluster config: must not raise

@@ -17,3 +17,8 @@ from .mesh import (  # noqa: F401
     stack_hybrid_plans,
 )
 from .scan import ShardedScan, gather_column, scan_units  # noqa: F401
+from .distributed import (  # noqa: F401
+    MultiHostScan,
+    allgather_host,
+    process_units,
+)
