@@ -243,6 +243,114 @@ class TestDeviceRegressions:
         with _pytest.raises(ValueError):
             count_eq_scan(sc, 2, 2, validate_max=True)
 
+    def test_required_dict_byte_array_device(self):
+        """Required (max_def==0) dict-encoded BYTE_ARRAY on the device
+        path (regression: UnboundLocalError on single_bp_scan)."""
+        import io as _io
+
+        from tpuparquet import FileWriter, FileReader
+        from tpuparquet.kernels.device import read_row_group_device
+
+        buf = _io.BytesIO()
+        w = FileWriter(buf, "message m { required binary s; }")
+        vals = [f"cat_{i % 7}".encode() for i in range(200)]
+        for v in vals:
+            w.add_data({"s": v})
+        w.close()
+        buf.seek(0)
+        col = read_row_group_device(FileReader(buf), 0)["s"]
+        import numpy as _np
+        data = _np.asarray(col.data)
+        offs = _np.asarray(col.offsets)
+        got = [bytes(data[offs[i]:offs[i + 1]]) for i in range(len(vals))]
+        assert got == vals
+
+    def test_required_dict_fixed_device(self):
+        """Required dict-encoded fixed-width column, device path."""
+        import io as _io
+
+        import numpy as _np
+
+        from tpuparquet import FileWriter, FileReader
+        from tpuparquet.kernels.device import read_row_group_device
+
+        buf = _io.BytesIO()
+        w = FileWriter(buf, "message m { required int64 a; }")
+        vals = [(i % 11) * 1000 for i in range(300)]
+        for v in vals:
+            w.add_data({"a": v})
+        w.close()
+        buf.seek(0)
+        col = read_row_group_device(FileReader(buf), 0)["a"]
+        dv, _, _ = col.to_numpy()
+        _np.testing.assert_array_equal(_np.asarray(dv).reshape(-1), vals)
+
+    def test_out_of_range_dict_index_raises(self):
+        """Host-side index validation: indices beyond the dictionary
+        must raise, not silently clamp to the last entry."""
+        import numpy as _np
+        import pytest as _pytest
+
+        from tpuparquet.cpu.hybrid import encode_hybrid, scan_hybrid
+        from tpuparquet.kernels.device import _check_dict_indices
+
+        # width 3 can express 0..7; dictionary has only 5 entries
+        idx = _np.array([0, 1, 4, 7, 2] * 8, dtype=_np.uint64)
+        body = encode_hybrid(idx, 3)
+        sc = scan_hybrid(body, len(idx), 3)
+        with _pytest.raises(ValueError, match="out of range"):
+            _check_dict_indices(sc, 3, len(idx), 5)
+        # same indices are fine for an 8-entry dictionary
+        _check_dict_indices(sc, 3, len(idx), 8)
+        # byte-array path: expanded host indices
+        with _pytest.raises(ValueError, match="out of range"):
+            _check_dict_indices(None, 3, len(idx), 5,
+                                idx_np=idx.astype(_np.int32))
+        # empty dictionary with values present
+        with _pytest.raises(ValueError, match="empty dictionary"):
+            _check_dict_indices(None, 0, 4, 0)
+
+    def test_max_scan_value(self):
+        import numpy as _np
+
+        from tpuparquet.cpu.hybrid import encode_hybrid, scan_hybrid
+        from tpuparquet.kernels.hybrid import max_scan_value
+
+        for data in [
+            _np.array([3, 3, 3, 3, 3, 3, 3, 3, 3], dtype=_np.uint64),
+            _np.arange(40, dtype=_np.uint64) % 7,
+            _np.array([6] * 50 + [2, 5, 1] * 16, dtype=_np.uint64),
+        ]:
+            sc = scan_hybrid(encode_hybrid(data, 3), len(data), 3)
+            assert max_scan_value(sc, 3) == int(data.max())
+
+    def test_device_bitflip_sweep_raises_cleanly(self):
+        """Every single-byte corruption either decodes or raises a clean
+        error (ValueError family / EOFError) — never a raw TypeError /
+        AttributeError from a thrift-optional field arriving as None."""
+        import io as _io
+
+        from tpuparquet import FileWriter, FileReader
+        from tpuparquet.kernels.device import read_row_group_device
+
+        buf = _io.BytesIO()
+        w = FileWriter(buf, "message m { required int64 a; }")
+        for i in range(64):
+            w.add_data({"a": (i % 5) * 100})
+        w.close()
+        raw = bytearray(buf.getvalue())
+        for pos in range(4, len(raw) - 8):
+            m = bytearray(raw)
+            m[pos] ^= 0xFF
+            try:
+                col = read_row_group_device(
+                    FileReader(_io.BytesIO(bytes(m))), 0
+                )["a"]
+                col.block_until_ready()
+            except (ValueError, EOFError, KeyError,
+                    NotImplementedError, OverflowError):
+                pass
+
     def test_byte_array_data_property_full_buffer(self):
         import io as _io
 

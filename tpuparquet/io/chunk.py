@@ -93,6 +93,8 @@ def read_chunk(blob: bytes, cm: ColumnMetaData, node: SchemaNode) -> ChunkData:
         ph = decode_struct(PageHeader, r)
         if ph.compressed_page_size is None or ph.compressed_page_size < 0:
             raise ValueError("page header missing compressed size")
+        if r.pos + ph.compressed_page_size > end:
+            raise ValueError("page payload overruns column chunk")
         payload = bytes(blob[r.pos : r.pos + ph.compressed_page_size])
         if len(payload) != ph.compressed_page_size:
             raise ValueError("page payload truncated")

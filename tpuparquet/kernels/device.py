@@ -222,6 +222,36 @@ def _stage_byte_rows(arr: np.ndarray) -> jax.Array:
     return jnp.asarray(_stage_byte_rows_np(arr))
 
 
+def _check_dict_indices(i_sc, width: int, non_null: int, dict_len: int,
+                        idx_np=None) -> None:
+    """Reject out-of-range dictionary indices host-side.
+
+    The device gather clamps indices (its padding lanes must stay in
+    range), so a corrupt file's oversized index would silently decode to
+    the last dictionary entry; the CPU oracle raises instead.  Precise
+    scan maxing is only needed when the bit width can express an index
+    beyond the dictionary — the writer-aligned case costs nothing."""
+    if non_null == 0:
+        return
+    if dict_len <= 0:
+        raise ValueError("dict-encoded page with empty dictionary")
+    if idx_np is not None:
+        mx = int(idx_np.max()) if idx_np.size else -1
+    elif i_sc is None:
+        mx = 0  # width 0: every index decodes to 0
+    elif (1 << width) <= dict_len:
+        return
+    else:
+        from .hybrid import max_scan_value
+
+        mx = max_scan_value(i_sc, width)
+    if mx >= dict_len:
+        raise ValueError(
+            f"dictionary index {mx} out of range "
+            f"(dictionary has {dict_len} entries)"
+        )
+
+
 class _Stager:
     """Collects host arrays across chunks for one batched transfer.
 
@@ -284,6 +314,7 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
     dict_offsets_h = None  # stager handles: byte-array dictionary
     dict_data_h = None
     dict_lens_np = None
+    dict_len = 0
 
     # Deferred device work: each op is a closure (staged, parts) -> None
     # appended during the host walk and executed by finish() after the
@@ -295,15 +326,32 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
     dwidth = max_def.bit_length()
 
     while values_read < total:
+        if r.pos >= end:
+            raise ValueError(
+                f"column chunk exhausted at {values_read}/{total} values"
+            )
         ph = decode_struct(PageHeader, r)
+        # same malformed-header checks as the CPU path (io/chunk.py,
+        # io/pages.py) — thrift-optional fields may arrive as None
+        if ph.compressed_page_size is None or ph.compressed_page_size < 0:
+            raise ValueError("page header missing compressed size")
+        if r.pos + ph.compressed_page_size > end:
+            raise ValueError("page payload overruns column chunk")
         payload = bytes(blob[r.pos : r.pos + ph.compressed_page_size])
+        if len(payload) != ph.compressed_page_size:
+            raise ValueError("page payload truncated")
         r.pos += ph.compressed_page_size
         ptype_page = PageType(ph.type)
 
         if ptype_page == PageType.DICTIONARY_PAGE:
+            dph = ph.dictionary_page_header
+            if dph is None or dph.num_values is None or dph.num_values < 0:
+                raise ValueError(
+                    "DICTIONARY_PAGE header missing its struct"
+                )
             raw = decompress_block(codec, payload, ph.uncompressed_page_size)
             dict_np = decode_plain(
-                ptype, raw, ph.dictionary_page_header.num_values,
+                ptype, raw, dph.num_values,
                 node.element.type_length,
             )
             if isinstance(dict_np, ByteArrayColumn):
@@ -311,6 +359,7 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                     dict_np.offsets.astype(np.int32))
                 dict_data_h = stager.add(dict_np.data)
                 dict_lens_np = dict_np.lengths()
+                dict_len = len(dict_lens_np)
             else:
                 arr = np.asarray(dict_np)
                 if arr.dtype == np.bool_:
@@ -324,12 +373,15 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 else:  # FLBA (D, L) u8
                     staged = _stage_byte_rows_np(arr)
                 dict_fixed_h = stager.add(staged)
+                dict_len = staged.shape[0]
             if r.pos != cm.data_page_offset - base:
                 r.pos = cm.data_page_offset - base
             continue
 
         if ptype_page == PageType.DATA_PAGE:
             h = ph.data_page_header
+            if h is None or h.num_values is None or h.num_values < 0:
+                raise ValueError("DATA_PAGE header missing data_page_header")
             raw = decompress_block(codec, payload, ph.uncompressed_page_size)
             n = h.num_values
             pos = 0
@@ -350,9 +402,15 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
             from ..cpu.hybrid import scan_hybrid
 
             h = ph.data_page_header_v2
+            if h is None or h.num_values is None or h.num_values < 0:
+                raise ValueError(
+                    "DATA_PAGE_V2 header missing data_page_header_v2"
+                )
             n = h.num_values
             rl_len = h.repetition_levels_byte_length or 0
             dl_len = h.definition_levels_byte_length or 0
+            if rl_len < 0 or dl_len < 0 or rl_len + dl_len > len(payload):
+                raise ValueError("V2 level lengths exceed page size")
             if node.max_rep_level:
                 r_scan = scan_hybrid(
                     payload[:rl_len], n, node.max_rep_level.bit_length()
@@ -445,6 +503,7 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
 
                 i_sc = scan_hybrid(values_seg, non_null, width, pos=1) \
                     if width else None
+                _check_dict_indices(i_sc, width, non_null, dict_len)
                 idx_ref = None
                 if i_sc is not None:
                     idx_args, i_cnt, _, i_nbp = _pp(
@@ -500,17 +559,26 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 # One scan serves both the host expand and the device plan.
                 from ..cpu.hybrid import expand_scan, scan_hybrid
                 from .decode import bucket
-                from .hybrid import pack_plan as _pp, plan_from_scan as _pf
+                from .hybrid import (
+                    pack_plan as _pp,
+                    plan_from_scan as _pf,
+                    single_bp_scan,
+                )
 
                 _def_standalone()
                 if width:
                     i_sc = scan_hybrid(values_seg, non_null, width, pos=1)
-                    idx_np = expand_scan(
-                        *i_sc[:6], non_null, width
-                    ).astype(np.int32)
+                    idx_u = expand_scan(*i_sc[:6], non_null, width)
+                    # validate BEFORE the int32 cast: a width-32 index
+                    # like 0xFFFFFFFF would wrap negative and pass
+                    _check_dict_indices(None, width, non_null, dict_len,
+                                        idx_np=idx_u)
+                    idx_np = idx_u.astype(np.int32)
                 else:
                     i_sc = None
                     idx_np = np.zeros(non_null, np.int32)
+                    _check_dict_indices(None, width, non_null, dict_len,
+                                        idx_np=idx_np)
                 lens = dict_lens_np[idx_np]
                 out_offsets = np.zeros(non_null + 1, dtype=np.int32)
                 np.cumsum(lens, out=out_offsets[1:])

@@ -25,7 +25,8 @@ import numpy as np
 from .bitunpack import pad_to_words, unpack_u32
 
 __all__ = [
-    "plan_hybrid", "plan_from_scan", "count_eq_scan", "pad_plan",
+    "plan_hybrid", "plan_from_scan", "count_eq_scan", "max_scan_value",
+    "pad_plan",
     "expand_hybrid", "expand_hybrid_core", "expand_plan_padded",
     "decode_hybrid_device", "decode_hybrid_device_padded", "HybridPlan",
 ]
@@ -85,6 +86,30 @@ def plan_from_scan(scan, count: int, width: int) -> HybridPlan:
     )
 
 
+def _scan_lanes(scan, width: int):
+    """Per-run output lengths + active bit-packed lanes of a run table.
+
+    Returns ``(lens, live, unpacked, active)``: run output lengths, the
+    live-run mask, and — when bit-packed runs exist — the unpacked lane
+    values with their active (actually-consumed) mask, else ``(None,
+    None)``.  Shared by the host-side validators/counters below."""
+    from ..cpu.bitpack import unpack
+
+    ends, is_rle, values, bp_starts, bp_bytes, n_bp, _ = scan
+    lens = np.diff(ends, prepend=np.int32(0))
+    live = lens > 0
+    unpacked = active = None
+    bp = ~is_rle
+    if bp.any() and n_bp:
+        unpacked = unpack(bp_bytes, n_bp, width)
+        delta = np.zeros(n_bp + 1, dtype=np.int64)
+        starts = bp_starts[bp].astype(np.int64)
+        np.add.at(delta, starts, 1)
+        np.add.at(delta, starts + lens[bp], -1)
+        active = np.cumsum(delta[:-1]) > 0
+    return lens, live, unpacked, active
+
+
 def count_eq_scan(scan, width: int, target: int,
                   validate_max: bool = False) -> int:
     """Count occurrences of ``target`` from a scan's run table without a
@@ -95,27 +120,17 @@ def count_eq_scan(scan, width: int, target: int,
     ``validate_max`` additionally rejects any level above ``target``
     (the level-range check of ``cpu/levels._check``; values above
     max_def would otherwise silently read as null)."""
-    from ..cpu.bitpack import unpack
-
-    ends, is_rle, values, bp_starts, bp_bytes, n_bp, _ = scan
+    ends, is_rle, values = scan[0], scan[1], scan[2]
     if len(ends) == 0:
         return 0
-    lens = np.diff(ends, prepend=np.int32(0))
-    live = lens > 0
+    lens, live, unpacked, active = _scan_lanes(scan, width)
     if validate_max and bool((values[is_rle & live] > target).any()):
         raise ValueError(
             f"level value {int(values[is_rle & live].max())} exceeds "
             f"max level {target}"
         )
     cnt = int(lens[is_rle & (values == target)].sum())
-    bp = ~is_rle
-    if bp.any() and n_bp:
-        unpacked = unpack(bp_bytes, n_bp, width)
-        delta = np.zeros(n_bp + 1, dtype=np.int64)
-        starts = bp_starts[bp].astype(np.int64)
-        np.add.at(delta, starts, 1)
-        np.add.at(delta, starts + lens[bp], -1)
-        active = np.cumsum(delta[:-1]) > 0
+    if unpacked is not None:
         if validate_max and bool((unpacked[active] > target).any()):
             raise ValueError(
                 f"level value {int(unpacked[active].max())} exceeds "
@@ -123,6 +138,26 @@ def count_eq_scan(scan, width: int, target: int,
             )
         cnt += int(((unpacked == target) & active).sum())
     return cnt
+
+
+def max_scan_value(scan, width: int) -> int:
+    """Max decoded value across a scan's live runs (RLE fills + active
+    bit-packed lanes), without a device round-trip.  -1 when empty.
+
+    Used to validate dictionary indices host-side: the device gather
+    clamps indices (padding lanes must stay in range), which would turn
+    a corrupt file's out-of-range index into a silent wrong value."""
+    ends, is_rle, values = scan[0], scan[1], scan[2]
+    if len(ends) == 0:
+        return -1
+    _, live, unpacked, active = _scan_lanes(scan, width)
+    mx = -1
+    rle_live = is_rle & live
+    if rle_live.any():
+        mx = int(values[rle_live].max())
+    if unpacked is not None and active.any():
+        mx = max(mx, int(unpacked[active].max()))
+    return mx
 
 
 def expand_hybrid_core(bp_words, run_ends, run_is_rle, run_value,
