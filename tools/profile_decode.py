@@ -1,0 +1,187 @@
+"""Host/device breakdown of the device decode path at scale.
+
+Usage: python tools/profile_decode.py [n_rows] [n_groups]
+
+Builds a NYC-Taxi-shaped file (config 2: snappy + dict) via the columnar
+writer, then times each phase of read_row_group_device separately:
+  plan      - page-header walk, decompress, run-table scans (host)
+  transfer  - the one batched device_put
+  dispatch  - jitted kernel dispatch (host side of finish())
+  execute   - device execution tail (block_until_ready after dispatch)
+Also reports the CPU-oracle time for the same row groups.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_file(n_rows: int, n_groups: int) -> io.BytesIO:
+    from tpuparquet import CompressionCodec, FileWriter
+
+    rng = np.random.default_rng(42)
+    buf = io.BytesIO()
+    w = FileWriter(
+        buf,
+        """message taxi {
+            required int64 pickup_ts;
+            required int32 passenger_count;
+            required int32 rate_code;
+            required int64 trip_distance_mm;
+            optional int32 payment_type;
+        }""",
+        codec=CompressionCodec.SNAPPY,
+    )
+    per = n_rows // n_groups
+    base_ts = 1_700_000_000_000
+    t0 = time.perf_counter()
+    for g in range(n_groups):
+        ts = base_ts + rng.integers(0, 3_600_000, size=per).cumsum()
+        pay_mask = rng.random(per) >= 0.05
+        w.write_columns(
+            {
+                "pickup_ts": ts,
+                "passenger_count": rng.integers(1, 7, size=per,
+                                                dtype=np.int32),
+                "rate_code": rng.integers(1, 6, size=per, dtype=np.int32),
+                "trip_distance_mm": rng.integers(100, 50_000, size=per),
+                "payment_type": rng.integers(
+                    0, 5, size=int(pay_mask.sum()), dtype=np.int32),
+            },
+            masks={"payment_type": pay_mask},
+        )
+    w.close()
+    print(f"write: {time.perf_counter()-t0:.2f}s "
+          f"({len(buf.getvalue())/1e6:.1f} MB)")
+    buf.seek(0)
+    return buf
+
+
+def profile(reader, reps: int = 3):
+    import jax
+
+    from tpuparquet.kernels import device as D
+
+    phases = {"plan": 0.0, "transfer": 0.0, "dispatch": 0.0, "execute": 0.0,
+              "decompress": 0.0, "scan": 0.0}
+
+    # sub-instrument decompress + scans inside plan
+    import tpuparquet.compress as C
+    import tpuparquet.cpu.hybrid as H
+    orig_dec, orig_scan = C.decompress_block_into, H.scan_hybrid
+
+    def timed_dec(*a, **k):
+        t = time.perf_counter()
+        r = orig_dec(*a, **k)
+        phases["decompress"] += time.perf_counter() - t
+        return r
+
+    def timed_scan(*a, **k):
+        t = time.perf_counter()
+        r = orig_scan(*a, **k)
+        phases["scan"] += time.perf_counter() - t
+        return r
+
+    best = None
+    for rep in range(reps):
+        for k in phases:
+            phases[k] = 0.0
+        t_total = time.perf_counter()
+        outs = []
+        for rg_index in range(reader.row_group_count()):
+            rg = reader.meta.row_groups[rg_index]
+            st = D._Stager()
+            planned = []
+            t = time.perf_counter()
+            D.decompress_block_into = C.decompress_block_into = timed_dec
+            D.scan_hybrid = H.scan_hybrid = timed_scan
+            try:
+                import tpuparquet.kernels.device as _d
+                for path, node, cm, blob, start in \
+                        reader.iter_selected_chunks(rg):
+                    planned.append((path, D.plan_chunk_device(
+                        memoryview(blob), cm, node, start, st)))
+            finally:
+                D.decompress_block_into = C.decompress_block_into = orig_dec
+                D.scan_hybrid = H.scan_hybrid = orig_scan
+            phases["plan"] += time.perf_counter() - t
+
+            t = time.perf_counter()
+            staged = st.put()
+            jax.block_until_ready(staged)
+            phases["transfer"] += time.perf_counter() - t
+
+            t = time.perf_counter()
+            out = {p: f(staged) for p, f in planned}
+            phases["dispatch"] += time.perf_counter() - t
+            outs.append(out)
+        t = time.perf_counter()
+        for out in outs:
+            for c in out.values():
+                c.block_until_ready()
+        phases["execute"] += time.perf_counter() - t
+        total = time.perf_counter() - t_total
+        snap = dict(phases, total=total)
+        if best is None or total < best["total"]:
+            best = snap
+    return best
+
+
+def main():
+    n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000_000
+    n_groups = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    from tpuparquet import FileReader
+
+    buf = build_file(n_rows, n_groups)
+    reader = FileReader(buf)
+    n_values = sum(cc.meta_data.num_values
+                   for rg in reader.meta.row_groups for cc in rg.columns)
+    print(f"n_values = {n_values/1e6:.1f}M")
+
+    t0 = time.perf_counter()
+    for rg in range(reader.row_group_count()):
+        reader.read_row_group_arrays(rg)
+    cpu1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for rg in range(reader.row_group_count()):
+        reader.read_row_group_arrays(rg)
+    cpu = min(cpu1, time.perf_counter() - t0)
+    print(f"cpu oracle: {cpu:.3f}s  ({n_values/cpu/1e6:.1f} M vals/s)")
+
+    profile(reader, reps=1)  # warm compile
+    best = profile(reader, reps=3)
+    # end-to-end via the real entry point (arena + per-rg sync included)
+    from tpuparquet.kernels.device import read_row_group_device
+    e2e = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        outs = [read_row_group_device(reader, rg)
+                for rg in range(reader.row_group_count())]
+        for o in outs:
+            for c in o.values():
+                c.block_until_ready()
+        e2e.append(time.perf_counter() - t0)
+    e2e_s = min(e2e)
+    print(f"read_row_group_device e2e: {e2e_s:.3f}s "
+          f"({n_values/e2e_s/1e6:.1f} M vals/s)  vs cpu {cpu/e2e_s:.2f}x")
+    print("device path breakdown (best of 3):")
+    for k in ("plan", "decompress", "scan", "transfer", "dispatch",
+              "execute", "total"):
+        extra = ""
+        if k in ("decompress", "scan"):
+            extra = "   (inside plan)"
+        print(f"  {k:10s} {best[k]*1e3:8.1f} ms{extra}")
+    print(f"device: {best['total']:.3f}s  "
+          f"({n_values/best['total']/1e6:.1f} M vals/s)  "
+          f"vs cpu {cpu/best['total']:.2f}x")
+
+
+if __name__ == "__main__":
+    main()

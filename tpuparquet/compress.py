@@ -95,6 +95,46 @@ def decompress_block(
     return out
 
 
+def decompress_block_into(codec: CompressionCodec, block,
+                          decompressed_size: int, arena):
+    """Device-path decompress: zero input copy and a recycled output
+    slab when the native snappy codec is available; otherwise falls back
+    to :func:`decompress_block`.  Returns a u8 numpy view either way —
+    arena-backed outputs are only valid until ``arena.release_all()``."""
+    import numpy as np
+
+    if decompressed_size is None or decompressed_size < 0:
+        raise CompressionError("missing decompressed size")
+    if codec == CompressionCodec.UNCOMPRESSED:
+        out = np.frombuffer(block, dtype=np.uint8) if not isinstance(
+            block, np.ndarray) else block
+        if out.size != decompressed_size:
+            raise CompressionError(
+                f"decompressed size {out.size} != expected "
+                f"{decompressed_size}"
+            )
+        return out
+    if codec == CompressionCodec.SNAPPY:
+        from .native import snappy_native
+
+        nat = snappy_native()
+        if nat is not None:
+            out = arena.borrow(decompressed_size + 16)
+            try:
+                got = nat.decompress_np(block, decompressed_size, out=out)
+            except ValueError as e:
+                raise CompressionError(str(e)) from None
+            if got.size != decompressed_size:
+                raise CompressionError(
+                    f"decompressed size {got.size} != expected "
+                    f"{decompressed_size}"
+                )
+            return got
+    return np.frombuffer(
+        decompress_block(codec, block, decompressed_size), dtype=np.uint8
+    )
+
+
 # --------------------------------------------------------------------------
 # Built-in codecs
 # --------------------------------------------------------------------------

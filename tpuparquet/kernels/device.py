@@ -25,8 +25,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..compress import decompress_block
+from ..compress import decompress_block, decompress_block_into
 from ..cpu import decode_plain
+from .arena import HostArena, thread_arena
 from ..cpu.plain import ByteArrayColumn
 from ..format.compact import CompactReader
 from ..format.metadata import (
@@ -148,8 +149,8 @@ class DeviceColumn:
             lambda: jnp.zeros((self.num_values,), dtype=jnp.int32))
 
     def block_until_ready(self):
-        for x in (self._data_p, self.offsets, self._mask_p, self._rep_p,
-                  self._def_p):
+        for x in (self._data_p, self.offsets, self._mask_p, self._pos_p,
+                  self._rep_p, self._def_p):
             if x is not None:
                 x.block_until_ready()
         return self
@@ -252,24 +253,69 @@ def _check_dict_indices(i_sc, width: int, non_null: int, dict_len: int,
         )
 
 
+def _extend_view(arr: np.ndarray, rows: int):
+    """Zero-copy extension of a 1-D view to ``rows`` entries by reading
+    further into its base allocation (an arena slab the caller owns
+    whole); None when the base lacks capacity or isn't extendable.  The
+    extra entries are garbage — valid only as kernel padding that every
+    consumer slices off before use."""
+    if arr.ndim != 1 or not arr.flags["C_CONTIGUOUS"]:
+        return None
+    base = arr
+    while isinstance(base.base, np.ndarray):
+        base = base.base
+    if base.base is not None or base is arr:
+        return None  # rooted in foreign memory (bytes/mmap) or no view
+    if not base.flags["C_CONTIGUOUS"] or base.ndim != 1:
+        return None
+    off = arr.ctypes.data - base.ctypes.data
+    need = off + rows * arr.itemsize
+    if off < 0 or need > base.nbytes:
+        return None
+    start = off // base.itemsize
+    if off % base.itemsize:
+        return None
+    return base[start : start + (rows * arr.itemsize) // base.itemsize] \
+        .view(arr.dtype)
+
+
 class _Stager:
     """Collects host arrays across chunks for one batched transfer.
 
     Every ``jax.device_put`` call costs ~0.5 ms of fixed host overhead on
-    a remote-attached TPU; staging a whole row group's plan tables and
-    page words through one call amortizes it."""
+    a remote-attached TPU — and the axon tunnel additionally compiles a
+    transfer program per distinct (shape, dtype) at ~65-80 ms a piece.
+    So staging (a) batches a whole row group into one call and (b)
+    bucket-pads every array's leading dimension to a power of two, so
+    the universe of staged shapes is small and the per-shape cost
+    amortizes away.  Padding is zero-copy for arena-backed views
+    (``_extend_view``); consumers slice to logical sizes on device."""
 
     __slots__ = ("arrays",)
 
     def __init__(self):
         self.arrays = []
 
-    def add(self, arr) -> int:
-        self.arrays.append(np.ascontiguousarray(arr))
+    def add(self, arr, pad: bool = True) -> int:
+        a = arr if isinstance(arr, np.ndarray) else np.asarray(arr)
+        if pad and a.ndim >= 1:
+            from .decode import bucket
+
+            n = a.shape[0]
+            b = bucket(max(n, 1))
+            if b != n:
+                ext = _extend_view(a, b) if a.ndim == 1 else None
+                if ext is not None:
+                    a = ext
+                else:
+                    padded = np.zeros((b,) + a.shape[1:], a.dtype)
+                    padded[:n] = a
+                    a = padded
+        self.arrays.append(np.ascontiguousarray(a))
         return len(self.arrays) - 1
 
-    def add_many(self, arrs) -> list[int]:
-        return [self.add(a) for a in arrs]
+    def add_many(self, arrs, pad: bool = True) -> list[int]:
+        return [self.add(a, pad=pad) for a in arrs]
 
     def put(self):
         return jax.device_put(self.arrays) if self.arrays else []
@@ -279,13 +325,18 @@ def decode_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                         base: int = 0) -> DeviceColumn:
     """Decode one column chunk to a DeviceColumn (standalone wrapper; the
     row-group path batches staging across chunks)."""
+    arena = thread_arena()
     st = _Stager()
-    finish = plan_chunk_device(blob, cm, node, base, st)
-    return finish(st.put())
+    finish = plan_chunk_device(blob, cm, node, base, st, arena)
+    col = finish(jax.block_until_ready(st.put()))
+    col.block_until_ready()  # transfers from arena slabs must complete
+    arena.release_all()
+    return col
 
 
 def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
-                      base: int, stager: _Stager):
+                      base: int, stager: _Stager,
+                      arena: HostArena | None = None):
     """Phase 1 (host): page-header walk, block decompression, run-table
     scans, staging-plan registration.  Returns ``finish(staged)`` which
     issues the fused device dispatches and assembles the DeviceColumn.
@@ -295,6 +346,8 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
     """
     from ..stats import current_stats
 
+    if arena is None:
+        arena = HostArena()  # throwaway: no recycling, plain lifetime
     codec = CompressionCodec(cm.codec)
     ptype = Type(node.element.type)
     _st = current_stats()
@@ -335,10 +388,16 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
         # io/pages.py) — thrift-optional fields may arrive as None
         if ph.compressed_page_size is None or ph.compressed_page_size < 0:
             raise ValueError("page header missing compressed size")
+        if ph.uncompressed_page_size is None or ph.uncompressed_page_size < 0:
+            raise ValueError("page header missing uncompressed size")
         if r.pos + ph.compressed_page_size > end:
             raise ValueError("page payload overruns column chunk")
-        payload = bytes(blob[r.pos : r.pos + ph.compressed_page_size])
-        if len(payload) != ph.compressed_page_size:
+        # zero-copy view of the compressed bytes (the decompressors take
+        # any buffer; a bytes() here would copy every page)
+        payload = np.frombuffer(
+            blob[r.pos : r.pos + ph.compressed_page_size], dtype=np.uint8
+        )
+        if payload.size != ph.compressed_page_size:
             raise ValueError("page payload truncated")
         r.pos += ph.compressed_page_size
         ptype_page = PageType(ph.type)
@@ -349,7 +408,8 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 raise ValueError(
                     "DICTIONARY_PAGE header missing its struct"
                 )
-            raw = decompress_block(codec, payload, ph.uncompressed_page_size)
+            raw = decompress_block_into(codec, payload,
+                                        ph.uncompressed_page_size, arena)
             dict_np = decode_plain(
                 ptype, raw, dph.num_values,
                 node.element.type_length,
@@ -382,7 +442,8 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
             h = ph.data_page_header
             if h is None or h.num_values is None or h.num_values < 0:
                 raise ValueError("DATA_PAGE header missing data_page_header")
-            raw = decompress_block(codec, payload, ph.uncompressed_page_size)
+            raw = decompress_block_into(codec, payload,
+                                        ph.uncompressed_page_size, arena)
             n = h.num_values
             pos = 0
             if node.max_rep_level:
@@ -425,9 +486,9 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 )
             values_seg = payload[rl_len + dl_len :]
             if h.is_compressed is not False:
-                values_seg = decompress_block(
+                values_seg = decompress_block_into(
                     codec, values_seg,
-                    ph.uncompressed_page_size - rl_len - dl_len,
+                    ph.uncompressed_page_size - rl_len - dl_len, arena,
                 )
             enc = h.encoding
         else:
@@ -467,7 +528,7 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
             dl_args, dl_cnt, _, dl_nbp = pack_plan(
                 plan_from_scan(dl_scan, n, dwidth)
             )
-            dl_ref = (stager.add_many(dl_args), dl_cnt, dl_nbp,
+            dl_ref = (stager.add_many(dl_args, pad=False), dl_cnt, dl_nbp,
                       single_bp_scan(dl_scan))
         elif dl_host is not None:
             hh = stager.add(np.asarray(dl_host, dtype=np.int32))
@@ -492,7 +553,7 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 ops.append(op)
 
         if enc in _DICT_ENCODINGS:
-            width = values_seg[0] if len(values_seg) else 0
+            width = int(values_seg[0]) if len(values_seg) else 0
             if dict_fixed_h is not None:
                 from ..cpu.hybrid import scan_hybrid
                 from .hybrid import (
@@ -509,7 +570,7 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                     idx_args, i_cnt, _, i_nbp = _pp(
                         _pf(i_sc, non_null, width)
                     )
-                    idx_ref = (stager.add_many(idx_args), i_cnt, i_nbp,
+                    idx_ref = (stager.add_many(idx_args, pad=False), i_cnt, i_nbp,
                                single_bp_scan(i_sc))
                 if dl_ref is not None and idx_ref is not None:
                     from .decode import page_dict_fixed_levels_tbl
@@ -589,7 +650,7 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 if i_sc is not None:
                     i_args, i_cnt, _, i_nbp = _pp(_pf(i_sc, non_null,
                                                       width))
-                    idx_hs = stager.add_many(i_args)
+                    idx_hs = stager.add_many(i_args, pad=False)
                     i_single = single_bp_scan(i_sc)
                 else:
                     idx_hs = None
@@ -597,7 +658,7 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                     i_single = False
                 offs_pad = np.full(i_cnt + 1, total_b, dtype=np.int32)
                 offs_pad[: non_null + 1] = out_offsets
-                offs_h = stager.add(offs_pad)
+                offs_h = stager.add(offs_pad, pad=False)
 
                 def op(s, p, _ih=idx_hs, _icnt=i_cnt,
                        _inbp=(i_nbp if width else 0), _w=width,
@@ -651,11 +712,24 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                     p["val"].append((vals, _nn))
 
                 ops.append(op)
+            elif ptype in _LANES:
+                # zero-copy u32 view of the decompressed values rides the
+                # one batched transfer; 'decode' is a device reshape
+                _def_standalone()
+                lanes = _LANES[ptype]
+                wh = stager.add(stage_u32(values_seg, non_null * lanes))
+                ops.append(
+                    lambda s, p, _wh=wh, _nn=non_null, _lanes=lanes:
+                    p["val"].append(
+                        (plain_fixed_to_lanes(s[_wh], _nn, _lanes), _nn)
+                    )
+                )
             else:
                 _def_standalone()
-                seg = bytes(values_seg)
+                # values_seg stays a zero-copy view (arena lifetime runs
+                # until the caller's release, after transfers complete)
                 ops.append(
-                    lambda s, p, _seg=seg, _nn=non_null:
+                    lambda s, p, _seg=values_seg, _nn=non_null:
                     p["val"].append((
                         _stage_fixed_plain(_seg, _nn, ptype,
                                            node.element.type_length),
@@ -749,7 +823,7 @@ def _defer_levels(ops, stager, kind, scan, host_vals, n, width,
         if max_level is not None:
             count_eq_scan(scan, width, max_level, validate_max=True)
         args, cnt, _, nbp = pack_plan(plan_from_scan(scan, n, width))
-        hs = stager.add_many(args)
+        hs = stager.add_many(args, pad=False)
         from .hybrid import single_bp_scan
 
         sg = single_bp_scan(scan)
@@ -798,14 +872,88 @@ def read_row_group_device(reader, rg_index: int) -> dict[str, DeviceColumn]:
     if _cs is not None:
         _cs.row_groups += 1
     rg = reader.meta.row_groups[rg_index]
+    arena = _acquire_arena()
     st = _Stager()
     planned = []
     for path, node, cm, blob, start in reader.iter_selected_chunks(rg):
         planned.append(
-            (path, plan_chunk_device(memoryview(blob), cm, node, start, st))
+            (path,
+             plan_chunk_device(memoryview(blob), cm, node, start, st,
+                               arena))
         )
     staged = st.put()
-    return {path: finish(staged) for path, finish in planned}
+    out = {path: finish(staged) for path, finish in planned}
+    # Arena slabs back staged arrays (zero-copy views); they must not be
+    # recycled until the device owns the data.  Retire the arena behind
+    # fences instead of blocking here so planning of the next row group
+    # overlaps these transfers.
+    fences = list(staged)
+    for c in out.values():
+        for x in (c._data_p, c.offsets, c._mask_p, c._pos_p, c._rep_p,
+                  c._def_p):
+            if x is not None:
+                fences.append(x)
+    _retire_arena(arena, fences)
+    return out
+
+
+# -- arena recycling across row groups ---------------------------------
+# A small pool of arenas cycles through (in use) -> (pending: transfers
+# may still be in flight) -> (free).  _MAX_PENDING bounds host memory:
+# above it the oldest generation is blocked on and reclaimed.
+
+_MAX_PENDING = 2
+
+
+class _ArenaPool:
+    __slots__ = ("free", "pending")
+
+    def __init__(self):
+        self.free = []
+        self.pending = []  # (arena, fence arrays)
+
+
+def _arena_pool() -> _ArenaPool:
+    import threading
+    pool = getattr(_arena_tls, "pool", None)
+    if pool is None:
+        pool = _arena_tls.pool = _ArenaPool()
+    return pool
+
+
+import threading as _threading  # noqa: E402
+
+_arena_tls = _threading.local()
+
+
+def _fences_ready(fences) -> bool:
+    for f in fences:
+        ready = getattr(f, "is_ready", None)
+        if ready is None or not ready():
+            return False
+    return True
+
+
+def _acquire_arena() -> HostArena:
+    pool = _arena_pool()
+    still = []
+    for arena, fences in pool.pending:
+        if _fences_ready(fences):
+            arena.release_all()
+            pool.free.append(arena)
+        else:
+            still.append((arena, fences))
+    pool.pending = still
+    if len(pool.pending) >= _MAX_PENDING:
+        arena, fences = pool.pending.pop(0)
+        jax.block_until_ready(fences)
+        arena.release_all()
+        pool.free.append(arena)
+    return pool.free.pop() if pool.free else HostArena()
+
+
+def _retire_arena(arena: HostArena, fences) -> None:
+    _arena_pool().pending.append((arena, fences))
 
 
 def decode_values_cpu(ptype, enc, data, count, type_length):

@@ -86,35 +86,44 @@ def plan_from_scan(scan, count: int, width: int) -> HybridPlan:
     )
 
 
-def _scan_lanes(scan, width: int):
-    """Per-run output lengths + active bit-packed lanes of a run table.
+def _bp_lane_stats(scan, width: int, target: int):
+    """(max value | None, count == target) over a scan's consumed
+    bit-packed lanes.  One native C pass when available; numpy unpack +
+    active-lane mask otherwise.  Lanes in per-run 8-group padding are
+    excluded either way."""
+    ends, is_rle, _, bp_starts, bp_bytes, n_bp, _pos = scan
+    lens = np.diff(ends, prepend=np.int32(0))
+    bp = ~is_rle
+    if not bp.any() or not n_bp:
+        return None, 0
+    from ..native import hybrid_native
 
-    Returns ``(lens, live, unpacked, active)``: run output lengths, the
-    live-run mask, and — when bit-packed runs exist — the unpacked lane
-    values with their active (actually-consumed) mask, else ``(None,
-    None)``.  Shared by the host-side validators/counters below."""
+    nat = hybrid_native()
+    if nat is not None:
+        try:
+            return nat.bp_stats(bp_bytes, width, bp_starts[bp], lens[bp],
+                                target)
+        except RuntimeError:  # stale .so without tpq_bp_stats
+            pass
     from ..cpu.bitpack import unpack
 
-    ends, is_rle, values, bp_starts, bp_bytes, n_bp, _ = scan
-    lens = np.diff(ends, prepend=np.int32(0))
-    live = lens > 0
-    unpacked = active = None
-    bp = ~is_rle
-    if bp.any() and n_bp:
-        unpacked = unpack(bp_bytes, n_bp, width)
-        delta = np.zeros(n_bp + 1, dtype=np.int64)
-        starts = bp_starts[bp].astype(np.int64)
-        np.add.at(delta, starts, 1)
-        np.add.at(delta, starts + lens[bp], -1)
-        active = np.cumsum(delta[:-1]) > 0
-    return lens, live, unpacked, active
+    unpacked = unpack(bp_bytes, n_bp, width)
+    delta = np.zeros(n_bp + 1, dtype=np.int64)
+    starts = bp_starts[bp].astype(np.int64)
+    np.add.at(delta, starts, 1)
+    np.add.at(delta, starts + lens[bp], -1)
+    active = np.cumsum(delta[:-1]) > 0
+    if not active.any():
+        return None, 0
+    return (int(unpacked[active].max()),
+            int(((unpacked == target) & active).sum()))
 
 
 def count_eq_scan(scan, width: int, target: int,
                   validate_max: bool = False) -> int:
     """Count occurrences of ``target`` from a scan's run table without a
     full expand: RLE runs are arithmetic, bit-packed segments get one
-    vectorized unpack.  Used to count non-null values (def == max_def)
+    native C pass.  Used to count non-null values (def == max_def)
     without a device sync or a second decode.
 
     ``validate_max`` additionally rejects any level above ``target``
@@ -123,20 +132,21 @@ def count_eq_scan(scan, width: int, target: int,
     ends, is_rle, values = scan[0], scan[1], scan[2]
     if len(ends) == 0:
         return 0
-    lens, live, unpacked, active = _scan_lanes(scan, width)
+    lens = np.diff(ends, prepend=np.int32(0))
+    live = lens > 0
     if validate_max and bool((values[is_rle & live] > target).any()):
         raise ValueError(
             f"level value {int(values[is_rle & live].max())} exceeds "
             f"max level {target}"
         )
     cnt = int(lens[is_rle & (values == target)].sum())
-    if unpacked is not None:
-        if validate_max and bool((unpacked[active] > target).any()):
+    bp_max, bp_cnt = _bp_lane_stats(scan, width, target)
+    if bp_max is not None:
+        if validate_max and bp_max > target:
             raise ValueError(
-                f"level value {int(unpacked[active].max())} exceeds "
-                f"max level {target}"
+                f"level value {bp_max} exceeds max level {target}"
             )
-        cnt += int(((unpacked == target) & active).sum())
+        cnt += bp_cnt
     return cnt
 
 
@@ -150,13 +160,14 @@ def max_scan_value(scan, width: int) -> int:
     ends, is_rle, values = scan[0], scan[1], scan[2]
     if len(ends) == 0:
         return -1
-    _, live, unpacked, active = _scan_lanes(scan, width)
+    lens = np.diff(ends, prepend=np.int32(0))
     mx = -1
-    rle_live = is_rle & live
+    rle_live = is_rle & (lens > 0)
     if rle_live.any():
         mx = int(values[rle_live].max())
-    if unpacked is not None and active.any():
-        mx = max(mx, int(unpacked[active].max()))
+    bp_max, _ = _bp_lane_stats(scan, width, 0)
+    if bp_max is not None:
+        mx = max(mx, bp_max)
     return mx
 
 

@@ -28,6 +28,13 @@ _lock = threading.Lock()
 _cached: "ctypes.CDLL | None | bool" = False  # False = not tried yet
 
 
+def _as_u8(block) -> np.ndarray:
+    """Zero-copy u8 view of bytes / memoryview / ndarray input."""
+    if isinstance(block, np.ndarray):
+        return np.ascontiguousarray(block.reshape(-1).view(np.uint8))
+    return np.frombuffer(block, dtype=np.uint8)
+
+
 def _build() -> bool:
     """(Re)build the shared library if stale; returns success."""
     try:
@@ -74,8 +81,8 @@ class NativeSnappy:
         self._lib = lib
         lib.tpq_snappy_decompress.restype = ctypes.c_int
         lib.tpq_snappy_decompress.argtypes = [
-            ctypes.c_char_p, ctypes.c_size_t,
-            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_void_p, ctypes.c_size_t,
+            ctypes.c_void_p, ctypes.c_size_t,
             ctypes.POINTER(ctypes.c_size_t),
         ]
         lib.tpq_snappy_compress.restype = ctypes.c_int
@@ -86,16 +93,17 @@ class NativeSnappy:
         ]
         lib.tpq_snappy_uncompressed_length.restype = ctypes.c_int
         lib.tpq_snappy_uncompressed_length.argtypes = [
-            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_void_p, ctypes.c_size_t,
             ctypes.POINTER(ctypes.c_uint64),
         ]
         lib.tpq_snappy_max_compressed_length.restype = ctypes.c_uint64
         lib.tpq_snappy_max_compressed_length.argtypes = [ctypes.c_uint64]
 
-    def uncompressed_length(self, block: bytes) -> int:
+    def uncompressed_length(self, block) -> int:
+        buf = _as_u8(block)
         out = ctypes.c_uint64()
         rc = self._lib.tpq_snappy_uncompressed_length(
-            block, len(block), ctypes.byref(out)
+            buf.ctypes.data, buf.size, ctypes.byref(out)
         )
         if rc != 0:
             raise ValueError("snappy: bad size header")
@@ -137,19 +145,27 @@ class NativeSnappy:
         return (tok_end[:t], tok_src[:t], lits[: lit_len.value],
                 int(out_len.value))
 
-    def decompress_np(self, block: bytes,
-                      expected_size: int | None = None) -> np.ndarray:
-        """Decompress into a numpy buffer (no intermediate copies)."""
-        total = self.uncompressed_length(block)
+    def decompress_np(self, block, expected_size: int | None = None,
+                      out: np.ndarray | None = None) -> np.ndarray:
+        """Decompress into a numpy buffer (no intermediate copies).
+
+        ``out``, when given, must be a u8 array of >= total + 16 bytes
+        (the slack opts into the codec's fixed-width speculative copies);
+        the caller owns its lifetime (arena recycling)."""
+        buf = _as_u8(block)
+        total = self.uncompressed_length(buf)
         if expected_size is not None and total != expected_size:
             raise ValueError(
                 f"snappy: header size {total} != expected {expected_size}"
             )
-        out = np.empty(max(total, 1), dtype=np.uint8)
+        if out is None:
+            out = np.empty(max(total, 1) + 16, dtype=np.uint8)
+        elif out.size < total + 16:
+            raise ValueError("snappy: output buffer too small")
         produced = ctypes.c_size_t()
         rc = self._lib.tpq_snappy_decompress(
-            block, len(block), out.ctypes.data_as(ctypes.c_char_p), total,
-            ctypes.byref(produced),
+            buf.ctypes.data, buf.size, out.ctypes.data,
+            out.size, ctypes.byref(produced),
         )
         if rc != 0:
             raise ValueError(f"snappy: corrupt block (rc={rc})")
@@ -174,6 +190,7 @@ class NativeHybrid:
     """ctypes bindings over the C hybrid RLE/BP run scanner."""
 
     def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
         self._scan = lib.tpq_hybrid_scan
         self._scan.restype = ctypes.c_int
         self._scan.argtypes = [
@@ -185,6 +202,40 @@ class NativeHybrid:
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_size_t), ctypes.POINTER(ctypes.c_size_t),
         ]
+
+    def bp_stats(self, bp_bytes, width: int, starts, lens,
+                 target: int = 0):
+        """(max value | None, count of == target) over the consumed lanes
+        of bit-packed segments — one C pass, no unpack materialization."""
+        fn = getattr(self._lib, "tpq_bp_stats", None)
+        if fn is None:
+            raise RuntimeError("native library too old; rebuild")
+        if not getattr(fn, "_tpq_bound", False):
+            fn.restype = ctypes.c_int
+            fn.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_uint32,
+                ctypes.POINTER(ctypes.c_uint32),
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+            fn._tpq_bound = True
+        bp = np.ascontiguousarray(
+            np.frombuffer(bp_bytes, dtype=np.uint8)
+            if not isinstance(bp_bytes, np.ndarray) else bp_bytes
+        )
+        s = np.ascontiguousarray(starts, dtype=np.int64)
+        ln = np.ascontiguousarray(lens, dtype=np.int64)
+        mx = ctypes.c_uint32()
+        cnt = ctypes.c_int64()
+        rc = fn(bp.ctypes.data_as(ctypes.c_char_p), bp.size, width,
+                s.ctypes.data, ln.ctypes.data, s.size, target,
+                ctypes.byref(mx), ctypes.byref(cnt))
+        if rc == 1:
+            return None, 0
+        if rc != 0:
+            raise ValueError(f"bit-packed segment out of bounds (rc={rc})")
+        return int(mx.value), int(cnt.value)
 
     def scan(self, buf, count: int, width: int, pos: int = 0):
         """Parse run headers; returns (run_ends, run_is_rle, run_value,

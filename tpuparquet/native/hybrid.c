@@ -99,3 +99,76 @@ int tpq_hybrid_scan(const uint8_t *buf, size_t buflen, size_t pos,
   *end_pos = pos;
   return TPQ_OK;
 }
+
+/* Unpack value i (LSB-first within bytes) from a width-bit stream.
+ * Caller guarantees the value's bits lie within bp_len bytes. */
+static inline uint32_t bp_get(const uint8_t *bp, size_t bp_len, int64_t i,
+                              int width, uint32_t vmask) {
+  uint64_t bit = (uint64_t)i * (uint64_t)width;
+  size_t byte = (size_t)(bit >> 3);
+  int shift = (int)(bit & 7);
+  uint64_t w;
+  if (byte + 8 <= bp_len) {
+    memcpy(&w, bp + byte, 8); /* single unaligned load (little-endian) */
+  } else {
+    w = 0;
+    for (size_t k = 0; byte + k < bp_len && k < 8; k++)
+      w |= (uint64_t)bp[byte + k] << (8 * k);
+  }
+  return (uint32_t)(w >> shift) & vmask;
+}
+
+/* Aggregate statistics over the CONSUMED lanes of bit-packed segments:
+ * max value and count of lanes equal to `target`.  Segments are
+ * (start, len) pairs in value positions within the concatenated
+ * bit-packed stream (the run table's bp_start column); per-run
+ * 8-group padding lanes are skipped by construction.  One pass at C
+ * speed replaces a numpy unpack + scatter + cumsum per stream. */
+int tpq_bp_stats(const uint8_t *bp, size_t bp_len, int width,
+                 const int64_t *starts, const int64_t *lens,
+                 int64_t n_segs, uint32_t target,
+                 uint32_t *out_max, int64_t *out_count_eq) {
+  if (width < 0 || width > 32) return TPQ_ERR_WIDTH;
+  uint32_t vmask = width >= 32 ? 0xffffffffu : ((1u << width) - 1u);
+  uint32_t mx = 0;
+  int64_t cnt = 0;
+  int seen = 0;
+  for (int64_t s = 0; s < n_segs; s++) {
+    int64_t start = starts[s], len = lens[s];
+    if (start < 0 || len < 0) return TPQ_ERR_TRUNCATED;
+    if (len == 0) continue;
+    if ((uint64_t)(start + len) * (uint64_t)width > (uint64_t)bp_len * 8)
+      return TPQ_ERR_TRUNCATED;
+    if (width == 0) {
+      seen = 1;
+      cnt += (target == 0) ? len : 0;
+      continue;
+    }
+    if (width == 1) {
+      /* def-level fast path: popcount whole bytes, mask the edges */
+      int64_t i = start, end = start + len;
+      int64_t ones = 0;
+      while (i < end && (i & 7))
+        ones += (bp[i >> 3] >> (i & 7)) & 1, i++;
+      while (i + 8 <= end) {
+        ones += __builtin_popcount(bp[i >> 3]);
+        i += 8;
+      }
+      while (i < end)
+        ones += (bp[i >> 3] >> (i & 7)) & 1, i++;
+      if (ones && 1u > mx) mx = 1u;
+      seen = 1;
+      cnt += (target == 1) ? ones : (target == 0 ? len - ones : 0);
+      continue;
+    }
+    for (int64_t i = start; i < start + len; i++) {
+      uint32_t v = bp_get(bp, bp_len, i, width, vmask);
+      if (v > mx) mx = v;
+      cnt += (v == target);
+    }
+    seen = 1;
+  }
+  *out_max = mx;
+  *out_count_eq = cnt;
+  return seen ? TPQ_OK : 1; /* 1 = no lanes (max undefined) */
+}

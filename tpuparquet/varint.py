@@ -25,7 +25,7 @@ def read_uvarint(buf, pos: int) -> tuple[int, int]:
     while True:
         if pos >= n:
             raise ValueError("truncated uvarint")
-        b = buf[pos]
+        b = int(buf[pos])  # int(): numpy buffers yield uint8 scalars
         pos += 1
         result |= (b & 0x7F) << shift
         if not b & 0x80:
