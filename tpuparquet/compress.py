@@ -35,6 +35,7 @@ __all__ = [
     "snappy_compress",
     "snappy_decompress",
     "snappy_parse_tokens",
+    "snappy_single_literal_view",
     "CompressionError",
 ]
 
@@ -95,16 +96,63 @@ def decompress_block(
     return out
 
 
+def snappy_single_literal_view(block) -> "np.ndarray | None":
+    """Zero-copy view of a snappy block that is one literal token.
+
+    Incompressible pages — PLAIN numeric columns of high-entropy data —
+    compress to ``[uvarint total][literal tag][payload]``; the payload
+    IS the decompressed block, sitting inside the file bytes already.
+    Returns that view, or None when the stream is anything else.  The
+    single-core host this runs on makes the skipped memcpy a first-order
+    win (decompression was ~60% of the device path's plan phase)."""
+    buf = block if isinstance(block, np.ndarray) else np.frombuffer(
+        block, dtype=np.uint8)
+    try:
+        total, pos = read_uvarint(buf, 0)
+    except Exception:
+        return None
+    if pos >= buf.size:
+        return None
+    tag = int(buf[pos])
+    pos += 1
+    if tag & 3:
+        return None  # first token is a copy
+    ln = tag >> 2
+    if ln >= 60:
+        extra = ln - 59
+        if pos + extra > buf.size:
+            return None
+        ln = 0
+        for i in range(extra):
+            ln |= int(buf[pos + i]) << (8 * i)
+        pos += extra
+    ln += 1
+    if ln != total or pos + ln != buf.size:
+        return None  # not a single literal covering the whole block
+    return buf[pos : pos + ln]
+
+
 def decompress_block_into(codec: CompressionCodec, block,
                           decompressed_size: int, arena):
     """Device-path decompress: zero input copy and a recycled output
     slab when the native snappy codec is available; otherwise falls back
     to :func:`decompress_block`.  Returns a u8 numpy view either way —
-    arena-backed outputs are only valid until ``arena.release_all()``."""
+    arena-backed outputs are only valid until ``arena.release_all()``
+    (single-literal snappy blocks come back as views of ``block``
+    itself, valid as long as the caller's buffer)."""
     import numpy as np
 
     if decompressed_size is None or decompressed_size < 0:
         raise CompressionError("missing decompressed size")
+    if codec == CompressionCodec.SNAPPY:
+        view = snappy_single_literal_view(block)
+        if view is not None:
+            if view.size != decompressed_size:
+                raise CompressionError(
+                    f"decompressed size {view.size} != expected "
+                    f"{decompressed_size}"
+                )
+            return view
     if codec == CompressionCodec.UNCOMPRESSED:
         out = np.frombuffer(block, dtype=np.uint8) if not isinstance(
             block, np.ndarray) else block
@@ -280,21 +328,30 @@ def snappy_decompress(block: bytes, expected_size: int | None = None) -> bytes:
 
 
 def _emit_literal(out: bytearray, data, lo: int, hi: int) -> None:
+    # One token per literal stretch, however long (the tag format takes
+    # up to 4 length bytes): an incompressible block then compresses to
+    # exactly [uvarint][tag][payload], which the decode path serves as a
+    # zero-copy view (``snappy_single_literal_view``) — same shape the
+    # native C encoder emits.
     n = hi - lo
-    while n > 0:
-        chunk = min(n, 65536)  # keep extension lengths <= 2 bytes
-        ln = chunk - 1
-        if ln < 60:
-            out.append(ln << 2)
-        elif ln < 256:
-            out.append(60 << 2)
-            out.append(ln)
-        else:
-            out.append(61 << 2)
-            out += ln.to_bytes(2, "little")
-        out += data[lo : lo + chunk]
-        lo += chunk
-        n -= chunk
+    if n <= 0:
+        return
+    ln = n - 1
+    if ln < 60:
+        out.append(ln << 2)
+    elif ln < 256:
+        out.append(60 << 2)
+        out.append(ln)
+    elif ln < 65536:
+        out.append(61 << 2)
+        out += ln.to_bytes(2, "little")
+    elif ln < 1 << 24:
+        out.append(62 << 2)
+        out += ln.to_bytes(3, "little")
+    else:
+        out.append(63 << 2)
+        out += ln.to_bytes(4, "little")
+    out += data[lo:hi]
 
 
 def _emit_copy(out: bytearray, offset: int, ln: int) -> None:

@@ -29,12 +29,18 @@ class FileReader:
     """Reads a seekable binary file object (or a path)."""
 
     def __init__(self, source, *columns: str):
+        import threading
+
         if isinstance(source, (str, bytes)) and not hasattr(source, "read"):
             self._f = open(source, "rb")
             self._owns = True
         else:
             self._f = source
             self._owns = False
+        # seek+read pairs must be atomic: the pipelined device reader
+        # plans row group N+1 on a worker thread while the caller may
+        # still use this reader from the main thread
+        self._io_lock = threading.Lock()
         self.meta: FileMetaData = read_file_metadata(self._f)
         self.schema = Schema.from_elements(self.meta.schema)
         attach_stores(self.schema)
@@ -116,8 +122,10 @@ class FileReader:
             start = cm.data_page_offset
             if cm.dictionary_page_offset is not None:
                 start = min(start, cm.dictionary_page_offset)
-            self._f.seek(start)
-            yield path, node, cm, self._f.read(cm.total_compressed_size), start
+            with self._io_lock:
+                self._f.seek(start)
+                blob = self._f.read(cm.total_compressed_size)
+            yield path, node, cm, blob, start
 
     def pre_load(self) -> None:
         """Eagerly load the next row group (≙ ``PreLoad``)."""

@@ -19,7 +19,7 @@ import threading
 
 import numpy as np
 
-__all__ = ["HostArena", "thread_arena"]
+__all__ = ["HostArena", "thread_arena", "discard_thread_arena"]
 
 
 class HostArena:
@@ -73,3 +73,14 @@ def thread_arena() -> HostArena:
     if a is None:
         a = _local.arena = HostArena()
     return a
+
+
+def discard_thread_arena() -> None:
+    """Drop the calling thread's arena without releasing its slabs.
+
+    The error-path escape hatch: when device transfers sourced from
+    arena-backed views may still be in flight after an exception, the
+    slabs cannot be recycled safely — abandoning the arena lets the
+    transfers finish against memory nothing else will touch (numpy
+    frees it only once JAX's references drop)."""
+    _local.arena = None

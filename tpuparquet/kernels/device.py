@@ -27,7 +27,7 @@ import jax.numpy as jnp
 
 from ..compress import decompress_block, decompress_block_into
 from ..cpu import decode_plain
-from .arena import HostArena, thread_arena
+from .arena import HostArena, discard_thread_arena, thread_arena
 from ..cpu.plain import ByteArrayColumn
 from ..format.compact import CompactReader
 from ..format.metadata import (
@@ -52,7 +52,8 @@ from .decode import (
     stage_u32,
 )
 
-__all__ = ["DeviceColumn", "decode_chunk_device", "read_row_group_device"]
+__all__ = ["DeviceColumn", "decode_chunk_device", "read_row_group_device",
+           "read_row_groups_device"]
 
 _LANES = {
     Type.INT32: 1, Type.FLOAT: 1, Type.INT64: 2, Type.DOUBLE: 2,
@@ -253,72 +254,120 @@ def _check_dict_indices(i_sc, width: int, non_null: int, dict_len: int,
         )
 
 
-def _extend_view(arr: np.ndarray, rows: int):
-    """Zero-copy extension of a 1-D view to ``rows`` entries by reading
-    further into its base allocation (an arena slab the caller owns
-    whole); None when the base lacks capacity or isn't extendable.  The
-    extra entries are garbage — valid only as kernel padding that every
-    consumer slices off before use."""
-    if arr.ndim != 1 or not arr.flags["C_CONTIGUOUS"]:
-        return None
-    base = arr
-    while isinstance(base.base, np.ndarray):
-        base = base.base
-    if base.base is not None or base is arr:
-        return None  # rooted in foreign memory (bytes/mmap) or no view
-    if not base.flags["C_CONTIGUOUS"] or base.ndim != 1:
-        return None
-    off = arr.ctypes.data - base.ctypes.data
-    need = off + rows * arr.itemsize
-    if off < 0 or need > base.nbytes:
-        return None
-    start = off // base.itemsize
-    if off % base.itemsize:
-        return None
-    return base[start : start + (rows * arr.itemsize) // base.itemsize] \
-        .view(arr.dtype)
+# Transfer geometry, measured on the remote-attached TPU tunnel:
+# a single device_put runs ~1.7 GB/s up to ~96 MB and collapses to
+# ~115 MB/s above ~128 MB, while a list of <=16 MB pieces in one call
+# sustains 4-6 GB/s — provided no more than ~128 MB is in flight at
+# once (beyond that the tunnel congests).  So staging splits large
+# arrays into power-of-two-row pieces and ships them in bounded waves,
+# blocking between waves.
+_PIECE_BYTES = 16 << 20   # split unit for large arrays
+_MIN_PIECE_BYTES = 1 << 20  # below this, pieces zero-pad to a bucket
+_WAVE_BYTES = 96 << 20    # max bytes in flight per wave
+
+
+def _split_rows(a: np.ndarray):
+    """Decompose an array into leading-dim pieces with power-of-two row
+    counts (descending), zero-padding only the final piece.  Keeps the
+    universe of transferred shapes small — the tunnel compiles a
+    transfer program per distinct (shape, dtype) at ~65-80 ms each —
+    without bucket-padding whole multi-hundred-MB buffers."""
+    if a.ndim == 0 or a.shape[0] == 0:
+        return [a]
+    from .decode import bucket
+
+    row_bytes = a.itemsize
+    for d in a.shape[1:]:
+        row_bytes *= d
+    max_rows = max(1, 1 << max(0, (_PIECE_BYTES // row_bytes)
+                               .bit_length() - 1))
+    min_rows = max(1, 1 << max(0, (_MIN_PIECE_BYTES // row_bytes)
+                               .bit_length() - 1))
+    # Zero-copy slices with power-of-two row counts: 16 MB pieces, then
+    # descending powers of two down to ~1 MB, then one zero-padded tail
+    # of at most ~1 MB.  Transfer-program shapes stay a small power-of-
+    # two universe, the host copies at most _MIN_PIECE_BYTES per array,
+    # and the reassembled total is deterministic in n (bounded jit keys).
+    n = a.shape[0]
+    pieces = []
+    pos = 0
+    while n - pos >= max_rows:
+        pieces.append(a[pos : pos + max_rows])
+        pos += max_rows
+    left = n - pos
+    while left >= min_rows:
+        p = 1 << (left.bit_length() - 1)
+        pieces.append(a[pos : pos + p])
+        pos += p
+        left -= p
+    if left:
+        b = bucket(left)  # <= min_rows (bucket() floors at 32)
+        tail = np.zeros((b,) + a.shape[1:], a.dtype)
+        tail[:left] = a[pos:]
+        pieces.append(tail)
+    return pieces
 
 
 class _Stager:
-    """Collects host arrays across chunks for one batched transfer.
+    """Collects host arrays across chunks for batched wave transfers.
 
-    Every ``jax.device_put`` call costs ~0.5 ms of fixed host overhead on
-    a remote-attached TPU — and the axon tunnel additionally compiles a
-    transfer program per distinct (shape, dtype) at ~65-80 ms a piece.
-    So staging (a) batches a whole row group into one call and (b)
-    bucket-pads every array's leading dimension to a power of two, so
-    the universe of staged shapes is small and the per-shape cost
-    amortizes away.  Padding is zero-copy for arena-backed views
-    (``_extend_view``); consumers slice to logical sizes on device."""
+    ``put()`` decomposes padded arrays into pieces (``_split_rows``),
+    ships them in waves of at most ``_WAVE_BYTES`` — blocking between
+    waves, which is what keeps the tunnel at full throughput — and
+    reassembles split arrays with a device-side concatenate.  It returns
+    only after every transfer has completed, so host buffers (arena
+    slabs included) are immediately reusable; all padding is zeros.
 
-    __slots__ = ("arrays",)
+    ``pad=False`` arrays ship with their exact shape, unsplit — for
+    buffers whose tail padding would corrupt device semantics (e.g. the
+    monotonic offset arrays fed to searchsorted)."""
+
+    __slots__ = ("arrays", "no_pad")
 
     def __init__(self):
         self.arrays = []
+        self.no_pad = set()
 
     def add(self, arr, pad: bool = True) -> int:
         a = arr if isinstance(arr, np.ndarray) else np.asarray(arr)
-        if pad and a.ndim >= 1:
-            from .decode import bucket
-
-            n = a.shape[0]
-            b = bucket(max(n, 1))
-            if b != n:
-                ext = _extend_view(a, b) if a.ndim == 1 else None
-                if ext is not None:
-                    a = ext
-                else:
-                    padded = np.zeros((b,) + a.shape[1:], a.dtype)
-                    padded[:n] = a
-                    a = padded
         self.arrays.append(np.ascontiguousarray(a))
+        if not pad:
+            self.no_pad.add(len(self.arrays) - 1)
         return len(self.arrays) - 1
 
     def add_many(self, arrs, pad: bool = True) -> list[int]:
         return [self.add(a, pad=pad) for a in arrs]
 
     def put(self):
-        return jax.device_put(self.arrays) if self.arrays else []
+        if not self.arrays:
+            return []
+        pieces, spec = [], []
+        for i, a in enumerate(self.arrays):
+            ps = [a] if i in self.no_pad else _split_rows(a)
+            spec.append((len(pieces), len(ps)))
+            pieces.extend(ps)
+        dev = [None] * len(pieces)
+        prev = None
+        i = 0
+        while i < len(pieces):
+            wave, wave_bytes = [], 0
+            while i < len(pieces) and (
+                not wave or wave_bytes + pieces[i].nbytes <= _WAVE_BYTES
+            ):
+                wave.append(i)
+                wave_bytes += pieces[i].nbytes
+                i += 1
+            if prev is not None:
+                jax.block_until_ready(prev)
+            out = jax.device_put([pieces[j] for j in wave])
+            for j, d in zip(wave, out):
+                dev[j] = d
+            prev = out
+        jax.block_until_ready(prev)
+        return [
+            dev[s] if n == 1 else jnp.concatenate(dev[s : s + n])
+            for s, n in spec
+        ]
 
 
 def decode_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
@@ -326,10 +375,17 @@ def decode_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
     """Decode one column chunk to a DeviceColumn (standalone wrapper; the
     row-group path batches staging across chunks)."""
     arena = thread_arena()
-    st = _Stager()
-    finish = plan_chunk_device(blob, cm, node, base, st, arena)
-    col = finish(jax.block_until_ready(st.put()))
-    col.block_until_ready()  # transfers from arena slabs must complete
+    try:
+        st = _Stager()
+        finish = plan_chunk_device(blob, cm, node, base, st, arena)
+        col = finish(st.put())  # put() blocks until transfers complete
+        # finish() itself stages some paths (CPU fallbacks, delta,
+        # FLBA/boolean) straight from arena-backed views, outside the
+        # stager — those transfers must land before slabs recycle
+        col.block_until_ready()
+    except BaseException:
+        discard_thread_arena()  # in-flight transfers may read the slabs
+        raise
     arena.release_all()
     return col
 
@@ -862,98 +918,108 @@ def read_row_group_device(reader, rg_index: int) -> dict[str, DeviceColumn]:
 
     The device-path sibling of ``FileReader.read_row_group_arrays``: same
     selection semantics, device-resident results.  All chunks' plan
-    tables and page words ship in ONE batched transfer, then the fused
-    page kernels dispatch.  (A thread-pooled plan phase was measured
-    slower at realistic page sizes — per-chunk host work is sub-ms and
-    pool overhead dominates.)"""
+    tables and page words ship in batched wave transfers (``_Stager``),
+    then the fused page kernels dispatch and are drained before
+    returning (async pile-up degrades the remote tunnel — see the
+    comment below).  For multi-row-group reads prefer
+    :func:`read_row_groups_device`, which overlaps row group N+1's host
+    planning with N's transfer on multi-core hosts."""
     from ..stats import current_stats
 
     _cs = current_stats()
     if _cs is not None:
         _cs.row_groups += 1
     rg = reader.meta.row_groups[rg_index]
-    arena = _acquire_arena()
-    st = _Stager()
+    arena = thread_arena()
+    try:
+        st = _Stager()
+        planned = _plan_row_group(reader, rg, st, arena)
+        out = _finish_row_group(planned, st)
+    except BaseException:
+        discard_thread_arena()  # in-flight transfers may read the slabs
+        raise
+    arena.release_all()
+    return out
+
+
+def _plan_row_group(reader, rg, stager: _Stager, arena: HostArena):
+    """Host phase shared by the per-row-group and pipelined readers."""
     planned = []
     for path, node, cm, blob, start in reader.iter_selected_chunks(rg):
         planned.append(
             (path,
-             plan_chunk_device(memoryview(blob), cm, node, start, st,
+             plan_chunk_device(memoryview(blob), cm, node, start, stager,
                                arena))
         )
+    return planned
+
+
+def _finish_row_group(planned, st: _Stager):
     staged = st.put()
     out = {path: finish(staged) for path, finish in planned}
-    # Arena slabs back staged arrays (zero-copy views); they must not be
-    # recycled until the device owns the data.  Retire the arena behind
-    # fences instead of blocking here so planning of the next row group
-    # overlaps these transfers.
-    fences = list(staged)
+    # Drain the dispatched kernels before returning: on the
+    # remote-attached TPU, letting async work pile up degrades every
+    # subsequent transfer ~2x (measured 1.16s vs 0.53s over 8 row
+    # groups at 50M values) — the tunnel serializes badly under a deep
+    # queue.  Compute itself is sub-ms; this costs one sync, and it
+    # also fences the finish()-time transfers sourced from arena slabs.
     for c in out.values():
-        for x in (c._data_p, c.offsets, c._mask_p, c._pos_p, c._rep_p,
-                  c._def_p):
-            if x is not None:
-                fences.append(x)
-    _retire_arena(arena, fences)
+        c.block_until_ready()
     return out
 
 
-# -- arena recycling across row groups ---------------------------------
-# A small pool of arenas cycles through (in use) -> (pending: transfers
-# may still be in flight) -> (free).  _MAX_PENDING bounds host memory:
-# above it the oldest generation is blocked on and reclaimed.
+def read_row_groups_device(reader, rg_indices=None):
+    """Yield ``(rg_index, {path: DeviceColumn})`` for several row groups,
+    overlapping host planning with device transfer.
 
-_MAX_PENDING = 2
+    A single worker thread runs row group N+1's plan phase (file reads,
+    block decompression, run-table scans — all GIL-releasing C/numpy
+    work) while the main thread transfers and dispatches row group N.
+    Two arenas alternate so the planner never writes into slabs the
+    in-flight transfer still reads.  Results are identical to calling
+    :func:`read_row_group_device` per index."""
+    from concurrent.futures import ThreadPoolExecutor
 
+    from ..stats import current_stats
 
-class _ArenaPool:
-    __slots__ = ("free", "pending")
+    if rg_indices is None:
+        rg_indices = range(reader.row_group_count())
+    indices = list(rg_indices)
+    if not indices:
+        return
+    _cs = current_stats()
+    arenas = [HostArena(), HostArena()]
 
-    def __init__(self):
-        self.free = []
-        self.pending = []  # (arena, fence arrays)
+    def plan(rg_index, arena):
+        st = _Stager()
+        planned = _plan_row_group(
+            reader, reader.meta.row_groups[rg_index], st, arena)
+        return planned, st
 
+    ex = ThreadPoolExecutor(max_workers=1)
+    try:
+        futs = {}
 
-def _arena_pool() -> _ArenaPool:
-    import threading
-    pool = getattr(_arena_tls, "pool", None)
-    if pool is None:
-        pool = _arena_tls.pool = _ArenaPool()
-    return pool
+        def submit(k):
+            futs[k] = ex.submit(plan, indices[k], arenas[k % 2])
 
-
-import threading as _threading  # noqa: E402
-
-_arena_tls = _threading.local()
-
-
-def _fences_ready(fences) -> bool:
-    for f in fences:
-        ready = getattr(f, "is_ready", None)
-        if ready is None or not ready():
-            return False
-    return True
-
-
-def _acquire_arena() -> HostArena:
-    pool = _arena_pool()
-    still = []
-    for arena, fences in pool.pending:
-        if _fences_ready(fences):
-            arena.release_all()
-            pool.free.append(arena)
-        else:
-            still.append((arena, fences))
-    pool.pending = still
-    if len(pool.pending) >= _MAX_PENDING:
-        arena, fences = pool.pending.pop(0)
-        jax.block_until_ready(fences)
-        arena.release_all()
-        pool.free.append(arena)
-    return pool.free.pop() if pool.free else HostArena()
-
-
-def _retire_arena(arena: HostArena, fences) -> None:
-    _arena_pool().pending.append((arena, fences))
+        submit(0)
+        if len(indices) > 1:
+            submit(1)
+        for k in range(len(indices)):
+            planned, st = futs.pop(k).result()
+            out = _finish_row_group(planned, st)  # drains; arena free
+            arenas[k % 2].release_all()
+            if k + 2 < len(indices):
+                submit(k + 2)
+            if _cs is not None:
+                _cs.row_groups += 1
+            yield indices[k], out
+    finally:
+        # On error/early close just drop the arenas (never recycle slabs
+        # that in-flight transfers might still read); the worker is
+        # joined so no new borrows can race the interpreter shutdown.
+        ex.shutdown(wait=True)
 
 
 def decode_values_cpu(ptype, enc, data, count, type_length):
