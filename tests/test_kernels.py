@@ -564,3 +564,96 @@ class TestSnappyLiteralView:
             decompress_block_into(
                 CompressionCodec.SNAPPY, blk, 49_999, HostArena()
             )
+
+
+class TestDelta64Device:
+    """Device DELTA_BINARY_PACKED int64 vs the CPU oracle
+    (reference twin: deltabp_decoder.go:89-175, 64-bit variant)."""
+
+    def _roundtrip(self, vals):
+        from tpuparquet.cpu.delta import (
+            decode_delta_binary_packed,
+            encode_delta_binary_packed,
+        )
+        from tpuparquet.kernels.decode import expand_delta_i64, plan_delta_i64
+
+        vals = np.asarray(vals, dtype=np.int64)
+        enc = encode_delta_binary_packed(vals)
+        ref, _ = decode_delta_binary_packed(enc, np.int64)
+        lanes = np.asarray(expand_delta_i64(plan_delta_i64(enc)))
+        got = lanes.reshape(-1).view(np.uint8).view("<i8")
+        np.testing.assert_array_equal(got, ref)
+        np.testing.assert_array_equal(got, vals)
+
+    @pytest.mark.parametrize("n", [0, 1, 2, 100, 128, 129, 1000, 4096])
+    def test_random_small_deltas(self, n):
+        self._roundtrip(
+            1_700_000_000_000 + rng.integers(0, 3_600_000, size=n).cumsum()
+        )
+
+    def test_wide_deltas_above_32_bits(self):
+        # jumps > 2^32 force miniblock widths in the 33..64 range
+        self._roundtrip(rng.integers(-(2**62), 2**62, size=2000))
+
+    def test_extremes_and_wraparound(self):
+        self._roundtrip([
+            np.iinfo(np.int64).min, np.iinfo(np.int64).max, 0, -1, 1,
+            np.iinfo(np.int64).max, np.iinfo(np.int64).min,
+        ] * 40)
+
+    def test_negative_drift(self):
+        self._roundtrip(10**15 - rng.integers(0, 10**9, size=999).cumsum())
+
+    def test_width_groups_mixed(self):
+        # alternate tiny and huge deltas so one stream holds many widths
+        base = np.zeros(1024, dtype=np.int64)
+        base[::2] = rng.integers(0, 3, size=512)
+        base[1::2] = rng.integers(0, 2**50, size=512)
+        self._roundtrip(base.cumsum())
+
+    def test_truncated_width_list_raises(self):
+        from tpuparquet.cpu.delta import encode_delta_binary_packed
+        from tpuparquet.kernels.decode import plan_delta_i64
+
+        enc = encode_delta_binary_packed(
+            np.arange(300, dtype=np.int64) * 7)
+        with pytest.raises(ValueError):
+            plan_delta_i64(enc[: len(enc) - 40])
+
+    def test_file_level_delta_i64_device(self):
+        # BASELINE config 3 shape: delta int64 timestamps, nullable
+        buf = io.BytesIO()
+        w = FileWriter(
+            buf,
+            "message m { optional int64 ts; required int64 seq; }",
+            column_encodings={"ts": Encoding.DELTA_BINARY_PACKED,
+                              "seq": Encoding.DELTA_BINARY_PACKED},
+            allow_dict=False,
+            codec=CompressionCodec.SNAPPY,
+        )
+        t = 1_700_000_000_000_000
+        for i in range(5000):
+            t += int(rng.integers(0, 10**7))
+            w.add_data({"ts": None if i % 13 == 0 else t, "seq": i - 2500})
+        w.close()
+        buf.seek(0)
+        _parity_check(FileReader(buf))
+
+    def test_no_cpu_fallback_for_delta_i64(self, monkeypatch):
+        # the device path must NOT route config-3 pages through the
+        # CPU fallback anymore (third-round VERDICT item)
+        import tpuparquet.kernels.device as D
+
+        def boom(*a, **k):
+            raise AssertionError("CPU fallback used for delta int64")
+
+        monkeypatch.setattr(D, "decode_values_cpu", boom)
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required int64 ts; }",
+                       column_encodings={"ts": Encoding.DELTA_BINARY_PACKED},
+                       allow_dict=False)
+        for i in range(3000):
+            w.add_data({"ts": i * 1_000_003})
+        w.close()
+        buf.seek(0)
+        _parity_check(FileReader(buf))

@@ -29,6 +29,8 @@ __all__ = [
     "dict_gather_bytes",
     "plan_delta_i32",
     "expand_delta_i32",
+    "plan_delta_i64",
+    "expand_delta_i64",
     "bucket",
 ]
 
@@ -247,9 +249,11 @@ class DeltaPlan:
         self.total = total
 
 
-def plan_delta_i32(data, pos: int = 0) -> DeltaPlan:
+def _plan_delta(data, pos: int, max_width: int) -> DeltaPlan:
     """Parse DELTA_BINARY_PACKED headers; group miniblock payloads by bit
-    width so the device unpacks each width class in one static-shape call."""
+    width so the device unpacks each width class in one static-shape
+    call.  Shared by the 32- and 64-bit planners (``max_width`` is the
+    column's physical width — a wider miniblock is malformed)."""
     block_size, pos = read_uvarint(data, pos)
     n_miniblocks, pos = read_uvarint(data, pos)
     if block_size <= 0 or block_size % 128 or n_miniblocks <= 0 \
@@ -270,13 +274,16 @@ def plan_delta_i32(data, pos: int = 0) -> DeltaPlan:
     while got < n_deltas:
         min_delta, pos = read_zigzag(data, pos)
         widths = bytes(data[pos : pos + n_miniblocks])
+        if len(widths) < n_miniblocks:
+            raise ValueError("truncated miniblock width list")
         pos += n_miniblocks
         for w in widths:
             if got >= n_deltas:
                 break
-            if w > 32:
+            if w > max_width:
                 raise ValueError(
-                    f"delta miniblock width {w} > 32 (int64 path is CPU)"
+                    f"delta miniblock width {w} > {max_width} for this "
+                    "column's physical type"
                 )
             nbytes = mb_size * w // 8
             take = min(mb_size, n_deltas - got)
@@ -305,6 +312,10 @@ def plan_delta_i32(data, pos: int = 0) -> DeltaPlan:
     return DeltaPlan(groups, min_deltas, first, total)
 
 
+def plan_delta_i32(data, pos: int = 0) -> DeltaPlan:
+    return _plan_delta(data, pos, 32)
+
+
 def expand_delta_i32(plan: DeltaPlan) -> jax.Array:
     """Device: unpack each width class, scatter into the delta stream, add
     min_delta, prefix-sum (int32 two's-complement wrap)."""
@@ -323,3 +334,60 @@ def expand_delta_i32(plan: DeltaPlan) -> jax.Array:
     md = jnp.asarray((plan.min_deltas & 0xFFFFFFFF).astype(np.uint32))
     full = deltas[:n_deltas] + md  # u32 wraparound == two's complement
     return jnp.concatenate([first[None], first + jnp.cumsum(full)])
+
+
+# ----------------------------------------------------------------------
+# DELTA_BINARY_PACKED (int64) — the 64-bit twin, with every 64-bit
+# quantity carried as (lo, hi) u32 lanes (TPUs have no native int64;
+# the reference instead duplicates its whole decoder per width,
+# deltabp_decoder.go:10-12).
+# ----------------------------------------------------------------------
+
+
+def plan_delta_i64(data, pos: int = 0) -> DeltaPlan:
+    """Parse a 64-bit DELTA_BINARY_PACKED stream (widths 0..64); same
+    width-grouped miniblock layout as :func:`plan_delta_i32`."""
+    return _plan_delta(data, pos, 64)
+
+
+def _add64(a, b):
+    """(lo, hi) u32-lane 64-bit add — associative, carried via the
+    unsigned-wraparound compare."""
+    lo = a[0] + b[0]
+    carry = (lo < b[0]).astype(jnp.uint32)
+    return lo, a[1] + b[1] + carry
+
+
+def expand_delta_i64(plan: DeltaPlan) -> jax.Array:
+    """Device: unpack each width class to (lo, hi) lanes, scatter into
+    the delta stream, add min_delta (64-bit lane add), then an inclusive
+    64-bit prefix sum via ``lax.associative_scan``.  Returns (total, 2)
+    u32 — the (lo, hi) little-endian lane layout of DeviceColumn INT64."""
+    from .bitunpack import unpack_u64
+
+    if plan.total == 0:
+        return jnp.zeros((0, 2), dtype=jnp.uint32)
+    n_deltas = plan.total - 1
+    first_u = plan.first & 0xFFFFFFFFFFFFFFFF
+    first = jnp.asarray(
+        [[np.uint32(first_u & 0xFFFFFFFF), np.uint32(first_u >> 32)]],
+        dtype=jnp.uint32,
+    )
+    if n_deltas == 0:
+        return first
+    dlo = jnp.zeros((n_deltas,), dtype=jnp.uint32)
+    dhi = jnp.zeros((n_deltas,), dtype=jnp.uint32)
+    for w, words, positions, keep, n_vals in plan.groups:
+        lo, hi = unpack_u64(jnp.asarray(words), w, n_vals)
+        p = jnp.asarray(positions)
+        k = jnp.asarray(keep)
+        dlo = dlo.at[p].set(lo[k])
+        dhi = dhi.at[p].set(hi[k])
+    md_u = plan.min_deltas.view(np.uint64)
+    md_lo = jnp.asarray((md_u & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+    md_hi = jnp.asarray((md_u >> np.uint64(32)).astype(np.uint32))
+    flo, fhi = _add64((dlo, dhi), (md_lo, md_hi))
+    slo = jnp.concatenate([first[:, 0], flo])
+    shi = jnp.concatenate([first[:, 1], fhi])
+    lo, hi = jax.lax.associative_scan(_add64, (slo, shi))
+    return jnp.stack([lo, hi], axis=1)

@@ -15,7 +15,7 @@ segment, still staged into the same DeviceColumn):
 * RLE_DICTIONARY indices (run-table expand) + dictionary gather,
   fixed-width and variable-width (byte-level gather)
 * definition/repetition levels (run-table expand) + validity fusion
-* DELTA_BINARY_PACKED int32
+* DELTA_BINARY_PACKED int32 and int64 (two-u32-lane arithmetic)
 """
 
 from __future__ import annotations
@@ -45,10 +45,12 @@ from .decode import (
     dict_gather_bytes,
     dict_gather_fixed,
     expand_delta_i32,
+    expand_delta_i64,
     levels_to_validity,
     pallas_expand_enabled,
     plain_fixed_to_lanes,
     plan_delta_i32,
+    plan_delta_i64,
     stage_u32,
 )
 
@@ -792,15 +794,25 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                         _nn,
                     ))
                 )
-        elif enc == Encoding.DELTA_BINARY_PACKED and ptype == Type.INT32:
+        elif enc == Encoding.DELTA_BINARY_PACKED and ptype in (
+                Type.INT32, Type.INT64):
             _def_standalone()
-            plan = plan_delta_i32(values_seg)
-            ops.append(
-                lambda s, p, _pl=plan, _nn=non_null:
-                p["val"].append(
-                    (expand_delta_i32(_pl)[:_nn, None], _nn)
+            if ptype == Type.INT32:
+                plan = plan_delta_i32(values_seg)
+                ops.append(
+                    lambda s, p, _pl=plan, _nn=non_null:
+                    p["val"].append(
+                        (expand_delta_i32(_pl)[:_nn, None], _nn)
+                    )
                 )
-            )
+            else:
+                plan = plan_delta_i64(values_seg)
+                ops.append(
+                    lambda s, p, _pl=plan, _nn=non_null:
+                    p["val"].append(
+                        (expand_delta_i64(_pl)[:_nn], _nn)
+                    )
+                )
         else:
             # CPU fallback for the remaining encodings; stage the result.
             _def_standalone()
