@@ -224,3 +224,64 @@ class TestDistributed:
         from tpuparquet.shard.distributed import initialize
 
         initialize()  # no cluster config: must not raise
+
+
+class TestGatherByteColumn:
+    def _write_string_file(self, n_rows, n_groups, seed):
+        buf = io.BytesIO()
+        w = FileWriter(
+            buf,
+            "message m { optional binary s (STRING); required int64 a; }",
+            codec=__import__(
+                "tpuparquet"
+            ).CompressionCodec.SNAPPY,
+        )
+        rng = np.random.default_rng(seed)
+        rows = []
+        per = n_rows // n_groups
+        for g in range(n_groups):
+            for i in range(per):
+                s = (None if i % 6 == 0
+                     else f"s{int(rng.integers(0, 37))}" * (i % 3 + 1))
+                rows.append(s)
+                w.add_data({"a": i} if s is None else {"a": i, "s": s})
+            w.flush_row_group()
+        w.close()
+        buf.seek(0)
+        return buf, rows
+
+    def test_gather_strings_across_mesh(self):
+        from tpuparquet.shard import ShardedScan, gather_byte_column
+
+        files, all_rows = [], []
+        for s in range(2):
+            buf, rows = self._write_string_file(240, 2, seed=s)
+            files.append(buf)
+            all_rows.append(rows)
+        mesh = make_mesh(8)
+        with ShardedScan(files, mesh=mesh) as scan:
+            results = scan.run()
+            offs, data, row_counts, _ = gather_byte_column(
+                mesh, results, "s")
+        u = 0
+        for fi in range(2):
+            per = len(all_rows[fi]) // 2
+            for g in range(2):
+                exp = all_rows[fi][g * per : (g + 1) * per]
+                assert row_counts[u] == len(exp)
+                for i, s in enumerate(exp):
+                    lo, hi = int(offs[u, i]), int(offs[u, i + 1])
+                    got = bytes(data[u, lo:hi].tobytes())
+                    want = b"" if s is None else s.encode()
+                    assert got == want, (u, i, got, want)
+                u += 1
+
+    def test_fixed_width_rejected(self):
+        from tpuparquet.shard import ShardedScan, gather_byte_column
+
+        buf, _ = self._write_string_file(60, 1, seed=9)
+        mesh = make_mesh(2, sp=1)
+        with ShardedScan([buf], mesh=mesh) as scan:
+            results = scan.run()
+            with pytest.raises(TypeError, match="fixed-width"):
+                gather_byte_column(mesh, results, "a")

@@ -146,15 +146,27 @@ class FileWriter:
         columns: dict,
         *,
         masks: dict | None = None,
+        offsets: dict | None = None,
+        element_masks: dict | None = None,
         kv_metadata: dict | None = None,
         kv_per_column: dict | None = None,
     ) -> None:
         """Write one row group directly from column arrays.
 
-        Only flat schemas (no repeated/group nesting beyond optional
-        leaves).  ``columns`` maps leaf name -> array/ByteArrayColumn/list
-        of **non-null** values; ``masks`` maps leaf name -> bool validity
-        array (required for optional columns containing nulls).
+        Flat leaves: ``columns`` maps leaf name -> array/ByteArrayColumn/
+        list of **non-null** values; ``masks`` maps leaf name -> bool
+        validity (required for optional columns containing nulls).
+
+        LIST columns (one repeated level on the path, e.g. the standard
+        3-level ``optional group f (LIST) { repeated group list {
+        element } }`` or a bare ``repeated`` leaf): key by the top-level
+        field name, pass the **non-null element** values in ``columns``
+        and the per-row slot ranges in ``offsets`` (int array of
+        ``n_rows+1``).  ``masks[f]`` marks null *rows* (their offset
+        range must be empty); ``element_masks[f]`` marks valid *slots*
+        for optional elements.  Rep/def levels are derived exactly as
+        the row path's shredder would (``io/store.py``; reference
+        semantics ``schema.go:733-778``).
         """
         if self._closed:
             raise ValueError("writer is closed")
@@ -163,54 +175,150 @@ class FileWriter:
         leaves = self.schema.leaves
         n_rows = None
         prepared = []
+        reps = {}
         for leaf in leaves:
-            if len(leaf.path) != 1 or leaf.max_rep_level:
-                raise ValueError(
-                    "write_columns supports flat schemas only; use add_data"
-                )
-            if leaf.name not in columns:
-                raise ValueError(f"missing column {leaf.name!r}")
-            vals = columns[leaf.name]
-            mask = (masks or {}).get(leaf.name)
-            handler = handler_for(leaf.element)
-            if isinstance(vals, list):
-                vals = handler.finalize([handler.coerce_one(v) for v in vals])
-            else:
-                vals = handler.validate_array(vals)
-            if mask is not None and leaf.max_def_level == 0:
-                raise ValueError(
-                    f"column {leaf.name!r} is required; a validity mask "
-                    "is not allowed"
-                )
-            if mask is not None:
-                mask = np.asarray(mask, dtype=bool)
-                rows = len(mask)
-                nn = int(mask.sum())
-                if _column_len(vals) == rows and rows != nn:
+            if leaf.max_rep_level:
+                key = leaf.path[0]
+                if key not in columns:
+                    raise ValueError(f"missing column {key!r}")
+                if offsets is None or key not in offsets:
                     raise ValueError(
-                        f"column {leaf.name!r}: pass only non-null values "
-                        "with a mask (got full-length values)"
+                        f"repeated column {key!r} needs offsets= "
+                        "(row -> element ranges)"
                     )
-                if _column_len(vals) != nn:
-                    raise ValueError(
-                        f"column {leaf.name!r}: {_column_len(vals)} values "
-                        f"vs {nn} valid mask entries"
-                    )
-                dl = mask.astype(np.int32) * leaf.max_def_level
+                vals, rep, dl, rows = self._prepare_repeated(
+                    leaf, columns[key], np.asarray(offsets[key]),
+                    (masks or {}).get(key),
+                    (element_masks or {}).get(key),
+                )
+                reps[leaf.flat_name] = rep
+            elif len(leaf.path) != 1:
+                raise ValueError(
+                    "write_columns supports flat and single-repeated-"
+                    "level columns; use add_data for general nesting"
+                )
             else:
-                rows = _column_len(vals)
-                if leaf.max_def_level:
-                    dl = np.full(rows, leaf.max_def_level, dtype=np.int32)
-                else:
-                    dl = np.zeros(rows, dtype=np.int32)
+                if leaf.name not in columns:
+                    raise ValueError(f"missing column {leaf.name!r}")
+                vals, dl, rows = self._prepare_flat(
+                    leaf, columns[leaf.name], (masks or {}).get(leaf.name)
+                )
             if n_rows is None:
                 n_rows = rows
             elif n_rows != rows:
                 raise ValueError("column row counts differ")
             prepared.append((leaf, vals, dl))
         self._flush_prepared(
-            prepared, n_rows or 0, kv_metadata or {}, kv_per_column or {}
+            prepared, n_rows or 0, kv_metadata or {}, kv_per_column or {},
+            reps=reps or None,
         )
+
+    def _prepare_flat(self, leaf, vals, mask):
+        handler = handler_for(leaf.element)
+        if isinstance(vals, list):
+            vals = handler.finalize([handler.coerce_one(v) for v in vals])
+        else:
+            vals = handler.validate_array(vals)
+        if mask is not None and leaf.max_def_level == 0:
+            raise ValueError(
+                f"column {leaf.name!r} is required; a validity mask "
+                "is not allowed"
+            )
+        if mask is not None:
+            mask = np.asarray(mask, dtype=bool)
+            rows = len(mask)
+            nn = int(mask.sum())
+            if _column_len(vals) == rows and rows != nn:
+                raise ValueError(
+                    f"column {leaf.name!r}: pass only non-null values "
+                    "with a mask (got full-length values)"
+                )
+            if _column_len(vals) != nn:
+                raise ValueError(
+                    f"column {leaf.name!r}: {_column_len(vals)} values "
+                    f"vs {nn} valid mask entries"
+                )
+            dl = mask.astype(np.int32) * leaf.max_def_level
+        else:
+            rows = _column_len(vals)
+            if leaf.max_def_level:
+                dl = np.full(rows, leaf.max_def_level, dtype=np.int32)
+            else:
+                dl = np.zeros(rows, dtype=np.int32)
+        return vals, dl, rows
+
+    def _prepare_repeated(self, leaf, vals, offs, row_mask, elem_mask):
+        """Offsets-based LIST column -> (values, rep, def, n_rows)."""
+        # the nearest repeated ancestor sets the empty/null def levels
+        node = leaf
+        rep_node = None
+        while node is not None:
+            if node.is_repeated:
+                rep_node = node
+            node = node.parent
+        if leaf.max_rep_level != 1 or rep_node is None:
+            raise ValueError(
+                f"column {leaf.flat_name!r}: write_columns supports one "
+                "repeated level; use add_data for deeper nesting"
+            )
+        offs = offs.astype(np.int64, copy=False)
+        if offs.ndim != 1 or offs.size == 0 or (np.diff(offs) < 0).any() \
+                or offs[0] != 0:
+            raise ValueError("offsets must be monotone and start at 0")
+        counts = np.diff(offs)
+        n_rows = counts.size
+        empty_def = rep_node.max_def_level - 1
+        if row_mask is not None:
+            row_mask = np.asarray(row_mask, dtype=bool)
+            if row_mask.size != n_rows:
+                raise ValueError("row mask length != offsets rows")
+            if rep_node.max_def_level < 2:
+                raise ValueError(
+                    f"column {leaf.path[0]!r} has no optional ancestor; "
+                    "a row mask is not allowed"
+                )
+            if (counts[~row_mask] != 0).any():
+                raise ValueError("null rows must have empty offset ranges")
+        # each row occupies max(count, 1) slots (empty/null rows keep a
+        # placeholder slot carrying the low def level)
+        slots = np.maximum(counts, 1)
+        first = np.cumsum(slots) - slots
+        total = int(slots.sum())
+        rep = np.ones(total, dtype=np.int32) * leaf.max_rep_level
+        rep[first] = 0
+        dl = np.full(total, leaf.max_def_level, dtype=np.int32)
+        placeholder = first[counts == 0]
+        dl[placeholder] = empty_def
+        if row_mask is not None:
+            dl[first[~row_mask]] = rep_node.max_def_level - 2
+        if elem_mask is not None:
+            elem_mask = np.asarray(elem_mask, dtype=bool)
+            if elem_mask.size != int(offs[-1]):
+                raise ValueError("element mask length != total elements")
+            if leaf.max_def_level == rep_node.max_def_level:
+                raise ValueError(
+                    f"column {leaf.flat_name!r}: element is required; "
+                    "an element mask is not allowed"
+                )
+            elem_slots = np.ones(total, dtype=bool)
+            elem_slots[placeholder] = False
+            dl_elems = np.where(elem_mask, leaf.max_def_level,
+                                leaf.max_def_level - 1).astype(np.int32)
+            dl[elem_slots] = dl_elems
+            n_vals = int(elem_mask.sum())
+        else:
+            n_vals = int(offs[-1])
+        handler = handler_for(leaf.element)
+        if isinstance(vals, list):
+            vals = handler.finalize([handler.coerce_one(v) for v in vals])
+        else:
+            vals = handler.validate_array(vals)
+        if _column_len(vals) != n_vals:
+            raise ValueError(
+                f"column {leaf.path[0]!r}: {_column_len(vals)} values vs "
+                f"{n_vals} non-null elements"
+            )
+        return vals, rep, dl, n_rows
 
     # -- flush -------------------------------------------------------------
 

@@ -16,7 +16,12 @@ from .mesh import (  # noqa: F401
     sharded_dict_decode,
     stack_hybrid_plans,
 )
-from .scan import ShardedScan, gather_column, scan_units  # noqa: F401
+from .scan import (  # noqa: F401
+    ShardedScan,
+    gather_byte_column,
+    gather_column,
+    scan_units,
+)
 from .distributed import (  # noqa: F401
     MultiHostScan,
     allgather_host,

@@ -19,7 +19,8 @@ from ..io.reader import FileReader
 from ..kernels.decode import scatter_to_dense
 from ..kernels.device import DeviceColumn, read_row_group_device
 
-__all__ = ["ShardedScan", "scan_units", "gather_column"]
+__all__ = ["ShardedScan", "scan_units", "gather_column",
+           "gather_byte_column"]
 
 
 def scan_units(readers: list[FileReader]) -> list[tuple[int, int]]:
@@ -82,7 +83,7 @@ def gather_column(mesh, results: list[dict[str, DeviceColumn]], path: str):
     cols = [r[path] for r in results]
     if any(c.offsets is not None for c in cols):
         raise TypeError("gather_column handles fixed-width columns; "
-                        "BYTE_ARRAY shards stay per-device")
+                        "use gather_byte_column for BYTE_ARRAY")
     dense = [
         scatter_to_dense(
             c.data if c.data.ndim > 1 else c.data[:, None],
@@ -104,3 +105,67 @@ def gather_column(mesh, results: list[dict[str, DeviceColumn]], path: str):
         lambda x: x, out_shardings=NamedSharding(mesh, P())
     )(sharded)
     return np.asarray(gathered)[: len(dense)], counts
+
+
+def gather_byte_column(mesh, results: list[dict[str, DeviceColumn]],
+                       path: str):
+    """All-gather one BYTE_ARRAY column across the mesh.
+
+    Each unit's shard densifies on its own device first: null record
+    slots become zero-length values (their bytes are already absent, so
+    the packed data buffer IS the dense data buffer — only the offsets
+    re-derive), then padded (offsets to Lmax+1 with the byte total,
+    keeping them monotone; data to Bmax with zeros) and stacked into
+    (U, Lmax+1) / (U, Bmax) globals sharded unit-wise over "rg".  One
+    jitted identity with replicated out-sharding lowers to the
+    all-gather over ICI, exactly like :func:`gather_column`.
+
+    Returns ``(offsets (U, Lmax+1) ndarray, data (U, Bmax) u8 ndarray,
+    row_counts, byte_counts)``; row i of unit u spans
+    ``data[u, offsets[u, i]:offsets[u, i+1]]``.
+    """
+    cols = [r[path] for r in results]
+    if any(c.offsets is None for c in cols):
+        raise TypeError("gather_byte_column handles BYTE_ARRAY columns; "
+                        "use gather_column for fixed-width types")
+    dense_offs = []
+    datas = []
+    for c in cols:
+        offs = c.offsets[: c.n_packed + 1]
+        lens = offs[1:] - offs[:-1]
+        if c.num_values == c.n_packed and c._mask_p is None:
+            dl = lens
+        else:
+            dl = jnp.where(c.mask, lens[c.positions],
+                           jnp.zeros((), dtype=lens.dtype))
+        do = jnp.concatenate(
+            [jnp.zeros((1,), dtype=lens.dtype), jnp.cumsum(dl)]
+        )
+        dense_offs.append(do)
+        datas.append(c.data)
+    row_counts = np.asarray([d.shape[0] - 1 for d in dense_offs],
+                            dtype=np.int64)
+    byte_counts = np.asarray([d.shape[0] for d in datas], dtype=np.int64)
+    L = int(row_counts.max()) + 1 if len(cols) else 1
+    B = max(int(byte_counts.max()), 1) if len(cols) else 1
+    n_dev = len(list(mesh.devices.flat))
+    U = max(len(cols), 1)
+    U = ((U + n_dev - 1) // n_dev) * n_dev
+    offs_stack = jnp.zeros((U, L), dtype=dense_offs[0].dtype if cols
+                           else jnp.int32)
+    data_stack = jnp.zeros((U, B), dtype=jnp.uint8)
+    for i, (do, d) in enumerate(zip(dense_offs, datas)):
+        offs_stack = offs_stack.at[i, : do.shape[0]].set(do)
+        if do.shape[0] < L:  # keep padding monotone at the byte total
+            offs_stack = offs_stack.at[i, do.shape[0]:].set(do[-1])
+        if d.shape[0]:
+            data_stack = data_stack.at[i, : d.shape[0]].set(d)
+    spec = NamedSharding(mesh, P("rg"))
+    rep = NamedSharding(mesh, P())
+    o_sh = jax.device_put(offs_stack, spec)
+    d_sh = jax.device_put(data_stack, spec)
+    o_g, d_g = jax.jit(
+        lambda o, d: (o, d), out_shardings=(rep, rep)
+    )(o_sh, d_sh)
+    return (np.asarray(o_g)[: len(cols)], np.asarray(d_g)[: len(cols)],
+            row_counts, byte_counts)
