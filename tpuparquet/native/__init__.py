@@ -91,6 +91,15 @@ class NativeSnappy:
             ctypes.c_char_p, ctypes.c_size_t,
             ctypes.POINTER(ctypes.c_size_t),
         ]
+        self._compress_opt_fn = getattr(lib, "tpq_snappy_compress_opt", None)
+        if self._compress_opt_fn is not None:
+            self._compress_opt_fn.restype = ctypes.c_int
+            self._compress_opt_fn.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_size_t),
+                ctypes.c_int,
+            ]
         lib.tpq_snappy_uncompressed_length.restype = ctypes.c_int
         lib.tpq_snappy_uncompressed_length.argtypes = [
             ctypes.c_void_p, ctypes.c_size_t,
@@ -98,6 +107,19 @@ class NativeSnappy:
         ]
         lib.tpq_snappy_max_compressed_length.restype = ctypes.c_uint64
         lib.tpq_snappy_max_compressed_length.argtypes = [ctypes.c_uint64]
+        # optional symbol (absent in a stale .so): bind once here rather
+        # than per call — ctypes function objects are shared across threads
+        self._scan_tokens_fn = getattr(lib, "tpq_snappy_scan_tokens", None)
+        if self._scan_tokens_fn is not None:
+            self._scan_tokens_fn.restype = ctypes.c_int
+            self._scan_tokens_fn.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_size_t),
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
 
     def uncompressed_length(self, block) -> int:
         buf = _as_u8(block)
@@ -113,20 +135,9 @@ class NativeSnappy:
         """Parse the tag stream into (tok_out_end, tok_src, literals,
         out_len) for the device copy-resolution kernel — host cost is
         O(#tokens + literal bytes), no output materialization."""
-        if not hasattr(self._lib, "tpq_snappy_scan_tokens"):
+        fn = self._scan_tokens_fn
+        if fn is None:
             raise RuntimeError("native library too old; rebuild")
-        fn = self._lib.tpq_snappy_scan_tokens
-        if not getattr(fn, "_tpq_bound", False):
-            fn.restype = ctypes.c_int
-            fn.argtypes = [
-                ctypes.c_char_p, ctypes.c_size_t,
-                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
-                ctypes.c_void_p, ctypes.c_size_t,
-                ctypes.POINTER(ctypes.c_int64),
-                ctypes.POINTER(ctypes.c_size_t),
-                ctypes.POINTER(ctypes.c_uint64),
-            ]
-            fn._tpq_bound = True
         cap_tokens = max(len(block), 1)  # every token needs >= 1 input byte
         tok_end = np.empty(cap_tokens, dtype=np.int64)
         tok_src = np.empty(cap_tokens, dtype=np.int64)
@@ -174,13 +185,18 @@ class NativeSnappy:
     def decompress(self, block: bytes, expected_size: int | None = None):
         return self.decompress_np(block, expected_size).tobytes()
 
-    def compress(self, data: bytes) -> bytes:
+    def compress(self, data: bytes, min_match: int = 8) -> bytes:
         cap = self._lib.tpq_snappy_max_compressed_length(len(data))
         buf = ctypes.create_string_buffer(cap)
         produced = ctypes.c_size_t()
-        rc = self._lib.tpq_snappy_compress(
-            data, len(data), buf, cap, ctypes.byref(produced)
-        )
+        opt = self._compress_opt_fn
+        if opt is not None:
+            rc = opt(data, len(data), buf, cap, ctypes.byref(produced),
+                     min_match)
+        else:  # stale .so without the tunable: fixed min_match = 8
+            rc = self._lib.tpq_snappy_compress(
+                data, len(data), buf, cap, ctypes.byref(produced)
+            )
         if rc != 0:
             raise ValueError(f"snappy: compress failed (rc={rc})")
         return ctypes.string_at(buf, produced.value)
@@ -202,24 +218,26 @@ class NativeHybrid:
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_size_t), ctypes.POINTER(ctypes.c_size_t),
         ]
-
-    def bp_stats(self, bp_bytes, width: int, starts, lens,
-                 target: int = 0):
-        """(max value | None, count of == target) over the consumed lanes
-        of bit-packed segments — one C pass, no unpack materialization."""
-        fn = getattr(self._lib, "tpq_bp_stats", None)
-        if fn is None:
-            raise RuntimeError("native library too old; rebuild")
-        if not getattr(fn, "_tpq_bound", False):
-            fn.restype = ctypes.c_int
-            fn.argtypes = [
+        # optional symbol (absent in a stale .so): bind once here rather
+        # than per call — ctypes function objects are shared across threads
+        self._bp_stats_fn = getattr(lib, "tpq_bp_stats", None)
+        if self._bp_stats_fn is not None:
+            self._bp_stats_fn.restype = ctypes.c_int
+            self._bp_stats_fn.argtypes = [
                 ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
                 ctypes.c_uint32,
                 ctypes.POINTER(ctypes.c_uint32),
                 ctypes.POINTER(ctypes.c_int64),
             ]
-            fn._tpq_bound = True
+
+    def bp_stats(self, bp_bytes, width: int, starts, lens,
+                 target: int = 0):
+        """(max value | None, count of == target) over the consumed lanes
+        of bit-packed segments — one C pass, no unpack materialization."""
+        fn = self._bp_stats_fn
+        if fn is None:
+            raise RuntimeError("native library too old; rebuild")
         bp = np.ascontiguousarray(
             np.frombuffer(bp_bytes, dtype=np.uint8)
             if not isinstance(bp_bytes, np.ndarray) else bp_bytes

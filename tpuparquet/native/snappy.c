@@ -243,10 +243,17 @@ static inline uint32_t hash32(uint32_t v) {
   return (v * 0x1e35a7bdu) >> (32 - HASH_BITS);
 }
 
-int tpq_snappy_compress(const uint8_t *in, size_t n, uint8_t *out,
-                        size_t out_cap, size_t *produced) {
+/* min_match: shortest back-reference worth emitting.  8 is the decode-
+ * throughput sweet spot for numeric column data (short copies decode
+ * token-at-a-time); 4 recovers the ratio on text/byte-array pages whose
+ * redundancy is mostly 4..7-byte matches.  Values < 4 clamp to 4 (the
+ * format's copy minimum). */
+int tpq_snappy_compress_opt(const uint8_t *in, size_t n, uint8_t *out,
+                            size_t out_cap, size_t *produced,
+                            int min_match) {
   if (n > 0xffffffffu) return TPQ_ERR_TOO_BIG; /* hash table + literal
     length encoding hold positions/lengths as uint32 */
+  size_t min_len = min_match < 4 ? 4 : (size_t)min_match;
   if (out_cap < tpq_snappy_max_compressed_length(n)) return TPQ_ERR_BUFFER;
   size_t op = emit_uvarint(out, n);
   if (n < 4) {
@@ -271,11 +278,11 @@ int tpq_snappy_compress(const uint8_t *in, size_t n, uint8_t *out,
       size_t len = 4;
       size_t max = n - pos;
       while (len < max && in[cand + len] == in[pos + len]) len++;
-      /* Emit only matches >= 8 bytes: short copies cost ~as many
-       * compressed bytes as the literal they replace but decode
-       * token-at-a-time; dense 4..7-byte matches (typical for numeric
-       * column data) would cap decompression near 1 GB/s. */
-      if (len < 8) {
+      /* Short copies cost ~as many compressed bytes as the literal
+       * they replace but decode token-at-a-time; dense 4..7-byte
+       * matches (typical for numeric column data) would cap
+       * decompression near 1 GB/s — hence the caller-set floor. */
+      if (len < min_len) {
         size_t step = skip >> 5;
         pos += step;
         skip += (uint32_t)step;
@@ -302,6 +309,11 @@ int tpq_snappy_compress(const uint8_t *in, size_t n, uint8_t *out,
   if (n > lit_start) op += emit_literal(out + op, in + lit_start, n - lit_start);
   *produced = op;
   return TPQ_OK;
+}
+
+int tpq_snappy_compress(const uint8_t *in, size_t n, uint8_t *out,
+                        size_t out_cap, size_t *produced) {
+  return tpq_snappy_compress_opt(in, n, out, out_cap, produced, 8);
 }
 
 /* ------------------------------------------------------------------ */
