@@ -337,6 +337,18 @@ class TestColumnarAPI:
         with pytest.raises(ValueError, match="offsets"):
             w.write_columns({"a": np.arange(3)})
 
+    def test_write_columns_rejects_multi_leaf_repeated_group(self):
+        # keying by the top-level field would write the same array into
+        # every leaf of the group — must be an error, not silent aliasing
+        buf = io.BytesIO()
+        w = FileWriter(
+            buf,
+            "message m { repeated group r "
+            "{ required int64 a; required int64 b; } }")
+        offs = np.array([0, 2, 3])
+        with pytest.raises(ValueError, match="multiple leaves"):
+            w.write_columns({"r": np.arange(3)}, offsets={"r": offs})
+
     def test_write_columns_rejects_deep_nesting(self):
         buf = io.BytesIO()
         w = FileWriter(

@@ -285,3 +285,112 @@ class TestGatherByteColumn:
             results = scan.run()
             with pytest.raises(TypeError, match="fixed-width"):
                 gather_byte_column(mesh, results, "a")
+
+
+def _column_equal(a, b):
+    """Compare two DeviceColumn decodes (values + levels)."""
+    from tpuparquet.cpu.plain import ByteArrayColumn
+
+    av, ar, ad = a.to_numpy()
+    bv, br, bd = b.to_numpy()
+    np.testing.assert_array_equal(ar, br)
+    np.testing.assert_array_equal(ad, bd)
+    if isinstance(av, ByteArrayColumn):
+        assert av == bv
+    else:
+        np.testing.assert_array_equal(av, bv)
+
+
+class TestPipelinedScan:
+    """run() overlaps planning with transfer; results must be identical
+    to a serial read_row_group_device loop (VERDICT round-2 ask #4)."""
+
+    def test_matches_serial_loop(self):
+        from tpuparquet.kernels.device import read_row_group_device
+
+        files = [ _write_file(300, 3, seed=s)[0] for s in range(2) ]
+        mesh = make_mesh(4, sp=1)
+        with ShardedScan(files, mesh=mesh) as scan:
+            results = scan.run()
+            assert len(results) == 6
+            for k, (fi, rgi) in enumerate(scan.units):
+                with jax.default_device(scan.device_for(k)):
+                    ref = read_row_group_device(scan.readers[fi], rgi)
+                assert set(results[k]) == set(ref)
+                for path in ref:
+                    _column_equal(results[k][path], ref[path])
+
+    def test_multi_host_scan_pipelined(self, tmp_path):
+        from tpuparquet.shard import MultiHostScan
+
+        paths = []
+        for s in range(2):
+            buf, _ = _write_file(200, 2, seed=20 + s)
+            p = tmp_path / f"f{s}.parquet"
+            p.write_bytes(buf.getvalue())
+            paths.append(str(p))
+        scan = MultiHostScan(paths)
+        out = scan.run()
+        assert len(out) == len(scan.local_units) == 4
+        for d in out:
+            assert set(d) == {"a", "b"}
+
+
+class TestResumableCursor:
+    """ShardedScan.state() -> kill -> resume must produce the same total
+    output as one uninterrupted scan (SURVEY.md §5 checkpoint/resume)."""
+
+    def test_kill_and_resume_identical(self):
+        files = [ _write_file(300, 3, seed=40 + s)[0] for s in range(2) ]
+        mesh = make_mesh(4, sp=1)
+
+        full = ShardedScan(files, mesh=mesh)
+        expected = full.run()
+        assert len(expected) == 6
+
+        for b in files:
+            b.seek(0)
+        scan1 = ShardedScan(files, mesh=mesh)
+        got = {}
+        it = scan1.run_iter()
+        for _ in range(2):  # decode 2 units, then "crash"
+            k, out = next(it)
+            got[k] = out
+        it.close()
+        cursor = scan1.state()
+        assert cursor["next_unit"] == 2
+
+        # fresh instance (fresh process stand-in) resumes at the cursor
+        for b in files:
+            b.seek(0)
+        scan2 = ShardedScan(files, mesh=mesh, resume=cursor)
+        for k, out in scan2.run_iter():
+            assert k not in got
+            got[k] = out
+        assert sorted(got) == list(range(6))
+        for k in range(6):
+            for path in expected[k]:
+                _column_equal(got[k][path], expected[k][path])
+
+    def test_cursor_roundtrips_json(self):
+        import json
+
+        buf, _ = _write_file(100, 2, seed=50)[0], None
+        scan = ShardedScan([buf], mesh=make_mesh(2, sp=1))
+        cur = json.loads(json.dumps(scan.state()))
+        scan2 = ShardedScan([buf], mesh=make_mesh(2, sp=1), resume=cur)
+        assert scan2.state() == scan.state()
+
+    def test_cursor_mismatch_rejected(self):
+        buf, _ = _write_file(100, 2, seed=51)
+        other, _ = _write_file(100, 1, seed=52)
+        scan = ShardedScan([buf], mesh=make_mesh(2, sp=1))
+        cur = scan.state()
+        with pytest.raises(ValueError, match="unit list differs"):
+            ShardedScan([other], mesh=make_mesh(2, sp=1), resume=cur)
+        bad = dict(cur, version=9)
+        with pytest.raises(ValueError, match="cursor version"):
+            ShardedScan([buf], mesh=make_mesh(2, sp=1), resume=bad)
+        bad = dict(cur, next_unit=99)
+        with pytest.raises(ValueError, match="out of range"):
+            ShardedScan([buf], mesh=make_mesh(2, sp=1), resume=bad)

@@ -176,9 +176,22 @@ class FileWriter:
         n_rows = None
         prepared = []
         reps = {}
+        rep_leaf_counts: dict[str, int] = {}
+        for leaf in leaves:
+            if leaf.max_rep_level:
+                top = leaf.path[0]
+                rep_leaf_counts[top] = rep_leaf_counts.get(top, 0) + 1
         for leaf in leaves:
             if leaf.max_rep_level:
                 key = leaf.path[0]
+                if rep_leaf_counts[key] > 1:
+                    # keying values by the top-level field would silently
+                    # write the same array into every leaf of the group
+                    raise ValueError(
+                        f"repeated group {key!r} has multiple leaves; "
+                        "write_columns supports single-leaf LIST columns "
+                        "only — use add_data for general nesting"
+                    )
                 if key not in columns:
                     raise ValueError(f"missing column {key!r}")
                 if offsets is None or key not in offsets:

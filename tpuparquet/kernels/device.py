@@ -151,11 +151,20 @@ class DeviceColumn:
             "def", self._def_p, self.num_values,
             lambda: jnp.zeros((self.num_values,), dtype=jnp.int32))
 
+    def _buffers(self):
+        """Every live device buffer (the single source of truth for
+        batched syncs — block_until_ready AND _finish_row_group fence
+        through this, so a new slot added here is fenced everywhere)."""
+        return [
+            x for x in (self._data_p, self.offsets, self._mask_p,
+                        self._pos_p, self._rep_p, self._def_p)
+            if x is not None
+        ]
+
     def block_until_ready(self):
-        for x in (self._data_p, self.offsets, self._mask_p, self._pos_p,
-                  self._rep_p, self._def_p):
-            if x is not None:
-                x.block_until_ready()
+        # one batched sync: each individual block_until_ready is a
+        # round trip over a remote-attached device
+        jax.block_until_ready(self._buffers())
         return self
 
     def to_numpy(self):
@@ -975,63 +984,86 @@ def _finish_row_group(planned, st: _Stager):
     # groups at 50M values) — the tunnel serializes badly under a deep
     # queue.  Compute itself is sub-ms; this costs one sync, and it
     # also fences the finish()-time transfers sourced from arena slabs.
-    for c in out.values():
-        c.block_until_ready()
+    # One batched block_until_ready: per-buffer syncs are a round trip
+    # EACH over the tunnel (~240 of them across 8 row groups x 5 columns
+    # x 6 buffers cost ~0.6s — the entire e2e-vs-internals gap).
+    jax.block_until_ready(
+        [x for c in out.values() for x in c._buffers()])
     return out
 
 
-def read_row_groups_device(reader, rg_indices=None):
-    """Yield ``(rg_index, {path: DeviceColumn})`` for several row groups,
+def pipelined_reads(readers, units, device_for=None, start: int = 0):
+    """Yield ``(unit_index, {path: DeviceColumn})`` for
+    ``units[start:]`` (each a ``(reader_index, rg_index)`` pair),
     overlapping host planning with device transfer.
 
-    A single worker thread runs row group N+1's plan phase (file reads,
+    A single worker thread runs unit N+1's plan phase (file reads,
     block decompression, run-table scans — all GIL-releasing C/numpy
-    work) while the main thread transfers and dispatches row group N.
-    Two arenas alternate so the planner never writes into slabs the
-    in-flight transfer still reads.  Results are identical to calling
-    :func:`read_row_group_device` per index."""
+    work) while the main thread transfers and dispatches unit N on its
+    assigned device (``device_for(unit_index)``, default device when
+    None; plans are device-independent, so the target only matters at
+    transfer time).  Two arenas alternate so the planner never writes
+    into slabs an in-flight transfer still reads.  Results are identical
+    to a serial :func:`read_row_group_device` loop.  The single shared
+    pipeline under ``read_row_groups_device`` and the scan drivers in
+    ``shard/``."""
     from concurrent.futures import ThreadPoolExecutor
 
     from ..stats import current_stats
 
-    if rg_indices is None:
-        rg_indices = range(reader.row_group_count())
-    indices = list(rg_indices)
-    if not indices:
+    order = list(range(start, len(units)))
+    if not order:
         return
     _cs = current_stats()
     arenas = [HostArena(), HostArena()]
 
-    def plan(rg_index, arena):
+    def plan(k):
+        ri, rgi = units[k]
+        reader = readers[ri]
         st = _Stager()
         planned = _plan_row_group(
-            reader, reader.meta.row_groups[rg_index], st, arena)
+            reader, reader.meta.row_groups[rgi], st, arenas[k % 2])
         return planned, st
 
     ex = ThreadPoolExecutor(max_workers=1)
     try:
         futs = {}
 
-        def submit(k):
-            futs[k] = ex.submit(plan, indices[k], arenas[k % 2])
+        def submit(j):
+            if j < len(order):
+                futs[order[j]] = ex.submit(plan, order[j])
 
         submit(0)
-        if len(indices) > 1:
-            submit(1)
-        for k in range(len(indices)):
+        submit(1)
+        for j, k in enumerate(order):
             planned, st = futs.pop(k).result()
-            out = _finish_row_group(planned, st)  # drains; arena free
+            if device_for is not None:
+                with jax.default_device(device_for(k)):
+                    out = _finish_row_group(planned, st)
+            else:
+                out = _finish_row_group(planned, st)  # drains; arena free
             arenas[k % 2].release_all()
-            if k + 2 < len(indices):
-                submit(k + 2)
+            submit(j + 2)
             if _cs is not None:
                 _cs.row_groups += 1
-            yield indices[k], out
+            yield k, out
     finally:
         # On error/early close just drop the arenas (never recycle slabs
         # that in-flight transfers might still read); the worker is
         # joined so no new borrows can race the interpreter shutdown.
         ex.shutdown(wait=True)
+
+
+def read_row_groups_device(reader, rg_indices=None):
+    """Yield ``(rg_index, {path: DeviceColumn})`` for several row groups,
+    overlapping host planning with device transfer (see
+    :func:`pipelined_reads`).  Results are identical to calling
+    :func:`read_row_group_device` per index."""
+    if rg_indices is None:
+        rg_indices = range(reader.row_group_count())
+    indices = list(rg_indices)
+    for k, out in pipelined_reads([reader], [(0, i) for i in indices]):
+        yield indices[k], out
 
 
 def decode_values_cpu(ptype, enc, data, count, type_length):

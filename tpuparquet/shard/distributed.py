@@ -86,14 +86,18 @@ class MultiHostScan:
         self.devices = list(self.mesh.devices.flat)
 
     def run(self) -> list[dict]:
-        """Decode this process's units (device-resident results)."""
-        from ..kernels.device import read_row_group_device
+        """Decode this process's units (device-resident results).
 
-        out = []
-        for i, (fi, rgi) in enumerate(self.local_units):
-            with jax.default_device(self.devices[i % len(self.devices)]):
-                out.append(read_row_group_device(self.readers[fi], rgi))
-        return out
+        Host planning of unit N+1 overlaps device transfer of unit N
+        (same pipeline as :class:`~tpuparquet.shard.scan.ShardedScan`)."""
+        from .scan import pipelined_unit_scan
+
+        return [
+            out for _, out in pipelined_unit_scan(
+                self.readers, self.local_units,
+                lambda i: self.devices[i % len(self.devices)],
+            )
+        ]
 
     def counts_allgather(self) -> np.ndarray:
         """(global_units,) row counts, identical on every process."""
