@@ -1,0 +1,74 @@
+"""Isolate the e2e-vs-internals gap: drain policy x arena policy.
+
+Variants at the same scale:
+  A  read_row_group_device as shipped (arena + per-rg drain)
+  B  no per-rg drain (drain everything once at the end)
+  C  no arena (throwaway buffers) + per-rg drain
+  D  no arena + no per-rg drain  (== the hand-driven profile loop)
+"""
+
+import sys
+import os
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.profile_decode import build_file  # noqa: E402
+
+
+def run(reader, *, drain_per_rg: bool, use_arena: bool, reps: int = 3):
+    import jax
+    from tpuparquet.kernels import device as D
+
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        outs = []
+        for rg_index in range(reader.row_group_count()):
+            rg = reader.meta.row_groups[rg_index]
+            arena = D.thread_arena() if use_arena else D.HostArena()
+            st = D._Stager()
+            planned = D._plan_row_group(reader, rg, st, arena)
+            staged = st.put()
+            out = {p: f(staged) for p, f in planned}
+            if drain_per_rg:
+                jax.block_until_ready([
+                    x for c in out.values()
+                    for x in (c._data_p, c.offsets, c._mask_p, c._pos_p,
+                              c._rep_p, c._def_p) if x is not None
+                ])
+            if use_arena:
+                arena.release_all()
+            outs.append(out)
+        jax.block_until_ready([
+            x for out in outs for c in out.values()
+            for x in (c._data_p, c.offsets, c._mask_p, c._pos_p,
+                      c._rep_p, c._def_p) if x is not None
+        ])
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
+    n_groups = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    from tpuparquet import FileReader
+
+    buf = build_file(n_rows, n_groups)
+    reader = FileReader(buf)
+    n_values = sum(cc.meta_data.num_values
+                   for rg in reader.meta.row_groups for cc in rg.columns)
+    print(f"n_values = {n_values/1e6:.1f}M")
+    run(reader, drain_per_rg=True, use_arena=True, reps=1)  # warm compile
+    for name, drain, arena in [("A drain+arena", True, True),
+                               ("B arena only", False, True),
+                               ("C drain only", True, False),
+                               ("D neither", False, False)]:
+        s = run(reader, drain_per_rg=drain, use_arena=arena)
+        print(f"{name:16s} {s:.3f}s  ({n_values/s/1e6:.1f} M vals/s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -157,8 +157,9 @@ def main():
 
     profile(reader, reps=1)  # warm compile
     best = profile(reader, reps=3)
-    # end-to-end via the real entry point (arena + per-rg sync included)
-    from tpuparquet.kernels.device import read_row_group_device
+    # end-to-end via the real entry points (arena + per-rg sync included)
+    from tpuparquet.kernels.device import (read_row_group_device,
+                                           read_row_groups_device)
     e2e = []
     for _ in range(3):
         t0 = time.perf_counter()
@@ -171,6 +172,17 @@ def main():
     e2e_s = min(e2e)
     print(f"read_row_group_device e2e: {e2e_s:.3f}s "
           f"({n_values/e2e_s/1e6:.1f} M vals/s)  vs cpu {cpu/e2e_s:.2f}x")
+    pipe = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        outs = [out for _, out in read_row_groups_device(reader)]
+        for o in outs:
+            for c in o.values():
+                c.block_until_ready()
+        pipe.append(time.perf_counter() - t0)
+    pipe_s = min(pipe)
+    print(f"read_row_groups_device (pipelined) e2e: {pipe_s:.3f}s "
+          f"({n_values/pipe_s/1e6:.1f} M vals/s)  vs cpu {cpu/pipe_s:.2f}x")
     print("device path breakdown (best of 3):")
     for k in ("plan", "decompress", "scan", "transfer", "dispatch",
               "execute", "total"):
