@@ -1,0 +1,103 @@
+"""Extract the reference's embedded fuzz-crash inputs as binary fixtures.
+
+The reference pins its go-fuzz crash findings as ``[]byte("...")``
+literals inside ``TestFuzzCrash*`` functions (``chunk_reader_test.go:5``,
+``deltabp_decoder_test.go:5,152``, ``schema_test.go:140,219``,
+``type_bytearray_test.go:5``, ``type_dict_test.go:30``).  This script
+parses those Go string literals (data, not code), unescapes them, and
+writes each as ``tests/corpus/crash/<TestName>.bin`` so our regression
+suite can assert every historical crasher fails *cleanly* in this
+implementation too.
+
+Run from the repo root with the reference checkout available:
+``python tools/extract_crash_corpus.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REF = "/root/reference"
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "tests", "corpus", "crash")
+
+FILES = [
+    "chunk_reader_test.go",
+    "deltabp_decoder_test.go",
+    "schema_test.go",
+    "type_bytearray_test.go",
+    "type_dict_test.go",
+    "page_v1_test.go",
+]
+
+_SIMPLE = {"a": 0x07, "b": 0x08, "f": 0x0C, "n": 0x0A, "r": 0x0D,
+           "t": 0x09, "v": 0x0B, "\\": 0x5C, '"': 0x22, "'": 0x27}
+
+
+def unescape_go(segment: str) -> bytes:
+    """Decode one interpreted Go string literal body to bytes."""
+    out = bytearray()
+    i = 0
+    while i < len(segment):
+        c = segment[i]
+        if c != "\\":
+            out.extend(c.encode("utf-8"))
+            i += 1
+            continue
+        e = segment[i + 1]
+        if e in _SIMPLE:
+            out.append(_SIMPLE[e])
+            i += 2
+        elif e == "x":
+            out.append(int(segment[i + 2 : i + 4], 16))
+            i += 4
+        elif e == "u":
+            out.extend(chr(int(segment[i + 2 : i + 6], 16)).encode("utf-8"))
+            i += 6
+        elif e.isdigit():  # octal \NNN
+            out.append(int(segment[i + 1 : i + 4], 8))
+            i += 4
+        else:
+            raise ValueError(f"unknown escape \\{e}")
+    return bytes(out)
+
+
+def extract(path: str) -> dict[str, bytes]:
+    src = open(path, encoding="utf-8").read()
+    found = {}
+    for m in re.finditer(
+        r"func (Test\w*Crash\w*)\(t \*testing\.T\) \{(.*?)\n\}",
+        src, re.S,
+    ):
+        name, body = m.group(1), m.group(2)
+        lit = re.search(r"\[\]byte\((.*?)\)\n", body, re.S)
+        if lit is None:
+            continue
+        data = bytearray()
+        for piece in re.findall(r'"((?:[^"\\]|\\.)*)"', lit.group(1)):
+            data.extend(unescape_go(piece))
+        found[name] = bytes(data)
+    return found
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    total = 0
+    for fn in FILES:
+        path = os.path.join(REF, fn)
+        if not os.path.exists(path):
+            print(f"skip {fn}: not found")
+            continue
+        for name, data in extract(path).items():
+            out = os.path.join(OUT, f"{name}.bin")
+            with open(out, "wb") as f:
+                f.write(data)
+            print(f"{name}.bin: {len(data)} bytes (from {fn})")
+            total += 1
+    print(f"{total} crash inputs extracted")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,221 @@
+"""Generate the checked-in cross-implementation corpus.
+
+Writes small parquet files with **pyarrow** (the foreign writer) into
+``tests/corpus/pyarrow/`` plus a ``manifest.json`` holding the expected
+contents, so the corpus tests need no pyarrow at run time and keep
+passing even if the generator's pyarrow version disappears.  The
+reference's analogue is the impala-written file corpus its compat test
+reads (``parquet_compatibility_test.go:76-87``).
+
+Run from the repo root: ``python tools/make_corpus.py``.  Idempotent:
+fixed seeds, fixed data.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "tests", "corpus", "pyarrow")
+
+
+def enc(v):
+    """JSON-encode an expected value (bytes/str -> hex; exact floats)."""
+    if v is None or isinstance(v, (bool, int, float)):
+        return v
+    if isinstance(v, str):
+        return {"$b": v.encode().hex()}
+    if isinstance(v, bytes):
+        return {"$b": v.hex()}
+    if isinstance(v, (list, tuple)):
+        return [enc(x) for x in v]
+    if isinstance(v, dict):
+        return {"$struct": {k: enc(x) for k, x in v.items()}}
+    raise TypeError(f"unhandled expected value type {type(v)}")
+
+
+def expected_from_table(t: pa.Table) -> dict:
+    out = {}
+    for name in t.column_names:
+        col = t.column(name)
+        typ = col.type
+        if pa.types.is_timestamp(typ) or pa.types.is_date(typ):
+            # store raw encoded integers (our reader doesn't apply
+            # logical conversions); ground truth still pyarrow-derived
+            col = col.cast(pa.int64() if pa.types.is_timestamp(typ)
+                           or typ == pa.date64() else pa.int32())
+        out[name] = [enc(v) for v in col.to_pylist()]
+    return out
+
+
+def flat_table(n=151, seed=0):
+    rng = np.random.default_rng(seed)
+    i64 = rng.integers(-(2**60), 2**60, size=n)
+    mask = rng.random(n) < 0.15
+    vocab = ["", "a", "bb", "hello world", "日本語", "x" * 40]
+    return pa.table({
+        "i32": pa.array(rng.integers(-(2**31), 2**31, size=n),
+                        pa.int32()),
+        "i64": pa.array([None if m else int(v) for m, v in zip(mask, i64)],
+                        pa.int64()),
+        "d": pa.array(rng.random(n)),
+        "f": pa.array(rng.random(n).astype(np.float32)),
+        "flag": pa.array(rng.random(n) < 0.5),
+        "s": pa.array([None if rng.random() < 0.1
+                       else vocab[int(rng.integers(0, len(vocab)))]
+                       for _ in range(n)]),
+    })
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    manifest = {}
+
+    def emit(name, table, **write_kw):
+        path = os.path.join(OUT, name)
+        pq.write_table(table, path, **write_kw)
+        back = pq.read_table(path)  # what pyarrow itself sees
+        manifest[name] = {
+            "n_rows": back.num_rows,
+            "write_kw": {k: str(v) for k, v in write_kw.items()},
+            "columns": expected_from_table(back),
+        }
+        print(f"{name}: {back.num_rows} rows, "
+              f"{os.path.getsize(path)} bytes")
+
+    # codec x page-version ladder over the same flat data
+    t = flat_table()
+    emit("flat_none_v1.parquet", t, compression="none",
+         data_page_version="1.0")
+    emit("flat_snappy_v1.parquet", t, compression="snappy",
+         data_page_version="1.0")
+    emit("flat_gzip_v1.parquet", t, compression="gzip",
+         data_page_version="1.0")
+    emit("flat_snappy_v2.parquet", t, compression="snappy",
+         data_page_version="2.0")
+    emit("flat_zstd_v2.parquet", t, compression="zstd",
+         data_page_version="2.0")
+
+    # dictionary-encoded low-cardinality strings, multiple row groups
+    rng = np.random.default_rng(1)
+    n = 400
+    t = pa.table({
+        "cat": pa.array([f"cat-{int(i)%7}" for i in
+                         rng.integers(0, 7, size=n)]),
+        "v": pa.array(rng.integers(0, 1000, size=n), pa.int32()),
+    })
+    emit("dict_strings_v1.parquet", t, compression="snappy",
+         use_dictionary=True, row_group_size=150)
+
+    # delta encodings (dictionary off so the encodings actually appear)
+    rng = np.random.default_rng(2)
+    n = 300
+    t = pa.table({
+        "ts64": pa.array((1_600_000_000_000
+                          + rng.integers(0, 10_000, size=n).cumsum())
+                         .astype(np.int64)),
+        "seq32": pa.array(rng.integers(0, 100, size=n).cumsum()
+                          .astype(np.int32), pa.int32()),
+    })
+    emit("delta_ints_v1.parquet", t, compression="snappy",
+         use_dictionary=False,
+         column_encoding={"ts64": "DELTA_BINARY_PACKED",
+                          "seq32": "DELTA_BINARY_PACKED"})
+
+    words = [f"prefix-common-{i:04d}-suffix" for i in range(120)]
+    t = pa.table({
+        "dba": pa.array(words),
+        "dlba": pa.array([w[::-1] for w in words]),
+    })
+    emit("delta_bytes_v1.parquet", t, compression="snappy",
+         use_dictionary=False,
+         column_encoding={"dba": "DELTA_BYTE_ARRAY",
+                          "dlba": "DELTA_LENGTH_BYTE_ARRAY"})
+
+    rng = np.random.default_rng(3)
+    t = pa.table({
+        "bf": pa.array(rng.random(200).astype(np.float32)),
+        "bd": pa.array(rng.random(200)),
+    })
+    emit("byte_stream_split_v1.parquet", t, compression="snappy",
+         use_dictionary=False,
+         column_encoding={"bf": "BYTE_STREAM_SPLIT",
+                          "bd": "BYTE_STREAM_SPLIT"})
+
+    # nesting: list, list<struct>, map, struct
+    t = pa.table({
+        "l": pa.array([[1, 2], None, [], [3, None, 5], [7]],
+                      pa.list_(pa.int64())),
+        "ls": pa.array(
+            [[{"k": "a", "n": 1}], [], None,
+             [{"k": "b", "n": None}, {"k": "c", "n": 3}], [{"k": "", "n": 0}]],
+            pa.list_(pa.struct([("k", pa.string()), ("n", pa.int64())]))),
+    })
+    emit("nested_list_snappy_v1.parquet", t, compression="snappy")
+
+    t = pa.table({
+        "m": pa.array([[("a", 1), ("b", 2)], None, [], [("c", None)]],
+                      pa.map_(pa.string(), pa.int64())),
+        "st": pa.array([{"x": 1, "y": "u"}, None, {"x": 3, "y": None},
+                        {"x": None, "y": "w"}],
+                       pa.struct([("x", pa.int64()), ("y", pa.string())])),
+    })
+    emit("map_struct_snappy_v2.parquet", t, compression="snappy",
+         data_page_version="2.0")
+
+    # decimal128 -> FIXED_LEN_BYTE_ARRAY: expected = unscaled big-endian
+    from decimal import Decimal
+    dec_vals = [Decimal("123456.789"), Decimal("-1.001"), None,
+                Decimal("99999999999999999.999"), Decimal("0.000")]
+    t = pa.table({"dec": pa.array(dec_vals, pa.decimal128(20, 3))})
+    path = os.path.join(OUT, "decimal_flba_v1.parquet")
+    pq.write_table(t, path, compression="snappy")
+    byte_width = 9  # precision 20
+    manifest["decimal_flba_v1.parquet"] = {
+        "n_rows": len(dec_vals),
+        "write_kw": {"compression": "snappy"},
+        "columns": {"dec": [
+            None if v is None else
+            {"$b": int(v.scaleb(3)).to_bytes(byte_width, "big",
+                                             signed=True).hex()}
+            for v in dec_vals
+        ]},
+    }
+    print(f"decimal_flba_v1.parquet: {len(dec_vals)} rows, "
+          f"{os.path.getsize(path)} bytes")
+
+    # INT96 timestamps (deprecated impala/hive layout)
+    import datetime as dt
+    stamps = [dt.datetime(2001, 1, 1, 12, 0, 0),
+              dt.datetime(1969, 12, 31, 23, 59, 59, 999999),
+              dt.datetime(2200, 1, 1, 0, 0, 1)]
+    t = pa.table({"t96": pa.array(stamps, pa.timestamp("ns"))})
+    path = os.path.join(OUT, "int96_v1.parquet")
+    pq.write_table(t, path, compression="snappy",
+                   use_deprecated_int96_timestamps=True)
+    manifest["int96_v1.parquet"] = {
+        "n_rows": len(stamps),
+        "write_kw": {"use_deprecated_int96_timestamps": "True"},
+        "columns": {"t96": [{"$iso": s.isoformat()} for s in stamps]},
+    }
+    print(f"int96_v1.parquet: {len(stamps)} rows, "
+          f"{os.path.getsize(path)} bytes")
+
+    # degenerate shapes
+    emit("empty_v1.parquet", flat_table(0), compression="snappy")
+    emit("one_row_v2.parquet", flat_table(1, seed=9), compression="snappy",
+         data_page_version="2.0")
+
+    with open(os.path.join(OUT, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    print(f"manifest: {len(manifest)} files")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
