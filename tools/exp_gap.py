@@ -1,10 +1,9 @@
-"""Isolate the e2e-vs-internals gap: drain policy x arena policy.
+"""Isolate host-side policy costs in the device decode path.
 
-Variants at the same scale:
-  A  read_row_group_device as shipped (arena + per-rg drain)
-  B  no per-rg drain (drain everything once at the end)
-  C  no arena (throwaway buffers) + per-rg drain
-  D  no arena + no per-rg drain  (== the hand-driven profile loop)
+Variants at the same scale (both respect the arena lifetime contract —
+slabs recycle only after the per-row-group drain fences every transfer):
+  A  arena recycling + per-rg drain  (what read_row_group_device ships)
+  C  throwaway buffers + per-rg drain (first-touch page-fault cost)
 """
 
 import sys
@@ -18,7 +17,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from tools.profile_decode import build_file  # noqa: E402
 
 
-def run(reader, *, drain_per_rg: bool, use_arena: bool, reps: int = 3):
+def run(reader, *, use_arena: bool, reps: int = 3):
     import jax
     from tpuparquet.kernels import device as D
 
@@ -33,20 +32,10 @@ def run(reader, *, drain_per_rg: bool, use_arena: bool, reps: int = 3):
             planned = D._plan_row_group(reader, rg, st, arena)
             staged = st.put()
             out = {p: f(staged) for p, f in planned}
-            if drain_per_rg:
-                jax.block_until_ready([
-                    x for c in out.values()
-                    for x in (c._data_p, c.offsets, c._mask_p, c._pos_p,
-                              c._rep_p, c._def_p) if x is not None
-                ])
-            if use_arena:
-                arena.release_all()
+            jax.block_until_ready(
+                [x for c in out.values() for x in c._buffers()])
+            arena.release_all()
             outs.append(out)
-        jax.block_until_ready([
-            x for out in outs for c in out.values()
-            for x in (c._data_p, c.offsets, c._mask_p, c._pos_p,
-                      c._rep_p, c._def_p) if x is not None
-        ])
         best = min(best, time.perf_counter() - t0)
     return best
 
@@ -61,13 +50,11 @@ def main():
     n_values = sum(cc.meta_data.num_values
                    for rg in reader.meta.row_groups for cc in rg.columns)
     print(f"n_values = {n_values/1e6:.1f}M")
-    run(reader, drain_per_rg=True, use_arena=True, reps=1)  # warm compile
-    for name, drain, arena in [("A drain+arena", True, True),
-                               ("B arena only", False, True),
-                               ("C drain only", True, False),
-                               ("D neither", False, False)]:
-        s = run(reader, drain_per_rg=drain, use_arena=arena)
-        print(f"{name:16s} {s:.3f}s  ({n_values/s/1e6:.1f} M vals/s)")
+    run(reader, use_arena=True, reps=1)  # warm compile
+    for name, arena in [("A arena (shipped)", True),
+                        ("C throwaway buffers", False)]:
+        s = run(reader, use_arena=arena)
+        print(f"{name:20s} {s:.3f}s  ({n_values/s/1e6:.1f} M vals/s)")
 
 
 if __name__ == "__main__":
