@@ -657,3 +657,76 @@ class TestDelta64Device:
         w.close()
         buf.seek(0)
         _parity_check(FileReader(buf))
+
+
+class TestDeviceSnappyWired:
+    """PLAIN fixed-width value segments of genuinely-compressed snappy
+    pages decompress ON DEVICE (tokens+literals ship, not raw bytes)."""
+
+    def _compressible_i64(self, n=4000, seed=3):
+        # long repeated byte patterns -> multi-token snappy blocks
+        rng = np.random.default_rng(seed)
+        base = rng.integers(0, 50, size=16)
+        return np.tile(base, n // 16 + 1)[:n].astype(np.int64)
+
+    def test_v1_required_flat_device_decompress(self):
+        import tpuparquet
+
+        vals = self._compressible_i64()
+        buf = io.BytesIO()
+        # allow_dict=False keeps the low-cardinality column PLAIN so the
+        # V1 flat-required deferred-decompression branch actually runs
+        w = FileWriter(buf, "message m { required int64 a; }",
+                       codec=CompressionCodec.SNAPPY, allow_dict=False)
+        w.write_columns({"a": vals})
+        w.close()
+        buf.seek(0)
+        r = FileReader(buf)
+        with tpuparquet.collect_stats() as st:
+            dev = read_row_group_device(r, 0)
+        assert st.pages_device_snappy > 0, \
+            "device snappy kernel did not engage on a compressed V1 page"
+        got, _, _ = dev["a"].to_numpy()
+        cpu = r.read_row_group_arrays(0)["a"]
+        np.testing.assert_array_equal(got, np.asarray(cpu.values))
+
+    def test_v2_pyarrow_optional_device_decompress(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        import tpuparquet
+
+        vals = self._compressible_i64(6000, seed=4)
+        mask = np.random.default_rng(5).random(6000) < 0.1
+        t = pa.table({"a": pa.array(
+            [None if m else int(v) for m, v in zip(mask, vals)],
+            pa.int64())})
+        p = tmp_path / "c.parquet"
+        pq.write_table(t, p, compression="snappy", use_dictionary=False,
+                       data_page_version="2.0")
+        r = FileReader(str(p))
+        with tpuparquet.collect_stats() as st:
+            dev = read_row_group_device(r, 0)
+        assert st.pages_device_snappy > 0, \
+            "device snappy kernel did not engage on a compressed V2 page"
+        got, _, gdl = dev["a"].to_numpy()
+        cpu = r.read_row_group_arrays(0)["a"]
+        np.testing.assert_array_equal(got, np.asarray(cpu.values))
+        np.testing.assert_array_equal(gdl, cpu.def_levels)
+
+    def test_env_off_still_correct(self, tmp_path, monkeypatch):
+        import tpuparquet.kernels.device as D
+
+        vals = self._compressible_i64(2000, seed=6)
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required int64 a; }",
+                       codec=CompressionCodec.SNAPPY)
+        w.write_columns({"a": vals})
+        w.close()
+        buf.seek(0)
+        r = FileReader(buf)
+        monkeypatch.setattr(D, "_DEVICE_SNAPPY", False)
+        dev = read_row_group_device(r, 0)
+        got, _, _ = dev["a"].to_numpy()
+        cpu = r.read_row_group_arrays(0)["a"]
+        np.testing.assert_array_equal(got, np.asarray(cpu.values))

@@ -1,0 +1,73 @@
+"""On-chip microbenchmark: Pallas vs XLA formulations of the unpack kernel.
+
+VERDICT round-2 ask #8: earn or retire the TPQ_PALLAS default with
+kernel-level numbers measured on the real device at scale, not "within
+noise" on an idle chip.  Inputs are staged to HBM once; each timing is
+dispatch + execute only (block_until_ready), best of ``REPS``.
+
+Usage: python tools/bench_pallas.py [n_values]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPS = 10
+
+
+def timeit(fn, *args):
+    import jax
+
+    jax.block_until_ready(fn(*args))  # compile + warm
+    best = float("inf")
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from tpuparquet.kernels.bitunpack import (pad_to_words, unpack_u32,
+                                              unpack_u32_pallas)
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000_000
+    print(f"backend={jax.default_backend()}  n={n/1e6:.0f}M values")
+    rng = np.random.default_rng(0)
+    rows = []
+    for width in (1, 3, 5, 8, 13, 17, 24, 32):
+        vals = rng.integers(0, 1 << width, size=n, dtype=np.uint64)
+        # pack on host (vectorized) -> (n_blocks, width) u32 words
+        from tpuparquet.cpu.bitpack import pack
+
+        packed = pack(vals, width)
+        words = jax.device_put(pad_to_words(packed, width, n))
+        t_xla = timeit(lambda w: unpack_u32(w, width, n), words)
+        t_pal = timeit(lambda w: unpack_u32_pallas(w, width, n), words)
+        # parity between the two device formulations
+        a = np.asarray(unpack_u32(words, width, n))
+        b = np.asarray(unpack_u32_pallas(words, width, n))
+        np.testing.assert_array_equal(a, b)
+        gbps_x = n * 4 / t_xla / 1e9
+        gbps_p = n * 4 / t_pal / 1e9
+        winner = "pallas" if t_pal < t_xla else "xla"
+        rows.append((width, t_xla * 1e3, t_pal * 1e3, gbps_x, gbps_p,
+                     winner))
+        print(f"width {width:2d}: xla {t_xla*1e3:7.2f} ms ({gbps_x:6.1f} "
+              f"GB/s out)   pallas {t_pal*1e3:7.2f} ms ({gbps_p:6.1f} "
+              f"GB/s out)   -> {winner}")
+    wins = sum(1 for r in rows if r[5] == "pallas")
+    print(f"pallas wins {wins}/{len(rows)} widths")
+
+
+if __name__ == "__main__":
+    main()

@@ -56,6 +56,16 @@ def stage_u32(data, n_words: int) -> np.ndarray:
     return buf[:need].view("<u4")
 
 
+@functools.partial(jax.jit, static_argnames=("n_words",))
+def u8_to_u32_words(b: jax.Array, n_words: int):
+    """Device-resident little-endian byte stream -> (n_words,) u32.
+
+    The device twin of :func:`stage_u32` for bytes that never visit the
+    host (e.g. the device snappy decompressor's output)."""
+    w = b[: n_words * 4].astype(jnp.uint32).reshape(-1, 4)
+    return w[:, 0] | (w[:, 1] << 8) | (w[:, 2] << 16) | (w[:, 3] << 24)
+
+
 @functools.partial(jax.jit, static_argnames=("count", "lanes"))
 def plain_fixed_to_lanes(words: jax.Array, count: int, lanes: int):
     """PLAIN fixed-width values staged as u32 words -> (count, lanes) u32.

@@ -20,6 +20,8 @@ segment, still staged into the same DeviceColumn):
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import jax
@@ -42,6 +44,7 @@ from ..format.metadata import (
 from ..format.schema import SchemaNode
 from .bitunpack import pad_to_words, unpack_u32
 from .decode import (
+    bucket,
     dict_gather_bytes,
     dict_gather_fixed,
     expand_delta_i32,
@@ -52,6 +55,7 @@ from .decode import (
     plan_delta_i32,
     plan_delta_i64,
     stage_u32,
+    u8_to_u32_words,
 )
 
 __all__ = ["DeviceColumn", "decode_chunk_device", "read_row_group_device",
@@ -63,6 +67,53 @@ _LANES = {
 }
 
 _DICT_ENCODINGS = (Encoding.PLAIN_DICTIONARY, Encoding.RLE_DICTIONARY)
+
+# Device-side snappy decompression of PLAIN fixed-width value segments
+# (tokens + literals ship instead of the decompressed bytes).  Engages
+# only for genuinely-compressed blocks — single-literal blocks keep the
+# zero-copy host view, which is strictly cheaper.
+_DEVICE_SNAPPY = os.environ.get("TPQ_DEVICE_SNAPPY", "1") != "0"
+
+
+def _plan_device_snappy_words(payload, expected_size: int, n_words: int,
+                              stager: "_Stager"):
+    """Plan device-side snappy decompression of one values segment.
+
+    Returns ``words(staged) -> (n_words,) u32`` when the segment should
+    decompress on device (multi-token block, native scanner available),
+    or None when the host path applies (single literal -> zero-copy
+    view; no native scanner; int32 overflow risk).  Wire format work
+    happens in ``native/snappy.c tpq_snappy_scan_tokens``; copy
+    resolution is :func:`tpuparquet.kernels.snappy.expand_tokens`
+    (pointer doubling).  Reference analogue of the block being replaced:
+    ``compress.go:102-122`` (the hot decompress in the read loop)."""
+    from ..compress import snappy_single_literal_view
+
+    if snappy_single_literal_view(payload) is not None:
+        return None
+    from ..native import snappy_native
+
+    nat = snappy_native()
+    if nat is None or getattr(nat, "_scan_tokens_fn", None) is None:
+        return None
+    from .snappy import plan_tokens
+
+    plan = plan_tokens(payload, expected_size)
+    if plan is None:
+        return None  # int32 token table would wrap
+    te, ts, lp, out_cap, steps, out_len = plan
+    if out_len < n_words * 4:
+        raise ValueError("PLAIN values segment shorter than value count")
+    hs = stager.add_many([te, ts, lp], pad=False)
+
+    def words(staged, _hs=hs, _cap=out_cap, _steps=steps, _nw=n_words):
+        from .snappy import expand_tokens
+
+        out = expand_tokens(staged[_hs[0]], staged[_hs[1]], staged[_hs[2]],
+                            _cap, _steps)
+        return u8_to_u32_words(out, _nw)
+
+    return words
 
 
 class DeviceColumn:
@@ -509,22 +560,35 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
             h = ph.data_page_header
             if h is None or h.num_values is None or h.num_values < 0:
                 raise ValueError("DATA_PAGE header missing data_page_header")
-            raw = decompress_block_into(codec, payload,
-                                        ph.uncompressed_page_size, arena)
             n = h.num_values
-            pos = 0
-            if node.max_rep_level:
-                r_scan, r_host, pos = _scan_levels_v1(
-                    raw, n, node.max_rep_level, pos,
-                    h.repetition_level_encoding,
+            if (_DEVICE_SNAPPY and codec == CompressionCodec.SNAPPY
+                    and not node.max_rep_level and not max_def
+                    and h.encoding == Encoding.PLAIN
+                    and ptype in _LANES):
+                # flat-required PLAIN page: the block holds no level
+                # bytes, so planning needs nothing from the payload —
+                # defer decompression (device tokens, or zero-copy host
+                # view for single-literal blocks, decided at dispatch)
+                values_comp = (payload, ph.uncompressed_page_size)
+                values_seg = None
+                dl_scan = dl_host = None
+            else:
+                values_comp = None
+                raw = decompress_block_into(codec, payload,
+                                            ph.uncompressed_page_size, arena)
+                pos = 0
+                if node.max_rep_level:
+                    r_scan, r_host, pos = _scan_levels_v1(
+                        raw, n, node.max_rep_level, pos,
+                        h.repetition_level_encoding,
+                    )
+                    _defer_levels(ops, stager, "rep", r_scan, r_host, n,
+                                  node.max_rep_level.bit_length(),
+                                  max_level=node.max_rep_level)
+                dl_scan, dl_host, pos = _scan_levels_v1(
+                    raw, n, max_def, pos, h.definition_level_encoding
                 )
-                _defer_levels(ops, stager, "rep", r_scan, r_host, n,
-                              node.max_rep_level.bit_length(),
-                              max_level=node.max_rep_level)
-            dl_scan, dl_host, pos = _scan_levels_v1(
-                raw, n, max_def, pos, h.definition_level_encoding
-            )
-            values_seg = raw[pos:]
+                values_seg = raw[pos:]
             enc = h.encoding
         elif ptype_page == PageType.DATA_PAGE_V2:
             from ..cpu.hybrid import scan_hybrid
@@ -552,11 +616,21 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                     payload[rl_len : rl_len + dl_len], n, dwidth
                 )
             values_seg = payload[rl_len + dl_len :]
+            values_comp = None
             if h.is_compressed is not False:
-                values_seg = decompress_block_into(
-                    codec, values_seg,
-                    ph.uncompressed_page_size - rl_len - dl_len, arena,
-                )
+                vals_size = ph.uncompressed_page_size - rl_len - dl_len
+                if (_DEVICE_SNAPPY and codec == CompressionCodec.SNAPPY
+                        and h.encoding == Encoding.PLAIN
+                        and ptype in _LANES):
+                    # V2 keeps levels outside compression: planning only
+                    # needs the level bytes, so the values block can
+                    # decompress on device
+                    values_comp = (values_seg, vals_size)
+                    values_seg = None
+                else:
+                    values_seg = decompress_block_into(
+                        codec, values_seg, vals_size, arena,
+                    )
             enc = h.encoding
         else:
             continue
@@ -584,6 +658,21 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
         else:
             non_null = int((dl_host == max_def).sum())
         values_read += n
+
+        # Resolve deferred value-segment decompression: device tokens
+        # when the block is genuinely compressed, host (zero-copy for
+        # single-literal blocks) otherwise.
+        plan_words = None
+        if values_comp is not None:
+            plan_words = _plan_device_snappy_words(
+                values_comp[0], values_comp[1],
+                non_null * _LANES[ptype], stager,
+            )
+            if plan_words is None:
+                values_seg = decompress_block_into(
+                    codec, values_comp[0], values_comp[1], arena)
+            elif _st is not None:
+                _st.pages_device_snappy += 1
 
         # Def-level plan, padded for the fused page kernels.  A page
         # whose value path can't fuse expands it standalone via
@@ -766,12 +855,16 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 from .decode import page_plain_fixed_levels_tbl
 
                 lanes = _LANES[ptype]
-                wh = stager.add(stage_u32(values_seg, non_null * lanes))
+                if plan_words is not None:
+                    get_words = plan_words
+                else:
+                    wh = stager.add(stage_u32(values_seg, non_null * lanes))
+                    get_words = lambda s, _wh=wh: s[_wh]
 
-                def op(s, p, _wh=wh, _d=dl_ref, _nn=non_null, _n=n,
+                def op(s, p, _gw=get_words, _d=dl_ref, _nn=non_null, _n=n,
                        _lanes=lanes, _upl=pallas_expand_enabled()):
                     vals, dl_dev = page_plain_fixed_levels_tbl(
-                        s[_wh], s[_d[0][0]], s[_d[0][1]], _nn, _lanes,
+                        _gw(s), s[_d[0][0]], s[_d[0][1]], _nn, _lanes,
                         _d[1], dwidth, _d[2], dsingle=_d[3],
                         use_pallas=_upl,
                     )
@@ -781,14 +874,19 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 ops.append(op)
             elif ptype in _LANES:
                 # zero-copy u32 view of the decompressed values rides the
-                # one batched transfer; 'decode' is a device reshape
+                # one batched transfer (or the words come straight from
+                # the device snappy kernel); 'decode' is a device reshape
                 _def_standalone()
                 lanes = _LANES[ptype]
-                wh = stager.add(stage_u32(values_seg, non_null * lanes))
+                if plan_words is not None:
+                    get_words = plan_words
+                else:
+                    wh = stager.add(stage_u32(values_seg, non_null * lanes))
+                    get_words = lambda s, _wh=wh: s[_wh]
                 ops.append(
-                    lambda s, p, _wh=wh, _nn=non_null, _lanes=lanes:
+                    lambda s, p, _gw=get_words, _nn=non_null, _lanes=lanes:
                     p["val"].append(
-                        (plain_fixed_to_lanes(s[_wh], _nn, _lanes), _nn)
+                        (plain_fixed_to_lanes(_gw(s), _nn, _lanes), _nn)
                     )
                 )
             else:

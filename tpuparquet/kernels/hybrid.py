@@ -105,6 +105,13 @@ def _bp_lane_stats(scan, width: int, target: int):
                                 target)
         except RuntimeError:  # stale .so without tpq_bp_stats
             pass
+    # record the degradation: this fallback unpacks the whole stream in
+    # numpy, so perf quietly regresses with no functional symptom
+    from ..stats import current_stats
+
+    _st = current_stats()
+    if _st is not None:
+        _st.native_fallbacks += 1
     from ..cpu.bitpack import unpack
 
     unpacked = unpack(bp_bytes, n_bp, width)

@@ -34,17 +34,50 @@ import numpy as np
 
 from .decode import bucket
 
-__all__ = ["snappy_scan_tokens", "decompress_device", "expand_tokens"]
+__all__ = ["snappy_scan_tokens", "plan_tokens", "decompress_device",
+           "expand_tokens"]
 
 
-def snappy_scan_tokens(block: bytes):
-    """Host pass 1: (tok_out_end, tok_src, literals, out_len)."""
+def snappy_scan_tokens(block):
+    """Host pass 1: (tok_out_end, tok_src, literals, out_len).
+
+    ``block`` may be bytes / memoryview / u8 ndarray (zero-copy)."""
     from ..native import snappy_native
 
     nat = snappy_native()
     if nat is None:
         raise RuntimeError("native scanner unavailable (no C compiler)")
-    return nat.scan_tokens(bytes(block))
+    return nat.scan_tokens(block)
+
+
+def plan_tokens(block, expected_size: int | None = None):
+    """Scan + pad one block's token tables for :func:`expand_tokens`.
+
+    Returns ``(te, ts, lp, out_cap, steps, out_len)`` — int32 token
+    ends/sources and u8 literals, bucket-padded (sentinels: ends=out_cap
+    so padded tokens are never selected, sources=-1 resolving to literal
+    0) — or None when the int32 device path would overflow.  The single
+    source of the pointer-doubling preconditions, shared by
+    :func:`decompress_device` and the page planner's deferred path."""
+    tok_end, tok_src, lits, out_len = snappy_scan_tokens(block)
+    if expected_size is not None and out_len != expected_size:
+        raise ValueError(
+            f"snappy: header size {out_len} != expected {expected_size}"
+        )
+    out_cap = bucket(out_len)
+    if out_cap >= 1 << 31:  # int32 token table would wrap
+        return None
+    T = bucket(len(tok_end))
+    te = np.full(T, out_cap, dtype=np.int32)
+    te[: len(tok_end)] = tok_end
+    ts = np.full(T, -1, dtype=np.int32)
+    ts[: len(tok_src)] = tok_src
+    lp = np.zeros(bucket(max(len(lits), 1)), dtype=np.uint8)
+    lp[: len(lits)] = lits
+    # chains shorten by >= 1 output position per unresolved hop, and
+    # every hop at least doubles resolved coverage: ceil(log2(n)) rounds
+    steps = max(int(np.ceil(np.log2(max(out_len, 2)))), 1)
+    return te, ts, lp, out_cap, steps, out_len
 
 
 @functools.partial(jax.jit, static_argnames=("out_cap", "steps"))
@@ -73,27 +106,12 @@ def expand_tokens(tok_end, tok_src, lits, out_cap: int, steps: int):
 
 def decompress_device(block: bytes, expected_size: int | None = None):
     """Decompress one snappy block to a device-resident u8 array."""
-    tok_end, tok_src, lits, out_len = snappy_scan_tokens(block)
-    if expected_size is not None and out_len != expected_size:
-        raise ValueError(
-            f"snappy: header size {out_len} != expected {expected_size}"
-        )
+    plan = plan_tokens(block, expected_size)
+    if plan is None:
+        raise ValueError("device snappy: block too large for int32 path")
+    te, ts, lp, out_cap, steps, out_len = plan
     if out_len == 0:
         return jnp.zeros((0,), dtype=jnp.uint8)
-    out_cap = bucket(out_len)
-    if out_cap >= 1 << 31:  # int32 token table would wrap
-        raise ValueError("device snappy: block too large for int32 path")
-    # pad the token table so positions >= out_len resolve to literal 0
-    T = bucket(len(tok_end))
-    te = np.full(T, out_cap, dtype=np.int32)
-    te[: len(tok_end)] = tok_end
-    ts = np.full(T, -1, dtype=np.int32)
-    ts[: len(tok_src)] = tok_src
-    lp = np.zeros(bucket(max(len(lits), 1)), dtype=np.uint8)
-    lp[: len(lits)] = lits
-    # chains shorten by >= 1 output position per unresolved hop, and
-    # every hop at least doubles resolved coverage: ceil(log2(n)) rounds
-    steps = max(int(np.ceil(np.log2(max(out_len, 2)))), 1)
     staged = jax.device_put((te, ts, lp))
     out = expand_tokens(*staged, out_cap, steps)
     return out[:out_len]

@@ -113,7 +113,7 @@ class NativeSnappy:
         if self._scan_tokens_fn is not None:
             self._scan_tokens_fn.restype = ctypes.c_int
             self._scan_tokens_fn.argtypes = [
-                ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.c_void_p, ctypes.c_size_t,
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
                 ctypes.c_void_p, ctypes.c_size_t,
                 ctypes.POINTER(ctypes.c_int64),
@@ -138,14 +138,15 @@ class NativeSnappy:
         fn = self._scan_tokens_fn
         if fn is None:
             raise RuntimeError("native library too old; rebuild")
-        cap_tokens = max(len(block), 1)  # every token needs >= 1 input byte
+        buf = _as_u8(block)  # zero-copy for bytes/memoryview/ndarray
+        cap_tokens = max(buf.size, 1)  # every token needs >= 1 input byte
         tok_end = np.empty(cap_tokens, dtype=np.int64)
         tok_src = np.empty(cap_tokens, dtype=np.int64)
-        lits = np.empty(max(len(block), 1), dtype=np.uint8)
+        lits = np.empty(cap_tokens, dtype=np.uint8)
         n_tok = ctypes.c_int64()
         lit_len = ctypes.c_size_t()
         out_len = ctypes.c_uint64()
-        rc = fn(block, len(block),
+        rc = fn(buf.ctypes.data, buf.size,
                 tok_end.ctypes.data, tok_src.ctypes.data, cap_tokens,
                 lits.ctypes.data, lits.size,
                 ctypes.byref(n_tok), ctypes.byref(lit_len),

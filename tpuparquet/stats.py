@@ -29,9 +29,16 @@ class DecodeStats:
     row_groups: int = 0
     chunks: int = 0
     pages: int = 0
+    # pages whose values segment decompressed ON DEVICE (snappy token
+    # kernel) rather than on host — evidence the device path engaged
+    pages_device_snappy: int = 0
     values: int = 0
     bytes_compressed: int = 0
     bytes_uncompressed: int = 0
+    # slow-path executions that a healthy build would run natively (e.g.
+    # a stale .so forcing the numpy bp-stats fallback): nonzero means
+    # perf has quietly regressed with no functional symptom
+    native_fallbacks: int = 0
     wall_s: float = 0.0
     _t0: float = dataclasses.field(default=0.0, repr=False)
 
@@ -50,9 +57,11 @@ class DecodeStats:
             "row_groups": self.row_groups,
             "chunks": self.chunks,
             "pages": self.pages,
+            "pages_device_snappy": self.pages_device_snappy,
             "values": self.values,
             "bytes_compressed": self.bytes_compressed,
             "bytes_uncompressed": self.bytes_uncompressed,
+            "native_fallbacks": self.native_fallbacks,
             "wall_s": round(self.wall_s, 6),
             "values_per_sec": round(self.values_per_sec, 1),
             "compression_ratio": round(self.compression_ratio, 3),
@@ -66,6 +75,8 @@ class DecodeStats:
             f"{d['bytes_compressed']:,}B -> {d['bytes_uncompressed']:,}B "
             f"(x{d['compression_ratio']}); "
             f"{d['wall_s']:.4f}s = {d['values_per_sec']:,.0f} values/s"
+            + (f"; {d['native_fallbacks']} native fallbacks (stale .so?)"
+               if d["native_fallbacks"] else "")
         )
 
 
