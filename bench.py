@@ -320,15 +320,23 @@ def parity(reader) -> None:
                     f"cpu={want} device={got}")
 
 
+def _progress(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
 def run_config(name: str, buf: io.BytesIO) -> dict:
     from tpuparquet import FileReader
 
     reader = FileReader(buf)
     n_values = total_values(reader)
+    _progress(f"[{name}] file built ({len(buf.getbuffer())/1e6:.0f} MB, "
+              f"{n_values/1e6:.1f}M values); timing cpu oracle")
     _cpu_pass(reader)  # warm page cache / allocator (one pass suffices)
     cpu_s = time_cpu(reader)
+    _progress(f"[{name}] cpu {cpu_s:.2f}s; timing device path")
     time_device(reader)  # compile warmup
     dev_s = time_device(reader)
+    _progress(f"[{name}] device {dev_s:.2f}s; parity check")
     # Parity AFTER timing: the first device->host readback drops the
     # runtime into synchronous dispatch on the remote tunnel; the report
     # is still gated on it — a mismatch raises before printing.
@@ -425,6 +433,7 @@ def main() -> None:
         ("3-delta-int64-nested-list", build_config3),
         ("4-wide-string-dict-float64-v2", build_config4),
     ]:
+        _progress(f"[{name}] building file")
         r = run_config(name, builder())
         results[name] = r
         print(json.dumps(r), flush=True)
