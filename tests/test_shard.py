@@ -394,3 +394,48 @@ class TestResumableCursor:
         bad = dict(cur, next_unit=99)
         with pytest.raises(ValueError, match="out of range"):
             ShardedScan([buf], mesh=make_mesh(2, sp=1), resume=bad)
+
+
+class TestMultiHostCursor:
+    def test_state_resume_roundtrip(self, tmp_path):
+        import json
+
+        from tpuparquet.shard import MultiHostScan
+
+        paths = []
+        for s in range(2):
+            buf, _ = _write_file(200, 2, seed=60 + s)
+            p = tmp_path / f"m{s}.parquet"
+            p.write_bytes(buf.getvalue())
+            paths.append(str(p))
+
+        full = MultiHostScan(paths)
+        expected = full.run()
+        assert len(expected) == 4
+
+        scan1 = MultiHostScan(paths)
+        it = scan1.run_iter()
+        got = dict([next(it)])
+        it.close()
+        cur = json.loads(json.dumps(scan1.state()))
+        assert cur["next_local_unit"] == 1
+
+        scan2 = MultiHostScan(paths, resume=cur)
+        for k, out in scan2.run_iter():
+            got[k] = out
+        assert sorted(got) == [0, 1, 2, 3]
+        for k in range(4):
+            for path in expected[k]:
+                _column_equal(got[k][path], expected[k][path])
+
+    def test_cursor_process_count_checked(self, tmp_path):
+        from tpuparquet.shard import MultiHostScan
+
+        buf, _ = _write_file(100, 1, seed=70)
+        p = tmp_path / "p.parquet"
+        p.write_bytes(buf.getvalue())
+        cur = MultiHostScan([str(p)]).state()
+        bad = dict(cur, process_count=4)
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="process_count"):
+            MultiHostScan([str(p)], resume=bad)

@@ -74,7 +74,7 @@ class MultiHostScan:
     process knows the global shape (the usual precursor to a global
     reshard)."""
 
-    def __init__(self, sources, *columns: str, mesh=None):
+    def __init__(self, sources, *columns: str, mesh=None, resume=None):
         from ..io.reader import FileReader
         from .mesh import make_mesh
         from .scan import scan_units
@@ -84,20 +84,57 @@ class MultiHostScan:
         self.local_units = process_units(self.global_units)
         self.mesh = mesh if mesh is not None else make_mesh()
         self.devices = list(self.mesh.devices.flat)
+        self._next_local = 0
+        if resume is not None:
+            self._load_cursor(resume)
+
+    def _load_cursor(self, cursor: dict) -> None:
+        from .scan import cursor_load
+
+        # process grid coordinates are identity: a cursor restored on
+        # the wrong process (or grid size) would silently skip or
+        # re-decode units of the strided assignment
+        self._next_local = cursor_load(
+            cursor, self.global_units, "next_local_unit",
+            len(self.local_units),
+            process_count=jax.process_count(),
+            process_index=jax.process_index(),
+        )
+
+    def state(self) -> dict:
+        """JSON-serializable per-process cursor (resume with
+        ``MultiHostScan(sources, ..., resume=state)`` on the SAME
+        process of the SAME grid).  Valid between :meth:`run_iter`
+        steps."""
+        from .scan import cursor_state
+
+        return cursor_state(
+            self.global_units, "next_local_unit", self._next_local,
+            process_count=jax.process_count(),
+            process_index=jax.process_index(),
+        )
+
+    def run_iter(self):
+        """Yield ``(local_index, {path: DeviceColumn})`` from the cursor
+        position, advancing it after each unit."""
+        from .scan import pipelined_unit_scan
+
+        for k, out in pipelined_unit_scan(
+            self.readers, self.local_units,
+            lambda i: self.devices[i % len(self.devices)],
+            start=self._next_local,
+        ):
+            self._next_local = k + 1
+            yield k, out
 
     def run(self) -> list[dict]:
-        """Decode this process's units (device-resident results).
+        """Decode ALL of this process's units (position i of the result
+        is local unit i; always a full scan — resume via run_iter).
 
         Host planning of unit N+1 overlaps device transfer of unit N
         (same pipeline as :class:`~tpuparquet.shard.scan.ShardedScan`)."""
-        from .scan import pipelined_unit_scan
-
-        return [
-            out for _, out in pipelined_unit_scan(
-                self.readers, self.local_units,
-                lambda i: self.devices[i % len(self.devices)],
-            )
-        ]
+        self._next_local = 0
+        return [out for _, out in self.run_iter()]
 
     def counts_allgather(self) -> np.ndarray:
         """(global_units,) row counts, identical on every process."""

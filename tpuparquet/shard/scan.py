@@ -32,6 +32,40 @@ def scan_units(readers: list[FileReader]) -> list[tuple[int, int]]:
     ]
 
 
+def cursor_state(units, next_key: str, next_value: int, **extra) -> dict:
+    """Snapshot a JSON-serializable scan cursor (shared by ShardedScan
+    and MultiHostScan so the format can't drift between them)."""
+    cur = {"version": 1, next_key: next_value,
+           "units": [list(u) for u in units]}
+    cur.update(extra)
+    return cur
+
+
+def cursor_load(cursor: dict, units, next_key: str, n_units: int,
+                **expected) -> int:
+    """Validate a cursor against this scan's shape; returns the resume
+    position.  ``expected`` pins run-identity fields (e.g. process grid
+    coordinates) that must match exactly."""
+    if cursor.get("version") != 1:
+        raise ValueError(f"unknown cursor version {cursor.get('version')}")
+    if [tuple(u) for u in cursor["units"]] != list(units):
+        raise ValueError(
+            "cursor does not match these sources: unit list differs "
+            "(files changed since the cursor was taken?)"
+        )
+    for k, v in expected.items():
+        if cursor.get(k) != v:
+            raise ValueError(
+                f"cursor {k} {cursor.get(k)!r} does not match this "
+                f"run's {v!r}; resuming would misalign the unit "
+                "assignment"
+            )
+    nxt = int(cursor[next_key])
+    if not 0 <= nxt <= n_units:
+        raise ValueError(f"cursor {next_key} {nxt} out of range")
+    return nxt
+
+
 def pipelined_unit_scan(readers, units, device_for=None, start: int = 0):
     """Yield ``(unit_index, {path: DeviceColumn})`` for ``units[start:]``,
     overlapping host planning with device transfer/dispatch — the shared
@@ -70,29 +104,15 @@ class ShardedScan:
             self._load_cursor(resume)
 
     def _load_cursor(self, cursor: dict) -> None:
-        if cursor.get("version") != 1:
-            raise ValueError(f"unknown cursor version {cursor.get('version')}")
-        units = [tuple(u) for u in cursor["units"]]
-        if units != self.units:
-            raise ValueError(
-                "cursor does not match these sources: unit list differs "
-                "(files changed since the cursor was taken?)"
-            )
-        nxt = int(cursor["next_unit"])
-        if not 0 <= nxt <= len(self.units):
-            raise ValueError(f"cursor next_unit {nxt} out of range")
-        self._next_unit = nxt
+        self._next_unit = cursor_load(cursor, self.units, "next_unit",
+                                      len(self.units))
 
     def state(self) -> dict:
         """JSON-serializable cursor: resume with
         ``ShardedScan(sources, ..., resume=state)``.  Valid between
         :meth:`run_iter` steps; decoding restarts at the first unit not
         yet yielded."""
-        return {
-            "version": 1,
-            "next_unit": self._next_unit,
-            "units": [list(u) for u in self.units],
-        }
+        return cursor_state(self.units, "next_unit", self._next_unit)
 
     def device_for(self, unit_index: int):
         return self.devices[unit_index % len(self.devices)]
