@@ -161,6 +161,42 @@ int tpq_bp_stats(const uint8_t *bp, size_t bp_len, int width,
       cnt += (target == 1) ? ones : (target == 0 ? len - ones : 0);
       continue;
     }
+    if (width <= 8) {
+      /* dict-index/level widths: 8 values span exactly `width` bytes
+       * starting on a byte boundary whenever the value index is a
+       * multiple of 8 — one 8-byte load serves the whole group */
+      int64_t i = start, end = start + len;
+      while (i < end && (i & 7)) {
+        uint32_t v = bp_get(bp, bp_len, i, width, vmask);
+        if (v > mx) mx = v;
+        cnt += (v == target);
+        i++;
+      }
+      while (i + 8 <= end) {
+        uint64_t byte_off = (uint64_t)i * width >> 3;
+        uint64_t w64;
+        if (byte_off + 8 <= bp_len) {
+          memcpy(&w64, bp + byte_off, 8);
+        } else {
+          w64 = 0;
+          memcpy(&w64, bp + byte_off, bp_len - byte_off);
+        }
+        for (int k = 0; k < 8; k++) {
+          uint32_t v = (uint32_t)(w64 >> (k * width)) & vmask;
+          if (v > mx) mx = v;
+          cnt += (v == target);
+        }
+        i += 8;
+      }
+      while (i < end) {
+        uint32_t v = bp_get(bp, bp_len, i, width, vmask);
+        if (v > mx) mx = v;
+        cnt += (v == target);
+        i++;
+      }
+      seen = 1;
+      continue;
+    }
     for (int64_t i = start; i < start + len; i++) {
       uint32_t v = bp_get(bp, bp_len, i, width, vmask);
       if (v > mx) mx = v;
