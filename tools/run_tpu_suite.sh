@@ -1,0 +1,27 @@
+#!/bin/bash
+# One tunnel window, everything measured: official bench ladder first
+# (the number that matters), then the scale sweep, then the Pallas A/B.
+# Usage: bash tools/run_tpu_suite.sh [outdir]
+set -u
+cd "$(dirname "$0")/.."
+OUT=$(realpath -m "${1:-/tmp/tpu_suite}")
+mkdir -p "$OUT"
+
+echo "=== bench.py (official ladder) ==="
+timeout 2400 python bench.py > "$OUT/bench.out" 2> "$OUT/bench.err"
+echo "rc=$?" | tee -a "$OUT/bench.err"
+tail -1 "$OUT/bench.out"
+
+echo "=== profile_decode scale sweep ==="
+for rows in 2000000 4000000 10000000; do
+  timeout 900 python tools/profile_decode.py $rows 8 \
+    > "$OUT/profile_${rows}.out" 2>&1
+  echo "rows=$rows rc=$?"
+  grep -E "e2e|device:" "$OUT/profile_${rows}.out" | head -4
+done
+
+echo "=== pallas vs xla unpack A/B ==="
+timeout 1200 python tools/bench_pallas.py 50000000 \
+  > "$OUT/pallas.out" 2>&1
+echo "rc=$?"
+tail -10 "$OUT/pallas.out"
