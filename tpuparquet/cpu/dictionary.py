@@ -61,13 +61,28 @@ def gather(dictionary, indices: np.ndarray):
         offsets = np.zeros(idx.size + 1, dtype=np.int64)
         np.cumsum(lens, out=offsets[1:])
         src_off = np.asarray(dictionary.offsets, dtype=np.int64)
+        data = np.asarray(dictionary.data)
         # vectorized byte gather: out byte b of value i comes from
-        # src_off[idx[i]] + (b - offsets[i]) — one fancy index instead
-        # of a per-value Python loop (2.7 -> ~9 M values/s on strings);
-        # the per-value shift fuses before the single repeat
-        pos = (np.arange(int(offsets[-1]), dtype=np.int64)
-               + np.repeat(src_off[idx] - offsets[:-1], lens))
-        return ByteArrayColumn(offsets, np.asarray(dictionary.data)[pos])
+        # src_off[idx[i]] + (b - offsets[i]) — fancy indexing instead of
+        # a per-value Python loop (2.7 -> ~9 M values/s on strings).
+        # Value-aligned slabs bound the int64 position temporaries to
+        # ~3x slab size instead of ~24x the whole output.
+        total = int(offsets[-1])
+        out = np.empty(total, dtype=np.uint8)
+        shift = src_off[idx] - offsets[:-1]
+        slab = 4 << 20
+        va = 0
+        while va < idx.size:
+            vb = (int(np.searchsorted(offsets, offsets[va] + slab,
+                                      side="left"))
+                  if total - int(offsets[va]) > slab else idx.size)
+            vb = max(vb, va + 1)
+            lo, hi = int(offsets[va]), int(offsets[vb])
+            pos = (np.arange(lo, hi, dtype=np.int64)
+                   + np.repeat(shift[va:vb], lens[va:vb]))
+            out[lo:hi] = data[pos]
+            va = vb
+        return ByteArrayColumn(offsets, out)
     arr = np.asarray(dictionary)
     if idx.size and (idx.min() < 0 or idx.max() >= len(arr)):
         raise ValueError("dictionary index out of range")

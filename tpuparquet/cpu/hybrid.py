@@ -117,6 +117,13 @@ def expand_scan(run_ends, run_is_rle, run_value, run_bp_start, bp_bytes,
     dtype = np.uint64 if width > 32 else np.uint32
     if count == 0 or len(run_ends) == 0:
         return np.zeros(count, dtype=dtype)
+    if len(run_ends) == 1:
+        # single-run fast paths (every stream our own writer emits):
+        # no searchsorted, no per-position gather
+        if run_is_rle[0]:
+            return np.full(count, run_value[0], dtype=dtype)
+        return unpack(bp_bytes, n_bp, width)[:count].astype(dtype,
+                                                           copy=False)
     unpacked = (unpack(bp_bytes, n_bp, width) if n_bp
                 else np.zeros(1, dtype=dtype))
     idx = np.arange(count, dtype=np.int64)
