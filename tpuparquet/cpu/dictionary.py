@@ -89,6 +89,65 @@ def gather(dictionary, indices: np.ndarray):
     return arr[idx]
 
 
+def _first_occurrence_rank(first_idx: np.ndarray):
+    """(order, rank) re-ranking sorted-unique ids by first occurrence;
+    ``kind="stable"`` everywhere or tie-breaking (and file bytes)
+    silently change."""
+    order = np.argsort(first_idx, kind="stable")
+    rank = np.empty_like(order)
+    rank[order] = np.arange(order.size)
+    return order, rank
+
+
+def _build_bytes_dictionary(values: ByteArrayColumn):
+    """Vectorized first-occurrence interning of variable-length bytes.
+
+    Values group by length; within one length they compare as fixed-
+    width rows via ``np.unique`` (a per-value Python dict loop costs
+    more than the encode it feeds at millions of strings).  Row gathers
+    walk value slabs so index temporaries stay bounded; global ids
+    re-rank by first occurrence so the output is identical to the
+    sequential interner — files look like the reference's."""
+    n = len(values)
+    if n == 0:
+        return ByteArrayColumn.from_list([]), np.empty(0, dtype=np.int32)
+    offsets = np.asarray(values.offsets, dtype=np.int64)
+    data = np.asarray(values.data)
+    lens = offsets[1:] - offsets[:-1]
+    indices = np.empty(n, dtype=np.int64)
+    group_firsts = []   # per group: first-occurrence value positions,
+    next_id = 0         # in group-local unique-id order
+    for L in np.unique(lens):
+        L = int(L)
+        sel = np.nonzero(lens == L)[0]
+        if L == 0:
+            indices[sel] = next_id
+            group_firsts.append(sel[:1])
+            next_id += 1
+            continue
+        k = sel.size
+        rows = np.empty((k, L), dtype=np.uint8)
+        slab = max(1, (4 << 20) // L)
+        for s in range(0, k, slab):
+            e = min(s + slab, k)
+            pos = (np.arange(L, dtype=np.int64)
+                   + offsets[sel[s:e]][:, None])
+            rows[s:e] = data[pos]
+        view = rows.view(np.dtype((np.void, L))).reshape(-1)
+        _, first_idx, inv = np.unique(view, return_index=True,
+                                      return_inverse=True)
+        order, rank = _first_occurrence_rank(first_idx)
+        indices[sel] = next_id + rank[inv]
+        group_firsts.append(sel[first_idx[order]])
+        next_id += order.size
+    # global first-occurrence order across the length groups
+    uniq_first = np.concatenate(group_firsts)
+    gorder, grank = _first_occurrence_rank(uniq_first)
+    indices = grank[indices]
+    # the dictionary IS the unique values gathered in global order
+    return gather(values, uniq_first[gorder]), indices.astype(np.int32)
+
+
 def build_dictionary(values):
     """Return (dictionary, indices) preserving first-occurrence order.
 
@@ -100,16 +159,7 @@ def build_dictionary(values):
         # strips trailing NULs — go through ByteArrayColumn instead.
         values = ByteArrayColumn.from_list(values)
     if isinstance(values, ByteArrayColumn):
-        vals = values.to_list()
-        seen: dict = {}
-        indices = np.empty(len(vals), dtype=np.int32)
-        for i, v in enumerate(vals):
-            j = seen.get(v)
-            if j is None:
-                j = len(seen)
-                seen[v] = j
-            indices[i] = j
-        return ByteArrayColumn.from_list(list(seen)), indices
+        return _build_bytes_dictionary(values)
     arr = np.asarray(values)
     if arr.ndim == 2:  # FIXED_LEN_BYTE_ARRAY / INT96 rows
         uniq, first_idx, inv = np.unique(
@@ -120,7 +170,5 @@ def build_dictionary(values):
             arr, return_index=True, return_inverse=True
         )
     # np.unique sorts; remap to first-occurrence order.
-    order = np.argsort(first_idx, kind="stable")
-    rank = np.empty_like(order)
-    rank[order] = np.arange(order.size)
+    order, rank = _first_occurrence_rank(first_idx)
     return uniq[order], rank[inv].astype(np.int32)
