@@ -124,6 +124,11 @@ def expand_scan(run_ends, run_is_rle, run_value, run_bp_start, bp_bytes,
             return np.full(count, run_value[0], dtype=dtype)
         return unpack(bp_bytes, n_bp, width)[:count].astype(dtype,
                                                            copy=False)
+    if run_is_rle.all():
+        # all-RLE streams (typical pyarrow level data): one repeat
+        lens = np.diff(run_ends, prepend=np.int32(0))
+        return np.repeat(run_value.astype(dtype, copy=False),
+                         lens)[:count]
     unpacked = (unpack(bp_bytes, n_bp, width) if n_bp
                 else np.zeros(1, dtype=dtype))
     idx = np.arange(count, dtype=np.int64)
