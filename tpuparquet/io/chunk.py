@@ -62,8 +62,12 @@ class ChunkData:
         self.null_count = null_count
 
 
-def read_chunk(blob: bytes, cm: ColumnMetaData, node: SchemaNode) -> ChunkData:
-    """Decode one column chunk from the file bytes."""
+def read_chunk(blob: "bytes | memoryview", cm: ColumnMetaData,
+               node: SchemaNode) -> ChunkData:
+    """Decode one column chunk from the file bytes.
+
+    Pass a memoryview for zero-copy page payloads (a bytes blob still
+    works but its page slices copy)."""
     codec = CompressionCodec(cm.codec)
     start = cm.data_page_offset
     if cm.dictionary_page_offset is not None:
@@ -95,7 +99,10 @@ def read_chunk(blob: bytes, cm: ColumnMetaData, node: SchemaNode) -> ChunkData:
             raise ValueError("page header missing compressed size")
         if r.pos + ph.compressed_page_size > end:
             raise ValueError("page payload overruns column chunk")
-        payload = bytes(blob[r.pos : r.pos + ph.compressed_page_size])
+        # zero-copy view: the codec layer's own bytes() conversion makes
+        # the single owned copy (a bytes() here would copy every
+        # compressed page a second time)
+        payload = blob[r.pos : r.pos + ph.compressed_page_size]
         if len(payload) != ph.compressed_page_size:
             raise ValueError("page payload truncated")
         r.pos += ph.compressed_page_size

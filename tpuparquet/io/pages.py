@@ -243,6 +243,10 @@ def decode_data_page_v2(header: PageHeader, payload, codec: CompressionCodec,
             values_seg,
             header.uncompressed_page_size - rl_len - dl_len,
         )
+    else:
+        # own the bytes: payload may be a zero-copy view of the source
+        # buffer, and decoded PLAIN arrays must not alias the file
+        values_seg = bytes(values_seg)
     non_null = n - (h.num_nulls or 0)
     check = int((dl == node.max_def_level).sum()) if node.max_def_level else n
     if check != non_null:
