@@ -68,12 +68,17 @@ def u8_to_u32_words(b: jax.Array, n_words: int):
 
 @functools.partial(jax.jit, static_argnames=("count", "lanes"))
 def plain_fixed_to_lanes(words: jax.Array, count: int, lanes: int):
-    """PLAIN fixed-width values staged as u32 words -> (count, lanes) u32.
+    """PLAIN fixed-width values staged as u32 words -> flat u32 lanes.
 
     lanes=1: int32/float32; lanes=2: int64/double (lo, hi); lanes=3: int96.
     The 'decode' of PLAIN on device is a reinterpret — the point is that
-    the bytes are already in HBM and never round-trip through host."""
-    return words[: count * lanes].reshape(count, lanes)
+    the bytes are already in HBM and never round-trip through host.
+
+    Value buffers stay FLAT 1-D at every jit boundary: TPU tiles a 2-D
+    ``u32[n, lanes]`` output as T(8,128), padding the minor dim to 128
+    lanes — 64x HBM waste for int64, 128x for int32 (measured: a 400 MB
+    ``u32[50M,2]`` column would allocate 25.6 GB and OOM the chip)."""
+    return words[: count * lanes]
 
 
 @functools.partial(jax.jit, static_argnames=("max_def",))
@@ -88,23 +93,39 @@ def levels_to_validity(def_levels: jax.Array, max_def: int):
     return mask, jnp.maximum(positions, 0)
 
 
-@jax.jit
+@functools.partial(jax.jit, static_argnames=("lanes",))
 def scatter_to_dense(packed: jax.Array, mask: jax.Array,
-                     positions: jax.Array):
+                     positions: jax.Array, lanes: int = 1):
     """Inflate packed non-null values to one-per-slot dense form (null
-    slots get 0); works on (n,) or (n, lanes) packed arrays."""
-    gathered = packed[positions]
-    if gathered.ndim > mask.ndim:
-        m = mask[:, None]
-    else:
-        m = mask
-    return jnp.where(m, gathered, jnp.zeros_like(gathered))
+    slots get 0).  ``packed`` is flat 1-D with ``lanes`` u32 words per
+    value (the DeviceColumn layout); 2-D (n, lanes) inputs are also
+    accepted for synthetic callers (output stays 2-D then)."""
+    if packed.ndim > 1:
+        gathered = packed[positions]
+        return jnp.where(mask[:, None], gathered,
+                         jnp.zeros_like(gathered))
+    if lanes == 1:
+        return jnp.where(mask, packed[positions],
+                         jnp.zeros((), dtype=packed.dtype))
+    flat = (positions[:, None] * lanes
+            + jnp.arange(lanes, dtype=positions.dtype)).reshape(-1)
+    m = jnp.repeat(mask, lanes)
+    return jnp.where(m, packed[flat], jnp.zeros((), dtype=packed.dtype))
 
 
-@jax.jit
-def dict_gather_fixed(dictionary: jax.Array, indices: jax.Array):
-    """Fixed-width dictionary gather ((D,) or (D, lanes) u32)."""
-    return dictionary[indices]
+@functools.partial(jax.jit, static_argnames=("lanes",))
+def dict_gather_fixed(dictionary: jax.Array, indices: jax.Array,
+                      lanes: int = 1):
+    """Fixed-width dictionary gather over a FLAT (D*lanes,) u32 dict."""
+    return _dict_gather_flat(dictionary, indices, lanes)
+
+
+def _dict_gather_flat(dictionary, indices, lanes: int):
+    if lanes == 1:
+        return dictionary[indices]
+    flat = (indices[:, None] * lanes
+            + jnp.arange(lanes, dtype=indices.dtype)).reshape(-1)
+    return dictionary[flat]
 
 
 # ----------------------------------------------------------------------
@@ -184,31 +205,37 @@ def expand_tbl(bp, table, cnt: int, w: int, nbp: int,
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "dcnt", "dw", "dnbp", "icnt", "iw", "inbp", "dsingle", "isingle",
-    "use_pallas"))
+    "dcnt", "dw", "dnbp", "icnt", "iw", "inbp", "lanes", "dsingle",
+    "isingle", "use_pallas"))
 def page_dict_fixed_levels_tbl(dictionary, d_bp, d_tbl, i_bp, i_tbl,
                                dcnt: int, dw: int, dnbp: int,
                                icnt: int, iw: int, inbp: int,
+                               lanes: int = 1,
                                dsingle: bool = False,
                                isingle: bool = False,
                                use_pallas: bool = False):
-    """Fused dict-page decode from packed run tables (one dispatch)."""
+    """Fused dict-page decode from packed run tables (one dispatch).
+    ``dictionary`` is flat (D*lanes,) u32; returns flat values."""
     dl = _expand_stream(d_bp, d_tbl, dcnt, dw, dnbp,
                         dsingle, use_pallas).astype(jnp.int32)
     idx = _expand_stream(i_bp, i_tbl, icnt, iw, inbp,
                          isingle, use_pallas).astype(jnp.int32)
-    vals = dictionary[jnp.minimum(idx, dictionary.shape[0] - 1)]
+    n_dict = dictionary.shape[0] // lanes
+    vals = _dict_gather_flat(dictionary, jnp.minimum(idx, n_dict - 1),
+                             lanes)
     return vals, dl
 
 
-@functools.partial(jax.jit, static_argnames=("icnt", "iw", "inbp",
+@functools.partial(jax.jit, static_argnames=("icnt", "iw", "inbp", "lanes",
                                              "isingle", "use_pallas"))
 def page_dict_fixed_tbl(dictionary, i_bp, i_tbl,
-                        icnt: int, iw: int, inbp: int,
+                        icnt: int, iw: int, inbp: int, lanes: int = 1,
                         isingle: bool = False, use_pallas: bool = False):
     idx = _expand_stream(i_bp, i_tbl, icnt, iw, inbp,
                          isingle, use_pallas).astype(jnp.int32)
-    return dictionary[jnp.minimum(idx, dictionary.shape[0] - 1)]
+    n_dict = dictionary.shape[0] // lanes
+    return _dict_gather_flat(dictionary, jnp.minimum(idx, n_dict - 1),
+                             lanes)
 
 
 @functools.partial(jax.jit, static_argnames=(
@@ -219,7 +246,7 @@ def page_plain_fixed_levels_tbl(words, d_bp, d_tbl, count: int, lanes: int,
                                 use_pallas: bool = False):
     dl = _expand_stream(d_bp, d_tbl, dcnt, dw, dnbp,
                         dsingle, use_pallas).astype(jnp.int32)
-    return words[: count * lanes].reshape(count, lanes), dl
+    return words[: count * lanes], dl
 
 
 @functools.partial(jax.jit, static_argnames=("total_bytes",))
@@ -368,15 +395,25 @@ def _add64(a, b):
     return lo, a[1] + b[1] + carry
 
 
+@jax.jit
+def _scan64_interleaved(slo, shi):
+    """Inclusive 64-bit prefix sum -> flat interleaved (lo, hi) u32.
+    One jit so the (n, 2) stack fuses away instead of materializing
+    with a 64x-padded TPU tile layout."""
+    lo, hi = jax.lax.associative_scan(_add64, (slo, shi))
+    return jnp.stack([lo, hi], axis=1).reshape(-1)
+
+
 def expand_delta_i64(plan: DeltaPlan) -> jax.Array:
     """Device: unpack each width class to (lo, hi) lanes, scatter into
     the delta stream, add min_delta (64-bit lane add), then an inclusive
-    64-bit prefix sum via ``lax.associative_scan``.  Returns (total, 2)
-    u32 — the (lo, hi) little-endian lane layout of DeviceColumn INT64."""
+    64-bit prefix sum via ``lax.associative_scan``.  Returns flat
+    (total*2,) u32 — the interleaved (lo, hi) little-endian lane layout
+    of DeviceColumn INT64."""
     from .bitunpack import unpack_u64
 
     if plan.total == 0:
-        return jnp.zeros((0, 2), dtype=jnp.uint32)
+        return jnp.zeros((0,), dtype=jnp.uint32)
     n_deltas = plan.total - 1
     first_u = plan.first & 0xFFFFFFFFFFFFFFFF
     first = jnp.asarray(
@@ -384,7 +421,7 @@ def expand_delta_i64(plan: DeltaPlan) -> jax.Array:
         dtype=jnp.uint32,
     )
     if n_deltas == 0:
-        return first
+        return first.reshape(-1)
     dlo = jnp.zeros((n_deltas,), dtype=jnp.uint32)
     dhi = jnp.zeros((n_deltas,), dtype=jnp.uint32)
     for w, words, positions, keep, n_vals in plan.groups:
@@ -399,5 +436,4 @@ def expand_delta_i64(plan: DeltaPlan) -> jax.Array:
     flo, fhi = _add64((dlo, dhi), (md_lo, md_hi))
     slo = jnp.concatenate([first[:, 0], flo])
     shi = jnp.concatenate([first[:, 1], fhi])
-    lo, hi = jax.lax.associative_scan(_add64, (slo, shi))
-    return jnp.stack([lo, hi], axis=1)
+    return _scan64_interleaved(slo, shi)

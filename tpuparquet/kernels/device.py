@@ -66,6 +66,20 @@ _LANES = {
     Type.INT96: 3,
 }
 
+
+def _lanes_for(ptype: Type, type_length) -> int:
+    """u32 words per value in the flat device layout.
+
+    Value buffers are FLAT 1-D u32 at every jit boundary: a 2-D
+    ``u32[n, lanes]`` TPU output is tiled T(8,128) with the minor dim
+    padded to 128 — 64x HBM waste for int64 (measured: a 50M-value
+    int64 chunk would allocate 25.6 GB and OOM)."""
+    if ptype == Type.BOOLEAN:
+        return 1
+    if ptype == Type.FIXED_LEN_BYTE_ARRAY:
+        return _flba_lanes(type_length)
+    return _LANES[ptype]
+
 _DICT_ENCODINGS = (Encoding.PLAIN_DICTIONARY, Encoding.RLE_DICTIONARY)
 
 # Device-side snappy decompression of PLAIN fixed-width value segments
@@ -119,8 +133,10 @@ def _plan_device_snappy_words(payload, expected_size: int, n_words: int,
 class DeviceColumn:
     """Device-resident decoded column (Arrow layout).
 
-    ``data``: (n_non_null, lanes) u32 for fixed-width types, or u8 bytes
-    with ``offsets`` for BYTE_ARRAY.  ``mask``/``positions`` map record
+    ``data``: flat (n_non_null * lanes,) u32 for fixed-width types
+    (``lanes`` little-endian words per value — see :func:`_lanes_for`
+    for why the buffer is 1-D), or u8 bytes with ``offsets`` for
+    BYTE_ARRAY.  ``mask``/``positions`` map record
     slots to packed values; ``rep_levels``/``def_levels`` preserve nesting.
 
     Buffers are stored *bucket-padded* (the shape the fused page kernels
@@ -148,10 +164,18 @@ class DeviceColumn:
         self.num_values = num_values
         self.n_packed = (
             n_packed if n_packed is not None
-            else (None if data is None else data.shape[0])
+            else (None if data is None
+                  else data.shape[0] // (self.lanes or 1))
         )
         self.n_bytes = n_bytes  # BYTE_ARRAY only: logical data length
         self._cache = {}
+
+    @property
+    def lanes(self):
+        """u32 words per value (fixed-width types; None for BYTE_ARRAY)."""
+        if self.offsets is not None:
+            return None
+        return _lanes_for(self.ptype, self.type_length)
 
     # -- lazy exact-shape accessors ---------------------------------------
 
@@ -175,8 +199,8 @@ class DeviceColumn:
                 "data", self._data_p, self.n_bytes,
                 lambda: jnp.zeros((0,), dtype=jnp.uint8))
         return self._sliced(
-            "data", self._data_p, self.n_packed,
-            lambda: jnp.zeros((0, 1), dtype=jnp.uint32))
+            "data", self._data_p, (self.n_packed or 0) * self.lanes,
+            lambda: jnp.zeros((0,), dtype=jnp.uint32))
 
     @property
     def mask(self):
@@ -230,23 +254,25 @@ class DeviceColumn:
             offs = np.asarray(self.offsets, dtype=np.int64)
             data = np.asarray(self._data_p, dtype=np.uint8)[: int(offs[-1])]
             return ByteArrayColumn(offs, data), rep, dl
-        lanes = np.asarray(self._data_p, dtype=np.uint32)[: self.n_packed]
+        lanes = self.lanes
+        flat = np.asarray(self._data_p, dtype=np.uint32)[
+            : self.n_packed * lanes]
         if self.ptype == Type.BOOLEAN:
-            return lanes.reshape(-1).astype(bool), rep, dl
+            return flat.astype(bool), rep, dl
         if self.ptype == Type.INT32:
-            return lanes.reshape(-1).view(np.int32), rep, dl
+            return flat.view(np.int32), rep, dl
         if self.ptype == Type.FLOAT:
-            return lanes.reshape(-1).view(np.float32), rep, dl
+            return flat.view(np.float32), rep, dl
         if self.ptype == Type.INT64:
-            return lanes.reshape(-1).view(np.uint8).view("<i8"), rep, dl
+            return flat.view(np.uint8).view("<i8"), rep, dl
         if self.ptype == Type.DOUBLE:
-            return lanes.reshape(-1).view(np.uint8).view("<f8"), rep, dl
+            return flat.view(np.uint8).view("<f8"), rep, dl
         if self.ptype == Type.INT96:
-            return lanes.reshape(-1, 3), rep, dl
+            return flat.reshape(-1, 3), rep, dl
         if self.ptype == Type.FIXED_LEN_BYTE_ARRAY:
             n = self.type_length
             return (
-                lanes.reshape(-1).view(np.uint8).reshape(-1, 4 * lanes.shape[1])[:, :n],
+                flat.view(np.uint8).reshape(-1, 4 * lanes)[:, :n],
                 rep, dl,
             )
         raise TypeError(f"unsupported type {self.ptype}")
@@ -256,7 +282,7 @@ def _stage_fixed_plain(raw: bytes, count: int, ptype: Type,
                        type_length) -> jax.Array:
     if ptype == Type.BOOLEAN:
         words = pad_to_words(np.frombuffer(raw, np.uint8), 1, count)
-        return unpack_u32(jnp.asarray(words), 1, count)[:, None]
+        return unpack_u32(jnp.asarray(words), 1, count)
     if ptype == Type.FIXED_LEN_BYTE_ARRAY:
         return _stage_byte_rows(
             np.frombuffer(raw, np.uint8, count * type_length).reshape(
@@ -273,13 +299,13 @@ def _flba_lanes(type_length: int) -> int:
 
 
 def _stage_byte_rows_np(arr: np.ndarray) -> np.ndarray:
-    """(N, L) u8 rows -> (N, lanes) u32, zero-padding each row to whole
-    little-endian u32 lanes (shared FLBA/int96 staging)."""
+    """(N, L) u8 rows -> flat (N*lanes,) u32, zero-padding each row to
+    whole little-endian u32 lanes (shared FLBA/int96 staging)."""
     rows = arr.view(np.uint8).reshape(arr.shape[0], -1)
     lanes = _flba_lanes(rows.shape[1])
     padded = np.zeros((rows.shape[0], lanes * 4), dtype=np.uint8)
     padded[:, : rows.shape[1]] = rows
-    return padded.reshape(-1, lanes, 4).view("<u4")[..., 0]
+    return padded.view("<u4").reshape(-1)
 
 
 def _stage_byte_rows(arr: np.ndarray) -> jax.Array:
@@ -481,7 +507,7 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
     end = start + cm.total_compressed_size
     r = CompactReader(blob, start, end)
 
-    dict_fixed_h = None    # stager handle: (D, lanes) u32
+    dict_fixed_h = None    # stager handle: flat (D*lanes,) u32
     dict_offsets_h = None  # stager handles: byte-array dictionary
     dict_data_h = None
     dict_lens_np = None
@@ -495,6 +521,8 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
     total = cm.num_values
     max_def = node.max_def_level
     dwidth = max_def.bit_length()
+    vlanes = (None if ptype == Type.BYTE_ARRAY
+              else _lanes_for(ptype, node.element.type_length))
 
     while values_read < total:
         if r.pos >= end:
@@ -540,18 +568,18 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 dict_len = len(dict_lens_np)
             else:
                 arr = np.asarray(dict_np)
+                dict_len = arr.shape[0]
                 if arr.dtype == np.bool_:
-                    staged = arr.astype(np.uint32)[:, None]
+                    staged = arr.astype(np.uint32).reshape(-1)
                 elif arr.dtype in (np.dtype("<i4"), np.dtype("<f4")):
-                    staged = arr.view("<u4")[:, None]
+                    staged = arr.view("<u4").reshape(-1)
                 elif arr.dtype in (np.dtype("<i8"), np.dtype("<f8")):
-                    staged = arr.view("<u4").reshape(-1, 2)
+                    staged = arr.view("<u4").reshape(-1)
                 elif ptype == Type.INT96:
-                    staged = arr.astype("<u4")
+                    staged = arr.astype("<u4").reshape(-1)
                 else:  # FLBA (D, L) u8
                     staged = _stage_byte_rows_np(arr)
                 dict_fixed_h = stager.add(staged)
-                dict_len = staged.shape[0]
             if r.pos != cm.data_page_offset - base:
                 r.pos = cm.data_page_offset - base
             continue
@@ -733,13 +761,13 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
 
                     def op(s, p, _d=dl_ref, _i=idx_ref, _n=n,
                            _nn=non_null, _w=width, _dh=dict_fixed_h,
-                           _upl=pallas_expand_enabled()):
+                           _vl=vlanes, _upl=pallas_expand_enabled()):
                         vals, dl_dev = page_dict_fixed_levels_tbl(
                             s[_dh],
                             s[_d[0][0]], s[_d[0][1]],
                             s[_i[0][0]], s[_i[0][1]],
                             _d[1], dwidth, _d[2], _i[1], _w, _i[2],
-                            dsingle=_d[3], isingle=_i[3],
+                            lanes=_vl, dsingle=_d[3], isingle=_i[3],
                             use_pallas=_upl,
                         )
                         p["def"].append((dl_dev, _n))
@@ -749,10 +777,12 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 else:
                     _def_standalone()
                     if idx_ref is None:
-                        def op(s, p, _nn=non_null, _dh=dict_fixed_h):
+                        def op(s, p, _nn=non_null, _dh=dict_fixed_h,
+                               _vl=vlanes):
                             idx = jnp.zeros((_nn,), jnp.int32)
                             p["val"].append(
-                                (dict_gather_fixed(s[_dh], idx), _nn)
+                                (dict_gather_fixed(s[_dh], idx,
+                                                   lanes=_vl), _nn)
                             )
 
                         ops.append(op)
@@ -760,12 +790,12 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                         from .decode import page_dict_fixed_tbl
 
                         def op(s, p, _i=idx_ref, _nn=non_null, _w=width,
-                               _dh=dict_fixed_h,
+                               _dh=dict_fixed_h, _vl=vlanes,
                                _upl=pallas_expand_enabled()):
                             vals = page_dict_fixed_tbl(
                                 s[_dh], s[_i[0][0]], s[_i[0][1]],
-                                _i[1], _w, _i[2], isingle=_i[3],
-                                use_pallas=_upl,
+                                _i[1], _w, _i[2], lanes=_vl,
+                                isingle=_i[3], use_pallas=_upl,
                             )
                             p["val"].append((vals, _nn))
 
@@ -909,7 +939,7 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 ops.append(
                     lambda s, p, _pl=plan, _nn=non_null:
                     p["val"].append(
-                        (expand_delta_i32(_pl)[:_nn, None], _nn)
+                        (expand_delta_i32(_pl)[:_nn], _nn)
                     )
                 )
             else:
@@ -917,7 +947,7 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 ops.append(
                     lambda s, p, _pl=plan, _nn=non_null:
                     p["val"].append(
-                        (expand_delta_i64(_pl)[:_nn], _nn)
+                        (expand_delta_i64(_pl)[: _nn * 2], _nn)
                     )
                 )
         else:
@@ -978,7 +1008,7 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                                 n_packed=sum(len(o) for o in all_offs) - 1,
                                 n_bytes=base_off)
 
-        data, n_packed = _merge_parts(parts["val"])
+        data, n_packed = _merge_parts(parts["val"], lanes=vlanes)
         return DeviceColumn(ptype, type_length, data, None, mask,
                             positions, rep, dl, total,
                             n_packed=n_packed or 0)
@@ -1019,16 +1049,18 @@ def _defer_levels(ops, stager, kind, scan, host_vals, n, width,
         ops.append(lambda s, p, _h=hh, _n=n: p[kind].append((s[_h], _n)))
 
 
-def _merge_parts(parts):
+def _merge_parts(parts, lanes: int = 1):
     """Merge [(padded device array, logical n)] -> (array, total n).
 
     Single-part chunks keep their padding (consumers slice lazily);
-    multi-part chunks slice then concatenate."""
+    multi-part chunks slice then concatenate.  ``lanes`` scales the
+    slice for flat value buffers (n u32 words per value)."""
     if not parts:
         return None, 0
     if len(parts) == 1:
         return parts[0]
-    arrs = [a if a.shape[0] == m else a[:m] for a, m in parts]
+    k = lanes or 1
+    arrs = [a if a.shape[0] == m * k else a[: m * k] for a, m in parts]
     return jnp.concatenate(arrs), sum(m for _, m in parts)
 
 
@@ -1171,13 +1203,13 @@ def decode_values_cpu(ptype, enc, data, count, type_length):
 
 
 def _stage_numpy_fixed(col, ptype: Type) -> jax.Array:
+    """Host-decoded values -> flat u32 lane buffer."""
     arr = np.asarray(col)
     if arr.dtype == np.bool_:
-        return jnp.asarray(arr.astype(np.uint32)[:, None])
-    if arr.dtype.itemsize == 4:
-        return jnp.asarray(arr.view("<u4").reshape(-1, 1))
-    if arr.dtype.itemsize == 8:
-        return jnp.asarray(arr.view("<u4").reshape(-1, 2))
+        return jnp.asarray(arr.astype(np.uint32).reshape(-1))
+    if arr.dtype.itemsize in (4, 8):
+        return jnp.asarray(np.ascontiguousarray(arr).view("<u4")
+                           .reshape(-1))
     if arr.ndim == 2:  # FLBA / int96 byte matrices
         return _stage_byte_rows(arr)
     raise TypeError(f"cannot stage {arr.dtype} for {ptype}")

@@ -164,32 +164,35 @@ def gather_column(mesh, results: list[dict[str, DeviceColumn]], path: str):
     if any(c.offsets is not None for c in cols):
         raise TypeError("gather_column handles fixed-width columns; "
                         "use gather_byte_column for BYTE_ARRAY")
+    lanes = cols[0].lanes if cols else 1
+    if any(c.lanes != lanes for c in cols):
+        raise TypeError("gather_column units disagree on value width")
+    # flat (num_values*lanes,) per unit: device buffers stay 1-D (a 2-D
+    # (n, lanes) stack would tile T(8,128) on TPU — 64x HBM padding)
     dense = [
-        scatter_to_dense(
-            c.data if c.data.ndim > 1 else c.data[:, None],
-            c.mask, c.positions,
-        )
+        scatter_to_dense(c.data, c.mask, c.positions, lanes=lanes)
         for c in cols
     ]
-    counts = np.asarray([d.shape[0] for d in dense], dtype=np.int64)
+    counts = np.asarray([c.num_values for c in cols], dtype=np.int64)
     L = int(counts.max()) if len(counts) else 0
-    lanes = dense[0].shape[1] if dense else 1
     n_dev = len(list(mesh.devices.flat))
     U = max(len(dense), 1)
     U = ((U + n_dev - 1) // n_dev) * n_dev
     # pad each unit then stack once: O(U*L) total, vs the O(U^2 * L)
     # of per-unit .at[].set updates on the stacked array
     padded = [
-        jnp.pad(d.astype(jnp.uint32), ((0, L - d.shape[0]), (0, 0)))
+        jnp.pad(d.astype(jnp.uint32), (0, L * lanes - d.shape[0]))
         for d in dense
     ]
-    padded += [jnp.zeros((L, lanes), dtype=jnp.uint32)] * (U - len(dense))
+    padded += [jnp.zeros((L * lanes,), dtype=jnp.uint32)] * (U - len(dense))
     stacked = jnp.stack(padded)
     sharded = jax.device_put(stacked, NamedSharding(mesh, P("rg")))
     gathered = jax.jit(
         lambda x: x, out_shardings=NamedSharding(mesh, P())
     )(sharded)
-    return np.asarray(gathered)[: len(dense)], counts
+    # host-side reshape to the (U, L, lanes) view callers index
+    out = np.asarray(gathered).reshape(U, L, lanes)
+    return out[: len(dense)], counts
 
 
 def gather_byte_column(mesh, results: list[dict[str, DeviceColumn]],
