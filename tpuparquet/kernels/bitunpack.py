@@ -165,7 +165,16 @@ def _unpack_block_unrolled(words, width: int):
     """Same math as :func:`_unpack_block_math` but with the per-lane index
     tables unrolled into static Python ints — Pallas kernels may not
     capture array constants, and 32 static shift/or ops map straight onto
-    the VPU anyway."""
+    the VPU anyway.
+
+    The word-straddle contribution uses ``hi * 2^k`` instead of
+    ``hi << k``: Mosaic (TPU v5e, measured on hardware 2026-07)
+    miscompiles the ``(lo >> sh) | (hi << (32 - sh))`` pattern for
+    straddle lanes with sh >= 16 — bit 16+ of the hi contribution is
+    data-dependently dropped for every width >= 17, while interpret mode
+    is bit-exact.  The u32-wraparound multiply is the same value and
+    compiles correctly at every width (verified by an on-chip sweep vs
+    the CPU oracle, widths 1..32)."""
     if width == 32:
         return words
     widx, widx2, shift = plan_tables(width)
@@ -175,7 +184,8 @@ def _unpack_block_unrolled(words, width: int):
         sh = shift[i]
         lo = words[:, widx[i]] >> np.uint32(sh)
         if sh + width > 32:
-            lo = lo | (words[:, widx2[i]] << np.uint32(32 - sh))
+            lo = lo | (words[:, widx2[i]]
+                       * np.uint32((1 << (32 - sh)) & 0xFFFFFFFF))
         cols.append(lo & mask)
     return jnp.stack(cols, axis=1)
 
@@ -184,10 +194,16 @@ def _unpack_kernel(words_ref, out_ref, *, width: int):
     out_ref[:] = _unpack_block_unrolled(words_ref[:], width)
 
 
+@functools.partial(jax.jit, static_argnames=("width", "count",
+                                             "block_rows", "interpret"))
 def unpack_u32_pallas(words: jax.Array, width: int, count: int,
                       block_rows: int = 512, interpret: bool = False):
     """Pallas version: grid over row-blocks of the words matrix, VPU
-    shift/mask math in VMEM.  Semantics identical to :func:`unpack_u32`."""
+    shift/mask math in VMEM.  Semantics identical to :func:`unpack_u32`.
+
+    Jitted so eager callers (and the A/B harness) don't pay a re-trace
+    + re-lower of the pallas_call per invocation; inside the fused page
+    kernels the enclosing jit makes this a no-op."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 

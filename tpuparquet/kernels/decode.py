@@ -107,10 +107,15 @@ def scatter_to_dense(packed: jax.Array, mask: jax.Array,
     if lanes == 1:
         return jnp.where(mask, packed[positions],
                          jnp.zeros((), dtype=packed.dtype))
-    flat = (positions[:, None] * lanes
-            + jnp.arange(lanes, dtype=positions.dtype)).reshape(-1)
     m = jnp.repeat(mask, lanes)
-    return jnp.where(m, packed[flat], jnp.zeros((), dtype=packed.dtype))
+    return jnp.where(m, packed[_flat_lane_indices(positions, lanes)],
+                     jnp.zeros((), dtype=packed.dtype))
+
+
+def _flat_lane_indices(idx, lanes: int):
+    """Value indices -> flat word indices in a (n*lanes,) lane buffer."""
+    return (idx[:, None] * lanes
+            + jnp.arange(lanes, dtype=idx.dtype)).reshape(-1)
 
 
 @functools.partial(jax.jit, static_argnames=("lanes",))
@@ -123,9 +128,7 @@ def dict_gather_fixed(dictionary: jax.Array, indices: jax.Array,
 def _dict_gather_flat(dictionary, indices, lanes: int):
     if lanes == 1:
         return dictionary[indices]
-    flat = (indices[:, None] * lanes
-            + jnp.arange(lanes, dtype=indices.dtype)).reshape(-1)
-    return dictionary[flat]
+    return dictionary[_flat_lane_indices(indices, lanes)]
 
 
 # ----------------------------------------------------------------------
