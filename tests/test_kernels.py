@@ -40,8 +40,12 @@ class TestBitUnpackDevice:
         out = np.asarray(unpack_u32(jnp.asarray(words), width, 1000))
         np.testing.assert_array_equal(out, vals.astype(np.uint32))
 
-    @pytest.mark.parametrize("width", [3, 8, 20])
+    @pytest.mark.parametrize("width", list(range(1, 33)))
     def test_pallas_interpret_matches(self, width):
+        """Every width 1..32: the unrolled Pallas math (including the
+        multiply-based straddle contribution that works around the
+        Mosaic sh>=16 shift miscompile — see _unpack_block_unrolled)
+        must equal the XLA formulation and the true values."""
         hi = (1 << width) - 1
         vals = rng.integers(0, hi, size=500, endpoint=True, dtype=np.uint64)
         packed = pack(vals, width)
@@ -52,6 +56,7 @@ class TestBitUnpackDevice:
         b = np.asarray(
             unpack_u32_pallas(words, width, 500, interpret=True)
         )
+        np.testing.assert_array_equal(a, vals.astype(np.uint32))
         np.testing.assert_array_equal(a, b)
 
     def test_count_not_multiple_of_32(self):

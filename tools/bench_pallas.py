@@ -50,7 +50,9 @@ def main():
         from tpuparquet.cpu.bitpack import pack
 
         packed = pack(vals, width)
-        words = jax.device_put(pad_to_words(packed, width, n))
+        # flat staging, as the production planners ship it (a 2-D
+        # (n_blocks, width) device buffer tiles its minor dim to 128)
+        words = jax.device_put(pad_to_words(packed, width, n).reshape(-1))
         t_xla = timeit(lambda w: unpack_u32(w, width, n), words)
         t_pal = timeit(lambda w: unpack_u32_pallas(w, width, n), words)
         # parity between the two device formulations

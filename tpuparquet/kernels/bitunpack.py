@@ -91,10 +91,15 @@ def _unpack_block_math(words, width: int):
 def unpack_u32(words: jax.Array, width: int, count: int) -> jax.Array:
     """Unpack LSB-first ``width``-bit values (device, jnp path).
 
-    ``words``: (n_blocks, width) u32 from :func:`pad_to_words`.
-    Returns (count,) u32."""
+    ``words``: u32 words from :func:`pad_to_words` — either the 2-D
+    (n_blocks, width) matrix or its FLAT 1-D form.  Ship flat: a 2-D
+    u32 array with a <=32 minor dim tiles to 128 lanes on TPU (up to
+    128/width x transient HBM); the reshape here happens inside the jit
+    and fuses into the column gathers.  Returns (count,) u32."""
     if width == 0:
         return jnp.zeros((count,), dtype=jnp.uint32)
+    if words.ndim == 1:
+        words = words.reshape(-1, width)
     out = _unpack_block_math(words.astype(jnp.uint32), width)
     return out.reshape(-1)[:count]
 
@@ -148,6 +153,8 @@ def unpack_u64(words: jax.Array, width: int, count: int):
     if width <= 32:
         lo = unpack_u32(words, width, count)
         return lo, jnp.zeros((count,), dtype=jnp.uint32)
+    if words.ndim == 1:
+        words = words.reshape(-1, width)
     words = words.astype(jnp.uint32)
     widx, widx2, widx3, shift = plan_tables64(width)
     shift = jnp.asarray(shift, dtype=jnp.uint32)
@@ -169,12 +176,14 @@ def _unpack_block_unrolled(words, width: int):
 
     The word-straddle contribution uses ``hi * 2^k`` instead of
     ``hi << k``: Mosaic (TPU v5e, measured on hardware 2026-07)
-    miscompiles the ``(lo >> sh) | (hi << (32 - sh))`` pattern for
-    straddle lanes with sh >= 16 — bit 16+ of the hi contribution is
-    data-dependently dropped for every width >= 17, while interpret mode
-    is bit-exact.  The u32-wraparound multiply is the same value and
-    compiles correctly at every width (verified by an on-chip sweep vs
-    the CPU oracle, widths 1..32)."""
+    miscompiles the ``(lo >> sh) | (hi << (32 - sh))`` pattern — every
+    width >= 17 data-dependently corrupts high bits of the straddle
+    contribution, while widths <= 16 (including their straddle lanes,
+    e.g. sh=30 at width 3) decode clean and interpret mode is bit-exact
+    at every width, so the precise codegen trigger lives in Mosaic.
+    The u32-wraparound multiply is the same value for every straddle
+    lane and compiles correctly at every width (verified by an on-chip
+    sweep vs the CPU oracle, widths 1..32)."""
     if width == 32:
         return words
     widx, widx2, shift = plan_tables(width)
@@ -211,6 +220,8 @@ def unpack_u32_pallas(words: jax.Array, width: int, count: int,
         return jnp.zeros((count,), dtype=jnp.uint32)
     if not interpret and jax.default_backend() != "tpu":
         interpret = True  # Mosaic only compiles for TPU
+    if words.ndim == 1:
+        words = words.reshape(-1, width)
     n_blocks = words.shape[0]
     rows = min(block_rows, max(n_blocks, 1))
     grid = (pl.cdiv(n_blocks, rows),)

@@ -339,7 +339,8 @@ def _plan_delta(data, pos: int, max_width: int) -> DeltaPlan:
             continue  # deltas are all zero; min_delta carries the value
         packed = np.concatenate([s for s, _, _ in segs])
         n_vals = mb_size * len(segs)
-        words = pad_to_words(packed, w, n_vals)
+        # flat: a 2-D (n_blocks, w) device buffer tiles to 128 lanes
+        words = pad_to_words(packed, w, n_vals).reshape(-1)
         positions = np.concatenate([
             np.arange(start, start + take, dtype=np.int32)
             for _, start, take in segs

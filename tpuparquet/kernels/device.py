@@ -282,7 +282,7 @@ def _stage_fixed_plain(raw: bytes, count: int, ptype: Type,
                        type_length) -> jax.Array:
     if ptype == Type.BOOLEAN:
         words = pad_to_words(np.frombuffer(raw, np.uint8), 1, count)
-        return unpack_u32(jnp.asarray(words), 1, count)
+        return unpack_u32(jnp.asarray(words.reshape(-1)), 1, count)
     if ptype == Type.FIXED_LEN_BYTE_ARRAY:
         return _stage_byte_rows(
             np.frombuffer(raw, np.uint8, count * type_length).reshape(

@@ -227,7 +227,8 @@ def pad_plan(p: HybridPlan):
     run_value[: len(p.run_value)] = p.run_value
     run_bp_start = np.zeros(R, dtype=np.int32)
     run_bp_start[: len(p.run_bp_start)] = p.run_bp_start
-    return (bp_words, run_ends, run_is_rle, run_value,
+    # flat bp words, same as pack_plan (2-D tiles to 128 lanes on TPU)
+    return (bp_words.reshape(-1), run_ends, run_is_rle, run_value,
             run_bp_start), cnt, p.width, n_bp
 
 
@@ -254,7 +255,10 @@ def pack_plan(p: HybridPlan):
     table[1, : len(p.run_is_rle)] = p.run_is_rle.astype(np.uint32)
     table[2, : len(p.run_value)] = p.run_value
     table[3, : len(p.run_bp_start)] = p.run_bp_start.astype(np.uint32)
-    return (bp_words, table), cnt, p.width, n_bp
+    # bp words ship FLAT: a (n_blocks, w) u32 device buffer tiles its
+    # <=32-wide minor dim to 128 lanes on TPU (128/w x transient HBM);
+    # the unpack kernels reshape inside their jit, where it fuses
+    return (bp_words.reshape(-1), table), cnt, p.width, n_bp
 
 
 def expand_plan_padded(p: HybridPlan):

@@ -75,7 +75,8 @@ class BatchedHybridPlan:
     """A stack of :class:`HybridPlan` padded to common static shapes.
 
     Array shapes (U = padded unit count, R = run bucket, B = bp-word
-    bucket): ``bp_words`` (U, B_blocks, width), ``run_ends`` /
+    bucket): ``bp_words`` (U, B_blocks*width) — flat per-unit rows, the
+    unpack kernel reshapes inside its jit — ``run_ends`` /
     ``run_is_rle`` / ``run_value`` / ``run_bp_start`` (U, R).  ``count``
     is the padded per-unit value count; ``counts`` the true per-unit
     counts (for unpadding on the host afterwards).
@@ -142,9 +143,12 @@ def stack_hybrid_plans(plans: list[HybridPlan], n_units: int | None = None,
         run_value[u, :nr] = p.run_value
         run_bp_start[u, :nr] = p.run_bp_start
         counts[u] = p.count
-    return BatchedHybridPlan(bp_words, run_ends, run_is_rle, run_value,
-                             run_bp_start, count, width, n_bp, counts,
-                             true_n)
+    # per-unit bp words flatten to (U, B_blocks*width): a <=32 minor
+    # dim would tile to 128 lanes on TPU; the unpack kernel reshapes
+    # its 1-D row inside the jit
+    return BatchedHybridPlan(bp_words.reshape(n_units, -1), run_ends,
+                             run_is_rle, run_value, run_bp_start, count,
+                             width, n_bp, counts, true_n)
 
 
 def _expand_slice(bw, re, rr, rv, rs, idx, width: int, n_bp: int):
