@@ -268,9 +268,16 @@ def _cpu_checksum(cd) -> dict:
             "n": len(cd.def_levels)}
 
 
+_CKSUM_JITS: dict = {}
+
+
 def _device_checksum(col) -> dict:
     """Same sums computed on device; only scalars cross to the host.
-    Needs x64 (sums wrap mod 2^64 like the numpy side)."""
+    Needs x64 (sums wrap mod 2^64 like the numpy side).  Each variant
+    is ONE jitted dispatch returning three scalars — eager per-op
+    execution here costs a tunnel round trip per op on the
+    remote-attached TPU, and the parity phase runs it for every
+    (row group x column)."""
     import jax
     import jax.numpy as jnp
 
@@ -283,17 +290,35 @@ def _device_checksum(col) -> dict:
                    % jnp.uint64(idx_mod))
             return jnp.sum(x * (pos + jnp.uint64(1)), dtype=jnp.uint64)
 
+        if "bytes" not in _CKSUM_JITS:
+            @jax.jit
+            def _ck_bytes(data, offs, rep, dl):
+                offs = offs.astype(jnp.uint64)
+                v = wsum(data) + jnp.sum(
+                    offs * ((jnp.arange(offs.shape[0], dtype=jnp.uint64)
+                             % jnp.uint64(idx_mod)) + jnp.uint64(1)),
+                    dtype=jnp.uint64)
+                lv = (jnp.sum(rep.astype(jnp.uint64))
+                      + jnp.sum(dl.astype(jnp.uint64)))
+                return v, lv
+
+            @jax.jit
+            def _ck_fixed(data, rep, dl):
+                lv = (jnp.sum(rep.astype(jnp.uint64))
+                      + jnp.sum(dl.astype(jnp.uint64)))
+                return wsum(data), lv
+
+            _CKSUM_JITS["bytes"] = _ck_bytes
+            _CKSUM_JITS["fixed"] = _ck_fixed
+
         if col.offsets is not None:
-            offs = col.offsets.astype(jnp.uint64)
-            val = int(wsum(col.data)) + int(
-                jnp.sum(offs * ((jnp.arange(offs.shape[0], dtype=jnp.uint64)
-                                 % jnp.uint64(idx_mod)) + jnp.uint64(1)),
-                        dtype=jnp.uint64))
+            v, lv = _CKSUM_JITS["bytes"](col.data, col.offsets,
+                                         col.rep_levels, col.def_levels)
         else:
-            val = int(wsum(col.data))
-        lv = int(jnp.sum(col.rep_levels.astype(jnp.uint64))
-                 + jnp.sum(col.def_levels.astype(jnp.uint64)))
-    return {"v": val & 0xFFFFFFFFFFFFFFFF, "l": lv, "n": col.num_values}
+            v, lv = _CKSUM_JITS["fixed"](col.data, col.rep_levels,
+                                         col.def_levels)
+        val, lvi = int(v), int(lv)
+    return {"v": val & 0xFFFFFFFFFFFFFFFF, "l": lvi, "n": col.num_values}
 
 
 def parity(reader) -> None:

@@ -22,6 +22,7 @@ from .bitunpack import pad_to_words, unpack_u32
 
 __all__ = [
     "stage_u32",
+    "bss_to_lanes",
     "plain_fixed_to_lanes",
     "levels_to_validity",
     "scatter_to_dense",
@@ -64,6 +65,23 @@ def u8_to_u32_words(b: jax.Array, n_words: int):
     host (e.g. the device snappy decompressor's output)."""
     w = b[: n_words * 4].astype(jnp.uint32).reshape(-1, 4)
     return w[:, 0] | (w[:, 1] << 8) | (w[:, 2] << 16) | (w[:, 3] << 24)
+
+
+@functools.partial(jax.jit, static_argnames=("count", "k", "lanes"))
+def bss_to_lanes(raw: jax.Array, count: int, k: int, lanes: int):
+    """BYTE_STREAM_SPLIT decode on device: ``k`` byte streams of
+    ``count`` bytes each -> flat (count*lanes,) u32 little-endian lane
+    words.  The scatter of value bytes across streams
+    (``cpu/bss.py``) inverts to one transpose — ideal device work:
+    no sequential structure at all."""
+    streams = raw[: k * count].reshape(k, count)
+    rows = streams.T                                  # (count, k) u8
+    if k != lanes * 4:
+        rows = jnp.pad(rows, ((0, 0), (0, lanes * 4 - k)))
+    b = rows.reshape(count, lanes, 4).astype(jnp.uint32)
+    words = (b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16)
+             | (b[..., 3] << 24))
+    return words.reshape(-1)
 
 
 @functools.partial(jax.jit, static_argnames=("count", "lanes"))

@@ -11,11 +11,12 @@ Current device coverage (the rest falls back to the CPU oracle per value
 segment, still staged into the same DeviceColumn):
 
 * PLAIN int32/int64/float/double/int96/FLBA (reinterpret staging)
-* PLAIN boolean (width-1 unpack)
+* PLAIN boolean (width-1 unpack) and RLE boolean (run-table expand)
 * RLE_DICTIONARY indices (run-table expand) + dictionary gather,
   fixed-width and variable-width (byte-level gather)
 * definition/repetition levels (run-table expand) + validity fusion
 * DELTA_BINARY_PACKED int32 and int64 (two-u32-lane arithmetic)
+* BYTE_STREAM_SPLIT int32/int64/float/double/FLBA (device transpose)
 """
 
 from __future__ import annotations
@@ -931,6 +932,45 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                         _nn,
                     ))
                 )
+        elif enc == Encoding.BYTE_STREAM_SPLIT and ptype in (
+                Type.INT32, Type.INT64, Type.FLOAT, Type.DOUBLE,
+                Type.FIXED_LEN_BYTE_ARRAY):
+            from .decode import bss_to_lanes
+
+            _def_standalone()
+            k = (node.element.type_length
+                 if ptype == Type.FIXED_LEN_BYTE_ARRAY
+                 else 4 * _LANES[ptype])
+            raw_np = (values_seg.reshape(-1)
+                      if isinstance(values_seg, np.ndarray)
+                      else np.frombuffer(values_seg, dtype=np.uint8))
+            if raw_np.size < non_null * k:
+                raise ValueError("BYTE_STREAM_SPLIT: input too short")
+            if non_null:
+                rh = stager.add(raw_np[: non_null * k])
+                ops.append(
+                    lambda s, p, _rh=rh, _nn=non_null, _k=k, _vl=vlanes:
+                    p["val"].append(
+                        (bss_to_lanes(s[_rh], _nn, _k, _vl), _nn)
+                    )
+                )
+        elif enc == Encoding.RLE and ptype == Type.BOOLEAN:
+            # boolean RLE data values: a length-prefixed width-1 hybrid
+            # stream — the same run-table deferral as the levels
+            import struct
+
+            from ..cpu.hybrid import scan_hybrid
+
+            _def_standalone()
+            if len(values_seg) < 4:
+                raise ValueError("boolean RLE stream missing length")
+            (bsz,) = struct.unpack_from("<I", values_seg, 0)
+            if 4 + bsz > len(values_seg):
+                raise ValueError("boolean RLE length exceeds page")
+            if non_null:
+                b_sc = scan_hybrid(values_seg[4 : 4 + bsz], non_null, 1)
+                _defer_levels(ops, stager, "val", b_sc, None, non_null, 1,
+                              cast=None)
         elif enc == Encoding.DELTA_BINARY_PACKED and ptype in (
                 Type.INT32, Type.INT64):
             _def_standalone()
@@ -1017,9 +1057,11 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
 
 
 def _defer_levels(ops, stager, kind, scan, host_vals, n, width,
-                  max_level=None):
-    """Register a deferred level expansion: hybrid plan -> device expand,
-    or host-decoded values -> staged transfer.  ``max_level`` enables the
+                  max_level=None, cast=jnp.int32):
+    """Register a deferred hybrid-stream expansion: scan -> device
+    expand, or host-decoded values -> staged transfer.  Levels use the
+    default int32 ``cast``; value streams (boolean RLE) pass
+    ``cast=None`` to keep the expand's u32.  ``max_level`` enables the
     range validation of ``cpu/levels._check`` (rep levels would otherwise
     silently mis-nest on corrupt streams)."""
     if scan is not None:
@@ -1040,7 +1082,9 @@ def _defer_levels(ops, stager, kind, scan, host_vals, n, width,
             dev = expand_tbl(
                 s[_hs[0]], s[_hs[1]], _cnt, _w, _nbp, single=_sg,
                 use_pallas=_upl,
-            ).astype(jnp.int32)
+            )
+            if cast is not None:
+                dev = dev.astype(cast)
             p[kind].append((dev, _n))
 
         ops.append(op)
