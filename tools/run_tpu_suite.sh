@@ -12,6 +12,12 @@ timeout 2400 python bench.py > "$OUT/bench.out" 2> "$OUT/bench.err"
 echo "rc=$?" | tee -a "$OUT/bench.err"
 tail -1 "$OUT/bench.out"
 
+echo "=== unpack hardware parity sweep (catches Mosaic regressions) ==="
+timeout 900 python tools/check_unpack_hw.py 200000 \
+  > "$OUT/unpack_hw.out" 2>&1
+echo "rc=$?"
+tail -1 "$OUT/unpack_hw.out"
+
 echo "=== profile_decode scale sweep ==="
 for rows in 2000000 4000000 10000000; do
   timeout 900 python tools/profile_decode.py $rows 8 \

@@ -956,19 +956,13 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 )
         elif enc == Encoding.RLE and ptype == Type.BOOLEAN:
             # boolean RLE data values: a length-prefixed width-1 hybrid
-            # stream — the same run-table deferral as the levels
-            import struct
-
-            from ..cpu.hybrid import scan_hybrid
-
+            # stream — the same prefix parse and run-table deferral as
+            # the V1 levels
             _def_standalone()
             if len(values_seg) < 4:
                 raise ValueError("boolean RLE stream missing length")
-            (bsz,) = struct.unpack_from("<I", values_seg, 0)
-            if 4 + bsz > len(values_seg):
-                raise ValueError("boolean RLE length exceeds page")
             if non_null:
-                b_sc = scan_hybrid(values_seg[4 : 4 + bsz], non_null, 1)
+                b_sc, _, _ = _scan_levels_v1(values_seg, non_null, 1, 0)
                 _defer_levels(ops, stager, "val", b_sc, None, non_null, 1,
                               cast=None)
         elif enc == Encoding.DELTA_BINARY_PACKED and ptype in (
