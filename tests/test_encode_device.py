@@ -1,0 +1,227 @@
+"""Device encode kernels: byte-exact with the host (NumPy) encoders.
+
+The write-side twins of the decode kernel set (SURVEY.md §7 stage 7).
+Every test asserts identical WIRE BYTES, not just round-trip equality —
+the device path must be indistinguishable on disk from the host path.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpuparquet import CompressionCodec, FileReader, FileWriter
+from tpuparquet.cpu.bitpack import pack
+from tpuparquet.cpu.bss import encode_byte_stream_split
+from tpuparquet.cpu.delta import (
+    decode_delta_binary_packed,
+    encode_delta_binary_packed,
+)
+from tpuparquet.format.metadata import Encoding
+from tpuparquet.kernels.encode import (
+    DeviceValues,
+    bss_encode_device,
+    delta_encode_device,
+    pack_u32_device,
+    pack_u64_device,
+)
+
+rng = np.random.default_rng(21)
+
+
+class TestPackDevice:
+    @pytest.mark.parametrize("width", list(range(1, 33)))
+    @pytest.mark.parametrize("n", [1, 31, 32, 33, 777])
+    def test_pack_u32_matches_cpu(self, width, n):
+        vals = rng.integers(0, 1 << width, size=n, dtype=np.uint64)
+        want = pack(vals, width)
+        got = np.asarray(
+            pack_u32_device(jnp.asarray(vals.astype(np.uint32)), width, n)
+        ).tobytes()
+        assert got[: len(want)] == want
+        assert not any(got[len(want):])  # tail padding is zeros
+
+    @pytest.mark.parametrize("width", [33, 40, 47, 56, 63, 64])
+    @pytest.mark.parametrize("n", [1, 32, 33, 500])
+    def test_pack_u64_matches_cpu(self, width, n):
+        vals = rng.integers(0, 1 << min(width, 63), size=n, dtype=np.uint64)
+        want = pack(vals, width)
+        got = np.asarray(pack_u64_device(
+            jnp.asarray((vals & 0xFFFFFFFF).astype(np.uint32)),
+            jnp.asarray((vals >> 32).astype(np.uint32)), width, n,
+        )).tobytes()
+        assert got[: len(want)] == want
+
+    def test_padding_never_leaks(self):
+        """Values past count must not contaminate the stream."""
+        vals = np.full(40, (1 << 7) - 1, dtype=np.uint32)
+        got = np.asarray(pack_u32_device(jnp.asarray(vals), 7, 3))
+        want = pack(np.array([127, 127, 127], dtype=np.uint64), 7)
+        assert np.asarray(got).tobytes()[: len(want)] == want
+        assert not any(np.asarray(got).tobytes()[len(want):])
+
+
+class TestBssEncodeDevice:
+    @pytest.mark.parametrize("dt,k,lanes", [
+        (np.float32, 4, 1), (np.float64, 8, 2),
+        (np.int32, 4, 1), (np.int64, 8, 2),
+    ])
+    def test_matches_cpu(self, dt, k, lanes):
+        vals = (rng.random(500) * 1000).astype(dt)
+        want = encode_byte_stream_split(vals)
+        flat = np.ascontiguousarray(vals).view(np.uint32)
+        got = np.asarray(
+            bss_encode_device(jnp.asarray(flat), 500, k, lanes)).tobytes()
+        assert got == want
+
+
+class TestDeltaEncodeDevice:
+    @pytest.mark.parametrize("vals", [
+        np.array([], dtype=np.int64),
+        np.array([7], dtype=np.int64),
+        np.array([5, 5], dtype=np.int64),
+        np.arange(129, dtype=np.int64) * -3,
+        np.full(128, 42, dtype=np.int64),
+        np.array([np.iinfo(np.int64).min, np.iinfo(np.int64).max, 0, -1, 1],
+                 dtype=np.int64),
+    ], ids=["empty", "one", "two", "129", "const128", "extremes"])
+    def test_byte_identical(self, vals):
+        want = encode_delta_binary_packed(vals)
+        flat = vals.view(np.uint32) if vals.size else np.zeros(0, np.uint32)
+        got = delta_encode_device(jnp.asarray(flat), vals.size)
+        assert got == want
+        dec, _ = decode_delta_binary_packed(got, np.int64)
+        np.testing.assert_array_equal(dec, vals)
+
+    def test_timestamps_and_wide(self):
+        for vals in (
+            1_700_000_000_000
+            + rng.integers(0, 3_600_000, size=5000, dtype=np.int64).cumsum(),
+            rng.integers(-(2**62), 2**62, size=3000, dtype=np.int64),
+        ):
+            want = encode_delta_binary_packed(vals)
+            got = delta_encode_device(jnp.asarray(vals.view(np.uint32)),
+                                      vals.size)
+            assert got == want
+
+    @pytest.mark.parametrize("vals", [
+        np.array([], dtype=np.int32),
+        np.array([-7], dtype=np.int32),
+        np.arange(-300, 300, dtype=np.int32) * 1000,
+        np.array([np.iinfo(np.int32).min, np.iinfo(np.int32).max, 0, -1],
+                 dtype=np.int32),
+    ], ids=["empty", "one", "ramp", "extremes"])
+    def test_int32_byte_identical(self, vals):
+        """The is32 path wraps deltas at 32 bits exactly like the host
+        encoder (full-range int32 data must not emit 33-bit widths)."""
+        want = encode_delta_binary_packed(vals, is32=True)
+        flat = vals.view(np.uint32) if vals.size else np.zeros(0, np.uint32)
+        got = delta_encode_device(jnp.asarray(flat), vals.size, is32=True)
+        assert got == want
+        dec, _ = decode_delta_binary_packed(got, np.int32)
+        np.testing.assert_array_equal(dec, vals)
+
+    def test_int32_random(self):
+        vals = rng.integers(np.iinfo(np.int32).min, np.iinfo(np.int32).max,
+                            size=3000, dtype=np.int32)
+        want = encode_delta_binary_packed(vals, is32=True)
+        got = delta_encode_device(jnp.asarray(vals.view(np.uint32)),
+                                  vals.size, is32=True)
+        assert got == want
+
+
+def _build(schema, vals_by_col, masks=None, device=False, **wkw):
+    buf = io.BytesIO()
+    w = FileWriter(buf, schema, **wkw)
+    cols = {}
+    for k, v in vals_by_col.items():
+        if device:
+            cols[k] = DeviceValues(
+                jnp.asarray(np.ascontiguousarray(v).view(np.uint32)),
+                v.dtype)
+        else:
+            cols[k] = v
+    w.write_columns(cols, masks=masks)
+    w.close()
+    return buf.getvalue()
+
+
+class TestDeviceValuesWriter:
+    """write_columns with DeviceValues: the produced FILE must be
+    byte-identical to the host path (stats, pages, footer included)."""
+
+    SCHEMA = """message m {
+        required int64 ts;
+        required double fare;
+        optional int64 dist;
+        required float score;
+        required int32 code;
+    }"""
+
+    def _vals(self, n=4000):
+        dm = rng.random(n) >= 0.2
+        return {
+            "ts": 1_700_000_000_000
+            + rng.integers(0, 60_000, n).cumsum(),
+            "fare": rng.random(n) * 100,
+            "dist": rng.integers(0, 10**9, size=int(dm.sum())),
+            "score": rng.random(n).astype(np.float32),
+            "code": rng.integers(-100, 100, n, dtype=np.int32),
+        }, {"dist": dm}
+
+    @pytest.mark.parametrize("v2", [False, True], ids=["v1", "v2"])
+    @pytest.mark.parametrize("codec", [CompressionCodec.UNCOMPRESSED,
+                                       CompressionCodec.SNAPPY])
+    def test_byte_identical_files(self, v2, codec):
+        vals, masks = self._vals()
+        kw = dict(codec=codec, data_page_v2=v2, allow_dict=False,
+                  column_encodings={
+                      "ts": Encoding.DELTA_BINARY_PACKED,
+                      "fare": Encoding.BYTE_STREAM_SPLIT,
+                      "code": Encoding.DELTA_BINARY_PACKED,
+                  })
+        a = _build(self.SCHEMA, vals, masks=masks, device=False, **kw)
+        b = _build(self.SCHEMA, vals, masks=masks, device=True, **kw)
+        assert a == b
+
+    @pytest.mark.parametrize("a64,f64", [
+        (np.array([], np.int64), np.array([], np.float64)),
+        (np.array([5], np.int64), np.array([np.nan], np.float64)),
+        (np.array([np.iinfo(np.int64).min, np.iinfo(np.int64).max],
+                  np.int64),
+         np.array([np.inf, -np.inf], np.float64)),
+    ], ids=["empty", "nan", "extremes"])
+    def test_edge_stats(self, a64, f64):
+        schema = "message m { required int64 a; required double f; }"
+        kw = dict(allow_dict=False,
+                  column_encodings={"a": Encoding.DELTA_BINARY_PACKED})
+        assert _build(schema, {"a": a64, "f": f64}, device=False, **kw) \
+            == _build(schema, {"a": a64, "f": f64}, device=True, **kw)
+
+    def test_unsigned_stat_order(self):
+        schema = "message m { required int64 u (INT(64, false)); }"
+        uv = np.array([1, -1, 5], np.int64)  # -1 == u64 max
+        assert _build(schema, {"u": uv}, device=False, allow_dict=False) \
+            == _build(schema, {"u": uv}, device=True, allow_dict=False)
+
+    def test_readback(self):
+        vals, masks = self._vals(1000)
+        buf = io.BytesIO(_build(
+            self.SCHEMA, vals, masks=masks, device=True,
+            codec=CompressionCodec.SNAPPY, allow_dict=False,
+            column_encodings={"ts": Encoding.DELTA_BINARY_PACKED}))
+        cd = FileReader(buf).read_row_group_arrays(0)
+        np.testing.assert_array_equal(np.asarray(cd["ts"].values),
+                                      vals["ts"])
+        np.testing.assert_array_equal(np.asarray(cd["dist"].values),
+                                      vals["dist"])
+
+    def test_device_values_rejects_dtype_mismatch(self):
+        schema = "message m { required int32 a; }"
+        buf = io.BytesIO()
+        w = FileWriter(buf, schema)
+        dv = DeviceValues(jnp.zeros(8, jnp.uint32), np.int64)
+        with pytest.raises(TypeError, match="DeviceValues"):
+            w.write_columns({"a": dv})

@@ -197,6 +197,12 @@ def _maybe_dictionary(column, allow_dict: bool):
     smaller than the plain values and the dictionary stays small."""
     if not allow_dict:
         return None, None
+    from .values import is_device_values
+
+    if is_device_values(column):
+        # device-resident values never dictionary-encode: interning is
+        # host work and would pull the raw column off the device
+        return None, None
     n = len(column) if isinstance(column, ByteArrayColumn) else \
         np.asarray(column).shape[0]
     if n == 0:

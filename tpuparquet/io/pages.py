@@ -157,6 +157,12 @@ def encode_values(ptype: Type, encoding: Encoding, column,
                   type_length=None) -> bytes:
     """Non-dictionary value encode dispatch (mirrors getValuesEncoder,
     chunk_writer.go:99-159)."""
+    from .values import is_device_values
+
+    if is_device_values(column):
+        # device-resident values: PLAIN/DELTA/BSS encode on device
+        # (kernels/encode.py) and only the wire bytes cross to host
+        return column.encode(ptype, encoding)
     if encoding == Encoding.PLAIN:
         return encode_plain(ptype, column, type_length)
     if encoding == Encoding.RLE:

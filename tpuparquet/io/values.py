@@ -16,7 +16,24 @@ import numpy as np
 from ..cpu.plain import ByteArrayColumn
 from ..format.metadata import ConvertedType, SchemaElement, Type
 
-__all__ = ["ValueHandler", "handler_for", "is_unsigned"]
+__all__ = ["ValueHandler", "handler_for", "is_unsigned",
+           "is_device_values"]
+
+
+def is_device_values(obj) -> bool:
+    """True for :class:`tpuparquet.kernels.encode.DeviceValues` (and
+    subclasses).  Lazy import keeps the io layer jax-free until a
+    device column actually appears; the fast isinstance-free pre-check
+    avoids importing jax for plain numpy writes."""
+    if isinstance(obj, (np.ndarray, ByteArrayColumn, list, tuple)) \
+            or obj is None:
+        return False
+    import sys
+
+    mod = sys.modules.get("tpuparquet.kernels.encode")
+    if mod is None:
+        return False  # DeviceValues can't exist if its module isn't loaded
+    return isinstance(obj, mod.DeviceValues)
 
 _INT_RANGE = {
     Type.INT32: (-(2**31), 2**31 - 1),
@@ -128,6 +145,17 @@ class ValueHandler:
             if p not in (Type.BYTE_ARRAY, Type.FIXED_LEN_BYTE_ARRAY):
                 raise TypeError(f"{p.name} column cannot take byte values")
             return arr
+        if is_device_values(arr):
+            # device-resident values (kernels/encode.py) stay in HBM:
+            # validated by dtype only, stats and page encode on device
+            want = {Type.INT32: np.dtype(np.int32),
+                    Type.INT64: np.dtype(np.int64),
+                    Type.FLOAT: np.dtype(np.float32),
+                    Type.DOUBLE: np.dtype(np.float64)}.get(p)
+            if want is None or arr.dtype != want:
+                raise TypeError(
+                    f"{p.name} column cannot take DeviceValues[{arr.dtype}]")
+            return arr
         a = np.asarray(arr)
         if p == Type.BOOLEAN:
             if a.dtype != np.bool_:
@@ -228,6 +256,8 @@ class ValueHandler:
         p = self.ptype
         if p == Type.INT96:
             return None, None  # ordering undefined in the spec
+        if is_device_values(column):
+            return column.min_max(unsigned=self.unsigned)
         if isinstance(column, ByteArrayColumn):
             if len(column) == 0:
                 return None, None

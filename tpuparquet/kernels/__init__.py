@@ -1,6 +1,13 @@
-"""Device (JAX/XLA/Pallas) decode kernels and orchestration."""
+"""Device (JAX/XLA/Pallas) decode/encode kernels and orchestration."""
 
 from .bitunpack import unpack_u32, unpack_u32_pallas, pad_to_words  # noqa: F401
+from .encode import (  # noqa: F401
+    DeviceValues,
+    bss_encode_device,
+    delta_encode_device,
+    pack_u32_device,
+    pack_u64_device,
+)
 from .decode import (  # noqa: F401
     dict_gather_bytes,
     dict_gather_fixed,

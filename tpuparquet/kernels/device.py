@@ -958,9 +958,16 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
             # boolean RLE data values: a length-prefixed width-1 hybrid
             # stream — the same prefix parse and run-table deferral as
             # the V1 levels
+            import struct
+
             _def_standalone()
             if len(values_seg) < 4:
                 raise ValueError("boolean RLE stream missing length")
+            (bsz,) = struct.unpack_from("<I", values_seg, 0)
+            if 4 + bsz > len(values_seg):
+                # the shared level scanner would silently truncate the
+                # slice; a declared length beyond the page is corrupt
+                raise ValueError("boolean RLE length exceeds page")
             if non_null:
                 b_sc, _, _ = _scan_levels_v1(values_seg, non_null, 1, 0)
                 _defer_levels(ops, stager, "val", b_sc, None, non_null, 1,
