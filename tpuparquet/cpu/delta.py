@@ -29,7 +29,23 @@ __all__ = [
     "encode_delta_length_byte_array",
     "decode_delta_byte_array",
     "encode_delta_byte_array",
+    "widths_from_max",
 ]
+
+
+def widths_from_max(mb_max: np.ndarray) -> np.ndarray:
+    """Vectorized bit_length: per-miniblock packing width from the max
+    adjusted delta.  Shared with the device encoder
+    (``kernels/encode.py``) — the wire format depends on both sides
+    choosing identical widths."""
+    widths = np.zeros(mb_max.shape, dtype=np.int64)
+    m = mb_max.astype(np.uint64, copy=True)
+    for s in (32, 16, 8, 4, 2, 1):
+        big = m >= (np.uint64(1) << np.uint64(s))
+        widths[big] += s
+        m[big] >>= np.uint64(s)
+    widths += (m > 0)
+    return widths
 
 
 
@@ -131,14 +147,7 @@ def encode_delta_binary_packed(
     adj = blk2.view(np.uint64) - min_deltas.view(np.uint64)[:, None]
     adj.reshape(-1)[n:] = 0                             # padded lanes are 0
     mb = adj.reshape(n_blocks * n_miniblocks, mb_size)
-    mb_max = mb.max(axis=1)
-    widths = np.zeros(mb_max.shape, dtype=np.int64)     # bit_length, vector
-    m = mb_max.copy()
-    for s in (32, 16, 8, 4, 2, 1):
-        big = m >= (np.uint64(1) << np.uint64(s))
-        widths[big] += s
-        m[big] >>= np.uint64(s)
-    widths += (m > 0)
+    widths = widths_from_max(mb.max(axis=1))
 
     # pack all miniblocks of one width in a single pack() call, then
     # carve the concatenated bytes back into per-miniblock payloads

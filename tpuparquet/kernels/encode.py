@@ -155,20 +155,43 @@ def _sub64(alo, ahi, blo, bhi):
     return lo, ahi - bhi - borrow
 
 
-@functools.partial(jax.jit, static_argnames=("count",))
-def _delta_phase1_i64(flat: jax.Array, count: int):
-    """Flat (count*2,) u32 interleaved i64 lanes -> per-block min_delta
-    lanes, per-miniblock adjusted maxima lanes, and the adjusted delta
-    stream (device-resident for phase 2)."""
-    v = flat[: count * 2].reshape(count, 2)
+def _bucket_blocks(count: int) -> int:
+    """Power-of-two block count covering ``count`` values (min 32).
+
+    Phase-1/phase-2 jits key on SHAPES with the true count traced, so
+    arbitrary per-page value counts compile O(log) kernel variants, not
+    one per count (a writer streaming variable pages would otherwise
+    recompile per page)."""
+    from .decode import bucket
+
+    need = max((max(count - 1, 1) + _BLOCK - 1) // _BLOCK, 1)
+    return bucket(need)
+
+
+def _pad_flat(flat, lanes: int, nb: int):
+    want = (nb * _BLOCK + 1) * lanes
+    if flat.shape[0] < want:
+        flat = jnp.pad(flat, (0, want - flat.shape[0]))
+    return flat[:want]
+
+
+@jax.jit
+def _delta_phase1_i64(flat: jax.Array, valid):
+    """Flat (2*(NB*128+1),) u32 interleaved i64 lanes (bucket-padded,
+    true count ``valid`` traced) -> per-block min_delta lanes,
+    per-miniblock adjusted maxima lanes, and the adjusted delta stream
+    (device-resident for phase 2)."""
+    c = flat.shape[0] // 2
+    v = flat.reshape(c, 2)
     lo, hi = v[:, 0], v[:, 1]
     dlo, dhi = _sub64(lo[1:], hi[1:], lo[:-1], hi[:-1])
-    n = count - 1
-    nb = (n + _BLOCK - 1) // _BLOCK
-    pad = nb * _BLOCK - n
-    # pad with i64 max so padding never wins the min
-    dlo = jnp.pad(dlo, (0, pad), constant_values=np.uint32(0xFFFFFFFF))
-    dhi = jnp.pad(dhi, (0, pad), constant_values=np.uint32(0x7FFFFFFF))
+    nd = c - 1                      # == NB * _BLOCK
+    nb = nd // _BLOCK
+    idx = jnp.arange(nd, dtype=jnp.int32)
+    live = idx < (valid - 1)
+    # dead lanes become i64 max so they never win the min
+    dlo = jnp.where(live, dlo, jnp.uint32(0xFFFFFFFF))
+    dhi = jnp.where(live, dhi, jnp.uint32(0x7FFFFFFF))
     blo = dlo.reshape(nb, _BLOCK)
     bhi = dhi.reshape(nb, _BLOCK)
     # signed i64 min per block via lexicographic (hi signed, lo unsigned)
@@ -188,13 +211,12 @@ def _delta_phase1_i64(flat: jax.Array, count: int):
         mlo, mhi = min_pair(
             (mlo[:, :k], mhi[:, :k]), (mlo[:, k:2 * k], mhi[:, k:2 * k]))
     min_lo, min_hi = mlo[:, 0], mhi[:, 0].astype(jnp.uint32)
-    # adjusted = delta - min_delta (u64 lanes), padding forced to 0
+    # adjusted = delta - min_delta (u64 lanes), dead lanes forced to 0
     alo, ahi = _sub64(blo.reshape(-1), bhi.reshape(-1),
                       jnp.repeat(min_lo, _BLOCK),
                       jnp.repeat(min_hi, _BLOCK))
-    idx = jnp.arange(nb * _BLOCK, dtype=jnp.int32)
-    alo = jnp.where(idx < n, alo, 0)
-    ahi = jnp.where(idx < n, ahi, 0)
+    alo = jnp.where(live, alo, 0)
+    ahi = jnp.where(live, ahi, 0)
     # per-miniblock max (u64): lexicographic on (hi unsigned, lo)
     xlo = alo.reshape(nb * _MINIBLOCKS, _MB)
     xhi = ahi.reshape(nb * _MINIBLOCKS, _MB)
@@ -215,37 +237,48 @@ def _delta_phase1_i64(flat: jax.Array, count: int):
     return (min_lo, min_hi, qlo[:, 0], qhi[:, 0], alo, ahi)
 
 
-def _widths_from_max(mb_max: np.ndarray) -> np.ndarray:
-    widths = np.zeros(mb_max.shape, dtype=np.int64)
-    m = mb_max.copy()
-    for s in (32, 16, 8, 4, 2, 1):
-        big = m >= (np.uint64(1) << np.uint64(s))
-        widths[big] += s
-        m[big] >>= np.uint64(s)
-    widths += (m > 0)
-    return widths
-
-
-@functools.partial(jax.jit, static_argnames=("count",))
-def _delta_phase1_i32(flat: jax.Array, count: int):
+@jax.jit
+def _delta_phase1_i32(flat: jax.Array, valid):
     """32-bit twin of :func:`_delta_phase1_i64`: single-lane u32 math
     (the host is32 path wraps deltas at 32 bits, cpu/delta.py)."""
-    v = flat[:count]
+    c = flat.shape[0]
+    v = flat
     d = v[1:] - v[:-1]  # u32 wraparound == two's-complement i32 delta
-    n = count - 1
-    nb = (n + _BLOCK - 1) // _BLOCK
-    # pad with i32 max so padding never wins the signed min
-    d = jnp.pad(d, (0, nb * _BLOCK - n),
-                constant_values=np.uint32(0x7FFFFFFF))
+    nd = c - 1
+    nb = nd // _BLOCK
+    idx = jnp.arange(nd, dtype=jnp.int32)
+    live = idx < (valid - 1)
+    # dead lanes become i32 max so they never win the signed min
+    d = jnp.where(live, d, jnp.uint32(0x7FFFFFFF))
     b = d.reshape(nb, _BLOCK)
     mins = jnp.min(b.astype(jnp.int32), axis=1)
     # adjusted = delta - min in [0, 2^32): u32 wrap equals the host's
     # 64-bit subtraction of values within the i32 range
     adj = b - mins.astype(jnp.uint32)[:, None]
-    idx = jnp.arange(nb * _BLOCK, dtype=jnp.int32).reshape(nb, _BLOCK)
-    adj = jnp.where(idx < n, adj, 0)
+    adj = jnp.where(live.reshape(nb, _BLOCK), adj, 0)
     mx = jnp.max(adj.reshape(nb * _MINIBLOCKS, _MB), axis=1)
     return mins, mx, adj.reshape(-1)
+
+
+@functools.partial(jax.jit, static_argnames=("width",))
+def _pack_masked32(values: jax.Array, valid, width: int) -> jax.Array:
+    """Bucket-shaped pack: ``values`` length is a padded multiple of 32
+    (jit keys on the bucket shape), the true count ``valid`` is traced,
+    dead lanes zeroed before packing."""
+    idx = jnp.arange(values.shape[0], dtype=jnp.int32)
+    v = jnp.where(idx < valid, values, 0).reshape(-1, 32)
+    mask = jnp.uint32(((1 << width) - 1) & 0xFFFFFFFF)
+    return _pack_block_math(v & mask, None, width).reshape(-1)
+
+
+@functools.partial(jax.jit, static_argnames=("width",))
+def _pack_masked64(lo: jax.Array, hi: jax.Array, valid,
+                   width: int) -> jax.Array:
+    idx = jnp.arange(lo.shape[0], dtype=jnp.int32)
+    vlo = jnp.where(idx < valid, lo, 0).reshape(-1, 32)
+    vhi = jnp.where(idx < valid, hi, 0).reshape(-1, 32)
+    himask = jnp.uint32(((1 << (width - 32)) - 1) & 0xFFFFFFFF)
+    return _pack_block_math(vlo, vhi & himask, width).reshape(-1)
 
 
 def delta_encode_device(flat, count: int, is32: bool = False) -> bytes:
@@ -261,7 +294,11 @@ def delta_encode_device(flat, count: int, is32: bool = False) -> bytes:
     PLAIN bytes."""
     from ..varint import write_uvarint, write_zigzag
 
+    from ..cpu.delta import widths_from_max
+    from .decode import bucket
+
     flat2 = jnp.asarray(flat)
+    lanes = 1 if is32 else 2
     out = bytearray()
     write_uvarint(out, _BLOCK)
     write_uvarint(out, _MINIBLOCKS)
@@ -269,47 +306,55 @@ def delta_encode_device(flat, count: int, is32: bool = False) -> bytes:
     if count == 0:
         write_zigzag(out, 0)
         return bytes(out)
+    first_lanes = np.asarray(flat2[:lanes])  # one transfer
     if is32:
-        v0 = int(flat2[0])
+        v0 = int(first_lanes[0])
         first = v0 - (1 << 32) if v0 >= (1 << 31) else v0
     else:
-        v0 = (int(flat2[0]) | (int(flat2[1]) << 32))
+        v0 = int(first_lanes[0]) | (int(first_lanes[1]) << 32)
         first = v0 - (1 << 64) if v0 >= (1 << 63) else v0
     write_zigzag(out, first)
     if count == 1:
         return bytes(out)
 
+    nb_bucket = _bucket_blocks(count)
+    padded = _pad_flat(flat2, lanes, nb_bucket)
+    nb = (count - 1 + _BLOCK - 1) // _BLOCK  # true block count
     if is32:
-        mins, mx, alo = _delta_phase1_i32(flat2, count)
-        minima = np.asarray(mins).astype(np.int64)
-        mb_max = np.asarray(mx).astype(np.uint64)
+        mins, mx, alo = _delta_phase1_i32(padded, count)
+        minima = np.asarray(mins)[:nb].astype(np.int64)
+        mb_max = np.asarray(mx)[: nb * _MINIBLOCKS].astype(np.uint64)
         ahi = None
     else:
         min_lo, min_hi, mx_lo, mx_hi, alo, ahi = _delta_phase1_i64(
-            flat2, count)
-        minima = (np.asarray(min_lo).astype(np.uint64)
-                  | (np.asarray(min_hi).astype(np.uint64)
+            padded, count)
+        minima = (np.asarray(min_lo)[:nb].astype(np.uint64)
+                  | (np.asarray(min_hi)[:nb].astype(np.uint64)
                      << np.uint64(32))).view(np.int64)
-        mb_max = (np.asarray(mx_lo).astype(np.uint64)
-                  | (np.asarray(mx_hi).astype(np.uint64) << np.uint64(32)))
-    widths = _widths_from_max(mb_max)
-    nb = len(minima)
+        mb_max = (np.asarray(mx_lo)[: nb * _MINIBLOCKS].astype(np.uint64)
+                  | (np.asarray(mx_hi)[: nb * _MINIBLOCKS].astype(np.uint64)
+                     << np.uint64(32)))
+    widths = widths_from_max(mb_max)
 
-    # phase 2: pack all miniblocks of one width in one device call
+    # phase 2: pack all miniblocks of one width in one device call.
+    # The gather/pack shapes bucket so the jit cache stays O(widths x
+    # log(size)), not one entry per data-dependent miniblock count.
     payloads: list[bytes] = [b""] * len(widths)
     for w in np.unique(widths):
         w = int(w)
         if w == 0:
             continue
         idx = np.nonzero(widths == w)[0]
-        sel = (idx[:, None] * _MB
-               + np.arange(_MB)[None, :]).reshape(-1).astype(np.int32)
-        glo = alo[jnp.asarray(sel)]
         cnt = len(idx) * _MB
+        cap = bucket(cnt)
+        sel = np.zeros(cap, dtype=np.int32)
+        sel[:cnt] = (idx[:, None] * _MB
+                     + np.arange(_MB)[None, :]).reshape(-1)
+        sel_dev = jnp.asarray(sel)
         if w <= 32:
-            words = pack_u32_device(glo, w, cnt)
+            words = _pack_masked32(alo[sel_dev], cnt, w)
         else:
-            words = pack_u64_device(glo, ahi[jnp.asarray(sel)], w, cnt)
+            words = _pack_masked64(alo[sel_dev], ahi[sel_dev], cnt, w)
         raw = np.asarray(words).tobytes()
         step = _MB * w // 8
         for j, i in enumerate(idx):
@@ -406,7 +451,11 @@ class DeviceValues:
     def encode(self, ptype, encoding) -> bytes:
         """Encode one page's values on device; returns the wire bytes."""
         from ..format.metadata import Encoding, Type
+        from ..stats import current_stats
 
+        st = current_stats()
+        if st is not None:
+            st.pages_device_encoded += 1
         if encoding == Encoding.PLAIN:
             # PLAIN little-endian value bytes == the LE lane words' bytes
             return np.asarray(self.flat).tobytes()

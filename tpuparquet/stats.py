@@ -32,6 +32,10 @@ class DecodeStats:
     # pages whose values segment decompressed ON DEVICE (snappy token
     # kernel) rather than on host — evidence the device path engaged
     pages_device_snappy: int = 0
+    # write-side pages whose values encoded ON DEVICE (DeviceValues:
+    # DELTA/BSS/PLAIN in kernels/encode.py) — evidence the writer TPU
+    # path engaged rather than pulling raw values to host
+    pages_device_encoded: int = 0
     values: int = 0
     bytes_compressed: int = 0
     bytes_uncompressed: int = 0
@@ -58,6 +62,7 @@ class DecodeStats:
             "chunks": self.chunks,
             "pages": self.pages,
             "pages_device_snappy": self.pages_device_snappy,
+            "pages_device_encoded": self.pages_device_encoded,
             "values": self.values,
             "bytes_compressed": self.bytes_compressed,
             "bytes_uncompressed": self.bytes_uncompressed,
