@@ -20,7 +20,7 @@ from hypothesis import strategies as st
 from tpuparquet import CompressionCodec, FileReader, FileWriter
 from tpuparquet.cpu import bitpack, bss, delta, dictionary, hybrid, levels
 from tpuparquet.cpu.plain import decode_plain, encode_plain
-from tpuparquet.format.metadata import Type
+from tpuparquet.format.metadata import Encoding, Type
 
 SET = settings(max_examples=40,
                suppress_health_check=[HealthCheck.too_slow], deadline=None)
@@ -284,12 +284,23 @@ class TestDeviceFileProperties:
         else:
             a = rng.integers(-(2**62), 2**62, size=n)
         bm = rng.random(n) >= data_st.draw(st.sampled_from([0.0, 0.3, 1.0]))
+        # randomly force the non-default device branches: delta int64,
+        # BYTE_STREAM_SPLIT doubles, boolean RLE
+        encs = {}
+        if data_st.draw(st.booleans()):
+            encs["a"] = Encoding.DELTA_BINARY_PACKED
+        if data_st.draw(st.booleans()):
+            encs["x"] = Encoding.BYTE_STREAM_SPLIT
+        if data_st.draw(st.booleans()):
+            encs["f"] = Encoding.RLE
         buf = io.BytesIO()
         w = FileWriter(
             buf,
             "message m { required int64 a; optional int32 b; "
-            "optional binary s (STRING); }",
+            "optional binary s (STRING); required double x; "
+            "required boolean f; }",
             codec=codec, data_page_v2=v2, allow_dict=allow_dict,
+            column_encodings=encs,
         )
         sm = rng.random(n) >= 0.2
         vocab = [b"", b"x", b"yz", b"long-ish-value"]
@@ -297,7 +308,9 @@ class TestDeviceFileProperties:
         w.write_columns(
             {"a": a,
              "b": rng.integers(0, 100, size=int(bm.sum()), dtype=np.int32),
-             "s": ByteArrayColumn.from_list([vocab[p] for p in picks])},
+             "s": ByteArrayColumn.from_list([vocab[p] for p in picks]),
+             "x": rng.random(n) * 1e6,
+             "f": rng.random(n) >= 0.5},
             masks={"b": bm, "s": sm},
         )
         w.close()

@@ -243,10 +243,33 @@ class DeviceColumn:
         jax.block_until_ready(self._buffers())
         return self
 
-    def to_numpy(self):
+    def to_numpy(self, limit: int | None = None):
         """Materialize to the CPU oracle's chunk representation:
-        (values, rep_levels, def_levels).  Slices padding host-side."""
+        (values, rep_levels, def_levels).  Slices padding host-side.
+
+        ``limit`` bounds the materialization to the first ``limit``
+        record slots (values keep their packed non-null order) —
+        device buffers are sliced BEFORE the pull, so a bounded check
+        of a huge chunk never streams the whole buffer over a narrow
+        host link."""
         n = self.num_values
+        if limit is not None and limit < n:
+            n = max(limit, 0)
+            rep = (np.zeros(n, dtype=np.int32) if self._rep_p is None
+                   else np.asarray(self._rep_p[:n], dtype=np.int32))
+            dl = (np.zeros(n, dtype=np.int32) if self._def_p is None
+                  else np.asarray(self._def_p[:n], dtype=np.int32))
+            nn = (n if self._mask_p is None
+                  else int(np.asarray(self.mask[:n]).sum()))
+            if self.offsets is not None:
+                offs = np.asarray(self.offsets[: nn + 1], dtype=np.int64)
+                data = np.asarray(self._data_p[: int(offs[-1])],
+                                  dtype=np.uint8)
+                return ByteArrayColumn(offs, data), rep, dl
+            lanes = self.lanes
+            flat = np.asarray(self._data_p[: nn * lanes],
+                              dtype=np.uint32)
+            return self._flat_to_typed(flat, lanes), rep, dl
         rep = (np.zeros(n, dtype=np.int32) if self._rep_p is None
                else np.asarray(self._rep_p, dtype=np.int32)[:n])
         dl = (np.zeros(n, dtype=np.int32) if self._def_p is None
@@ -258,24 +281,26 @@ class DeviceColumn:
         lanes = self.lanes
         flat = np.asarray(self._data_p, dtype=np.uint32)[
             : self.n_packed * lanes]
+        return self._flat_to_typed(flat, lanes), rep, dl
+
+    def _flat_to_typed(self, flat: np.ndarray, lanes: int):
+        """Flat little-endian u32 lane words -> the oracle's value
+        array (the single home of the lane-layout contract)."""
         if self.ptype == Type.BOOLEAN:
-            return flat.astype(bool), rep, dl
+            return flat.astype(bool)
         if self.ptype == Type.INT32:
-            return flat.view(np.int32), rep, dl
+            return flat.view(np.int32)
         if self.ptype == Type.FLOAT:
-            return flat.view(np.float32), rep, dl
+            return flat.view(np.float32)
         if self.ptype == Type.INT64:
-            return flat.view(np.uint8).view("<i8"), rep, dl
+            return flat.view(np.uint8).view("<i8")
         if self.ptype == Type.DOUBLE:
-            return flat.view(np.uint8).view("<f8"), rep, dl
+            return flat.view(np.uint8).view("<f8")
         if self.ptype == Type.INT96:
-            return flat.reshape(-1, 3), rep, dl
+            return flat.reshape(-1, 3)
         if self.ptype == Type.FIXED_LEN_BYTE_ARRAY:
             n = self.type_length
-            return (
-                flat.view(np.uint8).reshape(-1, 4 * lanes)[:, :n],
-                rep, dl,
-            )
+            return flat.view(np.uint8).reshape(-1, 4 * lanes)[:, :n]
         raise TypeError(f"unsupported type {self.ptype}")
 
 
