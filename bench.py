@@ -467,6 +467,32 @@ def run_config5() -> dict:
     }
 
 
+def _probe_backend(timeout_s: int = 240) -> None:
+    """Fail fast when the device backend can't initialize.
+
+    A wedged remote tunnel makes ``jax.devices()`` hang indefinitely
+    (observed repeatedly on the axon tunnel); probing in a subprocess
+    with a timeout turns a silently-eaten measurement window into an
+    immediate, diagnosable failure."""
+    import subprocess
+
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, check=True,
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"bench: device backend failed to initialize within "
+              f"{timeout_s}s (tunnel wedged?) — aborting instead of "
+              "hanging", file=sys.stderr)
+        raise SystemExit(2)
+    except subprocess.CalledProcessError as e:
+        print(f"bench: device backend probe failed (rc={e.returncode})\n"
+              f"{(e.stderr or '')[-2000:]}", file=sys.stderr)
+        raise SystemExit(2)
+
+
 def main() -> None:
     if os.environ.get("TPQ_BENCH_CPU"):
         # smoke-test mode: this image's sitecustomize pins jax_platforms
@@ -474,6 +500,8 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    else:
+        _probe_backend()
     results = {}
     for name, builder in [
         ("1-plain-int64-uncompressed", build_config1),

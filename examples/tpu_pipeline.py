@@ -47,9 +47,8 @@ w = FileWriter(buf, """message trips {
 }""", codec=CompressionCodec.SNAPPY)
 from tpuparquet.cpu.plain import ByteArrayColumn
 
-vendors = [f"vendor-{i % 7}".encode() for i in range(n)]
-offs = np.zeros(n + 1, np.int64)
-np.cumsum([len(v) for v in vendors], out=offs[1:])
+vendor_col = ByteArrayColumn.from_list(
+    [f"vendor-{i % 7}".encode() for i in range(n)])
 for _ in range(4):  # four row groups
     w.write_columns({
         "pickup_ts": 1_700_000_000_000
@@ -57,9 +56,7 @@ for _ in range(4):  # four row groups
         "fare": rng.random(n) * 80,
         "payment_type": rng.integers(0, 5, size=int(mask.sum()),
                                      dtype=np.int32),
-        "vendor": ByteArrayColumn(offs,
-                                  np.frombuffer(b"".join(vendors),
-                                                np.uint8)),
+        "vendor": vendor_col,
     }, masks={"payment_type": mask})
 w.close()
 buf.seek(0)
@@ -95,7 +92,8 @@ w2.write_columns({
     "fare_tipped": DeviceValues(out_lanes.reshape(-1), np.float64)})
 w2.close()
 out2.seek(0)
-check = FileReader(out2).read_row_group_arrays(0)["fare_tipped"]
+with FileReader(out2) as rcheck:
+    check = rcheck.read_row_group_arrays(0)["fare_tipped"]
 print(f"device-encoded round trip: {len(check.values):,} values, "
       f"max {np.asarray(check.values).max():.2f}")
 
