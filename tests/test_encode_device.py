@@ -266,3 +266,41 @@ class TestDeviceFullCircle:
         out.seek(0)
         got = FileReader(out).read_row_group_arrays(0)["v"]
         np.testing.assert_array_equal(np.asarray(got.values), base * 2)
+
+    def test_as_values_bridge(self):
+        """DeviceColumn.as_values: decode -> write with zero layout
+        plumbing; output byte-identical to writing the numpy values."""
+        from tpuparquet.kernels.device import read_row_group_device
+
+        rng_ = np.random.default_rng(44)
+        vals = rng_.integers(-(2**50), 2**50, size=2000)
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required int64 v; }")
+        w.write_columns({"v": vals})
+        w.close()
+        buf.seek(0)
+        col = read_row_group_device(FileReader(buf), 0)["v"]
+
+        def write(v):
+            o = io.BytesIO()
+            ww = FileWriter(o, "message m { required int64 v; }",
+                            column_encodings={
+                                "v": Encoding.DELTA_BINARY_PACKED},
+                            allow_dict=False)
+            ww.write_columns({"v": v})
+            ww.close()
+            return o.getvalue()
+
+        assert write(col.as_values()) == write(vals)
+
+    def test_as_values_rejects_bytes(self):
+        from tpuparquet.kernels.device import read_row_group_device
+
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required binary s; }")
+        w.add_data({"s": b"x"})
+        w.close()
+        buf.seek(0)
+        col = read_row_group_device(FileReader(buf), 0)["s"]
+        with pytest.raises(TypeError, match="as_values"):
+            col.as_values()

@@ -283,6 +283,26 @@ class DeviceColumn:
             : self.n_packed * lanes]
         return self._flat_to_typed(flat, lanes), rep, dl
 
+    def as_values(self):
+        """Repackage the packed values for ``FileWriter.write_columns``
+        (a :class:`tpuparquet.kernels.encode.DeviceValues` — it shares
+        this column's flat u32 lane layout, so no data moves).
+
+        Fixed-width int32/int64/float/double columns only, and the
+        column must be all-non-null (``write_columns`` takes validity
+        separately via ``masks=``; the packed buffer is exactly the
+        non-null stream either way)."""
+        from ..cpu.plain import PHYSICAL_DTYPES
+        from .encode import DeviceValues
+
+        dt = (None if self.offsets is not None or self.ptype == Type.BOOLEAN
+              else PHYSICAL_DTYPES.get(self.ptype))
+        if dt is None:
+            raise TypeError(
+                f"as_values supports int32/int64/float/double columns, "
+                f"not {self.ptype.name}")
+        return DeviceValues(self.data, dt)
+
     def _flat_to_typed(self, flat: np.ndarray, lanes: int):
         """Flat little-endian u32 lane words -> the oracle's value
         array (the single home of the lane-layout contract)."""
