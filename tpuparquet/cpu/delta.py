@@ -26,6 +26,7 @@ __all__ = [
     "decode_delta_binary_packed",
     "encode_delta_binary_packed",
     "decode_delta_length_byte_array",
+    "scan_delta_length_byte_array",
     "encode_delta_length_byte_array",
     "decode_delta_byte_array",
     "encode_delta_byte_array",
@@ -173,9 +174,12 @@ def encode_delta_binary_packed(
 
 # -- DELTA_LENGTH_BYTE_ARRAY ------------------------------------------------
 
-def decode_delta_length_byte_array(data, count: int, pos: int = 0):
-    """Lengths (delta-bp int32) then concatenated bytes; returns
-    (ByteArrayColumn, end_pos) — ``type_bytearray.go:98-140`` equivalent."""
+def scan_delta_length_byte_array(data, count: int, pos: int = 0):
+    """Validated DLBA structure without materializing the payload:
+    returns (offsets, data_pos) where the byte payload is
+    ``data[data_pos : data_pos + offsets[-1]]``.  Shared by the CPU
+    decoder and the device path's zero-copy staging so the validation
+    rules cannot drift."""
     lengths, pos = decode_delta_binary_packed(data, np.int64, pos)
     if lengths.size != count:
         raise ValueError(
@@ -186,9 +190,16 @@ def decode_delta_length_byte_array(data, count: int, pos: int = 0):
         raise ValueError("negative byte-array length")
     offsets = np.zeros(count + 1, dtype=np.int64)
     np.cumsum(lengths, out=offsets[1:])
-    total = int(offsets[-1])
-    if pos + total > len(data):
+    if pos + int(offsets[-1]) > len(data):
         raise ValueError("DELTA_LENGTH_BYTE_ARRAY: truncated data section")
+    return offsets, pos
+
+
+def decode_delta_length_byte_array(data, count: int, pos: int = 0):
+    """Lengths (delta-bp int32) then concatenated bytes; returns
+    (ByteArrayColumn, end_pos) — ``type_bytearray.go:98-140`` equivalent."""
+    offsets, pos = scan_delta_length_byte_array(data, count, pos)
+    total = int(offsets[-1])
     payload = np.frombuffer(data, dtype=np.uint8, count=total, offset=pos)
     return ByteArrayColumn(offsets, payload.copy()), pos + total
 

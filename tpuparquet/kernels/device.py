@@ -1017,6 +1017,24 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 b_sc, _, _ = _scan_levels_v1(values_seg, non_null, 1, 0)
                 _defer_levels(ops, stager, "val", b_sc, None, non_null, 1,
                               cast=None)
+        elif enc == Encoding.DELTA_LENGTH_BYTE_ARRAY \
+                and ptype == Type.BYTE_ARRAY:
+            # lengths decode host-side (small delta stream, validation
+            # shared with the CPU decoder); the byte payload ships as a
+            # zero-copy view — the CPU fallback would memcpy the whole
+            # string payload before staging
+            from ..cpu.delta import scan_delta_length_byte_array
+
+            _def_standalone()
+            offs, dpos = scan_delta_length_byte_array(values_seg,
+                                                      non_null)
+            dlba_bytes = int(offs[-1])
+            view = np.frombuffer(values_seg, np.uint8, dlba_bytes, dpos)
+            dh = stager.add(view)
+            ops.append(
+                lambda s, p, _dh=dh, _o=offs, _nb=dlba_bytes:
+                p["bytes"].append((_o, s[_dh], _nb))
+            )
         elif enc == Encoding.DELTA_BINARY_PACKED and ptype in (
                 Type.INT32, Type.INT64):
             _def_standalone()
