@@ -17,6 +17,7 @@ segment, still staged into the same DeviceColumn):
 * definition/repetition levels (run-table expand) + validity fusion
 * DELTA_BINARY_PACKED int32 and int64 (two-u32-lane arithmetic)
 * BYTE_STREAM_SPLIT int32/int64/float/double/FLBA (device transpose)
+* DELTA_LENGTH_BYTE_ARRAY (host length scan, zero-copy payload staging)
 """
 
 from __future__ import annotations
