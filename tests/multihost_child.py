@@ -1,0 +1,99 @@
+"""Child process for the 2-process MultiHostScan test (test_multihost.py).
+
+Each process builds the SAME deterministic files, decodes ITS strided
+slice of the global (file x row-group) unit list on its local device,
+then exchanges per-unit checksums and row counts over the distributed
+runtime.  Process 0 writes the gathered global result as JSON for the
+parent to verify against a single-process oracle.
+
+Usage: python tests/multihost_child.py <port> <process_id> <out_json>
+"""
+
+import json
+import sys
+
+import numpy as np
+
+import jax
+
+
+def build_files():
+    import io
+
+    from tpuparquet import CompressionCodec, FileWriter
+
+    bufs = []
+    for seed in (301, 302, 303):
+        r = np.random.default_rng(seed)
+        buf = io.BytesIO()
+        w = FileWriter(
+            buf,
+            "message m { required int64 a; optional int32 b; }",
+            codec=CompressionCodec.SNAPPY,
+        )
+        for _ in range(2):  # two row groups per file
+            n = 400
+            bm = r.random(n) >= 0.3
+            w.write_columns(
+                {"a": r.integers(-(2**40), 2**40, size=n),
+                 "b": r.integers(0, 50, size=int(bm.sum()),
+                                 dtype=np.int32)},
+                masks={"b": bm},
+            )
+        w.close()
+        buf.seek(0)
+        bufs.append(buf)
+    return bufs
+
+
+def unit_checksum(cols) -> int:
+    total = 0
+    for path in sorted(cols):
+        vals, rep, dl = cols[path].to_numpy()
+        u = np.ascontiguousarray(vals).view(np.uint8).astype(np.uint64)
+        total += int((u * (np.arange(u.size, dtype=np.uint64) % 997 + 1))
+                     .sum() % (1 << 62))
+        total += int(dl.astype(np.uint64).sum())
+    return total & ((1 << 62) - 1)
+
+
+def main():
+    # config mutation stays in the CHILD: the parent test imports this
+    # module for build_files/unit_checksum and must keep its own backend
+    jax.config.update("jax_platforms", "cpu")
+    port, pid, out_path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    from tpuparquet.shard.distributed import MultiHostScan, allgather_host
+    from tpuparquet.shard.distributed import initialize
+
+    initialize(coordinator_address=f"localhost:{port}", num_processes=2,
+               process_id=pid)
+    assert jax.process_count() == 2
+
+    scan = MultiHostScan(build_files())
+    results = scan.run()
+    assert len(results) == len(scan.local_units)
+
+    # per-global-unit checksums: local slots filled, others zero; the
+    # allgather + sum reconstructs the full vector on every process
+    local = np.zeros(len(scan.global_units), dtype=np.int64)
+    for j, out in enumerate(results):
+        gidx = scan.global_units.index(scan.local_units[j])
+        local[gidx] = unit_checksum(out)
+    gathered = allgather_host(local).reshape(2, -1).sum(axis=0)
+    counts = scan.counts_allgather()
+
+    # resume-cursor shape check on this process's grid coordinates
+    st = scan.state()
+    assert st["process_index"] == pid and st["process_count"] == 2
+
+    if pid == 0:
+        with open(out_path, "w") as f:
+            json.dump({"checksums": gathered.tolist(),
+                       "counts": counts.tolist(),
+                       "units": [list(u) for u in scan.global_units]},
+                      f)
+    print(f"proc {pid}: {len(results)} local units ok", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -82,6 +82,8 @@ class MultiHostScan:
         self.readers = [FileReader(s, *columns) for s in sources]
         self.global_units = scan_units(self.readers)
         self.local_units = process_units(self.global_units)
+        # make_mesh defaults to LOCAL devices (see its docstring; the
+        # 2-process integration test caught the global-devices variant)
         self.mesh = mesh if mesh is not None else make_mesh()
         self.devices = list(self.mesh.devices.flat)
         self._next_local = 0

@@ -50,8 +50,14 @@ def make_mesh(n_devices: int | None = None, sp: int | None = None,
 
     ``sp`` defaults to 2 when the device count is even and >2 (so both
     axes are exercised), else 1 — pass explicitly for real topologies.
+
+    Defaults to this process's LOCAL devices: in a multi-process
+    runtime ``jax.devices()`` includes other hosts' non-addressable
+    devices, and a scan mesh containing those yields arrays the
+    process cannot read (identical to ``jax.devices()`` when
+    single-process).  Cross-host layouts pass ``devices`` explicitly.
     """
-    devs = list(devices if devices is not None else jax.devices())
+    devs = list(devices if devices is not None else jax.local_devices())
     if n_devices is not None:
         devs = devs[:n_devices]
     n = len(devs)
