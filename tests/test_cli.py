@@ -239,3 +239,57 @@ class TestCsv2Parquet:
         t = pq.read_table(dst)
         assert t.column("id").to_pylist() == [1, 2, 3]
         assert t.column("name").to_pylist() == ["alpha", "beta", None]
+
+
+class TestVerifyCommand:
+    def run(self, *argv):
+        import contextlib
+
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = pt.main(list(argv))
+        return rc, out.getvalue()
+
+    def test_verify_ok(self, sample_file):
+        rc, out = self.run("verify", sample_file)
+        assert rc == 0
+        assert "all row groups bit-exact" in out
+        assert "row group 0" in out and "OK" in out
+
+    def test_verify_multi_row_group(self, tmp_path):
+        import numpy as np
+
+        from tpuparquet import CompressionCodec, FileWriter
+
+        p = tmp_path / "multi.parquet"
+        with open(p, "wb") as f:
+            w = FileWriter(f, "message m { required int64 a; "
+                              "optional binary s (STRING); }",
+                           codec=CompressionCodec.SNAPPY)
+            r = np.random.default_rng(5)
+            for _ in range(3):
+                n = 300
+                sm = r.random(n) >= 0.2
+                w.write_columns(
+                    {"a": r.integers(0, 10**9, size=n),
+                     "s": [b"v%d" % i for i in range(int(sm.sum()))]},
+                    masks={"s": sm})
+            w.close()
+        rc, out = self.run("verify", str(p))
+        assert rc == 0
+        assert out.count("OK") == 3
+
+    def test_verify_nan_doubles(self, tmp_path):
+        """NaN payloads must compare bit-exact, not value-equal."""
+        import numpy as np
+
+        from tpuparquet import FileWriter
+
+        p = tmp_path / "nan.parquet"
+        with open(p, "wb") as f:
+            w = FileWriter(f, "message m { required double x; }")
+            w.write_columns({"x": np.array([1.0, np.nan, -np.inf, 3.5])})
+            w.close()
+        rc, out = self.run("verify", str(p))
+        assert rc == 0, out
+        assert "all row groups bit-exact" in out

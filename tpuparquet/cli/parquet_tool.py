@@ -1,8 +1,9 @@
-"""parquet-tool: inspect and split Parquet files.
+"""parquet-tool: inspect, verify and split Parquet files.
 
 Subcommand parity with the reference's cobra tool
 (``/root/reference/cmd/parquet-tool/cmds/``): ``cat``, ``head``,
-``meta``, ``schema``, ``rowcount``, ``split``.
+``meta``, ``schema``, ``rowcount``, ``split``; plus ``verify``
+(CPU-vs-device bit-exact decode comparison — TPU-build addition).
 
 Run as ``python -m tpuparquet.cli.parquet_tool <cmd> <file>``.
 """
@@ -148,6 +149,57 @@ def cmd_rowcount(args, out=None) -> int:
     return 0
 
 
+def cmd_verify(args, out=None) -> int:
+    """Decode every row group on BOTH paths (CPU oracle and device
+    kernels) and compare bit-exactly — the file doctor for the decode
+    backend.  No reference analogue (the reference has one path)."""
+    import time
+
+    import numpy as np
+
+    out = out or sys.stdout
+    from ..cpu.plain import ByteArrayColumn
+    from ..kernels.device import read_row_group_device
+
+    rc = 0
+    with FileReader(args.file) as r:
+        for rg in range(r.row_group_count()):
+            t0 = time.perf_counter()
+            cpu = r.read_row_group_arrays(rg)
+            t1 = time.perf_counter()
+            # read_row_group_device drains all buffers in one batched
+            # sync before returning — no per-column sync needed
+            dev = read_row_group_device(r, rg)
+            t2 = time.perf_counter()
+            n = sum(len(cd.def_levels) for cd in cpu.values())
+            bad = []
+            for path, cd in cpu.items():
+                vals, rep, dl = dev[path].to_numpy()
+                ok = (np.array_equal(rep, cd.rep_levels)
+                      and np.array_equal(dl, cd.def_levels))
+                if ok:
+                    if isinstance(cd.values, ByteArrayColumn):
+                        ok = vals == cd.values
+                    else:
+                        # bitwise, not value, comparison: NaN payloads
+                        # must compare equal for a bit-exact check
+                        a = np.ascontiguousarray(np.asarray(vals))
+                        b = np.ascontiguousarray(np.asarray(cd.values))
+                        ok = (a.shape == b.shape and a.dtype == b.dtype
+                              and a.tobytes() == b.tobytes())
+                if not ok:
+                    bad.append(path)
+            status = "OK" if not bad else f"MISMATCH: {', '.join(bad)}"
+            print(f"row group {rg}: {n:,} values  "
+                  f"cpu {(t1 - t0) * 1e3:.1f}ms  "
+                  f"device {(t2 - t1) * 1e3:.1f}ms  {status}", file=out)
+            if bad:
+                rc = 1
+    print("verify: " + ("all row groups bit-exact" if rc == 0
+                        else "MISMATCHES FOUND"), file=out)
+    return rc
+
+
 def cmd_split(args, out=None) -> int:
     """Re-shard into multiple files of ~--file-size each
     (``split.go:33-122``)."""
@@ -234,6 +286,12 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("schema", help="print the file schema definition")
     s.add_argument("file")
     s.set_defaults(fn=cmd_schema)
+
+    v = sub.add_parser(
+        "verify",
+        help="decode on the CPU and device paths and compare bit-exactly")
+    v.add_argument("file")
+    v.set_defaults(fn=cmd_verify)
 
     rc = sub.add_parser("rowcount", help="print the total row count")
     rc.add_argument("file")
