@@ -200,6 +200,16 @@ class TestDeviceValuesWriter:
         assert _build(schema, {"a": a64, "f": f64}, device=False, **kw) \
             == _build(schema, {"a": a64, "f": f64}, device=True, **kw)
 
+    def test_float32_nan_stats(self):
+        schema = "message m { required float f; }"
+        for f32 in (np.array([np.nan, 2.5, -1.0], np.float32),
+                    np.array([np.nan, np.nan], np.float32),
+                    np.array([np.inf, -np.inf, 0.0], np.float32)):
+            assert _build(schema, {"f": f32}, device=False,
+                          allow_dict=False) \
+                == _build(schema, {"f": f32}, device=True,
+                          allow_dict=False)
+
     def test_unsigned_stat_order(self):
         schema = "message m { required int64 u (INT(64, false)); }"
         uv = np.array([1, -1, 5], np.int64)  # -1 == u64 max
