@@ -51,13 +51,49 @@ def widths_from_max(mb_max: np.ndarray) -> np.ndarray:
 
 
 
-def decode_delta_binary_packed(data, dtype=np.int64, pos: int = 0):
-    """Decode one DELTA_BINARY_PACKED stream; returns (values, end_pos).
+class DeltaStructure:
+    """Parsed DELTA_BINARY_PACKED layout: per-miniblock bookkeeping
+    from one cheap varint walk, shared by the CPU decoder and the
+    device planner (``kernels/decode.py``) so the parsing and
+    validation rules cannot drift.  Zero-width miniblocks are omitted
+    (their deltas are zero; ``min_delta`` carries the value)."""
 
-    ``end_pos`` is where the stream's payload ends, which callers need when
-    another stream follows (DELTA_LENGTH_BYTE_ARRAY data, suffix streams).
-    """
-    dtype = np.dtype(dtype)
+    __slots__ = ("block_size", "mb_size", "total", "first", "md_blocks",
+                 "mb_w", "mb_pos", "mb_start", "end_pos")
+
+    def __init__(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+    def grouped(self):
+        """Per-width (w, positions, starts, takes) with contiguity
+        precomputed: yields ``(w, seg_slice_or_None, p_w, s_w, t_w,
+        dest_contiguous)`` per distinct width."""
+        if not self.mb_w:
+            return
+        n_deltas = self.total - 1
+        w_np = np.asarray(self.mb_w, dtype=np.int64)
+        p_np = np.asarray(self.mb_pos, dtype=np.int64)
+        s_np = np.asarray(self.mb_start, dtype=np.int64)
+        t_np = np.minimum(self.mb_size, n_deltas - s_np)
+        for w in np.unique(w_np):
+            w = int(w)
+            nbytes = self.mb_size * w // 8
+            m = w_np == w
+            p_w, s_w, t_w = p_np[m], s_np[m], t_np[m]
+            k = len(p_w)
+            src_contig = k == 1 or (np.diff(p_w) == nbytes).all()
+            dst_contig = k == 1 or (np.diff(s_w) == self.mb_size).all()
+            yield w, src_contig, p_w, s_w, t_w, dst_contig
+
+
+def scan_delta_structure(data, pos: int = 0,
+                         max_width: int = 64) -> DeltaStructure:
+    """One structure pass over a DELTA_BINARY_PACKED stream: headers
+    validated, per-miniblock (width, payload position, delta start)
+    collected — a per-miniblock ``unpack()`` call costs a Python call
+    per 32 values (~370k for a 12M-value chunk); callers batch-decode
+    from this structure instead."""
     block_size, pos = read_uvarint(data, pos)
     n_miniblocks, pos = read_uvarint(data, pos)
     if block_size <= 0 or block_size % 128:
@@ -69,39 +105,100 @@ def decode_delta_binary_packed(data, dtype=np.int64, pos: int = 0):
         raise ValueError(f"miniblock size {mb_size} not a multiple of 32")
     total, pos = read_uvarint(data, pos)
     first, pos = read_zigzag(data, pos)
-    if total == 0:
-        return np.empty(0, dtype=dtype), pos
-
-    # All arithmetic in uint64: two's-complement wraparound for free, for
-    # both the 32- and 64-bit cases (final cast truncates to the target).
-    n_deltas = total - 1
-    deltas = np.empty(n_deltas, dtype=np.uint64)
+    n_deltas = max(total - 1, 0)
+    data_len = len(data)
+    md_blocks: list[int] = []
+    mb_w: list[int] = []
+    mb_pos: list[int] = []
+    mb_start: list[int] = []
     got = 0
     while got < n_deltas:
         min_delta, pos = read_zigzag(data, pos)
-        md = np.uint64(min_delta & 0xFFFFFFFFFFFFFFFF)
-        if pos + n_miniblocks > len(data):
+        md_blocks.append(min_delta)
+        if pos + n_miniblocks > data_len:
             raise ValueError("truncated miniblock width list")
         widths = bytes(data[pos : pos + n_miniblocks])
         pos += n_miniblocks
         for w in widths:
             if got >= n_deltas:
                 break  # unused trailing miniblocks carry no payload
-            if w > 64:
-                raise ValueError(f"invalid miniblock bit width {w}")
+            if w > max_width:
+                raise ValueError(
+                    f"delta miniblock width {w} > {max_width} for this "
+                    "column's physical type")
             nbytes = mb_size * w // 8
-            if pos + nbytes > len(data):
+            if pos + nbytes > data_len:
                 raise ValueError("truncated miniblock payload")
-            vals = unpack(data[pos : pos + nbytes], mb_size, w)
+            if w:
+                mb_w.append(w)
+                mb_pos.append(pos)
+                mb_start.append(got)
             pos += nbytes
-            take = min(mb_size, n_deltas - got)
-            deltas[got : got + take] = vals[:take].astype(np.uint64) + md
-            got += take
-    out = np.empty(total, dtype=np.uint64)
-    out[0] = np.uint64(first & 0xFFFFFFFFFFFFFFFF)
+            got += mb_size  # final miniblock may overshoot; clamped later
+    return DeltaStructure(
+        block_size=block_size, mb_size=mb_size, total=total, first=first,
+        md_blocks=md_blocks, mb_w=mb_w, mb_pos=mb_pos, mb_start=mb_start,
+        end_pos=pos)
+
+
+# Cap per-unpack batch size on the host: unpack() materializes a
+# (count, width) lane matrix, so an unbounded batch over a 12M-value
+# chunk at width 40 would transiently allocate ~4 GB.  1M values keeps
+# the working set ~tens of MB with the vectorization intact.
+_UNPACK_SLAB_VALUES = 1 << 20
+
+
+def decode_delta_binary_packed(data, dtype=np.int64, pos: int = 0):
+    """Decode one DELTA_BINARY_PACKED stream; returns (values, end_pos).
+
+    ``end_pos`` is where the stream's payload ends, which callers need when
+    another stream follows (DELTA_LENGTH_BYTE_ARRAY data, suffix streams).
+    """
+    dtype = np.dtype(dtype)
+    st = scan_delta_structure(data, pos)
+    if st.total == 0:
+        return np.empty(0, dtype=dtype), st.end_pos
+
+    # All arithmetic in uint64: two's-complement wraparound for free, for
+    # both the 32- and 64-bit cases (final cast truncates to the target).
+    n_deltas = st.total - 1
+    mb_size = st.mb_size
+    deltas = np.zeros(n_deltas, dtype=np.uint64)  # w==0 blocks stay 0
+    buf = (data if isinstance(data, np.ndarray)
+           else np.frombuffer(data, dtype=np.uint8))
+    for w, src_contig, p_w, s_w, t_w, dst_contig in st.grouped():
+        nbytes = mb_size * w // 8
+        k = len(p_w)
+        slab = max(_UNPACK_SLAB_VALUES // mb_size, 1)
+        for lo_i in range(0, k, slab):
+            hi_i = min(lo_i + slab, k)
+            kk = hi_i - lo_i
+            if src_contig:
+                seg = buf[p_w[lo_i] : p_w[lo_i] + nbytes * kk]
+            else:
+                seg = np.concatenate(
+                    [buf[p : p + nbytes] for p in p_w[lo_i:hi_i]])
+            vals = unpack(seg, mb_size * kk, w).astype(np.uint64)
+            s_s, t_s = s_w[lo_i:hi_i], t_w[lo_i:hi_i]
+            if dst_contig:
+                # only the globally-last miniblock can be partial
+                n_take = int(t_s.sum())
+                deltas[s_s[0] : s_s[0] + n_take] = vals[:n_take]
+            else:
+                vals = vals.reshape(kk, mb_size)
+                keep = np.arange(mb_size)[None, :] < t_s[:, None]
+                deltas[(s_s[:, None]
+                        + np.arange(mb_size)[None, :])[keep]] = vals[keep]
+    # per-block min_delta, expanded once (one repeat, no per-miniblock
+    # slice assignments)
+    deltas += np.repeat(
+        np.asarray(st.md_blocks, dtype=np.int64).view(np.uint64),
+        st.block_size)[:n_deltas]
+    out = np.empty(st.total, dtype=np.uint64)
+    out[0] = np.uint64(st.first & 0xFFFFFFFFFFFFFFFF)
     np.cumsum(deltas, out=out[1:])
     out[1:] += out[0]
-    return out.view(np.int64).astype(dtype), pos
+    return out.view(np.int64).astype(dtype), st.end_pos
 
 
 def encode_delta_binary_packed(

@@ -17,7 +17,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..varint import read_uvarint, read_zigzag
 from .bitunpack import pad_to_words, unpack_u32
 
 __all__ = [
@@ -295,7 +294,11 @@ def dict_gather_bytes(dict_offsets: jax.Array, dict_data: jax.Array,
 
 class DeltaPlan:
     __slots__ = (
-        "groups",        # list of (width, words_np, positions_np, n_vals)
+        # list of 7-tuples (width, words_np, positions_np, keep_np,
+        # n_vals, start, n_take); positions/keep are None for a
+        # contiguous group, whose deltas land in the destination slice
+        # [start, start + n_take) (the common single-width stream)
+        "groups",
         "min_deltas",    # per-delta min_delta contribution (host-expanded)
         "first", "total",
     )
@@ -311,64 +314,45 @@ def _plan_delta(data, pos: int, max_width: int) -> DeltaPlan:
     """Parse DELTA_BINARY_PACKED headers; group miniblock payloads by bit
     width so the device unpacks each width class in one static-shape
     call.  Shared by the 32- and 64-bit planners (``max_width`` is the
-    column's physical width — a wider miniblock is malformed)."""
-    block_size, pos = read_uvarint(data, pos)
-    n_miniblocks, pos = read_uvarint(data, pos)
-    if block_size <= 0 or block_size % 128 or n_miniblocks <= 0 \
-            or block_size % n_miniblocks:
-        raise ValueError("invalid delta header")
-    mb_size = block_size // n_miniblocks
-    if mb_size % 32:
-        # same constraint the CPU oracle enforces (cpu/delta.py): the
-        # whole-word miniblock layout this planner assumes requires it
-        raise ValueError(f"miniblock size {mb_size} not a multiple of 32")
-    total, pos = read_uvarint(data, pos)
-    first, pos = read_zigzag(data, pos)
-    n_deltas = max(total - 1, 0)
+    column's physical width — a wider miniblock is malformed).
 
-    by_width: dict[int, list] = {}
-    min_deltas = np.zeros(n_deltas, dtype=np.int64)
-    got = 0
-    while got < n_deltas:
-        min_delta, pos = read_zigzag(data, pos)
-        widths = bytes(data[pos : pos + n_miniblocks])
-        if len(widths) < n_miniblocks:
-            raise ValueError("truncated miniblock width list")
-        pos += n_miniblocks
-        for w in widths:
-            if got >= n_deltas:
-                break
-            if w > max_width:
-                raise ValueError(
-                    f"delta miniblock width {w} > {max_width} for this "
-                    "column's physical type"
-                )
-            nbytes = mb_size * w // 8
-            take = min(mb_size, n_deltas - got)
-            min_deltas[got : got + take] = min_delta
-            seg = np.frombuffer(data, np.uint8, nbytes, pos)
-            by_width.setdefault(w, []).append((seg, got, take))
-            pos += nbytes
-            got += take
+    The structure pass (validation + per-miniblock bookkeeping) is the
+    CPU oracle's own ``scan_delta_structure`` — one implementation of
+    the parsing rules for both paths."""
+    from ..cpu.delta import scan_delta_structure
 
+    st = scan_delta_structure(data, pos, max_width=max_width)
+    n_deltas = max(st.total - 1, 0)
+    mb_size = st.mb_size
+    buf = (data if isinstance(data, np.ndarray)
+           else np.frombuffer(data, dtype=np.uint8))
+    min_deltas = np.repeat(np.asarray(st.md_blocks, dtype=np.int64),
+                           st.block_size)[:n_deltas]
     groups = []
-    for w, segs in by_width.items():
-        if w == 0:
-            continue  # deltas are all zero; min_delta carries the value
-        packed = np.concatenate([s for s, _, _ in segs])
-        n_vals = mb_size * len(segs)
+    for w, src_contig, p_w, s_w, t_w, dst_contig in st.grouped():
+        nbytes = mb_size * w // 8
+        k = len(p_w)
+        if src_contig:
+            packed = buf[p_w[0] : p_w[0] + nbytes * k]
+        else:
+            packed = np.concatenate([buf[p : p + nbytes] for p in p_w])
+        n_vals = mb_size * k
         # flat: a 2-D (n_blocks, w) device buffer tiles to 128 lanes
         words = pad_to_words(packed, w, n_vals).reshape(-1)
-        positions = np.concatenate([
-            np.arange(start, start + take, dtype=np.int32)
-            for _, start, take in segs
-        ])
-        keep = np.concatenate([
-            np.arange(i * mb_size, i * mb_size + take, dtype=np.int32)
-            for i, (_, _, take) in enumerate(segs)
-        ])
-        groups.append((w, words, positions, keep, n_vals))
-    return DeltaPlan(groups, min_deltas, first, total)
+        if dst_contig:
+            # contiguous destination slice: only the globally-last
+            # miniblock can be partial.  positions/keep stay None and
+            # the expanders use a cheap dynamic-slice update.
+            groups.append((w, words, None, None, n_vals,
+                           int(s_w[0]), int(t_w.sum())))
+        else:
+            lane = np.arange(mb_size, dtype=np.int32)[None, :]
+            keep_m = lane < t_w[:, None]
+            positions = (s_w[:, None].astype(np.int32) + lane)[keep_m]
+            keep = (np.arange(n_vals, dtype=np.int32)
+                    .reshape(k, mb_size))[keep_m]
+            groups.append((w, words, positions, keep, n_vals, 0, 0))
+    return DeltaPlan(groups, min_deltas, st.first, st.total)
 
 
 def plan_delta_i32(data, pos: int = 0) -> DeltaPlan:
@@ -380,11 +364,15 @@ def expand_delta_i32(plan: DeltaPlan) -> jax.Array:
     min_delta, prefix-sum (int32 two's-complement wrap)."""
     n_deltas = max(plan.total - 1, 0)
     deltas = jnp.zeros((max(n_deltas, 1),), dtype=jnp.uint32)
-    for w, words, positions, keep, n_vals in plan.groups:
+    for w, words, positions, keep, n_vals, start, n_take in plan.groups:
         vals = unpack_u32(jnp.asarray(words), w, n_vals)
-        deltas = deltas.at[jnp.asarray(positions)].set(
-            vals[jnp.asarray(keep)]
-        )
+        if positions is None:  # contiguous destination slice
+            deltas = jax.lax.dynamic_update_slice(
+                deltas, vals[:n_take], (start,))
+        else:
+            deltas = deltas.at[jnp.asarray(positions)].set(
+                vals[jnp.asarray(keep)]
+            )
     if plan.total == 0:
         return jnp.zeros((0,), dtype=jnp.uint32)
     first = jnp.asarray(np.uint32(plan.first & 0xFFFFFFFF))
@@ -446,12 +434,16 @@ def expand_delta_i64(plan: DeltaPlan) -> jax.Array:
         return first.reshape(-1)
     dlo = jnp.zeros((n_deltas,), dtype=jnp.uint32)
     dhi = jnp.zeros((n_deltas,), dtype=jnp.uint32)
-    for w, words, positions, keep, n_vals in plan.groups:
+    for w, words, positions, keep, n_vals, start, n_take in plan.groups:
         lo, hi = unpack_u64(jnp.asarray(words), w, n_vals)
-        p = jnp.asarray(positions)
-        k = jnp.asarray(keep)
-        dlo = dlo.at[p].set(lo[k])
-        dhi = dhi.at[p].set(hi[k])
+        if positions is None:  # contiguous destination slice
+            dlo = jax.lax.dynamic_update_slice(dlo, lo[:n_take], (start,))
+            dhi = jax.lax.dynamic_update_slice(dhi, hi[:n_take], (start,))
+        else:
+            p = jnp.asarray(positions)
+            k = jnp.asarray(keep)
+            dlo = dlo.at[p].set(lo[k])
+            dhi = dhi.at[p].set(hi[k])
     md_u = plan.min_deltas.view(np.uint64)
     md_lo = jnp.asarray((md_u & np.uint64(0xFFFFFFFF)).astype(np.uint32))
     md_hi = jnp.asarray((md_u >> np.uint64(32)).astype(np.uint32))
