@@ -1,5 +1,6 @@
 """Device value-decode kernels: PLAIN staging, levels→validity, dictionary
-gather (fixed and variable width), DELTA_BINARY_PACKED int32.
+gather (fixed and variable width), BYTE_STREAM_SPLIT, and
+DELTA_BINARY_PACKED int32/int64.
 
 All kernels follow the same shape discipline: hosts stage *padded,
 fixed-shape* buffers (page bytes as u32 words, run/plan tables as arrays)
