@@ -895,3 +895,71 @@ class TestDeviceDeltaLengthByteArray:
 
         monkeypatch.setattr(D, "decode_values_cpu", boom)
         self._roundtrip([b"abc", b"", b"defg"] * 100)
+
+
+class TestPytreeRegistration:
+    """DeviceColumn / DeviceValues are JAX pytrees: decoded columns and
+    device value buffers pass straight through jit boundaries."""
+
+    def _column(self):
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required int64 a; "
+                            "optional int32 b; }")
+        rng_ = np.random.default_rng(3)
+        n = 500
+        bm = rng_.random(n) >= 0.4
+        w.write_columns({"a": rng_.integers(0, 10**12, size=n),
+                         "b": rng_.integers(0, 9, size=int(bm.sum()),
+                                            dtype=np.int32)},
+                        masks={"b": bm})
+        w.close()
+        buf.seek(0)
+        return read_row_group_device(FileReader(buf), 0)
+
+    def test_jit_over_device_column(self):
+        import jax
+
+        cols = self._column()
+
+        @jax.jit
+        def double_low_lane(col):
+            # structured input AND output cross the jit boundary
+            lanes = col.data.reshape(-1, 2)
+            return lanes[:, 0] * 2, col
+
+        doubled, same = double_low_lane(cols["a"])
+        want = np.asarray(cols["a"].data).reshape(-1, 2)[:, 0] * 2
+        np.testing.assert_array_equal(np.asarray(doubled), want)
+        va, ra, da = same.to_numpy()
+        wa, wr, wd = cols["a"].to_numpy()
+        np.testing.assert_array_equal(va, wa)
+        np.testing.assert_array_equal(da, wd)
+        assert same.num_values == cols["a"].num_values
+
+    def test_jit_over_nullable_column(self):
+        import jax
+
+        cols = self._column()
+
+        out = jax.jit(lambda c: c)(cols["b"])
+        gv, gr, gd = out.to_numpy()
+        wv, wr, wd = cols["b"].to_numpy()
+        np.testing.assert_array_equal(gv, wv)
+        np.testing.assert_array_equal(gd, wd)
+
+    def test_jit_returns_device_values(self):
+        import jax
+
+        from tpuparquet.kernels.encode import DeviceValues
+
+        dv = DeviceValues(jnp.arange(20, dtype=jnp.uint32), np.int64)
+
+        @jax.jit
+        def passthrough(v):
+            return v
+
+        out = passthrough(dv)
+        assert isinstance(out, DeviceValues)
+        assert out.dtype == np.dtype(np.int64) and out.count == 10
+        np.testing.assert_array_equal(np.asarray(out.flat),
+                                      np.arange(20, dtype=np.uint32))

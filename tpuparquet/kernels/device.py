@@ -325,6 +325,30 @@ class DeviceColumn:
         raise TypeError(f"unsupported type {self.ptype}")
 
 
+def _devicecolumn_flatten(col: DeviceColumn):
+    leaves = (col._data_p, col.offsets, col._mask_p, col._pos_p,
+              col._rep_p, col._def_p)
+    aux = (col.ptype, col.type_length, col.num_values, col.n_packed,
+           col.n_bytes)
+    return leaves, aux
+
+
+def _devicecolumn_unflatten(aux, leaves):
+    data, offsets, mask, positions, rep, dl = leaves
+    ptype, type_length, num_values, n_packed, n_bytes = aux
+    return DeviceColumn(ptype, type_length, data, offsets, mask,
+                        positions, rep, dl, num_values,
+                        n_packed=n_packed, n_bytes=n_bytes)
+
+
+# DeviceColumn is a JAX pytree: decoded columns pass straight through
+# jit/vmap/transform boundaries (buffers are the leaves; shape metadata
+# is static aux), so `jax.jit(fn)(read_row_group_device(...)['x'])`
+# just works — the decode output is a first-class device value.
+jax.tree_util.register_pytree_node(
+    DeviceColumn, _devicecolumn_flatten, _devicecolumn_unflatten)
+
+
 def _stage_fixed_plain(raw: bytes, count: int, ptype: Type,
                        type_length) -> jax.Array:
     if ptype == Type.BOOLEAN:

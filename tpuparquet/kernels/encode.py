@@ -383,7 +383,7 @@ class DeviceValues:
     design); combine with ``column_encodings`` to force DELTA or BSS.
     """
 
-    __slots__ = ("flat", "count", "dtype")
+    __slots__ = ("flat", "dtype")
 
     def __init__(self, flat, dtype):
         self.dtype = np.dtype(dtype)
@@ -395,16 +395,20 @@ class DeviceValues:
         self.flat = jnp.asarray(flat)
         if self.flat.dtype != jnp.uint32 or self.flat.ndim != 1:
             raise TypeError("flat must be a 1-D uint32 lane array")
-        lanes = self.lanes
-        if self.flat.shape[0] % lanes:
+        if self.flat.shape[0] % self.lanes:
             raise ValueError(
                 f"lane array length {self.flat.shape[0]} not a multiple "
-                f"of {lanes}")
-        self.count = self.flat.shape[0] // lanes
+                f"of {self.lanes}")
 
     @property
     def lanes(self) -> int:
         return self.dtype.itemsize // 4
+
+    @property
+    def count(self) -> int:
+        """Derived from the lane buffer (never stored), so tree
+        transforms that reshape the leaf can't desync it."""
+        return self.flat.shape[0] // self.lanes
 
     def __len__(self) -> int:
         return self.count
@@ -469,3 +473,23 @@ class DeviceValues:
         raise ValueError(
             f"DeviceValues cannot encode {encoding!r}; supported: PLAIN, "
             "DELTA_BINARY_PACKED, BYTE_STREAM_SPLIT")
+
+
+def _devicevalues_unflatten(aux, leaves):
+    # bypass __init__: pytree unflattening may pass dummy leaves while
+    # manipulating tree structure, which must not be validated
+    obj = DeviceValues.__new__(DeviceValues)
+    (obj.dtype,) = aux
+    obj.flat = leaves[0]
+    return obj
+
+
+# DeviceValues is a JAX pytree (lane buffer is the leaf; dtype static
+# aux — count derives from the leaf, so leaf-reshaping transforms stay
+# consistent): jitted producers can return one directly, and it feeds
+# write_columns without leaving the device.
+jax.tree_util.register_pytree_node(
+    DeviceValues,
+    lambda v: ((v.flat,), (v.dtype,)),
+    _devicevalues_unflatten,
+)
