@@ -467,30 +467,41 @@ def run_config5() -> dict:
     }
 
 
-def _probe_backend(timeout_s: int = 240) -> None:
-    """Fail fast when the device backend can't initialize.
+def _probe_backend(timeout_s: int = 240, attempts: int = 3) -> None:
+    """Fail fast (after a few retries) when the device backend can't
+    initialize.
 
     A wedged remote tunnel makes ``jax.devices()`` hang indefinitely
     (observed repeatedly on the axon tunnel); probing in a subprocess
-    with a timeout turns a silently-eaten measurement window into an
-    immediate, diagnosable failure."""
+    with a timeout turns a silently-eaten measurement window into a
+    bounded, diagnosable failure — while the retries ride out a tunnel
+    that recovers mid-window."""
     import subprocess
 
-    try:
-        subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s, check=True,
-            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
-        )
-    except subprocess.TimeoutExpired:
-        print(f"bench: device backend failed to initialize within "
-              f"{timeout_s}s (tunnel wedged?) — aborting instead of "
-              "hanging", file=sys.stderr)
-        raise SystemExit(2)
-    except subprocess.CalledProcessError as e:
-        print(f"bench: device backend probe failed (rc={e.returncode})\n"
-              f"{(e.stderr or '')[-2000:]}", file=sys.stderr)
-        raise SystemExit(2)
+    last = None
+    for attempt in range(1, attempts + 1):
+        try:
+            subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=timeout_s, check=True,
+                stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+                text=True,
+            )
+            return
+        except subprocess.TimeoutExpired:
+            last = (f"device backend failed to initialize within "
+                    f"{timeout_s}s (tunnel wedged?)")
+            pause = 0  # the timeout itself already passed wall time
+        except subprocess.CalledProcessError as e:
+            last = (f"device backend probe failed (rc={e.returncode})\n"
+                    f"{(e.stderr or '')[-2000:]}")
+            pause = 60  # fast failure: give the tunnel a window to return
+        print(f"bench: probe attempt {attempt}/{attempts}: {last}",
+              file=sys.stderr, flush=True)
+        if attempt < attempts and pause:
+            time.sleep(pause)
+    print("bench: aborting instead of hanging", file=sys.stderr)
+    raise SystemExit(2)
 
 
 def main() -> None:
