@@ -12,6 +12,13 @@ decisions (per-miniblock widths) made on host between two device
 phases.  Every kernel is byte-exact with its NumPy twin in
 ``cpu/bitpack.py`` / ``cpu/delta.py`` — the tests assert identical
 wire bytes, not just round-trip equality.
+
+Reference analogues (CPU-only, value-at-a-time there): the generated
+pack tables ``bitbacking32.go``/``bitpacking64.go`` (one vectorized
+formulation replaces ~4.6k generated LoC, as on the decode side), the
+delta encoder ``deltabp_encoder.go`` (block 128 / 4 miniblocks per its
+call sites, ``type_bytearray.go:176-180``), and the writer encode
+dispatch ``chunk_writer.go:99-159``.
 """
 
 from __future__ import annotations
