@@ -329,3 +329,73 @@ class TestReflectionRoundTrip:
             got = list(r)
         assert got[0].vals == [1, 2, 3]
         assert got[1].vals in ([], None)
+
+
+class TestColumnarObjectWrite:
+    """Writer.write_columns: bulk columnar extraction for flat
+    schemas — decoded contents identical to the per-row path."""
+
+    @dataclass
+    class Flat:
+        ident: int
+        name: str
+        score: float
+        ok: bool
+        maybe: Optional[int] = None
+        born: Optional[datetime.date] = None
+        seen: Optional[datetime.datetime] = None
+
+    def _objs(self, n=200):
+        out = []
+        for i in range(n):
+            out.append(self.Flat(
+                ident=i, name=f"n{i % 13}", score=i / 7, ok=i % 3 == 0,
+                maybe=None if i % 5 == 0 else i * 2,
+                born=None if i % 4 == 0 else datetime.date(2000, 1, 1 + i % 28),
+                seen=None if i % 6 == 0 else
+                datetime.datetime(2024, 1, 1, 0, 0, i % 60),
+            ))
+        return out
+
+    def test_matches_row_path(self, tmp_path):
+        objs = self._objs()
+        pa_ = tmp_path / "rows.parquet"
+        pb_ = tmp_path / "cols.parquet"
+        with new_file_writer(str(pa_), cls=self.Flat) as w:
+            w.write_many(objs)
+        with new_file_writer(str(pb_), cls=self.Flat) as w:
+            w.write_columns(objs)
+        with new_file_reader(str(pa_), self.Flat) as r:
+            want = list(r)
+        with new_file_reader(str(pb_), self.Flat) as r:
+            got = list(r)
+        assert got == want
+
+    def test_nested_schema_rejected(self, tmp_path):
+        p = tmp_path / "x.parquet"
+        with new_file_writer(str(p), cls=Record) as w:
+            with pytest.raises(ValueError, match="flat schemas"):
+                w.write_columns(sample_records())
+            w.write_many(sample_records())  # row path still fine
+
+    def test_required_null_rejected(self, tmp_path):
+        p = tmp_path / "y.parquet"
+        objs = self._objs(3)
+        objs[1] = self.Flat(ident=1, name=None, score=0.0, ok=True)
+        with new_file_writer(str(p), cls=self.Flat) as w:
+            with pytest.raises(ValueError, match="required"):
+                w.write_columns(objs)
+            w.write_columns(self._objs(3))  # clean batch succeeds
+
+    def test_marshal_hook_rejected(self, tmp_path):
+        @dataclass
+        class Hooked:
+            ident: int
+
+            def marshal_parquet(self):
+                return {"ident": self.ident * 100}
+
+        p = tmp_path / "h.parquet"
+        with new_file_writer(str(p), schema_of(Hooked)) as w:
+            with pytest.raises(TypeError, match="marshal_parquet"):
+                w.write_columns([Hooked(ident=1)])

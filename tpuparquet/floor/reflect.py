@@ -33,7 +33,8 @@ from .time import (
     time_from_nanoseconds,
 )
 
-__all__ = ["field_name", "schema_of", "to_row", "from_row"]
+__all__ = ["field_name", "schema_of", "to_row", "from_row",
+           "objects_to_columns"]
 
 
 def field_name(f: dataclasses.Field) -> str:
@@ -193,6 +194,68 @@ def to_row(obj, schema) -> dict:
         for child in schema.root.children
         if _has_member(obj, child.name)
     }
+
+
+def objects_to_columns(objs, schema):
+    """Bulk columnar extraction for FLAT schemas: dataclasses/mappings
+    -> ``(columns, masks)`` for ``FileWriter.write_columns``.
+
+    Skips the per-row dict building + shredding machinery while
+    applying the SAME leaf conversions as :func:`to_row`
+    (strings, date/time/timestamp units, UUID) — decoded contents are
+    identical to the row path; the columnar call writes one row group.
+    Nested schemas (groups, LIST/MAP, repeated leaves) raise — use
+    ``Writer.write``/``write_many`` for those."""
+    leaves = schema.leaves
+    for leaf in leaves:
+        if len(leaf.path) != 1 or leaf.max_rep_level:
+            raise ValueError(
+                f"objects_to_columns supports flat schemas only; "
+                f"{leaf.flat_name!r} is nested (use write/write_many)")
+    objs = list(objs)
+    # per-class parquet-name -> attribute map, computed once (the row
+    # path's per-access field scan would cost O(fields) per value here)
+    attr_maps: dict = {}
+
+    def getter(o, name):
+        if isinstance(o, dict):
+            return o.get(name)
+        cls = type(o)
+        m = attr_maps.get(cls)
+        if m is None:
+            if not dataclasses.is_dataclass(o):
+                raise TypeError(
+                    f"cannot marshal {cls.__name__}: expected a "
+                    "dataclass or mapping")
+            m = {field_name(f): f.name for f in dataclasses.fields(o)}
+            attr_maps[cls] = m
+        attr = m.get(name)
+        return getattr(o, attr) if attr is not None else None
+
+    columns: dict = {}
+    masks: dict = {}
+    for leaf in leaves:
+        name = leaf.name
+        vals = []
+        mask = None
+        for i, o in enumerate(objs):
+            v = getter(o, name)
+            if v is None:
+                if not leaf.max_def_level:
+                    raise ValueError(
+                        f"column {name!r} is required but object {i} "
+                        "has no value")
+                if mask is None:
+                    import numpy as _np
+
+                    mask = _np.ones(len(objs), dtype=bool)
+                mask[i] = False
+            else:
+                vals.append(_encode_leaf(v, leaf))
+        columns[name] = vals
+        if mask is not None:
+            masks[name] = mask
+    return columns, masks
 
 
 def _get_member(obj, name: str):

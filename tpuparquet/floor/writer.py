@@ -11,7 +11,7 @@ from __future__ import annotations
 import dataclasses
 
 from ..io.writer import FileWriter
-from .reflect import schema_of, to_row
+from .reflect import objects_to_columns, schema_of, to_row
 
 __all__ = ["Writer", "new_file_writer"]
 
@@ -46,6 +46,24 @@ class Writer:
     def write_many(self, objs) -> None:
         for o in objs:
             self.write(o)
+
+    def write_columns(self, objs, **flush_kw) -> None:
+        """Bulk columnar write of objects with a FLAT schema: one row
+        group per call, same decoded contents as :meth:`write_many`
+        but without per-row dict building and shredding.  Objects with
+        a ``marshal_parquet`` hook or nested schemas need the row path
+        (``write``/``write_many``)."""
+        objs = list(objs)
+        for o in objs:
+            if callable(getattr(o, "marshal_parquet", None)):
+                # the hook supplies custom rows that reflection would
+                # silently diverge from — refuse loudly
+                raise TypeError(
+                    f"{type(o).__name__} defines marshal_parquet; the "
+                    "columnar path reflects raw attributes — use "
+                    "write/write_many")
+        cols, masks = objects_to_columns(objs, self._fw.schema)
+        self._fw.write_columns(cols, masks=masks or None, **flush_kw)
 
     def flush_row_group(self, **kw) -> None:
         self._fw.flush_row_group(**kw)
