@@ -399,3 +399,63 @@ class TestColumnarObjectWrite:
         with new_file_writer(str(p), schema_of(Hooked)) as w:
             with pytest.raises(TypeError, match="marshal_parquet"):
                 w.write_columns([Hooked(ident=1)])
+
+    def test_read_columns_matches_iteration(self, tmp_path):
+        objs = self._objs(150)
+        p = tmp_path / "rc.parquet"
+        with new_file_writer(str(p), cls=self.Flat) as w:
+            w.write_columns(objs)
+        with new_file_reader(str(p), self.Flat) as r:
+            want = list(r)
+        with new_file_reader(str(p), self.Flat) as r:
+            got = r.read_columns(0)
+        assert got == want
+        assert got == objs
+
+    def test_read_columns_needs_cls(self, tmp_path):
+        p = tmp_path / "nc.parquet"
+        with new_file_writer(str(p), cls=self.Flat) as w:
+            w.write_columns(self._objs(3))
+        with new_file_reader(str(p)) as r:
+            with pytest.raises(TypeError, match="dataclass"):
+                r.read_columns(0)
+
+    def test_read_columns_nested_rejected(self, tmp_path):
+        p = tmp_path / "nr.parquet"
+        with new_file_writer(str(p), cls=Record) as w:
+            w.write_many(sample_records())
+        with new_file_reader(str(p), Record) as r:
+            with pytest.raises(ValueError, match="flat schemas"):
+                r.read_columns(0)
+
+    def test_read_columns_uuid_and_unmatched_fields(self, tmp_path):
+        @dataclass
+        class WithUuid:
+            ident: int
+            uid: Optional[uuid.UUID] = None
+
+        objs = [WithUuid(ident=i,
+                         uid=None if i % 3 == 0 else
+                         uuid.UUID(int=i * 7919)) for i in range(30)]
+        p = tmp_path / "u.parquet"
+        with new_file_writer(str(p), cls=WithUuid) as w:
+            w.write_columns(objs)
+        with new_file_reader(str(p), WithUuid) as r:
+            assert r.read_columns(0) == objs
+
+        @dataclass
+        class NoMatch:
+            other: Optional[int] = None
+
+        with new_file_reader(str(p)) as r:
+            got = r.read_columns(0, cls=NoMatch)
+        assert got == [NoMatch(other=None)] * 30  # rows preserved
+
+    def test_write_columns_empty_is_noop(self, tmp_path):
+        p = tmp_path / "e.parquet"
+        with new_file_writer(str(p), cls=self.Flat) as w:
+            w.write_columns([])
+            w.write_columns(self._objs(5))
+        from tpuparquet import FileReader
+        with FileReader(str(p)) as fr:
+            assert fr.row_group_count() == 1 and fr.num_rows == 5

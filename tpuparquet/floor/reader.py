@@ -56,6 +56,21 @@ class Reader:
             return decode_row(self._row, self._fr.schema)
         return from_row(self._row, cls, self._fr.schema)
 
+    def read_columns(self, rg_index: int, cls=None) -> list:
+        """Bulk-materialize one row group's objects for a FLAT schema:
+        columnar decode + per-leaf conversion, no per-row record
+        assembly.  Same objects as iterating that row group."""
+        from .reflect import objects_from_columns
+
+        cls = cls or self._cls
+        if cls is None:
+            raise TypeError("read_columns needs a dataclass (bind cls "
+                            "or pass one)")
+        return objects_from_columns(
+            self._fr.read_row_group_arrays(rg_index), cls,
+            self._fr.schema,
+            n_rows=self._fr.meta.row_groups[rg_index].num_rows)
+
     def __iter__(self):
         while self.next():
             yield self.scan()

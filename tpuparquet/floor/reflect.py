@@ -34,7 +34,7 @@ from .time import (
 )
 
 __all__ = ["field_name", "schema_of", "to_row", "from_row",
-           "objects_to_columns"]
+           "objects_to_columns", "objects_from_columns"]
 
 
 def field_name(f: dataclasses.Field) -> str:
@@ -256,6 +256,59 @@ def objects_to_columns(objs, schema):
         if mask is not None:
             masks[name] = mask
     return columns, masks
+
+
+def objects_from_columns(columns, cls, schema, n_rows=None) -> list:
+    """Bulk inverse of :func:`objects_to_columns`: the
+    ``{name: ChunkData}`` output of ``FileReader.read_row_group_arrays``
+    -> ``list[cls]``, flat schemas only, with the same leaf conversions
+    as :func:`from_row` (strings, date/time/timestamp units, UUID) —
+    but no per-row record assembly.  ``n_rows`` is required when no
+    dataclass field matches a file column (there is then no column to
+    infer the row count from)."""
+    from ..io.values import handler_for
+
+    if not dataclasses.is_dataclass(cls):
+        raise TypeError(f"{cls!r} is not a dataclass")
+    for leaf in schema.leaves:
+        if len(leaf.path) != 1 or leaf.max_rep_level:
+            raise ValueError(
+                f"objects_from_columns supports flat schemas only; "
+                f"{leaf.flat_name!r} is nested (use iteration/scan)")
+    field_cols: list = []
+    for f, hint in _dc_fields(cls):
+        name = field_name(f)
+        node = _child_named(schema.root, name)
+        if node is None or name not in columns:
+            field_cols.append((f.name, None))
+            continue
+        cd = columns[name]
+        # the row path's materialization (io/store.py): unsigned
+        # re-views, FLBA/INT96 -> bytes, np scalars -> Python values
+        vals = handler_for(node.element).to_pylist(cd.values)
+        dl = cd.def_levels
+        if n_rows is None:
+            n_rows = len(dl)
+        elif n_rows != len(dl):
+            raise ValueError(
+                f"column {name!r} has {len(dl)} rows, expected {n_rows}")
+        hint_u = _unwrap_optional(hint)[0] if hint is not None else None
+        md = node.max_def_level
+        out = []
+        k = 0
+        for lvl in dl:
+            if md and lvl != md:
+                out.append(None)
+            else:
+                out.append(_decode_leaf(vals[k], node, hint_u))
+                k += 1
+        field_cols.append((f.name, out))
+    n_rows = n_rows or 0
+    return [
+        cls(**{attr: (col[i] if col is not None else None)
+               for attr, col in field_cols})
+        for i in range(n_rows)
+    ]
 
 
 def _get_member(obj, name: str):

@@ -54,6 +54,8 @@ class Writer:
         a ``marshal_parquet`` hook or nested schemas need the row path
         (``write``/``write_many``)."""
         objs = list(objs)
+        if not objs:
+            return  # match write_many([]): no empty row group
         for o in objs:
             if callable(getattr(o, "marshal_parquet", None)):
                 # the hook supplies custom rows that reflection would
