@@ -963,3 +963,64 @@ class TestPytreeRegistration:
         assert out.dtype == np.dtype(np.int64) and out.count == 10
         np.testing.assert_array_equal(np.asarray(out.flat),
                                       np.arange(20, dtype=np.uint32))
+
+
+class TestDeviceDeltaByteArray:
+    """DELTA_BYTE_ARRAY on the device path: front coding expands by
+    pointer doubling over the same token graph as the snappy kernel
+    (copy token = shared prefix, literal token = suffix)."""
+
+    def _roundtrip(self, vals, **wkw):
+        from tpuparquet.cpu.plain import ByteArrayColumn as BAC
+
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required binary s; }",
+                       column_encodings={"s": Encoding.DELTA_BYTE_ARRAY},
+                       allow_dict=False, **wkw)
+        w.write_columns({"s": BAC.from_list(vals)})
+        w.close()
+        buf.seek(0)
+        _parity_check(FileReader(buf))
+        return buf
+
+    def test_long_shared_prefixes(self):
+        # sorted keys with heavy front coding: the device path engages
+        vals = [f"warehouse/region-7/shelf-{i // 50:04d}/item-{i:07d}"
+                .encode() for i in range(2000)]
+        self._roundtrip(vals)
+
+    def test_chained_prefixes_rle_like(self):
+        # every value equals its predecessor: maximal copy chains
+        self._roundtrip([b"abcdefghij-shared-long-tail" for _ in range(800)])
+
+    def test_mixed_and_empty(self):
+        vals = [b"", b"a", b"ab", b"ab", b"", b"abcde", b"abcdx"] * 100
+        self._roundtrip(vals)
+
+    def test_short_values_take_host_path(self, monkeypatch):
+        """Below the expansion-pays threshold the host path serves the
+        page (parity still enforced) and the token kernel never runs."""
+        import tpuparquet.kernels.snappy as S
+
+        def boom(*a, **kw):  # pragma: no cover
+            raise AssertionError("token kernel engaged on non-expanding "
+                                 "data")
+
+        monkeypatch.setattr(S, "expand_tokens", boom)
+        self._roundtrip([b"x%d" % (i % 7) for i in range(500)])
+
+    def test_device_engaged_on_expanding_data(self, monkeypatch):
+        import tpuparquet.kernels.device as D
+
+        def boom(*a, **kw):  # pragma: no cover
+            raise AssertionError("CPU value fallback engaged")
+
+        monkeypatch.setattr(D, "decode_values_cpu", boom)
+        vals = [b"shared-prefix-shared-prefix-%04d" % (i % 10)
+                for i in range(1000)]
+        self._roundtrip(vals)
+
+    def test_snappy_compressed(self):
+        self._roundtrip(
+            [f"k/{i:06d}/suffix".encode() for i in range(1500)],
+            codec=CompressionCodec.SNAPPY)

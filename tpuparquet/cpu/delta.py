@@ -311,20 +311,20 @@ def encode_delta_length_byte_array(values) -> bytes:
 
 # -- DELTA_BYTE_ARRAY (front coding) ----------------------------------------
 
-def decode_delta_byte_array(data, count: int, pos: int = 0):
-    """Prefix lengths (delta-bp) + suffixes (delta-length); front-coded
-    reconstruction (``type_bytearray.go:189-240``)."""
-    prefix_lens, pos = decode_delta_binary_packed(data, np.int64, pos)
-    if prefix_lens.size != count:
-        raise ValueError("DELTA_BYTE_ARRAY: prefix count mismatch")
-    suffixes, pos = decode_delta_length_byte_array(data, count, pos)
-    suffix_lens = suffixes.lengths()
+def assemble_delta_byte_array(prefix_lens, suffix_offsets,
+                              suffix_data) -> ByteArrayColumn:
+    """Front-coded reconstruction from the parsed streams (validation
+    included); shared by the CPU decoder and the device planner's
+    non-expanding fallback so neither re-parses nor re-implements the
+    fill (``type_bytearray.go:189-240``)."""
+    count = len(prefix_lens)
+    suffix_lens = np.diff(suffix_offsets)
     total_lens = prefix_lens + suffix_lens
     offsets = np.zeros(count + 1, dtype=np.int64)
     np.cumsum(total_lens, out=offsets[1:])
     out = np.empty(int(offsets[-1]), dtype=np.uint8)
-    sdata = suffixes.data
-    soffs = suffixes.offsets
+    sdata = suffix_data
+    soffs = suffix_offsets
     prev_start = 0
     for i in range(count):
         start = int(offsets[i])
@@ -339,7 +339,18 @@ def decode_delta_byte_array(data, count: int, pos: int = 0):
             out[start : start + plen] = out[prev_start : prev_start + plen]
         out[start + plen : int(offsets[i + 1])] = sdata[soffs[i] : soffs[i + 1]]
         prev_start = start
-    return ByteArrayColumn(offsets, out), pos
+    return ByteArrayColumn(offsets, out)
+
+
+def decode_delta_byte_array(data, count: int, pos: int = 0):
+    """Prefix lengths (delta-bp) + suffixes (delta-length); front-coded
+    reconstruction (``type_bytearray.go:189-240``)."""
+    prefix_lens, pos = decode_delta_binary_packed(data, np.int64, pos)
+    if prefix_lens.size != count:
+        raise ValueError("DELTA_BYTE_ARRAY: prefix count mismatch")
+    suffixes, pos = decode_delta_length_byte_array(data, count, pos)
+    return assemble_delta_byte_array(
+        prefix_lens, suffixes.offsets, suffixes.data), pos
 
 
 def encode_delta_byte_array(values) -> bytes:

@@ -286,7 +286,9 @@ def objects_from_columns(columns, cls, schema, n_rows=None) -> list:
         # the row path's materialization (io/store.py): unsigned
         # re-views, FLBA/INT96 -> bytes, np scalars -> Python values
         vals = handler_for(node.element).to_pylist(cd.values)
-        dl = cd.def_levels
+        # one C-level conversion: iterating the np array would box an
+        # np.int32 per row in this bulk path
+        dl = cd.def_levels.tolist()
         if n_rows is None:
             n_rows = len(dl)
         elif n_rows != len(dl):

@@ -1060,6 +1060,92 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 lambda s, p, _dh=dh, _o=offs, _nb=dlba_bytes:
                 p["bytes"].append((_o, s[_dh], _nb))
             )
+        elif enc == Encoding.DELTA_BYTE_ARRAY and ptype == Type.BYTE_ARRAY:
+            # front coding IS the LZ copy-resolution problem the snappy
+            # kernel solves: each value = one copy token (its prefix,
+            # read from the previous value's output start) + one literal
+            # token (its suffix).  Ship compact prefixes+suffixes, expand
+            # on device by pointer doubling (kernels/snappy.py).
+            from ..cpu.delta import (
+                assemble_delta_byte_array,
+                decode_delta_binary_packed,
+                scan_delta_length_byte_array,
+            )
+
+            _def_standalone()
+            prefix_lens, ppos = decode_delta_binary_packed(
+                values_seg, np.int64)
+            if prefix_lens.size != non_null:
+                raise ValueError("DELTA_BYTE_ARRAY: prefix count mismatch")
+            soffs, spos = scan_delta_length_byte_array(
+                values_seg, non_null, ppos)
+            suffix_lens = np.diff(soffs)
+            if non_null:
+                if prefix_lens[0] != 0:
+                    raise ValueError(
+                        "DELTA_BYTE_ARRAY: first prefix must be 0")
+                if (prefix_lens < 0).any():
+                    raise ValueError("DELTA_BYTE_ARRAY: negative prefix")
+            total_lens = prefix_lens + suffix_lens
+            if non_null > 1 and (prefix_lens[1:]
+                                 > total_lens[:-1]).any():
+                raise ValueError(
+                    "DELTA_BYTE_ARRAY: prefix longer than previous value")
+            offs = np.zeros(non_null + 1, dtype=np.int64)
+            np.cumsum(total_lens, out=offs[1:])
+            expanded = int(offs[-1])
+            n_suffix = int(soffs[-1]) if non_null else 0
+            compact = n_suffix + 8 * non_null  # suffixes + token table
+            if (non_null == 0 or expanded > (1 << 30)
+                    or expanded <= compact):
+                # device expansion only pays when the front coding
+                # actually expands; otherwise (or where bucket(expanded)
+                # would pass int32, cf. plan_tokens) assemble on host
+                # from the ALREADY-parsed streams — no re-parse
+                suffix_view = np.frombuffer(values_seg, np.uint8,
+                                            n_suffix, spos)
+                col = assemble_delta_byte_array(prefix_lens, soffs,
+                                                suffix_view)
+                dh = stager.add(col.data)
+                ops.append(
+                    lambda s, p, _dh=dh,
+                    _o=col.offsets.astype(np.int64),
+                    _nb=int(col.data.size):
+                    p["bytes"].append((_o, s[_dh], _nb))
+                )
+            else:
+                from .decode import bucket as _bucket
+
+                out_cap = _bucket(expanded)
+                T = _bucket(2 * non_null)
+                te = np.full(T, out_cap, dtype=np.int32)
+                ts = np.full(T, -1, dtype=np.int32)
+                # copy token i: output [offs[i], offs[i]+p[i]) reads
+                # from the previous value's start; literal token i:
+                # the suffix bytes
+                te[0 : 2 * non_null : 2] = (offs[:-1]
+                                            + prefix_lens).astype(np.int32)
+                te[1 : 2 * non_null : 2] = offs[1:].astype(np.int32)
+                prev_start = np.zeros(non_null, dtype=np.int64)
+                prev_start[1:] = offs[:-2]
+                ts[0 : 2 * non_null : 2] = prev_start.astype(np.int32)
+                ts[1 : 2 * non_null : 2] = (-soffs[:-1] - 1).astype(
+                    np.int32)
+                lits = np.frombuffer(values_seg, np.uint8, n_suffix,
+                                     spos)
+                th = stager.add_many([te, ts], pad=False)
+                lh = stager.add(lits)
+                steps = max(int(np.ceil(np.log2(max(expanded, 2)))), 1)
+
+                def op(s, p, _th=th, _lh=lh, _cap=out_cap, _st=steps,
+                       _o=offs, _nb=expanded):
+                    from .snappy import expand_tokens
+
+                    out = expand_tokens(s[_th[0]], s[_th[1]], s[_lh],
+                                        _cap, _st)
+                    p["bytes"].append((_o, out, _nb))
+
+                ops.append(op)
         elif enc == Encoding.DELTA_BINARY_PACKED and ptype in (
                 Type.INT32, Type.INT64):
             _def_standalone()
