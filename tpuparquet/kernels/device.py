@@ -7,8 +7,7 @@ parses headers and builds plan tables.  Output is Arrow-layout
 ``to_numpy()`` materializes in exactly the CPU oracle's representation for
 bit-exact parity checks.
 
-Current device coverage (the rest falls back to the CPU oracle per value
-segment, still staged into the same DeviceColumn):
+Device coverage — every value encoding the format defines:
 
 * PLAIN int32/int64/float/double/int96/FLBA (reinterpret staging)
 * PLAIN boolean (width-1 unpack) and RLE boolean (run-table expand)
@@ -18,6 +17,10 @@ segment, still staged into the same DeviceColumn):
 * DELTA_BINARY_PACKED int32 and int64 (two-u32-lane arithmetic)
 * BYTE_STREAM_SPLIT int32/int64/float/double/FLBA (device transpose)
 * DELTA_LENGTH_BYTE_ARRAY (host length scan, zero-copy payload staging)
+* DELTA_BYTE_ARRAY (front coding = the snappy kernel's copy graph;
+  non-expanding pages assemble on host — the only remaining host path,
+  chosen per page because it ships FEWER bytes, not for lack of a
+  kernel)
 """
 
 from __future__ import annotations
