@@ -18,6 +18,12 @@ timeout 900 python tools/check_unpack_hw.py 200000 \
 echo "rc=$?"
 tail -1 "$OUT/unpack_hw.out"
 
+echo "=== every device decode branch, bit-exact on chip ==="
+timeout 900 python tools/check_device_paths.py \
+  > "$OUT/device_paths.out" 2>&1
+echo "rc=$?"
+tail -1 "$OUT/device_paths.out"
+
 echo "=== profile_decode scale sweep ==="
 for rows in 2000000 4000000 10000000; do
   timeout 900 python tools/profile_decode.py $rows 8 \
