@@ -459,3 +459,132 @@ class TestColumnarObjectWrite:
         from tpuparquet import FileReader
         with FileReader(str(p)) as fr:
             assert fr.row_group_count() == 1 and fr.num_rows == 5
+
+
+class TestColumnarListFields:
+    """Bulk columnar paths with list-of-primitive fields (round-3
+    verdict item 6): write_columns/read_columns round-trip dataclasses
+    with list[int]/list[str] fields, pinned equal to the row path."""
+
+    @dataclass
+    class WithLists:
+        ident: int
+        tags: Optional[list[str]] = None
+        nums: Optional[list[int]] = None
+
+    def _objs(self, n=60):
+        out = []
+        for i in range(n):
+            out.append(self.WithLists(
+                ident=i,
+                tags=(None if i % 7 == 0 else
+                      [None if j % 4 == 3 else f"t{i}_{j}"
+                       for j in range(i % 5)]),
+                nums=(None if i % 5 == 0 else
+                      list(range(i % 4))),
+            ))
+        return out
+
+    def test_write_columns_matches_row_path(self, tmp_path):
+        objs = self._objs()
+        pa_ = tmp_path / "rows.parquet"
+        pb_ = tmp_path / "cols.parquet"
+        with new_file_writer(str(pa_), cls=self.WithLists) as w:
+            w.write_many(objs)
+        with new_file_writer(str(pb_), cls=self.WithLists) as w:
+            w.write_columns(objs)
+        with new_file_reader(str(pa_), self.WithLists) as r:
+            want = list(r)
+        with new_file_reader(str(pb_), self.WithLists) as r:
+            got = list(r)
+        assert got == want
+
+    def test_read_columns_matches_iteration(self, tmp_path):
+        objs = self._objs(80)
+        p = tmp_path / "rc.parquet"
+        with new_file_writer(str(p), cls=self.WithLists) as w:
+            w.write_columns(objs)
+        with new_file_reader(str(p), self.WithLists) as r:
+            want = list(r)
+        with new_file_reader(str(p), self.WithLists) as r:
+            got = r.read_columns(0)
+        assert got == want
+
+    def test_round_trip_both_bulk(self, tmp_path):
+        objs = self._objs(50)
+        p = tmp_path / "bb.parquet"
+        with new_file_writer(str(p), cls=self.WithLists) as w:
+            w.write_columns(objs)
+        with new_file_reader(str(p), self.WithLists) as r:
+            got = r.read_columns(0)
+        # row-path None lists read back as None; empty stay empty
+        for o, g in zip(objs, got):
+            assert g.ident == o.ident
+            assert g.tags == o.tags
+            assert g.nums == o.nums
+
+    def test_bare_repeated_leaf(self, tmp_path):
+        @dataclass
+        class R:
+            vals: list[int]
+
+        objs = [R(vals=[1, 2, 3]), R(vals=[]), R(vals=[7])]
+        p = tmp_path / "rep.parquet"
+        with new_file_writer(
+                str(p), "message m { repeated int64 vals; }") as w:
+            w.write_columns(objs)
+        with new_file_reader(str(p), R) as r:
+            got = r.read_columns(0)
+        assert [g.vals for g in got] == [[1, 2, 3], [], [7]]
+
+    def test_required_list_none_rejected(self, tmp_path):
+        @dataclass
+        class R:
+            tags: list[str]
+
+        schema = ("message m { required group tags (LIST) "
+                  "{ repeated group list { required binary element "
+                  "(STRING); } } }")
+        p = tmp_path / "rq.parquet"
+        with new_file_writer(str(p), schema) as w:
+            with pytest.raises(ValueError, match="required"):
+                w.write_columns([R(tags=None)])
+            with pytest.raises(ValueError, match="required"):
+                w.write_columns([R(tags=["a", None])])
+            w.write_columns([R(tags=["a", "b"]), R(tags=[])])
+        with new_file_reader(str(p), R) as r:
+            got = r.read_columns(0)
+        assert [g.tags for g in got] == [["a", "b"], []]
+
+    def test_maps_still_rejected(self, tmp_path):
+        @dataclass
+        class M:
+            attrs: Optional[dict[str, int]] = None
+
+        p = tmp_path / "m.parquet"
+        with new_file_writer(str(p), cls=M) as w:
+            with pytest.raises(ValueError, match="flat schemas"):
+                w.write_columns([M(attrs={"a": 1})])
+            w.write_many([M(attrs={"a": 1})])
+
+    def test_element_hint_suppresses_decoding(self, tmp_path):
+        """list[Optional[bytes]] on a STRING column: the bytes hint
+        suppresses utf-8 decoding identically in read_columns and row
+        iteration (code-review regression)."""
+        @dataclass
+        class B:
+            tags: Optional[list[Optional[bytes]]] = None
+
+        objs = [B(tags=[b"ab", None, b"cd"]), B(tags=None)]
+        p = tmp_path / "bh.parquet"
+        with new_file_writer(
+                str(p),
+                "message m { optional group tags (LIST) { repeated "
+                "group list { optional binary element (STRING); } } }"
+        ) as w:
+            w.write_columns(objs)
+        with new_file_reader(str(p), B) as r:
+            want = list(r)
+        with new_file_reader(str(p), B) as r:
+            got = r.read_columns(0)
+        assert got == want == objs

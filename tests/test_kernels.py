@@ -270,6 +270,120 @@ class TestDeviceRegressions:
         got = [bytes(data[offs[i]:offs[i + 1]]) for i in range(len(vals))]
         assert got == vals
 
+    def test_all_empty_string_dict_device(self):
+        """Pinned regression: a BYTE_ARRAY dictionary of all-empty
+        strings has a zero-length blob; the device gather must decode
+        it like the CPU oracle does (round-3 verdict: dict_gather_bytes
+        crashed on gather over uint8[0])."""
+        import io as _io
+
+        from tpuparquet import FileWriter, FileReader
+        from tpuparquet.kernels.device import read_row_group_device
+
+        for n, schema in ((3, "message m { required binary s; }"),
+                          (3, "message m { optional binary s; }"),
+                          (40, "message m { required binary s; }")):
+            buf = _io.BytesIO()
+            w = FileWriter(buf, schema)
+            for _ in range(n):
+                w.add_data({"s": b""})
+            w.close()
+            buf.seek(0)
+            col = read_row_group_device(FileReader(buf), 0)["s"]
+            import numpy as _np
+            data = _np.asarray(col.data)
+            offs = _np.asarray(col.offsets)
+            _np.testing.assert_array_equal(offs, _np.zeros(n + 1))
+            got = [bytes(data[offs[i]:offs[i + 1]]) for i in range(n)]
+            assert got == [b""] * n
+
+    def test_zero_size_edge_sweep_device(self):
+        """Systematic zero-size edges across every device decode branch
+        (round-3 verdict item 1): all-null pages for each physical type
+        and encoding, all-empty byte-array payloads for each byte-array
+        encoding, and a single-row file.  Device output must match the
+        CPU oracle on each — the oracle paths (descended from
+        ``type_bytearray.go:24-55``) handle these without special cases."""
+        import io as _io
+
+        import numpy as _np
+
+        from tpuparquet import FileWriter, FileReader
+        from tpuparquet.cpu.plain import ByteArrayColumn
+        from tpuparquet.format.metadata import CompressionCodec, Encoding
+        from tpuparquet.kernels.device import read_row_group_device
+
+        def compare(buf):
+            buf.seek(0)
+            r = FileReader(buf)
+            cpu = r.read_row_group_arrays(0)
+            dev = read_row_group_device(r, 0)
+            for path, cd in cpu.items():
+                vals, rep, dl = dev[path].to_numpy()
+                _np.testing.assert_array_equal(dl, cd.def_levels,
+                                               err_msg=path)
+                _np.testing.assert_array_equal(rep, cd.rep_levels,
+                                               err_msg=path)
+                if isinstance(vals, ByteArrayColumn):
+                    assert vals == cd.values, path
+                else:
+                    _np.testing.assert_array_equal(
+                        vals, _np.asarray(cd.values), err_msg=path)
+
+        schema = ("message m { optional int64 a; optional int32 b; "
+                  "optional binary s (STRING); optional double x; "
+                  "optional float g; optional boolean f; "
+                  "optional fixed_len_byte_array(4) k; }")
+        enc_sets = [
+            {},
+            {"a": Encoding.DELTA_BINARY_PACKED,
+             "b": Encoding.DELTA_BINARY_PACKED,
+             "x": Encoding.BYTE_STREAM_SPLIT,
+             "g": Encoding.BYTE_STREAM_SPLIT,
+             "f": Encoding.RLE,
+             "s": Encoding.DELTA_LENGTH_BYTE_ARRAY},
+            {"s": Encoding.DELTA_BYTE_ARRAY},
+        ]
+        for codec in (CompressionCodec.UNCOMPRESSED,
+                      CompressionCodec.SNAPPY):
+            for v2 in (False, True):
+                for allow_dict in (False, True):
+                    for encs in enc_sets:
+                        # every column all-null (zero packed values)
+                        buf = _io.BytesIO()
+                        w = FileWriter(buf, schema, codec=codec,
+                                       data_page_v2=v2,
+                                       allow_dict=allow_dict,
+                                       column_encodings=encs)
+                        for _ in range(5):
+                            w.add_data({})
+                        w.close()
+                        compare(buf)
+                        # one non-null row among nulls, empty string
+                        buf = _io.BytesIO()
+                        w = FileWriter(buf, schema, codec=codec,
+                                       data_page_v2=v2,
+                                       allow_dict=allow_dict,
+                                       column_encodings=encs)
+                        w.add_data({})
+                        w.add_data({"a": 0, "b": 0, "s": b"", "x": 0.0,
+                                    "g": 0.0, "f": False, "k": b"\0" * 4})
+                        w.add_data({})
+                        w.close()
+                        compare(buf)
+                        # all rows present, all strings empty
+                        buf = _io.BytesIO()
+                        w = FileWriter(buf, schema, codec=codec,
+                                       data_page_v2=v2,
+                                       allow_dict=allow_dict,
+                                       column_encodings=encs)
+                        for i in range(7):
+                            w.add_data({"a": i, "b": i, "s": b"",
+                                        "x": 0.5, "g": 0.5, "f": True,
+                                        "k": b"abcd"})
+                        w.close()
+                        compare(buf)
+
     def test_required_dict_fixed_device(self):
         """Required dict-encoded fixed-width column, device path."""
         import io as _io

@@ -21,6 +21,23 @@ from .format import (  # noqa: F401
     PageType,
     Type,
 )
+from .format.builder import (  # noqa: F401
+    logical_bson,
+    logical_date,
+    logical_decimal,
+    logical_enum,
+    logical_int,
+    logical_json,
+    logical_string,
+    logical_time,
+    logical_timestamp,
+    logical_uuid,
+    new_data_column,
+    new_group,
+    new_list_column,
+    new_map_column,
+    new_root,
+)
 from .format.dsl import SchemaDefinition, parse_schema_definition  # noqa: F401
 from .format.schema import Schema  # noqa: F401
 from .io import FileReader, FileWriter  # noqa: F401

@@ -196,22 +196,54 @@ def to_row(obj, schema) -> dict:
     }
 
 
+def _bulk_list_leaf(schema, leaf) -> "SchemaNode | None":
+    """If ``leaf`` sits under a top-level column the bulk columnar paths
+    can handle as a Python list — a bare repeated leaf, a 2-level legacy
+    list, or the canonical 3-level LIST of a primitive/string — return
+    the top-level node; None for shapes the row path must handle
+    (multi-leaf groups, maps, deeper nesting)."""
+    if leaf.max_rep_level != 1:
+        return None
+    top = _child_named(schema.root, leaf.path[0])
+    if top is None:
+        return None
+    if top is leaf:  # bare repeated leaf
+        return top
+    if _is_list_group(top):
+        mid = top.children[0]
+        if mid is leaf:  # 2-level legacy: repeated leaf is the element
+            return top
+        if len(mid.children) == 1 and mid.children[0] is leaf:
+            return top  # canonical 3-level
+    return None
+
+
 def objects_to_columns(objs, schema):
-    """Bulk columnar extraction for FLAT schemas: dataclasses/mappings
-    -> ``(columns, masks)`` for ``FileWriter.write_columns``.
+    """Bulk columnar extraction: dataclasses/mappings ->
+    ``(columns, masks, offsets, element_masks)`` for
+    ``FileWriter.write_columns``.
 
     Skips the per-row dict building + shredding machinery while
     applying the SAME leaf conversions as :func:`to_row`
     (strings, date/time/timestamp units, UUID) — decoded contents are
     identical to the row path; the columnar call writes one row group.
-    Nested schemas (groups, LIST/MAP, repeated leaves) raise — use
+    Flat leaves and LIST-of-primitive columns (bare repeated leaves,
+    2-level legacy, canonical 3-level — the shapes the reference's
+    reflection shreds at ``floor/writer.go:241-294``) are supported;
+    other nesting (structs, maps, multi-leaf groups) raises — use
     ``Writer.write``/``write_many`` for those."""
     leaves = schema.leaves
+    list_tops = {}
     for leaf in leaves:
-        if len(leaf.path) != 1 or leaf.max_rep_level:
+        if len(leaf.path) == 1 and not leaf.max_rep_level:
+            continue
+        top = _bulk_list_leaf(schema, leaf)
+        if top is None:
             raise ValueError(
-                f"objects_to_columns supports flat schemas only; "
-                f"{leaf.flat_name!r} is nested (use write/write_many)")
+                f"objects_to_columns supports flat schemas and "
+                f"LIST-of-primitive columns only; {leaf.flat_name!r} "
+                f"is nested (use write/write_many)")
+        list_tops[leaf] = top
     objs = list(objs)
     # per-class parquet-name -> attribute map, computed once (the row
     # path's per-access field scan would cost O(fields) per value here)
@@ -232,9 +264,54 @@ def objects_to_columns(objs, schema):
         attr = m.get(name)
         return getattr(o, attr) if attr is not None else None
 
+    import numpy as _np
+
     columns: dict = {}
     masks: dict = {}
+    offsets: dict = {}
+    element_masks: dict = {}
     for leaf in leaves:
+        top = list_tops.get(leaf)
+        if top is not None:
+            name = top.name
+            elem_optional = not leaf.is_required and not leaf.is_repeated
+            vals = []
+            offs = _np.zeros(len(objs) + 1, dtype=_np.int64)
+            mask = None
+            emask = []
+            for i, o in enumerate(objs):
+                v = getter(o, name)
+                if v is None:
+                    # a bare repeated leaf has no null state — an absent
+                    # value is an empty list, matching the row path
+                    if top is not leaf and not top.is_required:
+                        if mask is None:
+                            mask = _np.ones(len(objs), dtype=bool)
+                        mask[i] = False
+                    elif top is not leaf:
+                        raise ValueError(
+                            f"column {name!r} is required but object "
+                            f"{i} has no value")
+                    offs[i + 1] = offs[i]
+                    continue
+                offs[i + 1] = offs[i] + len(v)
+                for e in v:
+                    if e is None:
+                        if not elem_optional:
+                            raise ValueError(
+                                f"column {name!r} element is required "
+                                f"but object {i} contains None")
+                        emask.append(False)
+                    else:
+                        emask.append(True)
+                        vals.append(_encode_leaf(e, leaf))
+            columns[name] = vals
+            offsets[name] = offs
+            if mask is not None:
+                masks[name] = mask
+            if not all(emask):
+                element_masks[name] = _np.asarray(emask, dtype=bool)
+            continue
         name = leaf.name
         vals = []
         mask = None
@@ -246,8 +323,6 @@ def objects_to_columns(objs, schema):
                         f"column {name!r} is required but object {i} "
                         "has no value")
                 if mask is None:
-                    import numpy as _np
-
                     mask = _np.ones(len(objs), dtype=bool)
                 mask[i] = False
             else:
@@ -255,7 +330,7 @@ def objects_to_columns(objs, schema):
         columns[name] = vals
         if mask is not None:
             masks[name] = mask
-    return columns, masks
+    return columns, masks, offsets, element_masks
 
 
 def objects_from_columns(columns, cls, schema, n_rows=None) -> list:
@@ -270,15 +345,41 @@ def objects_from_columns(columns, cls, schema, n_rows=None) -> list:
 
     if not dataclasses.is_dataclass(cls):
         raise TypeError(f"{cls!r} is not a dataclass")
+    list_leaves = {}
     for leaf in schema.leaves:
-        if len(leaf.path) != 1 or leaf.max_rep_level:
+        if len(leaf.path) == 1 and not leaf.max_rep_level:
+            continue
+        top = _bulk_list_leaf(schema, leaf)
+        if top is None:
             raise ValueError(
-                f"objects_from_columns supports flat schemas only; "
-                f"{leaf.flat_name!r} is nested (use iteration/scan)")
+                f"objects_from_columns supports flat schemas and "
+                f"LIST-of-primitive columns only; {leaf.flat_name!r} "
+                f"is nested (use iteration/scan)")
+        list_leaves[top.name] = leaf
     field_cols: list = []
     for f, hint in _dc_fields(cls):
         name = field_name(f)
         node = _child_named(schema.root, name)
+        if node is not None and name in list_leaves:
+            leaf = list_leaves[name]
+            cd = columns.get(leaf.flat_name)
+            if cd is None:
+                field_cols.append((f.name, None))
+                continue
+            hint_u = _unwrap_optional(hint)[0] if hint is not None else None
+            ehint = (typing.get_args(hint_u)[0]
+                     if hint_u and typing.get_args(hint_u) else None)
+            # list[Optional[T]]: the row path decodes against T
+            ehint = _unwrap_optional(ehint)[0] if ehint is not None else None
+            out = _lists_from_chunk(cd, node, leaf, ehint)
+            if n_rows is None:
+                n_rows = len(out)
+            elif n_rows != len(out):
+                raise ValueError(
+                    f"column {name!r} has {len(out)} rows, "
+                    f"expected {n_rows}")
+            field_cols.append((f.name, out))
+            continue
         if node is None or name not in columns:
             field_cols.append((f.name, None))
             continue
@@ -311,6 +412,44 @@ def objects_from_columns(columns, cls, schema, n_rows=None) -> list:
                for attr, col in field_cols})
         for i in range(n_rows)
     ]
+
+
+def _lists_from_chunk(cd, top: SchemaNode, leaf: SchemaNode, ehint):
+    """Reconstruct per-row Python lists from one repeated leaf's
+    ChunkData — the bulk inverse of the single-level list shredding
+    (Dremel with one repeated level: ``rep==0`` starts a row; ``def``
+    distinguishes null row / empty list / null element / element)."""
+    from ..io.values import handler_for
+
+    vals = handler_for(leaf.element).to_pylist(cd.values)
+    rep = cd.rep_levels.tolist()
+    dl = cd.def_levels.tolist()
+    # the repeated node on the path (the leaf itself for bare/2-level)
+    mid = top if top is leaf else top.children[0]
+    def_m = mid.max_def_level      # slot holds an element at def >= this
+    def_l = leaf.max_def_level     # ... a non-null element at exactly this
+    row_nullable = top is not leaf and not top.is_required
+    def_t = top.max_def_level      # row defined (possibly empty) at >= this
+    out = []
+    _no_row = object()
+    row = _no_row
+    k = 0
+    for r, d in zip(rep, dl):
+        if r == 0:
+            if row is not _no_row:
+                out.append(row)
+            row = []
+        if d >= def_m:
+            if d == def_l:
+                row.append(_decode_leaf(vals[k], leaf, ehint))
+                k += 1
+            else:
+                row.append(None)
+        elif row_nullable and d < def_t:
+            row = None
+    if row is not _no_row:
+        out.append(row)
+    return out
 
 
 def _get_member(obj, name: str):

@@ -48,11 +48,12 @@ class Writer:
             self.write(o)
 
     def write_columns(self, objs, **flush_kw) -> None:
-        """Bulk columnar write of objects with a FLAT schema: one row
-        group per call, same decoded contents as :meth:`write_many`
-        but without per-row dict building and shredding.  Objects with
-        a ``marshal_parquet`` hook or nested schemas need the row path
-        (``write``/``write_many``)."""
+        """Bulk columnar write of objects: one row group per call, same
+        decoded contents as :meth:`write_many` but without per-row dict
+        building and shredding.  Flat fields and list-of-primitive
+        fields (``list[int]``, ``list[str]``, ...) are supported;
+        objects with a ``marshal_parquet`` hook or deeper nesting
+        (structs, maps) need the row path (``write``/``write_many``)."""
         objs = list(objs)
         if not objs:
             return  # match write_many([]): no empty row group
@@ -64,8 +65,11 @@ class Writer:
                     f"{type(o).__name__} defines marshal_parquet; the "
                     "columnar path reflects raw attributes — use "
                     "write/write_many")
-        cols, masks = objects_to_columns(objs, self._fw.schema)
-        self._fw.write_columns(cols, masks=masks or None, **flush_kw)
+        cols, masks, offs, emasks = objects_to_columns(
+            objs, self._fw.schema)
+        self._fw.write_columns(
+            cols, masks=masks or None, offsets=offs or None,
+            element_masks=emasks or None, **flush_kw)
 
     def flush_row_group(self, **kw) -> None:
         self._fw.flush_row_group(**kw)

@@ -457,6 +457,7 @@ class _Parser:
             lt.DECIMAL = DecimalType(scale=scale, precision=precision)
             se.scale = scale
             se.precision = precision
+            ct = ConvertedType.DECIMAL
         else:
             # Bare converted-type annotation (UTF8, LIST, TIME_MILLIS, ...)
             try:

@@ -279,7 +279,14 @@ def dict_gather_bytes(dict_offsets: jax.Array, dict_data: jax.Array,
     For every output byte position, locate its value via searchsorted over
     the output offsets, then its source byte in the dictionary blob —
     the device analogue of the reference's per-value dict gather
-    (``type_dict.go:39-59``), vectorized at byte granularity."""
+    (``type_dict.go:39-59``), vectorized at byte granularity.
+
+    A dictionary of all-empty strings has a zero-length blob (legal:
+    ``type_bytearray.go:24-55`` decodes it with no special case); every
+    gathered value is empty, so the output is pure padding — a gather
+    over ``uint8[0]`` would be out of range, so short-circuit it."""
+    if dict_data.shape[0] == 0:
+        return jnp.zeros((total_bytes,), dtype=dict_data.dtype)
     b = jnp.arange(total_bytes, dtype=jnp.int32)
     val = jnp.searchsorted(out_offsets[1:], b, side="right").astype(jnp.int32)
     val = jnp.minimum(val, indices.shape[0] - 1)

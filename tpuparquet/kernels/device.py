@@ -267,11 +267,11 @@ class DeviceColumn:
                   else int(np.asarray(self.mask[:n]).sum()))
             if self.offsets is not None:
                 offs = np.asarray(self.offsets[: nn + 1], dtype=np.int64)
-                data = np.asarray(self._data_p[: int(offs[-1])],
+                data = np.asarray(self.data[: int(offs[-1])],
                                   dtype=np.uint8)
                 return ByteArrayColumn(offs, data), rep, dl
             lanes = self.lanes
-            flat = np.asarray(self._data_p[: nn * lanes],
+            flat = np.asarray(self.data[: nn * lanes],
                               dtype=np.uint32)
             return self._flat_to_typed(flat, lanes), rep, dl
         rep = (np.zeros(n, dtype=np.int32) if self._rep_p is None
@@ -280,11 +280,10 @@ class DeviceColumn:
               else np.asarray(self._def_p, dtype=np.int32)[:n])
         if self.offsets is not None:
             offs = np.asarray(self.offsets, dtype=np.int64)
-            data = np.asarray(self._data_p, dtype=np.uint8)[: int(offs[-1])]
+            data = np.asarray(self.data, dtype=np.uint8)[: int(offs[-1])]
             return ByteArrayColumn(offs, data), rep, dl
         lanes = self.lanes
-        flat = np.asarray(self._data_p, dtype=np.uint32)[
-            : self.n_packed * lanes]
+        flat = np.asarray(self.data, dtype=np.uint32)
         return self._flat_to_typed(flat, lanes), rep, dl
 
     def as_values(self):
@@ -375,6 +374,8 @@ def _flba_lanes(type_length: int) -> int:
 def _stage_byte_rows_np(arr: np.ndarray) -> np.ndarray:
     """(N, L) u8 rows -> flat (N*lanes,) u32, zero-padding each row to
     whole little-endian u32 lanes (shared FLBA/int96 staging)."""
+    if arr.shape[0] == 0:  # all-null page: zero rows, width still known
+        return np.zeros((0,), dtype=np.uint32)
     rows = arr.view(np.uint8).reshape(arr.shape[0], -1)
     lanes = _flba_lanes(rows.shape[1])
     padded = np.zeros((rows.shape[0], lanes * 4), dtype=np.uint8)
