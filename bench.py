@@ -372,6 +372,22 @@ def _progress(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def time_pyarrow(buf: io.BytesIO) -> float:
+    """Decode the same file with pyarrow.parquet — the external anchor
+    the ratio can be checked against (the role the Java harness plays
+    for correctness in the reference, ``compatibility/compare.go:35``).
+    Single-threaded: values/sec/chip is a per-core metric here."""
+    import pyarrow.parquet as pq
+
+    best = float("inf")
+    for _ in range(CPU_REPS):
+        buf.seek(0)
+        t0 = time.perf_counter()
+        pq.read_table(buf, use_threads=False)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
 def run_config(name: str, buf: io.BytesIO) -> dict:
     from tpuparquet import FileReader
 
@@ -381,7 +397,9 @@ def run_config(name: str, buf: io.BytesIO) -> dict:
               f"{n_values/1e6:.1f}M values); timing cpu oracle")
     _cpu_pass(reader)  # warm page cache / allocator (one pass suffices)
     cpu_s = time_cpu(reader)
-    _progress(f"[{name}] cpu {cpu_s:.2f}s; timing device path")
+    pa_s = time_pyarrow(buf)
+    _progress(f"[{name}] cpu {cpu_s:.2f}s pyarrow {pa_s:.2f}s; "
+              "timing device path")
     time_device(reader)  # compile warmup
     dev_s = time_device(reader)
     _progress(f"[{name}] device {dev_s:.2f}s; parity check")
@@ -393,8 +411,10 @@ def run_config(name: str, buf: io.BytesIO) -> dict:
         "config": name,
         "n_values": n_values,
         "cpu_vps": round(n_values / cpu_s, 1),
+        "pyarrow_vps": round(n_values / pa_s, 1),
         "device_vps": round(n_values / dev_s, 1),
         "vs_baseline": round(cpu_s / dev_s, 3),
+        "vs_pyarrow": round(pa_s / dev_s, 3),
     }
 
 
@@ -417,6 +437,7 @@ def run_config5() -> dict:
             for rg in range(r.row_group_count()):
                 r.read_row_group_arrays(rg)
         cpu_best = min(cpu_best, time.perf_counter() - t0)
+    pa_best = sum(time_pyarrow(b) for b in bufs)
 
     mesh = make_mesh()
     for b in bufs:
@@ -462,8 +483,10 @@ def run_config5() -> dict:
         "config": "5-multifile-sharded-scan",
         "n_values": n_values,
         "cpu_vps": round(n_values / cpu_best, 1),
+        "pyarrow_vps": round(n_values / pa_best, 1),
         "device_vps": round(n_values / dev_best, 1),
         "vs_baseline": round(cpu_best / dev_best, 3),
+        "vs_pyarrow": round(pa_best / dev_best, 3),
     }
 
 
@@ -535,10 +558,14 @@ def main() -> None:
         "value": head["device_vps"],
         "unit": "values/sec",
         "vs_baseline": head["vs_baseline"],
+        "pyarrow_values_per_sec": head["pyarrow_vps"],
+        "vs_pyarrow": head["vs_pyarrow"],
         "configs": {k: {"n_values": v["n_values"],
                         "cpu_vps": v["cpu_vps"],
+                        "pyarrow_vps": v["pyarrow_vps"],
                         "device_vps": v["device_vps"],
-                        "vs_baseline": v["vs_baseline"]}
+                        "vs_baseline": v["vs_baseline"],
+                        "vs_pyarrow": v["vs_pyarrow"]}
                     for k, v in results.items()},
     }))
 

@@ -1138,3 +1138,109 @@ class TestDeviceDeltaByteArray:
         self._roundtrip(
             [f"k/{i:06d}/suffix".encode() for i in range(1500)],
             codec=CompressionCodec.SNAPPY)
+
+
+class TestDeviceWireTransports:
+    """Wire-size-gated device transports (round-3 verdict item 3): the
+    byte-plane RLE transport for PLAIN fixed-width segments and the
+    token-size gate on the device snappy path.  bytes_staged is the
+    observable: compressed-wire shipping means bytes_staged <
+    bytes_uncompressed."""
+
+    def _decode_both(self, schema, codec, cols, masks=None, **kw):
+        import io as _io
+
+        import numpy as _np
+
+        from tpuparquet import FileReader, FileWriter
+        from tpuparquet.kernels.device import read_row_group_device
+        from tpuparquet.stats import collect_stats
+
+        buf = _io.BytesIO()
+        w = FileWriter(buf, schema, codec=codec, allow_dict=False, **kw)
+        w.write_columns(cols, masks=masks)
+        w.close()
+        buf.seek(0)
+        r = FileReader(buf)
+        cpu = r.read_row_group_arrays(0)
+        with collect_stats() as st:
+            dev = read_row_group_device(r, 0)
+            for k, cd in cpu.items():
+                got, rep, dl = dev[k].to_numpy()
+                _np.testing.assert_array_equal(
+                    got, _np.asarray(cd.values), err_msg=k)
+                _np.testing.assert_array_equal(dl, cd.def_levels,
+                                               err_msg=k)
+        return st.as_dict()
+
+    def _ts(self, n=120_000, seed=7):
+        import numpy as _np
+
+        rng = _np.random.default_rng(seed)
+        return (1_700_000_000_000
+                + rng.integers(0, 3_600_000, size=n).cumsum())
+
+    def test_planes_engage_timestamps_uncompressed(self):
+        from tpuparquet.format.metadata import CompressionCodec
+
+        d = self._decode_both("message m { required int64 v; }",
+                              CompressionCodec.UNCOMPRESSED,
+                              {"v": self._ts()})
+        assert d["pages_device_planes"] > 0
+        assert d["bytes_staged"] < 0.75 * d["bytes_uncompressed"]
+
+    def test_planes_engage_v1_optional_snappy(self):
+        """V1 page with level bytes inside the compressed block: the
+        levels scan on host no longer forces raw value bytes onto the
+        wire."""
+        import numpy as _np
+
+        from tpuparquet.format.metadata import CompressionCodec
+
+        vals = self._ts()
+        rng = _np.random.default_rng(8)
+        mask = rng.random(len(vals)) >= 0.05
+        d = self._decode_both("message m { optional int64 v; }",
+                              CompressionCodec.SNAPPY,
+                              {"v": vals[mask]}, {"v": mask})
+        assert d["pages_device_planes"] + d["pages_device_snappy"] > 0
+        assert d["bytes_staged"] < 0.8 * d["bytes_uncompressed"]
+
+    def test_planes_parity_int32_and_double(self):
+        import numpy as _np
+
+        from tpuparquet.format.metadata import CompressionCodec
+
+        rng = _np.random.default_rng(9)
+        n = 100_000
+        d = self._decode_both(
+            "message m { required int32 a; required double x; }",
+            CompressionCodec.SNAPPY,
+            {"a": rng.integers(0, 1000, n, dtype=_np.int32),
+             "x": rng.random(n) * 100})
+        # both columns decode bit-exactly whatever transport won
+        assert d["pages"] >= 2
+
+    def test_full_entropy_stays_raw(self):
+        """Uniform uint64 bytes: every plane is random — the transport
+        must NOT engage (the gate requires a real win)."""
+        import numpy as _np
+
+        from tpuparquet.format.metadata import CompressionCodec
+
+        rng = _np.random.default_rng(10)
+        vals = rng.integers(-(2**62), 2**62, size=100_000)
+        d = self._decode_both("message m { required int64 v; }",
+                              CompressionCodec.UNCOMPRESSED, {"v": vals})
+        assert d["pages_device_planes"] == 0
+
+    def test_token_gate_rejects_short_match_tables(self):
+        """Numeric snappy blocks under min_match=4 produce token tables
+        bigger than the raw bytes; the gate must route them to planes
+        or raw, never ship a larger wire than the data."""
+        from tpuparquet.format.metadata import CompressionCodec
+
+        d = self._decode_both("message m { required int64 v; }",
+                              CompressionCodec.SNAPPY, {"v": self._ts()})
+        assert d["bytes_staged"] <= 1.05 * d["bytes_uncompressed"]
+        assert d["bytes_staged"] < 0.75 * d["bytes_uncompressed"]

@@ -439,3 +439,69 @@ class TestMultiHostCursor:
         import pytest as _pytest
         with _pytest.raises(ValueError, match="process_count"):
             MultiHostScan([str(p)], resume=bad)
+
+
+class TestScanAtScale:
+    """Sharded scan at realistic row-group sizes (round-3 verdict item
+    5): parity and a sharding-overhead bound, not just the tiny-shape
+    dryrun.  The routine suite runs TPQ_SCAN_VALUES_PER_UNIT=1M on the
+    8-device CPU mesh; tools/scan_at_scale.py runs the full 10M/device
+    config and records throughput/memory to SCAN_SCALE_r{N}.json."""
+
+    def test_scan_parity_and_overhead(self):
+        import os
+        import time
+
+        import numpy as np
+
+        from tpuparquet import CompressionCodec, FileReader, FileWriter
+        from tpuparquet.kernels.device import read_row_group_device
+        from tpuparquet.shard.mesh import make_mesh
+        from tpuparquet.shard.scan import ShardedScan
+
+        nv = int(os.environ.get("TPQ_SCAN_VALUES_PER_UNIT", 1_000_000))
+        n_units = 8
+        rng = np.random.default_rng(5)
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required int64 v; }",
+                       codec=CompressionCodec.SNAPPY)
+        base = 1_700_000_000_000
+        sums = []
+        for g in range(n_units):
+            vals = base + rng.integers(0, 3_600_000, size=nv).cumsum()
+            sums.append(int(vals.astype(np.uint64).sum(dtype=np.uint64)))
+            w.write_columns({"v": vals})
+        w.close()
+
+        # serial per-unit device decode: the no-sharding baseline
+        buf.seek(0)
+        r = FileReader(buf)
+        t0 = time.time()
+        for g in range(n_units):
+            out = read_row_group_device(r, g)
+            out["v"].block_until_ready()
+        serial_s = time.time() - t0
+
+        buf.seek(0)
+        mesh = make_mesh(n_units)
+        t1 = time.time()
+        with ShardedScan([buf], mesh=mesh) as scan:
+            results = scan.run()
+            for res in results:
+                for c in res.values():
+                    c.block_until_ready()
+        scan_s = time.time() - t1
+
+        # parity: whole-unit uint64 checksums against the written data
+        for u, res in enumerate(results):
+            flat = np.asarray(res["v"].data, dtype=np.uint32)
+            v64 = flat.view(np.uint8).view("<u8")
+            assert int(v64.sum(dtype=np.uint64)) == sums[u], u
+            assert res["v"].num_values == nv
+
+        # the sharding machinery may not cost more than 2x the serial
+        # per-unit decode on the same backend (generous: CI is 1-core)
+        assert scan_s < 2.0 * serial_s + 5.0, (scan_s, serial_s)
+        print(f"scan {n_units}x{nv}: serial {serial_s:.1f}s "
+              f"scan {scan_s:.1f}s "
+              f"({n_units * nv / scan_s / 1e6:.1f} M v/s)")

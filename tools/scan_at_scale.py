@@ -1,0 +1,77 @@
+"""At-scale virtual-mesh scan record (round-4 verdict item 5).
+
+Runs the TestScanAtScale scenario at 10M values/device on the 8-device
+CPU mesh and records throughput + peak RSS to SCAN_SCALE_r{N}.json.
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/scan_at_scale.py [out.json]
+"""
+
+import io
+import json
+import os
+import resource
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import numpy as np
+
+    from tpuparquet import CompressionCodec, FileWriter
+    from tpuparquet.shard.mesh import make_mesh
+    from tpuparquet.shard.scan import ShardedScan
+
+    nv = int(os.environ.get("TPQ_SCAN_VALUES_PER_UNIT", 10_000_000))
+    n_units = 8
+    rng = np.random.default_rng(5)
+    buf = io.BytesIO()
+    w = FileWriter(buf, "message m { required int64 v; }",
+                   codec=CompressionCodec.SNAPPY)
+    base = 1_700_000_000_000
+    sums = []
+    t0 = time.time()
+    for _ in range(n_units):
+        vals = base + rng.integers(0, 3_600_000, size=nv).cumsum()
+        sums.append(int(vals.astype(np.uint64).sum(dtype=np.uint64)))
+        w.write_columns({"v": vals})
+    w.close()
+    write_s = time.time() - t0
+
+    buf.seek(0)
+    mesh = make_mesh(n_units)
+    t1 = time.time()
+    with ShardedScan([buf], mesh=mesh) as scan:
+        results = scan.run()
+        for res in results:
+            for c in res.values():
+                c.block_until_ready()
+    scan_s = time.time() - t1
+    for u, res in enumerate(results):
+        flat = np.asarray(res["v"].data, dtype=np.uint32)
+        v64 = flat.view(np.uint8).view("<u8")
+        assert int(v64.sum(dtype=np.uint64)) == sums[u], f"unit {u} parity"
+    rec = {
+        "n_units": n_units,
+        "values_per_unit": nv,
+        "total_values": n_units * nv,
+        "file_mb": round(len(buf.getvalue()) / 1e6, 1),
+        "write_s": round(write_s, 2),
+        "scan_s": round(scan_s, 2),
+        "values_per_sec": round(n_units * nv / scan_s, 0),
+        "peak_rss_mb": round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024, 1),
+        "parity": "ok",
+        "backend": "cpu-virtual-8",
+    }
+    out = sys.argv[1] if len(sys.argv) > 1 else "SCAN_SCALE.json"
+    with open(out, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

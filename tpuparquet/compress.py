@@ -422,12 +422,16 @@ class _Snappy(BlockCompressor):
     interchangeable either way.
 
     ``min_match`` sets the shortest back-reference the encoder emits.
-    The default (8) favors decode throughput on numeric column data;
-    register ``_Snappy(min_match=4)`` via ``register_block_compressor``
-    for text/byte-array-heavy files whose redundancy is mostly 4..7-byte
-    matches."""
+    The default (4) matches the format's reference encoders (the Go
+    implementation the reference vendors emits 4-byte matches): numeric
+    column data's redundancy lives almost entirely in 4..7-byte matches
+    at lag ``sizeof(value)`` — timestamp-like int64 streams measure
+    1.00 at ``min_match=8`` vs 0.76 at 4 — and smaller blocks are what
+    the device decompressor turns into less wire time.  Register
+    ``_Snappy(min_match=8)`` via ``register_block_compressor`` to trade
+    ratio back for encode throughput."""
 
-    def __init__(self, min_match: int = 8):
+    def __init__(self, min_match: int = 4):
         self._native = False  # not resolved yet
         self.min_match = min_match
 

@@ -67,6 +67,17 @@ def u8_to_u32_words(b: jax.Array, n_words: int):
     return w[:, 0] | (w[:, 1] << 8) | (w[:, 2] << 16) | (w[:, 3] << 24)
 
 
+@functools.partial(jax.jit, static_argnames=("n_words",))
+def u8_to_u32_words_at(b: jax.Array, off, n_words: int):
+    """Like :func:`u8_to_u32_words` but reading from byte offset ``off``
+    (a traced scalar, so one compiled kernel serves every page of a
+    chunk regardless of how many level bytes precede its values
+    segment)."""
+    w = jax.lax.dynamic_slice(b, (off,), (n_words * 4,))
+    w = w.astype(jnp.uint32).reshape(-1, 4)
+    return w[:, 0] | (w[:, 1] << 8) | (w[:, 2] << 16) | (w[:, 3] << 24)
+
+
 @functools.partial(jax.jit, static_argnames=("count", "k", "lanes"))
 def bss_to_lanes(raw: jax.Array, count: int, k: int, lanes: int):
     """BYTE_STREAM_SPLIT decode on device: ``k`` byte streams of
@@ -82,6 +93,45 @@ def bss_to_lanes(raw: jax.Array, count: int, k: int, lanes: int):
     words = (b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16)
              | (b[..., 3] << 24))
     return words.reshape(-1)
+
+
+@functools.partial(jax.jit, static_argnames=("spec", "count", "lanes"))
+def planes_to_words(raw_block: jax.Array, rle_ends: jax.Array,
+                    rle_vals: jax.Array, spec: tuple, count: int,
+                    lanes: int):
+    """Byte-plane wire transport -> flat u32 lane words.
+
+    The host ships each of the value's ``lanes*4`` byte planes either
+    raw (``u8[count]`` slabs concatenated in ``raw_block``) or
+    run-length coded (run ends/values concatenated in ``rle_ends`` /
+    ``rle_vals``); ``spec`` holds one static entry per plane:
+    ``("raw", slab_index)`` or ``("rle", start, n_runs)``.  Numeric
+    column data (timestamps, counters, monotone ids) is nearly constant
+    in its upper byte planes, so those planes ship as a handful of runs
+    while only the genuinely random low planes pay full wire — the
+    transport the transfer-bound remote-TPU link needs, with a
+    reconstruction (searchsorted expand + shift-combine) that is pure
+    parallel device work."""
+    planes = []
+    for entry in spec:
+        if entry[0] == "raw":
+            j = entry[1]
+            planes.append(
+                jax.lax.dynamic_slice(raw_block, (j * count,), (count,)))
+        else:
+            start, n_runs = entry[1], entry[2]
+            ends = jax.lax.dynamic_slice(rle_ends, (start,), (n_runs,))
+            i = jnp.arange(count, dtype=jnp.int32)
+            idx = jnp.searchsorted(ends, i, side="right").astype(jnp.int32)
+            idx = jnp.minimum(idx, n_runs - 1)
+            planes.append(rle_vals[start + idx])
+    words = []
+    for lane in range(lanes):
+        b = [planes[4 * lane + t].astype(jnp.uint32) for t in range(4)]
+        words.append(b[0] | (b[1] << 8) | (b[2] << 16) | (b[3] << 24))
+    if lanes == 1:
+        return words[0]
+    return jnp.stack(words, axis=1).reshape(-1)
 
 
 @functools.partial(jax.jit, static_argnames=("count", "lanes"))
@@ -268,6 +318,37 @@ def page_plain_fixed_levels_tbl(words, d_bp, d_tbl, count: int, lanes: int,
     dl = _expand_stream(d_bp, d_tbl, dcnt, dw, dnbp,
                         dsingle, use_pallas).astype(jnp.int32)
     return words[: count * lanes], dl
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "icnt", "iw", "inbp", "total_bytes", "has_idx", "isingle",
+    "use_pallas"))
+def page_dict_bytes_tbl(dict_offsets, dict_data, i_bp, i_tbl, non_null,
+                        icnt: int, iw: int, inbp: int, total_bytes: int,
+                        has_idx: bool = True, isingle: bool = False,
+                        use_pallas: bool = False):
+    """Fused dict BYTE_ARRAY page decode: expand indices, derive the
+    output offsets ON DEVICE (value lengths are just the dictionary
+    offset diffs; a masked cumsum rebuilds the padded offset table the
+    gather needs), then the byte-granular gather.  Shipping the offsets
+    cost 4 bytes per value — more wire than the dict indices themselves
+    for short-string columns; now only the run tables ship."""
+    if has_idx:
+        idx = _expand_stream(i_bp, i_tbl, icnt, iw, inbp, isingle,
+                             use_pallas).astype(jnp.int32)
+    else:
+        idx = jnp.zeros((icnt,), jnp.int32)
+    n_dict = dict_offsets.shape[0] - 1
+    idx = jnp.clip(idx, 0, max(n_dict - 1, 0))
+    lens = dict_offsets[1:] - dict_offsets[:-1]
+    valid = jnp.arange(icnt, dtype=jnp.int32) < non_null
+    contrib = jnp.where(valid, lens[idx], 0)
+    out_offsets = jnp.concatenate([
+        jnp.zeros((1,), dict_offsets.dtype),
+        jnp.cumsum(contrib).astype(dict_offsets.dtype),
+    ])
+    return dict_gather_bytes(dict_offsets, dict_data, idx, out_offsets,
+                             total_bytes)
 
 
 @functools.partial(jax.jit, static_argnames=("total_bytes",))

@@ -93,9 +93,91 @@ _DICT_ENCODINGS = (Encoding.PLAIN_DICTIONARY, Encoding.RLE_DICTIONARY)
 # zero-copy host view, which is strictly cheaper.
 _DEVICE_SNAPPY = os.environ.get("TPQ_DEVICE_SNAPPY", "1") != "0"
 
+# Byte-plane RLE wire transport for PLAIN fixed-width segments (any
+# codec, including UNCOMPRESSED): upper byte planes of numeric data are
+# nearly constant and ship as runs.  Gated per page by measured wire
+# size — pages whose planes are all random ship raw as before.
+_DEVICE_PLANES = os.environ.get("TPQ_DEVICE_PLANES", "1") != "0"
+
+
+def _plan_plane_words(seg, count: int, lanes: int, stager: "_Stager"):
+    """Plan the byte-plane RLE transport for one PLAIN fixed-width
+    values segment (``count`` values of ``lanes`` u32 words each).
+
+    Returns ``words(staged) -> (count*lanes,) u32`` when the per-plane
+    run-length coding measurably beats shipping the raw bytes (the
+    normal case for timestamps, counters, monotone ids — their upper
+    planes are runs), or None to keep the raw path.  The decision is
+    made from ONE vectorized inequality pass; planes that don't
+    compress ship as raw slabs inside the same transport."""
+    from .decode import bucket
+
+    k = lanes * 4
+    nbytes = count * k
+    buf = (seg.reshape(-1) if isinstance(seg, np.ndarray)
+           else np.frombuffer(seg, dtype=np.uint8, count=nbytes))
+    if buf.size < nbytes:
+        raise ValueError("PLAIN values segment shorter than value count")
+    mat = buf[:nbytes].reshape(count, k)
+    if count > 1 << 17:
+        # cheap pre-filter: estimate per-plane run rates on a contiguous
+        # window before paying a full-page scan (a full-entropy 400 MB
+        # page must reject in O(window), not O(page))
+        mid = (count - (1 << 16)) // 2
+        win = mat[mid : mid + (1 << 16)]
+        wrates = (win[1:] != win[:-1]).mean(axis=0)
+        est = np.minimum(5 * wrates * count + 160, count).sum()
+        if est > 0.9 * nbytes:
+            return None
+    diff = mat[1:] != mat[:-1]
+    runs = diff.sum(axis=0, dtype=np.int64) + 1
+    # 5 wire bytes per run (i32 end + u8 value), at the BUCKETED table
+    # size that actually ships (the jit-cache padding is real wire); a
+    # plane ships raw when runs don't pay.  Engage only on a real win:
+    # >=10% and >=4 KiB.
+    rle_cost = np.array([5 * bucket(int(r)) for r in runs])
+    wire = int(np.minimum(rle_cost, count).sum())
+    if wire > 0.9 * nbytes or nbytes - wire < 4096:
+        return None
+    raw_slabs, ends_parts, vals_parts, spec = [], [], [], []
+    start = 0
+    for j in range(k):
+        if rle_cost[j] >= count:
+            spec.append(("raw", len(raw_slabs)))
+            raw_slabs.append(np.ascontiguousarray(mat[:, j]))
+            continue
+        change = np.flatnonzero(diff[:, j]).astype(np.int32) + 1
+        cap = bucket(len(change) + 1)
+        ends = np.full(cap, count, dtype=np.int32)
+        ends[: len(change)] = change
+        ends[len(change)] = count
+        vals = np.zeros(cap, dtype=np.uint8)
+        vals[: len(change) + 1] = mat[:, j][np.concatenate(
+            ([0], change)).astype(np.int64)]
+        ends_parts.append(ends)
+        vals_parts.append(vals)
+        spec.append(("rle", start, cap))
+        start += cap
+    raw_block = (np.concatenate(raw_slabs) if raw_slabs
+                 else np.zeros(1, dtype=np.uint8))
+    rle_ends = (np.concatenate(ends_parts) if ends_parts
+                else np.zeros(1, dtype=np.int32))
+    rle_vals = (np.concatenate(vals_parts) if vals_parts
+                else np.zeros(1, dtype=np.uint8))
+    hs = stager.add_many([raw_block, rle_ends, rle_vals], pad=False)
+    spec = tuple(spec)
+
+    def words(staged, _hs=hs, _spec=spec, _count=count, _lanes=lanes):
+        from .decode import planes_to_words
+
+        return planes_to_words(staged[_hs[0]], staged[_hs[1]],
+                               staged[_hs[2]], _spec, _count, _lanes)
+
+    return words
+
 
 def _plan_device_snappy_words(payload, expected_size: int, n_words: int,
-                              stager: "_Stager"):
+                              stager: "_Stager", offset: int = 0):
     """Plan device-side snappy decompression of one values segment.
 
     Returns ``words(staged) -> (n_words,) u32`` when the segment should
@@ -105,7 +187,13 @@ def _plan_device_snappy_words(payload, expected_size: int, n_words: int,
     happens in ``native/snappy.c tpq_snappy_scan_tokens``; copy
     resolution is :func:`tpuparquet.kernels.snappy.expand_tokens`
     (pointer doubling).  Reference analogue of the block being replaced:
-    ``compress.go:102-122`` (the hot decompress in the read loop)."""
+    ``compress.go:102-122`` (the hot decompress in the read loop).
+
+    ``offset`` (bytes into the decompressed block) serves V1 pages whose
+    level streams precede the values: the host scans levels from its own
+    decompressed copy, but the WIRE ships the compressed tokens and the
+    device slices the values segment out of its own expansion — level
+    run tables are tiny; the values bytes are the transfer wall."""
     from ..compress import snappy_single_literal_view
 
     if snappy_single_literal_view(payload) is not None:
@@ -121,16 +209,25 @@ def _plan_device_snappy_words(payload, expected_size: int, n_words: int,
     if plan is None:
         return None  # int32 token table would wrap
     te, ts, lp, out_cap, steps, out_len = plan
-    if out_len < n_words * 4:
+    if out_len < offset + n_words * 4:
         raise ValueError("PLAIN values segment shorter than value count")
+    # the wire gate: short-match-heavy blocks (numeric data under
+    # min_match=4) cost more as 8-byte-per-token tables than as raw
+    # bytes — ship tokens only when they actually shrink the transfer
+    if te.nbytes + ts.nbytes + lp.nbytes >= 0.9 * (n_words * 4):
+        return None
     hs = stager.add_many([te, ts, lp], pad=False)
 
-    def words(staged, _hs=hs, _cap=out_cap, _steps=steps, _nw=n_words):
+    def words(staged, _hs=hs, _cap=out_cap, _steps=steps, _nw=n_words,
+              _off=offset):
+        from .decode import u8_to_u32_words_at
         from .snappy import expand_tokens
 
         out = expand_tokens(staged[_hs[0]], staged[_hs[1]], staged[_hs[2]],
                             _cap, _steps)
-        return u8_to_u32_words(out, _nw)
+        if _off == 0:
+            return u8_to_u32_words(out, _nw)
+        return u8_to_u32_words_at(out, jnp.int32(_off), _nw)
 
     return words
 
@@ -509,6 +606,13 @@ class _Stager:
             ps = [a] if i in self.no_pad else _split_rows(a)
             spec.append((len(pieces), len(ps)))
             pieces.extend(ps)
+        from ..stats import current_stats
+
+        _cs = current_stats()
+        if _cs is not None:
+            # counted at transfer time, post-split/padding: the pieces
+            # ARE the wire
+            _cs.bytes_staged += sum(p.nbytes for p in pieces)
         dev = [None] * len(pieces)
         prev = None
         i = 0
@@ -664,15 +768,16 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
             if h is None or h.num_values is None or h.num_values < 0:
                 raise ValueError("DATA_PAGE header missing data_page_header")
             n = h.num_values
-            if (_DEVICE_SNAPPY and codec == CompressionCodec.SNAPPY
-                    and not node.max_rep_level and not max_def
-                    and h.encoding == Encoding.PLAIN
-                    and ptype in _LANES):
+            device_plain = (_DEVICE_SNAPPY
+                            and codec == CompressionCodec.SNAPPY
+                            and h.encoding == Encoding.PLAIN
+                            and ptype in _LANES)
+            if device_plain and not node.max_rep_level and not max_def:
                 # flat-required PLAIN page: the block holds no level
                 # bytes, so planning needs nothing from the payload —
                 # defer decompression (device tokens, or zero-copy host
                 # view for single-literal blocks, decided at dispatch)
-                values_comp = (payload, ph.uncompressed_page_size)
+                values_comp = (payload, ph.uncompressed_page_size, 0)
                 values_seg = None
                 dl_scan = dl_host = None
             else:
@@ -692,6 +797,14 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                     raw, n, max_def, pos, h.definition_level_encoding
                 )
                 values_seg = raw[pos:]
+                if device_plain:
+                    # V1 page WITH levels: host scanned them from its
+                    # own copy; the wire can still ship tokens, with the
+                    # device slicing values out of its expansion at
+                    # ``pos`` (values_seg stays the host fallback for
+                    # single-literal / no-scanner blocks)
+                    values_comp = (payload, ph.uncompressed_page_size,
+                                   pos)
             enc = h.encoding
         elif ptype_page == PageType.DATA_PAGE_V2:
             from ..cpu.hybrid import scan_hybrid
@@ -728,7 +841,7 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                     # V2 keeps levels outside compression: planning only
                     # needs the level bytes, so the values block can
                     # decompress on device
-                    values_comp = (values_seg, vals_size)
+                    values_comp = (values_seg, vals_size, 0)
                     values_seg = None
                 else:
                     values_seg = decompress_block_into(
@@ -770,12 +883,21 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
             plan_words = _plan_device_snappy_words(
                 values_comp[0], values_comp[1],
                 non_null * _LANES[ptype], stager,
+                offset=values_comp[2],
             )
             if plan_words is None:
-                values_seg = decompress_block_into(
-                    codec, values_comp[0], values_comp[1], arena)
+                if values_seg is None:
+                    values_seg = decompress_block_into(
+                        codec, values_comp[0], values_comp[1], arena)
             elif _st is not None:
                 _st.pages_device_snappy += 1
+        if (plan_words is None and _DEVICE_PLANES and non_null
+                and enc == Encoding.PLAIN and ptype in _LANES
+                and values_seg is not None):
+            plan_words = _plan_plane_words(
+                values_seg, non_null, _LANES[ptype], stager)
+            if plan_words is not None and _st is not None:
+                _st.pages_device_planes += 1
 
         # Def-level plan, padded for the fused page kernels.  A page
         # whose value path can't fuse expands it standalone via
@@ -917,28 +1039,27 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                     idx_hs = None
                     i_cnt = bucket(max(non_null, 1))
                     i_single = False
-                offs_pad = np.full(i_cnt + 1, total_b, dtype=np.int32)
-                offs_pad[: non_null + 1] = out_offsets
-                offs_h = stager.add(offs_pad, pad=False)
-
                 def op(s, p, _ih=idx_hs, _icnt=i_cnt,
                        _inbp=(i_nbp if width else 0), _w=width,
                        _isg=i_single, _upl=pallas_expand_enabled(),
-                       _oh=offs_h, _cap=cap, _oo=out_offsets,
+                       _cap=cap, _oo=out_offsets, _nn=non_null,
                        _tb=total_b, _doh=dict_offsets_h,
                        _ddh=dict_data_h):
-                    if _ih is None:
-                        idx_pad = jnp.zeros((_icnt,), jnp.int32)
-                    else:
-                        from .decode import expand_tbl
+                    from .decode import page_dict_bytes_tbl
 
-                        idx_pad = expand_tbl(
-                            s[_ih[0]], s[_ih[1]], _icnt, _w, _inbp,
-                            single=_isg, use_pallas=_upl,
-                        ).astype(jnp.int32)
-                    data = dict_gather_bytes(
-                        s[_doh], s[_ddh], idx_pad, s[_oh], _cap
-                    )
+                    if _ih is None:
+                        dummy = jnp.zeros((1,), jnp.uint32)
+                        data = page_dict_bytes_tbl(
+                            s[_doh], s[_ddh], dummy, dummy,
+                            np.int32(_nn), _icnt, _w, _inbp, _cap,
+                            has_idx=False,
+                        )
+                    else:
+                        data = page_dict_bytes_tbl(
+                            s[_doh], s[_ddh], s[_ih[0]], s[_ih[1]],
+                            np.int32(_nn), _icnt, _w, _inbp, _cap,
+                            isingle=_isg, use_pallas=_upl,
+                        )
                     p["bytes"].append((_oo, data, _tb))
 
                 ops.append(op)

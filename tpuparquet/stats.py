@@ -32,6 +32,9 @@ class DecodeStats:
     # pages whose values segment decompressed ON DEVICE (snappy token
     # kernel) rather than on host — evidence the device path engaged
     pages_device_snappy: int = 0
+    # pages whose PLAIN values shipped as the byte-plane RLE transport
+    # (upper planes as runs) instead of raw bytes
+    pages_device_planes: int = 0
     # write-side pages whose values encoded ON DEVICE (DeviceValues:
     # DELTA/BSS/PLAIN in kernels/encode.py) — evidence the writer TPU
     # path engaged rather than pulling raw values to host
@@ -39,6 +42,13 @@ class DecodeStats:
     values: int = 0
     bytes_compressed: int = 0
     bytes_uncompressed: int = 0
+    # bytes shipped host->device THROUGH THE BATCHED STAGER (counted at
+    # transfer time, split/padding included) — the transfer-wall
+    # observable: compressed-wire shipping shows up as bytes_staged <
+    # bytes_uncompressed.  A few fallback paths (CPU-decoded values,
+    # delta/FLBA/boolean staging inside finish()) transfer outside the
+    # stager and are not counted here.
+    bytes_staged: int = 0
     # slow-path executions that a healthy build would run natively (e.g.
     # a stale .so forcing the numpy bp-stats fallback): nonzero means
     # perf has quietly regressed with no functional symptom
@@ -62,10 +72,12 @@ class DecodeStats:
             "chunks": self.chunks,
             "pages": self.pages,
             "pages_device_snappy": self.pages_device_snappy,
+            "pages_device_planes": self.pages_device_planes,
             "pages_device_encoded": self.pages_device_encoded,
             "values": self.values,
             "bytes_compressed": self.bytes_compressed,
             "bytes_uncompressed": self.bytes_uncompressed,
+            "bytes_staged": self.bytes_staged,
             "native_fallbacks": self.native_fallbacks,
             "wall_s": round(self.wall_s, 6),
             "values_per_sec": round(self.values_per_sec, 1),
