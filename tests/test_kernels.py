@@ -834,8 +834,6 @@ class TestDeviceSnappyWired:
         np.testing.assert_array_equal(gdl, cpu.def_levels)
 
     def test_env_off_still_correct(self, tmp_path, monkeypatch):
-        import tpuparquet.kernels.device as D
-
         vals = self._compressible_i64(2000, seed=6)
         buf = io.BytesIO()
         w = FileWriter(buf, "message m { required int64 a; }",
@@ -844,7 +842,7 @@ class TestDeviceSnappyWired:
         w.close()
         buf.seek(0)
         r = FileReader(buf)
-        monkeypatch.setattr(D, "_DEVICE_SNAPPY", False)
+        monkeypatch.setenv("TPQ_DEVICE_SNAPPY", "0")
         dev = read_row_group_device(r, 0)
         got, _, _ = dev["a"].to_numpy()
         cpu = r.read_row_group_arrays(0)["a"]
@@ -1244,3 +1242,52 @@ class TestDeviceWireTransports:
                               CompressionCodec.SNAPPY, {"v": self._ts()})
         assert d["bytes_staged"] <= 1.05 * d["bytes_uncompressed"]
         assert d["bytes_staged"] < 0.75 * d["bytes_uncompressed"]
+
+    def test_plain_byte_array_device_gather(self):
+        """Compressible PLAIN BYTE_ARRAY pages ship tokens + offsets;
+        the device expands the page and gathers value bytes around the
+        length prefixes.  Parity across V1/V2 x required/optional."""
+        import io as _io
+
+        import numpy as _np
+
+        from tpuparquet import FileReader, FileWriter
+        from tpuparquet.cpu.plain import ByteArrayColumn
+        from tpuparquet.format.metadata import CompressionCodec
+        from tpuparquet.kernels.device import read_row_group_device
+        from tpuparquet.stats import collect_stats
+
+        rng = _np.random.default_rng(11)
+        n = 30_000
+        words = [f"the-quick-brown-fox-{i % 97}".encode()
+                 for i in range(400)]
+        vals = [words[i] for i in rng.integers(0, len(words), n)]
+        for v2 in (False, True):
+            for optional in (False, True):
+                schema = ("message m { %s binary s; }"
+                          % ("optional" if optional else "required"))
+                buf = _io.BytesIO()
+                w = FileWriter(buf, schema,
+                               codec=CompressionCodec.SNAPPY,
+                               allow_dict=False, data_page_v2=v2)
+                if optional:
+                    mask = rng.random(n) >= 0.1
+                    w.write_columns(
+                        {"s": ByteArrayColumn.from_list(
+                            [v for v, m in zip(vals, mask) if m])},
+                        masks={"s": mask})
+                else:
+                    w.write_columns(
+                        {"s": ByteArrayColumn.from_list(vals)})
+                w.close()
+                buf.seek(0)
+                r = FileReader(buf)
+                cpu = r.read_row_group_arrays(0)["s"]
+                with collect_stats() as st:
+                    dev = read_row_group_device(r, 0)["s"]
+                    got, rep, dl = dev.to_numpy()
+                assert got == cpu.values, (v2, optional)
+                _np.testing.assert_array_equal(dl, cpu.def_levels)
+                d = st.as_dict()
+                assert d["pages_device_snappy"] > 0, (v2, optional)
+                assert d["bytes_staged"] < d["bytes_uncompressed"]

@@ -1,0 +1,69 @@
+"""Same-process A/B of the wire transports (round-4 verdict item 3).
+
+For each bench config: e2e device decode with the gated transports ON
+vs OFF (TPQ_DEVICE_PLANES / TPQ_DEVICE_SNAPPY flipped between passes in
+this process), plus bytes_staged for each.  Run on the real chip:
+
+    timeout 1800 python tools/bench_wire.py [target_values]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def measure(reader, reps: int = 3):
+    from tpuparquet.kernels.device import read_row_groups_device
+    from tpuparquet.stats import collect_stats
+
+    best, staged = float("inf"), 0
+    for _ in range(reps):
+        with collect_stats() as st:
+            t0 = time.perf_counter()
+            for _rg, out in read_row_groups_device(reader):
+                for c in out.values():
+                    c.block_until_ready()
+            dt = time.perf_counter() - t0
+        best = min(best, dt)
+        staged = st.bytes_staged
+    return best, staged
+
+
+def main() -> None:
+    if len(sys.argv) > 1:
+        os.environ["TPQ_BENCH_TARGET"] = sys.argv[1]
+    import bench
+    from tpuparquet import FileReader
+
+    for name, builder in [("1-plain", bench.build_config1),
+                          ("2-taxi", bench.build_config2),
+                          ("3-delta-nested", bench.build_config3),
+                          ("4-wide-string", bench.build_config4)]:
+        buf = builder()
+        reader = FileReader(buf)
+        n = sum(rg.num_rows for rg in reader.meta.row_groups)
+        os.environ["TPQ_DEVICE_PLANES"] = "0"
+        os.environ["TPQ_DEVICE_SNAPPY"] = "0"
+        measure(reader, reps=1)  # warmup/compile
+        off_s, off_b = measure(reader)
+        os.environ["TPQ_DEVICE_PLANES"] = "1"
+        os.environ["TPQ_DEVICE_SNAPPY"] = "1"
+        measure(reader, reps=1)
+        on_s, on_b = measure(reader)
+        print(json.dumps({
+            "config": name, "rows": n,
+            "off_s": round(off_s, 3), "on_s": round(on_s, 3),
+            "speedup": round(off_s / on_s, 3),
+            "off_staged_mb": round(off_b / 1e6, 1),
+            "on_staged_mb": round(on_b / 1e6, 1),
+        }), flush=True)
+        os.environ.pop("TPQ_DEVICE_PLANES", None)
+        os.environ.pop("TPQ_DEVICE_SNAPPY", None)
+
+
+if __name__ == "__main__":
+    main()

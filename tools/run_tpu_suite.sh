@@ -32,6 +32,11 @@ for rows in 2000000 4000000 10000000; do
   grep -E "e2e|device:" "$OUT/profile_${rows}.out" | head -4
 done
 
+echo "=== wire transport A/B (planes/tokens on vs off) ==="
+timeout 1800 python tools/bench_wire.py > "$OUT/wire.out" 2>&1
+echo "rc=$?"
+cat "$OUT/wire.out"
+
 echo "=== pallas vs xla unpack A/B ==="
 timeout 1200 python tools/bench_pallas.py 50000000 \
   > "$OUT/pallas.out" 2>&1

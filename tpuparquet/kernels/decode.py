@@ -352,6 +352,25 @@ def page_dict_bytes_tbl(dict_offsets, dict_data, i_bp, i_tbl, non_null,
 
 
 @functools.partial(jax.jit, static_argnames=("total_bytes",))
+def plain_bytes_from_blob(blob: jax.Array, out_offsets: jax.Array, pos,
+                          total_bytes: int):
+    """PLAIN BYTE_ARRAY values gathered out of a device-resident page
+    blob (e.g. the snappy expansion), skipping each value's 4-byte
+    length prefix: value ``v``'s bytes start at
+    ``pos + out_offsets[v] + 4*(v+1)`` in the blob — pure arithmetic
+    from the output offsets, no extra source table on the wire."""
+    if blob.shape[0] == 0:
+        return jnp.zeros((total_bytes,), dtype=jnp.uint8)
+    b = jnp.arange(total_bytes, dtype=jnp.int32)
+    val = jnp.searchsorted(out_offsets[1:], b, side="right").astype(
+        jnp.int32)
+    val = jnp.minimum(val, out_offsets.shape[0] - 2)
+    src = pos + out_offsets[val] + 4 * (val + 1) + (b - out_offsets[val])
+    src = jnp.clip(src, 0, blob.shape[0] - 1)
+    return blob[src]
+
+
+@functools.partial(jax.jit, static_argnames=("total_bytes",))
 def dict_gather_bytes(dict_offsets: jax.Array, dict_data: jax.Array,
                       indices: jax.Array, out_offsets: jax.Array,
                       total_bytes: int):

@@ -91,13 +91,65 @@ _DICT_ENCODINGS = (Encoding.PLAIN_DICTIONARY, Encoding.RLE_DICTIONARY)
 # (tokens + literals ship instead of the decompressed bytes).  Engages
 # only for genuinely-compressed blocks — single-literal blocks keep the
 # zero-copy host view, which is strictly cheaper.
-_DEVICE_SNAPPY = os.environ.get("TPQ_DEVICE_SNAPPY", "1") != "0"
+def _DEVICE_SNAPPY() -> bool:
+    """Read per plan (not import) so same-process A/B runs can flip it."""
+    return os.environ.get("TPQ_DEVICE_SNAPPY", "1") != "0"
 
 # Byte-plane RLE wire transport for PLAIN fixed-width segments (any
 # codec, including UNCOMPRESSED): upper byte planes of numeric data are
 # nearly constant and ship as runs.  Gated per page by measured wire
 # size — pages whose planes are all random ship raw as before.
-_DEVICE_PLANES = os.environ.get("TPQ_DEVICE_PLANES", "1") != "0"
+def _DEVICE_PLANES() -> bool:
+    return os.environ.get("TPQ_DEVICE_PLANES", "1") != "0"
+
+
+def _plan_token_expansion(payload, expected_size: int):
+    """Shared prologue of the token-shipping planners: single-literal /
+    no-native-scanner / int32-overflow checks, then the token plan.
+    Returns ``(te, ts, lp, out_cap, steps, out_len, wire)`` or None;
+    ``wire`` is what the token tables cost on the wire (padded sizes —
+    the padding ships)."""
+    from ..compress import snappy_single_literal_view
+
+    if snappy_single_literal_view(payload) is not None:
+        return None
+    from ..native import snappy_native
+
+    nat = snappy_native()
+    if nat is None or getattr(nat, "_scan_tokens_fn", None) is None:
+        return None
+    from .snappy import plan_tokens
+
+    plan = plan_tokens(payload, expected_size)
+    if plan is None:
+        return None  # int32 token table would wrap
+    te, ts, lp = plan[:3]
+    return (*plan, te.nbytes + ts.nbytes + lp.nbytes)
+
+
+def _stage_token_expansion(plan, stager: "_Stager"):
+    """Stage a token plan; returns ``blob(staged) -> u8[out_cap]``."""
+    te, ts, lp, out_cap, steps = plan[:5]
+    hs = stager.add_many([te, ts, lp], pad=False)
+
+    def blob(staged, _hs=hs, _cap=out_cap, _steps=steps):
+        from .snappy import expand_tokens
+
+        return expand_tokens(staged[_hs[0]], staged[_hs[1]],
+                             staged[_hs[2]], _cap, _steps)
+
+    return blob
+
+
+def _plan_device_snappy_blob(payload, expected_size: int,
+                             wire_budget: float, stager: "_Stager"):
+    """Like :func:`_plan_device_snappy_words` but returning the raw u8
+    page expansion (for byte-granular consumers), engaged only when the
+    token tables fit ``wire_budget`` bytes."""
+    plan = _plan_token_expansion(payload, expected_size)
+    if plan is None or plan[6] > wire_budget:
+        return None
+    return _stage_token_expansion(plan, stager)
 
 
 def _plan_plane_words(seg, count: int, lanes: int, stager: "_Stager"):
@@ -194,37 +246,23 @@ def _plan_device_snappy_words(payload, expected_size: int, n_words: int,
     decompressed copy, but the WIRE ships the compressed tokens and the
     device slices the values segment out of its own expansion — level
     run tables are tiny; the values bytes are the transfer wall."""
-    from ..compress import snappy_single_literal_view
-
-    if snappy_single_literal_view(payload) is not None:
-        return None
-    from ..native import snappy_native
-
-    nat = snappy_native()
-    if nat is None or getattr(nat, "_scan_tokens_fn", None) is None:
-        return None
-    from .snappy import plan_tokens
-
-    plan = plan_tokens(payload, expected_size)
+    plan = _plan_token_expansion(payload, expected_size)
     if plan is None:
-        return None  # int32 token table would wrap
-    te, ts, lp, out_cap, steps, out_len = plan
+        return None
+    out_len, wire = plan[5], plan[6]
     if out_len < offset + n_words * 4:
         raise ValueError("PLAIN values segment shorter than value count")
     # the wire gate: short-match-heavy blocks (numeric data under
     # min_match=4) cost more as 8-byte-per-token tables than as raw
     # bytes — ship tokens only when they actually shrink the transfer
-    if te.nbytes + ts.nbytes + lp.nbytes >= 0.9 * (n_words * 4):
+    if wire >= 0.9 * (n_words * 4):
         return None
-    hs = stager.add_many([te, ts, lp], pad=False)
+    blob = _stage_token_expansion(plan, stager)
 
-    def words(staged, _hs=hs, _cap=out_cap, _steps=steps, _nw=n_words,
-              _off=offset):
+    def words(staged, _blob=blob, _nw=n_words, _off=offset):
         from .decode import u8_to_u32_words_at
-        from .snappy import expand_tokens
 
-        out = expand_tokens(staged[_hs[0]], staged[_hs[1]], staged[_hs[2]],
-                            _cap, _steps)
+        out = _blob(staged)
         if _off == 0:
             return u8_to_u32_words(out, _nw)
         return u8_to_u32_words_at(out, jnp.int32(_off), _nw)
@@ -763,12 +801,14 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 r.pos = cm.data_page_offset - base
             continue
 
+        bytes_comp = None  # BYTE_ARRAY PLAIN: compressed source for the
+        # device page-blob gather (src, uncompressed_size, values_offset)
         if ptype_page == PageType.DATA_PAGE:
             h = ph.data_page_header
             if h is None or h.num_values is None or h.num_values < 0:
                 raise ValueError("DATA_PAGE header missing data_page_header")
             n = h.num_values
-            device_plain = (_DEVICE_SNAPPY
+            device_plain = (_DEVICE_SNAPPY()
                             and codec == CompressionCodec.SNAPPY
                             and h.encoding == Encoding.PLAIN
                             and ptype in _LANES)
@@ -805,6 +845,13 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                     # single-literal / no-scanner blocks)
                     values_comp = (payload, ph.uncompressed_page_size,
                                    pos)
+                elif (_DEVICE_SNAPPY() and codec == CompressionCodec.SNAPPY
+                        and h.encoding == Encoding.PLAIN
+                        and ptype == Type.BYTE_ARRAY):
+                    # BYTE_ARRAY twin: host scans lengths from its copy;
+                    # the device can gather value bytes out of its own
+                    # expansion (length prefixes skipped arithmetically)
+                    bytes_comp = (payload, ph.uncompressed_page_size, pos)
             enc = h.encoding
         elif ptype_page == PageType.DATA_PAGE_V2:
             from ..cpu.hybrid import scan_hybrid
@@ -835,7 +882,7 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
             values_comp = None
             if h.is_compressed is not False:
                 vals_size = ph.uncompressed_page_size - rl_len - dl_len
-                if (_DEVICE_SNAPPY and codec == CompressionCodec.SNAPPY
+                if (_DEVICE_SNAPPY() and codec == CompressionCodec.SNAPPY
                         and h.encoding == Encoding.PLAIN
                         and ptype in _LANES):
                     # V2 keeps levels outside compression: planning only
@@ -844,6 +891,11 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                     values_comp = (values_seg, vals_size, 0)
                     values_seg = None
                 else:
+                    if (_DEVICE_SNAPPY()
+                            and codec == CompressionCodec.SNAPPY
+                            and h.encoding == Encoding.PLAIN
+                            and ptype == Type.BYTE_ARRAY):
+                        bytes_comp = (values_seg, vals_size, 0)
                     values_seg = decompress_block_into(
                         codec, values_seg, vals_size, arena,
                     )
@@ -891,7 +943,7 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                         codec, values_comp[0], values_comp[1], arena)
             elif _st is not None:
                 _st.pages_device_snappy += 1
-        if (plan_words is None and _DEVICE_PLANES and non_null
+        if (plan_words is None and _DEVICE_PLANES() and non_null
                 and enc == Encoding.PLAIN and ptype in _LANES
                 and values_seg is not None):
             plan_words = _plan_plane_words(
@@ -1070,11 +1122,44 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 _def_standalone()
                 col = decode_plain(ptype, values_seg, non_null)  # host scan
                 offs = col.offsets.astype(np.int32)
-                dh = stager.add(col.data)
-                ops.append(
-                    lambda s, p, _dh=dh, _o=offs, _nb=int(col.data.size):
-                    p["bytes"].append((_o, s[_dh], _nb))
-                )
+                from .decode import bucket as _bucket
+
+                blob_plan = None
+                if bytes_comp is not None:
+                    budget = (0.9 * int(col.data.size)
+                              - 4 * _bucket(non_null + 1))
+                    if budget > 0:
+                        blob_plan = _plan_device_snappy_blob(
+                            bytes_comp[0], bytes_comp[1], budget, stager)
+                if blob_plan is not None:
+                    # compressed tokens + padded offsets ship; the
+                    # device expands the page and gathers value bytes
+                    # (length prefixes skipped arithmetically)
+                    from .decode import bucket, plain_bytes_from_blob
+
+                    if _st is not None:
+                        _st.pages_device_snappy += 1
+                    nb = int(col.data.size)
+                    cap = bucket(max(nb, 1))
+                    ocap = bucket(non_null + 1)
+                    offs_pad = np.full(ocap, nb, dtype=np.int32)
+                    offs_pad[: non_null + 1] = offs
+                    oh = stager.add(offs_pad, pad=False)
+
+                    def op(s, p, _bp=blob_plan, _oh=oh, _o=offs,
+                           _cap=cap, _nb=nb, _pos=bytes_comp[2]):
+                        data = plain_bytes_from_blob(
+                            _bp(s), s[_oh], jnp.int32(_pos), _cap)
+                        p["bytes"].append((_o, data, _nb))
+
+                    ops.append(op)
+                else:
+                    dh = stager.add(col.data)
+                    ops.append(
+                        lambda s, p, _dh=dh, _o=offs,
+                        _nb=int(col.data.size):
+                        p["bytes"].append((_o, s[_dh], _nb))
+                    )
             elif (dl_ref is not None
                   and ptype not in (Type.BOOLEAN,
                                     Type.FIXED_LEN_BYTE_ARRAY)):
