@@ -95,40 +95,54 @@ def bss_to_lanes(raw: jax.Array, count: int, k: int, lanes: int):
     return words.reshape(-1)
 
 
-@functools.partial(jax.jit, static_argnames=("spec", "count", "lanes"))
-def planes_to_words(raw_block: jax.Array, rle_ends: jax.Array,
-                    rle_vals: jax.Array, spec: tuple, count: int,
-                    lanes: int):
-    """Byte-plane wire transport -> flat u32 lane words.
+def _rle_expand(ends: jax.Array, vals: jax.Array, start: int, n_runs: int,
+                count: int):
+    """Run table slice -> per-position values (searchsorted expand)."""
+    e = ends[start : start + n_runs]
+    i = jnp.arange(count, dtype=jnp.int32)
+    idx = jnp.searchsorted(e, i, side="right").astype(jnp.int32)
+    idx = jnp.minimum(idx, n_runs - 1)
+    return vals[start + idx]
 
-    The host ships each of the value's ``lanes*4`` byte planes either
-    raw (``u8[count]`` slabs concatenated in ``raw_block``) or
-    run-length coded (run ends/values concatenated in ``rle_ends`` /
-    ``rle_vals``); ``spec`` holds one static entry per plane:
-    ``("raw", slab_index)`` or ``("rle", start, n_runs)``.  Numeric
-    column data (timestamps, counters, monotone ids) is nearly constant
-    in its upper byte planes, so those planes ship as a handful of runs
-    while only the genuinely random low planes pay full wire — the
-    transport the transfer-bound remote-TPU link needs, with a
-    reconstruction (searchsorted expand + shift-combine) that is pure
-    parallel device work."""
-    planes = []
-    for entry in spec:
-        if entry[0] == "raw":
-            j = entry[1]
-            planes.append(
-                jax.lax.dynamic_slice(raw_block, (j * count,), (count,)))
-        else:
-            start, n_runs = entry[1], entry[2]
-            ends = jax.lax.dynamic_slice(rle_ends, (start,), (n_runs,))
-            i = jnp.arange(count, dtype=jnp.int32)
-            idx = jnp.searchsorted(ends, i, side="right").astype(jnp.int32)
-            idx = jnp.minimum(idx, n_runs - 1)
-            planes.append(rle_vals[start + idx])
+
+@functools.partial(jax.jit, static_argnames=("spec", "count", "lanes"))
+def planes_to_words(raw32: jax.Array, rle32_ends: jax.Array,
+                    rle32_vals: jax.Array, raw8: jax.Array,
+                    rle8_ends: jax.Array, rle8_vals: jax.Array,
+                    spec: tuple, count: int, lanes: int):
+    """Lane/byte-plane wire transport -> flat u32 lane words.
+
+    The host ships each of the value's u32 lanes one of three ways —
+    whole-lane run-length coding (``("rle32", start, n_runs)``: numeric
+    data's high words are runs), raw (``("raw32", slab)``), or
+    descended to its four byte planes (``("bytes", e0, e1, e2, e3)``
+    with per-plane ``("raw8", slab)`` / ``("rle8", start, n_runs)``
+    entries: catches constant upper bytes INSIDE an otherwise-random
+    lane, e.g. values < 2^16 in an int64).  Only genuinely random bytes
+    pay full wire; reconstruction (searchsorted expands + shift
+    combine) is pure parallel device work."""
     words = []
-    for lane in range(lanes):
-        b = [planes[4 * lane + t].astype(jnp.uint32) for t in range(4)]
-        words.append(b[0] | (b[1] << 8) | (b[2] << 16) | (b[3] << 24))
+    for entry in spec:
+        kind = entry[0]
+        if kind == "raw32":
+            j = entry[1]
+            words.append(raw32[j * count : (j + 1) * count])
+        elif kind == "rle32":
+            words.append(_rle_expand(rle32_ends, rle32_vals,
+                                     entry[1], entry[2], count))
+        else:  # "bytes": four byte-plane sub-entries
+            b = []
+            for sub in entry[1:]:
+                if sub[0] == "raw8":
+                    j = sub[1]
+                    b.append(raw8[j * count : (j + 1) * count]
+                             .astype(jnp.uint32))
+                else:
+                    b.append(_rle_expand(rle8_ends, rle8_vals,
+                                         sub[1], sub[2], count)
+                             .astype(jnp.uint32))
+            words.append(b[0] | (b[1] << 8) | (b[2] << 16)
+                         | (b[3] << 24))
     if lanes == 1:
         return words[0]
     return jnp.stack(words, axis=1).reshape(-1)

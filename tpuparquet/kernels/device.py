@@ -152,78 +152,156 @@ def _plan_device_snappy_blob(payload, expected_size: int,
     return _stage_token_expansion(plan, stager)
 
 
+def _rle_table(plane: np.ndarray, count: int, val_dtype, bucket):
+    """(bucket-padded ends, vals, cap) run tables for one plane/lane."""
+    change = np.flatnonzero(plane[1:] != plane[:-1]).astype(np.int32) + 1
+    cap = bucket(len(change) + 1)
+    ends = np.full(cap, count, dtype=np.int32)
+    ends[: len(change)] = change
+    ends[len(change)] = count
+    vals = np.zeros(cap, dtype=val_dtype)
+    vals[: len(change) + 1] = plane[np.concatenate(
+        ([0], change)).astype(np.int64)]
+    return ends, vals, cap
+
+
 def _plan_plane_words(seg, count: int, lanes: int, stager: "_Stager"):
-    """Plan the byte-plane RLE transport for one PLAIN fixed-width
+    """Plan the lane/byte-plane RLE transport for one PLAIN fixed-width
     values segment (``count`` values of ``lanes`` u32 words each).
 
-    Returns ``words(staged) -> (count*lanes,) u32`` when the per-plane
-    run-length coding measurably beats shipping the raw bytes (the
-    normal case for timestamps, counters, monotone ids — their upper
-    planes are runs), or None to keep the raw path.  The decision is
-    made from ONE vectorized inequality pass; planes that don't
-    compress ship as raw slabs inside the same transport."""
+    Decisions are made PER U32 LANE from a contiguous sample window, so
+    a full-entropy page rejects in O(window) and an engaged page only
+    ever touches the lanes/planes that pay:
+
+    * ``rle32`` — the lane is runs as a whole (high words of
+      timestamps/counters; zero high lanes of small-range values);
+      one strided compare + flatnonzero, 8 wire bytes per run.
+    * ``bytes`` — the lane is random as a word but has constant upper
+      byte planes (e.g. int32s < 2^16); only the random byte planes
+      ship raw.
+    * ``raw32`` — genuinely random lane: one contiguous u32 slab.
+
+    Host cost matters as much as wire here (the planner runs on the
+    pipeline's plan thread): everything below is one strided-view pass
+    per engaged lane, no full-page 2-D materialization."""
     from .decode import bucket
 
-    k = lanes * 4
-    nbytes = count * k
+    if count < 1024:
+        return None  # can't clear the 4 KiB savings gate
+    nbytes = count * lanes * 4
     buf = (seg.reshape(-1) if isinstance(seg, np.ndarray)
            else np.frombuffer(seg, dtype=np.uint8, count=nbytes))
     if buf.size < nbytes:
         raise ValueError("PLAIN values segment shorter than value count")
-    mat = buf[:nbytes].reshape(count, k)
-    if count > 1 << 17:
-        # cheap pre-filter: estimate per-plane run rates on a contiguous
-        # window before paying a full-page scan (a full-entropy 400 MB
-        # page must reject in O(window), not O(page))
-        mid = (count - (1 << 16)) // 2
-        win = mat[mid : mid + (1 << 16)]
-        wrates = (win[1:] != win[:-1]).mean(axis=0)
-        est = np.minimum(5 * wrates * count + 160, count).sum()
-        if est > 0.9 * nbytes:
-            return None
-    diff = mat[1:] != mat[:-1]
-    runs = diff.sum(axis=0, dtype=np.int64) + 1
-    # 5 wire bytes per run (i32 end + u8 value), at the BUCKETED table
-    # size that actually ships (the jit-cache padding is real wire); a
-    # plane ships raw when runs don't pay.  Engage only on a real win:
-    # >=10% and >=4 KiB.
-    rle_cost = np.array([5 * bucket(int(r)) for r in runs])
-    wire = int(np.minimum(rle_cost, count).sum())
-    if wire > 0.9 * nbytes or nbytes - wire < 4096:
-        return None
-    raw_slabs, ends_parts, vals_parts, spec = [], [], [], []
-    start = 0
-    for j in range(k):
-        if rle_cost[j] >= count:
-            spec.append(("raw", len(raw_slabs)))
-            raw_slabs.append(np.ascontiguousarray(mat[:, j]))
+    words_v = buf[:nbytes].view("<u4")  # value-interleaved lanes
+    win_n = min(count, 1 << 14)
+    mid = (count - win_n) // 2
+
+    plans = []  # per lane: ("raw32",) | ("rle32", est) | ("bytes", keep)
+    wire = 0
+    for lane in range(lanes):
+        lw = np.ascontiguousarray(
+            words_v[mid * lanes + lane : (mid + win_n) * lanes : lanes])
+        r32 = float((lw[1:] != lw[:-1]).mean()) if win_n > 1 else 1.0
+        est32 = 8 * bucket(int(r32 * count) + 1)
+        if est32 < 4 * count:  # beats the 4-bytes-per-value raw lane
+            plans.append(("rle32", est32))
+            wire += est32
             continue
-        change = np.flatnonzero(diff[:, j]).astype(np.int32) + 1
-        cap = bucket(len(change) + 1)
-        ends = np.full(cap, count, dtype=np.int32)
-        ends[: len(change)] = change
-        ends[len(change)] = count
-        vals = np.zeros(cap, dtype=np.uint8)
-        vals[: len(change) + 1] = mat[:, j][np.concatenate(
-            ([0], change)).astype(np.int64)]
-        ends_parts.append(ends)
-        vals_parts.append(vals)
-        spec.append(("rle", start, cap))
-        start += cap
-    raw_block = (np.concatenate(raw_slabs) if raw_slabs
-                 else np.zeros(1, dtype=np.uint8))
-    rle_ends = (np.concatenate(ends_parts) if ends_parts
-                else np.zeros(1, dtype=np.int32))
-    rle_vals = (np.concatenate(vals_parts) if vals_parts
-                else np.zeros(1, dtype=np.uint8))
-    hs = stager.add_many([raw_block, rle_ends, rle_vals], pad=False)
+        wb = lw.view(np.uint8).reshape(win_n, 4)
+        r8 = (wb[1:] != wb[:-1]).mean(axis=0)
+        cost8 = np.minimum(5 * np.array(
+            [bucket(int(r * count) + 1) for r in r8]), count)
+        if cost8.sum() < 0.75 * 4 * count:
+            plans.append(("bytes", cost8))
+            wire += int(cost8.sum())
+        else:
+            plans.append(("raw32",))
+            wire += 4 * count
+    # engage only on a solid win: the plan thread pays real host time
+    # per engaged lane, so marginal pages keep the raw path
+    if wire > 0.75 * nbytes or nbytes - wire < 4096:
+        return None
+
+    raw32_parts, raw8_parts = [], []
+    e32_parts, v32_parts, e8_parts, v8_parts = [], [], [], []
+    s32 = s8 = 0
+    spec = []
+    actual = 0  # wire recomputed from BUILT tables (samples can lie)
+
+    def raw32(lane_v):
+        nonlocal actual
+        spec.append(("raw32", len(raw32_parts)))
+        raw32_parts.append(np.ascontiguousarray(lane_v))
+        actual += 4 * count
+
+    def raw8(col):
+        nonlocal actual
+        raw8_parts.append(col)
+        actual += count
+        return ("raw8", len(raw8_parts) - 1)
+
+    for lane, plan in enumerate(plans):
+        lane_v = words_v[lane::lanes]  # strided view, len == count
+        if plan[0] == "rle32":
+            ends, vals, cap = _rle_table(lane_v, count, np.uint32, bucket)
+            if 8 * cap >= 4 * count:
+                # the sample under-estimated (heterogeneous page):
+                # the built table would out-weigh the raw lane
+                raw32(lane_v)
+                continue
+            e32_parts.append(ends)
+            v32_parts.append(vals)
+            spec.append(("rle32", s32, cap))
+            s32 += cap
+            actual += 8 * cap
+        elif plan[0] == "raw32":
+            raw32(lane_v)
+        else:
+            cost8 = plan[1]
+            lane_c = np.ascontiguousarray(lane_v)
+            mat8 = lane_c.view(np.uint8).reshape(count, 4)
+            subs = []
+            for t in range(4):
+                col = np.ascontiguousarray(mat8[:, t])
+                if cost8[t] >= count:
+                    subs.append(raw8(col))
+                    continue
+                ends, vals, cap = _rle_table(col, count, np.uint8, bucket)
+                if 5 * cap >= count:  # sample under-estimated
+                    subs.append(raw8(col))
+                    continue
+                e8_parts.append(ends)
+                v8_parts.append(vals)
+                subs.append(("rle8", s8, cap))
+                s8 += cap
+                actual += 5 * cap
+            spec.append(("bytes", *subs))
+    # re-apply the gate on what the tables actually cost: a page whose
+    # sample window misrepresented it should ship raw, not an engaged
+    # transport that saves nothing (nothing is staged until below, so
+    # bailing here is free)
+    if actual > 0.75 * nbytes or nbytes - actual < 4096:
+        return None
+
+    def cat(parts, dtype):
+        return (np.concatenate(parts) if parts
+                else np.zeros(1, dtype=dtype))
+
+    hs = stager.add_many(
+        [cat(raw32_parts, np.uint32), cat(e32_parts, np.int32),
+         cat(v32_parts, np.uint32), cat(raw8_parts, np.uint8),
+         cat(e8_parts, np.int32), cat(v8_parts, np.uint8)],
+        pad=False)
     spec = tuple(spec)
 
     def words(staged, _hs=hs, _spec=spec, _count=count, _lanes=lanes):
         from .decode import planes_to_words
 
-        return planes_to_words(staged[_hs[0]], staged[_hs[1]],
-                               staged[_hs[2]], _spec, _count, _lanes)
+        return planes_to_words(
+            staged[_hs[0]], staged[_hs[1]], staged[_hs[2]],
+            staged[_hs[3]], staged[_hs[4]], staged[_hs[5]],
+            _spec, _count, _lanes)
 
     return words
 
