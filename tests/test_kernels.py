@@ -526,39 +526,6 @@ class TestPallasPath:
         _np.testing.assert_array_equal(a, b)
         _np.testing.assert_array_equal(a, rnd)
 
-    def test_device_read_with_pallas_env(self, monkeypatch):
-        """Full device read with TPQ_PALLAS=interpret (the interpreter
-        path for CPU test runs; TPQ_PALLAS=1 compiles for real on TPU
-        and is ignored on other backends)."""
-        import io as _io
-
-        import numpy as _np
-
-        monkeypatch.setenv("TPQ_PALLAS", "interpret")
-        from tpuparquet import FileReader, FileWriter
-        from tpuparquet.kernels.device import read_row_group_device
-
-        buf = _io.BytesIO()
-        w = FileWriter(buf, "message m { required int64 a; "
-                            "optional int32 b; }")
-        rng = _np.random.default_rng(3)
-        rows = [{"a": int(rng.integers(0, 50)),
-                 **({} if i % 6 == 0 else {"b": int(rng.integers(0, 9))})}
-                for i in range(4000)]
-        for row in rows:
-            w.add_data(row)
-        w.close()
-        buf.seek(0)
-        r = FileReader(buf)
-        cpu = r.read_row_group_arrays(0)
-        dev = read_row_group_device(r, 0)
-        for path, cd in cpu.items():
-            vals, rep, dl = dev[path].to_numpy()
-            _np.testing.assert_array_equal(
-                _np.asarray(vals), _np.asarray(cd.values))
-            _np.testing.assert_array_equal(dl, cd.def_levels)
-
-
 class TestMultiRowGroupReader:
     """read_row_groups_device: pipelined multi-row-group decode must be
     result-identical to per-row-group read_row_group_device calls."""

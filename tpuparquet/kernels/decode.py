@@ -235,76 +235,46 @@ def _expand_tbl(bp, table, cnt: int, w: int, nbp: int):
     )
 
 
-def pallas_expand_enabled() -> bool:
-    """Opt-in (TPQ_PALLAS=1): route single-run unpacks in the fused page
-    kernels through the Pallas kernel instead of the XLA formulation.
-
-    Both are bit-exact and compile on TPU; measured end-to-end on the
-    NYC-Taxi bench the two were within noise (the workload is
-    host-dispatch-bound), so XLA is the default and the Pallas kernel
-    stays selectable for device-compute-bound workloads.  The opt-in is
-    honored on TPU backends only (Mosaic compiles for TPU; elsewhere the
-    interpreter would silently crawl) — except TPQ_PALLAS=interpret,
-    which forces the interpreter on any backend (returned as the string
-    "interpret", threaded through to ``pallas_call``).  Resolved on HOST
-    at op
-    build time and passed as a static jit arg, so flipping the env var
-    mid-process takes effect (trace-time reads would freeze into the jit
-    cache)."""
-    import os
-
-    env = os.environ.get("TPQ_PALLAS")
-    if env == "interpret":
-        return "interpret"
-    if env in ("1", "true", "on"):
-        try:
-            return jax.default_backend() == "tpu"
-        except Exception:  # pragma: no cover
-            return False
-    return False
-
-
-def _expand_stream(bp, table, cnt: int, w: int, nbp: int, single: bool,
-                   use_pallas: bool = False):
+def _expand_stream(bp, table, cnt: int, w: int, nbp: int, single: bool):
     """Stream expansion with a static fast path: a single bit-packed run
     (what our encoder and most writers emit for levels and dict indices)
-    needs no run search at all — it is a pure tiled bit-unpack, which
-    can run as the Pallas VPU kernel (SURVEY.md §2.8 "Pallas hybrid
-    RLE/BP decode kernel"; ``bitunpack.unpack_u32_pallas``).  ``single``
-    and ``use_pallas`` are decided on host and are part of the jit key."""
-    if single and w:
-        from .bitunpack import unpack_u32, unpack_u32_pallas
+    needs no run search at all — it is a pure tiled bit-unpack.
+    ``single`` is decided on host and is part of the jit key.
 
-        if use_pallas:
-            return unpack_u32_pallas(
-                bp, w, cnt, interpret=(use_pallas == "interpret"))
+    The Pallas formulation of this unpack (``bitunpack.unpack_u32_pallas``,
+    with the documented Mosaic width>=17 straddle-shift workaround) was
+    A/B'd jitted on TPU v5e across widths 1..32 and lost or tied XLA at
+    every width, so the production path is XLA-only; the kernel remains
+    validated by tests (interpret mode) and measurable via
+    ``tools/bench_pallas.py`` should a future Mosaic change the verdict."""
+    if single and w:
+        from .bitunpack import unpack_u32
+
         return unpack_u32(bp, w, cnt)
     return _expand_tbl(bp, table, cnt, w, nbp)
 
 
-@functools.partial(jax.jit, static_argnames=("cnt", "w", "nbp",
-                                             "single", "use_pallas"))
+@functools.partial(jax.jit, static_argnames=("cnt", "w", "nbp", "single"))
 def expand_tbl(bp, table, cnt: int, w: int, nbp: int,
-               single: bool = False, use_pallas: bool = False):
-    return _expand_stream(bp, table, cnt, w, nbp, single, use_pallas)
+               single: bool = False):
+    return _expand_stream(bp, table, cnt, w, nbp, single)
 
 
 @functools.partial(jax.jit, static_argnames=(
     "dcnt", "dw", "dnbp", "icnt", "iw", "inbp", "lanes", "dsingle",
-    "isingle", "use_pallas"))
+    "isingle"))
 def page_dict_fixed_levels_tbl(dictionary, d_bp, d_tbl, i_bp, i_tbl,
                                dcnt: int, dw: int, dnbp: int,
                                icnt: int, iw: int, inbp: int,
                                lanes: int = 1,
                                dsingle: bool = False,
-                               isingle: bool = False,
-                               use_pallas: bool = False):
+                               isingle: bool = False):
     """Fused dict-page decode from packed run tables (one dispatch).
     ``dictionary`` is flat (D*lanes,) u32; returns flat values."""
     dl = _expand_stream(d_bp, d_tbl, dcnt, dw, dnbp,
-                        dsingle, use_pallas).astype(jnp.int32)
+                        dsingle).astype(jnp.int32)
     idx = _expand_stream(i_bp, i_tbl, icnt, iw, inbp,
-                         isingle, use_pallas).astype(jnp.int32)
+                         isingle).astype(jnp.int32)
     n_dict = dictionary.shape[0] // lanes
     vals = _dict_gather_flat(dictionary, jnp.minimum(idx, n_dict - 1),
                              lanes)
@@ -312,35 +282,32 @@ def page_dict_fixed_levels_tbl(dictionary, d_bp, d_tbl, i_bp, i_tbl,
 
 
 @functools.partial(jax.jit, static_argnames=("icnt", "iw", "inbp", "lanes",
-                                             "isingle", "use_pallas"))
+                                             "isingle"))
 def page_dict_fixed_tbl(dictionary, i_bp, i_tbl,
                         icnt: int, iw: int, inbp: int, lanes: int = 1,
-                        isingle: bool = False, use_pallas: bool = False):
+                        isingle: bool = False):
     idx = _expand_stream(i_bp, i_tbl, icnt, iw, inbp,
-                         isingle, use_pallas).astype(jnp.int32)
+                         isingle).astype(jnp.int32)
     n_dict = dictionary.shape[0] // lanes
     return _dict_gather_flat(dictionary, jnp.minimum(idx, n_dict - 1),
                              lanes)
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "count", "lanes", "dcnt", "dw", "dnbp", "dsingle", "use_pallas"))
+    "count", "lanes", "dcnt", "dw", "dnbp", "dsingle"))
 def page_plain_fixed_levels_tbl(words, d_bp, d_tbl, count: int, lanes: int,
                                 dcnt: int, dw: int, dnbp: int,
-                                dsingle: bool = False,
-                                use_pallas: bool = False):
+                                dsingle: bool = False):
     dl = _expand_stream(d_bp, d_tbl, dcnt, dw, dnbp,
-                        dsingle, use_pallas).astype(jnp.int32)
+                        dsingle).astype(jnp.int32)
     return words[: count * lanes], dl
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "icnt", "iw", "inbp", "total_bytes", "has_idx", "isingle",
-    "use_pallas"))
+    "icnt", "iw", "inbp", "total_bytes", "has_idx", "isingle"))
 def page_dict_bytes_tbl(dict_offsets, dict_data, i_bp, i_tbl, non_null,
                         icnt: int, iw: int, inbp: int, total_bytes: int,
-                        has_idx: bool = True, isingle: bool = False,
-                        use_pallas: bool = False):
+                        has_idx: bool = True, isingle: bool = False):
     """Fused dict BYTE_ARRAY page decode: expand indices, derive the
     output offsets ON DEVICE (value lengths are just the dictionary
     offset diffs; a masked cumsum rebuilds the padded offset table the
@@ -348,8 +315,8 @@ def page_dict_bytes_tbl(dict_offsets, dict_data, i_bp, i_tbl, non_null,
     cost 4 bytes per value — more wire than the dict indices themselves
     for short-string columns; now only the run tables ship."""
     if has_idx:
-        idx = _expand_stream(i_bp, i_tbl, icnt, iw, inbp, isingle,
-                             use_pallas).astype(jnp.int32)
+        idx = _expand_stream(i_bp, i_tbl, icnt, iw, inbp,
+                             isingle).astype(jnp.int32)
     else:
         idx = jnp.zeros((icnt,), jnp.int32)
     n_dict = dict_offsets.shape[0] - 1

@@ -55,7 +55,6 @@ from .decode import (
     expand_delta_i32,
     expand_delta_i64,
     levels_to_validity,
-    pallas_expand_enabled,
     plain_fixed_to_lanes,
     plan_delta_i32,
     plan_delta_i64,
@@ -1054,10 +1053,10 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 hs, cnt, nbp = dl_ref[:3]
 
                 def op(s, p, _hs=hs, _cnt=cnt, _nbp=nbp, _n=n,
-                       _sg=dl_ref[3], _upl=pallas_expand_enabled()):
+                       _sg=dl_ref[3]):
                     dl_dev = expand_tbl(
                         s[_hs[0]], s[_hs[1]], _cnt, dwidth, _nbp,
-                        single=_sg, use_pallas=_upl,
+                        single=_sg,
                     ).astype(jnp.int32)
                     p["def"].append((dl_dev, _n))
 
@@ -1088,14 +1087,13 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
 
                     def op(s, p, _d=dl_ref, _i=idx_ref, _n=n,
                            _nn=non_null, _w=width, _dh=dict_fixed_h,
-                           _vl=vlanes, _upl=pallas_expand_enabled()):
+                           _vl=vlanes):
                         vals, dl_dev = page_dict_fixed_levels_tbl(
                             s[_dh],
                             s[_d[0][0]], s[_d[0][1]],
                             s[_i[0][0]], s[_i[0][1]],
                             _d[1], dwidth, _d[2], _i[1], _w, _i[2],
                             lanes=_vl, dsingle=_d[3], isingle=_i[3],
-                            use_pallas=_upl,
                         )
                         p["def"].append((dl_dev, _n))
                         p["val"].append((vals, _nn))
@@ -1117,12 +1115,11 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                         from .decode import page_dict_fixed_tbl
 
                         def op(s, p, _i=idx_ref, _nn=non_null, _w=width,
-                               _dh=dict_fixed_h, _vl=vlanes,
-                               _upl=pallas_expand_enabled()):
+                               _dh=dict_fixed_h, _vl=vlanes):
                             vals = page_dict_fixed_tbl(
                                 s[_dh], s[_i[0][0]], s[_i[0][1]],
                                 _i[1], _w, _i[2], lanes=_vl,
-                                isingle=_i[3], use_pallas=_upl,
+                                isingle=_i[3],
                             )
                             p["val"].append((vals, _nn))
 
@@ -1171,7 +1168,7 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                     i_single = False
                 def op(s, p, _ih=idx_hs, _icnt=i_cnt,
                        _inbp=(i_nbp if width else 0), _w=width,
-                       _isg=i_single, _upl=pallas_expand_enabled(),
+                       _isg=i_single,
                        _cap=cap, _oo=out_offsets, _nn=non_null,
                        _tb=total_b, _doh=dict_offsets_h,
                        _ddh=dict_data_h):
@@ -1188,7 +1185,7 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                         data = page_dict_bytes_tbl(
                             s[_doh], s[_ddh], s[_ih[0]], s[_ih[1]],
                             np.int32(_nn), _icnt, _w, _inbp, _cap,
-                            isingle=_isg, use_pallas=_upl,
+                            isingle=_isg,
                         )
                     p["bytes"].append((_oo, data, _tb))
 
@@ -1251,12 +1248,11 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                     get_words = lambda s, _wh=wh: s[_wh]
 
                 def op(s, p, _gw=get_words, _d=dl_ref, _nn=non_null, _n=n,
-                       _lanes=lanes, _upl=pallas_expand_enabled()):
+                       _lanes=lanes):
                     vals, dl_dev = page_plain_fixed_levels_tbl(
                         _gw(s), s[_d[0][0]], s[_d[0][1]], _nn, _lanes,
                         _d[1], dwidth, _d[2], dsingle=_d[3],
-                        use_pallas=_upl,
-                    )
+                                            )
                     p["def"].append((dl_dev, _n))
                     p["val"].append((vals, _nn))
 
@@ -1538,14 +1534,11 @@ def _defer_levels(ops, stager, kind, scan, host_vals, n, width,
 
         sg = single_bp_scan(scan)
 
-        def op(s, p, _hs=hs, _cnt=cnt, _nbp=nbp, _n=n, _w=width, _sg=sg,
-               _upl=pallas_expand_enabled()):
+        def op(s, p, _hs=hs, _cnt=cnt, _nbp=nbp, _n=n, _w=width, _sg=sg):
             from .decode import expand_tbl
 
             dev = expand_tbl(
-                s[_hs[0]], s[_hs[1]], _cnt, _w, _nbp, single=_sg,
-                use_pallas=_upl,
-            )
+                s[_hs[0]], s[_hs[1]], _cnt, _w, _nbp, single=_sg)
             if cast is not None:
                 dev = dev.astype(cast)
             p[kind].append((dev, _n))
