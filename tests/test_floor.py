@@ -588,3 +588,27 @@ class TestColumnarListFields:
         with new_file_reader(str(p), B) as r:
             got = r.read_columns(0)
         assert got == want == objs
+
+    def test_list_of_dates_and_times(self, tmp_path):
+        """Leaf conversions (DATE/TIMESTAMP) apply inside list elements
+        identically on the bulk and row paths."""
+        @dataclass
+        class R:
+            days: Optional[list[datetime.date]] = None
+            stamps: Optional[list[datetime.datetime]] = None
+
+        objs = [
+            R(days=[datetime.date(2024, 1, i + 1) for i in range(3)],
+              stamps=[datetime.datetime(2024, 1, 1, 12, 0, i)
+                      for i in range(2)]),
+            R(days=[], stamps=None),
+            R(days=None, stamps=[datetime.datetime(1999, 12, 31, 23)]),
+        ]
+        p = tmp_path / "ld.parquet"
+        with new_file_writer(str(p), cls=R) as w:
+            w.write_columns(objs)
+        with new_file_reader(str(p), R) as r:
+            want = list(r)
+        with new_file_reader(str(p), R) as r:
+            got = r.read_columns(0)
+        assert got == want == objs
