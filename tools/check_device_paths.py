@@ -87,6 +87,27 @@ def _files():
         "optional int64 element; } } }",
         {"l": rng.integers(0, 10**9, size=3 * n)},
         offsets={"l": np.arange(0, 3 * n + 1, 3, dtype=np.int64)})
+    # -- round-4 wire transports -----------------------------------------
+    big = 50_000  # large enough to clear the transports' savings gates
+    yield build(
+        "lane-RLE transport (timestamp i64 uncompressed)",
+        "message m { required int64 t; }",
+        {"t": 1_700_000_000_000
+         + rng.integers(0, 3_600_000, size=big).cumsum()},
+        allow_dict=False)
+    yield build(
+        "byte-plane descent (small-range i32) + V1 optional levels",
+        "message m { optional int32 k; }",
+        {"k": rng.integers(0, 1000, size=big - big // 10,
+                           dtype=np.int32)},
+        masks={"k": np.arange(big) % 10 != 0},
+        codec=CompressionCodec.SNAPPY, allow_dict=False)
+    yield build(
+        "PLAIN byte-array token+gather (compressible strings)",
+        "message m { required binary s (STRING); }",
+        {"s": ByteArrayColumn.from_list(
+            [b"the-quick-brown-fox-%d" % (i % 97) for i in range(big)])},
+        codec=CompressionCodec.SNAPPY, allow_dict=False)
 
 
 def main() -> int:

@@ -1033,13 +1033,12 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
         # _defer_levels below.
         dl_ref = None  # (handles, cnt, nbp, single) when fusable
         if dl_scan is not None:
-            from .hybrid import pack_plan, plan_from_scan, single_bp_scan
+            from .hybrid import plan_stream_args
 
-            dl_args, dl_cnt, _, dl_nbp = pack_plan(
-                plan_from_scan(dl_scan, n, dwidth)
-            )
+            dl_args, dl_cnt, dl_nbp, dl_sg = plan_stream_args(
+                dl_scan, n, dwidth)
             dl_ref = (stager.add_many(dl_args, pad=False), dl_cnt, dl_nbp,
-                      single_bp_scan(dl_scan))
+                      dl_sg)
         elif dl_host is not None:
             hh = stager.add(np.asarray(dl_host, dtype=np.int32))
             ops.append(lambda s, p, _h=hh, _n=n:
@@ -1066,22 +1065,17 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
             width = int(values_seg[0]) if len(values_seg) else 0
             if dict_fixed_h is not None:
                 from ..cpu.hybrid import scan_hybrid
-                from .hybrid import (
-                    pack_plan as _pp,
-                    plan_from_scan as _pf,
-                    single_bp_scan,
-                )
+                from .hybrid import plan_stream_args
 
                 i_sc = scan_hybrid(values_seg, non_null, width, pos=1) \
                     if width else None
                 _check_dict_indices(i_sc, width, non_null, dict_len)
                 idx_ref = None
                 if i_sc is not None:
-                    idx_args, i_cnt, _, i_nbp = _pp(
-                        _pf(i_sc, non_null, width)
-                    )
-                    idx_ref = (stager.add_many(idx_args, pad=False), i_cnt, i_nbp,
-                               single_bp_scan(i_sc))
+                    idx_args, i_cnt, i_nbp, i_sg = plan_stream_args(
+                        i_sc, non_null, width)
+                    idx_ref = (stager.add_many(idx_args, pad=False),
+                               i_cnt, i_nbp, i_sg)
                 if dl_ref is not None and idx_ref is not None:
                     from .decode import page_dict_fixed_levels_tbl
 
@@ -1130,11 +1124,7 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 # One scan serves both the host expand and the device plan.
                 from ..cpu.hybrid import expand_scan, scan_hybrid
                 from .decode import bucket
-                from .hybrid import (
-                    pack_plan as _pp,
-                    plan_from_scan as _pf,
-                    single_bp_scan,
-                )
+                from .hybrid import plan_stream_args
 
                 _def_standalone()
                 if width:
@@ -1158,10 +1148,9 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 # cache keys on buckets, not exact per-page counts
                 cap = bucket(max(total_b, 1))
                 if i_sc is not None:
-                    i_args, i_cnt, _, i_nbp = _pp(_pf(i_sc, non_null,
-                                                      width))
+                    i_args, i_cnt, i_nbp, i_single = plan_stream_args(
+                        i_sc, non_null, width, expanded=idx_u)
                     idx_hs = stager.add_many(i_args, pad=False)
-                    i_single = single_bp_scan(i_sc)
                 else:
                     idx_hs = None
                     i_cnt = bucket(max(non_null, 1))
@@ -1524,15 +1513,12 @@ def _defer_levels(ops, stager, kind, scan, host_vals, n, width,
     range validation of ``cpu/levels._check`` (rep levels would otherwise
     silently mis-nest on corrupt streams)."""
     if scan is not None:
-        from .hybrid import count_eq_scan, pack_plan, plan_from_scan
+        from .hybrid import count_eq_scan, plan_stream_args
 
         if max_level is not None:
             count_eq_scan(scan, width, max_level, validate_max=True)
-        args, cnt, _, nbp = pack_plan(plan_from_scan(scan, n, width))
+        args, cnt, nbp, sg = plan_stream_args(scan, n, width)
         hs = stager.add_many(args, pad=False)
-        from .hybrid import single_bp_scan
-
-        sg = single_bp_scan(scan)
 
         def op(s, p, _hs=hs, _cnt=cnt, _nbp=nbp, _n=n, _w=width, _sg=sg):
             from .decode import expand_tbl

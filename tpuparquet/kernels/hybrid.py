@@ -279,6 +279,49 @@ def decode_hybrid_device(data, count: int, width: int, pos: int = 0):
     return decode_hybrid_device_padded(data, count, width, pos)[:count]
 
 
+def plan_stream_args(scan, count: int, width: int, expanded=None):
+    """((bp_words, table), cnt, nbp, single) staging plan for one hybrid
+    stream — the single decision point for how a level/index stream goes
+    on the wire.
+
+    Mixed-run streams (random validity masks, irregular dict indices)
+    can carry run tables of 16 bytes/run that dwarf the packed values
+    themselves (measured: a 6 KB def-level stream shipping a 262 KB
+    table).  When the stream's TOTAL wire (table + its bit-packed
+    segments, bucket-padded as shipped) exceeds a plain bit-packing of
+    all values, the host expands the runs (vectorized pass 2; pass
+    ``expanded`` to reuse a caller's expansion) and re-packs them as
+    ONE bit-packed run: a minimal table ships and the device expansion
+    degenerates to a pure tiled unpack (``single=True``)."""
+    from .decode import bucket
+
+    def bp_wire(n_vals: int) -> int:
+        return ((bucket(max(n_vals, 1)) + 31) // 32) * 4 * width
+
+    single = single_bp_scan(scan)
+    if not single and width and count >= 1024:
+        n_bp = int(scan[5])
+        old_wire = 16 * bucket(max(len(scan[0]), 1)) + (
+            bp_wire(n_bp) if n_bp else 0)
+        new_wire = 16 * bucket(1) + bp_wire(count)
+        if old_wire > new_wire:
+            from ..cpu.bitpack import pack
+            from ..cpu.hybrid import expand_scan
+
+            vals = (expanded if expanded is not None
+                    else expand_scan(*scan[:6], count, width))
+            packed = np.frombuffer(pack(vals[:count], width),
+                                   dtype=np.uint8)
+            scan = (np.array([count], dtype=np.int32),
+                    np.zeros(1, dtype=bool),
+                    np.zeros(1, dtype=np.uint32),
+                    np.zeros(1, dtype=np.int32),
+                    packed, count, scan[6])
+            single = True
+    args, cnt, _, nbp = pack_plan(plan_from_scan(scan, count, width))
+    return args, cnt, nbp, single
+
+
 def single_bp_scan(scan) -> bool:
     """True when a scan is exactly one bit-packed run — expansion then
     degenerates to a pure tiled bit-unpack (no run search), which the
