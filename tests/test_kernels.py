@@ -1293,3 +1293,49 @@ class TestDeviceWireTransports:
         _np.testing.assert_array_equal(dl, cpu.def_levels)
         d = st.as_dict()
         assert d["bytes_staged"] < 0.8 * d["bytes_uncompressed"], d
+
+    def test_plan_stream_args_decisions(self):
+        """The stream-wire decision table: RLE-heavy streams keep their
+        (tiny) run table, single-bp streams pass through untouched, and
+        mixed many-run streams re-pack — with bit-exact expansion in
+        every case."""
+        import numpy as _np
+
+        from tpuparquet.cpu.hybrid import encode_hybrid, scan_hybrid
+        from tpuparquet.kernels.decode import expand_tbl
+        from tpuparquet.kernels.hybrid import plan_stream_args
+
+        def expand(args, cnt, nbp, single, n, w):
+            import jax.numpy as _jnp
+
+            bp, tbl = args
+            out = expand_tbl(_jnp.asarray(bp), _jnp.asarray(tbl),
+                             cnt, w, nbp, single=single)
+            return _np.asarray(out)[:n]
+
+        w = 2
+        # RLE-heavy: 4 long runs -> table stays (no repack)
+        vals = _np.repeat([3, 0, 2, 1], 2000).astype(_np.uint64)
+        sc = scan_hybrid(encode_hybrid(vals, w), len(vals), w)
+        args, cnt, nbp, single = plan_stream_args(sc, len(vals), w)
+        assert not single  # kept the run table
+        assert args[1].shape[1] <= 32  # minimal bucket, not per-run blowup
+        _np.testing.assert_array_equal(
+            expand(args, cnt, nbp, single, len(vals), w), vals)
+
+        # mixed many-run: alternating short runs -> repacked to single
+        vals = _np.tile(_np.repeat([1, 2], 3), 2000).astype(_np.uint64)
+        sc = scan_hybrid(encode_hybrid(vals, w), len(vals), w)
+        args, cnt, nbp, single = plan_stream_args(sc, len(vals), w)
+        assert single  # re-packed: no run table ships
+        _np.testing.assert_array_equal(
+            expand(args, cnt, nbp, single, len(vals), w), vals)
+
+        # already single bit-packed run: untouched fast path
+        rnd = _np.random.default_rng(3).integers(
+            0, 4, 5000, dtype=_np.uint64)
+        sc = scan_hybrid(encode_hybrid(rnd, w), len(rnd), w)
+        args, cnt, nbp, single = plan_stream_args(sc, len(rnd), w)
+        assert single
+        _np.testing.assert_array_equal(
+            expand(args, cnt, nbp, single, len(rnd), w), rnd)
