@@ -92,6 +92,8 @@ class DecodeStats:
             f"{d['bytes_compressed']:,}B -> {d['bytes_uncompressed']:,}B "
             f"(x{d['compression_ratio']}); "
             f"{d['wall_s']:.4f}s = {d['values_per_sec']:,.0f} values/s"
+            + (f"; staged {d['bytes_staged']:,}B to device"
+               if d["bytes_staged"] else "")
             + (f"; {d['native_fallbacks']} native fallbacks (stale .so?)"
                if d["native_fallbacks"] else "")
         )
