@@ -198,3 +198,87 @@ class TestDeviceSnappy:
             decompress_device(good[:-2])
         with pytest.raises(ValueError):
             decompress_device(good, expected_size=5)
+
+
+class TestNativePlane:
+    """Strided lane/byte-plane primitives behind the wire planner."""
+
+    def _nat(self):
+        from tpuparquet.native import plane_native
+
+        p = plane_native()
+        if p is None:
+            pytest.skip("native plane primitives unavailable")
+        return p
+
+    def test_gather_parity_all_strides(self):
+        nat = self._nat()
+        rng = np.random.default_rng(11)
+        buf = rng.integers(0, 256, 8192, dtype=np.uint8)
+        words = buf.view("<u4")
+        views = [
+            words[0::2], words[1::2],          # int64 u32 lanes
+            words[0::3], words[2::3],          # FLBA 12-byte lanes
+            buf[0::4], buf[3::4],              # int32 byte planes
+            buf[1::8], buf[7::8],              # int64 byte planes
+            buf[5::12],                        # FLBA byte plane
+        ]
+        for v in views:
+            assert np.array_equal(nat.gather(v), np.ascontiguousarray(v))
+
+    def test_gather_no_overread_at_page_boundary(self):
+        """The widened-load fast paths must not read past the buffer:
+        lane bases are offset into the segment, so the last element's
+        natural 8-byte load would cross the end (SIGSEGV when the
+        segment is a zero-copy view ending at an mmap page edge)."""
+        import mmap
+
+        nat = self._nat()
+        m = mmap.mmap(-1, 4096 * 2)
+        seg = np.frombuffer(m, dtype=np.uint8)[4096:]  # ends at map end
+        seg[:] = np.arange(4096, dtype=np.uint64).view(np.uint8)[:4096]
+        words = seg.view("<u4")
+        for v in (words[1::2], seg[3::4], seg[7::8]):
+            assert np.array_equal(nat.gather(v), np.ascontiguousarray(v))
+
+    def test_run_scan_matches_numpy(self):
+        nat = self._nat()
+        rng = np.random.default_rng(12)
+        for plane in (
+            rng.integers(0, 3, 10_000, dtype=np.uint8)[1::4],
+            np.repeat(rng.integers(0, 9, 40), 25).astype(np.uint8),
+            rng.integers(0, 2, 5_000, dtype=np.uint32)[0::2].copy().reshape(-1),
+            np.zeros(1, dtype=np.uint32),
+        ):
+            count = plane.size
+            ends, vals = nat.run_scan(plane, count + 1)
+            change = np.flatnonzero(plane[1:] != plane[:-1]) + 1
+            assert np.array_equal(ends[:-1], change.astype(np.int32))
+            assert ends[-1] == count
+            assert np.array_equal(
+                vals, plane[np.concatenate(([0], change)).astype(np.int64)]
+            )
+
+    def test_run_scan_cap_aborts(self):
+        nat = self._nat()
+        plane = np.arange(1000, dtype=np.uint32)  # 1000 runs
+        assert nat.run_scan(plane, 10) is None
+
+    def test_rle_table_native_numpy_identical(self):
+        import tpuparquet.kernels.device as D
+        from tpuparquet.kernels.decode import bucket
+
+        self._nat()
+        rng = np.random.default_rng(13)
+        plane = np.repeat(rng.integers(0, 50, 200), 17).astype(np.uint32)
+        n = plane.size
+        t1 = D._rle_table(plane, n, np.uint32, bucket, max_runs=n)
+        orig = D.plane_native
+        D.plane_native = lambda: None
+        try:
+            t2 = D._rle_table(plane, n, np.uint32, bucket, max_runs=n)
+        finally:
+            D.plane_native = orig
+        for a, b in zip(t1[:2], t2[:2]):
+            assert np.array_equal(a, b)
+        assert t1[2] == t2[2]

@@ -198,6 +198,8 @@ def decode_data_page_v1(header: PageHeader, payload, codec: CompressionCodec,
         raise ValueError("DATA_PAGE header missing data_page_header")
     raw = decompress_block(codec, payload, header.uncompressed_page_size)
     n = h.num_values
+    if n is None or n < 0:
+        raise ValueError("DATA_PAGE header missing num_values")
     pos = 0
     rep, pos = _decode_levels_dispatch_v1(
         raw, n, node.max_rep_level, h.repetition_level_encoding, pos
@@ -234,6 +236,8 @@ def decode_data_page_v2(header: PageHeader, payload, codec: CompressionCodec,
     if h is None:
         raise ValueError("DATA_PAGE_V2 header missing data_page_header_v2")
     n = h.num_values
+    if n is None or n < 0:
+        raise ValueError("DATA_PAGE_V2 header missing num_values")
     rl_len = h.repetition_levels_byte_length or 0
     dl_len = h.definition_levels_byte_length or 0
     if rl_len + dl_len > len(payload):
@@ -293,6 +297,8 @@ def decode_dictionary_page(header: PageHeader, payload,
         raise ValueError("DICTIONARY_PAGE header missing its struct")
     if h.encoding not in (Encoding.PLAIN, Encoding.PLAIN_DICTIONARY):
         raise ValueError(f"dictionary page encoding {h.encoding} unsupported")
+    if h.num_values is None or h.num_values < 0:
+        raise ValueError("DICTIONARY_PAGE header missing num_values")
     raw = decompress_block(codec, payload, header.uncompressed_page_size)
     return decode_plain(
         Type(node.element.type), raw, h.num_values, node.element.type_length

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 
 from ..compress import decompress_block, decompress_block_into
 from ..cpu import decode_plain
+from ..native import plane_native
 from .arena import HostArena, discard_thread_arena, thread_arena
 from ..cpu.plain import ByteArrayColumn
 from ..format.compact import CompactReader
@@ -151,9 +152,30 @@ def _plan_device_snappy_blob(payload, expected_size: int,
     return _stage_token_expansion(plan, stager)
 
 
-def _rle_table(plane: np.ndarray, count: int, val_dtype, bucket):
-    """(bucket-padded ends, vals, cap) run tables for one plane/lane."""
+def _rle_table(plane: np.ndarray, count: int, val_dtype, bucket,
+               max_runs: int | None = None):
+    """(bucket-padded ends, vals, cap) run tables for one plane/lane, or
+    None when the plane has more than ``max_runs`` runs (the table could
+    not beat shipping the plane raw, so don't finish building it).
+
+    ``plane`` may be a strided view — the native path scans it in one C
+    pass with no bool temp or materialized copy."""
+    nat = plane_native()
+    if nat is not None and plane.ndim == 1:
+        res = nat.run_scan(
+            plane, count if max_runs is None else min(max_runs, count))
+        if res is None:
+            return None
+        ends_r, vals_r = res
+        cap = bucket(len(ends_r))
+        ends = np.full(cap, count, dtype=np.int32)
+        ends[: len(ends_r)] = ends_r
+        vals = np.zeros(cap, dtype=val_dtype)
+        vals[: len(vals_r)] = vals_r
+        return ends, vals, cap
     change = np.flatnonzero(plane[1:] != plane[:-1]).astype(np.int32) + 1
+    if max_runs is not None and len(change) + 1 > max_runs:
+        return None
     cap = bucket(len(change) + 1)
     ends = np.full(cap, count, dtype=np.int32)
     ends[: len(change)] = change
@@ -162,6 +184,14 @@ def _rle_table(plane: np.ndarray, count: int, val_dtype, bucket):
     vals[: len(change) + 1] = plane[np.concatenate(
         ([0], change)).astype(np.int64)]
     return ends, vals, cap
+
+
+def _lane_contig(plane: np.ndarray) -> np.ndarray:
+    """Contiguous copy of a (possibly strided) lane/plane view."""
+    nat = plane_native()
+    if nat is not None and plane.ndim == 1 and not plane.flags.c_contiguous:
+        return nat.gather(plane)
+    return np.ascontiguousarray(plane)
 
 
 def _plan_plane_words(seg, count: int, lanes: int, stager: "_Stager"):
@@ -231,7 +261,7 @@ def _plan_plane_words(seg, count: int, lanes: int, stager: "_Stager"):
     def raw32(lane_v):
         nonlocal actual
         spec.append(("raw32", len(raw32_parts)))
-        raw32_parts.append(np.ascontiguousarray(lane_v))
+        raw32_parts.append(_lane_contig(lane_v))
         actual += 4 * count
 
     def raw8(col):
@@ -243,12 +273,16 @@ def _plan_plane_words(seg, count: int, lanes: int, stager: "_Stager"):
     for lane, plan in enumerate(plans):
         lane_v = words_v[lane::lanes]  # strided view, len == count
         if plan[0] == "rle32":
-            ends, vals, cap = _rle_table(lane_v, count, np.uint32, bucket)
-            if 8 * cap >= 4 * count:
+            # beyond count/2 runs the 8 B/run table cannot beat the raw
+            # 4 B/value lane, so the scan aborts there (tab is None)
+            tab = _rle_table(lane_v, count, np.uint32, bucket,
+                             max_runs=count // 2 + 1)
+            if tab is None or 8 * tab[2] >= 4 * count:
                 # the sample under-estimated (heterogeneous page):
                 # the built table would out-weigh the raw lane
                 raw32(lane_v)
                 continue
+            ends, vals, cap = tab
             e32_parts.append(ends)
             v32_parts.append(vals)
             spec.append(("rle32", s32, cap))
@@ -258,18 +292,21 @@ def _plan_plane_words(seg, count: int, lanes: int, stager: "_Stager"):
             raw32(lane_v)
         else:
             cost8 = plan[1]
-            lane_c = np.ascontiguousarray(lane_v)
-            mat8 = lane_c.view(np.uint8).reshape(count, 4)
             subs = []
             for t in range(4):
-                col = np.ascontiguousarray(mat8[:, t])
+                # strided view of byte plane t of this lane (LE words:
+                # byte t of value i lives at i*4*lanes + 4*lane + t)
+                col = buf[4 * lane + t : nbytes : 4 * lanes]
                 if cost8[t] >= count:
-                    subs.append(raw8(col))
+                    subs.append(raw8(_lane_contig(col)))
                     continue
-                ends, vals, cap = _rle_table(col, count, np.uint8, bucket)
-                if 5 * cap >= count:  # sample under-estimated
-                    subs.append(raw8(col))
+                tab = _rle_table(col, count, np.uint8, bucket,
+                                 max_runs=count // 5 + 1)
+                if tab is None or 5 * tab[2] >= count:
+                    # sample under-estimated
+                    subs.append(raw8(_lane_contig(col)))
                     continue
+                ends, vals, cap = tab
                 e8_parts.append(ends)
                 v8_parts.append(vals)
                 subs.append(("rle8", s8, cap))
@@ -284,8 +321,11 @@ def _plan_plane_words(seg, count: int, lanes: int, stager: "_Stager"):
         return None
 
     def cat(parts, dtype):
-        return (np.concatenate(parts) if parts
-                else np.zeros(1, dtype=dtype))
+        if not parts:
+            return np.zeros(1, dtype=dtype)
+        if len(parts) == 1:  # already contiguous: don't re-copy 10s of MB
+            return parts[0]
+        return np.concatenate(parts)
 
     hs = stager.add_many(
         [cat(raw32_parts, np.uint32), cat(e32_parts, np.int32),

@@ -18,10 +18,12 @@ import threading
 
 import numpy as np
 
-__all__ = ["snappy_native", "NativeSnappy", "hybrid_native", "NativeHybrid"]
+__all__ = ["snappy_native", "NativeSnappy", "hybrid_native", "NativeHybrid",
+           "plane_native", "NativePlane"]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRCS = [os.path.join(_DIR, "snappy.c"), os.path.join(_DIR, "hybrid.c")]
+_SRCS = [os.path.join(_DIR, "snappy.c"), os.path.join(_DIR, "hybrid.c"),
+         os.path.join(_DIR, "plane.c")]
 _SO = os.path.join(_DIR, "_tpq_native.so")
 
 _lock = threading.Lock()
@@ -299,8 +301,75 @@ class NativeHybrid:
                 bp_out[: bp_len.value], int(n_bp.value), int(end_pos.value))
 
 
+class NativePlane:
+    """ctypes bindings over the strided lane/byte-plane primitives used
+    by the device wire planner (one C pass per run-scan / gather)."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._scan32 = getattr(lib, "tpq_run_scan32", None)
+        self._scan8 = getattr(lib, "tpq_run_scan8", None)
+        self._gather32 = getattr(lib, "tpq_lane_gather32", None)
+        self._gather8 = getattr(lib, "tpq_lane_gather8", None)
+        if None in (self._scan32, self._scan8,
+                    self._gather32, self._gather8):
+            raise RuntimeError("native library too old; rebuild")
+        for fn, val in ((self._scan32, ctypes.c_longlong),
+                        (self._scan8, ctypes.c_longlong)):
+            fn.restype = val
+            fn.argtypes = [
+                ctypes.c_void_p, ctypes.c_longlong, ctypes.c_longlong,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong,
+            ]
+        for fn in (self._gather32, self._gather8):
+            fn.restype = None
+            fn.argtypes = [
+                ctypes.c_void_p, ctypes.c_longlong, ctypes.c_longlong,
+                ctypes.c_void_p,
+            ]
+
+    @staticmethod
+    def _strided(arr: np.ndarray, esize: int):
+        """(base pointer, element stride) for a 1-D strided view."""
+        if arr.ndim != 1 or arr.itemsize != esize:
+            raise ValueError("expected a 1-D view of the element type")
+        return arr.ctypes.data, arr.strides[0]
+
+    def run_scan(self, plane: np.ndarray, max_runs: int):
+        """Run-table scan of a strided u32/u8 view.  Returns
+        (ends[:n], vals[:n]) or None when the plane has more than
+        ``max_runs`` runs (the table cannot beat shipping raw)."""
+        cap = max(int(max_runs), 1)
+        ends = np.empty(cap, dtype=np.int32)
+        if plane.itemsize == 4:
+            vals = np.empty(cap, dtype=np.uint32)
+            base, stride = self._strided(plane, 4)
+            n = self._scan32(base, plane.size, stride,
+                             ends.ctypes.data, vals.ctypes.data, cap)
+        else:
+            vals = np.empty(cap, dtype=np.uint8)
+            base, stride = self._strided(plane, 1)
+            n = self._scan8(base, plane.size, stride,
+                            ends.ctypes.data, vals.ctypes.data, cap)
+        if n < 0:
+            return None
+        return ends[:n], vals[:n]
+
+    def gather(self, plane: np.ndarray) -> np.ndarray:
+        """Contiguous copy of a strided u32/u8 view (one pass)."""
+        out = np.empty(plane.size, dtype=plane.dtype)
+        if plane.itemsize == 4:
+            base, stride = self._strided(plane, 4)
+            self._gather32(base, plane.size, stride, out.ctypes.data)
+        else:
+            base, stride = self._strided(plane, 1)
+            self._gather8(base, plane.size, stride, out.ctypes.data)
+        return out
+
+
 _snappy_inst: "NativeSnappy | None" = None
 _hybrid_inst: "NativeHybrid | None" = None
+_PLANE_UNAVAILABLE = object()  # cached stale-.so miss (see plane_native)
+_plane_inst = None
 
 
 def snappy_native() -> NativeSnappy | None:
@@ -323,3 +392,24 @@ def hybrid_native() -> NativeHybrid | None:
     if _hybrid_inst is None:
         _hybrid_inst = NativeHybrid(lib)
     return _hybrid_inst
+
+
+def plane_native() -> NativePlane | None:
+    """The process-wide plane primitives, or None if unbuildable."""
+    global _plane_inst
+    if _plane_inst is not None:
+        return None if _plane_inst is _PLANE_UNAVAILABLE else _plane_inst
+    lib = _lib()
+    if lib is None:
+        return None
+    try:
+        _plane_inst = NativePlane(lib)
+    except RuntimeError:  # stale .so predating plane.c: cache the miss
+        _plane_inst = _PLANE_UNAVAILABLE
+        from ..stats import current_stats
+
+        st = current_stats()
+        if st is not None:
+            st.native_fallbacks += 1
+        return None
+    return _plane_inst
