@@ -282,3 +282,92 @@ class TestNativePlane:
         for a, b in zip(t1[:2], t2[:2]):
             assert np.array_equal(a, b)
         assert t1[2] == t2[2]
+
+
+class TestNativeDeltaScan:
+    """C block scanner vs the pure-Python structure pass."""
+
+    def _force_fallback(self, monkeypatch):
+        import tpuparquet.native as N
+
+        monkeypatch.setattr(N, "_delta_inst", N._DELTA_UNAVAILABLE)
+
+    def _scan_both(self, monkeypatch, data):
+        from tpuparquet.cpu.delta import scan_delta_structure
+
+        try:
+            a = scan_delta_structure(data)
+        except ValueError:
+            a = ("error", )
+        self._force_fallback(monkeypatch)
+        try:
+            b = scan_delta_structure(data)
+        except ValueError:
+            b = ("error", )
+        monkeypatch.undo()
+        return a, b
+
+    def test_parity_roundtrip_streams(self, monkeypatch):
+        from tpuparquet.cpu.delta import encode_delta_binary_packed
+        from tpuparquet.native import delta_native
+
+        if delta_native() is None:
+            pytest.skip("native delta scanner unavailable")
+        rng = np.random.default_rng(21)
+        streams = [
+            encode_delta_binary_packed(rng.integers(-50, 50, 1000)),
+            encode_delta_binary_packed(
+                np.int64(1 << 40) + rng.integers(0, 9, 4099).cumsum()),
+            encode_delta_binary_packed(np.array([7], dtype=np.int64)),
+            encode_delta_binary_packed(np.zeros(0, dtype=np.int64)),
+            encode_delta_binary_packed(
+                rng.integers(-(1 << 62), 1 << 62, 513)),
+        ]
+        for enc in streams:
+            a, b = self._scan_both(monkeypatch, np.frombuffer(enc, np.uint8))
+            assert a != ("error",) and b != ("error",)
+            assert np.array_equal(np.asarray(a.md_blocks, dtype=np.int64),
+                                  np.asarray(b.md_blocks, dtype=np.int64))
+            for f in ("mb_w", "mb_pos", "mb_start"):
+                assert np.array_equal(
+                    np.asarray(getattr(a, f), dtype=np.int64),
+                    np.asarray(getattr(b, f), dtype=np.int64)), f
+            assert (a.end_pos, a.total, a.first, a.block_size) == \
+                   (b.end_pos, b.total, b.first, b.block_size)
+
+    def test_parity_malformed(self, monkeypatch):
+        from tpuparquet.cpu.delta import encode_delta_binary_packed
+        from tpuparquet.native import delta_native
+
+        if delta_native() is None:
+            pytest.skip("native delta scanner unavailable")
+        rng = np.random.default_rng(22)
+        enc = bytearray(encode_delta_binary_packed(
+            rng.integers(-1000, 1000, 700)))
+        cases = [bytes(enc[:i]) for i in (0, 1, 3, 5, 9, len(enc) - 7)]
+        for i in range(4, len(enc), 37):
+            bad = bytearray(enc)
+            bad[i] ^= 0xFF
+            cases.append(bytes(bad))
+        for data in cases:
+            a, b = self._scan_both(monkeypatch, np.frombuffer(
+                data, dtype=np.uint8))
+            ea, eb = a == ("error",), b == ("error",)
+            assert ea == eb, f"native={'err' if ea else 'ok'} " \
+                             f"fallback={'err' if eb else 'ok'}"
+
+    def test_overlong_varint_rejected(self, monkeypatch):
+        """A >64-bit total/min_delta must raise ValueError on both
+        paths, not surface as OverflowError from np.asarray."""
+        from tpuparquet.cpu.delta import scan_delta_structure
+
+        # header: block_size=128, n_miniblocks=4, then an 11-byte
+        # uvarint total (> 2^70 continuation limit passes; value huge)
+        stream = bytes([128, 1, 4]) + b"\xff" * 10 + b"\x01"
+        for force in (False, True):
+            if force:
+                self._force_fallback(monkeypatch)
+            with pytest.raises(ValueError):
+                scan_delta_structure(np.frombuffer(stream, np.uint8))
+            if force:
+                monkeypatch.undo()

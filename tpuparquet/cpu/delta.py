@@ -69,7 +69,7 @@ class DeltaStructure:
         """Per-width (w, positions, starts, takes) with contiguity
         precomputed: yields ``(w, seg_slice_or_None, p_w, s_w, t_w,
         dest_contiguous)`` per distinct width."""
-        if not self.mb_w:
+        if len(self.mb_w) == 0:  # list (Python scan) or ndarray (native)
             return
         n_deltas = self.total - 1
         w_np = np.asarray(self.mb_w, dtype=np.int64)
@@ -105,8 +105,27 @@ def scan_delta_structure(data, pos: int = 0,
         raise ValueError(f"miniblock size {mb_size} not a multiple of 32")
     total, pos = read_uvarint(data, pos)
     first, pos = read_zigzag(data, pos)
+    # bound the header values to int64: Python varints are arbitrary
+    # precision, and an out-of-range total/first would otherwise surface
+    # later as an OverflowError from np.asarray instead of a clean
+    # malformed-input rejection
+    if (total >= 1 << 63 or block_size >= 1 << 31
+            or not -(1 << 63) <= first < 1 << 63):
+        raise ValueError("delta header value out of range")
     n_deltas = max(total - 1, 0)
     data_len = len(data)
+
+    from ..native import delta_native
+
+    nat = delta_native()
+    if nat is not None:
+        md_np, w_np, p_np, s_np, end = nat.scan_blocks(
+            data, pos, n_deltas, mb_size, n_miniblocks, max_width)
+        return DeltaStructure(
+            block_size=block_size, mb_size=mb_size, total=total,
+            first=first, md_blocks=md_np, mb_w=w_np, mb_pos=p_np,
+            mb_start=s_np, end_pos=end)
+
     md_blocks: list[int] = []
     mb_w: list[int] = []
     mb_pos: list[int] = []
@@ -114,6 +133,8 @@ def scan_delta_structure(data, pos: int = 0,
     got = 0
     while got < n_deltas:
         min_delta, pos = read_zigzag(data, pos)
+        if not -(1 << 63) <= min_delta < 1 << 63:
+            raise ValueError("delta header value out of range")
         md_blocks.append(min_delta)
         if pos + n_miniblocks > data_len:
             raise ValueError("truncated miniblock width list")

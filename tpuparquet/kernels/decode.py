@@ -383,18 +383,23 @@ def dict_gather_bytes(dict_offsets: jax.Array, dict_data: jax.Array,
 
 class DeltaPlan:
     __slots__ = (
-        # list of 7-tuples (width, words_np, positions_np, keep_np,
+        # list of 7-tuples (width, words, positions, keep,
         # n_vals, start, n_take); positions/keep are None for a
         # contiguous group, whose deltas land in the destination slice
         # [start, start + n_take) (the common single-width stream)
         "groups",
-        "min_deltas",    # per-delta min_delta contribution (host-expanded)
-        "first", "total",
+        # per-BLOCK min_delta as u32 (lo, hi) lanes — the device repeats
+        # them by block_size; shipping the per-delta expansion would be
+        # 8 wire bytes per value (more than the raw column)
+        "md_lo", "md_hi",
+        "block_size", "first", "total",
     )
 
-    def __init__(self, groups, min_deltas, first, total):
+    def __init__(self, groups, md_lo, md_hi, block_size, first, total):
         self.groups = groups
-        self.min_deltas = min_deltas
+        self.md_lo = md_lo
+        self.md_hi = md_hi
+        self.block_size = block_size
         self.first = first
         self.total = total
 
@@ -411,12 +416,12 @@ def _plan_delta(data, pos: int, max_width: int) -> DeltaPlan:
     from ..cpu.delta import scan_delta_structure
 
     st = scan_delta_structure(data, pos, max_width=max_width)
-    n_deltas = max(st.total - 1, 0)
     mb_size = st.mb_size
     buf = (data if isinstance(data, np.ndarray)
            else np.frombuffer(data, dtype=np.uint8))
-    min_deltas = np.repeat(np.asarray(st.md_blocks, dtype=np.int64),
-                           st.block_size)[:n_deltas]
+    md_u = np.asarray(st.md_blocks, dtype=np.int64).view(np.uint64)
+    md_lo = (md_u & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    md_hi = (md_u >> np.uint64(32)).astype(np.uint32)
     groups = []
     for w, src_contig, p_w, s_w, t_w, dst_contig in st.grouped():
         nbytes = mb_size * w // 8
@@ -441,11 +446,23 @@ def _plan_delta(data, pos: int, max_width: int) -> DeltaPlan:
             keep = (np.arange(n_vals, dtype=np.int32)
                     .reshape(k, mb_size))[keep_m]
             groups.append((w, words, positions, keep, n_vals, 0, 0))
-    return DeltaPlan(groups, min_deltas, st.first, st.total)
+    return DeltaPlan(groups, md_lo, md_hi, st.block_size, st.first,
+                     st.total)
 
 
 def plan_delta_i32(data, pos: int = 0) -> DeltaPlan:
     return _plan_delta(data, pos, 32)
+
+
+def _repeat_md(md_blocks, block_size: int, n_deltas: int) -> jax.Array:
+    """Per-delta min_delta lane from the per-BLOCK table (device-side
+    repeat — a (n_blocks, 1) broadcast, so only 4 bytes per 128-value
+    block ever cross the wire)."""
+    mdb = jnp.asarray(md_blocks)
+    n_blocks = mdb.shape[0]
+    return jnp.repeat(
+        mdb, block_size, total_repeat_length=n_blocks * block_size
+    )[:n_deltas]
 
 
 def expand_delta_i32(plan: DeltaPlan) -> jax.Array:
@@ -467,7 +484,7 @@ def expand_delta_i32(plan: DeltaPlan) -> jax.Array:
     first = jnp.asarray(np.uint32(plan.first & 0xFFFFFFFF))
     if n_deltas == 0:
         return first[None]
-    md = jnp.asarray((plan.min_deltas & 0xFFFFFFFF).astype(np.uint32))
+    md = _repeat_md(plan.md_lo, plan.block_size, n_deltas)
     full = deltas[:n_deltas] + md  # u32 wraparound == two's complement
     return jnp.concatenate([first[None], first + jnp.cumsum(full)])
 
@@ -533,9 +550,8 @@ def expand_delta_i64(plan: DeltaPlan) -> jax.Array:
             k = jnp.asarray(keep)
             dlo = dlo.at[p].set(lo[k])
             dhi = dhi.at[p].set(hi[k])
-    md_u = plan.min_deltas.view(np.uint64)
-    md_lo = jnp.asarray((md_u & np.uint64(0xFFFFFFFF)).astype(np.uint32))
-    md_hi = jnp.asarray((md_u >> np.uint64(32)).astype(np.uint32))
+    md_lo = _repeat_md(plan.md_lo, plan.block_size, n_deltas)
+    md_hi = _repeat_md(plan.md_hi, plan.block_size, n_deltas)
     flo, fhi = _add64((dlo, dhi), (md_lo, md_hi))
     slo = jnp.concatenate([first[:, 0], flo])
     shi = jnp.concatenate([first[:, 1], fhi])

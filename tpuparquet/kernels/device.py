@@ -345,6 +345,55 @@ def _plan_plane_words(seg, count: int, lanes: int, stager: "_Stager"):
     return words
 
 
+def _stage_delta_plan(plan, stager: "_Stager"):
+    """Route a DeltaPlan's device buffers through the batched stager
+    (wave-chunked transfer + bytes_staged accounting — these previously
+    shipped as implicit device_puts at dispatch, uncounted).
+
+    The packed width-class words ride the padded path (the build slices
+    them back to exact length before unpack's reshape); scatter
+    positions/keep and the per-block min_delta lanes ship exact —
+    padding would corrupt scatter targets and the repeat length."""
+    from .decode import DeltaPlan
+
+    specs = []
+    for w, words, positions, keep, n_vals, start, n_take in plan.groups:
+        wh = stager.add(words)
+        if positions is None:
+            specs.append((w, wh, words.size, None, None,
+                          n_vals, start, n_take))
+        else:
+            ph = stager.add(positions, pad=False)
+            kh = stager.add(keep, pad=False)
+            specs.append((w, wh, words.size, ph, kh, n_vals, 0, 0))
+    has_md = plan.md_lo.size > 0
+    lo_h = stager.add(plan.md_lo, pad=False) if has_md else None
+    hi_h = stager.add(plan.md_hi, pad=False) if has_md else None
+    # captured by value: holding the plan object itself would keep the
+    # just-staged host words/positions arrays alive through dispatch
+    empty_md = None if has_md else plan.md_lo
+    meta = (plan.block_size, plan.first, plan.total)
+
+    def build(s, _specs=tuple(specs), _lo=lo_h, _hi=hi_h,
+              _empty=empty_md, _meta=meta):
+        groups = []
+        for w, wh, nw, ph, kh, n_vals, start, n_take in _specs:
+            groups.append((
+                w, s[wh][:nw],
+                None if ph is None else s[ph],
+                None if kh is None else s[kh],
+                n_vals, start, n_take,
+            ))
+        return DeltaPlan(
+            groups,
+            _empty if _lo is None else s[_lo],
+            _empty if _hi is None else s[_hi],
+            *_meta,
+        )
+
+    return build
+
+
 def _plan_device_snappy_words(payload, expected_size: int, n_words: int,
                               stager: "_Stager", offset: int = 0):
     """Plan device-side snappy decompression of one values segment.
@@ -1463,19 +1512,21 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 Type.INT32, Type.INT64):
             _def_standalone()
             if ptype == Type.INT32:
-                plan = plan_delta_i32(values_seg)
+                build = _stage_delta_plan(
+                    plan_delta_i32(values_seg), stager)
                 ops.append(
-                    lambda s, p, _pl=plan, _nn=non_null:
+                    lambda s, p, _b=build, _nn=non_null:
                     p["val"].append(
-                        (expand_delta_i32(_pl)[:_nn], _nn)
+                        (expand_delta_i32(_b(s))[:_nn], _nn)
                     )
                 )
             else:
-                plan = plan_delta_i64(values_seg)
+                build = _stage_delta_plan(
+                    plan_delta_i64(values_seg), stager)
                 ops.append(
-                    lambda s, p, _pl=plan, _nn=non_null:
+                    lambda s, p, _b=build, _nn=non_null:
                     p["val"].append(
-                        (expand_delta_i64(_pl)[: _nn * 2], _nn)
+                        (expand_delta_i64(_b(s))[: _nn * 2], _nn)
                     )
                 )
         else:

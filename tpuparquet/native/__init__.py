@@ -19,11 +19,11 @@ import threading
 import numpy as np
 
 __all__ = ["snappy_native", "NativeSnappy", "hybrid_native", "NativeHybrid",
-           "plane_native", "NativePlane"]
+           "plane_native", "NativePlane", "delta_native", "NativeDelta"]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRCS = [os.path.join(_DIR, "snappy.c"), os.path.join(_DIR, "hybrid.c"),
-         os.path.join(_DIR, "plane.c")]
+         os.path.join(_DIR, "plane.c"), os.path.join(_DIR, "delta.c")]
 _SO = os.path.join(_DIR, "_tpq_native.so")
 
 _lock = threading.Lock()
@@ -366,10 +366,78 @@ class NativePlane:
         return out
 
 
+class NativeDelta:
+    """ctypes binding over the DELTA_BINARY_PACKED block scanner."""
+
+    _ERRORS = {
+        -1: "truncated uvarint",
+        -5: "truncated miniblock width list",
+        -7: "truncated miniblock payload",
+        -9: "uvarint too long",
+    }
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._scan = getattr(lib, "tpq_delta_scan_blocks", None)
+        if self._scan is None:
+            raise RuntimeError("native library too old; rebuild")
+        self._scan.restype = ctypes.c_longlong
+        self._scan.argtypes = [
+            ctypes.c_void_p, ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_longlong),
+        ]
+
+    def scan_blocks(self, data, pos: int, n_deltas: int, mb_size: int,
+                    n_miniblocks: int, max_width: int):
+        """Scan the block loop of a DELTA stream whose 4 header varints
+        the caller already consumed.  Returns (md_blocks, mb_w, mb_pos,
+        mb_start, end_pos) as numpy arrays / int; raises ValueError with
+        the CPU scanner's messages on malformed input."""
+        buf = _as_u8(data)
+        block_size = mb_size * n_miniblocks
+        # clamp by remaining bytes: each block consumes >= 1 byte of
+        # min_delta varint + n_miniblocks width bytes, so a corrupt
+        # total claiming 2^62 values must not size the allocation (the
+        # scan will hit its truncation error long before these caps)
+        max_blocks = max(buf.size - pos, 0) // (1 + n_miniblocks) + 2
+        cap_blocks = min(n_deltas // block_size + 2, max_blocks)
+        cap_mb = cap_blocks * n_miniblocks + 2
+        md = np.empty(cap_blocks, dtype=np.int64)
+        w = np.empty(cap_mb, dtype=np.int32)
+        p = np.empty(cap_mb, dtype=np.int64)
+        s = np.empty(cap_mb, dtype=np.int64)
+        nb = ctypes.c_longlong()
+        nm = ctypes.c_longlong()
+        end = ctypes.c_longlong()
+        rc = self._scan(
+            buf.ctypes.data, buf.size, pos,
+            n_deltas, mb_size, n_miniblocks, max_width,
+            md.ctypes.data, w.ctypes.data, p.ctypes.data, s.ctypes.data,
+            cap_blocks, cap_mb,
+            ctypes.byref(nb), ctypes.byref(nm), ctypes.byref(end),
+        )
+        if rc == -6:
+            raise ValueError(
+                f"delta miniblock width > {max_width} for this column's "
+                "physical type")
+        if rc != 0:
+            raise ValueError(self._ERRORS.get(
+                rc, f"delta scan failed (rc={rc})"))
+        b, m = int(nb.value), int(nm.value)
+        return md[:b], w[:m], p[:m], s[:m], int(end.value)
+
+
 _snappy_inst: "NativeSnappy | None" = None
 _hybrid_inst: "NativeHybrid | None" = None
 _PLANE_UNAVAILABLE = object()  # cached stale-.so miss (see plane_native)
 _plane_inst = None
+_DELTA_UNAVAILABLE = object()
+_delta_inst = None
 
 
 def snappy_native() -> NativeSnappy | None:
@@ -392,6 +460,27 @@ def hybrid_native() -> NativeHybrid | None:
     if _hybrid_inst is None:
         _hybrid_inst = NativeHybrid(lib)
     return _hybrid_inst
+
+
+def delta_native() -> NativeDelta | None:
+    """The process-wide delta block scanner, or None if unbuildable."""
+    global _delta_inst
+    if _delta_inst is not None:
+        return None if _delta_inst is _DELTA_UNAVAILABLE else _delta_inst
+    lib = _lib()
+    if lib is None:
+        return None
+    try:
+        _delta_inst = NativeDelta(lib)
+    except RuntimeError:  # stale .so predating delta.c: cache the miss
+        _delta_inst = _DELTA_UNAVAILABLE
+        from ..stats import current_stats
+
+        st = current_stats()
+        if st is not None:
+            st.native_fallbacks += 1
+        return None
+    return _delta_inst
 
 
 def plane_native() -> NativePlane | None:
