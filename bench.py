@@ -228,18 +228,28 @@ def time_cpu(reader) -> float:
     return best
 
 
-def time_device(reader) -> float:
+def time_device(reader):
+    """(best wall, {plan_s, transfer_s, dispatch_s, bytes_staged} of the
+    best rep) — the phase split says which side binds on the chip."""
     from tpuparquet.kernels.device import read_row_groups_device
+    from tpuparquet.stats import collect_stats
 
-    best = float("inf")
+    best, phases = float("inf"), {}
     for _ in range(DEV_REPS):
-        t0 = time.perf_counter()
-        outs = [out for _, out in read_row_groups_device(reader)]
-        for o in outs:
-            for c in o.values():
-                c.block_until_ready()
-        best = min(best, time.perf_counter() - t0)
-    return best
+        with collect_stats() as st:
+            t0 = time.perf_counter()
+            outs = [out for _, out in read_row_groups_device(reader)]
+            for o in outs:
+                for c in o.values():
+                    c.block_until_ready()
+            dt = time.perf_counter() - t0
+        if dt < best:
+            best = dt
+            phases = {"plan_s": round(st.plan_s, 3),
+                      "transfer_s": round(st.transfer_s, 3),
+                      "dispatch_s": round(st.dispatch_s, 3),
+                      "bytes_staged": st.bytes_staged}
+    return best, phases
 
 
 def _cpu_checksum(cd) -> dict:
@@ -401,8 +411,8 @@ def run_config(name: str, buf: io.BytesIO) -> dict:
     _progress(f"[{name}] cpu {cpu_s:.2f}s pyarrow {pa_s:.2f}s; "
               "timing device path")
     time_device(reader)  # compile warmup
-    dev_s = time_device(reader)
-    _progress(f"[{name}] device {dev_s:.2f}s; parity check")
+    dev_s, phases = time_device(reader)
+    _progress(f"[{name}] device {dev_s:.2f}s ({phases}); parity check")
     # Parity AFTER timing: the first device->host readback drops the
     # runtime into synchronous dispatch on the remote tunnel; the report
     # is still gated on it — a mismatch raises before printing.
@@ -415,6 +425,7 @@ def run_config(name: str, buf: io.BytesIO) -> dict:
         "device_vps": round(n_values / dev_s, 1),
         "vs_baseline": round(cpu_s / dev_s, 3),
         "vs_pyarrow": round(pa_s / dev_s, 3),
+        "device_phases": phases,
     }
 
 

@@ -46,13 +46,23 @@ class DecodeStats:
     # transfer time, split/padding included) — the transfer-wall
     # observable: compressed-wire shipping shows up as bytes_staged <
     # bytes_uncompressed.  A few fallback paths (CPU-decoded values,
-    # delta/FLBA/boolean staging inside finish()) transfer outside the
+    # FLBA/boolean staging inside finish()) transfer outside the
     # stager and are not counted here.
     bytes_staged: int = 0
     # slow-path executions that a healthy build would run natively (e.g.
     # a stale .so forcing the numpy bp-stats fallback): nonzero means
     # perf has quietly regressed with no functional symptom
     native_fallbacks: int = 0
+    # where the device-path wall went, accumulated per unit: host plan
+    # phase (page walk, decompression, run-table scans — overlapped with
+    # transfer by the pipelined reader, so plan_s can exceed the e2e
+    # wall), stager transfer (put(), blocking to completion), and
+    # dispatch+sync (finish ops + the batched block_until_ready).  On
+    # the real chip these tell which side binds: transfer_s ~ wall means
+    # the wire is the wall; plan_s ~ wall means the planner is.
+    plan_s: float = 0.0
+    transfer_s: float = 0.0
+    dispatch_s: float = 0.0
     wall_s: float = 0.0
     _t0: float = dataclasses.field(default=0.0, repr=False)
 
@@ -79,6 +89,9 @@ class DecodeStats:
             "bytes_uncompressed": self.bytes_uncompressed,
             "bytes_staged": self.bytes_staged,
             "native_fallbacks": self.native_fallbacks,
+            "plan_s": round(self.plan_s, 6),
+            "transfer_s": round(self.transfer_s, 6),
+            "dispatch_s": round(self.dispatch_s, 6),
             "wall_s": round(self.wall_s, 6),
             "values_per_sec": round(self.values_per_sec, 1),
             "compression_ratio": round(self.compression_ratio, 3),
@@ -94,6 +107,9 @@ class DecodeStats:
             f"{d['wall_s']:.4f}s = {d['values_per_sec']:,.0f} values/s"
             + (f"; staged {d['bytes_staged']:,}B to device"
                if d["bytes_staged"] else "")
+            + (f"; plan {d['plan_s']:.3f}s / transfer "
+               f"{d['transfer_s']:.3f}s / dispatch {d['dispatch_s']:.3f}s"
+               if d["transfer_s"] else "")
             + (f"; {d['native_fallbacks']} native fallbacks (stale .so?)"
                if d["native_fallbacks"] else "")
         )
