@@ -349,13 +349,15 @@ class TestColumnarAPI:
         with pytest.raises(ValueError, match="multiple leaves"):
             w.write_columns({"r": np.arange(3)}, offsets={"r": offs})
 
-    def test_write_columns_rejects_deep_nesting(self):
+    def test_write_columns_struct_needs_dotted_key(self):
+        # struct leaves are keyed by dotted flat name; the bare group
+        # name is not a column
         buf = io.BytesIO()
         w = FileWriter(
             buf,
             "message m { optional group o { optional int64 x; } }")
-        with pytest.raises(ValueError, match="add_data"):
-            w.write_columns({"o.x": np.arange(3)})
+        with pytest.raises(ValueError, match="missing column 'o.x'"):
+            w.write_columns({"o": np.arange(3)})
 
     def test_write_columns_list_roundtrip_matches_add_data(self):
         schema = ("message m { optional group tags (LIST) { "
@@ -713,3 +715,81 @@ class TestReviewRegressions:
         write_zigzag(out, 0)
         with pytest.raises(ValueError):
             plan_delta_i32(bytes(out))
+
+
+class TestColumnarStructs:
+    """write_columns with nested non-repeated groups: dotted leaf
+    columns + per-prefix masks produce the same file semantics as the
+    row-path shredder (``io/store.py``; reference ``schema.go:714-778``)."""
+
+    SCHEMA = ("message m { required int64 id; optional group loc { "
+              "required double lat; optional double lon; optional group "
+              "tag { optional binary name (STRING); } } }")
+
+    ROWS = [
+        {"id": 1, "loc": {"lat": 1.5, "lon": 2.5,
+                          "tag": {"name": b"a"}}},
+        {"id": 2, "loc": None},
+        {"id": 3, "loc": {"lat": 3.0, "lon": None, "tag": None}},
+        {"id": 4, "loc": {"lat": 4.0, "lon": 4.5,
+                          "tag": {"name": None}}},
+    ]
+
+    def _columnar(self):
+        buf = io.BytesIO()
+        w = FileWriter(buf, self.SCHEMA)
+        w.write_columns(
+            {"id": np.array([1, 2, 3, 4], dtype=np.int64),
+             "loc.lat": np.array([1.5, 3.0, 4.0]),
+             "loc.lon": np.array([2.5, 4.5]),
+             "loc.tag.name": [b"a"]},
+            masks={"loc": np.array([True, False, True, True]),
+                   "loc.lon": np.array([True, False, False, True]),
+                   "loc.tag": np.array([True, False, False, True]),
+                   "loc.tag.name": np.array(
+                       [True, False, False, False])})
+        w.close()
+        buf.seek(0)
+        return buf
+
+    def test_matches_row_path(self):
+        b1 = io.BytesIO()
+        w = FileWriter(b1, self.SCHEMA)
+        for r in self.ROWS:
+            w.add_data(r)
+        w.close()
+        b1.seek(0)
+        rows1 = list(FileReader(b1).rows())
+        rows2 = list(FileReader(self._columnar()).rows())
+        assert rows1 == rows2
+
+    def test_def_levels_exact(self):
+        arrays = FileReader(self._columnar()).read_row_group_arrays(0)
+        np.testing.assert_array_equal(
+            arrays["loc.lat"].def_levels, [1, 0, 1, 1])
+        np.testing.assert_array_equal(
+            arrays["loc.lon"].def_levels, [2, 0, 1, 2])
+        np.testing.assert_array_equal(
+            arrays["loc.tag.name"].def_levels, [3, 0, 1, 2])
+
+    def test_validation(self):
+        w = FileWriter(io.BytesIO(), self.SCHEMA)
+        with pytest.raises(ValueError, match="missing column"):
+            w.write_columns({"id": np.array([1], dtype=np.int64)})
+        w = FileWriter(io.BytesIO(), self.SCHEMA)
+        with pytest.raises(ValueError, match="present rows"):
+            w.write_columns(
+                {"id": np.array([1], dtype=np.int64),
+                 "loc.lat": np.array([1.0, 2.0]),
+                 "loc.lon": np.array([]),
+                 "loc.tag.name": []},
+                masks={"loc": np.array([True])})
+        # a mask on a required nested leaf is rejected
+        w = FileWriter(io.BytesIO(), self.SCHEMA)
+        with pytest.raises(ValueError, match="not allowed"):
+            w.write_columns(
+                {"id": np.array([1], dtype=np.int64),
+                 "loc.lat": np.array([1.0]),
+                 "loc.lon": np.array([1.0]),
+                 "loc.tag.name": [b"x"]},
+                masks={"loc.lat": np.array([True])})

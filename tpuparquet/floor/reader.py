@@ -57,9 +57,10 @@ class Reader:
         return from_row(self._row, cls, self._fr.schema)
 
     def read_columns(self, rg_index: int, cls=None) -> list:
-        """Bulk-materialize one row group's objects for a FLAT schema:
-        columnar decode + per-leaf conversion, no per-row record
-        assembly.  Same objects as iterating that row group."""
+        """Bulk-materialize one row group's objects: columnar decode +
+        per-leaf conversion, no per-row record assembly.  Flat, STRUCT
+        (nested dataclass), and list-of-primitive fields; same objects
+        as iterating that row group."""
         from .reflect import objects_from_columns
 
         cls = cls or self._cls

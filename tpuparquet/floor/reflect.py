@@ -227,22 +227,28 @@ def objects_to_columns(objs, schema):
     applying the SAME leaf conversions as :func:`to_row`
     (strings, date/time/timestamp units, UUID) — decoded contents are
     identical to the row path; the columnar call writes one row group.
-    Flat leaves and LIST-of-primitive columns (bare repeated leaves,
+    Flat leaves, STRUCT columns (nested dataclasses/mappings over
+    non-repeated groups, emitted as dotted leaf columns + per-group
+    masks), and LIST-of-primitive columns (bare repeated leaves,
     2-level legacy, canonical 3-level — the shapes the reference's
     reflection shreds at ``floor/writer.go:241-294``) are supported;
-    other nesting (structs, maps, multi-leaf groups) raises — use
+    maps and multi-leaf repeated groups raise — use
     ``Writer.write``/``write_many`` for those."""
     leaves = schema.leaves
     list_tops = {}
+    struct_leaves = set()
     for leaf in leaves:
         if len(leaf.path) == 1 and not leaf.max_rep_level:
+            continue
+        if not leaf.max_rep_level:
+            struct_leaves.add(leaf)  # nested non-repeated groups
             continue
         top = _bulk_list_leaf(schema, leaf)
         if top is None:
             raise ValueError(
-                f"objects_to_columns supports flat schemas and "
-                f"LIST-of-primitive columns only; {leaf.flat_name!r} "
-                f"is nested (use write/write_many)")
+                f"objects_to_columns supports flat schemas, STRUCT "
+                f"columns, and LIST-of-primitive columns; "
+                f"{leaf.flat_name!r} is nested (use write/write_many)")
         list_tops[leaf] = top
     objs = list(objs)
     # per-class parquet-name -> attribute map, computed once (the row
@@ -270,6 +276,25 @@ def objects_to_columns(objs, schema):
     masks: dict = {}
     offsets: dict = {}
     element_masks: dict = {}
+    # resolved sub-objects per group prefix, shared across the group's
+    # leaves so sibling columns see one traversal (and one mask)
+    prefix_objs: dict = {}
+
+    def resolve(parts):
+        key = ".".join(parts)
+        cached = prefix_objs.get(key)
+        if cached is not None:
+            return cached
+        if len(parts) == 1:
+            vals = [getter(o, parts[0]) for o in objs]
+        else:
+            parent = resolve(parts[:-1])
+            name = parts[-1]
+            vals = [None if p is None else getter(p, name)
+                    for p in parent]
+        prefix_objs[key] = vals
+        return vals
+
     for leaf in leaves:
         top = list_tops.get(leaf)
         if top is not None:
@@ -312,6 +337,50 @@ def objects_to_columns(objs, schema):
             if not all(emask):
                 element_masks[name] = _np.asarray(emask, dtype=bool)
             continue
+        if leaf in struct_leaves:
+            chain = []
+            node = leaf
+            while node is not None and node.parent is not None:
+                chain.append(node)
+                node = node.parent
+            chain.reverse()
+            # group prefix masks (optional groups only — a required
+            # group that is None under a present parent is an error,
+            # matching the row-path shredder)
+            for depth in range(1, len(chain)):
+                gnode = chain[depth - 1]
+                parts = [n.name for n in chain[:depth]]
+                key = ".".join(parts)
+                vals_g = resolve(parts)
+                parent_vals = resolve(parts[:-1]) if depth > 1 else None
+                if gnode.is_required:
+                    for i, v in enumerate(vals_g):
+                        if v is None and (parent_vals is None
+                                          or parent_vals[i] is not None):
+                            raise ValueError(
+                                f"group {key!r} is required but object "
+                                f"{i} has no value")
+                elif key not in masks:
+                    masks[key] = _np.fromiter(
+                        (v is not None for v in vals_g), dtype=bool,
+                        count=len(vals_g))
+            parent_vals = resolve([n.name for n in chain[:-1]])
+            vals = []
+            lmask = _np.ones(len(objs), dtype=bool)
+            for i, p in enumerate(parent_vals):
+                v = None if p is None else getter(p, leaf.name)
+                if v is None:
+                    if p is not None and leaf.is_required:
+                        raise ValueError(
+                            f"column {leaf.flat_name!r} is required but "
+                            f"object {i} has no value")
+                    lmask[i] = False
+                else:
+                    vals.append(_encode_leaf(v, leaf))
+            columns[leaf.flat_name] = vals
+            if not leaf.is_required:
+                masks[leaf.flat_name] = lmask
+            continue
         name = leaf.name
         vals = []
         mask = None
@@ -336,30 +405,47 @@ def objects_to_columns(objs, schema):
 def objects_from_columns(columns, cls, schema, n_rows=None) -> list:
     """Bulk inverse of :func:`objects_to_columns`: the
     ``{name: ChunkData}`` output of ``FileReader.read_row_group_arrays``
-    -> ``list[cls]``, flat schemas only, with the same leaf conversions
-    as :func:`from_row` (strings, date/time/timestamp units, UUID) —
-    but no per-row record assembly.  ``n_rows`` is required when no
-    dataclass field matches a file column (there is then no column to
-    infer the row count from)."""
-    from ..io.values import handler_for
-
+    -> ``list[cls]``, with the same leaf conversions as
+    :func:`from_row` (strings, date/time/timestamp units, UUID) —
+    but no per-row record assembly.  Flat, STRUCT (nested dataclass
+    fields), and LIST-of-primitive columns are supported.  ``n_rows``
+    is required when no dataclass field matches a file column (there
+    is then no column to infer the row count from)."""
     if not dataclasses.is_dataclass(cls):
         raise TypeError(f"{cls!r} is not a dataclass")
     list_leaves = {}
+    struct_tops = set()
     for leaf in schema.leaves:
         if len(leaf.path) == 1 and not leaf.max_rep_level:
+            continue
+        if not leaf.max_rep_level:
+            struct_tops.add(leaf.path[0])
             continue
         top = _bulk_list_leaf(schema, leaf)
         if top is None:
             raise ValueError(
-                f"objects_from_columns supports flat schemas and "
-                f"LIST-of-primitive columns only; {leaf.flat_name!r} "
-                f"is nested (use iteration/scan)")
+                f"objects_from_columns supports flat schemas, STRUCT "
+                f"columns, and LIST-of-primitive columns; "
+                f"{leaf.flat_name!r} is nested (use iteration/scan)")
         list_leaves[top.name] = leaf
     field_cols: list = []
     for f, hint in _dc_fields(cls):
         name = field_name(f)
         node = _child_named(schema.root, name)
+        if node is not None and name in struct_tops:
+            hint_u = _unwrap_optional(hint)[0] if hint is not None else None
+            out = _structs_from_chunks(columns, node, hint_u)
+            if out is None:
+                field_cols.append((f.name, None))
+                continue
+            if n_rows is None:
+                n_rows = len(out)
+            elif n_rows != len(out):
+                raise ValueError(
+                    f"column {name!r} has {len(out)} rows, "
+                    f"expected {n_rows}")
+            field_cols.append((f.name, out))
+            continue
         if node is not None and name in list_leaves:
             leaf = list_leaves[name]
             cd = columns.get(leaf.flat_name)
@@ -384,33 +470,97 @@ def objects_from_columns(columns, cls, schema, n_rows=None) -> list:
             field_cols.append((f.name, None))
             continue
         cd = columns[name]
+        hint_u = _unwrap_optional(hint)[0] if hint is not None else None
         # the row path's materialization (io/store.py): unsigned
         # re-views, FLBA/INT96 -> bytes, np scalars -> Python values
-        vals = handler_for(node.element).to_pylist(cd.values)
-        # one C-level conversion: iterating the np array would box an
-        # np.int32 per row in this bulk path
-        dl = cd.def_levels.tolist()
+        out = _leaf_col_from_chunk(cd, node, hint_u)
         if n_rows is None:
-            n_rows = len(dl)
-        elif n_rows != len(dl):
+            n_rows = len(out)
+        elif n_rows != len(out):
             raise ValueError(
-                f"column {name!r} has {len(dl)} rows, expected {n_rows}")
-        hint_u = _unwrap_optional(hint)[0] if hint is not None else None
-        md = node.max_def_level
-        out = []
-        k = 0
-        for lvl in dl:
-            if md and lvl != md:
-                out.append(None)
-            else:
-                out.append(_decode_leaf(vals[k], node, hint_u))
-                k += 1
+                f"column {name!r} has {len(out)} rows, expected {n_rows}")
         field_cols.append((f.name, out))
     n_rows = n_rows or 0
     return [
         cls(**{attr: (col[i] if col is not None else None)
                for attr, col in field_cols})
         for i in range(n_rows)
+    ]
+
+
+def _leaf_col_from_chunk(cd, node: SchemaNode, hint) -> list:
+    """Per-row Python values (None for nulls) from one non-repeated
+    leaf's ChunkData, with the row path's leaf conversions."""
+    from ..io.values import handler_for
+
+    vals = handler_for(node.element).to_pylist(cd.values)
+    # one C-level conversion: iterating the np array would box an
+    # np.int32 per row in this bulk path
+    dl = cd.def_levels.tolist()
+    md = node.max_def_level
+    out = []
+    k = 0
+    for lvl in dl:
+        if md and lvl != md:
+            out.append(None)
+        else:
+            out.append(_decode_leaf(vals[k], node, hint))
+            k += 1
+    return out
+
+
+def _structs_from_chunks(columns, node: SchemaNode, hint):
+    """Reconstruct per-row nested objects for one STRUCT subtree from
+    leaf ChunkData — presence at each group level comes from the def
+    levels the row path would walk one record at a time.  Returns
+    ``list[instance | None]``, or None when projection dropped every
+    leaf of the subtree."""
+    if hint is None or not dataclasses.is_dataclass(hint):
+        raise ValueError(
+            f"STRUCT column {node.name!r} needs a dataclass field type "
+            "in the bulk path (use iteration/scan for dict rows)")
+    import numpy as _np
+
+    cd0 = None
+    stack = [node]
+    while stack and cd0 is None:
+        c = stack.pop()
+        if c.is_leaf:
+            cd0 = columns.get(c.flat_name)
+        else:
+            stack.extend(c.children)
+    if cd0 is None:
+        return None
+    gd = node.max_def_level
+    dl0 = _np.asarray(cd0.def_levels)
+    n = len(dl0)
+    present = (dl0 >= gd) if gd else _np.ones(n, dtype=bool)
+    child_cols: list = []
+    for f, h in _dc_fields(hint):
+        child = _child_named(node, field_name(f))
+        if child is None:
+            child_cols.append((f.name, None))
+            continue
+        h_u = _unwrap_optional(h)[0] if h is not None else None
+        if child.is_leaf and not child.is_repeated:
+            cd = columns.get(child.flat_name)
+            child_cols.append(
+                (f.name,
+                 None if cd is None
+                 else _leaf_col_from_chunk(cd, child, h_u)))
+        elif (not child.is_leaf and not child.is_repeated
+              and not _is_list_group(child) and not _is_map_group(child)):
+            child_cols.append(
+                (f.name, _structs_from_chunks(columns, child, h_u)))
+        else:
+            raise ValueError(
+                f"{child.flat_name!r}: lists/maps inside STRUCT columns "
+                "are not supported by the bulk path (use iteration/scan)")
+    return [
+        hint(**{attr: (col[i] if col is not None else None)
+                for attr, col in child_cols})
+        if present[i] else None
+        for i in range(n)
     ]
 
 

@@ -167,6 +167,12 @@ class FileWriter:
         for optional elements.  Rep/def levels are derived exactly as
         the row path's shredder would (``io/store.py``; reference
         semantics ``schema.go:733-778``).
+
+        Nested STRUCT leaves (non-repeated groups on the path): key by
+        the dotted flat name (``"a.b"``), pass non-null values only;
+        ``masks`` entries on group prefixes (``"a"``) mark rows where
+        that whole group is null.  Multi-leaf repeated groups and MAPs
+        stay on the row path (``add_data``).
         """
         if self._closed:
             raise ValueError("writer is closed")
@@ -206,9 +212,13 @@ class FileWriter:
                 )
                 reps[leaf.flat_name] = rep
             elif len(leaf.path) != 1:
-                raise ValueError(
-                    "write_columns supports flat and single-repeated-"
-                    "level columns; use add_data for general nesting"
+                # nested struct leaf (non-repeated groups on the path):
+                # keyed by dotted flat name, null ancestors marked by
+                # masks on the group prefixes ("a", "a.b", ...)
+                if leaf.flat_name not in columns:
+                    raise ValueError(f"missing column {leaf.flat_name!r}")
+                vals, dl, rows = self._prepare_struct(
+                    leaf, columns[leaf.flat_name], masks or {}
                 )
             else:
                 if leaf.name not in columns:
@@ -259,6 +269,63 @@ class FileWriter:
             else:
                 dl = np.zeros(rows, dtype=np.int32)
         return vals, dl, rows
+
+    def _prepare_struct(self, leaf, vals, masks):
+        """Nested non-repeated leaf -> (values, def levels, n_rows).
+
+        Def levels are derived outermost-ancestor-first: a row absent at
+        group ``a`` stays at ``a``'s parent definition level, exactly as
+        the row-path shredder would record a None group
+        (``io/store.py``; reference ``schema.go:714-732``).  Masks are
+        keyed by dotted prefix (``"a"``, ``"a.b"``, leaf flat name);
+        ``columns`` carries only the fully-present values."""
+        handler = handler_for(leaf.element)
+        if isinstance(vals, list):
+            vals = handler.finalize([handler.coerce_one(v) for v in vals])
+        else:
+            vals = handler.validate_array(vals)
+        chain = []
+        node = leaf
+        while node is not None and node.parent is not None:
+            chain.append(node)
+            node = node.parent
+        chain.reverse()
+        prefixes = [".".join(n.name for n in chain[: i + 1])
+                    for i in range(len(chain))]
+        # row count: the first mask on the path knows it; an all-present
+        # column falls back to the value count
+        n_rows = None
+        for pref in prefixes:
+            m = masks.get(pref)
+            if m is not None:
+                n_rows = len(np.asarray(m))
+                break
+        if n_rows is None:
+            n_rows = _column_len(vals)
+        present = np.ones(n_rows, dtype=bool)
+        dl = np.zeros(n_rows, dtype=np.int32)
+        for node, pref in zip(chain, prefixes):
+            m = masks.get(pref)
+            if node.is_required:
+                if m is not None:
+                    raise ValueError(
+                        f"{pref!r} is required; a validity mask is not "
+                        "allowed")
+                continue
+            if m is not None:
+                m = np.asarray(m, dtype=bool)
+                if m.size != n_rows:
+                    raise ValueError(
+                        f"mask {pref!r}: {m.size} entries vs {n_rows} "
+                        "rows")
+                present &= m
+            dl[present] = node.max_def_level
+        nn = int(present.sum())
+        if _column_len(vals) != nn:
+            raise ValueError(
+                f"column {leaf.flat_name!r}: {_column_len(vals)} values "
+                f"vs {nn} present rows (pass only non-null values)")
+        return vals, dl, n_rows
 
     def _prepare_repeated(self, leaf, vals, offs, row_mask, elem_mask):
         """Offsets-based LIST column -> (values, rep, def, n_rows)."""

@@ -26,6 +26,7 @@ Device coverage — every value encoding the format defines:
 from __future__ import annotations
 
 import os
+import time
 
 import numpy as np
 
@@ -345,7 +346,7 @@ def _plan_plane_words(seg, count: int, lanes: int, stager: "_Stager"):
     return words
 
 
-def _stage_delta_plan(plan, stager: "_Stager"):
+def _stage_delta_plan(plan, stager: "_Stager", need_hi: bool):
     """Route a DeltaPlan's device buffers through the batched stager
     (wave-chunked transfer + bytes_staged accounting — these previously
     shipped as implicit device_puts at dispatch, uncounted).
@@ -353,7 +354,9 @@ def _stage_delta_plan(plan, stager: "_Stager"):
     The packed width-class words ride the padded path (the build slices
     them back to exact length before unpack's reshape); scatter
     positions/keep and the per-block min_delta lanes ship exact —
-    padding would corrupt scatter targets and the repeat length."""
+    padding would corrupt scatter targets and the repeat length.
+    ``need_hi`` is False for i32 plans: ``expand_delta_i32`` never
+    reads the hi lane, so it stays host-side."""
     from .decode import DeltaPlan
 
     specs = []
@@ -368,14 +371,16 @@ def _stage_delta_plan(plan, stager: "_Stager"):
             specs.append((w, wh, words.size, ph, kh, n_vals, 0, 0))
     has_md = plan.md_lo.size > 0
     lo_h = stager.add(plan.md_lo, pad=False) if has_md else None
-    hi_h = stager.add(plan.md_hi, pad=False) if has_md else None
+    hi_h = stager.add(plan.md_hi, pad=False) if has_md and need_hi \
+        else None
     # captured by value: holding the plan object itself would keep the
     # just-staged host words/positions arrays alive through dispatch
-    empty_md = None if has_md else plan.md_lo
+    lo_host = None if has_md else plan.md_lo
+    hi_host = plan.md_hi if hi_h is None else None
     meta = (plan.block_size, plan.first, plan.total)
 
     def build(s, _specs=tuple(specs), _lo=lo_h, _hi=hi_h,
-              _empty=empty_md, _meta=meta):
+              _lo_host=lo_host, _hi_host=hi_host, _meta=meta):
         groups = []
         for w, wh, nw, ph, kh, n_vals, start, n_take in _specs:
             groups.append((
@@ -386,8 +391,8 @@ def _stage_delta_plan(plan, stager: "_Stager"):
             ))
         return DeltaPlan(
             groups,
-            _empty if _lo is None else s[_lo],
-            _empty if _hi is None else s[_hi],
+            _lo_host if _lo is None else s[_lo],
+            _hi_host if _hi is None else s[_hi],
             *_meta,
         )
 
@@ -1513,7 +1518,7 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
             _def_standalone()
             if ptype == Type.INT32:
                 build = _stage_delta_plan(
-                    plan_delta_i32(values_seg), stager)
+                    plan_delta_i32(values_seg), stager, need_hi=False)
                 ops.append(
                     lambda s, p, _b=build, _nn=non_null:
                     p["val"].append(
@@ -1522,7 +1527,7 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 )
             else:
                 build = _stage_delta_plan(
-                    plan_delta_i64(values_seg), stager)
+                    plan_delta_i64(values_seg), stager, need_hi=True)
                 ops.append(
                     lambda s, p, _b=build, _nn=non_null:
                     p["val"].append(
@@ -1672,6 +1677,9 @@ def read_row_group_device(reader, rg_index: int) -> dict[str, DeviceColumn]:
 
 def _plan_row_group(reader, rg, stager: _Stager, arena: HostArena):
     """Host phase shared by the per-row-group and pipelined readers."""
+    from ..stats import current_stats
+
+    t0 = time.perf_counter()
     planned = []
     for path, node, cm, blob, start in reader.iter_selected_chunks(rg):
         planned.append(
@@ -1679,11 +1687,18 @@ def _plan_row_group(reader, rg, stager: _Stager, arena: HostArena):
              plan_chunk_device(memoryview(blob), cm, node, start, stager,
                                arena))
         )
+    _cs = current_stats()
+    if _cs is not None:
+        _cs.plan_s += time.perf_counter() - t0
     return planned
 
 
 def _finish_row_group(planned, st: _Stager):
+    from ..stats import current_stats
+
+    t0 = time.perf_counter()
     staged = st.put()
+    t1 = time.perf_counter()
     out = {path: finish(staged) for path, finish in planned}
     # Drain the dispatched kernels before returning: on the
     # remote-attached TPU, letting async work pile up degrades every
@@ -1696,6 +1711,11 @@ def _finish_row_group(planned, st: _Stager):
     # x 6 buffers cost ~0.6s — the entire e2e-vs-internals gap).
     jax.block_until_ready(
         [x for c in out.values() for x in c._buffers()])
+    _cs = current_stats()
+    if _cs is not None:
+        t2 = time.perf_counter()
+        _cs.transfer_s += t1 - t0
+        _cs.dispatch_s += t2 - t1
     return out
 
 

@@ -406,7 +406,11 @@ class NativeDelta:
         # scan will hit its truncation error long before these caps)
         max_blocks = max(buf.size - pos, 0) // (1 + n_miniblocks) + 2
         cap_blocks = min(n_deltas // block_size + 2, max_blocks)
-        cap_mb = cap_blocks * n_miniblocks + 2
+        # likewise for recorded miniblocks: each non-zero-width one
+        # consumes >= mb_size/8 payload bytes, so a corrupt header with
+        # a huge n_miniblocks cannot size a multi-GB table either
+        max_mb = max(buf.size - pos, 0) // max(mb_size // 8, 1) + 2
+        cap_mb = min(cap_blocks * n_miniblocks + 2, max_mb)
         md = np.empty(cap_blocks, dtype=np.int64)
         w = np.empty(cap_mb, dtype=np.int32)
         p = np.empty(cap_mb, dtype=np.int64)
