@@ -383,10 +383,12 @@ def dict_gather_bytes(dict_offsets: jax.Array, dict_data: jax.Array,
 
 class DeltaPlan:
     __slots__ = (
-        # list of 7-tuples (width, words, positions, keep,
-        # n_vals, start, n_take); positions/keep are None for a
+        # list of 7-tuples (width, words, starts, takes,
+        # n_vals, start, n_take); starts/takes are None for a
         # contiguous group, whose deltas land in the destination slice
-        # [start, start + n_take) (the common single-width stream)
+        # [start, start + n_take) (the common single-width stream) —
+        # otherwise per-MINIBLOCK scatter starts/take counts that the
+        # device expands into the per-value grid (_scatter_grid)
         "groups",
         # per-BLOCK min_delta as u32 (lo, hi) lanes — the device repeats
         # them by block_size; shipping the per-delta expansion would be
@@ -440,18 +442,34 @@ def _plan_delta(data, pos: int, max_width: int) -> DeltaPlan:
             groups.append((w, words, None, None, n_vals,
                            int(s_w[0]), int(t_w.sum())))
         else:
-            lane = np.arange(mb_size, dtype=np.int32)[None, :]
-            keep_m = lane < t_w[:, None]
-            positions = (s_w[:, None].astype(np.int32) + lane)[keep_m]
-            keep = (np.arange(n_vals, dtype=np.int32)
-                    .reshape(k, mb_size))[keep_m]
-            groups.append((w, words, positions, keep, n_vals, 0, 0))
+            # scattered destinations ship per-MINIBLOCK starts/takes
+            # (8 bytes each); the device rebuilds the per-value scatter
+            # grid — per-value position arrays would cost more wire
+            # than the packed deltas themselves
+            groups.append((w, words, s_w.astype(np.int32),
+                           t_w.astype(np.int32), n_vals, 0, 0))
     return DeltaPlan(groups, md_lo, md_hi, st.block_size, st.first,
                      st.total)
 
 
 def plan_delta_i32(data, pos: int = 0) -> DeltaPlan:
     return _plan_delta(data, pos, 32)
+
+
+def _scatter_grid(starts, takes, n_vals: int, out_len: int) -> jax.Array:
+    """Per-value scatter targets for a width class with non-contiguous
+    miniblock destinations, built ON DEVICE from per-miniblock starts
+    and take counts (the wire carries 8 bytes per miniblock, not per
+    value).  Positions past a miniblock's take count map out of bounds,
+    which ``.at[].set(mode="drop")`` discards."""
+    starts = jnp.asarray(starts)
+    takes = jnp.asarray(takes)
+    k = starts.shape[0]
+    mb = n_vals // max(k, 1)
+    lane = jnp.arange(mb, dtype=jnp.int32)[None, :]
+    pos = starts[:, None] + lane
+    pos = jnp.where(lane < takes[:, None], pos, out_len)
+    return pos.reshape(-1)
 
 
 def _repeat_md(md_blocks, block_size: int, n_deltas: int) -> jax.Array:
@@ -470,15 +488,14 @@ def expand_delta_i32(plan: DeltaPlan) -> jax.Array:
     min_delta, prefix-sum (int32 two's-complement wrap)."""
     n_deltas = max(plan.total - 1, 0)
     deltas = jnp.zeros((max(n_deltas, 1),), dtype=jnp.uint32)
-    for w, words, positions, keep, n_vals, start, n_take in plan.groups:
+    for w, words, starts, takes, n_vals, start, n_take in plan.groups:
         vals = unpack_u32(jnp.asarray(words), w, n_vals)
-        if positions is None:  # contiguous destination slice
+        if starts is None:  # contiguous destination slice
             deltas = jax.lax.dynamic_update_slice(
                 deltas, vals[:n_take], (start,))
         else:
-            deltas = deltas.at[jnp.asarray(positions)].set(
-                vals[jnp.asarray(keep)]
-            )
+            pos = _scatter_grid(starts, takes, n_vals, deltas.shape[0])
+            deltas = deltas.at[pos].set(vals[:n_vals], mode="drop")
     if plan.total == 0:
         return jnp.zeros((0,), dtype=jnp.uint32)
     first = jnp.asarray(np.uint32(plan.first & 0xFFFFFFFF))
@@ -540,16 +557,15 @@ def expand_delta_i64(plan: DeltaPlan) -> jax.Array:
         return first.reshape(-1)
     dlo = jnp.zeros((n_deltas,), dtype=jnp.uint32)
     dhi = jnp.zeros((n_deltas,), dtype=jnp.uint32)
-    for w, words, positions, keep, n_vals, start, n_take in plan.groups:
+    for w, words, starts, takes, n_vals, start, n_take in plan.groups:
         lo, hi = unpack_u64(jnp.asarray(words), w, n_vals)
-        if positions is None:  # contiguous destination slice
+        if starts is None:  # contiguous destination slice
             dlo = jax.lax.dynamic_update_slice(dlo, lo[:n_take], (start,))
             dhi = jax.lax.dynamic_update_slice(dhi, hi[:n_take], (start,))
         else:
-            p = jnp.asarray(positions)
-            k = jnp.asarray(keep)
-            dlo = dlo.at[p].set(lo[k])
-            dhi = dhi.at[p].set(hi[k])
+            pos = _scatter_grid(starts, takes, n_vals, n_deltas)
+            dlo = dlo.at[pos].set(lo[:n_vals], mode="drop")
+            dhi = dhi.at[pos].set(hi[:n_vals], mode="drop")
     md_lo = _repeat_md(plan.md_lo, plan.block_size, n_deltas)
     md_hi = _repeat_md(plan.md_hi, plan.block_size, n_deltas)
     flo, fhi = _add64((dlo, dhi), (md_lo, md_hi))

@@ -352,29 +352,29 @@ def _stage_delta_plan(plan, stager: "_Stager", need_hi: bool):
     shipped as implicit device_puts at dispatch, uncounted).
 
     The packed width-class words ride the padded path (the build slices
-    them back to exact length before unpack's reshape); scatter
-    positions/keep and the per-block min_delta lanes ship exact —
+    them back to exact length before unpack's reshape); per-miniblock
+    scatter starts/takes and the per-block min_delta lanes ship exact —
     padding would corrupt scatter targets and the repeat length.
     ``need_hi`` is False for i32 plans: ``expand_delta_i32`` never
     reads the hi lane, so it stays host-side."""
     from .decode import DeltaPlan
 
     specs = []
-    for w, words, positions, keep, n_vals, start, n_take in plan.groups:
+    for w, words, starts, takes, n_vals, start, n_take in plan.groups:
         wh = stager.add(words)
-        if positions is None:
+        if starts is None:
             specs.append((w, wh, words.size, None, None,
                           n_vals, start, n_take))
         else:
-            ph = stager.add(positions, pad=False)
-            kh = stager.add(keep, pad=False)
-            specs.append((w, wh, words.size, ph, kh, n_vals, 0, 0))
+            sh = stager.add(starts, pad=False)
+            th = stager.add(takes, pad=False)
+            specs.append((w, wh, words.size, sh, th, n_vals, 0, 0))
     has_md = plan.md_lo.size > 0
     lo_h = stager.add(plan.md_lo, pad=False) if has_md else None
     hi_h = stager.add(plan.md_hi, pad=False) if has_md and need_hi \
         else None
     # captured by value: holding the plan object itself would keep the
-    # just-staged host words/positions arrays alive through dispatch
+    # just-staged host words/starts/takes arrays alive through dispatch
     lo_host = None if has_md else plan.md_lo
     hi_host = plan.md_hi if hi_h is None else None
     meta = (plan.block_size, plan.first, plan.total)
@@ -382,11 +382,11 @@ def _stage_delta_plan(plan, stager: "_Stager", need_hi: bool):
     def build(s, _specs=tuple(specs), _lo=lo_h, _hi=hi_h,
               _lo_host=lo_host, _hi_host=hi_host, _meta=meta):
         groups = []
-        for w, wh, nw, ph, kh, n_vals, start, n_take in _specs:
+        for w, wh, nw, sh, th, n_vals, start, n_take in _specs:
             groups.append((
                 w, s[wh][:nw],
-                None if ph is None else s[ph],
-                None if kh is None else s[kh],
+                None if sh is None else s[sh],
+                None if th is None else s[th],
                 n_vals, start, n_take,
             ))
         return DeltaPlan(
