@@ -371,3 +371,80 @@ class TestNativeDeltaScan:
                 scan_delta_structure(np.frombuffer(stream, np.uint8))
             if force:
                 monkeypatch.undo()
+
+
+class TestNativePack:
+    """C bit packer + fused hybrid run-table repack."""
+
+    def _nat(self):
+        from tpuparquet.native import pack_native
+
+        p = pack_native()
+        if p is None:
+            pytest.skip("native pack primitives unavailable")
+        return p
+
+    def test_pack_roundtrip_all_widths(self):
+        from tpuparquet.cpu.bitpack import pack, unpack
+
+        self._nat()
+        rng = np.random.default_rng(31)
+        for w in (1, 2, 3, 5, 7, 8, 12, 17, 22, 31, 32, 33, 40, 48,
+                  63, 64):
+            hi = (1 << w) - 1 if w < 64 else (1 << 64) - 1
+            v = rng.integers(0, hi, 1003, dtype=np.uint64) if hi \
+                else np.zeros(1003, np.uint64)
+            v[0] = hi  # boundary value
+            out = unpack(pack(v, w), len(v), w)
+            assert np.array_equal(out.astype(np.uint64), v), w
+
+    def test_pack_rejects_oversized_value(self):
+        from tpuparquet.cpu.bitpack import pack
+
+        self._nat()
+        with pytest.raises(ValueError, match="does not fit"):
+            pack(np.array([4], dtype=np.uint64), 2)
+
+    def test_hybrid_repack_matches_expand_pack(self):
+        from tpuparquet.cpu.bitpack import pack
+        from tpuparquet.cpu.hybrid import (
+            encode_hybrid,
+            expand_scan,
+            scan_hybrid,
+        )
+
+        nat = self._nat()
+        rng = np.random.default_rng(32)
+        for trial in range(60):
+            w = int(rng.integers(1, 33))
+            n = int(rng.integers(1, 6000))
+            vals = rng.integers(0, 1 << w, n, dtype=np.uint64)
+            mode = trial % 4
+            if mode == 0:  # long RLE runs
+                vals = np.repeat(vals[: max(n // 8, 1)], 8)
+            elif mode == 1:  # mixed runs + noise
+                vals = np.where(rng.random(n) < 0.7, vals[0], vals)
+            n = len(vals)
+            enc = encode_hybrid(vals.astype(np.uint32), w)
+            scan = scan_hybrid(np.frombuffer(enc, np.uint8), n, w)
+            want = pack(expand_scan(*scan[:6], n, w)[:n], w)
+            got = nat.hybrid_repack(scan[0], scan[1], scan[2], scan[3],
+                                    scan[4], scan[5], n, w)
+            assert got is not None and got.tobytes() == want, (trial, w)
+
+    def test_hybrid_repack_declines_uncovered_table(self):
+        nat = self._nat()
+        # a table that stops short of count is not a valid scan output;
+        # the wrapper leaves it to the fallback
+        assert nat.hybrid_repack(
+            np.array([5], dtype=np.int32), np.array([1], np.uint8),
+            np.array([3], np.uint32), np.array([0], np.int32),
+            np.zeros(0, np.uint8), 0, 10, 3) is None
+
+    def test_hybrid_repack_rejects_oversized_rle_value(self):
+        nat = self._nat()
+        with pytest.raises(ValueError, match="does not fit"):
+            nat.hybrid_repack(
+                np.array([16], dtype=np.int32), np.array([1], np.uint8),
+                np.array([5], np.uint32), np.array([0], np.int32),
+                np.zeros(0, np.uint8), 0, 16, 2)

@@ -68,6 +68,11 @@ def pack(values, width: int) -> bytes:
     if not 0 < width <= 64:
         raise ValueError(f"bit width {width} out of range 0..64")
     v = np.asarray(values).astype(np.uint64, copy=False)
+    from ..native import pack_native
+
+    nat = pack_native()
+    if nat is not None:  # one C pass (fit check included)
+        return nat.pack(v, width).tobytes()
     _check_fits(v, width)
     if width % 8 == 0:
         k = width // 8

@@ -305,13 +305,25 @@ def plan_stream_args(scan, count: int, width: int, expanded=None):
             bp_wire(n_bp) if n_bp else 0)
         new_wire = 16 * bucket(1) + bp_wire(count)
         if old_wire > new_wire:
-            from ..cpu.bitpack import pack
-            from ..cpu.hybrid import expand_scan
+            packed = None
+            if expanded is None:
+                from ..native import pack_native
 
-            vals = (expanded if expanded is not None
-                    else expand_scan(*scan[:6], count, width))
-            packed = np.frombuffer(pack(vals[:count], width),
-                                   dtype=np.uint8)
+                nat = pack_native()
+                if nat is not None:
+                    # fused run-table -> packed bits: no expanded
+                    # intermediate, one C pass
+                    packed = nat.hybrid_repack(
+                        scan[0], scan[1], scan[2], scan[3], scan[4],
+                        scan[5], count, width)
+            if packed is None:
+                from ..cpu.bitpack import pack
+                from ..cpu.hybrid import expand_scan
+
+                vals = (expanded if expanded is not None
+                        else expand_scan(*scan[:6], count, width))
+                packed = np.frombuffer(pack(vals[:count], width),
+                                       dtype=np.uint8)
             scan = (np.array([count], dtype=np.int32),
                     np.zeros(1, dtype=bool),
                     np.zeros(1, dtype=np.uint32),

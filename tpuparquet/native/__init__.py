@@ -19,11 +19,13 @@ import threading
 import numpy as np
 
 __all__ = ["snappy_native", "NativeSnappy", "hybrid_native", "NativeHybrid",
-           "plane_native", "NativePlane", "delta_native", "NativeDelta"]
+           "plane_native", "NativePlane", "delta_native", "NativeDelta",
+           "pack_native", "NativePack"]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRCS = [os.path.join(_DIR, "snappy.c"), os.path.join(_DIR, "hybrid.c"),
-         os.path.join(_DIR, "plane.c"), os.path.join(_DIR, "delta.c")]
+         os.path.join(_DIR, "plane.c"), os.path.join(_DIR, "delta.c"),
+         os.path.join(_DIR, "pack.c")]
 _SO = os.path.join(_DIR, "_tpq_native.so")
 
 _lock = threading.Lock()
@@ -436,12 +438,81 @@ class NativeDelta:
         return md[:b], w[:m], p[:m], s[:m], int(end.value)
 
 
+class NativePack:
+    """ctypes bindings over the bit-packing primitives."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._pack64 = getattr(lib, "tpq_pack64", None)
+        self._repack = getattr(lib, "tpq_hybrid_repack", None)
+        if None in (self._pack64, self._repack):
+            raise RuntimeError("native library too old; rebuild")
+        self._pack64.restype = ctypes.c_longlong
+        self._pack64.argtypes = [
+            ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int,
+            ctypes.c_void_p,
+        ]
+        self._repack.restype = ctypes.c_longlong
+        self._repack.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_longlong,
+            ctypes.c_void_p, ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_longlong, ctypes.c_int, ctypes.c_void_p,
+        ]
+
+    def pack(self, values: np.ndarray, width: int) -> np.ndarray:
+        """LSB-first pack of a contiguous uint64 array; raises on a
+        value that does not fit ``width`` bits."""
+        v = np.ascontiguousarray(values, dtype=np.uint64)
+        n = (v.size * width + 7) // 8
+        out = np.empty(n + 8, dtype=np.uint8)  # word-writer slack
+        rc = self._pack64(v.ctypes.data, v.size, width, out.ctypes.data)
+        if rc == -1:
+            raise ValueError(
+                f"value {int(v.max())} does not fit in {width} bits")
+        if rc != 0:
+            raise ValueError(f"bit width {width} out of range 0..64")
+        return out[:n]
+
+    def hybrid_repack(self, run_ends, run_is_rle, run_value,
+                      run_bp_start, bp_bytes, n_bp: int, count: int,
+                      width: int) -> np.ndarray | None:
+        """Run table -> ONE bit-packed run, no expanded intermediate.
+        Returns the packed bytes, or None for widths > 32 (caller
+        falls back to expand + pack)."""
+        if not 0 < width <= 32 or not len(run_ends):
+            return None
+        if int(run_ends[-1]) < count:
+            # a table that does not cover count cannot come from a
+            # valid scan; the numpy paths disagree with each other on
+            # it, so leave it to the fallback rather than pin semantics
+            return None
+        ends = np.ascontiguousarray(run_ends, dtype=np.int32)
+        rle = np.ascontiguousarray(run_is_rle, dtype=np.uint8)
+        val = np.ascontiguousarray(run_value, dtype=np.uint32)
+        bps = np.ascontiguousarray(run_bp_start, dtype=np.int32)
+        bp = _as_u8(bp_bytes)
+        n = (count * width + 7) // 8
+        out = np.empty(n + 8, dtype=np.uint8)  # word-writer slack
+        rc = self._repack(
+            ends.ctypes.data, rle.ctypes.data, val.ctypes.data,
+            bps.ctypes.data, ends.size, bp.ctypes.data, bp.size,
+            int(n_bp), count, width, out.ctypes.data)
+        if rc == -1:  # same contract as pack(): refuse, don't truncate
+            raise ValueError(
+                f"value {int(val.max())} does not fit in {width} bits")
+        if rc != 0:
+            raise ValueError(f"hybrid repack failed (rc={rc})")
+        return out[:n]
+
+
 _snappy_inst: "NativeSnappy | None" = None
 _hybrid_inst: "NativeHybrid | None" = None
 _PLANE_UNAVAILABLE = object()  # cached stale-.so miss (see plane_native)
 _plane_inst = None
 _DELTA_UNAVAILABLE = object()
 _delta_inst = None
+_PACK_UNAVAILABLE = object()
+_pack_inst = None
 
 
 def snappy_native() -> NativeSnappy | None:
@@ -485,6 +556,27 @@ def delta_native() -> NativeDelta | None:
             st.native_fallbacks += 1
         return None
     return _delta_inst
+
+
+def pack_native() -> NativePack | None:
+    """The process-wide packing primitives, or None if unbuildable."""
+    global _pack_inst
+    if _pack_inst is not None:
+        return None if _pack_inst is _PACK_UNAVAILABLE else _pack_inst
+    lib = _lib()
+    if lib is None:
+        return None
+    try:
+        _pack_inst = NativePack(lib)
+    except RuntimeError:  # stale .so predating pack.c: cache the miss
+        _pack_inst = _PACK_UNAVAILABLE
+        from ..stats import current_stats
+
+        st = current_stats()
+        if st is not None:
+            st.native_fallbacks += 1
+        return None
+    return _pack_inst
 
 
 def plane_native() -> NativePlane | None:

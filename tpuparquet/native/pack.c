@@ -1,0 +1,136 @@
+/* LSB-first bit packing primitives.
+ *
+ * tpq_pack64 is the native core of cpu/bitpack.pack (the numpy
+ * formulation explodes every value into a byte-per-bit matrix — ~68 ms
+ * per million values).  tpq_hybrid_repack fuses the level/index stream
+ * re-pack (kernels/hybrid.py plan_stream_args): a mixed-run hybrid
+ * stream whose run table would out-weigh plain bits goes straight from
+ * the run table to one bit-packed run, without materializing the
+ * expanded values the numpy path needed (expand_scan + pack were the
+ * planner's hottest functions at bench scale).
+ *
+ * Both writers keep a u64 accumulator and flush whole 64-bit words
+ * (one unaligned store per 64 output bits); at most one value straddles
+ * a flush, recovered with a single shift.
+ */
+
+#include <stddef.h>
+#include <stdint.h>
+#include <string.h>
+
+/* Pack count LSB-first width-bit values from a contiguous u64 array.
+ * out must hold (count*width + 7)/8 + 8 bytes (8 slack for the word
+ * writer; the caller slices to the exact length).  Returns 0, or -1 if
+ * a value does not fit in width bits (silent truncation would corrupt
+ * the stream). */
+long long tpq_pack64(const uint64_t *v, long long count, int width,
+                     uint8_t *out) {
+    if (width <= 0 || width > 64)
+        return -2;
+    const uint64_t lim_mask =
+        width >= 64 ? 0 : ~((uint64_t)0) << width; /* high bits set */
+    uint64_t acc = 0;
+    int nbits = 0;
+    long long o = 0;
+    for (long long i = 0; i < count; i++) {
+        uint64_t x = v[i];
+        if (x & lim_mask)
+            return -1;
+        acc |= nbits < 64 ? x << nbits : 0;
+        nbits += width;
+        if (nbits >= 64) {
+            __builtin_memcpy(out + o, &acc, 8);
+            o += 8;
+            nbits -= 64;
+            /* bits of x that did not fit (0 when the flush landed
+             * exactly on a value boundary) */
+            acc = nbits ? x >> (width - nbits) : 0;
+        }
+    }
+    if (nbits > 0)
+        __builtin_memcpy(out + o, &acc, 8); /* slack covers the tail */
+    return 0;
+}
+
+static inline uint64_t load_bits(const uint8_t *bp, long long bp_len,
+                                 long long bitpos, int width) {
+    /* read width (<=32) bits at bitpos; safe at the tail */
+    long long byte = bitpos >> 3;
+    int shift = (int)(bitpos & 7);
+    uint64_t w = 0;
+    if (byte + 8 <= bp_len) {
+        __builtin_memcpy(&w, bp + byte, 8);
+    } else {
+        for (int i = 0; byte + i < bp_len && i < 8; i++)
+            w |= (uint64_t)bp[byte + i] << (8 * i);
+    }
+    w >>= shift;
+    return w & (((uint64_t)1 << width) - 1);
+}
+
+/* Re-pack a hybrid RLE/BP run table into ONE bit-packed run.
+ * Run k covers value indices [ends[k-1], ends[k]); RLE runs repeat
+ * value[k], bit-packed runs read consecutive width-bit values from the
+ * concatenated bp stream starting at value index bp_start[k].  out
+ * must hold (count*width + 7)/8 + 8 bytes (8 slack; caller slices).
+ * width 1..32.  Returns 0, or -2 on a bad width / non-monotone
+ * table. */
+long long tpq_hybrid_repack(const int32_t *ends, const uint8_t *is_rle,
+                            const uint32_t *value, const int32_t *bp_start,
+                            long long n_runs, const uint8_t *bp,
+                            long long bp_len, long long n_bp,
+                            long long count, int width, uint8_t *out) {
+    if (width <= 0 || width > 32 || n_runs <= 0)
+        return -2;
+    uint64_t acc = 0;
+    int nbits = 0;
+    long long o = 0;
+    long long prev = 0;
+    for (long long r = 0; r < n_runs && prev < count; r++) {
+        /* the clamp mirrors the numpy expand (cpu/hybrid.expand_scan):
+         * the LAST run extends to cover any values past the table's
+         * final end, and bit-packed positions clamp to the stream's
+         * last value */
+        long long end = (r == n_runs - 1) ? count : ends[r];
+        if (end > count)
+            end = count;
+        if (end < prev)
+            return -2;
+        long long len = end - prev;
+        if (is_rle[r]) {
+            const uint64_t x = value[r];
+            if (width < 32 && (x >> width))
+                return -1; /* would silently read back truncated */
+            for (long long i = 0; i < len; i++) {
+                acc |= x << nbits;
+                nbits += width;
+                if (nbits >= 64) {
+                    __builtin_memcpy(out + o, &acc, 8);
+                    o += 8;
+                    nbits -= 64;
+                    acc = nbits ? x >> (width - nbits) : 0;
+                }
+            }
+        } else {
+            long long lim = (n_bp > 0 ? n_bp - 1 : 0) * (long long)width;
+            long long bit = (long long)bp_start[r] * width;
+            for (long long i = 0; i < len; i++, bit += width) {
+                uint64_t x = load_bits(bp, bp_len,
+                                       bit > lim ? lim : bit, width);
+                acc |= x << nbits;
+                nbits += width;
+                if (nbits >= 64) {
+                    __builtin_memcpy(out + o, &acc, 8);
+                    o += 8;
+                    nbits -= 64;
+                    acc = nbits ? x >> (width - nbits) : 0;
+                }
+            }
+        }
+        prev = end;
+    }
+    long long total = (count * width + 7) / 8;
+    if (nbits > 0 && o < total)
+        __builtin_memcpy(out + o, &acc, 8); /* slack covers the tail */
+    return 0;
+}
