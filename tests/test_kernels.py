@@ -768,13 +768,50 @@ class TestDeviceSnappyWired:
         w.close()
         buf.seek(0)
         r = FileReader(buf)
-        with tpuparquet.collect_stats() as st:
-            dev = read_row_group_device(r, 0)
-        assert st.pages_device_snappy > 0, \
-            "device snappy kernel did not engage on a compressed V1 page"
+        import tpuparquet.kernels.device as _D
+        calls = []
+        orig = _D._plan_device_snappy_words
+        _D._plan_device_snappy_words = \
+            lambda *a, **k: calls.append(1) or orig(*a, **k)
+        try:
+            with tpuparquet.collect_stats() as st:
+                dev = read_row_group_device(r, 0)
+        finally:
+            _D._plan_device_snappy_words = orig
+        # the deferred branch must have consulted the token planner
+        # (proves values_comp was set), and the wire competition must
+        # have shipped SOME transport — this small-range data is
+        # cheaper as byte-plane runs than as snappy tokens
+        assert calls, "deferred-decompression branch did not run"
+        assert st.pages_device_snappy + st.pages_device_planes > 0, \
+            "no device transport engaged on a compressed V1 page"
         got, _, _ = dev["a"].to_numpy()
         cpu = r.read_row_group_arrays(0)["a"]
         np.testing.assert_array_equal(got, np.asarray(cpu.values))
+
+    def test_tokens_win_on_long_matches_without_lane_runs(self):
+        # full-entropy values tiled with a long period: snappy sees
+        # long matches (tiny token wire) while the lane/byte-plane
+        # sampler sees no runs — the competition must pick tokens
+        import tpuparquet
+
+        rng = np.random.default_rng(7)
+        base = rng.integers(-(2**62), 2**62, size=1024)
+        vals = np.tile(base, 8).astype(np.int64)
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required int64 a; }",
+                       codec=CompressionCodec.SNAPPY, allow_dict=False)
+        w.write_columns({"a": vals})
+        w.close()
+        buf.seek(0)
+        r = FileReader(buf)
+        with tpuparquet.collect_stats() as st:
+            dev = read_row_group_device(r, 0)
+        assert st.pages_device_snappy > 0, \
+            "token transport should win on long-match data"
+        assert st.pages_device_planes == 0
+        got, _, _ = dev["a"].to_numpy()
+        np.testing.assert_array_equal(got, vals)
 
     def test_v2_pyarrow_optional_device_decompress(self, tmp_path):
         import pyarrow as pa
@@ -791,10 +828,19 @@ class TestDeviceSnappyWired:
         pq.write_table(t, p, compression="snappy", use_dictionary=False,
                        data_page_version="2.0")
         r = FileReader(str(p))
-        with tpuparquet.collect_stats() as st:
-            dev = read_row_group_device(r, 0)
-        assert st.pages_device_snappy > 0, \
-            "device snappy kernel did not engage on a compressed V2 page"
+        import tpuparquet.kernels.device as _D
+        calls = []
+        orig = _D._plan_device_snappy_words
+        _D._plan_device_snappy_words = \
+            lambda *a, **k: calls.append(1) or orig(*a, **k)
+        try:
+            with tpuparquet.collect_stats() as st:
+                dev = read_row_group_device(r, 0)
+        finally:
+            _D._plan_device_snappy_words = orig
+        assert calls, "V2 deferred-decompression branch did not run"
+        assert st.pages_device_snappy + st.pages_device_planes > 0, \
+            "no device transport engaged on a compressed V2 page"
         got, _, gdl = dev["a"].to_numpy()
         cpu = r.read_row_group_arrays(0)["a"]
         np.testing.assert_array_equal(got, np.asarray(cpu.values))

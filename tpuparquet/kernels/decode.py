@@ -431,7 +431,14 @@ def _plan_delta(data, pos: int, max_width: int) -> DeltaPlan:
         if src_contig:
             packed = buf[p_w[0] : p_w[0] + nbytes * k]
         else:
-            packed = np.concatenate([buf[p : p + nbytes] for p in p_w])
+            from ..native import delta_native
+
+            nat = delta_native()
+            packed = (nat.gather_segments(buf, p_w, nbytes)
+                      if nat is not None else None)
+            if packed is None:  # one Python slice per miniblock
+                packed = np.concatenate(
+                    [buf[p : p + nbytes] for p in p_w])
         n_vals = mb_size * k
         # flat: a 2-D (n_blocks, w) device buffer tiles to 128 lanes
         words = pad_to_words(packed, w, n_vals).reshape(-1)

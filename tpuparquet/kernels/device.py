@@ -195,7 +195,8 @@ def _lane_contig(plane: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(plane)
 
 
-def _plan_plane_words(seg, count: int, lanes: int, stager: "_Stager"):
+def _plan_plane_words(seg, count: int, lanes: int, stager: "_Stager",
+                      budget: int | None = None):
     """Plan the lane/byte-plane RLE transport for one PLAIN fixed-width
     values segment (``count`` values of ``lanes`` u32 words each).
 
@@ -213,7 +214,10 @@ def _plan_plane_words(seg, count: int, lanes: int, stager: "_Stager"):
 
     Host cost matters as much as wire here (the planner runs on the
     pipeline's plan thread): everything below is one strided-view pass
-    per engaged lane, no full-page 2-D materialization."""
+    per engaged lane, no full-page 2-D materialization.
+
+    ``budget``, when given, is a competing transport's exact wire cost
+    (snappy tokens): the planes engage only if they beat it."""
     from .decode import bucket
 
     if count < 1024:
@@ -250,7 +254,9 @@ def _plan_plane_words(seg, count: int, lanes: int, stager: "_Stager"):
             wire += 4 * count
     # engage only on a solid win: the plan thread pays real host time
     # per engaged lane, so marginal pages keep the raw path
-    if wire > 0.75 * nbytes or nbytes - wire < 4096:
+    wire_cap = (0.75 * nbytes if budget is None
+                else min(0.75 * nbytes, budget))
+    if wire > wire_cap or nbytes - wire < 4096:
         return None
 
     raw32_parts, raw8_parts = [], []
@@ -318,7 +324,7 @@ def _plan_plane_words(seg, count: int, lanes: int, stager: "_Stager"):
     # sample window misrepresented it should ship raw, not an engaged
     # transport that saves nothing (nothing is staged until below, so
     # bailing here is free)
-    if actual > 0.75 * nbytes or nbytes - actual < 4096:
+    if actual > wire_cap or nbytes - actual < 4096:
         return None
 
     def cat(parts, dtype):
@@ -400,16 +406,21 @@ def _stage_delta_plan(plan, stager: "_Stager", need_hi: bool):
 
 
 def _plan_device_snappy_words(payload, expected_size: int, n_words: int,
-                              stager: "_Stager", offset: int = 0):
+                              offset: int = 0):
     """Plan device-side snappy decompression of one values segment.
 
-    Returns ``words(staged) -> (n_words,) u32`` when the segment should
-    decompress on device (multi-token block, native scanner available),
-    or None when the host path applies (single literal -> zero-copy
-    view; no native scanner; int32 overflow risk).  Wire format work
-    happens in ``native/snappy.c tpq_snappy_scan_tokens``; copy
-    resolution is :func:`tpuparquet.kernels.snappy.expand_tokens`
-    (pointer doubling).  Reference analogue of the block being replaced:
+    Returns ``(wire, commit)`` when the segment could decompress on
+    device (multi-token block, native scanner available): ``wire`` is
+    the exact transfer cost, and ``commit(stager)`` stages the plan and
+    returns ``words(staged) -> (n_words,) u32``.  Returns None when the
+    host path applies (single literal -> zero-copy view; no native
+    scanner; int32 overflow risk; tokens would not shrink the
+    transfer).  Staging is deferred so the dispatcher can pit the token
+    wire against the lane/byte-plane transport and ship the cheaper.
+    Wire format work happens in ``native/snappy.c
+    tpq_snappy_scan_tokens``; copy resolution is
+    :func:`tpuparquet.kernels.snappy.expand_tokens` (pointer doubling).
+    Reference analogue of the block being replaced:
     ``compress.go:102-122`` (the hot decompress in the read loop).
 
     ``offset`` (bytes into the decompressed block) serves V1 pages whose
@@ -428,17 +439,21 @@ def _plan_device_snappy_words(payload, expected_size: int, n_words: int,
     # bytes — ship tokens only when they actually shrink the transfer
     if wire >= 0.9 * (n_words * 4):
         return None
-    blob = _stage_token_expansion(plan, stager)
 
-    def words(staged, _blob=blob, _nw=n_words, _off=offset):
-        from .decode import u8_to_u32_words_at
+    def commit(stager, _plan=plan, _nw=n_words, _off=offset):
+        blob = _stage_token_expansion(_plan, stager)
 
-        out = _blob(staged)
-        if _off == 0:
-            return u8_to_u32_words(out, _nw)
-        return u8_to_u32_words_at(out, jnp.int32(_off), _nw)
+        def words(staged, _blob=blob, _nw=_nw, _off=_off):
+            from .decode import u8_to_u32_words_at
 
-    return words
+            out = _blob(staged)
+            if _off == 0:
+                return u8_to_u32_words(out, _nw)
+            return u8_to_u32_words_at(out, jnp.int32(_off), _nw)
+
+        return words
+
+    return wire, commit
 
 
 class DeviceColumn:
@@ -1098,29 +1113,39 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
             non_null = int((dl_host == max_def).sum())
         values_read += n
 
-        # Resolve deferred value-segment decompression: device tokens
-        # when the block is genuinely compressed, host (zero-copy for
-        # single-literal blocks) otherwise.
+        # Resolve deferred value-segment decompression.  The two device
+        # transports COMPETE on exact wire cost: snappy tokens (no host
+        # decompress) vs the lane/byte-plane transport (needs the
+        # decompressed bytes — native snappy makes that cheap).  A
+        # timestamp page whose tokens cost 0.76x of raw but whose lanes
+        # cost 0.50x must ship lanes, not whichever planner ran first.
         plan_words = None
+        tok = None
         if values_comp is not None:
-            plan_words = _plan_device_snappy_words(
+            tok = _plan_device_snappy_words(
                 values_comp[0], values_comp[1],
-                non_null * _LANES[ptype], stager,
-                offset=values_comp[2],
+                non_null * _LANES[ptype], offset=values_comp[2],
             )
-            if plan_words is None:
-                if values_seg is None:
-                    values_seg = decompress_block_into(
-                        codec, values_comp[0], values_comp[1], arena)
-            elif _st is not None:
-                _st.pages_device_snappy += 1
-        if (plan_words is None and _DEVICE_PLANES() and non_null
+            if values_seg is None and (
+                    tok is None
+                    or (_DEVICE_PLANES() and non_null >= 1024)):
+                # decompress so the planes can compete — skipped when
+                # the planner's own size floor (count >= 1024) makes
+                # the contest moot and tokens already cover the page
+                values_seg = decompress_block_into(
+                    codec, values_comp[0], values_comp[1], arena)
+        if (_DEVICE_PLANES() and non_null
                 and enc == Encoding.PLAIN and ptype in _LANES
                 and values_seg is not None):
             plan_words = _plan_plane_words(
-                values_seg, non_null, _LANES[ptype], stager)
+                values_seg, non_null, _LANES[ptype], stager,
+                budget=None if tok is None else tok[0])
             if plan_words is not None and _st is not None:
                 _st.pages_device_planes += 1
+        if plan_words is None and tok is not None:
+            plan_words = tok[1](stager)
+            if _st is not None:
+                _st.pages_device_snappy += 1
 
         # Def-level plan, padded for the fused page kernels.  A page
         # whose value path can't fuse expands it standalone via

@@ -393,6 +393,28 @@ class NativeDelta:
             ctypes.POINTER(ctypes.c_longlong),
             ctypes.POINTER(ctypes.c_longlong),
         ]
+        self._gather = getattr(lib, "tpq_gather_segments", None)
+        if self._gather is not None:
+            self._gather.restype = ctypes.c_longlong
+            self._gather.argtypes = [
+                ctypes.c_void_p, ctypes.c_longlong,
+                ctypes.c_void_p, ctypes.c_longlong, ctypes.c_longlong,
+                ctypes.c_void_p,
+            ]
+
+    def gather_segments(self, src, positions, nbytes: int):
+        """Concatenate fixed-size segments of ``src`` at ``positions``
+        in one C pass; None when the symbol is missing (stale .so)."""
+        if self._gather is None:
+            return None
+        buf = _as_u8(src)
+        pos = np.ascontiguousarray(positions, dtype=np.int64)
+        out = np.empty(pos.size * nbytes, dtype=np.uint8)
+        rc = self._gather(buf.ctypes.data, buf.size, pos.ctypes.data,
+                          pos.size, nbytes, out.ctypes.data)
+        if rc != 0:
+            raise ValueError("miniblock payload out of bounds")
+        return out
 
     def scan_blocks(self, data, pos: int, n_deltas: int, mb_size: int,
                     n_miniblocks: int, max_width: int):

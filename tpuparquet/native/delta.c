@@ -38,6 +38,20 @@ static int read_uvarint64(const uint8_t *d, long long len, long long *pos,
     return 0;
 }
 
+/* Gather k fixed-size segments (miniblock payloads) from src into one
+ * contiguous buffer — the numpy formulation concatenates one Python
+ * slice per miniblock (tens of thousands per chunk). */
+long long tpq_gather_segments(const uint8_t *src, long long src_len,
+                              const int64_t *pos, long long k,
+                              long long nbytes, uint8_t *out) {
+    for (long long i = 0; i < k; i++) {
+        if (pos[i] < 0 || pos[i] + nbytes > src_len)
+            return -1;
+        __builtin_memcpy(out + i * nbytes, src + pos[i], (size_t)nbytes);
+    }
+    return 0;
+}
+
 long long tpq_delta_scan_blocks(
     const uint8_t *data, long long data_len, long long pos,
     long long n_deltas, long long mb_size, long long n_miniblocks,
