@@ -371,12 +371,20 @@ class TestColumnarObjectWrite:
             got = list(r)
         assert got == want
 
-    def test_nested_schema_rejected(self, tmp_path):
-        p = tmp_path / "x.parquet"
-        with new_file_writer(str(p), cls=Record) as w:
-            with pytest.raises(ValueError, match="flat schemas"):
-                w.write_columns(sample_records())
-            w.write_many(sample_records())  # row path still fine
+    def test_full_record_bulk_matches_row_path(self, tmp_path):
+        # the full Record (map + struct + list + logical-typed fields)
+        # now rides the bulk path end to end, identical to the row path
+        pa_ = tmp_path / "rows.parquet"
+        pb_ = tmp_path / "cols.parquet"
+        with new_file_writer(str(pa_), cls=Record) as w:
+            w.write_many(sample_records())
+        with new_file_writer(str(pb_), cls=Record) as w:
+            w.write_columns(sample_records())
+        with new_file_reader(str(pa_), Record) as r:
+            want = list(r)
+        with new_file_reader(str(pb_), Record) as r:
+            got = list(r)
+        assert got == want == sample_records()
 
     def test_required_null_rejected(self, tmp_path):
         p = tmp_path / "y.parquet"
@@ -420,13 +428,31 @@ class TestColumnarObjectWrite:
             with pytest.raises(TypeError, match="dataclass"):
                 r.read_columns(0)
 
-    def test_read_columns_nested_rejected(self, tmp_path):
+    def test_read_columns_full_record(self, tmp_path):
         p = tmp_path / "nr.parquet"
         with new_file_writer(str(p), cls=Record) as w:
             w.write_many(sample_records())
         with new_file_reader(str(p), Record) as r:
-            with pytest.raises(ValueError, match="flat schemas"):
-                r.read_columns(0)
+            assert r.read_columns(0) == sample_records()
+
+    def test_list_of_structs_rejected(self, tmp_path):
+        @dataclass
+        class E:
+            x: int = 0
+
+        @dataclass
+        class L:
+            items: Optional[list[E]] = None
+
+        # typing.get_type_hints resolves the method-local names through
+        # module globals
+        globals()["E"] = E
+        globals()["L"] = L
+        p = tmp_path / "ls.parquet"
+        with new_file_writer(str(p), cls=L) as w:
+            with pytest.raises(ValueError, match="nested"):
+                w.write_columns([L(items=[E(1)])])
+            w.write_many([L(items=[E(1)])])  # row path still fine
 
     def test_read_columns_uuid_and_unmatched_fields(self, tmp_path):
         @dataclass
@@ -556,16 +582,33 @@ class TestColumnarListFields:
             got = r.read_columns(0)
         assert [g.tags for g in got] == [["a", "b"], []]
 
-    def test_maps_still_rejected(self, tmp_path):
+    def test_map_fields_bulk_round_trip(self, tmp_path):
         @dataclass
         class M:
+            ident: int = 0
             attrs: Optional[dict[str, int]] = None
 
-        p = tmp_path / "m.parquet"
-        with new_file_writer(str(p), cls=M) as w:
-            with pytest.raises(ValueError, match="flat schemas"):
-                w.write_columns([M(attrs={"a": 1})])
-            w.write_many([M(attrs={"a": 1})])
+        objs = [
+            M(1, {"a": 1, "b": 2}),
+            M(2, None),
+            M(3, {}),
+            M(4, {"z": None}),   # null value, present key
+            M(5, {"q": 9}),
+        ]
+        pa_ = tmp_path / "mr.parquet"
+        pb_ = tmp_path / "mc.parquet"
+        with new_file_writer(str(pa_), cls=M) as w:
+            w.write_many(objs)
+        with new_file_writer(str(pb_), cls=M) as w:
+            w.write_columns(objs)
+        with new_file_reader(str(pa_), M) as r:
+            want = list(r)
+        with new_file_reader(str(pb_), M) as r:
+            got = list(r)
+        assert got == want
+        with new_file_reader(str(pb_), M) as r:
+            bulk = r.read_columns(0)
+        assert bulk == want
 
     def test_element_hint_suppresses_decoding(self, tmp_path):
         """list[Optional[bytes]] on a STRING column: the bytes hint
@@ -746,3 +789,27 @@ class TestColumnarStructFields:
         got = Reader(fr, cls=self.Rec).read_columns(0)
         assert all(g.loc is None for g in got)
         assert [g.ident for g in got] == [o.ident for o in objs]
+
+
+@dataclass
+class _MapStructChild:
+    x: int = 0
+
+
+@dataclass
+class _MapStructHolder:
+    m: Optional[dict[str, _MapStructChild]] = None
+
+
+class TestMapOfStructsStaysOnRowPath:
+    def test_write_and_read_reject(self, tmp_path):
+        p = tmp_path / "ms.parquet"
+        objs = [_MapStructHolder(m={"a": _MapStructChild(5)})]
+        with new_file_writer(str(p), cls=_MapStructHolder) as w:
+            with pytest.raises(ValueError, match="nested"):
+                w.write_columns(objs)
+            w.write_many(objs)  # row path still fine
+        with new_file_reader(str(p), _MapStructHolder) as r:
+            assert list(r) == objs
+            with pytest.raises(ValueError, match="nested"):
+                r.read_columns(0)

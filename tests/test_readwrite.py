@@ -337,17 +337,73 @@ class TestColumnarAPI:
         with pytest.raises(ValueError, match="offsets"):
             w.write_columns({"a": np.arange(3)})
 
-    def test_write_columns_rejects_multi_leaf_repeated_group(self):
-        # keying by the top-level field would write the same array into
-        # every leaf of the group — must be an error, not silent aliasing
+    def test_write_columns_multi_leaf_needs_tuple(self):
+        # keying a bare array by the top-level field would silently
+        # alias the same array into every leaf of the group — a
+        # multi-leaf repeated group takes a tuple of per-leaf arrays
         buf = io.BytesIO()
         w = FileWriter(
             buf,
             "message m { repeated group r "
             "{ required int64 a; required int64 b; } }")
         offs = np.array([0, 2, 3])
-        with pytest.raises(ValueError, match="multiple leaves"):
+        with pytest.raises(ValueError, match="tuple of per-leaf"):
             w.write_columns({"r": np.arange(3)}, offsets={"r": offs})
+
+    def test_write_columns_multi_leaf_repeated_group(self):
+        # list-of-struct: per-leaf arrays share the slot offsets
+        buf = io.BytesIO()
+        w = FileWriter(
+            buf,
+            "message m { repeated group r "
+            "{ required int64 a; optional int64 b; } }")
+        offs = np.array([0, 2, 3, 3])
+        w.write_columns(
+            {"r": (np.array([1, 2, 3]), np.array([10, 30]))},
+            offsets={"r": offs},
+            element_masks={"r": {"r.b": np.array([True, False, True])}})
+        w.close()
+        buf.seek(0)
+        rows = list(FileReader(buf).rows())
+        # a bare repeated group has no empty-vs-absent distinction:
+        # the empty row assembles as {} (same as the row path)
+        assert rows == [
+            {"r": [{"a": 1, "b": 10}, {"a": 2}]},
+            {"r": [{"a": 3, "b": 30}]},
+            {},
+        ]
+
+    def test_write_columns_map(self):
+        # canonical MAP: (keys, values) tuple + offsets; parity with
+        # the row-path shredder
+        schema = ("message m { required int64 id; optional group m (MAP) "
+                  "{ repeated group key_value { required binary key "
+                  "(STRING); optional int64 value; } } }")
+        rows_in = [
+            {"id": 1, "m": {"key_value": [
+                {"key": b"a", "value": 10}, {"key": b"b"}]}},
+            {"id": 2, "m": None},
+            {"id": 3, "m": {"key_value": []}},
+            {"id": 4, "m": {"key_value": [{"key": b"z", "value": 4}]}},
+        ]
+        b1 = io.BytesIO()
+        w = FileWriter(b1, schema)
+        for r in rows_in:
+            w.add_data(r)
+        w.close()
+        b2 = io.BytesIO()
+        w = FileWriter(b2, schema)
+        w.write_columns(
+            {"id": np.array([1, 2, 3, 4], dtype=np.int64),
+             "m": ([b"a", b"b", b"z"], np.array([10, 4]))},
+            offsets={"m": np.array([0, 2, 2, 2, 3])},
+            masks={"m": np.array([True, False, True, True])},
+            element_masks={"m": {"m.key_value.value":
+                                 np.array([True, False, True])}})
+        w.close()
+        b1.seek(0)
+        b2.seek(0)
+        assert list(FileReader(b1).rows()) == list(FileReader(b2).rows())
 
     def test_write_columns_struct_needs_dotted_key(self):
         # struct leaves are keyed by dotted flat name; the bare group

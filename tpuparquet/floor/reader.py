@@ -59,8 +59,8 @@ class Reader:
     def read_columns(self, rg_index: int, cls=None) -> list:
         """Bulk-materialize one row group's objects: columnar decode +
         per-leaf conversion, no per-row record assembly.  Flat, STRUCT
-        (nested dataclass), and list-of-primitive fields; same objects
-        as iterating that row group."""
+        (nested dataclass), MAP (dict), and list-of-primitive fields;
+        same objects as iterating that row group."""
         from .reflect import objects_from_columns
 
         cls = cls or self._cls

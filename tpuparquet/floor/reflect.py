@@ -229,25 +229,35 @@ def objects_to_columns(objs, schema):
     identical to the row path; the columnar call writes one row group.
     Flat leaves, STRUCT columns (nested dataclasses/mappings over
     non-repeated groups, emitted as dotted leaf columns + per-group
-    masks), and LIST-of-primitive columns (bare repeated leaves,
-    2-level legacy, canonical 3-level — the shapes the reference's
-    reflection shreds at ``floor/writer.go:241-294``) are supported;
-    maps and multi-leaf repeated groups raise — use
+    masks), MAP columns (dict fields -> (keys, values) per-leaf arrays
+    sharing slot offsets), and LIST-of-primitive columns (bare repeated
+    leaves, 2-level legacy, canonical 3-level — the shapes the
+    reference's reflection shreds at ``floor/writer.go:241-294``) are
+    supported; lists of structs raise — use
     ``Writer.write``/``write_many`` for those."""
     leaves = schema.leaves
     list_tops = {}
     struct_leaves = set()
+    map_tops = {}  # map top node -> (key leaf, value leaf)
     for leaf in leaves:
         if len(leaf.path) == 1 and not leaf.max_rep_level:
             continue
         if not leaf.max_rep_level:
             struct_leaves.add(leaf)  # nested non-repeated groups
             continue
+        top = _child_named(schema.root, leaf.path[0])
+        if (top is not None and _is_map_group(top)
+                and leaf.max_rep_level == 1
+                and top.children[0].children[0].is_leaf
+                and top.children[0].children[1].is_leaf):
+            kv = top.children[0]
+            map_tops[top] = (kv.children[0], kv.children[1])
+            continue
         top = _bulk_list_leaf(schema, leaf)
         if top is None:
             raise ValueError(
-                f"objects_to_columns supports flat schemas, STRUCT "
-                f"columns, and LIST-of-primitive columns; "
+                f"objects_to_columns supports flat schemas, STRUCT, "
+                f"MAP, and LIST-of-primitive columns; "
                 f"{leaf.flat_name!r} is nested (use write/write_many)")
         list_tops[leaf] = top
     objs = list(objs)
@@ -295,7 +305,56 @@ def objects_to_columns(objs, schema):
         prefix_objs[key] = vals
         return vals
 
+    map_top_by_name = {t.name: t for t in map_tops}
+    done_maps: set = set()
     for leaf in leaves:
+        mtop = (map_top_by_name.get(leaf.path[0])
+                if leaf.max_rep_level else None)
+        if mtop is not None:
+            if mtop.name in done_maps:
+                continue  # key and value leaves marshal together
+            done_maps.add(mtop.name)
+            key_leaf, val_leaf = map_tops[mtop]
+            name = mtop.name
+            val_optional = not val_leaf.is_required
+            keys: list = []
+            vals_v: list = []
+            vmask: list = []
+            offs = _np.zeros(len(objs) + 1, dtype=_np.int64)
+            mask = None
+            for i, o in enumerate(objs):
+                v = getter(o, name)
+                if v is None:
+                    if not mtop.is_required:
+                        if mask is None:
+                            mask = _np.ones(len(objs), dtype=bool)
+                        mask[i] = False
+                    else:
+                        raise ValueError(
+                            f"column {name!r} is required but object "
+                            f"{i} has no value")
+                    offs[i + 1] = offs[i]
+                    continue
+                offs[i + 1] = offs[i] + len(v)
+                for k, val in v.items():
+                    keys.append(_encode_leaf(k, key_leaf))
+                    if val is None:
+                        if not val_optional:
+                            raise ValueError(
+                                f"column {name!r} value is required "
+                                f"but object {i} contains None")
+                        vmask.append(False)
+                    else:
+                        vmask.append(True)
+                        vals_v.append(_encode_leaf(val, val_leaf))
+            columns[name] = (keys, vals_v)
+            offsets[name] = offs
+            if mask is not None:
+                masks[name] = mask
+            if not all(vmask):
+                element_masks[name] = {
+                    val_leaf.flat_name: _np.asarray(vmask, dtype=bool)}
+            continue
         top = list_tops.get(leaf)
         if top is not None:
             name = top.name
@@ -408,30 +467,63 @@ def objects_from_columns(columns, cls, schema, n_rows=None) -> list:
     -> ``list[cls]``, with the same leaf conversions as
     :func:`from_row` (strings, date/time/timestamp units, UUID) —
     but no per-row record assembly.  Flat, STRUCT (nested dataclass
-    fields), and LIST-of-primitive columns are supported.  ``n_rows``
+    fields), MAP (dict fields), and LIST-of-primitive columns are
+    supported.  ``n_rows``
     is required when no dataclass field matches a file column (there
     is then no column to infer the row count from)."""
     if not dataclasses.is_dataclass(cls):
         raise TypeError(f"{cls!r} is not a dataclass")
     list_leaves = {}
     struct_tops = set()
+    map_tops = {}
     for leaf in schema.leaves:
         if len(leaf.path) == 1 and not leaf.max_rep_level:
             continue
         if not leaf.max_rep_level:
             struct_tops.add(leaf.path[0])
             continue
+        top = _child_named(schema.root, leaf.path[0])
+        if (top is not None and _is_map_group(top)
+                and leaf.max_rep_level == 1
+                and top.children[0].children[0].is_leaf
+                and top.children[0].children[1].is_leaf):
+            kv = top.children[0]
+            map_tops[top.name] = (top, kv.children[0], kv.children[1])
+            continue
         top = _bulk_list_leaf(schema, leaf)
         if top is None:
             raise ValueError(
-                f"objects_from_columns supports flat schemas, STRUCT "
-                f"columns, and LIST-of-primitive columns; "
+                f"objects_from_columns supports flat schemas, STRUCT, "
+                f"MAP, and LIST-of-primitive columns; "
                 f"{leaf.flat_name!r} is nested (use iteration/scan)")
         list_leaves[top.name] = leaf
     field_cols: list = []
     for f, hint in _dc_fields(cls):
         name = field_name(f)
         node = _child_named(schema.root, name)
+        if node is not None and name in map_tops:
+            top, key_leaf, val_leaf = map_tops[name]
+            cd_k = columns.get(key_leaf.flat_name)
+            cd_v = columns.get(val_leaf.flat_name)
+            if cd_k is None or cd_v is None:
+                field_cols.append((f.name, None))
+                continue
+            hint_u = _unwrap_optional(hint)[0] if hint is not None \
+                else None
+            args = typing.get_args(hint_u) if hint_u else ()
+            kh = _unwrap_optional(args[0])[0] if args else None
+            vh = (_unwrap_optional(args[1])[0]
+                  if len(args) > 1 else None)
+            out = _maps_from_chunks(cd_k, cd_v, top, key_leaf,
+                                    val_leaf, kh, vh)
+            if n_rows is None:
+                n_rows = len(out)
+            elif n_rows != len(out):
+                raise ValueError(
+                    f"column {name!r} has {len(out)} rows, "
+                    f"expected {n_rows}")
+            field_cols.append((f.name, out))
+            continue
         if node is not None and name in struct_tops:
             hint_u = _unwrap_optional(hint)[0] if hint is not None else None
             out = _structs_from_chunks(columns, node, hint_u)
@@ -562,6 +654,48 @@ def _structs_from_chunks(columns, node: SchemaNode, hint):
         if present[i] else None
         for i in range(n)
     ]
+
+
+def _maps_from_chunks(cd_k, cd_v, top: SchemaNode, key_leaf: SchemaNode,
+                      val_leaf: SchemaNode, khint, vhint):
+    """Reconstruct per-row Python dicts from a MAP column's key and
+    value ChunkData — the two leaf streams share rep levels and slot
+    structure (Dremel with one repeated level), so one walk over the
+    key stream drives both."""
+    from ..io.values import handler_for
+
+    keys = handler_for(key_leaf.element).to_pylist(cd_k.values)
+    vals = handler_for(val_leaf.element).to_pylist(cd_v.values)
+    rep = cd_k.rep_levels.tolist()
+    dl = cd_k.def_levels.tolist()
+    vdl = cd_v.def_levels.tolist()
+    kv = top.children[0]
+    def_m = kv.max_def_level       # slot holds an entry at def >= this
+    def_v = val_leaf.max_def_level  # ... with a non-null value at this
+    row_nullable = not top.is_required
+    def_t = top.max_def_level
+    out = []
+    _no_row = object()
+    row = _no_row
+    ki = vi = 0
+    for slot, (r, d) in enumerate(zip(rep, dl)):
+        if r == 0:
+            if row is not _no_row:
+                out.append(row)
+            row = {}
+        if d >= def_m:
+            k = _decode_leaf(keys[ki], key_leaf, khint)
+            ki += 1
+            if vdl[slot] == def_v:
+                row[k] = _decode_leaf(vals[vi], val_leaf, vhint)
+                vi += 1
+            else:
+                row[k] = None
+        elif row_nullable and d < def_t:
+            row = None
+    if row is not _no_row:
+        out.append(row)
+    return out
 
 
 def _lists_from_chunk(cd, top: SchemaNode, leaf: SchemaNode, ehint):

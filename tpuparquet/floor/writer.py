@@ -51,10 +51,11 @@ class Writer:
         """Bulk columnar write of objects: one row group per call, same
         decoded contents as :meth:`write_many` but without per-row dict
         building and shredding.  Flat fields, nested-dataclass STRUCT
-        fields, and list-of-primitive fields (``list[int]``,
-        ``list[str]``, ...) are supported; objects with a
-        ``marshal_parquet`` hook, maps, or lists of structs need the
-        row path (``write``/``write_many``)."""
+        fields, dict MAP fields (primitive keys/values), and
+        list-of-primitive fields (``list[int]``, ``list[str]``, ...)
+        are supported; objects with a ``marshal_parquet`` hook, lists
+        of structs, and maps with struct values need the row path
+        (``write``/``write_many``)."""
         objs = list(objs)
         if not objs:
             return  # match write_many([]): no empty row group

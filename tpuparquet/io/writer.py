@@ -171,8 +171,13 @@ class FileWriter:
         Nested STRUCT leaves (non-repeated groups on the path): key by
         the dotted flat name (``"a.b"``), pass non-null values only;
         ``masks`` entries on group prefixes (``"a"``) mark rows where
-        that whole group is null.  Multi-leaf repeated groups and MAPs
-        stay on the row path (``add_data``).
+        that whole group is null.
+
+        Multi-leaf repeated groups (MAP ``key_value``, LIST of struct):
+        ``columns[f]`` is a tuple of per-leaf arrays in schema leaf
+        order (for a MAP: ``(keys, values)``) sharing ``offsets[f]``;
+        ``element_masks[f]`` is then a dict keyed by leaf flat name
+        (e.g. ``"m.key_value.value"``).
         """
         if self._closed:
             raise ValueError("writer is closed")
@@ -183,6 +188,7 @@ class FileWriter:
         prepared = []
         reps = {}
         rep_leaf_counts: dict[str, int] = {}
+        rep_leaf_index: dict[str, int] = {}
         for leaf in leaves:
             if leaf.max_rep_level:
                 top = leaf.path[0]
@@ -190,14 +196,6 @@ class FileWriter:
         for leaf in leaves:
             if leaf.max_rep_level:
                 key = leaf.path[0]
-                if rep_leaf_counts[key] > 1:
-                    # keying values by the top-level field would silently
-                    # write the same array into every leaf of the group
-                    raise ValueError(
-                        f"repeated group {key!r} has multiple leaves; "
-                        "write_columns supports single-leaf LIST columns "
-                        "only — use add_data for general nesting"
-                    )
                 if key not in columns:
                     raise ValueError(f"missing column {key!r}")
                 if offsets is None or key not in offsets:
@@ -205,10 +203,38 @@ class FileWriter:
                         f"repeated column {key!r} needs offsets= "
                         "(row -> element ranges)"
                     )
+                k_leaves = rep_leaf_counts[key]
+                if k_leaves > 1:
+                    # MAP key_value / element struct: one tuple of
+                    # per-leaf arrays (schema leaf order) sharing the
+                    # row->slot offsets; element masks are keyed by
+                    # leaf flat name
+                    col = columns[key]
+                    if not isinstance(col, (tuple, list)) \
+                            or len(col) != k_leaves:
+                        raise ValueError(
+                            f"repeated group {key!r} has {k_leaves} "
+                            "leaves; pass a tuple of per-leaf arrays "
+                            "(schema leaf order)"
+                        )
+                    i = rep_leaf_index.get(key, 0)
+                    rep_leaf_index[key] = i + 1
+                    leaf_vals = col[i]
+                    em = (element_masks or {}).get(key)
+                    if isinstance(em, dict):
+                        em = em.get(leaf.flat_name)
+                    elif em is not None:
+                        raise ValueError(
+                            f"element_masks[{key!r}] must be a dict "
+                            "keyed by leaf flat name for a multi-leaf "
+                            "group"
+                        )
+                else:
+                    leaf_vals = columns[key]
+                    em = (element_masks or {}).get(key)
                 vals, rep, dl, rows = self._prepare_repeated(
-                    leaf, columns[key], np.asarray(offsets[key]),
-                    (masks or {}).get(key),
-                    (element_masks or {}).get(key),
+                    leaf, leaf_vals, np.asarray(offsets[key]),
+                    (masks or {}).get(key), em,
                 )
                 reps[leaf.flat_name] = rep
             elif len(leaf.path) != 1:
