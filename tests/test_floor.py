@@ -435,24 +435,41 @@ class TestColumnarObjectWrite:
         with new_file_reader(str(p), Record) as r:
             assert r.read_columns(0) == sample_records()
 
-    def test_list_of_structs_rejected(self, tmp_path):
+    def test_list_of_structs_bulk_round_trip(self, tmp_path):
         @dataclass
         class E:
             x: int = 0
+            y: Optional[str] = None
 
         @dataclass
         class L:
+            ident: int = 0
             items: Optional[list[E]] = None
 
         # typing.get_type_hints resolves the method-local names through
         # module globals
         globals()["E"] = E
         globals()["L"] = L
-        p = tmp_path / "ls.parquet"
-        with new_file_writer(str(p), cls=L) as w:
-            with pytest.raises(ValueError, match="nested"):
-                w.write_columns([L(items=[E(1)])])
-            w.write_many([L(items=[E(1)])])  # row path still fine
+        objs = [
+            L(1, [E(1, "a"), E(2, None)]),
+            L(2, None),
+            L(3, []),
+            L(4, [E(7, "z")]),
+            L(5, [None, E(3, "b"), None]),  # null ELEMENTS (group-null)
+        ]
+        pa_ = tmp_path / "lsr.parquet"
+        pb_ = tmp_path / "lsc.parquet"
+        with new_file_writer(str(pa_), cls=L) as w:
+            w.write_many(objs)
+        with new_file_writer(str(pb_), cls=L) as w:
+            w.write_columns(objs)
+        with new_file_reader(str(pa_), L) as r:
+            want = list(r)
+        with new_file_reader(str(pb_), L) as r:
+            got = list(r)
+        assert got == want
+        with new_file_reader(str(pb_), L) as r:
+            assert r.read_columns(0) == want
 
     def test_read_columns_uuid_and_unmatched_fields(self, tmp_path):
         @dataclass
@@ -813,3 +830,37 @@ class TestMapOfStructsStaysOnRowPath:
             assert list(r) == objs
             with pytest.raises(ValueError, match="nested"):
                 r.read_columns(0)
+
+
+@dataclass
+class _OneFieldElem:
+    x: Optional[int] = None
+
+
+@dataclass
+class _OneFieldHolder:
+    items: Optional[list[_OneFieldElem]] = None
+
+
+class TestSingleLeafElementStruct:
+    def test_bulk_round_trip(self, tmp_path):
+        # a one-field element struct still uses the tuple contract
+        # (review find: it used to fall into the scalar branch)
+        objs = [
+            _OneFieldHolder([_OneFieldElem(1), _OneFieldElem(None)]),
+            _OneFieldHolder(None),
+            _OneFieldHolder([]),
+            _OneFieldHolder([None, _OneFieldElem(3)]),
+        ]
+        p = tmp_path / "one.parquet"
+        with new_file_writer(str(p), cls=_OneFieldHolder) as w:
+            w.write_columns(objs)
+        with new_file_reader(str(p), _OneFieldHolder) as r:
+            want = list(r)
+        with new_file_reader(str(p), _OneFieldHolder) as r:
+            assert r.read_columns(0) == want
+        pb = tmp_path / "rows.parquet"
+        with new_file_writer(str(pb), cls=_OneFieldHolder) as w:
+            w.write_many(objs)
+        with new_file_reader(str(pb), _OneFieldHolder) as r:
+            assert list(r) == want

@@ -849,3 +849,21 @@ class TestColumnarStructs:
                  "loc.lon": np.array([1.0]),
                  "loc.tag.name": [b"x"]},
                 masks={"loc.lat": np.array([True])})
+
+
+class TestElemMaskGuards:
+    def test_required_field_mask_rejected_under_optional_element(self):
+        # an element mask on a REQUIRED field must be refused even when
+        # no group-null mask accompanies it — accepting it would write
+        # a present element missing a required field
+        schema = ("message m { optional group items (LIST) { "
+                  "repeated group list { optional group element { "
+                  "required int64 x; optional int64 y; } } } }")
+        w = FileWriter(io.BytesIO(), schema)
+        with pytest.raises(ValueError, match="element is required"):
+            w.write_columns(
+                {"items": (np.array([1, 3]), np.array([10, 20, 30]))},
+                offsets={"items": np.array([0, 3])},
+                element_masks={"items": {
+                    "items.list.element.x":
+                        np.array([True, False, True])}})

@@ -218,6 +218,39 @@ def _bulk_list_leaf(schema, leaf) -> "SchemaNode | None":
     return None
 
 
+def _bulk_struct_list(schema, top_name: str):
+    """If the top-level column ``top_name`` is a list of structs the
+    bulk paths can marshal — element leaves are direct, non-repeated
+    children of the element group — return ``(top, rep_node, elem_node,
+    leaves)``; None otherwise.
+
+    Covered shapes: canonical 3-level LIST whose element is a group,
+    and a bare ``repeated group`` element (2-level legacy).  An
+    optional element group ships a group-null mask (one def level below
+    null fields)."""
+    top = _child_named(schema.root, top_name)
+    if top is None or top.is_leaf:
+        return None
+    if _is_list_group(top):
+        mid = top.children[0]
+        if mid.is_leaf or len(mid.children) != 1:
+            return None
+        elem = mid.children[0]
+        if elem.is_leaf:
+            return None
+        rep_node = mid
+    elif top.is_repeated:  # bare repeated group: the element itself
+        elem = top
+        rep_node = top
+    else:
+        return None
+    if not elem.children or any(not c.is_leaf for c in elem.children):
+        return None
+    if any(c.max_rep_level != 1 for c in elem.children):
+        return None
+    return top, rep_node, elem, list(elem.children)
+
+
 def objects_to_columns(objs, schema):
     """Bulk columnar extraction: dataclasses/mappings ->
     ``(columns, masks, offsets, element_masks)`` for
@@ -233,12 +266,14 @@ def objects_to_columns(objs, schema):
     sharing slot offsets), and LIST-of-primitive columns (bare repeated
     leaves, 2-level legacy, canonical 3-level — the shapes the
     reference's reflection shreds at ``floor/writer.go:241-294``) are
-    supported; lists of structs raise — use
-    ``Writer.write``/``write_many`` for those."""
+    supported, as are LIST-of-struct columns (``list[dataclass]``
+    fields over a single-repeated-level element group, including
+    optional elements via a group-null mask)."""
     leaves = schema.leaves
     list_tops = {}
     struct_leaves = set()
     map_tops = {}  # map top node -> (key leaf, value leaf)
+    struct_list_tops = {}  # name -> (top, rep_node, elem, leaves)
     for leaf in leaves:
         if len(leaf.path) == 1 and not leaf.max_rep_level:
             continue
@@ -255,9 +290,13 @@ def objects_to_columns(objs, schema):
             continue
         top = _bulk_list_leaf(schema, leaf)
         if top is None:
+            sl = _bulk_struct_list(schema, leaf.path[0])
+            if sl is not None:
+                struct_list_tops[sl[0].name] = sl
+                continue
             raise ValueError(
                 f"objects_to_columns supports flat schemas, STRUCT, "
-                f"MAP, and LIST-of-primitive columns; "
+                f"MAP, LIST-of-primitive, and LIST-of-struct columns; "
                 f"{leaf.flat_name!r} is nested (use write/write_many)")
         list_tops[leaf] = top
     objs = list(objs)
@@ -308,6 +347,75 @@ def objects_to_columns(objs, schema):
     map_top_by_name = {t.name: t for t in map_tops}
     done_maps: set = set()
     for leaf in leaves:
+        sl = (struct_list_tops.get(leaf.path[0])
+              if leaf.max_rep_level else None)
+        if sl is not None:
+            if leaf.path[0] in done_maps:
+                continue  # all element leaves marshal together
+            done_maps.add(leaf.path[0])
+            top, rep_node, elem, elem_leaves = sl
+            name = top.name
+            elem_optional = elem is not rep_node and not elem.is_required
+            pl_vals = {lf.name: [] for lf in elem_leaves}
+            pl_mask = {lf.name: [] for lf in elem_leaves}
+            enull: list = []  # True = the element group itself is null
+            offs = _np.zeros(len(objs) + 1, dtype=_np.int64)
+            mask = None
+            for i, o in enumerate(objs):
+                v = getter(o, name)
+                if v is None:
+                    # a bare repeated group has no null state: absent
+                    # means empty, matching the row path
+                    if top is not rep_node and not top.is_required:
+                        if mask is None:
+                            mask = _np.ones(len(objs), dtype=bool)
+                        mask[i] = False
+                    elif top is not rep_node:
+                        raise ValueError(
+                            f"column {name!r} is required but object "
+                            f"{i} has no value")
+                    offs[i + 1] = offs[i]
+                    continue
+                offs[i + 1] = offs[i] + len(v)
+                for e in v:
+                    if e is None:
+                        if not elem_optional:
+                            raise ValueError(
+                                f"column {name!r} element is required "
+                                f"but object {i} contains None")
+                        enull.append(True)
+                        for lf in elem_leaves:
+                            # True keeps required-leaf masks all-true
+                            # (never emitted); the group-null mask
+                            # excludes the slot either way
+                            pl_mask[lf.name].append(lf.is_required)
+                        continue
+                    enull.append(False)
+                    for lf in elem_leaves:
+                        fv = getter(e, lf.name)
+                        if fv is None:
+                            if lf.is_required:
+                                raise ValueError(
+                                    f"{lf.flat_name!r} is required but "
+                                    f"an element of object {i} has no "
+                                    "value")
+                            pl_mask[lf.name].append(False)
+                        else:
+                            pl_mask[lf.name].append(True)
+                            pl_vals[lf.name].append(
+                                _encode_leaf(fv, lf))
+            columns[name] = tuple(pl_vals[lf.name] for lf in elem_leaves)
+            offsets[name] = offs
+            if mask is not None:
+                masks[name] = mask
+            emd = {lf.flat_name: _np.asarray(pl_mask[lf.name],
+                                             dtype=bool)
+                   for lf in elem_leaves if not all(pl_mask[lf.name])}
+            if any(enull):
+                emd[elem.flat_name] = _np.asarray(enull, dtype=bool)
+            if emd:
+                element_masks[name] = emd
+            continue
         mtop = (map_top_by_name.get(leaf.path[0])
                 if leaf.max_rep_level else None)
         if mtop is not None:
@@ -467,8 +575,8 @@ def objects_from_columns(columns, cls, schema, n_rows=None) -> list:
     -> ``list[cls]``, with the same leaf conversions as
     :func:`from_row` (strings, date/time/timestamp units, UUID) —
     but no per-row record assembly.  Flat, STRUCT (nested dataclass
-    fields), MAP (dict fields), and LIST-of-primitive columns are
-    supported.  ``n_rows``
+    fields), MAP (dict fields), LIST-of-primitive, and LIST-of-struct
+    columns are supported.  ``n_rows``
     is required when no dataclass field matches a file column (there
     is then no column to infer the row count from)."""
     if not dataclasses.is_dataclass(cls):
@@ -476,6 +584,7 @@ def objects_from_columns(columns, cls, schema, n_rows=None) -> list:
     list_leaves = {}
     struct_tops = set()
     map_tops = {}
+    struct_list_tops = {}
     for leaf in schema.leaves:
         if len(leaf.path) == 1 and not leaf.max_rep_level:
             continue
@@ -492,15 +601,40 @@ def objects_from_columns(columns, cls, schema, n_rows=None) -> list:
             continue
         top = _bulk_list_leaf(schema, leaf)
         if top is None:
+            sl = _bulk_struct_list(schema, leaf.path[0])
+            if sl is not None:
+                struct_list_tops[sl[0].name] = sl
+                continue
             raise ValueError(
                 f"objects_from_columns supports flat schemas, STRUCT, "
-                f"MAP, and LIST-of-primitive columns; "
+                f"MAP, LIST-of-primitive, and LIST-of-struct columns; "
                 f"{leaf.flat_name!r} is nested (use iteration/scan)")
         list_leaves[top.name] = leaf
     field_cols: list = []
     for f, hint in _dc_fields(cls):
         name = field_name(f)
         node = _child_named(schema.root, name)
+        if node is not None and name in struct_list_tops:
+            top, rep_node, elem, elem_leaves = struct_list_tops[name]
+            cds = {lf.name: columns.get(lf.flat_name)
+                   for lf in elem_leaves}
+            if all(cd is None for cd in cds.values()):
+                field_cols.append((f.name, None))
+                continue
+            hint_u = _unwrap_optional(hint)[0] if hint is not None \
+                else None
+            args = typing.get_args(hint_u) if hint_u else ()
+            ehint = _unwrap_optional(args[0])[0] if args else None
+            out = _struct_lists_from_chunks(
+                cds, top, rep_node, elem, elem_leaves, ehint)
+            if n_rows is None:
+                n_rows = len(out)
+            elif n_rows != len(out):
+                raise ValueError(
+                    f"column {name!r} has {len(out)} rows, "
+                    f"expected {n_rows}")
+            field_cols.append((f.name, out))
+            continue
         if node is not None and name in map_tops:
             top, key_leaf, val_leaf = map_tops[name]
             cd_k = columns.get(key_leaf.flat_name)
@@ -654,6 +788,73 @@ def _structs_from_chunks(columns, node: SchemaNode, hint):
         if present[i] else None
         for i in range(n)
     ]
+
+
+def _struct_lists_from_chunks(cds, top: SchemaNode, rep_node: SchemaNode,
+                              elem: SchemaNode, elem_leaves, ehint):
+    """Reconstruct per-row ``list[dataclass]`` values from the element
+    leaves' ChunkData — all leaf streams share rep levels and slot
+    structure; the first available stream drives the walk and each
+    leaf's own def levels say whether its field is set per slot."""
+    if ehint is None or not dataclasses.is_dataclass(ehint):
+        raise ValueError(
+            f"LIST-of-struct column {top.name!r} needs a list[dataclass] "
+            "field type in the bulk path (use iteration/scan)")
+    from ..io.values import handler_for
+
+    drive_name, drive = next(
+        (n, cd) for n, cd in cds.items() if cd is not None)
+    rep = drive.rep_levels.tolist()
+    streams = {}
+    for lf in elem_leaves:
+        cd = cds[lf.name]
+        if cd is None:
+            continue
+        streams[lf.name] = (
+            handler_for(lf.element).to_pylist(cd.values),
+            cd.def_levels.tolist(), lf, [0])
+    drive_dl = streams[drive_name][1]
+    # dataclass attr per leaf name
+    attr_of = {field_name(f): f.name for f in dataclasses.fields(ehint)}
+    hints = {field_name(f): _unwrap_optional(h)[0] if h is not None
+             else None for f, h in _dc_fields(ehint)}
+    # projection dropped these leaves: their attrs fill with None,
+    # matching the flat path's behavior for unmatched columns
+    absent = [attr_of[lf.name] for lf in elem_leaves
+              if lf.name not in streams and lf.name in attr_of]
+    slot_def = rep_node.max_def_level  # list holds an entry at >= this
+    elem_def = elem.max_def_level      # ... a non-null element at >= this
+    row_nullable = top is not rep_node and not top.is_required
+    def_t = top.max_def_level
+    out = []
+    _no_row = object()
+    row = _no_row
+    for slot, (r, d) in enumerate(zip(rep, drive_dl)):
+        if r == 0:
+            if row is not _no_row:
+                out.append(row)
+            row = []
+        if d >= slot_def:
+            if d < elem_def:
+                row.append(None)  # null element (optional elem group)
+            else:
+                kwargs = {attr: None for attr in absent}
+                for lname, (vals, dl, lf, k) in streams.items():
+                    attr = attr_of.get(lname)
+                    if dl[slot] == lf.max_def_level:
+                        v = _decode_leaf(vals[k[0]], lf,
+                                         hints.get(lname))
+                        k[0] += 1
+                        if attr is not None:
+                            kwargs[attr] = v
+                    elif attr is not None:
+                        kwargs[attr] = None
+                row.append(ehint(**kwargs))
+        elif row_nullable and d < def_t:
+            row = None
+    if row is not _no_row:
+        out.append(row)
+    return out
 
 
 def _maps_from_chunks(cd_k, cd_v, top: SchemaNode, key_leaf: SchemaNode,

@@ -22,11 +22,32 @@ from ..format.footer import MAGIC, write_footer
 from ..format.metadata import (
     ColumnChunk,
     CompressionCodec,
+    ConvertedType,
     Encoding,
     FileMetaData,
     KeyValue,
     RowGroup,
 )
+
+
+def _is_element_struct_leaf(leaf) -> bool:
+    """True when a rep-level-1 leaf sits inside an element GROUP (the
+    tuple-of-per-leaf-arrays contract, even with one leaf), False for
+    single-value list shapes (bare repeated leaf, 2-level legacy,
+    canonical LIST of a primitive)."""
+    if leaf.is_repeated:
+        return False  # bare repeated leaf / 2-level legacy element
+    parent = leaf.parent
+    if parent is not None and parent.is_repeated:
+        gp = parent.parent
+        if gp is not None and len(parent.children) == 1:
+            el = gp.element
+            lt = getattr(el, "logicalType", None)
+            if getattr(el, "converted_type", None) == ConvertedType.LIST \
+                    or (lt is not None and lt.set_member()[0] == "LIST"):
+                return False  # canonical LIST single element
+        return True  # repeated struct group (incl. MAP key_value)
+    return True  # element group below the repeated node
 from ..format.schema import Schema
 from .chunk import write_chunk
 from .pages import SUPPORTED_DATA_ENCODINGS
@@ -204,7 +225,7 @@ class FileWriter:
                         "(row -> element ranges)"
                     )
                 k_leaves = rep_leaf_counts[key]
-                if k_leaves > 1:
+                if k_leaves > 1 or _is_element_struct_leaf(leaf):
                     # MAP key_value / element struct: one tuple of
                     # per-leaf arrays (schema leaf order) sharing the
                     # row->slot offsets; element masks are keyed by
@@ -221,7 +242,11 @@ class FileWriter:
                     rep_leaf_index[key] = i + 1
                     leaf_vals = col[i]
                     em = (element_masks or {}).get(key)
+                    gm = None
                     if isinstance(em, dict):
+                        # the element GROUP's flat name marks null
+                        # elements (one level below null fields)
+                        gm = em.get(leaf.parent.flat_name)
                         em = em.get(leaf.flat_name)
                     elif em is not None:
                         raise ValueError(
@@ -232,9 +257,10 @@ class FileWriter:
                 else:
                     leaf_vals = columns[key]
                     em = (element_masks or {}).get(key)
+                    gm = None
                 vals, rep, dl, rows = self._prepare_repeated(
                     leaf, leaf_vals, np.asarray(offsets[key]),
-                    (masks or {}).get(key), em,
+                    (masks or {}).get(key), em, group_null=gm,
                 )
                 reps[leaf.flat_name] = rep
             elif len(leaf.path) != 1:
@@ -353,14 +379,24 @@ class FileWriter:
                 f"vs {nn} present rows (pass only non-null values)")
         return vals, dl, n_rows
 
-    def _prepare_repeated(self, leaf, vals, offs, row_mask, elem_mask):
-        """Offsets-based LIST column -> (values, rep, def, n_rows)."""
+    def _prepare_repeated(self, leaf, vals, offs, row_mask, elem_mask,
+                          group_null=None):
+        """Offsets-based LIST column -> (values, rep, def, n_rows).
+
+        ``group_null`` (full-slot bool, True = the element GROUP is
+        null at that slot) serves lists of structs whose element group
+        is optional: a null element sits one definition level below a
+        present element with null fields."""
         # the nearest repeated ancestor sets the empty/null def levels
         node = leaf
         rep_node = None
+        elem_opt = None  # optional group strictly between rep and leaf
         while node is not None:
             if node.is_repeated:
                 rep_node = node
+            elif node is not leaf and rep_node is None \
+                    and not node.is_required and node.parent is not None:
+                elem_opt = node
             node = node.parent
         if leaf.max_rep_level != 1 or rep_node is None:
             raise ValueError(
@@ -397,21 +433,48 @@ class FileWriter:
         dl[placeholder] = empty_def
         if row_mask is not None:
             dl[first[~row_mask]] = rep_node.max_def_level - 2
-        if elem_mask is not None:
-            elem_mask = np.asarray(elem_mask, dtype=bool)
-            if elem_mask.size != int(offs[-1]):
-                raise ValueError("element mask length != total elements")
-            if leaf.max_def_level == rep_node.max_def_level:
+        if group_null is not None:
+            if elem_opt is None:
                 raise ValueError(
-                    f"column {leaf.flat_name!r}: element is required; "
-                    "an element mask is not allowed"
+                    f"column {leaf.flat_name!r}: no optional element "
+                    "group on the path; a group-null mask is not allowed"
                 )
+            group_null = np.asarray(group_null, dtype=bool)
+            if group_null.size != int(offs[-1]):
+                raise ValueError(
+                    "group-null mask length != total elements")
+        if elem_mask is not None or group_null is not None:
+            if elem_mask is not None:
+                elem_mask = np.asarray(elem_mask, dtype=bool)
+                if elem_mask.size != int(offs[-1]):
+                    raise ValueError(
+                        "element mask length != total elements")
+                # the leaf itself must be optional: its def must sit
+                # one above the innermost optional ancestor (element
+                # group if present, else the repeated node) — a mask on
+                # a required field would write a schema-violating file
+                floor_def = (elem_opt.max_def_level
+                             if elem_opt is not None
+                             else rep_node.max_def_level)
+                if leaf.max_def_level == floor_def:
+                    raise ValueError(
+                        f"column {leaf.flat_name!r}: element is "
+                        "required; an element mask is not allowed"
+                    )
             elem_slots = np.ones(total, dtype=bool)
             elem_slots[placeholder] = False
-            dl_elems = np.where(elem_mask, leaf.max_def_level,
-                                leaf.max_def_level - 1).astype(np.int32)
+            dl_elems = np.full(int(offs[-1]), leaf.max_def_level,
+                               dtype=np.int32)
+            valid = np.ones(int(offs[-1]), dtype=bool)
+            if elem_mask is not None:
+                dl_elems[~elem_mask] = leaf.max_def_level - 1
+                valid &= elem_mask
+            if group_null is not None:
+                # a null element group sits below any field-level null
+                dl_elems[group_null] = elem_opt.max_def_level - 1
+                valid &= ~group_null
             dl[elem_slots] = dl_elems
-            n_vals = int(elem_mask.sum())
+            n_vals = int(valid.sum())
         else:
             n_vals = int(offs[-1])
         handler = handler_for(leaf.element)
