@@ -353,3 +353,104 @@ class TestByteStreamSplit:
         # first output stream is every value's byte 0
         vals = np.array([0x0102, 0x0304], dtype=np.uint16)
         assert encode_byte_stream_split(vals) == bytes([0x02, 0x04, 0x01, 0x03])
+
+
+class TestSmallRangeInterner:
+    """O(n + range) integer interning must be indistinguishable from
+    the sort-based unique path (first-occurrence order)."""
+
+    def test_parity_with_unique_path(self):
+        from tpuparquet.cpu.dictionary import (
+            _build_int_dictionary_smallrange,
+            build_dictionary,
+        )
+
+        rng = np.random.default_rng(40)
+        cases = [
+            rng.integers(1, 7, 10_000).astype(np.int32),
+            rng.integers(100, 50_000, 30_000),
+            rng.integers(-500, 500, 7_777),
+            rng.integers(0, 256, 4_096).astype(np.uint8),
+            np.array([5, 5, 5], dtype=np.int64),
+            np.array([2, 1, 2, 0], dtype=np.int32),
+        ]
+        for a in cases:
+            fast = _build_int_dictionary_smallrange(a)
+            assert fast is not None
+            uniq, first_idx, inv = np.unique(
+                a, return_index=True, return_inverse=True)
+            order = np.argsort(first_idx, kind="stable")
+            rank = np.empty_like(order)
+            rank[order] = np.arange(order.size)
+            assert np.array_equal(fast[0], uniq[order])
+            assert np.array_equal(fast[1], rank[inv].astype(np.int32))
+
+    def test_wide_range_falls_through(self):
+        from tpuparquet.cpu.dictionary import (
+            _build_int_dictionary_smallrange,
+        )
+
+        rng = np.random.default_rng(41)
+        assert _build_int_dictionary_smallrange(
+            rng.integers(0, 1 << 60, 100)) is None
+        # full-span int64: the Python-int range must not wrap
+        assert _build_int_dictionary_smallrange(np.array(
+            [-(2**63), 2**63 - 1], dtype=np.int64)) is None
+        # range much wider than n: the O(range) table would be slower
+        # than the unique path it replaces
+        assert _build_int_dictionary_smallrange(
+            rng.integers(0, 1_000_000, 4097)) is None
+
+    def test_uint64_above_int64_max(self):
+        from tpuparquet.cpu.dictionary import (
+            _build_int_dictionary_smallrange,
+        )
+
+        a = np.array([2**63 + 5, 2**63 + 6] * 3000, dtype=np.uint64)
+        fast = _build_int_dictionary_smallrange(a)
+        assert fast is not None
+        assert np.array_equal(fast[0],
+                              np.array([2**63 + 5, 2**63 + 6],
+                                       dtype=np.uint64))
+        assert np.array_equal(fast[1], np.tile([0, 1], 3000))
+
+    def test_unsigned_sawtooth_keeps_dictionary(self):
+        import io
+
+        from tpuparquet import FileReader, FileWriter
+        from tpuparquet.format.metadata import Encoding
+
+        # a uint64 sawtooth is NOT monotonic; np.diff would wrap and
+        # claim it is, silently disabling the dictionary
+        vals = np.array([5, 3] * 3000, dtype=np.uint64)
+        buf = io.BytesIO()
+        w = FileWriter(
+            buf, "message m { required int64 a (INT(64,false)); }")
+        w.write_columns({"a": vals})
+        w.close()
+        buf.seek(0)
+        r = FileReader(buf)
+        cm = r.meta.row_groups[0].columns[0].meta_data
+        assert Encoding.RLE_DICTIONARY in [
+            Encoding(e) for e in cm.encodings]
+
+    def test_monotonic_reject_matches_gate(self):
+        import io
+
+        from tpuparquet import FileReader, FileWriter
+
+        # strictly increasing: dict must not engage, decoded values
+        # identical
+        vals = np.arange(10_000, dtype=np.int64) * 3 + 7
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required int64 a; }")
+        w.write_columns({"a": vals})
+        w.close()
+        buf.seek(0)
+        r = FileReader(buf)
+        cm = r.meta.row_groups[0].columns[0].meta_data
+        from tpuparquet.format.metadata import Encoding
+        assert Encoding.RLE_DICTIONARY not in [
+            Encoding(e) for e in cm.encodings]
+        got = r.read_row_group_arrays(0)["a"]
+        np.testing.assert_array_equal(np.asarray(got.values), vals)

@@ -207,6 +207,18 @@ def _maybe_dictionary(column, allow_dict: bool):
         np.asarray(column).shape[0]
     if n == 0:
         return None, None
+    if not isinstance(column, ByteArrayColumn):
+        arr = np.asarray(column)
+        if arr.ndim == 1 and arr.dtype.kind in "iuf" and n > 4096:
+            # strictly monotonic values (timestamps, row ids) are all
+            # distinct: the dictionary would be the column itself plus
+            # packed indices — reject without paying the sort.
+            # Elementwise compares, NOT np.diff: a diff wraps on
+            # unsigned dtypes (and on int64 steps past 2**63) and
+            # would misclassify unsorted data as monotonic.
+            a, b = arr[1:], arr[:-1]
+            if bool((a > b).all()) or bool((a < b).all()):
+                return None, None
     dictionary, indices = build_dictionary(column)
     dsize = len(dictionary) if isinstance(dictionary, ByteArrayColumn) else \
         dictionary.shape[0]
