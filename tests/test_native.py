@@ -448,3 +448,30 @@ class TestNativePack:
                 np.array([16], dtype=np.int32), np.array([1], np.uint8),
                 np.array([5], np.uint32), np.array([0], np.int32),
                 np.zeros(0, np.uint8), 0, 16, 2)
+
+    def test_hybrid_expand_matches_numpy(self):
+        import tpuparquet.native as N
+        from tpuparquet.cpu.hybrid import (
+            encode_hybrid,
+            expand_scan,
+            scan_hybrid,
+        )
+
+        self._nat()
+        rng = np.random.default_rng(33)
+        for trial in range(50):
+            w = int(rng.integers(1, 33))
+            n = int(rng.integers(1, 6000))
+            vals = rng.integers(0, 1 << w, n, dtype=np.uint64)
+            if trial % 3 == 0:
+                vals = np.where(rng.random(n) < 0.6, vals[0], vals)
+            enc = encode_hybrid(vals.astype(np.uint32), w)
+            scan = scan_hybrid(np.frombuffer(enc, np.uint8), n, w)
+            got = expand_scan(*scan[:6], n, w)
+            # numpy fallback as the oracle for the oracle
+            from unittest import mock
+            with mock.patch.object(N, "_pack_inst",
+                                   N._PACK_UNAVAILABLE):
+                want = expand_scan(*scan[:6], n, w)
+            assert np.array_equal(got, want), (trial, w, n)
+            assert np.array_equal(got, vals.astype(got.dtype))

@@ -129,6 +129,16 @@ def expand_scan(run_ends, run_is_rle, run_value, run_bp_start, bp_bytes,
         lens = np.diff(run_ends, prepend=np.int32(0))
         return np.repeat(run_value.astype(dtype, copy=False),
                          lens)[:count]
+    if width <= 32:
+        from ..native import pack_native
+
+        nat = pack_native()
+        if nat is not None:
+            out = nat.hybrid_expand(run_ends, run_is_rle, run_value,
+                                    run_bp_start, bp_bytes, n_bp,
+                                    count, width)
+            if out is not None:
+                return out.astype(dtype, copy=False)
     unpacked = (unpack(bp_bytes, n_bp, width) if n_bp
                 else np.zeros(1, dtype=dtype))
     idx = np.arange(count, dtype=np.int64)

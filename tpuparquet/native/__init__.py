@@ -466,8 +466,16 @@ class NativePack:
     def __init__(self, lib: ctypes.CDLL):
         self._pack64 = getattr(lib, "tpq_pack64", None)
         self._repack = getattr(lib, "tpq_hybrid_repack", None)
-        if None in (self._pack64, self._repack):
+        self._expand = getattr(lib, "tpq_hybrid_expand32", None)
+        if None in (self._pack64, self._repack, self._expand):
             raise RuntimeError("native library too old; rebuild")
+        self._expand.restype = ctypes.c_longlong
+        self._expand.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_longlong,
+            ctypes.c_void_p, ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_longlong, ctypes.c_int, ctypes.c_void_p,
+        ]
         self._pack64.restype = ctypes.c_longlong
         self._pack64.argtypes = [
             ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int,
@@ -495,24 +503,55 @@ class NativePack:
             raise ValueError(f"bit width {width} out of range 0..64")
         return out[:n]
 
+    @staticmethod
+    def _run_table(run_ends, run_is_rle, run_value, run_bp_start,
+                   bp_bytes, count: int, width: int):
+        """Validated, C-ready run table for expand/repack, or None
+        when the fallback must handle it: widths > 32, or a table that
+        does not cover count — that shape cannot come from a valid
+        scan, and the numpy paths disagree with each other on it, so
+        don't pin semantics here."""
+        if not 0 < width <= 32 or not len(run_ends):
+            return None
+        if int(run_ends[-1]) < count:
+            return None
+        return (np.ascontiguousarray(run_ends, dtype=np.int32),
+                np.ascontiguousarray(run_is_rle, dtype=np.uint8),
+                np.ascontiguousarray(run_value, dtype=np.uint32),
+                np.ascontiguousarray(run_bp_start, dtype=np.int32),
+                _as_u8(bp_bytes))
+
+    def hybrid_expand(self, run_ends, run_is_rle, run_value,
+                      run_bp_start, bp_bytes, n_bp: int, count: int,
+                      width: int) -> np.ndarray | None:
+        """Run table -> (count,) u32 values in one C pass (pass 2 of
+        the two-pass hybrid decode).  None for widths > 32 or tables
+        that do not cover count (caller falls back to numpy)."""
+        t = self._run_table(run_ends, run_is_rle, run_value,
+                            run_bp_start, bp_bytes, count, width)
+        if t is None:
+            return None
+        ends, rle, val, bps, bp = t
+        out = np.empty(count, dtype=np.uint32)
+        rc = self._expand(
+            ends.ctypes.data, rle.ctypes.data, val.ctypes.data,
+            bps.ctypes.data, ends.size, bp.ctypes.data, bp.size,
+            int(n_bp), count, width, out.ctypes.data)
+        if rc != 0:
+            raise ValueError(f"hybrid expand failed (rc={rc})")
+        return out
+
     def hybrid_repack(self, run_ends, run_is_rle, run_value,
                       run_bp_start, bp_bytes, n_bp: int, count: int,
                       width: int) -> np.ndarray | None:
         """Run table -> ONE bit-packed run, no expanded intermediate.
         Returns the packed bytes, or None for widths > 32 (caller
         falls back to expand + pack)."""
-        if not 0 < width <= 32 or not len(run_ends):
+        t = self._run_table(run_ends, run_is_rle, run_value,
+                            run_bp_start, bp_bytes, count, width)
+        if t is None:
             return None
-        if int(run_ends[-1]) < count:
-            # a table that does not cover count cannot come from a
-            # valid scan; the numpy paths disagree with each other on
-            # it, so leave it to the fallback rather than pin semantics
-            return None
-        ends = np.ascontiguousarray(run_ends, dtype=np.int32)
-        rle = np.ascontiguousarray(run_is_rle, dtype=np.uint8)
-        val = np.ascontiguousarray(run_value, dtype=np.uint32)
-        bps = np.ascontiguousarray(run_bp_start, dtype=np.int32)
-        bp = _as_u8(bp_bytes)
+        ends, rle, val, bps, bp = t
         n = (count * width + 7) // 8
         out = np.empty(n + 8, dtype=np.uint8)  # word-writer slack
         rc = self._repack(

@@ -68,6 +68,47 @@ static inline uint64_t load_bits(const uint8_t *bp, long long bp_len,
     return w & (((uint64_t)1 << width) - 1);
 }
 
+/* Expand a hybrid RLE/BP run table to values — pass 2 of the two-pass
+ * decode, one C pass instead of the numpy searchsorted-over-runs
+ * formulation (the CPU oracle's hottest function on mixed-run level
+ * and dict-index streams).  Clamp semantics mirror the numpy mixed
+ * branch: the last run extends to count, bit-packed positions clamp to
+ * the stream's final value.  width 1..32. */
+long long tpq_hybrid_expand32(const int32_t *ends, const uint8_t *is_rle,
+                              const uint32_t *value,
+                              const int32_t *bp_start, long long n_runs,
+                              const uint8_t *bp, long long bp_len,
+                              long long n_bp, long long count, int width,
+                              uint32_t *out) {
+    if (width <= 0 || width > 32 || n_runs <= 0)
+        return -2;
+    long long o = 0;
+    long long prev = 0;
+    for (long long r = 0; r < n_runs && prev < count; r++) {
+        long long end = (r == n_runs - 1) ? count : ends[r];
+        if (end > count)
+            end = count;
+        if (end < prev)
+            return -2;
+        long long len = end - prev;
+        if (is_rle[r]) {
+            const uint32_t x = value[r];
+            for (long long i = 0; i < len; i++)
+                out[o++] = x;
+        } else {
+            long long lim = (n_bp > 0 ? n_bp - 1 : 0) * (long long)width;
+            long long bit = (long long)bp_start[r] * width;
+            for (long long i = 0; i < len; i++, bit += width)
+                out[o++] = (uint32_t)load_bits(
+                    bp, bp_len, bit > lim ? lim : bit, width);
+        }
+        prev = end;
+    }
+    while (o < count)
+        out[o++] = 0; /* unreachable for valid scans (ends cover count) */
+    return 0;
+}
+
 /* Re-pack a hybrid RLE/BP run table into ONE bit-packed run.
  * Run k covers value indices [ends[k-1], ends[k]); RLE runs repeat
  * value[k], bit-packed runs read consecutive width-bit values from the
