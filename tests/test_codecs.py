@@ -454,3 +454,27 @@ class TestSmallRangeInterner:
             Encoding(e) for e in cm.encodings]
         got = r.read_row_group_arrays(0)["a"]
         np.testing.assert_array_equal(np.asarray(got.values), vals)
+
+
+class TestGatherVarNative:
+    def test_bytes_gather_matches_fallback(self):
+        from unittest import mock
+
+        import tpuparquet.native as N
+        from tpuparquet.cpu.dictionary import gather
+        from tpuparquet.cpu.plain import ByteArrayColumn
+
+        nat = N.delta_native()
+        if nat is None or nat._gather_var is None:
+            pytest.skip("native gather_var unavailable")
+
+        rng = np.random.default_rng(50)
+        words = [rng.bytes(int(rng.integers(0, 40))) for _ in range(200)]
+        d = ByteArrayColumn.from_list(words)
+        idx = rng.integers(0, len(words), 5000).astype(np.int32)
+        got = gather(d, idx)
+        with mock.patch.object(N, "_delta_inst", N._DELTA_UNAVAILABLE):
+            want = gather(d, idx)
+        assert np.array_equal(got.offsets, want.offsets)
+        assert np.array_equal(got.data, want.data)
+        assert got.to_list() == [words[i] for i in idx]

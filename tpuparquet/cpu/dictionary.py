@@ -62,14 +62,27 @@ def gather(dictionary, indices: np.ndarray):
         np.cumsum(lens, out=offsets[1:])
         src_off = np.asarray(dictionary.offsets, dtype=np.int64)
         data = np.asarray(dictionary.data)
-        # vectorized byte gather: out byte b of value i comes from
-        # src_off[idx[i]] + (b - offsets[i]) — fancy indexing instead of
-        # a per-value Python loop (2.7 -> ~9 M values/s on strings).
-        # Value-aligned slabs bound the int64 position temporaries to
-        # ~3x slab size instead of ~24x the whole output.
         total = int(offsets[-1])
+        starts = src_off[idx]
+        from ..native import delta_native
+
+        nat = delta_native()
+        if nat is not None:
+            out = nat.gather_var(data, starts, lens, total)
+            if out is not None:
+                return ByteArrayColumn(offsets, out)
+            from ..stats import current_stats
+
+            st = current_stats()
+            if st is not None:  # stale .so: record the quiet slow path
+                st.native_fallbacks += 1
+        # numpy fallback: out byte b of value i comes from
+        # src_off[idx[i]] + (b - offsets[i]) — fancy indexing instead of
+        # a per-value Python loop.  Value-aligned slabs bound the int64
+        # position temporaries to ~3x slab size instead of ~24x the
+        # whole output.
         out = np.empty(total, dtype=np.uint8)
-        shift = src_off[idx] - offsets[:-1]
+        shift = starts - offsets[:-1]
         slab = 4 << 20
         va = 0
         while va < idx.size:

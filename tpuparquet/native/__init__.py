@@ -401,6 +401,30 @@ class NativeDelta:
                 ctypes.c_void_p, ctypes.c_longlong, ctypes.c_longlong,
                 ctypes.c_void_p,
             ]
+        self._gather_var = getattr(lib, "tpq_gather_var", None)
+        if self._gather_var is not None:
+            self._gather_var.restype = ctypes.c_longlong
+            self._gather_var.argtypes = [
+                ctypes.c_void_p, ctypes.c_longlong,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong,
+                ctypes.c_void_p, ctypes.c_longlong,
+            ]
+
+    def gather_var(self, src, starts, lens, total: int):
+        """Concatenate variable-length segments of ``src`` in one C
+        pass; None when the symbol is missing (stale .so)."""
+        if self._gather_var is None:
+            return None
+        buf = _as_u8(src)
+        s = np.ascontiguousarray(starts, dtype=np.int64)
+        ln = np.ascontiguousarray(lens, dtype=np.int64)
+        out = np.empty(max(total, 1), dtype=np.uint8)[:total]
+        rc = self._gather_var(buf.ctypes.data, buf.size,
+                              s.ctypes.data, ln.ctypes.data, s.size,
+                              out.ctypes.data, total)
+        if rc != 0:
+            raise ValueError("segment out of bounds")
+        return out
 
     def gather_segments(self, src, positions, nbytes: int):
         """Concatenate fixed-size segments of ``src`` at ``positions``

@@ -52,6 +52,24 @@ long long tpq_gather_segments(const uint8_t *src, long long src_len,
     return 0;
 }
 
+/* Gather n variable-length segments into one contiguous buffer —
+ * the byte-array dictionary gather (one memcpy per value instead of
+ * numpy arange/repeat position temporaries). */
+long long tpq_gather_var(const uint8_t *src, long long src_len,
+                         const int64_t *start, const int64_t *lens,
+                         long long n, uint8_t *out, long long out_len) {
+    long long o = 0;
+    for (long long i = 0; i < n; i++) {
+        long long L = lens[i];
+        if (L < 0 || start[i] < 0 || start[i] + L > src_len
+            || o + L > out_len)
+            return -1;
+        __builtin_memcpy(out + o, src + start[i], (size_t)L);
+        o += L;
+    }
+    return 0;
+}
+
 long long tpq_delta_scan_blocks(
     const uint8_t *data, long long data_len, long long pos,
     long long n_deltas, long long mb_size, long long n_miniblocks,
