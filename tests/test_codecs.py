@@ -478,3 +478,51 @@ class TestGatherVarNative:
         assert np.array_equal(got.offsets, want.offsets)
         assert np.array_equal(got.data, want.data)
         assert got.to_list() == [words[i] for i in idx]
+
+
+class TestUniqueRows:
+    def test_matches_void_unique(self):
+        from tpuparquet.cpu.dictionary import _unique_rows
+
+        rng = np.random.default_rng(60)
+        for k, L in [(1, 1), (7, 3), (5000, 14), (3000, 1), (4096, 8),
+                     (2000, 33)]:
+            rows = rng.integers(0, 4, (k, L), dtype=np.uint8)
+            first_idx, inv = _unique_rows(rows)
+            # exact oracle
+            view = np.ascontiguousarray(rows).view(
+                np.dtype((np.void, L))).reshape(-1)
+            _, w_first, w_inv = np.unique(view, return_index=True,
+                                          return_inverse=True)
+            # sort orders may differ; compare as sets of groups:
+            # first-occurrence index per element must agree
+            np.testing.assert_array_equal(first_idx[inv], w_first[w_inv])
+            # and every element maps to a row equal to its group head
+            assert np.array_equal(rows[first_idx[inv]], rows)
+
+    def test_collision_fallback_exact(self):
+        from unittest import mock
+
+        import tpuparquet.cpu.dictionary as D
+
+        rng = np.random.default_rng(61)
+        rows = rng.integers(0, 3, (500, 6), dtype=np.uint8)
+        want_first, want_inv = D._unique_rows_void(rows)
+        # force every hash equal: the verify must catch it and the
+        # void fallback must produce the exact answer
+        with mock.patch.object(
+                D, "_hash_rows",
+                lambda r: np.zeros(r.shape[0], dtype=np.uint64)):
+            first_idx, inv = D._unique_rows(rows)
+        np.testing.assert_array_equal(first_idx, want_first)
+        np.testing.assert_array_equal(inv, want_inv)
+
+    def test_long_rows_take_void_path(self):
+        from tpuparquet.cpu.dictionary import _unique_rows
+
+        rng = np.random.default_rng(62)
+        base = rng.integers(0, 256, (4, 200_000), dtype=np.uint8)
+        rows = base[rng.integers(0, 4, 64)]
+        first_idx, inv = _unique_rows(rows)
+        assert np.array_equal(rows[first_idx[inv]], rows)
+        assert first_idx.size == 4

@@ -272,7 +272,12 @@ def write_chunk(out, node: SchemaNode, column, rep, dl, *,
 
     stats = None
     if write_stats:
-        mn, mx = handler.min_max(column)
+        # min/max over the DICTIONARY when one was built: every distinct
+        # value appears in it, so the reduction runs over D entries
+        # instead of materializing n Python objects (byte columns paid
+        # a 2M-element to_list here)
+        mn, mx = handler.min_max(
+            dictionary if dictionary is not None else column)
         stats = Statistics(
             null_count=null_count,
             distinct_count=distinct,
