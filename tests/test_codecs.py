@@ -526,3 +526,44 @@ class TestUniqueRows:
         first_idx, inv = _unique_rows(rows)
         assert np.array_equal(rows[first_idx[inv]], rows)
         assert first_idx.size == 4
+
+
+class TestPlainByteArrayScanNative:
+    def test_matches_fallback_and_messages(self):
+        from unittest import mock
+
+        import tpuparquet.native as N
+        from tpuparquet.cpu.plain import (
+            ByteArrayColumn,
+            _decode_plain_byte_array,
+            encode_plain,
+        )
+        from tpuparquet.format.metadata import Type
+
+        nat = N.delta_native()
+        if nat is None or nat._ba_scan is None:
+            pytest.skip("native byte-array scan unavailable")
+        rng = np.random.default_rng(70)
+        vals = [rng.bytes(int(rng.integers(0, 30))) for _ in range(800)]
+        enc = encode_plain(Type.BYTE_ARRAY, ByteArrayColumn.from_list(vals))
+        got = _decode_plain_byte_array(memoryview(enc), len(vals))
+        with mock.patch.object(N, "_delta_inst", N._DELTA_UNAVAILABLE):
+            want = _decode_plain_byte_array(memoryview(enc), len(vals))
+        assert np.array_equal(got.offsets, want.offsets)
+        assert np.array_equal(got.data, want.data)
+        assert got.to_list() == vals
+        # malformed: both paths raise ValueError with the SAME message
+        for cut in (3, len(vals[-1]) + 6, 1, len(enc) // 2):
+            bad = bytes(enc[: len(enc) - cut])
+            msgs = []
+            for force in (False, True):
+                ctx = (mock.patch.object(N, "_delta_inst",
+                                         N._DELTA_UNAVAILABLE)
+                       if force else mock.patch.object(
+                           N, "_delta_inst", N._delta_inst))
+                with ctx:
+                    with pytest.raises(ValueError) as ei:
+                        _decode_plain_byte_array(
+                            memoryview(bad), len(vals))
+                    msgs.append(str(ei.value))
+            assert msgs[0] == msgs[1], msgs

@@ -126,13 +126,25 @@ def decode_plain(ptype: Type, data, count: int, type_length: int | None = None):
 def _decode_plain_byte_array(buf: memoryview, count: int) -> ByteArrayColumn:
     """Parse ``count`` (u32-LE length, bytes) records into offsets+data.
 
-    The length prefixes sit at data-dependent positions, so this is a scan;
-    it runs at Python speed per *value* only for the offsets — the payload
-    copy is one slice per value.  (The device path replaces this wholesale.)
-    """
+    The length prefixes sit at data-dependent positions, so this is a
+    scan — one C pass when the native library is available (prefix walk
+    + variable-length gather), else Python per value.  (The device path
+    replaces this wholesale.)"""
+    raw = np.frombuffer(buf, dtype=np.uint8)
+    from ..native import delta_native
+
+    nat = delta_native()
+    if nat is not None:
+        scanned = nat.byte_array_scan(raw, count)
+        if scanned is not None:
+            positions, offsets = scanned
+            lens = offsets[1:] - offsets[:-1]
+            data = nat.gather_var(raw, positions, lens,
+                                  int(offsets[-1]))
+            if data is not None:
+                return ByteArrayColumn(offsets, data)
     offsets = np.zeros(count + 1, dtype=np.int64)
     positions = np.zeros(count, dtype=np.int64)
-    raw = np.frombuffer(buf, dtype=np.uint8)
     pos = 0
     total = 0
     n = len(buf)

@@ -409,6 +409,41 @@ class NativeDelta:
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong,
                 ctypes.c_void_p, ctypes.c_longlong,
             ]
+        self._ba_scan = getattr(lib, "tpq_byte_array_scan", None)
+        if self._ba_scan is not None:
+            self._ba_scan.restype = ctypes.c_longlong
+            self._ba_scan.argtypes = [
+                ctypes.c_void_p, ctypes.c_longlong, ctypes.c_longlong,
+                ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_longlong),
+                ctypes.POINTER(ctypes.c_longlong),
+            ]
+
+    def byte_array_scan(self, buf, count: int):
+        """Scan PLAIN BYTE_ARRAY length prefixes in one C pass:
+        (positions, offsets) or None when the symbol is missing.
+        Raises ValueError with the CPU scanner's messages."""
+        if self._ba_scan is None or count < 0:
+            return None  # negative counts keep the legacy Python path
+        b = _as_u8(buf)
+        positions = np.empty(max(count, 1), dtype=np.int64)[:count]
+        offsets = np.zeros(count + 1, dtype=np.int64)
+        err = ctypes.c_longlong()
+        err_len = ctypes.c_longlong()
+        rc = self._ba_scan(b.ctypes.data, b.size, count,
+                           positions.ctypes.data, offsets.ctypes.data,
+                           ctypes.byref(err), ctypes.byref(err_len))
+        if rc == -1:
+            raise ValueError(
+                f"PLAIN BYTE_ARRAY: truncated length prefix at value "
+                f"{err.value}")
+        if rc == -2:
+            raise ValueError(
+                f"PLAIN BYTE_ARRAY: length {err_len.value} out of "
+                f"bounds at value {err.value}")
+        if rc != 0:
+            raise ValueError(f"byte-array scan failed (rc={rc})")
+        return positions, offsets
 
     def gather_var(self, src, starts, lens, total: int):
         """Concatenate variable-length segments of ``src`` in one C

@@ -52,6 +52,39 @@ long long tpq_gather_segments(const uint8_t *src, long long src_len,
     return 0;
 }
 
+/* Scan count PLAIN BYTE_ARRAY records (u32-LE length prefix + bytes):
+ * emits each value's payload position and the cumulative offsets.
+ * Returns 0, or -1 truncated prefix / -2 length out of bounds with
+ * *err_index the offending value and *err_len its claimed length. */
+long long tpq_byte_array_scan(const uint8_t *buf, long long n,
+                              long long count, int64_t *positions,
+                              int64_t *offsets, long long *err_index,
+                              long long *err_len) {
+    if (count < 0)
+        return -3;
+    long long pos = 0, total = 0;
+    offsets[0] = 0;
+    for (long long i = 0; i < count; i++) {
+        if (pos + 4 > n) {
+            *err_index = i;
+            return -1;
+        }
+        uint32_t ln;
+        __builtin_memcpy(&ln, buf + pos, 4);
+        pos += 4;
+        if ((long long)ln > n - pos) {
+            *err_index = i;
+            *err_len = (long long)ln;
+            return -2;
+        }
+        positions[i] = pos;
+        total += (long long)ln;
+        offsets[i + 1] = total;
+        pos += (long long)ln;
+    }
+    return 0;
+}
+
 /* Gather n variable-length segments into one contiguous buffer —
  * the byte-array dictionary gather (one memcpy per value instead of
  * numpy arange/repeat position temporaries). */
