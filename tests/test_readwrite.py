@@ -867,3 +867,63 @@ class TestElemMaskGuards:
                 element_masks={"items": {
                     "items.list.element.x":
                         np.array([True, False, True])}})
+
+
+class TestByteStatsRefinement:
+    def test_min_max_parity_random(self):
+        from tpuparquet.cpu.plain import ByteArrayColumn
+        from tpuparquet.io.values import _byte_array_min_max, _refine_lex
+
+        rng = np.random.default_rng(80)
+        for trial in range(25):
+            n = int(rng.integers(1, 2000))
+            vals = [rng.bytes(int(rng.integers(0, 25)))
+                    for _ in range(n)]
+            col = ByteArrayColumn.from_list(vals)
+            assert _byte_array_min_max(col) == (min(vals), max(vals))
+        for trial in range(10):
+            k, L = int(rng.integers(1, 1500)), int(rng.integers(1, 20))
+            rows = rng.integers(0, 4, (k, L), dtype=np.uint8)
+            assert _refine_lex(rows, np.min) == min(
+                bytes(r) for r in rows)
+            assert _refine_lex(rows, np.max) == max(
+                bytes(r) for r in rows)
+
+    def test_stats_in_file(self):
+        # PLAIN (non-dict) strings: stats must match Python min/max
+        from tpuparquet.cpu.plain import ByteArrayColumn
+
+        vals = [f"text-{i:06d}".encode() for i in range(9000)]
+        vals[7] = b""
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required binary s (STRING); }",
+                       allow_dict=False)
+        w.write_columns({"s": ByteArrayColumn.from_list(vals)})
+        w.close()
+        buf.seek(0)
+        st = FileReader(buf).meta.row_groups[0].columns[0] \
+            .meta_data.statistics
+        assert st.min_value == b"" and st.max_value == b"text-008999"
+
+    def test_flba_signedness_unsigned_order(self):
+        # raw file bytes compare UNSIGNED: an int8 input view must not
+        # invert the order (0x80 > 0x7f as bytes)
+        from tpuparquet.io.values import _refine_lex
+
+        rows = np.array([[0x7F], [-0x80]], dtype=np.int8)
+        assert _refine_lex(rows, np.min) == b"\x7f"
+        assert _refine_lex(rows, np.max) == b"\x80"
+
+    def test_adversarial_duplicates_bounded(self):
+        # duplicates + long shared prefixes must not degenerate: the
+        # work budget bails to a Python reduce over the candidates
+        from tpuparquet.cpu.plain import ByteArrayColumn
+        from tpuparquet.io.values import _byte_array_min_max
+
+        rng = np.random.default_rng(81)
+        vals = []
+        for i in range(400):
+            v = b"A" * (i % 120 + 1) + rng.bytes(2)
+            vals.extend([v] * 25)
+        col = ByteArrayColumn.from_list(vals)
+        assert _byte_array_min_max(col) == (min(vals), max(vals))

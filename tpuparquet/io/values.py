@@ -261,14 +261,14 @@ class ValueHandler:
         if isinstance(column, ByteArrayColumn):
             if len(column) == 0:
                 return None, None
-            vals = column.to_list()
-            return min(vals), max(vals)  # bytes compare unsigned lexicographic
+            return _byte_array_min_max(column)
         arr = np.asarray(column)
         if arr.size == 0:
             return None, None
         if p == Type.FIXED_LEN_BYTE_ARRAY:
-            vals = [bytes(r) for r in arr]
-            return min(vals), max(vals)
+            mn = _refine_lex(arr, np.min)
+            mx = _refine_lex(arr, np.max)
+            return mn, mx
         if self.unsigned and p in (Type.INT32, Type.INT64):
             u = arr.view(np.uint32 if p == Type.INT32 else np.uint64)
             return arr[int(np.argmin(u))], arr[int(np.argmax(u))]
@@ -311,6 +311,71 @@ class ValueHandler:
         if p == Type.DOUBLE:
             return float(np.frombuffer(b, dtype="<f8")[0])
         return bytes(b)
+
+
+def _refine_lex(rows: np.ndarray, reduce_fn) -> bytes:
+    """Lexicographic (unsigned byte order) extreme of a (k, L) byte
+    matrix by byte-plane refinement: narrow the candidate set one byte
+    position at a time (O(k) for the first plane, collapsing
+    geometrically after) instead of materializing k Python bytes
+    objects.  Ties that refuse to collapse (duplicates, long shared
+    prefixes) fall back to a Python reduce over the remaining
+    candidates once a fixed work budget is spent, so the worst case is
+    never slower than the old to_list path."""
+    if rows.dtype != np.uint8:
+        # the file stores raw bytes: compare UNSIGNED regardless of the
+        # input dtype (an int8 view would invert the order)
+        rows = np.ascontiguousarray(rows).view(np.uint8)
+    k, L = rows.shape
+    use_py = L > 4096  # few, huge values: per-plane dispatch dominates
+    cand = np.arange(k)
+    if not use_py:
+        budget = 4 * k + 1024
+        spent = 0
+        for j in range(L):
+            spent += cand.size
+            if spent > budget:
+                use_py = True
+                break
+            col = rows[cand, j]
+            m = reduce_fn(col)
+            cand = cand[col == m]
+            if cand.size == 1:
+                break
+    if use_py:
+        vals = [bytes(rows[int(i)]) for i in cand]
+        return min(vals) if reduce_fn is np.min else max(vals)
+    return bytes(rows[int(cand[0])])
+
+
+def _byte_array_min_max(col: ByteArrayColumn):
+    """(min, max) of variable-length bytes without ``to_list``: per
+    length group, gather the group's rows once and refine by byte
+    plane; the true extremes are among the per-group extremes, reduced
+    at the end under Python's lexicographic bytes order (which handles
+    the shorter-prefix-sorts-first rule across groups)."""
+    offs = np.asarray(col.offsets, dtype=np.int64)
+    data = np.asarray(col.data)
+    lens = offs[1:] - offs[:-1]
+    mins: list = []
+    maxs: list = []
+    for L in np.unique(lens):
+        L = int(L)
+        sel = np.nonzero(lens == L)[0]
+        if L == 0:
+            mins.append(b"")
+            maxs.append(b"")
+            continue
+        starts = offs[:-1][sel]
+        if L > 4096 or sel.size < 8:
+            vals = [bytes(data[int(s): int(s) + L]) for s in starts]
+            mins.append(min(vals))
+            maxs.append(max(vals))
+            continue
+        rows = data[starts[:, None] + np.arange(L, dtype=np.int64)]
+        mins.append(_refine_lex(rows, np.min))
+        maxs.append(_refine_lex(rows, np.max))
+    return min(mins), max(maxs)
 
 
 def handler_for(element: SchemaElement) -> ValueHandler:
