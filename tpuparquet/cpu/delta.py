@@ -268,6 +268,16 @@ def encode_delta_binary_packed(
     mb = adj.reshape(n_blocks * n_miniblocks, mb_size)
     widths = widths_from_max(mb.max(axis=1))
 
+    from ..native import pack_native
+
+    nat = pack_native()
+    if nat is not None:
+        body = nat.delta_emit(mb, widths, mb_size, min_deltas,
+                              n_miniblocks)
+        if body is not None:
+            # out holds only the few header bytes here; one concat
+            return bytes(out) + body.tobytes()
+
     # pack all miniblocks of one width in a single pack() call, then
     # carve the concatenated bytes back into per-miniblock payloads
     payloads: list[bytes] = [b""] * len(widths)

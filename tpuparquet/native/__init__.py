@@ -528,6 +528,16 @@ class NativePack:
         self._expand = getattr(lib, "tpq_hybrid_expand32", None)
         if None in (self._pack64, self._repack, self._expand):
             raise RuntimeError("native library too old; rebuild")
+        self._delta_emit = getattr(lib, "tpq_delta_emit", None)
+        if self._delta_emit is not None:
+            self._delta_emit.restype = ctypes.c_longlong
+            self._delta_emit.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_longlong, ctypes.c_longlong,
+                ctypes.c_void_p, ctypes.c_longlong, ctypes.c_longlong,
+                ctypes.c_void_p, ctypes.c_longlong,
+                ctypes.POINTER(ctypes.c_longlong),
+            ]
         self._expand.restype = ctypes.c_longlong
         self._expand.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
@@ -561,6 +571,29 @@ class NativePack:
         if rc != 0:
             raise ValueError(f"bit width {width} out of range 0..64")
         return out[:n]
+
+    def delta_emit(self, adj, widths, mb_size: int, min_deltas,
+                   n_miniblocks: int):
+        """Emit the per-block body of a DELTA_BINARY_PACKED stream in
+        one C pass (zigzag min_delta varints + width bytes + packed
+        miniblocks); None when the symbol is missing (stale .so)."""
+        if self._delta_emit is None:
+            return None
+        a = np.ascontiguousarray(adj, dtype=np.uint64).reshape(-1)
+        w = np.ascontiguousarray(widths, dtype=np.uint8)
+        md = np.ascontiguousarray(min_deltas, dtype=np.int64)
+        n_mb = w.size
+        packed_bytes = int((w.astype(np.int64) * mb_size).sum()) // 8
+        cap = packed_bytes + md.size * (10 + n_miniblocks) + 16
+        out = np.empty(cap, dtype=np.uint8)
+        out_len = ctypes.c_longlong()
+        rc = self._delta_emit(
+            a.ctypes.data, w.ctypes.data, n_mb, mb_size,
+            md.ctypes.data, md.size, n_miniblocks,
+            out.ctypes.data, cap, ctypes.byref(out_len))
+        if rc != 0:
+            raise ValueError(f"delta emit failed (rc={rc})")
+        return out[: out_len.value]
 
     @staticmethod
     def _run_table(run_ends, run_is_rle, run_value, run_bp_start,

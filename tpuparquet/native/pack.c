@@ -18,6 +18,33 @@
 #include <stdint.h>
 #include <string.h>
 
+/* Shared word-writer core: pack count width-bit values (already known
+ * to fit) LSB-first at out, which must have 8 bytes of slack past the
+ * exact (count*width + 7)/8 payload.  Returns the exact payload
+ * length.  The accumulator flushes whole 64-bit words; at most one
+ * value straddles a flush, recovered with a single shift. */
+static inline long long pack_words(const uint64_t *v, long long count,
+                                   int width, uint8_t *out) {
+    uint64_t acc = 0;
+    int nbits = 0;
+    long long o = 0;
+    for (long long i = 0; i < count; i++) {
+        acc |= nbits < 64 ? v[i] << nbits : 0;
+        nbits += width;
+        if (nbits >= 64) {
+            __builtin_memcpy(out + o, &acc, 8);
+            o += 8;
+            nbits -= 64;
+            /* bits of v[i] that did not fit (0 when the flush landed
+             * exactly on a value boundary) */
+            acc = nbits ? v[i] >> (width - nbits) : 0;
+        }
+    }
+    if (nbits > 0)
+        __builtin_memcpy(out + o, &acc, 8); /* slack covers the tail */
+    return (count * (long long)width + 7) / 8;
+}
+
 /* Pack count LSB-first width-bit values from a contiguous u64 array.
  * out must hold (count*width + 7)/8 + 8 bytes (8 slack for the word
  * writer; the caller slices to the exact length).  Returns 0, or -1 if
@@ -29,26 +56,58 @@ long long tpq_pack64(const uint64_t *v, long long count, int width,
         return -2;
     const uint64_t lim_mask =
         width >= 64 ? 0 : ~((uint64_t)0) << width; /* high bits set */
-    uint64_t acc = 0;
-    int nbits = 0;
-    long long o = 0;
-    for (long long i = 0; i < count; i++) {
-        uint64_t x = v[i];
-        if (x & lim_mask)
+    for (long long i = 0; i < count; i++)
+        if (v[i] & lim_mask)
             return -1;
-        acc |= nbits < 64 ? x << nbits : 0;
-        nbits += width;
-        if (nbits >= 64) {
-            __builtin_memcpy(out + o, &acc, 8);
-            o += 8;
-            nbits -= 64;
-            /* bits of x that did not fit (0 when the flush landed
-             * exactly on a value boundary) */
-            acc = nbits ? x >> (width - nbits) : 0;
+    pack_words(v, count, width, out);
+    return 0;
+}
+
+static inline long long emit_uvarint(uint8_t *out, long long o,
+                                     uint64_t v) {
+    while (v >= 0x80) {
+        out[o++] = (uint8_t)(v | 0x80);
+        v >>= 7;
+    }
+    out[o++] = (uint8_t)v;
+    return o;
+}
+
+/* Emit the block body of a DELTA_BINARY_PACKED stream: per block a
+ * zigzag-varint min_delta, the miniblock width bytes, then each
+ * non-zero-width miniblock's LSB-first packed payload — the assembly
+ * loop that ran per block in Python.  adj is the (n_mb * mb_size)
+ * min_delta-adjusted matrix (padding lanes zero), widths one byte per
+ * miniblock.  Returns 0 and *out_len, or -1 if cap would overflow. */
+long long tpq_delta_emit(const uint64_t *adj, const uint8_t *widths,
+                         long long n_mb, long long mb_size,
+                         const int64_t *min_deltas, long long n_blocks,
+                         long long n_miniblocks, uint8_t *out,
+                         long long cap, long long *out_len) {
+    long long o = 0;
+    for (long long b = 0; b < n_blocks; b++) {
+        if (o + 10 + n_miniblocks > cap)
+            return -1;
+        uint64_t u = (uint64_t)min_deltas[b];
+        o = emit_uvarint(out, o, (u << 1) ^ (uint64_t)(min_deltas[b] >> 63));
+        for (long long m = 0; m < n_miniblocks; m++) {
+            long long mb = b * n_miniblocks + m;
+            out[o++] = mb < n_mb ? widths[mb] : 0;
+        }
+        for (long long m = 0; m < n_miniblocks; m++) {
+            long long mb = b * n_miniblocks + m;
+            if (mb >= n_mb)
+                continue;
+            int width = widths[mb];
+            if (width == 0)
+                continue;
+            long long nbytes = mb_size * width / 8;
+            if (o + nbytes + 8 > cap)
+                return -1;
+            o += pack_words(adj + mb * mb_size, mb_size, width, out + o);
         }
     }
-    if (nbits > 0)
-        __builtin_memcpy(out + o, &acc, 8); /* slack covers the tail */
+    *out_len = o;
     return 0;
 }
 
