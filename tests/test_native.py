@@ -526,3 +526,53 @@ def test_native_library_builds_when_compiler_available():
     assert _lib() is not None, \
         "native library failed to build with a compiler present " \
         "(check cc errors on tpuparquet/native/*.c)"
+
+
+class TestNativeHybridEncode:
+    def test_byte_identical_to_python(self):
+        from unittest import mock
+
+        import tpuparquet.native as N
+        from tpuparquet.cpu.hybrid import decode_hybrid, encode_hybrid
+
+        nat = N.pack_native()
+        if nat is None or nat._hybrid_encode is None:
+            pytest.skip("native hybrid encode unavailable")
+        rng = np.random.default_rng(91)
+        for trial in range(120):
+            w = int(rng.integers(1, 33)) if trial % 4 \
+                else int(rng.integers(33, 65))
+            n = int(rng.integers(0, 3000))
+            vals = rng.integers(0, 1 << min(w, 62), n, dtype=np.uint64)
+            mode = trial % 5
+            if mode == 0:  # exact 8-runs
+                vals = np.repeat(vals[: max(n // 8, 1)], 8)[:n]
+            elif mode == 1:  # long constant stretches + noise
+                vals = np.where(rng.random(n) < 0.8,
+                                vals[0] if n else 0, vals)
+            elif mode == 2 and n:  # one constant run
+                vals = np.full(n, vals[0])
+            a = encode_hybrid(vals, w)
+            with mock.patch.object(N, "_pack_inst",
+                                   N._PACK_UNAVAILABLE):
+                b = encode_hybrid(vals, w)
+            assert a == b, (trial, w, len(vals))
+            if len(vals):
+                dec = decode_hybrid(np.frombuffer(a, np.uint8),
+                                    len(vals), w)
+                assert np.array_equal(dec.astype(np.uint64), vals)
+
+    def test_oversized_rle_value_refused_both_paths(self):
+        from unittest import mock
+
+        import tpuparquet.native as N
+        from tpuparquet.cpu.hybrid import encode_hybrid
+
+        for force in (False, True):
+            ctx = (mock.patch.object(N, "_pack_inst",
+                                     N._PACK_UNAVAILABLE)
+                   if force else mock.patch.object(
+                       N, "_pack_inst", N._pack_inst))
+            with ctx:
+                with pytest.raises(ValueError, match="does not fit"):
+                    encode_hybrid(np.full(16, 12, dtype=np.uint64), 3)

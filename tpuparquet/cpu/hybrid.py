@@ -190,6 +190,13 @@ def encode_hybrid(values, width: int) -> bytes:
     out = bytearray()
     if width == 0 or v.size == 0:
         return bytes(out)
+    from ..native import pack_native
+
+    nat = pack_native()
+    if nat is not None:
+        enc = nat.hybrid_encode(v, width)
+        if enc is not None:
+            return enc.tobytes()
     vbytes = (width + 7) // 8
 
     # Find constant runs via change points, then consider only the runs
@@ -223,6 +230,12 @@ def encode_hybrid(values, width: int) -> bytes:
             flush_end = min(pending + ((s - pending + 7) // 8) * 8, e)
         emit_bitpacked(pending, flush_end)
         if e - flush_end >= 1:
+            if width < 64 and int(v[s]) >> width:
+                # pack() guards the bit-packed runs; the RLE value
+                # needs the same refusal or the stream corrupts at
+                # read time ("RLE run value exceeds bit width")
+                raise ValueError(
+                    f"value {int(v[s])} does not fit in {width} bits")
             write_uvarint(out, (e - flush_end) << 1)
             out.extend(int(v[s]).to_bytes(vbytes, "little"))
         pending = e

@@ -538,6 +538,14 @@ class NativePack:
                 ctypes.c_void_p, ctypes.c_longlong,
                 ctypes.POINTER(ctypes.c_longlong),
             ]
+        self._hybrid_encode = getattr(lib, "tpq_hybrid_encode", None)
+        if self._hybrid_encode is not None:
+            self._hybrid_encode.restype = ctypes.c_longlong
+            self._hybrid_encode.argtypes = [
+                ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int,
+                ctypes.c_void_p, ctypes.c_longlong,
+                ctypes.POINTER(ctypes.c_longlong),
+            ]
         self._expand.restype = ctypes.c_longlong
         self._expand.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
@@ -571,6 +579,29 @@ class NativePack:
         if rc != 0:
             raise ValueError(f"bit width {width} out of range 0..64")
         return out[:n]
+
+    def hybrid_encode(self, values: np.ndarray, width: int):
+        """Hybrid RLE/BP encode in one C pass, byte-identical to the
+        Python encoder.  None when the symbol is missing (stale .so) or
+        the capacity estimate fell short (the fallback then encodes);
+        raises on a value that does not fit the width — writing it
+        would corrupt the stream at read time."""
+        if self._hybrid_encode is None:
+            return None
+        v = np.ascontiguousarray(values, dtype=np.uint64)
+        groups = (v.size + 7) // 8
+        cap = groups * width + 5 * (groups + 2) + 32
+        out = np.empty(cap, dtype=np.uint8)
+        out_len = ctypes.c_longlong()
+        rc = self._hybrid_encode(v.ctypes.data, v.size, width,
+                                 out.ctypes.data, cap,
+                                 ctypes.byref(out_len))
+        if rc == -1:
+            raise ValueError(
+                f"value {int(v.max())} does not fit in {width} bits")
+        if rc != 0:
+            return None  # cap shortfall / bad width: fallback decides
+        return out[: out_len.value]
 
     def delta_emit(self, adj, widths, mb_size: int, min_deltas,
                    n_miniblocks: int):

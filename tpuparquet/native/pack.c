@@ -111,6 +111,87 @@ long long tpq_delta_emit(const uint64_t *adj, const uint8_t *widths,
     return 0;
 }
 
+/* Emit one bit-packed region (header + 8-value groups, zero-padded
+ * tail group): shared by the mid-stream and end-of-stream flushes of
+ * tpq_hybrid_encode.  Returns the new offset, or -1 when cap would
+ * overflow. */
+static long long emit_bp_region(const uint64_t *v, long long bp_n,
+                                int width, uint8_t *out, long long cap,
+                                long long o) {
+    if (bp_n <= 0)
+        return o;
+    long long groups = (bp_n + 7) / 8;
+    if (o + 10 + groups * width + 8 > cap)
+        return -1;
+    o = emit_uvarint(out, o, ((uint64_t)groups << 1) | 1);
+    long long full = bp_n / 8 * 8;
+    if (full)
+        o += pack_words(v, full, width, out + o);
+    if (bp_n > full) { /* zero-padded tail group */
+        uint64_t tmp[8] = {0};
+        for (long long k = 0; k < bp_n - full; k++)
+            tmp[k] = v[full + k];
+        o += pack_words(tmp, 8, width, out + o);
+    }
+    return o;
+}
+
+/* Hybrid RLE/BP encode: RLE for constant stretches >= 8, bit-packing
+ * for the rest (8-value groups, zero-padded tail) — byte-identical to
+ * cpu/hybrid.encode_hybrid, whose long-run loop ran in Python.  out
+ * needs 8 bytes of slack past the worst case.  Returns 0 with
+ * *out_len, -1 if a value exceeds width bits, -2 on bad width. */
+long long tpq_hybrid_encode(const uint64_t *v, long long n, int width,
+                            uint8_t *out, long long cap,
+                            long long *out_len) {
+    if (width <= 0 || width > 64)
+        return -2;
+    const uint64_t lim_mask =
+        width >= 64 ? 0 : ~((uint64_t)0) << width;
+    for (long long i = 0; i < n; i++)
+        if (v[i] & lim_mask)
+            return -1;
+    const int vbytes = (width + 7) / 8;
+    long long o = 0;
+    long long pending = 0; /* start of the un-emitted bit-packed region */
+    long long i = 0;
+    while (i < n) {
+        /* find the constant run starting at i */
+        long long e = i + 1;
+        while (e < n && v[e] == v[i])
+            e++;
+        if (e - i >= 8) { /* long run: flush pending BP, then RLE */
+            long long flush_end = i;
+            if ((flush_end - pending) % 8) {
+                long long r = pending + ((i - pending + 7) / 8) * 8;
+                flush_end = r < e ? r : e;
+            }
+            o = emit_bp_region(v + pending, flush_end - pending, width,
+                               out, cap, o);
+            if (o < 0)
+                return -3;
+            if (e - flush_end >= 1) {
+                if (o + 10 + vbytes > cap)
+                    return -3;
+                o = emit_uvarint(out, o,
+                                 (uint64_t)(e - flush_end) << 1);
+                uint64_t x = v[i];
+                for (int b = 0; b < vbytes; b++) {
+                    out[o++] = (uint8_t)x;
+                    x >>= 8;
+                }
+            }
+            pending = e;
+        }
+        i = e;
+    }
+    o = emit_bp_region(v + pending, n - pending, width, out, cap, o);
+    if (o < 0)
+        return -3;
+    *out_len = o;
+    return 0;
+}
+
 static inline uint64_t load_bits(const uint8_t *bp, long long bp_len,
                                  long long bitpos, int width) {
     /* read width (<=32) bits at bitpos; safe at the tail */
