@@ -205,7 +205,19 @@ def encode_plain(ptype: Type, values, type_length: int | None = None) -> bytes:
     if ptype == Type.BYTE_ARRAY:
         if not isinstance(values, ByteArrayColumn):
             values = ByteArrayColumn.from_list(values)
-        lengths = values.lengths().astype("<u4")
+        from ..native import delta_native
+
+        nat = delta_native()
+        if nat is not None:
+            out = nat.byte_array_emit(values.data, values.offsets)
+            if out is not None:
+                return out.tobytes()
+        lengths = values.lengths()
+        if lengths.size and int(lengths.max()) > 0xFFFFFFFF:
+            # the native emitter refuses this; the fallback must too
+            # (an astype truncation would write a corrupt stream)
+            raise ValueError("byte-array value too long for a u32 prefix")
+        lengths = lengths.astype("<u4")
         out = bytearray()
         data = values.data.tobytes()
         offs = values.offsets

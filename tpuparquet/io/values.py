@@ -318,33 +318,43 @@ def _refine_lex(rows: np.ndarray, reduce_fn) -> bytes:
     matrix by byte-plane refinement: narrow the candidate set one byte
     position at a time (O(k) for the first plane, collapsing
     geometrically after) instead of materializing k Python bytes
-    objects.  Ties that refuse to collapse (duplicates, long shared
-    prefixes) fall back to a Python reduce over the remaining
-    candidates once a fixed work budget is spent, so the worst case is
-    never slower than the old to_list path."""
+    objects.  Constant planes (shared prefixes) are free progress;
+    when the pass cap trips before the set collapses (adversarial
+    prefixes, duplicate extremes), an exact memcmp sort over the
+    surviving candidate rows finishes the job."""
     if rows.dtype != np.uint8:
         # the file stores raw bytes: compare UNSIGNED regardless of the
         # input dtype (an int8 view would invert the order)
         rows = np.ascontiguousarray(rows).view(np.uint8)
     k, L = rows.shape
-    use_py = L > 4096  # few, huge values: per-plane dispatch dominates
     cand = np.arange(k)
-    if not use_py:
-        budget = 4 * k + 1024
-        spent = 0
+    bail = L > 4096  # few, huge values: per-plane dispatch dominates
+    if not bail:
+        # constant planes (shared prefixes) are free progress through
+        # the string; varying planes shrink the candidate set.  The
+        # pass cap bounds the numpy-dispatch count for adversarial
+        # shapes (very long shared prefixes, duplicate extremes).
+        passes = 0
         for j in range(L):
-            spent += cand.size
-            if spent > budget:
-                use_py = True
-                break
             col = rows[cand, j]
-            m = reduce_fn(col)
-            cand = cand[col == m]
-            if cand.size == 1:
+            mn = int(col.min())
+            mx = int(col.max())
+            if mn != mx:
+                m = mn if reduce_fn is np.min else mx
+                cand = cand[col == m]
+                if cand.size == 1:
+                    break
+            passes += 1
+            if passes > 96 and cand.size > 1:
+                bail = True
                 break
-    if use_py:
-        vals = [bytes(rows[int(i)]) for i in cand]
-        return min(vals) if reduce_fn is np.min else max(vals)
+    if bail:
+        # exact memcmp sort over the surviving candidates
+        sub = np.ascontiguousarray(rows[cand])
+        view = sub.view(np.dtype((np.void, L))).reshape(-1)
+        view = np.sort(view)
+        pick = view[0] if reduce_fn is np.min else view[-1]
+        return bytes(pick)
     return bytes(rows[int(cand[0])])
 
 

@@ -409,6 +409,13 @@ class NativeDelta:
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong,
                 ctypes.c_void_p, ctypes.c_longlong,
             ]
+        self._ba_emit = getattr(lib, "tpq_byte_array_emit", None)
+        if self._ba_emit is not None:
+            self._ba_emit.restype = ctypes.c_longlong
+            self._ba_emit.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong,
+                ctypes.c_void_p,
+            ]
         self._ba_scan = getattr(lib, "tpq_byte_array_scan", None)
         if self._ba_scan is not None:
             self._ba_scan.restype = ctypes.c_longlong
@@ -418,6 +425,22 @@ class NativeDelta:
                 ctypes.POINTER(ctypes.c_longlong),
                 ctypes.POINTER(ctypes.c_longlong),
             ]
+
+    def byte_array_emit(self, data, offsets):
+        """PLAIN-encode a ByteArrayColumn's records (u32-LE prefix +
+        bytes) in one C pass; None when the symbol is missing."""
+        if self._ba_emit is None:
+            return None
+        d = _as_u8(data)
+        offs = np.ascontiguousarray(offsets, dtype=np.int64)
+        count = offs.size - 1
+        total = 4 * count + int(offs[-1]) - int(offs[0])
+        out = np.empty(max(total, 1), dtype=np.uint8)[:total]
+        rc = self._ba_emit(d.ctypes.data, offs.ctypes.data, count,
+                           out.ctypes.data)
+        if rc != 0:
+            raise ValueError("byte-array value too long for a u32 prefix")
+        return out
 
     def byte_array_scan(self, buf, count: int):
         """Scan PLAIN BYTE_ARRAY length prefixes in one C pass:

@@ -85,6 +85,25 @@ long long tpq_byte_array_scan(const uint8_t *buf, long long n,
     return 0;
 }
 
+/* Emit count PLAIN BYTE_ARRAY records (u32-LE length prefix + bytes)
+ * from a ByteArrayColumn's offsets + contiguous data — the encode twin
+ * of tpq_byte_array_scan.  out must hold 4*count + data length. */
+long long tpq_byte_array_emit(const uint8_t *data, const int64_t *offsets,
+                              long long count, uint8_t *out) {
+    long long o = 0;
+    for (long long i = 0; i < count; i++) {
+        long long L = offsets[i + 1] - offsets[i];
+        if (L < 0 || L > 0xFFFFFFFFLL)
+            return -1;
+        uint32_t ln = (uint32_t)L;
+        __builtin_memcpy(out + o, &ln, 4);
+        o += 4;
+        __builtin_memcpy(out + o, data + offsets[i], (size_t)L);
+        o += L;
+    }
+    return 0;
+}
+
 /* Gather n variable-length segments into one contiguous buffer —
  * the byte-array dictionary gather (one memcpy per value instead of
  * numpy arange/repeat position temporaries). */
