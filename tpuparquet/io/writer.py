@@ -29,6 +29,14 @@ from ..format.metadata import (
     RowGroup,
 )
 
+from ..format.schema import Schema
+from .chunk import write_chunk
+from .pages import SUPPORTED_DATA_ENCODINGS
+from .store import attach_stores, shred_record
+from .values import handler_for
+
+__all__ = ["FileWriter"]
+
 
 def _is_element_struct_leaf(leaf) -> bool:
     """True when a rep-level-1 leaf sits inside an element GROUP (the
@@ -48,13 +56,7 @@ def _is_element_struct_leaf(leaf) -> bool:
                 return False  # canonical LIST single element
         return True  # repeated struct group (incl. MAP key_value)
     return True  # element group below the repeated node
-from ..format.schema import Schema
-from .chunk import write_chunk
-from .pages import SUPPORTED_DATA_ENCODINGS
-from .store import attach_stores, shred_record
-from .values import handler_for
 
-__all__ = ["FileWriter"]
 
 
 class FileWriter:
@@ -595,8 +597,19 @@ def _column_len(vals) -> int:
 
 
 def _approx_record_size(row) -> int:
+    # class-identity fast paths: flat scalar rows (the common case)
+    # never recurse, which keeps add_data's per-row accounting cheap
     if isinstance(row, dict):
-        return sum(_approx_record_size(v) + 8 for v in row.values())
+        t = 0
+        for v in row.values():
+            c = v.__class__
+            if c is str or c is bytes:
+                t += len(v) + 8
+            elif c is dict or c is list or c is tuple:
+                t += _approx_record_size(v) + 8
+            else:
+                t += 16
+        return t
     if isinstance(row, (list, tuple)):
         return sum(_approx_record_size(v) for v in row)
     if isinstance(row, (bytes, bytearray, str)):

@@ -413,7 +413,8 @@ class NativeDelta:
         if self._ba_emit is not None:
             self._ba_emit.restype = ctypes.c_longlong
             self._ba_emit.argtypes = [
-                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong,
+                ctypes.c_void_p, ctypes.c_longlong,
+                ctypes.c_void_p, ctypes.c_longlong,
                 ctypes.c_void_p,
             ]
         self._ba_scan = getattr(lib, "tpq_byte_array_scan", None)
@@ -436,10 +437,12 @@ class NativeDelta:
         count = offs.size - 1
         total = 4 * count + int(offs[-1]) - int(offs[0])
         out = np.empty(max(total, 1), dtype=np.uint8)[:total]
-        rc = self._ba_emit(d.ctypes.data, offs.ctypes.data, count,
-                           out.ctypes.data)
+        rc = self._ba_emit(d.ctypes.data, d.size, offs.ctypes.data,
+                           count, out.ctypes.data)
         if rc != 0:
-            raise ValueError("byte-array value too long for a u32 prefix")
+            raise ValueError(
+                "byte-array offsets out of bounds or value too long "
+                "for a u32 prefix")
         return out
 
     def byte_array_scan(self, buf, count: int):

@@ -88,12 +88,17 @@ long long tpq_byte_array_scan(const uint8_t *buf, long long n,
 /* Emit count PLAIN BYTE_ARRAY records (u32-LE length prefix + bytes)
  * from a ByteArrayColumn's offsets + contiguous data — the encode twin
  * of tpq_byte_array_scan.  out must hold 4*count + data length. */
-long long tpq_byte_array_emit(const uint8_t *data, const int64_t *offsets,
-                              long long count, uint8_t *out) {
+long long tpq_byte_array_emit(const uint8_t *data, long long data_len,
+                              const int64_t *offsets, long long count,
+                              uint8_t *out) {
     long long o = 0;
     for (long long i = 0; i < count; i++) {
         long long L = offsets[i + 1] - offsets[i];
-        if (L < 0 || L > 0xFFFFFFFFLL)
+        /* bounds-check against the data buffer: an inconsistent
+         * ByteArrayColumn must not copy adjacent heap bytes into the
+         * file */
+        if (L < 0 || L > 0xFFFFFFFFLL || offsets[i] < 0
+            || offsets[i] + L > data_len)
             return -1;
         uint32_t ln = (uint32_t)L;
         __builtin_memcpy(out + o, &ln, 4);
