@@ -82,6 +82,13 @@ def main():
     gathered = allgather_host(local).reshape(2, -1).sum(axis=0)
     counts = scan.counts_allgather()
 
+    # 64-bit transit check: values past 2**32 must survive the gather
+    # (JAX's 32-bit default silently wrapped them before the u32-lane
+    # fix in allgather_host)
+    probe = np.array([(1 << 40) + 7 + pid], dtype=np.int64)
+    g = allgather_host(probe)
+    assert g.reshape(-1).tolist() == [(1 << 40) + 7, (1 << 40) + 8], g
+
     # resume-cursor shape check on this process's grid coordinates
     st = scan.state()
     assert st["process_index"] == pid and st["process_count"] == 2

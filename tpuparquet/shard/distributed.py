@@ -56,12 +56,25 @@ def allgather_host(local_rows: np.ndarray) -> np.ndarray:
     """All-gather variable host arrays across processes (DCN).
 
     Single-process: identity.  Multi-process: delegates to
-    ``jax.experimental.multihost_utils.process_allgather``."""
+    ``jax.experimental.multihost_utils.process_allgather``.
+
+    64-bit payloads ship as (lo, hi) u32 lanes: JAX's default 32-bit
+    mode silently truncates int64/float64 in transit — checksums over
+    2**32 came back wrapped (caught by the at-scale two-process run;
+    the framework's device buffers use the same lane convention)."""
+    a = np.asarray(local_rows)
     if jax.process_count() == 1:
-        return np.asarray(local_rows)
+        return a
     from jax.experimental import multihost_utils
 
-    return np.asarray(multihost_utils.process_allgather(local_rows))
+    if a.dtype.itemsize == 8:
+        a1 = np.atleast_1d(a)  # 0-d arrays refuse the itemsize re-view
+        lanes = np.ascontiguousarray(a1).view(np.uint32).reshape(
+            a1.shape + (2,))
+        out = np.asarray(multihost_utils.process_allgather(lanes))
+        return np.ascontiguousarray(out).view(a.dtype).reshape(
+            out.shape[:-1])
+    return np.asarray(multihost_utils.process_allgather(a))
 
 
 class MultiHostScan:
