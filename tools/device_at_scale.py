@@ -1,0 +1,71 @@
+"""Device decode path at driver scale on the CPU backend: builds the
+bench's taxi config at the full 50M-value target and runs the pipelined
+device path once, recording wall, phase split, staged bytes, and peak
+RSS — the memory/plan regression harness for the exact shape
+``python bench.py`` drives on the real chip.
+
+    python tools/device_at_scale.py [target_values]
+
+Writes DEVICE_SCALE_r04.json at the repo root.
+"""
+
+import json
+import os
+import resource
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def main() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if len(sys.argv) > 1:
+        os.environ["TPQ_BENCH_TARGET"] = sys.argv[1]
+    import bench
+    from tpuparquet import FileReader
+    from tpuparquet.kernels.device import read_row_groups_device
+    from tpuparquet.stats import collect_stats
+
+    t0 = time.perf_counter()
+    buf = bench.build_config2()
+    build_s = time.perf_counter() - t0
+    file_mb = buf.seek(0, 2) / 1e6
+    buf.seek(0)
+    reader = FileReader(buf)
+    with collect_stats() as st:
+        t0 = time.perf_counter()
+        for _rg, out in read_row_groups_device(reader):
+            for c in out.values():
+                c.block_until_ready()
+        scan_s = time.perf_counter() - t0
+    rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+    record = {
+        "config": "2-taxi-dict-snappy",
+        "n_values": st.values,
+        "file_mb": round(file_mb, 1),
+        "build_s": round(build_s, 2),
+        "scan_s": round(scan_s, 2),
+        "values_per_sec": round(st.values / scan_s, 1),
+        "bytes_staged": st.bytes_staged,
+        "staged_over_uncompressed": round(
+            st.bytes_staged / max(st.bytes_uncompressed, 1), 3),
+        "plan_s": round(st.plan_s, 2),
+        "transfer_s": round(st.transfer_s, 2),
+        "dispatch_s": round(st.dispatch_s, 2),
+        "peak_rss_mb": round(rss, 1),
+        "backend": "cpu (device timings are not chip numbers; wire and "
+                   "plan figures are backend-independent)",
+    }
+    path = os.path.join(_REPO, "DEVICE_SCALE_r04.json")
+    with open(path, "w") as f:
+        json.dump(record, f, indent=1)
+    print(json.dumps(record))
+
+
+if __name__ == "__main__":
+    main()
