@@ -80,3 +80,28 @@ class TestStats:
         assert rc == 0
         err = capsys.readouterr().err
         assert "values/s" in err
+
+
+def test_device_phase_split_populated():
+    """plan_s / transfer_s / dispatch_s accumulate on the device path
+    and appear in as_dict + summary (the on-chip ladder reads them to
+    say which side binds)."""
+    import io
+
+    import numpy as np
+
+    from tpuparquet import FileWriter, FileReader, collect_stats
+    from tpuparquet.kernels.device import read_row_group_device
+
+    buf = io.BytesIO()
+    w = FileWriter(buf, "message m { required int64 a; }")
+    w.write_columns({"a": np.arange(50_000, dtype=np.int64)})
+    w.close()
+    buf.seek(0)
+    with collect_stats() as st:
+        read_row_group_device(FileReader(buf), 0)
+    d = st.as_dict()
+    assert d["plan_s"] > 0
+    assert d["transfer_s"] > 0
+    assert d["dispatch_s"] > 0
+    assert "transfer" in st.summary()
