@@ -576,3 +576,64 @@ class TestNativeHybridEncode:
             with ctx:
                 with pytest.raises(ValueError, match="does not fit"):
                     encode_hybrid(np.full(16, 12, dtype=np.uint64), 3)
+
+
+class TestNativeDbaAssemble:
+    def test_parity_and_malformed(self):
+        from unittest import mock
+
+        import tpuparquet.native as N
+        from tpuparquet.cpu.delta import (
+            decode_delta_byte_array,
+            encode_delta_byte_array,
+        )
+
+        nat = N.delta_native()
+        if nat is None or nat._dba is None:
+            pytest.skip("native DBA assembler unavailable")
+        rng = np.random.default_rng(95)
+        for trial in range(20):
+            n = int(rng.integers(1, 2000))
+            vals = [f"pre_{trial}_{rng.integers(0, 40)}_{i}".encode()
+                    for i in range(n)]
+            enc = encode_delta_byte_array(vals)
+            a, _ = decode_delta_byte_array(
+                np.frombuffer(enc, np.uint8), n)
+            with mock.patch.object(N, "_delta_inst",
+                                   N._DELTA_UNAVAILABLE):
+                b, _ = decode_delta_byte_array(
+                    np.frombuffer(enc, np.uint8), n)
+            assert np.array_equal(a.offsets, b.offsets)
+            assert np.array_equal(a.data, b.data)
+            assert a.to_list() == vals
+        # malformed: both paths raise the same ValueError message
+        from tpuparquet.cpu.delta import assemble_delta_byte_array
+
+        cases = [
+            (np.array([0, 5], dtype=np.int64),   # prefix > prev len
+             np.array([0, 2, 4], dtype=np.int64),
+             np.frombuffer(b"abcd", np.uint8)),
+            (np.array([0, -1], dtype=np.int64),  # negative prefix
+             np.array([0, 2, 4], dtype=np.int64),
+             np.frombuffer(b"abcd", np.uint8)),
+        ]
+        for args in cases:
+            self._both_raise_same(args)
+
+    def _both_raise_same(self, args):
+        from unittest import mock
+
+        import tpuparquet.native as N
+        from tpuparquet.cpu.delta import assemble_delta_byte_array
+
+        msgs = []
+        for force in (False, True):
+            ctx = (mock.patch.object(N, "_delta_inst",
+                                     N._DELTA_UNAVAILABLE)
+                   if force else mock.patch.object(
+                       N, "_delta_inst", N._delta_inst))
+            with ctx:
+                with pytest.raises(ValueError) as ei:
+                    assemble_delta_byte_array(*args)
+                msgs.append(str(ei.value))
+        assert msgs[0] == msgs[1], msgs

@@ -353,6 +353,14 @@ def assemble_delta_byte_array(prefix_lens, suffix_offsets,
     total_lens = prefix_lens + suffix_lens
     offsets = np.zeros(count + 1, dtype=np.int64)
     np.cumsum(total_lens, out=offsets[1:])
+    from ..native import delta_native
+
+    nat = delta_native()
+    if nat is not None:
+        out = nat.dba_assemble(prefix_lens, suffix_offsets, suffix_data,
+                               offsets, int(offsets[-1]))
+        if out is not None:
+            return ByteArrayColumn(offsets, out)
     out = np.empty(int(offsets[-1]), dtype=np.uint8)
     sdata = suffix_data
     soffs = suffix_offsets
@@ -362,7 +370,7 @@ def assemble_delta_byte_array(prefix_lens, suffix_offsets,
         plen = int(prefix_lens[i])
         if i == 0 and plen != 0:
             raise ValueError("DELTA_BYTE_ARRAY: first prefix must be 0")
-        if plen > (int(offsets[i]) - prev_start if i else 0):
+        if plen < 0 or plen > (int(offsets[i]) - prev_start if i else 0):
             raise ValueError(
                 f"DELTA_BYTE_ARRAY: prefix {plen} longer than previous value"
             )

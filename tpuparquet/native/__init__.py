@@ -409,6 +409,15 @@ class NativeDelta:
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong,
                 ctypes.c_void_p, ctypes.c_longlong,
             ]
+        self._dba = getattr(lib, "tpq_dba_assemble", None)
+        if self._dba is not None:
+            self._dba.restype = ctypes.c_longlong
+            self._dba.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_longlong,
+                ctypes.c_void_p, ctypes.c_longlong,
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
+            ]
         self._ba_emit = getattr(lib, "tpq_byte_array_emit", None)
         if self._ba_emit is not None:
             self._ba_emit.restype = ctypes.c_longlong
@@ -426,6 +435,34 @@ class NativeDelta:
                 ctypes.POINTER(ctypes.c_longlong),
                 ctypes.POINTER(ctypes.c_longlong),
             ]
+
+    def dba_assemble(self, prefix_lens, suffix_offs, suffix_data,
+                     out_offsets, total: int):
+        """Front-coded DELTA_BYTE_ARRAY fill in one C pass; None when
+        the symbol is missing.  Raises ValueError with the CPU
+        assembler's messages on malformed streams."""
+        if self._dba is None:
+            return None
+        pl = np.ascontiguousarray(prefix_lens, dtype=np.int64)
+        so = np.ascontiguousarray(suffix_offs, dtype=np.int64)
+        sd = _as_u8(suffix_data)
+        oo = np.ascontiguousarray(out_offsets, dtype=np.int64)
+        out = np.empty(max(total, 1), dtype=np.uint8)[:total]
+        err = ctypes.c_longlong()
+        rc = self._dba(pl.ctypes.data, so.ctypes.data,
+                       sd.ctypes.data, sd.size,
+                       oo.ctypes.data, pl.size, out.ctypes.data,
+                       ctypes.byref(err))
+        if rc == -1:
+            raise ValueError("DELTA_BYTE_ARRAY: first prefix must be 0")
+        if rc == -2:
+            raise ValueError(
+                f"DELTA_BYTE_ARRAY: prefix {int(pl[err.value])} longer "
+                "than previous value")
+        if rc != 0:
+            raise ValueError(f"DELTA_BYTE_ARRAY assembly failed "
+                             f"(rc={rc})")
+        return out
 
     def byte_array_emit(self, data, offsets):
         """PLAIN-encode a ByteArrayColumn's records (u32-LE prefix +

@@ -127,6 +127,48 @@ long long tpq_gather_var(const uint8_t *src, long long src_len,
     return 0;
 }
 
+/* Front-coded DELTA_BYTE_ARRAY reconstruction: value i = the first
+ * prefix_lens[i] bytes of value i-1 (from the OUTPUT, inherently
+ * sequential) + its suffix bytes.  out_offsets are precomputed
+ * cumulative total lengths (count+1 entries).  Returns 0, or -1 first
+ * prefix nonzero / -2 prefix longer than the previous value, with
+ * *err_index set. */
+long long tpq_dba_assemble(const int64_t *prefix_lens,
+                           const int64_t *suffix_offs,
+                           const uint8_t *suffix_data,
+                           long long suffix_len,
+                           const int64_t *out_offsets, long long count,
+                           uint8_t *out, long long *err_index) {
+    long long prev_start = 0, prev_len = 0;
+    for (long long i = 0; i < count; i++) {
+        long long start = out_offsets[i];
+        long long plen = prefix_lens[i];
+        if (i == 0 && plen != 0) {
+            *err_index = i;
+            return -1;
+        }
+        if (plen < 0 || plen > prev_len) {
+            *err_index = i;
+            return -2;
+        }
+        long long slen = suffix_offs[i + 1] - suffix_offs[i];
+        if (slen < 0 || suffix_offs[i] < 0
+            || suffix_offs[i] + slen > suffix_len
+            || start + plen + slen != out_offsets[i + 1]) {
+            *err_index = i;
+            return -3;
+        }
+        if (plen)
+            __builtin_memcpy(out + start, out + prev_start,
+                             (size_t)plen);
+        __builtin_memcpy(out + start + plen,
+                         suffix_data + suffix_offs[i], (size_t)slen);
+        prev_start = start;
+        prev_len = plen + slen;
+    }
+    return 0;
+}
+
 long long tpq_delta_scan_blocks(
     const uint8_t *data, long long data_len, long long pos,
     long long n_deltas, long long mb_size, long long n_miniblocks,
