@@ -327,3 +327,54 @@ class TestDeviceFileProperties:
             else:
                 np.testing.assert_array_equal(
                     vals, np.asarray(cd.values), err_msg=path)
+
+
+class TestBigFileMutation:
+    """Byte flips on a multi-MB mixed file (snappy + dict + delta +
+    optional strings): the native scanners walk deep offsets that the
+    small-file mutation property never reaches.  Both decode paths must
+    fail with library error types, never raw crashes."""
+
+    def test_flips_both_paths(self):
+        from tpuparquet.cpu.plain import ByteArrayColumn
+        from tpuparquet.kernels.device import read_row_group_device
+
+        rng = np.random.default_rng(99)
+        n = 60_000
+        buf = io.BytesIO()
+        w = FileWriter(buf, """message m {
+            required int64 ts (INT(64,true));
+            required int32 pc;
+            optional binary s (STRING);
+            required int64 d (INT(64,true));
+        }""", codec=CompressionCodec.SNAPPY,
+            column_encodings={"d": Encoding.DELTA_BINARY_PACKED})
+        mask = rng.random(n) >= 0.2
+        words = [f"w{i}".encode() for i in range(200)]
+        w.write_columns({
+            "ts": np.int64(1 << 40)
+            + rng.integers(0, 3_600_000, n).cumsum(),
+            "pc": rng.integers(1, 7, n).astype(np.int32),
+            "s": ByteArrayColumn.from_list(
+                [words[i]
+                 for i in rng.integers(0, 200, int(mask.sum()))]),
+            "d": rng.integers(-(2**40), 2**40, n),
+        }, masks={"s": mask})
+        w.close()
+        raw = bytearray(buf.getvalue())
+        for trial in range(40):
+            bad = bytearray(raw)
+            for _ in range(int(rng.integers(1, 6))):
+                bad[int(rng.integers(0, len(bad)))] ^= \
+                    int(rng.integers(1, 256))
+            for path in ("oracle", "device"):
+                try:
+                    r = FileReader(io.BytesIO(bytes(bad)))
+                    for rg in range(r.row_group_count()):
+                        if path == "oracle":
+                            r.read_row_group_arrays(rg)
+                        else:
+                            read_row_group_device(r, rg)
+                except Exception as e:
+                    assert _clean(e), \
+                        f"raw crash {path}: {type(e).__name__}: {e}"
