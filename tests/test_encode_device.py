@@ -314,3 +314,86 @@ class TestDeviceFullCircle:
         col = read_row_group_device(FileReader(buf), 0)["s"]
         with pytest.raises(TypeError, match="as_values"):
             col.as_values()
+
+
+class TestDeviceDictEncode:
+    """Device-side dictionary interning: small-range integer
+    DeviceValues columns dict-encode without pulling the unpacked
+    column, byte-identical to the host path."""
+
+    def _write(self, schema, col):
+        buf = io.BytesIO()
+        w = FileWriter(buf, schema, codec=CompressionCodec.SNAPPY)
+        w.write_columns({"v": col})
+        w.close()
+        return buf.getvalue()
+
+    def test_int64_dicty_byte_identical(self):
+        rng = np.random.default_rng(5)
+        vals = (np.int64(1) << 40) + rng.integers(0, 50, 60_000)
+        schema = "message m { required int64 v (INT(64,true)); }"
+        host = self._write(schema, vals)
+        dev = self._write(schema, DeviceValues(
+            jnp.asarray(vals.view("<u4")), np.int64))
+        assert host == dev
+        r = FileReader(io.BytesIO(dev))
+        cm = r.meta.row_groups[0].columns[0].meta_data
+        assert Encoding.RLE_DICTIONARY in [
+            Encoding(e) for e in cm.encodings]
+        np.testing.assert_array_equal(
+            np.asarray(r.read_row_group_arrays(0)["v"].values), vals)
+
+    def test_int32_dicty_byte_identical(self):
+        rng = np.random.default_rng(6)
+        vals = rng.integers(-3, 4, 50_000).astype(np.int32)
+        schema = "message m { required int32 v; }"
+        host = self._write(schema, vals)
+        dev = self._write(schema, DeviceValues(
+            jnp.asarray(vals.view("<u4")), np.int32))
+        assert host == dev
+
+    def test_wide_range_stays_plain(self):
+        rng = np.random.default_rng(7)
+        vals = rng.integers(-(2**60), 2**60, 20_000)
+        schema = "message m { required int64 v (INT(64,true)); }"
+        host = self._write(schema, vals)
+        dev = self._write(schema, DeviceValues(
+            jnp.asarray(vals.view("<u4")), np.int64))
+        assert host == dev
+        r = FileReader(io.BytesIO(dev))
+        cm = r.meta.row_groups[0].columns[0].meta_data
+        assert Encoding.RLE_DICTIONARY not in [
+            Encoding(e) for e in cm.encodings]
+
+    def test_floats_never_dict(self):
+        rng = np.random.default_rng(8)
+        vals = np.repeat(rng.random(10), 2000)
+        schema = "message m { required double v; }"
+        dev = self._write(schema, DeviceValues(
+            jnp.asarray(vals.view("<u4")), np.float64))
+        r = FileReader(io.BytesIO(dev))
+        cm = r.meta.row_groups[0].columns[0].meta_data
+        assert Encoding.RLE_DICTIONARY not in [
+            Encoding(e) for e in cm.encodings]
+
+    def test_wide_range_few_distinct_known_divergence(self):
+        # KNOWN divergence: the host interner's np.unique path still
+        # dict-encodes wide-range few-distinct columns; the device
+        # intern cannot (no 64-bit device sort) and stays non-dict
+        vals = np.where(np.arange(20_000) % 2 == 0,
+                        -(2**60), 2**60).astype(np.int64)
+        schema = "message m { required int64 v (INT(64,true)); }"
+        host = self._write(schema, vals)
+        dev = self._write(schema, DeviceValues(
+            jnp.asarray(vals.view("<u4")), np.int64))
+        r_h = FileReader(io.BytesIO(host))
+        r_d = FileReader(io.BytesIO(dev))
+        encs_h = [Encoding(e) for e in
+                  r_h.meta.row_groups[0].columns[0].meta_data.encodings]
+        encs_d = [Encoding(e) for e in
+                  r_d.meta.row_groups[0].columns[0].meta_data.encodings]
+        assert Encoding.RLE_DICTIONARY in encs_h
+        assert Encoding.RLE_DICTIONARY not in encs_d
+        # contents still agree
+        np.testing.assert_array_equal(
+            np.asarray(r_d.read_row_group_arrays(0)["v"].values), vals)

@@ -188,6 +188,10 @@ def _merge_page_values(pages, dictionary, node):
 def _column_size_of(column) -> int:
     if isinstance(column, ByteArrayColumn):
         return int(column.data.size) + 4 * len(column)
+    from .values import is_device_values
+
+    if is_device_values(column):
+        return column.count * column.dtype.itemsize
     arr = np.asarray(column)
     return int(arr.nbytes)
 
@@ -200,26 +204,39 @@ def _maybe_dictionary(column, allow_dict: bool):
     from .values import is_device_values
 
     if is_device_values(column):
-        # device-resident values never dictionary-encode: interning is
-        # host work and would pull the raw column off the device
-        return None, None
-    n = len(column) if isinstance(column, ByteArrayColumn) else \
-        np.asarray(column).shape[0]
-    if n == 0:
-        return None, None
-    if not isinstance(column, ByteArrayColumn):
-        arr = np.asarray(column)
-        if arr.ndim == 1 and arr.dtype.kind in "iuf" and n > 4096:
-            # strictly monotonic values (timestamps, row ids) are all
-            # distinct: the dictionary would be the column itself plus
-            # packed indices — reject without paying the sort.
-            # Elementwise compares, NOT np.diff: a diff wraps on
-            # unsigned dtypes (and on int64 steps past 2**63) and
-            # would misclassify unsorted data as monotonic.
-            a, b = arr[1:], arr[:-1]
-            if bool((a > b).all()) or bool((a < b).all()):
-                return None, None
-    dictionary, indices = build_dictionary(column)
+        # device-resident integers intern ON DEVICE (range table +
+        # first-occurrence scatter); only the int32 index stream and
+        # the tiny dictionary cross the link — identical output to the
+        # host interner for small-RANGE columns, so those files match
+        # the host path byte for byte.  (Known divergence: wide-range
+        # few-distinct columns stay non-dict here.)  The index pull is
+        # deferred until the size gates below accept the dictionary.
+        from ..kernels.encode import device_dict_build
+
+        built = device_dict_build(column)
+        if built is None:
+            return None, None
+        dictionary, indices = built
+        n = column.count
+    else:
+        n = len(column) if isinstance(column, ByteArrayColumn) else \
+            np.asarray(column).shape[0]
+        if n == 0:
+            return None, None
+        if not isinstance(column, ByteArrayColumn):
+            arr = np.asarray(column)
+            if arr.ndim == 1 and arr.dtype.kind in "iuf" and n > 4096:
+                # strictly monotonic values (timestamps, row ids) are
+                # all distinct: the dictionary would be the column
+                # itself plus packed indices — reject without paying
+                # the sort.  Elementwise compares, NOT np.diff: a diff
+                # wraps on unsigned dtypes (and on int64 steps past
+                # 2**63) and would misclassify unsorted data as
+                # monotonic.
+                a, b = arr[1:], arr[:-1]
+                if bool((a > b).all()) or bool((a < b).all()):
+                    return None, None
+        dictionary, indices = build_dictionary(column)
     dsize = len(dictionary) if isinstance(dictionary, ByteArrayColumn) else \
         dictionary.shape[0]
     if dsize >= MAX_DICT_ENTRIES:
@@ -228,6 +245,8 @@ def _maybe_dictionary(column, allow_dict: bool):
     approx_dict = _column_size_of(dictionary) + n * width // 8
     if approx_dict >= _column_size_of(column):
         return None, None
+    if callable(indices):
+        indices = indices()  # deferred device->host index pull
     return dictionary, indices
 
 

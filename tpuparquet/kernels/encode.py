@@ -381,13 +381,16 @@ class DeviceValues:
     path (``FileWriter.write_columns``): the values stay in HBM through
     validation and statistics, and DELTA_BINARY_PACKED (int64),
     BYTE_STREAM_SPLIT and PLAIN pages encode on device — only encoded
-    bytes and two stat scalars cross the host link.
+    bytes and two stat scalars cross the host link.  Small-range
+    integer columns dictionary-encode via a DEVICE-side intern
+    (:func:`device_dict_build`): the index stream crosses at 4 bytes
+    per value instead of the unpacked column, and the file matches the
+    host path byte for byte.
 
     ``flat``: flat u32 lane words (the DeviceColumn layout: lanes
     interleaved little-endian, ``itemsize//4`` words per value);
     ``dtype``: the logical dtype — int32/int64/float32/float64.
-    Device columns never dictionary-encode (interning is host-side by
-    design); combine with ``column_encodings`` to force DELTA or BSS.
+    Combine with ``column_encodings`` to force DELTA or BSS.
     """
 
     __slots__ = ("flat", "dtype")
@@ -480,6 +483,53 @@ class DeviceValues:
         raise ValueError(
             f"DeviceValues cannot encode {encoding!r}; supported: PLAIN, "
             "DELTA_BINARY_PACKED, BYTE_STREAM_SPLIT")
+
+
+def device_dict_build(dv: "DeviceValues"):
+    """Device-side dictionary interning for small-range integer
+    ``DeviceValues`` columns: the range table, first-occurrence order
+    and per-value indices all compute in HBM, and only the int32 index
+    stream plus the tiny order table cross to the host (4 wire bytes
+    per value instead of the unpacked column).
+
+    Returns ``(dictionary ndarray, pull)`` where ``pull()`` fetches
+    the int32 index stream — deferred so the caller's dictionary-size
+    gates run BEFORE the only per-value transfer.  The order is
+    EXACTLY the host interner's first-occurrence order
+    (``cpu/dictionary._build_int_dictionary_smallrange``), so for
+    small-RANGE columns the written file is byte-identical to encoding
+    the same values from a numpy array.  None when the range gate
+    rejects; a KNOWN divergence from the host path: wide-range but
+    few-distinct columns (host np.unique still dict-encodes them)
+    stay on the non-dict device encodes — interning them would need a
+    device sort over 64-bit lanes."""
+    if dv.dtype.kind != "i":
+        return None
+    n = dv.count
+    if n == 0:
+        return None
+    lo, hi = dv.min_max()
+    rng = int(hi) - int(lo) + 1  # Python ints: no wraparound
+    if rng > 4 * n or rng > 1 << 24:
+        return None  # same gate as the host interner
+    # (value - lo) < 2**24 fits the LOW lane's u32 wraparound exactly,
+    # so the high lane of int64 columns never participates
+    lo_lane = np.uint32(int(lo) & 0xFFFFFFFF)
+    vals_lo = dv.flat[:: dv.lanes] if dv.lanes > 1 else dv.flat
+    off = (vals_lo - lo_lane).astype(jnp.int32)
+    first = jnp.full(rng, n, dtype=jnp.int32).at[off].min(
+        jnp.arange(n, dtype=jnp.int32))
+    # present entries (first < n) sort before absent ones, in
+    # first-occurrence order; ties are impossible
+    order_full = jnp.argsort(first)
+    dsize = int(jnp.sum(first < n))
+    order = order_full[:dsize]
+    rank = jnp.zeros(rng, dtype=jnp.int32).at[order].set(
+        jnp.arange(dsize, dtype=jnp.int32))
+    indices = rank[off]
+    dict_np = (np.asarray(order).astype(np.int64) + int(lo)).astype(
+        dv.dtype)
+    return dict_np, lambda: np.asarray(indices)
 
 
 def _devicevalues_unflatten(aux, leaves):
