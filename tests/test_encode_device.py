@@ -418,3 +418,24 @@ class TestDeviceDictEncode:
         w.write_columns({"v": col.as_values()})
         w.close()
         assert b1.getvalue() == b2.getvalue()
+
+    def test_unsigned_small_range_byte_identical(self):
+        # unsigned logical values above the sign boundary, stored two's
+        # complement: the intern's signed-range math still engages
+        # (both bounds negative, small span) and stats stay
+        # unsigned-ordered
+        import struct
+
+        rng = np.random.default_rng(11)
+        logical = (np.uint64(2**63)
+                   + rng.integers(0, 40, 30_000).astype(np.uint64))
+        stored = logical.view(np.int64)
+        schema = "message m { required int64 v (INT(64,false)); }"
+        host = self._write(schema, stored)
+        dev = self._write(schema, DeviceValues(
+            jnp.asarray(stored.view("<u4")), np.int64))
+        assert host == dev
+        st = FileReader(io.BytesIO(dev)).meta.row_groups[0] \
+            .columns[0].meta_data.statistics
+        assert struct.unpack("<Q", st.min_value)[0] == int(logical.min())
+        assert struct.unpack("<Q", st.max_value)[0] == int(logical.max())
