@@ -385,6 +385,59 @@ class TestSmallRangeInterner:
             assert np.array_equal(fast[0], uniq[order])
             assert np.array_equal(fast[1], rank[inv].astype(np.int32))
 
+    def test_pluggable_row_hash_hook(self):
+        """``row_hash_func`` (≙ the reference's DefaultHashFunc,
+        helpers.go:18-22): a replacement hash — even a pathological
+        all-colliding one — must not change interning output, because
+        collisions are byte-verified and fall back to the exact path."""
+        import tpuparquet.cpu.dictionary as D
+        from tpuparquet.cpu.plain import ByteArrayColumn
+
+        vals = [f"k{i % 97}".encode() for i in range(3_000)]
+        col = ByteArrayColumn.from_list(vals)
+        want_d, want_i = D.build_dictionary(col)
+        try:
+            D.row_hash_func = lambda rows: np.zeros(
+                rows.shape[0], dtype=np.uint64)  # worst case: all collide
+            d, i = D.build_dictionary(col)
+            assert d == want_d
+            np.testing.assert_array_equal(i, want_i)
+            # a shape-violating hook fails loudly, not silently
+            D.row_hash_func = lambda rows: np.zeros(1, dtype=np.uint64)
+            try:
+                D.build_dictionary(col)
+            except ValueError as e:
+                assert "row_hash_func" in str(e)
+            else:
+                raise AssertionError("bad hook shape accepted")
+        finally:
+            D.row_hash_func = None
+
+    def test_signed_narrow_dtype_span_exceeds_dtype(self):
+        """int8/int16 whose span exceeds the dtype's positive range:
+        own-dtype subtraction wraps (int8 100-(-100) = -56), aliasing
+        distinct values into one table slot — the offset must widen to
+        int64 before subtracting (advisor round-4 high finding)."""
+        from tpuparquet.cpu.dictionary import (
+            _build_int_dictionary_smallrange,
+        )
+
+        rng = np.random.default_rng(42)
+        for dt, lo, hi in [(np.int8, -100, 101), (np.int8, -128, 128),
+                           (np.int16, -17_000, 17_001)]:
+            a = rng.integers(lo, hi, 9_000).astype(dt)
+            fast = _build_int_dictionary_smallrange(a)
+            assert fast is not None
+            uniq, first_idx, inv = np.unique(
+                a, return_index=True, return_inverse=True)
+            order = np.argsort(first_idx, kind="stable")
+            rank = np.empty_like(order)
+            rank[order] = np.arange(order.size)
+            assert np.array_equal(fast[0], uniq[order])
+            assert np.array_equal(fast[1], rank[inv].astype(np.int32))
+            # decode back: every index must reproduce its source value
+            assert np.array_equal(fast[0][fast[1]], a)
+
     def test_wide_range_falls_through(self):
         from tpuparquet.cpu.dictionary import (
             _build_int_dictionary_smallrange,

@@ -112,6 +112,18 @@ def _first_occurrence_rank(first_idx: np.ndarray):
     return order, rank
 
 
+# Pluggable row hash (≙ the reference's ``DefaultHashFunc``,
+# helpers.go:18-22, which it uses to intern values for dictionary
+# pages).  The signature is VECTORIZED — (k, L) u8 row matrix in, (k,)
+# u64 out — because the interner never touches values one at a time; a
+# per-value Python hook would cost more than the encode it feeds.
+# Unlike the reference (where two colliding keys silently merge into
+# one dictionary slot), a replacement hash here cannot corrupt output:
+# every row is byte-compared against its group's first occurrence and
+# any collision falls back to the exact memcmp path below.
+row_hash_func = None  # None -> the built-in FNV-style _hash_rows
+
+
 def _unique_rows(rows: np.ndarray):
     """(first_idx, inverse) over the rows of a (k, L) u8 matrix.
 
@@ -127,7 +139,14 @@ def _unique_rows(rows: np.ndarray):
         # few, long values (blobs): one memcmp sort over k rows beats
         # O(L) vectorized hash passes
         return _unique_rows_void(rows)
-    h = _hash_rows(rows)
+    h = (row_hash_func or _hash_rows)(rows)
+    h = np.asarray(h, dtype=np.uint64)
+    if h.shape != (k,):
+        raise ValueError(
+            f"row_hash_func must return shape ({k},) u64, got {h.shape}")
+    out = _unique_rows_table(rows, h)
+    if out is not None:
+        return out
     # np.unique(return_index=...) pays a full argsort; a plain value
     # sort + searchsorted inverse + reversed-scatter first occurrence
     # gets the same triple in O(k log k) comparisons without the
@@ -161,6 +180,43 @@ def _hash_rows(rows: np.ndarray) -> np.ndarray:
     return h
 
 
+def _unique_rows_table(rows: np.ndarray, h: np.ndarray):
+    """O(k + T) table interning of hashed rows — replaces the u64 sort
+    (``np.unique`` + ``searchsorted``) that dominates low-cardinality
+    string dictionary builds.  Each row scatters into a power-of-two
+    slot table by hash; every row is then byte-compared against its
+    slot's first occupant, so a slot shared by two DISTINCT values (slot
+    or hash collision alike) fails the compare and returns None — the
+    caller falls back to the exact sorted path.  With D distinct values
+    in T ≈ 4k slots the false-fallback probability is ~D²/2T:
+    negligible at dictionary-worthy cardinalities."""
+    k = rows.shape[0]
+    if k < 4096:
+        # the sort this path replaces is near-free at small k; the
+        # 64k-slot minimum table would cost more than it saves
+        return None
+    tbits = max(16, min(22, (4 * k - 1).bit_length()))
+    T = 1 << tbits
+    # Fibonacci hashing for the slot: multiply then take the HIGH bits.
+    # A low-bit mask (even XOR-folded) inherits the FNV multiply's
+    # linear structure — near-identical inputs collapsed 200 distinct
+    # hashes onto 100 slots when this used ``(h ^ h>>32) & (T-1)``.
+    slot = ((h * np.uint64(0x9E3779B97F4A7C15))
+            >> np.uint64(64 - tbits)).astype(np.int64)
+    first = np.full(T, k, dtype=np.int64)
+    # reversed scatter keeps the LAST write = smallest original index
+    first[slot[::-1]] = np.arange(k - 1, -1, -1, dtype=np.int64)
+    rep = first[slot]
+    if not np.array_equal(rows[rep], rows):
+        return None
+    present = first < k
+    first_idx = first[present]
+    # inverse: rank of each row's slot among occupied slots (slot order)
+    lookup = np.cumsum(present) - 1
+    inv = lookup[slot]
+    return first_idx, inv
+
+
 def _unique_rows_void(rows: np.ndarray):
     """Exact memcmp-ordered unique over fixed-width rows."""
     k, L = rows.shape
@@ -169,6 +225,29 @@ def _unique_rows_void(rows: np.ndarray):
     _, first_idx, inv = np.unique(view, return_index=True,
                                   return_inverse=True)
     return first_idx, inv
+
+
+def _gather_rows(data: np.ndarray, starts: np.ndarray, k: int,
+                 L: int) -> np.ndarray:
+    """(k, L) row matrix of fixed-length segments: one C memcpy pass
+    when the native is present, else slab-bounded fancy indexing (the
+    (k, L) int64 position temporary is 8L bytes per row — larger than
+    the rows it gathers)."""
+    from ..native import delta_native
+
+    nat = delta_native()
+    if nat is not None:
+        out = nat.gather_segments(data, starts, L)
+        if out is not None:
+            return out.reshape(k, L)
+    rows = np.empty((k, L), dtype=np.uint8)
+    slab = max(1, (4 << 20) // L)
+    for s in range(0, k, slab):
+        e = min(s + slab, k)
+        pos = (np.arange(L, dtype=np.int64)
+               + starts[s:e][:, None])
+        rows[s:e] = data[pos]
+    return rows
 
 
 def _build_bytes_dictionary(values: ByteArrayColumn):
@@ -198,13 +277,7 @@ def _build_bytes_dictionary(values: ByteArrayColumn):
             next_id += 1
             continue
         k = sel.size
-        rows = np.empty((k, L), dtype=np.uint8)
-        slab = max(1, (4 << 20) // L)
-        for s in range(0, k, slab):
-            e = min(s + slab, k)
-            pos = (np.arange(L, dtype=np.int64)
-                   + offsets[sel[s:e]][:, None])
-            rows[s:e] = data[pos]
+        rows = _gather_rows(data, offsets[sel], k, L)
         first_idx, inv = _unique_rows(rows)
         order, rank = _first_occurrence_rank(first_idx)
         indices[sel] = next_id + rank[inv]
@@ -265,9 +338,14 @@ def _build_int_dictionary_smallrange(arr: np.ndarray):
     # unique path is cheaper than touching rng-sized arrays
     if rng > 4 * n or rng > 1 << 24:
         return None
-    # subtract in the array's own dtype (a Python-int amin overflows
-    # int64 for uint64 columns); the small gated span then fits int64
-    off = (arr - lo).astype(np.int64)
+    # Signed dtypes must widen BEFORE subtracting: an int8 span of 200
+    # wraps under own-dtype subtraction, aliasing distinct values into
+    # one slot.  Unsigned stays in its own dtype (a Python-int amin
+    # overflows int64 for uint64 columns); the gated span fits int64.
+    if arr.dtype.kind == "i":
+        off = arr.astype(np.int64) - amin
+    else:
+        off = (arr - lo).astype(np.int64)
     # first occurrence per value: reversed fancy assignment keeps the
     # LAST write, which is the smallest original index
     first = np.full(rng, n, dtype=np.int64)
