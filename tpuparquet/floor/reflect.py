@@ -284,7 +284,10 @@ def objects_to_columns(objs, schema):
         if (top is not None and _is_map_group(top)
                 and leaf.max_rep_level == 1
                 and top.children[0].children[0].is_leaf
-                and top.children[0].children[1].is_leaf):
+                and top.children[0].children[1].is_leaf
+                # key must be required: _maps_from_chunks pairs one key
+                # per slot; an optional key leaf would misalign streams
+                and top.children[0].children[0].is_required):
             kv = top.children[0]
             map_tops[top] = (kv.children[0], kv.children[1])
             continue
@@ -595,7 +598,10 @@ def objects_from_columns(columns, cls, schema, n_rows=None) -> list:
         if (top is not None and _is_map_group(top)
                 and leaf.max_rep_level == 1
                 and top.children[0].children[0].is_leaf
-                and top.children[0].children[1].is_leaf):
+                and top.children[0].children[1].is_leaf
+                # key must be required: _maps_from_chunks pairs one key
+                # per slot; an optional key leaf would misalign streams
+                and top.children[0].children[0].is_required):
             kv = top.children[0]
             map_tops[top.name] = (top, kv.children[0], kv.children[1])
             continue

@@ -72,8 +72,11 @@ def allgather_host(local_rows: np.ndarray) -> np.ndarray:
         lanes = np.ascontiguousarray(a1).view(np.uint32).reshape(
             a1.shape + (2,))
         out = np.asarray(multihost_utils.process_allgather(lanes))
-        return np.ascontiguousarray(out).view(a.dtype).reshape(
+        res = np.ascontiguousarray(out).view(a.dtype).reshape(
             out.shape[:-1])
+        if a.ndim == 0:  # drop the atleast_1d axis: (procs, 1) -> (procs,)
+            res = res.reshape(res.shape[0])
+        return res
     return np.asarray(multihost_utils.process_allgather(a))
 
 
