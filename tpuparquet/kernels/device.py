@@ -1562,6 +1562,8 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
         else:
             # CPU fallback for the remaining encodings; stage the result.
             _def_standalone()
+            if _st is not None:
+                _st.pages_host_values += 1
             col = decode_values_cpu(ptype, enc, values_seg, non_null,
                                     node.element.type_length)
             if isinstance(col, ByteArrayColumn):

@@ -39,6 +39,12 @@ class DecodeStats:
     # DELTA/BSS/PLAIN in kernels/encode.py) — evidence the writer TPU
     # path engaged rather than pulling raw values to host
     pages_device_encoded: int = 0
+    # pages whose VALUES were decoded on host and staged as-is (the
+    # catch-all else of the device dispatch, kernels/device.py) — the
+    # fallback-matrix observable: tests/test_fallback_matrix.py pins
+    # exactly which (encoding x type) land here, so a regression that
+    # silently demotes a device path to host fails a test, not a profile
+    pages_host_values: int = 0
     values: int = 0
     bytes_compressed: int = 0
     bytes_uncompressed: int = 0
@@ -84,6 +90,7 @@ class DecodeStats:
             "pages_device_snappy": self.pages_device_snappy,
             "pages_device_planes": self.pages_device_planes,
             "pages_device_encoded": self.pages_device_encoded,
+            "pages_host_values": self.pages_host_values,
             "values": self.values,
             "bytes_compressed": self.bytes_compressed,
             "bytes_uncompressed": self.bytes_uncompressed,
