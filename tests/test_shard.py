@@ -286,6 +286,29 @@ class TestGatherByteColumn:
             with pytest.raises(TypeError, match="fixed-width"):
                 gather_byte_column(mesh, results, "a")
 
+    def test_gather_all_null_column(self):
+        """Every unit all-null (zero packed values): the dense gather is
+        zero-filled slots only.  (The L == 0 reshape hazard — a -1
+        reshape cannot infer alongside a 0 dim — is covered by the
+        explicit-U reshape in gather_column; review finding.)"""
+        from tpuparquet.shard import ShardedScan, gather_column
+
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { optional int64 v; }")
+        for _ in range(3):
+            for _ in range(40):
+                w.add_data({})
+            w.flush_row_group()
+        w.close()
+        buf.seek(0)
+        mesh = make_mesh(4)
+        with ShardedScan([buf], mesh=mesh) as scan:
+            results = scan.run()
+            vals, counts = gather_column(mesh, results, "v")
+        assert vals.shape[0] == 3 and vals.shape[1] == 40
+        np.testing.assert_array_equal(counts, [40, 40, 40])
+        np.testing.assert_array_equal(vals, np.zeros_like(vals))
+
 
 def _column_equal(a, b):
     """Compare two DeviceColumn decodes (values + levels)."""

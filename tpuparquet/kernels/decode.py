@@ -182,6 +182,13 @@ def scatter_to_dense(packed: jax.Array, mask: jax.Array,
     slots get 0).  ``packed`` is flat 1-D with ``lanes`` u32 words per
     value (the DeviceColumn layout); 2-D (n, lanes) inputs are also
     accepted for synthetic callers (output stays 2-D then)."""
+    if packed.shape[0] == 0:
+        # all slots null (zero packed values): nothing to gather — an
+        # empty-buffer gather is out-of-range at any index
+        n = mask.shape[0]
+        shape = ((n,) + packed.shape[1:] if packed.ndim > 1
+                 else (n * lanes,))
+        return jnp.zeros(shape, dtype=packed.dtype)
     if packed.ndim > 1:
         gathered = packed[positions]
         return jnp.where(mask[:, None], gathered,

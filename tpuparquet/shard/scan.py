@@ -175,24 +175,88 @@ def gather_column(mesh, results: list[dict[str, DeviceColumn]], path: str):
     ]
     counts = np.asarray([c.num_values for c in cols], dtype=np.int64)
     L = int(counts.max()) if len(counts) else 0
-    n_dev = len(list(mesh.devices.flat))
-    U = max(len(dense), 1)
-    U = ((U + n_dev - 1) // n_dev) * n_dev
-    # pad each unit then stack once: O(U*L) total, vs the O(U^2 * L)
-    # of per-unit .at[].set updates on the stacked array
-    padded = [
-        jnp.pad(d.astype(jnp.uint32), (0, L * lanes - d.shape[0]))
-        for d in dense
+    padded = [jnp.pad(d.astype(jnp.uint32), (0, L * lanes - d.shape[0]))
+              for d in dense]
+    (gathered,), perm = _assemble_and_gather(
+        mesh, [(padded, (L * lanes,), jnp.uint32)])
+    # host-side reshape to the (U, L, lanes) view callers index; the
+    # shard-major assembly order un-permutes here
+    out = np.asarray(gathered).reshape(gathered.shape[0], L, lanes)
+    return out[perm[: len(dense)]], counts
+
+
+def _assemble_and_gather(mesh, streams):
+    """All-gather per-unit device arrays into replicated globals,
+    WITHOUT funneling them through a single device.
+
+    The naive route (``jnp.stack`` then ``device_put`` with the sharded
+    layout) materializes the whole global on ONE device before the
+    reshard — on a real mesh that is a full extra trip over PCIe/ICI
+    for every byte, and it serializes on device 0 (the worst overhead
+    found by ``tools/scan_scale_curve.py``).  Instead: stack each rg
+    block's units on the block's own device (units were placed
+    round-robin, so rows are grouped shard-major), assemble each global
+    zero-copy with :func:`jax.make_array_from_single_device_arrays`,
+    and run ONE jitted identity over all streams whose replicated
+    out-shardings lower to the all-gather collectives.
+
+    ``streams`` is a list of ``(padded_units, row_shape, dtype)`` — all
+    streams must have the same unit count.  Returns ``(gathered_list,
+    perm)`` where ``gathered[i]`` is the unit at shard-major row i and
+    ``perm`` maps unit index -> gathered row.
+    """
+    # generalize over mesh rank: an rg-only mesh (no "sp" axis) is the
+    # sp == 1 layout — callers may build their own 1-D mesh
+    n_rg = mesh.shape["rg"]
+    sp = dict(mesh.shape).get("sp", 1)
+    grid = np.asarray(mesh.devices).reshape(n_rg, sp)
+    n_dev = n_rg * sp
+    n_true = len(streams[0][0])
+    U = max(((n_true + n_dev - 1) // n_dev) * n_dev, n_dev)
+    rows_per_block = U // n_rg
+    order = []   # shard-major: unit index per gathered row
+    # P("rg") shards rows over rg only: rg block r spans the units the
+    # round-robin placed on its sp sibling devices, and the whole block
+    # replicates across those siblings
+    blocks = [
+        [u for u in range(n_true)
+         if r * sp <= (u % n_dev) < (r + 1) * sp]
+        for r in range(n_rg)
     ]
-    padded += [jnp.zeros((L * lanes,), dtype=jnp.uint32)] * (U - len(dense))
-    stacked = jnp.stack(padded)
-    sharded = jax.device_put(stacked, NamedSharding(mesh, P("rg")))
+    for r, mine in enumerate(blocks):
+        order.extend(mine)
+        order.extend([-1] * (rows_per_block - len(mine)))
+    stacked_all = []
+    for padded, row_shape, dtype in streams:
+        zero = None
+        shards = []  # one per device, grid order (sp fastest)
+        for r, mine in enumerate(blocks):
+            # explicit placement BEFORE the stack: rows of an sp > 1
+            # block live on sibling devices, and a jitted stack over
+            # mixed committed devices is backend-dependent (no-op
+            # transfer when the scan already placed the unit here)
+            rows = [jax.device_put(padded[u], grid[r, 0]) for u in mine]
+            if len(rows) < rows_per_block:
+                if zero is None:
+                    zero = np.zeros(row_shape, dtype=dtype)
+                rows += [zero] * (rows_per_block - len(rows))
+            block = jnp.stack(rows)
+            for s in range(sp):
+                shards.append(jax.device_put(block, grid[r, s]))
+        sharding = NamedSharding(mesh, P("rg"))
+        global_shape = (U,) + tuple(shards[0].shape[1:])
+        stacked_all.append(jax.make_array_from_single_device_arrays(
+            global_shape, sharding, shards))
+    rep = NamedSharding(mesh, P())
     gathered = jax.jit(
-        lambda x: x, out_shardings=NamedSharding(mesh, P())
-    )(sharded)
-    # host-side reshape to the (U, L, lanes) view callers index
-    out = np.asarray(gathered).reshape(U, L, lanes)
-    return out[: len(dense)], counts
+        lambda *xs: xs, out_shardings=tuple(rep for _ in stacked_all)
+    )(*stacked_all)
+    # perm[u] = gathered row of unit u
+    perm = np.empty(n_true, dtype=np.int64)
+    for row, u in enumerate(order):
+        if u >= 0:
+            perm[u] = row
+    return list(gathered), perm
 
 
 def gather_byte_column(mesh, results: list[dict[str, DeviceColumn]],
@@ -236,27 +300,16 @@ def gather_byte_column(mesh, results: list[dict[str, DeviceColumn]],
     byte_counts = np.asarray([d.shape[0] for d in datas], dtype=np.int64)
     L = int(row_counts.max()) + 1 if len(cols) else 1
     B = max(int(byte_counts.max()), 1) if len(cols) else 1
-    n_dev = len(list(mesh.devices.flat))
-    U = max(len(cols), 1)
-    U = ((U + n_dev - 1) // n_dev) * n_dev
-    # pad each unit then stack once (O(U*B) total; edge-padding keeps
-    # the offsets monotone at the byte total)
+    # pad each unit on its own device (edge-padding keeps the offsets
+    # monotone at the byte total), then assemble shard-major and
+    # all-gather without funneling through one device
     offs_dtype = dense_offs[0].dtype if cols else jnp.int32
-    offs_padded = [
-        jnp.pad(do, (0, L - do.shape[0]), mode="edge")
-        for do in dense_offs
-    ] + [jnp.zeros((L,), dtype=offs_dtype)] * (U - len(cols))
-    data_padded = [
-        jnp.pad(d, (0, B - d.shape[0])) for d in datas
-    ] + [jnp.zeros((B,), dtype=jnp.uint8)] * (U - len(cols))
-    offs_stack = jnp.stack(offs_padded)
-    data_stack = jnp.stack(data_padded)
-    spec = NamedSharding(mesh, P("rg"))
-    rep = NamedSharding(mesh, P())
-    o_sh = jax.device_put(offs_stack, spec)
-    d_sh = jax.device_put(data_stack, spec)
-    o_g, d_g = jax.jit(
-        lambda o, d: (o, d), out_shardings=(rep, rep)
-    )(o_sh, d_sh)
-    return (np.asarray(o_g)[: len(cols)], np.asarray(d_g)[: len(cols)],
+    offs_padded = [jnp.pad(do, (0, L - do.shape[0]), mode="edge")
+                   for do in dense_offs]
+    data_padded = [jnp.pad(d, (0, B - d.shape[0])) for d in datas]
+    (o_g, d_g), perm = _assemble_and_gather(
+        mesh, [(offs_padded, (L,), offs_dtype),
+               (data_padded, (B,), jnp.uint8)])
+    return (np.asarray(o_g)[perm[: len(cols)]],
+            np.asarray(d_g)[perm[: len(cols)]],
             row_counts, byte_counts)
