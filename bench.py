@@ -398,6 +398,128 @@ def time_pyarrow(buf: io.BytesIO) -> float:
     return best
 
 
+# --------------------------------------------------------------------------
+# write-side external anchor (round-4 verdict item 7): our columnar
+# writer vs pyarrow writing the SAME logical data with matched settings
+# (snappy, dictionary on).  Configs 2 and 4 — the dict-int and string
+# shapes whose interning is the writer's wall.
+# --------------------------------------------------------------------------
+
+def _write_anchor_config2(n: int) -> dict:
+    from tpuparquet import CompressionCodec, FileWriter
+
+    rng = np.random.default_rng(52)
+    per = n // 5
+    pay_mask = rng.random(per) >= 0.05
+    cols = {
+        "pickup_ts": 1_700_000_000_000
+        + rng.integers(0, 3_600_000, size=per).cumsum(),
+        "passenger_count": rng.integers(1, 7, size=per, dtype=np.int32),
+        "rate_code": rng.integers(1, 6, size=per, dtype=np.int32),
+        "trip_distance_mm": rng.integers(100, 50_000, size=per),
+        "payment_type": rng.integers(0, 5, size=int(pay_mask.sum()),
+                                     dtype=np.int32),
+    }
+
+    def ours():
+        buf = io.BytesIO()
+        w = FileWriter(
+            buf,
+            """message taxi {
+                required int64 pickup_ts;
+                required int32 passenger_count;
+                required int32 rate_code;
+                required int64 trip_distance_mm;
+                optional int32 payment_type;
+            }""",
+            codec=CompressionCodec.SNAPPY,
+        )
+        w.write_columns(cols, masks={"payment_type": pay_mask})
+        w.close()
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    # table built OUTSIDE the timed region: ours starts from ready
+    # columns, so pyarrow must too — timing its Python->Arrow
+    # conversion would inflate our ratio
+    pay_full = np.zeros(per, dtype=np.int32)
+    pay_full[pay_mask] = cols["payment_type"]
+    table = pa.table({
+        "pickup_ts": cols["pickup_ts"],
+        "passenger_count": cols["passenger_count"],
+        "rate_code": cols["rate_code"],
+        "trip_distance_mm": cols["trip_distance_mm"],
+        "payment_type": pa.array(pay_full, mask=~pay_mask),
+    })
+
+    def theirs():
+        pq.write_table(table, io.BytesIO(), compression="snappy",
+                       use_dictionary=True)
+
+    return _time_write_pair(5 * per, ours, theirs)
+
+
+def _write_anchor_config4(n: int) -> dict:
+    from tpuparquet import CompressionCodec, FileWriter
+    from tpuparquet.cpu.plain import ByteArrayColumn
+
+    rng = np.random.default_rng(54)
+    per = n // 4
+    vocab = [f"vendor-{i:03d}".encode() for i in range(200)]
+    picks = rng.integers(0, len(vocab), size=per)
+    fare = rng.random(per) * 100.0
+    tip = rng.random(per) * 20.0
+    vendor_list = [vocab[i] for i in picks]
+    vendor_col = ByteArrayColumn.from_list(vendor_list)
+
+    def ours():
+        buf = io.BytesIO()
+        w = FileWriter(
+            buf,
+            """message m {
+                required binary vendor (STRING);
+                required double fare;
+                required double tip;
+            }""",
+            codec=CompressionCodec.SNAPPY, data_page_v2=True,
+        )
+        w.write_columns({"vendor": vendor_col, "fare": fare, "tip": tip})
+        w.close()
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    # pre-built like ours (see _write_anchor_config2)
+    table = pa.table({"vendor": pa.array(vendor_list, type=pa.binary()),
+                      "fare": fare, "tip": tip})
+
+    def theirs():
+        pq.write_table(table, io.BytesIO(), compression="snappy",
+                       use_dictionary=True, data_page_version="2.0")
+
+    return _time_write_pair(3 * per, ours, theirs)
+
+
+def _time_write_pair(n_values: int, ours, theirs) -> dict:
+    best_us = best_pa = float("inf")
+    for _ in range(CPU_REPS):
+        t0 = time.perf_counter()
+        ours()
+        best_us = min(best_us, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        theirs()
+        best_pa = min(best_pa, time.perf_counter() - t0)
+    return {
+        "write_vps": round(n_values / best_us, 1),
+        "pyarrow_write_vps": round(n_values / best_pa, 1),
+        "write_vs_pyarrow": round(best_pa / best_us, 3),
+    }
+
+
+_WRITE_ANCHORS = {2: _write_anchor_config2, 4: _write_anchor_config4}
+
+
 def run_config(name: str, buf: io.BytesIO) -> dict:
     from tpuparquet import FileReader
 
@@ -501,18 +623,87 @@ def run_config5() -> dict:
     }
 
 
-def _probe_backend(timeout_s: int = 240, attempts: int = 3) -> None:
-    """Fail fast (after a few retries) when the device backend can't
-    initialize.
+# --------------------------------------------------------------------------
+# orchestration
+#
+# The round-3/4 postmortem: one wedged tunnel window at driver time lost
+# the WHOLE round's record (BENCH_r03/r04: rc=2, parsed null).  The
+# structure that fixes it:
+#   * each config runs in its own SUBPROCESS with a timeout — a tunnel
+#     death mid-ladder kills one config, not the run, and can't hang;
+#   * results persist to BENCH_PARTIAL.json as each config completes;
+#   * a fully/partially successful device ladder persists to
+#     BENCH_SESSION.json with a timestamp, which a later run whose probe
+#     fails falls back to (tools/bench_opportunist.sh keeps trying all
+#     session so a brief tunnel window anytime yields a chip record);
+#   * the final stdout line is ALWAYS a parseable record — ok:false with
+#     CPU-side numbers in the worst case — and the exit code is 0.
+# --------------------------------------------------------------------------
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
+PARTIAL_PATH = os.path.join(_REPO, "BENCH_PARTIAL.json")
+SESSION_PATH = os.path.join(_REPO, "BENCH_SESSION.json")
+CONFIG_NAMES = {
+    1: "1-plain-int64-uncompressed",
+    2: "2-taxi-dict-snappy",
+    3: "3-delta-int64-nested-list",
+    4: "4-wide-string-dict-float64-v2",
+    5: "5-multifile-sharded-scan",
+}
+_BUILDERS = {1: build_config1, 2: build_config2, 3: build_config3,
+             4: build_config4}
+
+
+def _utcnow() -> str:
+    import datetime
+
+    return datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds")
+
+
+def _persist(path: str, obj: dict) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1)
+    os.replace(tmp, path)
+
+
+def _pin_cpu() -> None:
+    # this image's sitecustomize pins jax_platforms to the axon tunnel,
+    # so plain JAX_PLATFORMS=cpu is overridden; the config call is not
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def child_main(idx: int) -> None:
+    """Run ONE config and print its JSON line (invoked as a subprocess
+    by the orchestrator; stderr progress passes through)."""
+    if os.environ.get("TPQ_BENCH_CPU"):
+        _pin_cpu()
+    if idx == 5:
+        r = run_config5()
+    else:
+        name = CONFIG_NAMES[idx]
+        _progress(f"[{name}] building file")
+        r = run_config(name, _BUILDERS[idx]())
+        if idx in _WRITE_ANCHORS:
+            _progress(f"[{name}] write-side anchor vs pyarrow")
+            r.update(_WRITE_ANCHORS[idx](
+                min(TARGET, 10_000_000)))  # write anchor needs no 50M
+    print(json.dumps(r), flush=True)
+
+
+def _probe_backend(timeout_s: int, attempts: int) -> bool:
+    """True when the device backend initializes inside the window.
 
     A wedged remote tunnel makes ``jax.devices()`` hang indefinitely
     (observed repeatedly on the axon tunnel); probing in a subprocess
     with a timeout turns a silently-eaten measurement window into a
-    bounded, diagnosable failure — while the retries ride out a tunnel
+    bounded, diagnosable outcome — while the retries ride out a tunnel
     that recovers mid-window."""
     import subprocess
 
-    last = None
     for attempt in range(1, attempts + 1):
         try:
             subprocess.run(
@@ -521,7 +712,7 @@ def _probe_backend(timeout_s: int = 240, attempts: int = 3) -> None:
                 stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
                 text=True,
             )
-            return
+            return True
         except subprocess.TimeoutExpired:
             last = (f"device backend failed to initialize within "
                     f"{timeout_s}s (tunnel wedged?)")
@@ -530,40 +721,66 @@ def _probe_backend(timeout_s: int = 240, attempts: int = 3) -> None:
             last = (f"device backend probe failed (rc={e.returncode})\n"
                     f"{(e.stderr or '')[-2000:]}")
             pause = 60  # fast failure: give the tunnel a window to return
-        print(f"bench: probe attempt {attempt}/{attempts}: {last}",
-              file=sys.stderr, flush=True)
+        _progress(f"bench: probe attempt {attempt}/{attempts}: {last}")
         if attempt < attempts and pause:
             time.sleep(pause)
-    print("bench: aborting instead of hanging", file=sys.stderr)
-    raise SystemExit(2)
+    return False
 
 
-def main() -> None:
-    if os.environ.get("TPQ_BENCH_CPU"):
-        # smoke-test mode: this image's sitecustomize pins jax_platforms
-        # to the axon tunnel, so plain JAX_PLATFORMS=cpu is overridden
-        import jax
+def _run_config_subprocess(idx: int, timeout_s: int):
+    """(result dict | None, error str | None) for one config child."""
+    import subprocess
 
-        jax.config.update("jax_platforms", "cpu")
-    else:
-        _probe_backend()
-    results = {}
-    for name, builder in [
-        ("1-plain-int64-uncompressed", build_config1),
-        ("2-taxi-dict-snappy", build_config2),
-        ("3-delta-int64-nested-list", build_config3),
-        ("4-wide-string-dict-float64-v2", build_config4),
-    ]:
-        _progress(f"[{name}] building file")
-        r = run_config(name, builder())
-        results[name] = r
-        print(json.dumps(r), flush=True)
-    r5 = run_config5()
-    results[r5["config"]] = r5
-    print(json.dumps(r5), flush=True)
+    env = dict(os.environ)
+    # persistent compilation cache: each child (and each opportunist
+    # retry) would otherwise pay the full trace+compile over the tunnel
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(_REPO, ".jax_cache"))
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--config", str(idx)],
+            timeout=timeout_s, stdout=subprocess.PIPE, text=True, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {timeout_s}s (tunnel wedged?)"
+    lines = [ln for ln in (proc.stdout or "").splitlines() if ln.strip()]
+    if proc.returncode != 0:
+        tail = lines[-1][:500] if lines else ""
+        return None, f"rc={proc.returncode} {tail}"
+    try:
+        return json.loads(lines[-1]), None
+    except (ValueError, IndexError):
+        return None, "no JSON line in child output"
 
-    head = results["2-taxi-dict-snappy"]
-    print(json.dumps({
+
+def _device_ladder() -> tuple[dict, dict]:
+    """Run all five configs, one subprocess each; persist as they land."""
+    per_cfg_timeout = int(os.environ.get("TPQ_BENCH_CONFIG_TIMEOUT", 1500))
+    results: dict = {}
+    errors: dict = {}
+    backend = "cpu-smoke" if os.environ.get("TPQ_BENCH_CPU") else "device"
+    partial = {"ts": _utcnow(), "backend": backend, "target": TARGET,
+               "configs": results, "errors": errors}
+    for idx in range(1, 6):
+        name = CONFIG_NAMES[idx]
+        r, err = _run_config_subprocess(idx, per_cfg_timeout)
+        if r is not None:
+            results[name] = r
+            print(json.dumps(r), flush=True)
+        else:
+            errors[name] = err
+            _progress(f"bench: config {idx} failed: {err}")
+        _persist(PARTIAL_PATH, partial)
+    return results, errors
+
+
+def _final_record(results: dict, errors: dict, source: str,
+                  captured_at: str | None = None) -> dict:
+    """The driver-schema line, built from whatever completed."""
+    head_name = CONFIG_NAMES[2]
+    head = results.get(head_name) or next(iter(results.values()))
+    rec = {
         "metric": "decoded values/sec/chip, NYC-Taxi-like (Snappy+dict), "
                   f"{head['n_values']/1e6:.0f}M values",
         "value": head["device_vps"],
@@ -571,14 +788,114 @@ def main() -> None:
         "vs_baseline": head["vs_baseline"],
         "pyarrow_values_per_sec": head["pyarrow_vps"],
         "vs_pyarrow": head["vs_pyarrow"],
-        "configs": {k: {"n_values": v["n_values"],
-                        "cpu_vps": v["cpu_vps"],
-                        "pyarrow_vps": v["pyarrow_vps"],
-                        "device_vps": v["device_vps"],
-                        "vs_baseline": v["vs_baseline"],
-                        "vs_pyarrow": v["vs_pyarrow"]}
+        "ok": len(results) == 5,
+        "source": source,
+        "configs": {k: {kk: v[kk] for kk in (
+                        "n_values", "cpu_vps", "pyarrow_vps",
+                        "device_vps", "vs_baseline", "vs_pyarrow",
+                        "write_vps", "pyarrow_write_vps",
+                        "write_vs_pyarrow") if kk in v}
                     for k, v in results.items()},
-    }))
+    }
+    if head["config"] != head_name:
+        rec["headline_config"] = head["config"]
+    if errors:
+        rec["errors"] = errors
+    if captured_at:
+        rec["captured_at"] = captured_at
+    return rec
+
+
+def _cpu_side_fallback() -> dict:
+    """CPU-oracle + pyarrow numbers only (no device): the record of last
+    resort so a dead tunnel still yields a non-null parse.  Smaller
+    target: these numbers bound nothing on-chip, they just prove the
+    harness and anchor the CPU side."""
+    global TARGET
+    TARGET = int(os.environ.get("TPQ_BENCH_FALLBACK_TARGET", 10_000_000))
+    _pin_cpu()
+    from tpuparquet import FileReader
+
+    configs = {}
+    for idx in range(1, 5):
+        name = CONFIG_NAMES[idx]
+        _progress(f"[fallback {name}] building + timing cpu/pyarrow")
+        # config2's n_values default binds TARGET at def time; pass the
+        # reduced fallback target explicitly
+        buf = (build_config2(n_values=TARGET) if idx == 2
+               else _BUILDERS[idx]())
+        reader = FileReader(buf)
+        n = total_values(reader)
+        _cpu_pass(reader)
+        configs[name] = {
+            "n_values": n,
+            "cpu_vps": round(n / time_cpu(reader), 1),
+            "pyarrow_vps": round(n / time_pyarrow(buf), 1),
+        }
+    return configs
+
+
+def main() -> None:
+    if len(sys.argv) >= 3 and sys.argv[1] == "--config":
+        child_main(int(sys.argv[2]))
+        return
+
+    if os.environ.get("TPQ_BENCH_CPU"):
+        # smoke-test mode: run the ladder on the CPU backend, same
+        # subprocess structure as the real run so it is what's tested
+        os.environ.setdefault("TPQ_BENCH_CONFIG_TIMEOUT", "600")
+        results, errors = _device_ladder()
+        if results:
+            print(json.dumps(_final_record(results, errors, "cpu-smoke")),
+                  flush=True)
+        else:
+            print(json.dumps({"metric": "bench-smoke", "value": 0,
+                              "unit": "values/sec", "vs_baseline": 0,
+                              "ok": False, "errors": errors}), flush=True)
+        return
+
+    probe_s = int(os.environ.get("TPQ_BENCH_PROBE_TIMEOUT", 150))
+    attempts = int(os.environ.get("TPQ_BENCH_PROBE_ATTEMPTS", 2))
+    results: dict = {}
+    errors: dict = {}
+    if _probe_backend(probe_s, attempts):
+        results, errors = _device_ladder()
+        if results:
+            rec = _final_record(results, errors, "live")
+            _persist(SESSION_PATH, {"ts": _utcnow(), "record": rec})
+            print(json.dumps(rec), flush=True)
+            return
+    # Tunnel dead (or every config died): fall back to the freshest
+    # record captured earlier this session by tools/bench_opportunist.sh
+    if os.path.exists(SESSION_PATH):
+        try:
+            with open(SESSION_PATH) as f:
+                sess = json.load(f)
+            rec = dict(sess["record"])
+            rec["source"] = "session-opportunistic"
+            rec["captured_at"] = sess["ts"]
+            if errors:
+                rec["live_errors"] = errors
+            _progress("bench: tunnel dead now; emitting the session-"
+                      f"captured chip record from {sess['ts']}")
+            print(json.dumps(rec), flush=True)
+            return
+        except (OSError, ValueError, KeyError) as e:
+            _progress(f"bench: session record unreadable: {e!r}")
+    # No chip record exists at all: emit ok:false with CPU-side numbers
+    _progress("bench: no device window all session; CPU-side fallback")
+    configs = _cpu_side_fallback()
+    print(json.dumps({
+        "metric": "decoded values/sec/chip, NYC-Taxi-like (Snappy+dict) "
+                  "— DEVICE UNREACHABLE, cpu-side anchors only",
+        "value": 0,
+        "unit": "values/sec",
+        "vs_baseline": 0,
+        "ok": False,
+        "source": "cpu-fallback",
+        "errors": errors or {"probe": "device backend unreachable"},
+        "cpu_configs": configs,
+    }), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,44 @@
+#!/bin/bash
+# Session-long opportunistic bench capture (the round-4 postmortem fix:
+# betting the round on ONE driver-time tunnel window lost two rounds'
+# records).  Probes the device backend every PROBE_SLEEP seconds; on a
+# healthy window runs the official ladder (bench.py), which persists a
+# chip record to BENCH_SESSION.json.  Exits once a COMPLETE (ok:true)
+# record exists; keeps retrying after partial ones — so driver-time
+# bench.py can fall back to the freshest session capture even if the
+# tunnel is dead at round end.
+#
+# Usage: nohup bash tools/bench_opportunist.sh >> tools/bench_opportunist.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+PROBE_SLEEP=${PROBE_SLEEP:-900}
+PROBE_TIMEOUT=${PROBE_TIMEOUT:-60}
+
+complete_record() {
+  python - <<'EOF'
+import json, sys
+try:
+    with open("BENCH_SESSION.json") as f:
+        sess = json.load(f)
+    sys.exit(0 if sess["record"].get("ok") else 1)
+except Exception:
+    sys.exit(1)
+EOF
+}
+
+while true; do
+  if complete_record; then
+    echo "$(date -Is) complete session record exists; opportunist done"
+    exit 0
+  fi
+  if timeout "$PROBE_TIMEOUT" python -c "import jax; jax.devices()" \
+      >/dev/null 2>&1; then
+    echo "$(date -Is) tunnel up: running official ladder"
+    TPQ_BENCH_PROBE_TIMEOUT=60 TPQ_BENCH_PROBE_ATTEMPTS=1 \
+      python bench.py
+    echo "$(date -Is) ladder attempt finished (rc=$?)"
+  else
+    echo "$(date -Is) tunnel down"
+  fi
+  sleep "$PROBE_SLEEP"
+done
