@@ -1,0 +1,185 @@
+"""rg x sp scaling curve for the sharded scan on the virtual CPU mesh
+(round-4 verdict weak item 5 / next-round item 6).
+
+Fixed total work, two experiments, phase-decomposed:
+
+1. ShardedScan (the "rg" outer loop): same multi-row-group file scanned
+   on 1/2/4/8-device meshes; phases = scan (host plan + stage + kernel
+   dispatch per unit) and gather (the all-gather collective), plus the
+   gather's padding waste (padded bytes shipped / true bytes).
+
+2. The SPMD dict-decode step (sharded_dict_decode's internals, the
+   "rg" x "sp" jitted step): phases = host plan (run-table scan), pad
+   (stack_hybrid_plans bucket padding, with waste ratio), put (transfer
+   to the sharded layout), step (compute + both all-gathers).
+
+On virtual CPU devices every "device" is the same host, so absolute
+speedup is meaningless — what this measures is where the orchestration
+overhead lives and how it scales with the mesh, which IS transferable
+to real chips (the phases are the same code).
+
+    python tools/scan_scale_curve.py [out.json]
+"""
+
+import io
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def bench_sharded_scan(n_units=16, nv=1_000_000):
+    from tpuparquet import CompressionCodec, FileWriter
+    from tpuparquet.shard.mesh import make_mesh
+    from tpuparquet.shard.scan import ShardedScan, gather_column
+
+    rng = np.random.default_rng(6)
+    buf = io.BytesIO()
+    w = FileWriter(buf, "message m { required int64 v; }",
+                   codec=CompressionCodec.SNAPPY)
+    for _ in range(n_units):
+        w.write_columns(
+            {"v": rng.integers(0, 1 << 40, size=nv)})
+    w.close()
+
+    curve = []
+    for nd in (1, 2, 4, 8):
+        buf.seek(0)
+        mesh = make_mesh(nd, sp=1)
+        # warmup (compile) then measure best-of-2
+        best = None
+        for rep in range(3):
+            buf.seek(0)
+            scan = ShardedScan([buf], mesh=mesh)
+            t0 = time.perf_counter()
+            results = scan.run()
+            for res in results:
+                for c in res.values():
+                    c.block_until_ready()
+            t_scan = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            vals, counts = gather_column(mesh, results, "v")
+            t_gather = time.perf_counter() - t1
+            if rep == 0:
+                continue  # compile warmup
+            if best is None or t_scan + t_gather < sum(best[:2]):
+                true_bytes = int(counts.sum()) * 8
+                padded_bytes = vals.size * 4  # u32 elements, all dims
+                best = (t_scan, t_gather, padded_bytes / true_bytes)
+        curve.append({
+            "devices": nd,
+            "scan_s": round(best[0], 3),
+            "gather_s": round(best[1], 3),
+            "values_per_sec": round(n_units * nv / (best[0] + best[1]), 1),
+            "gather_pad_ratio": round(best[2], 3),
+        })
+    return {"n_units": n_units, "values_per_unit": nv, "curve": curve}
+
+
+def bench_spmd_step(n_streams=32, nv=1_000_000, width=7, dict_size=100):
+    """The rg x sp jitted decode step, phase-split."""
+    from tpuparquet.cpu.hybrid import encode_hybrid
+    from tpuparquet.kernels.hybrid import plan_hybrid
+    from tpuparquet.shard.mesh import (
+        decode_step_spmd, make_mesh, stack_hybrid_plans,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rng = np.random.default_rng(9)
+    streams, counts = [], []
+    for _ in range(n_streams):
+        idx = rng.integers(0, dict_size, size=nv).astype(np.uint32)
+        streams.append(encode_hybrid(idx, width))
+        counts.append(nv)
+    dictionary = rng.integers(0, 1 << 32, size=(dict_size, 2),
+                              dtype=np.uint32)
+
+    curve = []
+    for nd, sp in ((1, 1), (2, 1), (2, 2), (4, 1), (4, 2), (8, 1),
+                   (8, 2)):
+        if nd % sp:
+            continue
+        mesh = make_mesh(nd, sp=sp)
+        n_rg = mesh.shape["rg"]
+        best = None
+        for rep in range(3):
+            t0 = time.perf_counter()
+            plans = [plan_hybrid(s, c, width)
+                     for s, c in zip(streams, counts)]
+            t_plan = time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            n_units = ((len(plans) + n_rg - 1) // n_rg) * n_rg
+            batch = stack_hybrid_plans(plans, n_units=n_units)
+            count = batch.count
+            if count % sp:
+                count = (count + sp - 1) // sp * sp
+                batch = stack_hybrid_plans(plans, n_units=n_units,
+                                           count=count)
+            t_pad = time.perf_counter() - t0
+            pad_waste = (batch.count * batch.n_units) / float(
+                sum(counts)) - 1.0
+
+            t0 = time.perf_counter()
+            unit_sh = NamedSharding(mesh, P("rg"))
+            rep_sh = NamedSharding(mesh, P())
+            args = [jax.device_put(a, unit_sh) for a in batch.arrays()]
+            dict_dev = jax.device_put(dictionary, rep_sh)
+            for a in args:
+                a.block_until_ready()
+            t_put = time.perf_counter() - t0
+
+            step = decode_step_spmd(mesh, batch.count, batch.width,
+                                    batch.n_bp, dictionary.shape[1])
+            t0 = time.perf_counter()
+            out = step(*args, dict_dev)
+            out.block_until_ready()
+            t_step = time.perf_counter() - t0
+            if rep == 0:
+                continue  # compile warmup
+            tot = t_plan + t_pad + t_put + t_step
+            if best is None or tot < best[0]:
+                best = (tot, t_plan, t_pad, t_put, t_step, pad_waste)
+        tot, t_plan, t_pad, t_put, t_step, pad_waste = best
+        curve.append({
+            "devices": nd, "sp": sp,
+            "plan_s": round(t_plan, 3), "pad_s": round(t_pad, 3),
+            "put_s": round(t_put, 3), "step_s": round(t_step, 3),
+            "values_per_sec": round(n_streams * nv / tot, 1),
+            "pad_waste": round(pad_waste, 4),
+        })
+    return {"n_streams": n_streams, "values_per_stream": nv,
+            "width": width, "curve": curve}
+
+
+def main():
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "SCAN_SCALE_r05.json"
+    t0 = time.time()
+    scan = bench_sharded_scan()
+    spmd = bench_spmd_step()
+    rec = {
+        "backend": "cpu-virtual-8",
+        "sharded_scan": scan,
+        "spmd_step": spmd,
+        "wall_s": round(time.time() - t0, 1),
+    }
+    print(json.dumps(rec, indent=1))
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
