@@ -300,6 +300,37 @@ class TestProjection:
 
 
 class TestColumnarAPI:
+    def test_threaded_flush_byte_identical(self, monkeypatch):
+        """Per-column thread-pool encode must produce the same bytes as
+        the serial path (offsets made absolute at append time)."""
+        def build():
+            buf = io.BytesIO()
+            w = FileWriter(
+                buf,
+                "message m { required int64 a; required int32 b; "
+                "optional binary s (STRING); required double d; }",
+                codec=CompressionCodec.SNAPPY,
+            )
+            rng = np.random.default_rng(77)
+            n = 30_000
+            mask = rng.random(n) >= 0.2
+            w.write_columns(
+                {"a": rng.integers(0, 99, n),
+                 "b": rng.integers(0, 7, n, dtype=np.int32),
+                 "s": [f"s{i % 41}".encode()
+                       for i in range(int(mask.sum()))],
+                 "d": rng.random(n)},
+                masks={"s": mask},
+            )
+            w.close()
+            return buf.getvalue()
+
+        monkeypatch.setenv("TPQ_WRITE_THREADS", "1")
+        serial = build()
+        monkeypatch.setenv("TPQ_WRITE_THREADS", "4")
+        threaded = build()
+        assert serial == threaded
+
     def test_write_columns_read_arrays(self):
         buf = io.BytesIO()
         w = FileWriter(
