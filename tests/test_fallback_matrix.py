@@ -11,10 +11,11 @@ codec x page-version) combination and asserts, via the
 ``DecodeStats.pages_host_values`` counter, EXACTLY which combinations
 host-decode.
 
-Golden rule (as of round 5): the ONLY host-decoded value stream from
-our own writer is FIXED_LEN_BYTE_ARRAY + DELTA_BYTE_ARRAY — the device
-front-coding expansion (≙ the copy-token kernel) is wired for
-BYTE_ARRAY only.  Everything else decodes on device.
+Golden rule (as of round 5): NO combination our writer can produce
+host-decodes — the last one (FIXED_LEN_BYTE_ARRAY + DELTA_BYTE_ARRAY)
+gained a device path when the front-coding expansion learned to feed
+lane words (``flba_bytes_to_lanes``).  The catch-all host branch now
+serves only foreign/corrupt encodings.
 
 Reference analogue: the exhaustive encoding dispatch of
 ``chunk_reader.go:143-196`` — there the dispatch is correctness-only;
@@ -75,10 +76,9 @@ ENC = {
 
 # THE GOLDEN SET: (type, encoding) pairs whose values host-decode.
 # Adding a combination here must be a deliberate decision, not a
-# refactoring accident.
-EXPECTED_HOST = {
-    ("flba4", "dba"),  # device front-coding kernel is BYTE_ARRAY-only
-}
+# refactoring accident.  Empty since FLBA+DELTA_BYTE_ARRAY gained its
+# device path.
+EXPECTED_HOST: set = set()
 
 CODECS = [CompressionCodec.UNCOMPRESSED, CompressionCodec.SNAPPY,
           CompressionCodec.GZIP, CompressionCodec.ZSTD]

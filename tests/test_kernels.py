@@ -384,6 +384,50 @@ class TestDeviceRegressions:
                         w.close()
                         compare(buf)
 
+    def test_flba_delta_byte_array_device_expansion(self):
+        """FLBA + DELTA_BYTE_ARRAY through the device copy-token path:
+        long values sharing prefixes make the front coding expand
+        (expanded > suffixes + token table), so the pointer-doubling
+        kernel runs and its flat output converts to lane words on
+        device (flba_bytes_to_lanes) — the last former host fallback."""
+        import io as _io
+
+        import numpy as _np
+
+        from tpuparquet import FileReader, FileWriter
+        from tpuparquet.format.metadata import CompressionCodec, Encoding
+        from tpuparquet.kernels.device import read_row_group_device
+        from tpuparquet.stats import collect_stats
+
+        L = 32
+        vals = []
+        base = b"shared-prefix-0123456789abcdef-"  # 31 bytes
+        for i in range(600):
+            vals.append(base + bytes([i % 251]))
+        rows = _np.frombuffer(b"".join(vals), _np.uint8).reshape(-1, L)
+        for v2 in (False, True):
+            buf = _io.BytesIO()
+            w = FileWriter(
+                buf,
+                f"message m {{ required fixed_len_byte_array({L}) k; }}",
+                codec=CompressionCodec.SNAPPY, data_page_v2=v2,
+                allow_dict=False,
+                column_encodings={"k": Encoding.DELTA_BYTE_ARRAY},
+            )
+            w.write_columns({"k": rows})
+            w.close()
+            buf.seek(0)
+            r = FileReader(buf)
+            with collect_stats() as st:
+                dev = read_row_group_device(r, 0)
+                for c in dev.values():
+                    c.block_until_ready()
+            assert st.pages_host_values == 0
+            cpu = r.read_row_group_arrays(0)
+            got, _rep, _dl = dev["k"].to_numpy()
+            _np.testing.assert_array_equal(
+                _np.asarray(got), _np.asarray(cpu["k"].values))
+
     def test_required_dict_fixed_device(self):
         """Required dict-encoded fixed-width column, device path."""
         import io as _io

@@ -95,6 +95,24 @@ def bss_to_lanes(raw: jax.Array, count: int, k: int, lanes: int):
     return words.reshape(-1)
 
 
+@functools.partial(jax.jit, static_argnames=("count", "type_length"))
+def flba_bytes_to_lanes(raw: jax.Array, count: int, type_length: int):
+    """Device-resident FLBA byte rows -> flat (count*lanes,) u32 lane
+    words (rows zero-padded to whole little-endian u32 lanes — the
+    DeviceColumn FLBA layout of ``_stage_byte_rows_np``).  Lets a
+    device expansion (e.g. DELTA_BYTE_ARRAY front coding) feed a fixed
+    column without a host round trip."""
+    L = type_length
+    lanes = (L + 3) // 4
+    rows = raw[: count * L].reshape(count, L)
+    if L != lanes * 4:
+        rows = jnp.pad(rows, ((0, 0), (0, lanes * 4 - L)))
+    b = rows.reshape(count, lanes, 4).astype(jnp.uint32)
+    words = (b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16)
+             | (b[..., 3] << 24))
+    return words.reshape(-1)
+
+
 def _rle_expand(ends: jax.Array, vals: jax.Array, start: int, n_runs: int,
                 count: int):
     """Run table slice -> per-position values (searchsorted expand)."""

@@ -1452,12 +1452,15 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 lambda s, p, _dh=dh, _o=offs, _nb=dlba_bytes:
                 p["bytes"].append((_o, s[_dh], _nb))
             )
-        elif enc == Encoding.DELTA_BYTE_ARRAY and ptype == Type.BYTE_ARRAY:
+        elif enc == Encoding.DELTA_BYTE_ARRAY and ptype in (
+                Type.BYTE_ARRAY, Type.FIXED_LEN_BYTE_ARRAY):
             # front coding IS the LZ copy-resolution problem the snappy
             # kernel solves: each value = one copy token (its prefix,
             # read from the previous value's output start) + one literal
             # token (its suffix).  Ship compact prefixes+suffixes, expand
-            # on device by pointer doubling (kernels/snappy.py).
+            # on device by pointer doubling (kernels/snappy.py).  FLBA
+            # rides the same expansion; its flat output converts to lane
+            # words on device (flba_bytes_to_lanes) instead of offsets.
             from ..cpu.delta import (
                 assemble_delta_byte_array,
                 decode_delta_binary_packed,
@@ -1483,6 +1486,12 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                                  > total_lens[:-1]).any():
                 raise ValueError(
                     "DELTA_BYTE_ARRAY: prefix longer than previous value")
+            flba_len = (node.element.type_length
+                        if ptype == Type.FIXED_LEN_BYTE_ARRAY else None)
+            if flba_len is not None and non_null and not (
+                    total_lens == flba_len).all():
+                raise ValueError(
+                    "DELTA_BYTE_ARRAY: FLBA value length mismatch")
             offs = np.zeros(non_null + 1, dtype=np.int64)
             np.cumsum(total_lens, out=offs[1:])
             expanded = int(offs[-1])
@@ -1498,13 +1507,21 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                                             n_suffix, spos)
                 col = assemble_delta_byte_array(prefix_lens, soffs,
                                                 suffix_view)
-                dh = stager.add(col.data)
-                ops.append(
-                    lambda s, p, _dh=dh,
-                    _o=col.offsets.astype(np.int64),
-                    _nb=int(col.data.size):
-                    p["bytes"].append((_o, s[_dh], _nb))
-                )
+                if flba_len is not None:
+                    rows = np.asarray(col.data)[: non_null * flba_len] \
+                        .reshape(non_null, flba_len)
+                    ops.append(
+                        lambda s, p, _r=rows, _nn=non_null:
+                        p["val"].append((_stage_byte_rows(_r), _nn))
+                    )
+                else:
+                    dh = stager.add(col.data)
+                    ops.append(
+                        lambda s, p, _dh=dh,
+                        _o=col.offsets.astype(np.int64),
+                        _nb=int(col.data.size):
+                        p["bytes"].append((_o, s[_dh], _nb))
+                    )
             else:
                 from .decode import bucket as _bucket
 
@@ -1530,12 +1547,18 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 steps = max(int(np.ceil(np.log2(max(expanded, 2)))), 1)
 
                 def op(s, p, _th=th, _lh=lh, _cap=out_cap, _st=steps,
-                       _o=offs, _nb=expanded):
+                       _o=offs, _nb=expanded, _nn=non_null,
+                       _fl=flba_len):
+                    from .decode import flba_bytes_to_lanes
                     from .snappy import expand_tokens
 
                     out = expand_tokens(s[_th[0]], s[_th[1]], s[_lh],
                                         _cap, _st)
-                    p["bytes"].append((_o, out, _nb))
+                    if _fl is not None:
+                        p["val"].append(
+                            (flba_bytes_to_lanes(out, _nn, _fl), _nn))
+                    else:
+                        p["bytes"].append((_o, out, _nb))
 
                 ops.append(op)
         elif enc == Encoding.DELTA_BINARY_PACKED and ptype in (
