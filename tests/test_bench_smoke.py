@@ -42,3 +42,53 @@ def test_bench_ladder_smoke():
     for cfg in head["configs"].values():
         assert cfg["n_values"] > 0
         assert cfg["cpu_vps"] > 0 and cfg["device_vps"] > 0
+    # round-5 orchestration contract: a complete ladder is ok:true and
+    # carries the write-side anchors for configs 2 and 4
+    assert head["ok"] is True
+    assert head["source"] == "cpu-smoke"
+    for cfg_name in ("2-taxi-dict-snappy", "4-wide-string-dict-float64-v2"):
+        assert head["configs"][cfg_name]["write_vs_pyarrow"] > 0
+    # incremental persistence: the partial record exists, labeled with
+    # the smoke backend (NOT "device" -- review finding), all 5 configs
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "BENCH_PARTIAL.json")) as f:
+        partial = json.load(f)
+    assert partial["backend"] == "cpu-smoke"
+    assert set(partial["configs"]) == set(head["configs"])
+
+
+def test_bench_final_line_never_null_without_device(tmp_path):
+    """Total-tunnel-failure path: probe fails, no session record -- the
+    final line must still be parseable JSON with ok:false and CPU-side
+    anchors (the round-3/4 rc=2 'parsed: null' failure mode, engineered
+    out)."""
+    env = dict(os.environ)
+    env.update({
+        "TPQ_BENCH_FALLBACK_TARGET": "60000",
+        "TPQ_BENCH_PROBE_TIMEOUT": "5",
+        "TPQ_BENCH_PROBE_ATTEMPTS": "1",
+        # the probe child fails fast on a nonexistent platform (the
+        # parent's CPU fallback re-pins via jax.config, which overrides
+        # this env var)
+        "JAX_PLATFORMS": "bogus_platform",
+    })
+    env.pop("TPQ_BENCH_CPU", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, "bench.py"], cwd=repo,
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.strip().splitlines() if ln]
+    rec = json.loads(lines[-1])
+    if rec.get("source") == "session-opportunistic":
+        # a live opportunist capture exists on this machine; the
+        # fallback correctly preferred the real chip record
+        assert rec["ok"] in (True, False)
+        return
+    assert rec["ok"] is False
+    assert rec["vs_baseline"] == 0
+    assert rec["cpu_configs"]
+    for cfg in rec["cpu_configs"].values():
+        assert cfg["cpu_vps"] > 0 and cfg["pyarrow_vps"] > 0
