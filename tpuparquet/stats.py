@@ -35,6 +35,10 @@ class DecodeStats:
     # pages whose PLAIN values shipped as the byte-plane RLE transport
     # (upper planes as runs) instead of raw bytes
     pages_device_planes: int = 0
+    # pages whose PLAIN int values shipped as packed delta offsets
+    # (first + per-page min_delta + w-bit deltas), rebuilt by the delta
+    # expand kernels — the sorted-column transport
+    pages_device_delta_lanes: int = 0
     # write-side pages whose values encoded ON DEVICE (DeviceValues:
     # DELTA/BSS/PLAIN in kernels/encode.py) — evidence the writer TPU
     # path engaged rather than pulling raw values to host
@@ -89,6 +93,7 @@ class DecodeStats:
             "pages": self.pages,
             "pages_device_snappy": self.pages_device_snappy,
             "pages_device_planes": self.pages_device_planes,
+            "pages_device_delta_lanes": self.pages_device_delta_lanes,
             "pages_device_encoded": self.pages_device_encoded,
             "pages_host_values": self.pages_host_values,
             "values": self.values,
