@@ -6,7 +6,7 @@ RSS — the memory/plan regression harness for the exact shape
 
     python tools/device_at_scale.py [target_values]
 
-Writes DEVICE_SCALE_r04.json at the repo root.
+Writes DEVICE_SCALE_r05.json at the repo root.
 """
 
 import json
@@ -61,7 +61,7 @@ def main() -> None:
         "backend": "cpu (device timings are not chip numbers; wire and "
                    "plan figures are backend-independent)",
     }
-    path = os.path.join(_REPO, "DEVICE_SCALE_r04.json")
+    path = os.path.join(_REPO, "DEVICE_SCALE_r05.json")
     with open(path, "w") as f:
         json.dump(record, f, indent=1)
     print(json.dumps(record))

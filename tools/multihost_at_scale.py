@@ -11,7 +11,7 @@ RSS as JSON.
 
     python tools/multihost_at_scale.py [values_per_rowgroup] [n_procs]
 
-Writes MULTIHOST_SCALE_r04.json at the repo root.
+Writes MULTIHOST_SCALE_r05.json at the repo root.
 """
 
 import json
@@ -167,7 +167,7 @@ def main() -> None:
         "parity": "ok",
         "backend": f"cpu, {n_procs}-process jax.distributed (Gloo)",
     }
-    path = os.path.join(_REPO, "MULTIHOST_SCALE_r04.json")
+    path = os.path.join(_REPO, "MULTIHOST_SCALE_r05.json")
     with open(path, "w") as f:
         json.dump(record, f, indent=1)
     print(json.dumps(record))

