@@ -1297,16 +1297,19 @@ class TestDeviceWireTransports:
         return (1_700_000_000_000
                 + rng.integers(0, 3_600_000, size=n).cumsum())
 
-    def test_planes_engage_timestamps_uncompressed(self):
+    def test_planes_engage_timestamps_uncompressed(self, monkeypatch):
         from tpuparquet.format.metadata import CompressionCodec
 
+        # isolate the plane transport: with delta lanes enabled they
+        # (correctly) win sorted timestamps outright
+        monkeypatch.setenv("TPQ_DEVICE_DELTA", "0")
         d = self._decode_both("message m { required int64 v; }",
                               CompressionCodec.UNCOMPRESSED,
                               {"v": self._ts()})
         assert d["pages_device_planes"] > 0
         assert d["bytes_staged"] < 0.75 * d["bytes_uncompressed"]
 
-    def test_planes_engage_v1_optional_snappy(self):
+    def test_planes_engage_v1_optional_snappy(self, monkeypatch):
         """V1 page with level bytes inside the compressed block: the
         levels scan on host no longer forces raw value bytes onto the
         wire."""
@@ -1314,6 +1317,7 @@ class TestDeviceWireTransports:
 
         from tpuparquet.format.metadata import CompressionCodec
 
+        monkeypatch.setenv("TPQ_DEVICE_DELTA", "0")  # isolate planes
         vals = self._ts()
         rng = _np.random.default_rng(8)
         mask = rng.random(len(vals)) >= 0.05

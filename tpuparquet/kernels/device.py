@@ -107,8 +107,9 @@ def _DEVICE_DELTA_LANES() -> bool:
 def _padded_u32_bytes(n_words: int) -> int:
     """POST-split staged bytes of an (n_words,) u32 array — the pure
     arithmetic of ``_split_rows``' decomposition (16 MB pieces, then
-    descending powers of two down to ~1 MB, then one bucketed tail),
-    so wire estimates don't materialize throwaway arrays."""
+    descending powers of two down to ``_MIN_PIECE_BYTES``, then one
+    bucketed tail), so wire estimates don't materialize throwaway
+    arrays."""
     from .decode import bucket
 
     max_rows = 1 << ((_PIECE_BYTES // 4).bit_length() - 1)
@@ -881,7 +882,13 @@ def _check_dict_indices(i_sc, width: int, non_null: int, dict_len: int,
 # arrays into power-of-two-row pieces and ships them in bounded waves,
 # blocking between waves.
 _PIECE_BYTES = 16 << 20   # split unit for large arrays
-_MIN_PIECE_BYTES = 1 << 20  # below this, pieces zero-pad to a bucket
+# Below this, pieces zero-pad to a power-of-two bucket.  The floor
+# trades padding waste (tail bucket up to 2x a sub-floor array) against
+# transfer-program compiles (~65-80 ms per distinct shape on the
+# tunnel, one-time): the round-4 1 MB floor cost config-3/4 staged
+# wire 10-22% in tail padding across their many mid-sized level/word
+# arrays; 128 KB adds at most three more power-of-two shapes per dtype.
+_MIN_PIECE_BYTES = 128 << 10
 _WAVE_BYTES = 96 << 20    # max bytes in flight per wave
 
 
@@ -903,10 +910,11 @@ def _split_rows(a: np.ndarray):
     min_rows = max(1, 1 << max(0, (_MIN_PIECE_BYTES // row_bytes)
                                .bit_length() - 1))
     # Zero-copy slices with power-of-two row counts: 16 MB pieces, then
-    # descending powers of two down to ~1 MB, then one zero-padded tail
-    # of at most ~1 MB.  Transfer-program shapes stay a small power-of-
-    # two universe, the host copies at most _MIN_PIECE_BYTES per array,
-    # and the reassembled total is deterministic in n (bounded jit keys).
+    # descending powers of two down to _MIN_PIECE_BYTES, then one
+    # zero-padded tail of at most _MIN_PIECE_BYTES.  Transfer-program
+    # shapes stay a small power-of-two universe, the host copies at
+    # most _MIN_PIECE_BYTES per array, and the reassembled total is
+    # deterministic in n (bounded jit keys).
     n = a.shape[0]
     pieces = []
     pos = 0
