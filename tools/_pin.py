@@ -1,0 +1,29 @@
+"""Shared CPU-backend pinning for tools/ scripts.
+
+This image's sitecustomize force-registers the remote-TPU "axon"
+backend via jax config, which OVERRIDES the ``JAX_PLATFORMS`` env var —
+a script that relies on the env var alone wedges inside its first
+device op whenever the tunnel is down (observed: an at-scale run stuck
+at 3 MB RSS for 20+ minutes probing a dead tunnel).  Import this module
+BEFORE anything that imports jax:
+
+    sys.path.insert(0, <repo root>)
+    from tools._pin import pin_cpu
+    pin_cpu()            # or pin_cpu(devices=8) for a virtual mesh
+
+Chip-facing tools (profile_decode, bench_wire, bench_pallas, the
+check_* sweeps) must NOT use this — the tunnel is their target.
+"""
+
+import os
+
+
+def pin_cpu(devices: int | None = None) -> None:
+    if devices is not None:
+        flag = f"--xla_force_host_platform_device_count={devices}"
+        xf = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in xf:
+            os.environ["XLA_FLAGS"] = f"{xf} {flag}".strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

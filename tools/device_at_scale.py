@@ -21,9 +21,9 @@ sys.path.insert(0, _REPO)
 
 def main() -> None:
     os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
+    from tools._pin import pin_cpu
 
-    jax.config.update("jax_platforms", "cpu")
+    pin_cpu()
     if len(sys.argv) > 1:
         os.environ["TPQ_BENCH_TARGET"] = sys.argv[1]
     import bench

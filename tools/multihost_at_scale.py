@@ -71,9 +71,10 @@ def unit_checksum(cols) -> int:
 
 def child(port: str, pid: int, out_path: str, n_per_rg: int,
           n_procs: int) -> None:
-    import jax
+    from tools._pin import pin_cpu
 
-    jax.config.update("jax_platforms", "cpu")
+    pin_cpu()
+    import jax
     from tpuparquet.shard.distributed import (
         MultiHostScan,
         allgather_host,
@@ -141,9 +142,9 @@ def main() -> None:
 
     # single-process oracle over the same deterministic files, in the
     # scan's own global unit order
-    import jax
+    from tools._pin import pin_cpu
 
-    jax.config.update("jax_platforms", "cpu")
+    pin_cpu()
     from tpuparquet import FileReader
     from tpuparquet.kernels.device import read_row_group_device
     from tpuparquet.shard.scan import scan_units

@@ -17,6 +17,10 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+from tools._pin import pin_cpu  # noqa: E402
+
+pin_cpu(devices=8)
+
 
 def main() -> None:
     import numpy as np
