@@ -42,11 +42,18 @@ from .values import handler_for
 def _write_threads() -> int:
     """Per-column encode parallelism for row-group flushes.
     ``TPQ_WRITE_THREADS=1`` forces the serial path; default is the
-    core count (capped by the column count at use)."""
+    USABLE core count (affinity/cpuset-aware, capped by the column
+    count at use)."""
     v = os.environ.get("TPQ_WRITE_THREADS")
     if v is not None:
-        return max(int(v), 1)
-    return os.cpu_count() or 1
+        try:
+            return max(int(v), 1)
+        except ValueError:
+            pass  # malformed override falls back to the default
+    try:
+        return len(os.sched_getaffinity(0)) or 1
+    except (AttributeError, OSError):
+        return os.cpu_count() or 1
 
 __all__ = ["FileWriter"]
 
@@ -553,19 +560,24 @@ class FileWriter:
             # each chunk renders into its own buffer at position 0;
             # offsets in the returned metadata are made absolute when
             # the buffer is appended below — bytes are identical to
-            # the direct-write path, columns land in schema order
+            # the direct-write path, columns land in schema order.
+            # Stats collect per-thread and merge at append time (the
+            # active collector is thread-local; shared += would race).
+            from ..stats import worker_stats
+
             buf = io.BytesIO()
-            cc = write_chunk(
-                buf, leaf, column, rep, dl,
-                codec=self.codec,
-                page_version=self.page_version,
-                encoding=enc,
-                allow_dict=self.allow_dict,
-                num_rows=n_rows,
-                kv_metadata=kv or None,
-                write_stats=self.write_stats,
-            )
-            return buf.getvalue(), cc
+            with worker_stats() as ws:
+                cc = write_chunk(
+                    buf, leaf, column, rep, dl,
+                    codec=self.codec,
+                    page_version=self.page_version,
+                    encoding=enc,
+                    allow_dict=self.allow_dict,
+                    num_rows=n_rows,
+                    kv_metadata=kv or None,
+                    write_stats=self.write_stats,
+                )
+            return buf.getvalue(), cc, ws
 
         chunks: list[ColumnChunk] = []
         total_bytes = 0
@@ -582,6 +594,9 @@ class FileWriter:
         if len(jobs) > 1 and n_workers > 1 and total_values > 65536:
             from concurrent.futures import ThreadPoolExecutor
 
+            from ..stats import current_stats
+
+            _ws_sink = current_stats()
             with ThreadPoolExecutor(
                 max_workers=min(len(jobs), n_workers)
             ) as ex:
@@ -589,7 +604,7 @@ class FileWriter:
                 # written and dropped before the next is pulled, so
                 # buffering is bounded by completed-not-yet-consumed
                 # chunks rather than the whole row group
-                for blob, cc in ex.map(lambda a: render(*a), jobs):
+                for blob, cc, ws in ex.map(lambda a: render(*a), jobs):
                     base = self._pos
                     self._write(blob)
                     cc.file_offset += base
@@ -600,6 +615,8 @@ class FileWriter:
                     total_bytes += cm.total_uncompressed_size
                     total_comp += cm.total_compressed_size
                     chunks.append(cc)
+                    if _ws_sink is not None:
+                        _ws_sink.merge_from(ws)
         else:
             # serial path writes straight into the file: no per-chunk
             # buffer or blob copy (identical to the pre-pool behavior)

@@ -17,9 +17,11 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import threading
 import time
 
-__all__ = ["DecodeStats", "collect_stats", "current_stats", "trace"]
+__all__ = ["DecodeStats", "collect_stats", "current_stats",
+           "worker_stats", "trace"]
 
 
 @dataclasses.dataclass
@@ -76,6 +78,22 @@ class DecodeStats:
     wall_s: float = 0.0
     _t0: float = dataclasses.field(default=0.0, repr=False)
 
+    # counter fields merged across worker collectors (everything
+    # cumulative; wall_s/_t0 belong to the owning scope alone)
+    _MERGE_FIELDS = (
+        "row_groups", "chunks", "pages", "pages_device_snappy",
+        "pages_device_planes", "pages_device_delta_lanes",
+        "pages_device_encoded", "pages_host_values", "values",
+        "bytes_compressed", "bytes_uncompressed", "bytes_staged",
+        "native_fallbacks", "plan_s", "transfer_s", "dispatch_s",
+    )
+
+    def merge_from(self, other: "DecodeStats") -> None:
+        """Fold a worker collector's counts into this one (called on
+        the coordinating thread after the worker is joined)."""
+        for f in self._MERGE_FIELDS:
+            setattr(self, f, getattr(self, f) + getattr(other, f))
+
     @property
     def values_per_sec(self) -> float:
         return self.values / self.wall_s if self.wall_s > 0 else 0.0
@@ -127,27 +145,46 @@ class DecodeStats:
         )
 
 
-_active: DecodeStats | None = None
+_tls = threading.local()
 
 
 def current_stats() -> DecodeStats | None:
-    """The active collector, or None (the hot path checks this)."""
-    return _active
+    """The active collector ON THIS THREAD, or None (the hot path
+    checks this).  Thread-local: a worker thread planning or encoding
+    on behalf of a scope uses :func:`worker_stats` and its coordinator
+    merges — plain ``+=`` on a shared collector from racing threads
+    loses increments, and ``values``/``bytes_*`` feed headline bench
+    fields."""
+    return getattr(_tls, "active", None)
 
 
 @contextlib.contextmanager
 def collect_stats():
     """Collect decode counters for the enclosed scope."""
-    global _active
-    prev = _active
+    prev = getattr(_tls, "active", None)
     st = DecodeStats()
     st._t0 = time.perf_counter()
-    _active = st
+    _tls.active = st
     try:
         yield st
     finally:
         st.wall_s = time.perf_counter() - st._t0
-        _active = prev
+        _tls.active = prev
+
+
+@contextlib.contextmanager
+def worker_stats():
+    """Fresh per-thread collector for a pool worker; yields it.  The
+    coordinating thread merges the result into ITS active collector
+    (``merge_from``) after joining the worker — no cross-thread
+    increments, no lost counts."""
+    prev = getattr(_tls, "active", None)
+    st = DecodeStats()
+    _tls.active = st
+    try:
+        yield st
+    finally:
+        _tls.active = prev
 
 
 @contextlib.contextmanager
