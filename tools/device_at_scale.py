@@ -61,7 +61,11 @@ def main() -> None:
         "backend": "cpu (device timings are not chip numbers; wire and "
                    "plan figures are backend-independent)",
     }
-    path = os.path.join(_REPO, "DEVICE_SCALE_r05.json")
+    # sub-scale smoke runs must not clobber the canonical 50M record
+    # (a 100K smoke once overwrote the committed regression baseline)
+    name = ("DEVICE_SCALE_r05.json" if record["n_values"] >= 50_000_000
+            else "DEVICE_SCALE_smoke.json")
+    path = os.path.join(_REPO, name)
     with open(path, "w") as f:
         json.dump(record, f, indent=1)
     print(json.dumps(record))

@@ -168,7 +168,10 @@ def main() -> None:
         "parity": "ok",
         "backend": f"cpu, {n_procs}-process jax.distributed (Gloo)",
     }
-    path = os.path.join(_REPO, "MULTIHOST_SCALE_r05.json")
+    # sub-scale smoke runs must not clobber the canonical record
+    name = ("MULTIHOST_SCALE_r05.json" if n_per_rg >= 2_000_000
+            else "MULTIHOST_SCALE_smoke.json")
+    path = os.path.join(_REPO, name)
     with open(path, "w") as f:
         json.dump(record, f, indent=1)
     print(json.dumps(record))
