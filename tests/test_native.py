@@ -637,3 +637,107 @@ class TestNativeDbaAssemble:
                     assemble_delta_byte_array(*args)
                 msgs.append(str(ei.value))
         assert msgs[0] == msgs[1], msgs
+
+
+class TestNativeIntern:
+    """One-pass C byte interner vs the numpy interner: identical
+    (dictionary, indices) on every shape, plus the early exits the
+    numpy path cannot express."""
+
+    def test_parity_with_numpy_interner(self):
+        import tpuparquet.cpu.dictionary as D
+        from tpuparquet.cpu.plain import ByteArrayColumn
+        from tpuparquet.native import intern_native
+
+        if intern_native() is None:
+            pytest.skip("native interner unavailable")
+        rng = np.random.default_rng(60)
+        cases = [
+            [f"v{i % 37}".encode() for i in range(5_000)],
+            [b"", b"a\x00", b"a", b"", b"a\x00"],           # NULs, dups
+            [rng.bytes(int(rng.integers(0, 50)))
+             for _ in range(3_000)],                         # random blobs
+            [b"x"] * 2_000,                                  # constant
+            [f"{i}".encode() for i in range(3_000)],         # all distinct
+        ]
+        for vals in cases:
+            col = ByteArrayColumn.from_list(vals)
+            want = D.build_dictionary(col)
+            got = D.intern_byte_column(col, 1 << 15)
+            from tpuparquet.native import TOO_MANY_DISTINCT
+            if got is TOO_MANY_DISTINCT:
+                assert len(set(vals)) > (1 << 15)
+                continue
+            assert got is not None
+            assert got[0] == want[0]
+            np.testing.assert_array_equal(got[1], want[1])
+
+    def test_too_many_early_exit(self):
+        import tpuparquet.cpu.dictionary as D
+        from tpuparquet.cpu.plain import ByteArrayColumn
+        from tpuparquet.native import intern_native
+
+        if intern_native() is None:
+            pytest.skip("native interner unavailable")
+        from tpuparquet.native import TOO_MANY_DISTINCT
+
+        col = ByteArrayColumn.from_list(
+            [f"u{i}".encode() for i in range(40_000)])
+        assert D.intern_byte_column(col, 1 << 15) is TOO_MANY_DISTINCT
+        # cap + 1 distinct is the boundary; cap distinct is accepted
+        col2 = ByteArrayColumn.from_list(
+            [f"u{i}".encode() for i in range(100)])
+        out = D.intern_byte_column(col2, 100)
+        assert out is not None and out is not TOO_MANY_DISTINCT
+        assert len(out[0]) == 100
+        assert D.intern_byte_column(col2, 99) is TOO_MANY_DISTINCT
+
+    def test_custom_row_hash_bypasses_native(self):
+        """A pluggable hash must not be silently ignored by the C
+        pass (which has its own FNV)."""
+        import tpuparquet.cpu.dictionary as D
+        from tpuparquet.cpu.plain import ByteArrayColumn
+
+        col = ByteArrayColumn.from_list([b"a", b"b", b"a"])
+        try:
+            D.row_hash_func = lambda rows: np.zeros(
+                rows.shape[0], dtype=np.uint64)
+            assert D.intern_byte_column(col, 100) is None
+        finally:
+            D.row_hash_func = None
+
+    def test_writer_output_byte_identical(self):
+        """Files written through the native interner equal the numpy
+        path byte for byte (first-occurrence order preserved)."""
+        import io as _io
+
+        import tpuparquet.cpu.dictionary as D
+        from tpuparquet import CompressionCodec, FileWriter
+        from tpuparquet.native import intern_native
+
+        if intern_native() is None:
+            pytest.skip("native interner unavailable")
+        rng = np.random.default_rng(61)
+        vals = [f"s{int(i) % 211}".encode()
+                for i in rng.integers(0, 10_000, 50_000)]
+
+        def build():
+            buf = _io.BytesIO()
+            w = FileWriter(buf,
+                           "message m { required binary s (STRING); }",
+                           codec=CompressionCodec.SNAPPY)
+            w.write_columns(
+                {"s": __import__("tpuparquet.cpu.plain",
+                                 fromlist=["ByteArrayColumn"])
+                 .ByteArrayColumn.from_list(vals)})
+            w.close()
+            return buf.getvalue()
+
+        native_bytes = build()
+        orig = D.intern_byte_column
+        D.intern_byte_column = lambda *a, **k: None  # force numpy path
+        try:
+            numpy_bytes = build()
+        finally:
+            D.intern_byte_column = orig
+        assert native_bytes == numpy_bytes

@@ -291,6 +291,34 @@ def _build_bytes_dictionary(values: ByteArrayColumn):
     return gather(values, uniq_first[gorder]), indices.astype(np.int32)
 
 
+def intern_byte_column(values: ByteArrayColumn, max_distinct: int):
+    """One-pass C interning of a byte column with a distinct-count cap.
+
+    Returns ``(dictionary, indices)`` — identical to
+    :func:`build_dictionary` (first-occurrence order, exact memcmp
+    identity) — or the ``TOO_MANY_DISTINCT`` sentinel once more than
+    ``max_distinct`` distinct values appear (the dictionary gate would
+    reject anyway, so high-cardinality columns abort in O(cap) instead
+    of paying a full intern), or None when the native is unavailable
+    or a custom ``row_hash_func`` is installed (the C pass has its own
+    FNV and must not silently bypass the user's hook)."""
+    from ..native import TOO_MANY_DISTINCT, intern_native
+
+    if row_hash_func is not None:
+        return None
+    nat = intern_native()
+    if nat is None:
+        return None
+    n = len(values)
+    if n == 0:
+        return None  # python path makes the canonical empty shapes
+    out = nat.intern_var(values.data, values.offsets, max_distinct)
+    if out is TOO_MANY_DISTINCT:
+        return TOO_MANY_DISTINCT
+    firsts, indices = out
+    return gather(values, firsts), indices
+
+
 def build_dictionary(values):
     """Return (dictionary, indices) preserving first-occurrence order.
 

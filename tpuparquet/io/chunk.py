@@ -223,6 +223,19 @@ def _maybe_dictionary(column, allow_dict: bool):
             np.asarray(column).shape[0]
         if n == 0:
             return None, None
+        if isinstance(column, ByteArrayColumn):
+            from ..cpu.dictionary import intern_byte_column
+            from ..native import TOO_MANY_DISTINCT
+
+            # cap at MAX-1: the size gate rejects dsize >= MAX, so a
+            # column reaching MAX distinct should abort in O(cap)
+            # rather than pay the full intern + gather it discards
+            out = intern_byte_column(column, MAX_DICT_ENTRIES - 1)
+            if out is TOO_MANY_DISTINCT:
+                return None, None
+            if out is not None:
+                dictionary, indices = out
+                return _dict_size_gate(column, dictionary, indices, n)
         if not isinstance(column, ByteArrayColumn):
             arr = np.asarray(column)
             if arr.ndim == 1 and arr.dtype.kind in "iuf" and n > 4096:
@@ -252,6 +265,12 @@ def _maybe_dictionary(column, allow_dict: bool):
                         >= arr.nbytes):
                     return None, None
         dictionary, indices = build_dictionary(column)
+    return _dict_size_gate(column, dictionary, indices, n)
+
+
+def _dict_size_gate(column, dictionary, indices, n: int):
+    """Accept the dictionary only when it pays: small enough, and
+    dictionary + packed indices smaller than the plain values."""
     dsize = len(dictionary) if isinstance(dictionary, ByteArrayColumn) else \
         dictionary.shape[0]
     if dsize >= MAX_DICT_ENTRIES:

@@ -25,7 +25,7 @@ __all__ = ["snappy_native", "NativeSnappy", "hybrid_native", "NativeHybrid",
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRCS = [os.path.join(_DIR, "snappy.c"), os.path.join(_DIR, "hybrid.c"),
          os.path.join(_DIR, "plane.c"), os.path.join(_DIR, "delta.c"),
-         os.path.join(_DIR, "pack.c")]
+         os.path.join(_DIR, "pack.c"), os.path.join(_DIR, "intern.c")]
 _SO = os.path.join(_DIR, "_tpq_native.so")
 
 _lock = threading.Lock()
@@ -752,6 +752,59 @@ class NativePack:
         return out[:n]
 
 
+# sentinel: the interner hit its distinct-value cap (callers compare
+# with ``is``; a string literal here invited silent typo mismatches)
+TOO_MANY_DISTINCT = object()
+
+
+class NativeIntern:
+    """ctypes binding over the one-pass byte-value interner."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._intern = getattr(lib, "tpq_intern_var", None)
+        if self._intern is None:
+            raise RuntimeError("native library too old; rebuild")
+        self._intern.restype = ctypes.c_longlong
+        self._intern.argtypes = [
+            ctypes.c_void_p, ctypes.c_longlong,
+            ctypes.c_void_p, ctypes.c_longlong,
+            ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_longlong,
+            ctypes.c_void_p,
+        ]
+
+    def intern_var(self, data, offsets, max_d: int):
+        """First-occurrence intern of n variable byte values.
+
+        Returns ``(first_indices int64[D], indices int32[n])``, or
+        ``TOO_MANY_DISTINCT`` when more than ``max_d`` distinct values
+        exist (the early exit the caller's dictionary gate wants), or
+        raises on corrupt offsets."""
+        buf = _as_u8(data)
+        offs = np.ascontiguousarray(offsets, dtype=np.int64)
+        n = offs.size - 1
+        # ~4x max occupancy at the distinct cap keeps probe chains
+        # short; the cap (not n) sizes the table, so high-cardinality
+        # columns abort cheaply instead of growing the table
+        tbits = max(16, (4 * max_d - 1).bit_length())
+        T = 1 << tbits
+        slots = np.full(T, -1, dtype=np.int32)
+        firsts = np.empty(max_d, dtype=np.int64)
+        indices = np.empty(max(n, 1), dtype=np.int32)[:n]
+        d = self._intern(buf.ctypes.data, buf.size,
+                         offs.ctypes.data, n,
+                         slots.ctypes.data, T - 1, tbits,
+                         firsts.ctypes.data, max_d,
+                         indices.ctypes.data)
+        if d == -2:
+            return TOO_MANY_DISTINCT
+        if d == -3:
+            raise ValueError("byte column offsets out of bounds")
+        if d < 0:
+            raise ValueError(f"intern failed (rc={d})")
+        return firsts[:d].copy(), indices
+
+
 _snappy_inst: "NativeSnappy | None" = None
 _hybrid_inst: "NativeHybrid | None" = None
 _PLANE_UNAVAILABLE = object()  # cached stale-.so miss (see plane_native)
@@ -760,6 +813,8 @@ _DELTA_UNAVAILABLE = object()
 _delta_inst = None
 _PACK_UNAVAILABLE = object()
 _pack_inst = None
+_INTERN_UNAVAILABLE = object()
+_intern_inst = None
 
 
 def snappy_native() -> NativeSnappy | None:
@@ -824,6 +879,28 @@ def pack_native() -> NativePack | None:
             st.native_fallbacks += 1
         return None
     return _pack_inst
+
+
+def intern_native() -> NativeIntern | None:
+    """The process-wide byte interner, or None if unbuildable."""
+    global _intern_inst
+    if _intern_inst is not None:
+        return None if _intern_inst is _INTERN_UNAVAILABLE \
+            else _intern_inst
+    lib = _lib()
+    if lib is None:
+        return None
+    try:
+        _intern_inst = NativeIntern(lib)
+    except RuntimeError:  # stale .so predating intern.c: cache the miss
+        _intern_inst = _INTERN_UNAVAILABLE
+        from ..stats import current_stats
+
+        st = current_stats()
+        if st is not None:
+            st.native_fallbacks += 1
+        return None
+    return _intern_inst
 
 
 def plane_native() -> NativePlane | None:

@@ -1,0 +1,69 @@
+/* One-pass first-occurrence interning of variable-length byte values —
+ * the dictionary build for BYTE_ARRAY columns (≙ the reference's
+ * per-value map interning, type_dict.go getIndex, but one C pass with
+ * an open-addressed table instead of a Go map).
+ *
+ * The vectorized numpy interner groups values by length, gathers row
+ * matrices, hashes, and re-ranks — ~0.33 s for 2.5M short strings.
+ * This kernel replaces all of it with one sequential pass (~FNV hash +
+ * linear-probe table + memcmp verify per value), and adds the early
+ * exit the numpy path cannot express: the caller bounds the distinct
+ * count (MAX_DICT_ENTRIES), so a high-cardinality column aborts after
+ * max_d distinct values instead of paying a full intern whose result
+ * the dictionary gate then discards.
+ *
+ * slots:   T int32, caller-initialized to -1, T a power of two
+ * firsts:  capacity max_d int64 — first-occurrence value index per id
+ * indices: n int32 out
+ * Returns the distinct count D (ids are first-occurrence ranks by
+ * construction), or -1 table saturated (caller resizes), -2 more than
+ * max_d distinct (caller rejects the dictionary), -3 corrupt offsets.
+ */
+#include <stdint.h>
+#include <string.h>
+
+long long tpq_intern_var(const uint8_t *data, long long data_len,
+                         const int64_t *offs, long long n,
+                         int32_t *slots, long long t_mask, int tbits,
+                         int64_t *firsts, long long max_d,
+                         int32_t *indices) {
+    long long d = 0;
+    for (long long i = 0; i < n; i++) {
+        int64_t s0 = offs[i], e0 = offs[i + 1];
+        if (s0 < 0 || e0 < s0 || e0 > data_len)
+            return -3;
+        int64_t len = e0 - s0;
+        uint64_t h = 1469598103934665603ull + 31ull * (uint64_t)len;
+        for (int64_t p = s0; p < e0; p++)
+            h = (h ^ data[p]) * 1099511628211ull;
+        /* Fibonacci slot: multiply, take the high bits (low bits of the
+         * FNV multiply chain carry linear structure; cf. the numpy
+         * interner's slot-collapse finding) */
+        long long slot =
+            (long long)((h * 0x9E3779B97F4A7C15ull) >> (64 - tbits));
+        long long probes = 0;
+        for (;;) {
+            int32_t id = slots[slot];
+            if (id < 0) {
+                if (d >= max_d)
+                    return -2;
+                slots[slot] = (int32_t)d;
+                firsts[d] = i;
+                indices[i] = (int32_t)d;
+                d++;
+                break;
+            }
+            int64_t fs = offs[firsts[id]];
+            int64_t fe = offs[firsts[id] + 1];
+            if (fe - fs == len
+                && memcmp(data + fs, data + s0, (size_t)len) == 0) {
+                indices[i] = id;
+                break;
+            }
+            slot = (slot + 1) & t_mask;
+            if (++probes > t_mask)
+                return -1;
+        }
+    }
+    return d;
+}
