@@ -277,7 +277,22 @@ int tpq_snappy_compress_opt(const uint8_t *in, size_t n, uint8_t *out,
         load32(in + cand) == key) {
       size_t len = 4;
       size_t max = n - pos;
+      /* extend 8 bytes at a time; the xor's lowest set bit locates the
+       * first mismatch (little-endian), so long matches cost one
+       * comparison per word instead of per byte */
+      while (len + 8 <= max) {
+        uint64_t a, b;
+        memcpy(&a, in + cand + len, 8);
+        memcpy(&b, in + pos + len, 8);
+        uint64_t diff = a ^ b;
+        if (diff) {
+          len += (size_t)(__builtin_ctzll(diff) >> 3);
+          goto matched;
+        }
+        len += 8;
+      }
       while (len < max && in[cand + len] == in[pos + len]) len++;
+    matched:;
       /* Short copies cost ~as many compressed bytes as the literal
        * they replace but decode token-at-a-time; dense 4..7-byte
        * matches (typical for numeric column data) would cap
