@@ -384,6 +384,42 @@ class TestDeviceRegressions:
                         w.close()
                         compare(buf)
 
+    def test_planes_recontest_when_tokens_unreachable(self, monkeypatch):
+        """Lazy token scan: the plane planner is budget-pruned by the
+        compressed payload size, so when the token plan then turns out
+        unreachable the planes must be re-contested without that bound
+        — otherwise a planes-viable page silently ships raw (review
+        finding on the lazy-scan change)."""
+        import io as _io
+
+        import numpy as _np
+
+        from tpuparquet import FileReader, FileWriter
+        from tpuparquet.format.metadata import CompressionCodec
+        import tpuparquet.kernels.device as _D
+        from tpuparquet.stats import collect_stats
+
+        rng = _np.random.default_rng(5)
+        # doubles: planes-friendly (constant upper bytes), no delta path
+        vals = rng.integers(0, 255, 300_000).astype(_np.float64)
+        buf = _io.BytesIO()
+        w = FileWriter(buf, "message m { required double v; }",
+                       codec=CompressionCodec.SNAPPY, allow_dict=False)
+        w.write_columns({"v": vals})
+        w.close()
+        buf.seek(0)
+        monkeypatch.setattr(_D, "_plan_device_snappy_words",
+                            lambda *a, **k: None)
+        r = FileReader(buf)
+        with collect_stats() as st:
+            dev = _D.read_row_group_device(r, 0)
+            for c in dev.values():
+                c.block_until_ready()
+        got, _rep, _dl = dev["v"].to_numpy()
+        _np.testing.assert_array_equal(_np.asarray(got), vals)
+        assert st.pages_device_planes > 0
+        assert st.bytes_staged < vals.nbytes // 2
+
     def test_delta_lane_transport_sorted_plain(self, monkeypatch):
         """Sorted PLAIN int columns ship as packed delta offsets (the
         round-4 notes' rejected transport, revived by the C pack): the

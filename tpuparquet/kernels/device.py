@@ -1256,29 +1256,27 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
             non_null = int((dl_host == max_def).sum())
         values_read += n
 
-        # Resolve deferred value-segment decompression.  The two device
-        # transports COMPETE on exact wire cost: snappy tokens (no host
-        # decompress) vs the lane/byte-plane transport (needs the
+        # Resolve deferred value-segment decompression.  The device
+        # transports COMPETE on wire cost: snappy tokens (no host
+        # decompress) vs byte planes vs delta lanes (both need the
         # decompressed bytes — native snappy makes that cheap).  A
         # timestamp page whose tokens cost 0.76x of raw but whose lanes
         # cost 0.50x must ship lanes, not whichever planner ran first.
+        # The token SCAN is itself a third of the plan wall, so it runs
+        # LAZILY: the compressed payload size approximates the token
+        # transport's wire (tokens re-encode the block as table +
+        # literals), and a competitor already under that bound skips
+        # the scan outright — trading a few percent of wire precision
+        # in the crossover region for ~30% of the plan phase.
         plan_words = None
-        tok = None
+        payload_bound = None
         if values_comp is not None:
-            tok = _plan_device_snappy_words(
-                values_comp[0], values_comp[1],
-                non_null * _LANES[ptype], offset=values_comp[2],
-            )
-            if values_seg is None and (
-                    tok is None
-                    or ((_DEVICE_PLANES()
-                         or (_DEVICE_DELTA_LANES()
-                             and ptype in (Type.INT32, Type.INT64)))
-                        and non_null >= 1024)):
-                # decompress so the planes/delta lanes can compete —
-                # skipped when the planners' own size floor
-                # (count >= 1024) makes the contest moot and tokens
-                # already cover the page
+            payload_bound = len(values_comp[0])
+            competitors = ((_DEVICE_PLANES()
+                            or (_DEVICE_DELTA_LANES()
+                                and ptype in (Type.INT32, Type.INT64)))
+                           and non_null >= 1024)
+            if values_seg is None and competitors:
                 values_seg = decompress_block_into(
                     codec, values_comp[0], values_comp[1], arena)
         delta_cand = None
@@ -1287,23 +1285,56 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 and values_seg is not None):
             delta_cand = _plan_delta_lane_words(values_seg, non_null,
                                                 ptype)
-        budgets = [c[0] for c in (tok, delta_cand) if c is not None]
-        if (_DEVICE_PLANES() and non_null
-                and enc == Encoding.PLAIN and ptype in _LANES
-                and values_seg is not None):
-            plan_words = _plan_plane_words(
-                values_seg, non_null, _LANES[ptype], stager,
-                budget=min(budgets) if budgets else None)
-            if plan_words is not None and _st is not None:
+        delta_wire = delta_cand[0] if delta_cand is not None else None
+
+        def _try_planes(budget):
+            if (_DEVICE_PLANES() and non_null
+                    and enc == Encoding.PLAIN and ptype in _LANES
+                    and values_seg is not None):
+                return _plan_plane_words(
+                    values_seg, non_null, _LANES[ptype], stager,
+                    budget=budget)
+            return None
+
+        budgets = [c for c in (delta_wire, payload_bound)
+                   if c is not None]
+        plan_words = _try_planes(min(budgets) if budgets else None)
+        chosen = "planes" if plan_words is not None else None
+        tok = None
+        if plan_words is None:
+            if payload_bound is not None and not (
+                    delta_wire is not None
+                    and delta_wire < payload_bound):
+                # no competitor beats the token bound: pay the scan
+                tok = _plan_device_snappy_words(
+                    values_comp[0], values_comp[1],
+                    non_null * _LANES[ptype], offset=values_comp[2],
+                )
+                if tok is None:
+                    # token transport unreachable after all: re-contest
+                    # the planes without its payload bound (they may
+                    # have been pruned ONLY by it)
+                    plan_words = _try_planes(delta_wire)
+                    chosen = "planes" if plan_words is not None else None
+            if plan_words is None:
+                if delta_cand is not None and (
+                        tok is None or delta_cand[0] < tok[0]):
+                    plan_words = delta_cand[1](stager)
+                    chosen = "delta"
+                elif tok is not None:
+                    plan_words = tok[1](stager)
+                    chosen = "snappy"
+                elif values_seg is None and values_comp is not None:
+                    # no device transport reachable: the PLAIN fallback
+                    # below needs the decompressed bytes after all
+                    values_seg = decompress_block_into(
+                        codec, values_comp[0], values_comp[1], arena)
+        if _st is not None and chosen is not None:
+            if chosen == "planes":
                 _st.pages_device_planes += 1
-        if plan_words is None and delta_cand is not None and (
-                tok is None or delta_cand[0] < tok[0]):
-            plan_words = delta_cand[1](stager)
-            if _st is not None:
+            elif chosen == "delta":
                 _st.pages_device_delta_lanes += 1
-        if plan_words is None and tok is not None:
-            plan_words = tok[1](stager)
-            if _st is not None:
+            else:
                 _st.pages_device_snappy += 1
 
         # Def-level plan, padded for the fused page kernels.  A page
