@@ -384,6 +384,22 @@ class TestDeviceRegressions:
                         w.close()
                         compare(buf)
 
+    def test_padded_cost_matches_split_rows(self):
+        """The delta planner's wire estimate (_padded_u32_bytes) is the
+        pure arithmetic of _split_rows' decomposition; if the split
+        policy changes without the estimate, delta-vs-planes decisions
+        silently optimize the wrong cost."""
+        import numpy as np
+
+        from tpuparquet.kernels.device import (_padded_u32_bytes,
+                                               _split_rows)
+
+        for nw in (1, 31, 32, 1000, 136_000, 260_000, 999_999,
+                   4_194_304, 9_999_999):
+            real = sum(p.nbytes
+                       for p in _split_rows(np.empty((nw,), np.uint32)))
+            assert _padded_u32_bytes(nw) == real, nw
+
     def test_planes_recontest_when_tokens_unreachable(self, monkeypatch):
         """Lazy token scan: the plane planner is budget-pruned by the
         compressed payload size, so when the token plan then turns out
