@@ -108,6 +108,27 @@ def _files():
         {"s": ByteArrayColumn.from_list(
             [b"the-quick-brown-fox-%d" % (i % 97) for i in range(big)])},
         codec=CompressionCodec.SNAPPY, allow_dict=False)
+    # -- round-5 transports / kernels ------------------------------------
+    # (the uncompressed-timestamp case above now rides DELTA lanes; these
+    # pin the remaining new paths on real silicon)
+    flba_rows = rng.integers(0, 256, (n, 16)).astype(np.uint8)
+    flba_rows[:, :12] = 7  # shared prefixes -> expanding front coding
+    yield build(
+        "FLBA delta_byte_array (device copy-token expansion -> lanes)",
+        "message m { required fixed_len_byte_array(16) k; }",
+        {"k": flba_rows},
+        column_encodings={"k": Encoding.DELTA_BYTE_ARRAY},
+        allow_dict=False, codec=CompressionCodec.SNAPPY)
+    yield build(
+        "delta-lane w=0 (arithmetic sequence ships in 8 bytes)",
+        "message m { required int64 t; }",
+        {"t": np.arange(big, dtype=np.int64) * 12345},
+        allow_dict=False)
+    yield build(
+        "byte planes on doubles (delta-ineligible type)",
+        "message m { required double d; }",
+        {"d": rng.integers(0, 255, size=big).astype(np.float64)},
+        allow_dict=False, codec=CompressionCodec.SNAPPY)
 
 
 def main() -> int:
