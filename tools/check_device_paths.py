@@ -28,13 +28,14 @@ def _files():
     rng = np.random.default_rng(5)
     n = 4000
 
-    def build(name, schema, cols, masks=None, offsets=None, **kw):
+    def build(name, schema, cols, masks=None, offsets=None,
+              expect=None, **kw):
         buf = io.BytesIO()
         w = FileWriter(buf, schema, **kw)
         w.write_columns(cols, masks=masks, offsets=offsets)
         w.close()
         buf.seek(0)
-        return name, buf
+        return name, buf, expect
 
     m = rng.random(n) >= 0.2
     yield build(
@@ -118,17 +119,19 @@ def _files():
         "message m { required fixed_len_byte_array(16) k; }",
         {"k": flba_rows},
         column_encodings={"k": Encoding.DELTA_BYTE_ARRAY},
-        allow_dict=False, codec=CompressionCodec.SNAPPY)
+        allow_dict=False, codec=CompressionCodec.SNAPPY,
+        expect={"pages_host_values": 0})
     yield build(
         "delta-lane w=0 (arithmetic sequence ships in 8 bytes)",
         "message m { required int64 t; }",
         {"t": np.arange(big, dtype=np.int64) * 12345},
-        allow_dict=False)
+        allow_dict=False, expect={"pages_device_delta_lanes": 1})
     yield build(
         "byte planes on doubles (delta-ineligible type)",
         "message m { required double d; }",
         {"d": rng.integers(0, 255, size=big).astype(np.float64)},
-        allow_dict=False, codec=CompressionCodec.SNAPPY)
+        allow_dict=False, codec=CompressionCodec.SNAPPY,
+        expect={"pages_device_planes": 1})
 
 
 def main() -> int:
@@ -136,17 +139,31 @@ def main() -> int:
 
     from tpuparquet.cli.parquet_tool import cmd_verify
 
+    from tpuparquet.stats import collect_stats
+
     print(f"backend={jax.default_backend()}")
     failures = 0
-    for name, buf in _files():
+    for name, buf, expect in _files():
         class _A:
             file = buf
 
         out = io.StringIO()
-        rc = cmd_verify(_A, out=out)
+        with collect_stats() as st:
+            rc = cmd_verify(_A, out=out)
+        detail = out.getvalue().strip().splitlines()[-1]
+        # transport pinning: bit-exactness alone is vacuous for the
+        # cases whose point is WHICH path ran (a gate regression that
+        # demotes the transport must fail here, not pass silently)
+        if rc == 0 and expect:
+            d = st.as_dict()
+            for key, want in expect.items():
+                if d.get(key, 0) < want:
+                    rc = 1
+                    detail = (f"transport regression: {key}={d.get(key)}"
+                              f" < {want} (decode was bit-exact)")
+                    break
         status = "OK" if rc == 0 else "FAIL"
-        print(f"[{status}] {name}: "
-              f"{out.getvalue().strip().splitlines()[-1]}")
+        print(f"[{status}] {name}: {detail}")
         failures += rc
     print("ALL DEVICE PATHS BIT-EXACT" if not failures
           else f"{failures} FAILURES")
