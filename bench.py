@@ -290,10 +290,13 @@ def _device_checksum(col) -> dict:
     (row group x column)."""
     import jax
     import jax.numpy as jnp
+    from jax.experimental import enable_x64
 
     idx_mod = 1_000_003
 
-    with jax.enable_x64(True):
+    # jax.enable_x64 was removed from the top-level namespace; the
+    # experimental spelling is the stable one across the versions in use
+    with enable_x64(True):
         def wsum(x):
             x = x.reshape(-1).astype(jnp.uint64)
             pos = (jnp.arange(x.shape[0], dtype=jnp.uint64)
@@ -538,7 +541,16 @@ def run_config(name: str, buf: io.BytesIO) -> dict:
     # Parity AFTER timing: the first device->host readback drops the
     # runtime into synchronous dispatch on the remote tunnel; the report
     # is still gated on it — a mismatch raises before printing.
-    parity(reader)
+    # The parity pass runs under an event-carrying collector: it decodes
+    # every page on the device path anyway, so the per-page transport
+    # mix rides along free (timed reps stay event-free — the log
+    # allocates per page).  event_summary drops the parity pass's
+    # CPU-oracle pages.
+    from tpuparquet.obs import event_summary
+    from tpuparquet.stats import collect_stats
+
+    with collect_stats(events=True) as pst:
+        parity(reader)
     return {
         "config": name,
         "n_values": n_values,
@@ -548,6 +560,7 @@ def run_config(name: str, buf: io.BytesIO) -> dict:
         "vs_baseline": round(cpu_s / dev_s, 3),
         "vs_pyarrow": round(pa_s / dev_s, 3),
         "device_phases": phases,
+        "events": event_summary(pst.events),
     }
 
 
@@ -584,7 +597,13 @@ def run_config5() -> dict:
         np.asarray(vals)  # gathered result on host: scan is complete
         return time.perf_counter() - t0, results
 
-    one_scan()  # warmup
+    # warmup doubles as the event-collection pass: the timed reps stay
+    # event-free (the log allocates per page)
+    from tpuparquet.obs import event_summary
+    from tpuparquet.stats import collect_stats
+
+    with collect_stats(events=True) as pst:
+        one_scan()
     dev_best, results = float("inf"), None
     for _ in range(DEV_REPS):
         s, res = one_scan()
@@ -620,6 +639,7 @@ def run_config5() -> dict:
         "device_vps": round(n_values / dev_best, 1),
         "vs_baseline": round(cpu_best / dev_best, 3),
         "vs_pyarrow": round(pa_best / dev_best, 3),
+        "events": event_summary(pst.events),
     }
 
 
@@ -827,7 +847,7 @@ def _final_record(results: dict, errors: dict, source: str,
                         "n_values", "cpu_vps", "pyarrow_vps",
                         "device_vps", "vs_baseline", "vs_pyarrow",
                         "write_vps", "pyarrow_write_vps",
-                        "write_vs_pyarrow", "ts") if kk in v}
+                        "write_vs_pyarrow", "events", "ts") if kk in v}
                     for k, v in results.items()},
     }
     if head["config"] != head_name:

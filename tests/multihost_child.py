@@ -63,15 +63,42 @@ def main():
     jax.config.update("jax_platforms", "cpu")
     port, pid, out_path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
     from tpuparquet.shard.distributed import MultiHostScan, allgather_host
-    from tpuparquet.shard.distributed import initialize
+    from tpuparquet.shard.distributed import (allgather_bytes,
+                                              allgather_stats, initialize)
+    from tpuparquet.stats import collect_stats
 
     initialize(coordinator_address=f"localhost:{port}", num_processes=2,
                process_id=pid)
     assert jax.process_count() == 2
 
     scan = MultiHostScan(build_files())
-    results = scan.run()
+    with collect_stats() as st:
+        results = scan.run()
     assert len(results) == len(scan.local_units)
+
+    # fleet telemetry: allgather_stats totals must equal the
+    # elementwise sum of the per-host as_dict() outputs — the exact
+    # counters ship, so the fleet record is the sum, not an estimate
+    fleet = allgather_stats(st)
+    per_host = [json.loads(p) for p in
+                allgather_bytes(json.dumps(st.as_dict()).encode())]
+    assert len(per_host) == 2
+    fd = fleet.as_dict()
+    for k in ("row_groups", "chunks", "pages", "values",
+              "bytes_compressed", "bytes_uncompressed", "bytes_staged",
+              "pages_device_snappy", "pages_device_planes",
+              "pages_device_delta_lanes", "pages_host_values",
+              "native_fallbacks"):
+        want = sum(h[k] for h in per_host)
+        assert fd[k] == want, (k, fd[k], want)
+    for k in ("plan_s", "transfer_s", "dispatch_s"):
+        assert abs(fd[k] - sum(h[k] for h in per_host)) < 1e-3, k
+    # fleet wall is the slowest host (hosts decode concurrently)
+    assert abs(fleet.wall_s - max(h["wall_s"]
+                                  for h in per_host)) < 1e-3
+    # histogram folds stay exact across the wire: one page-size sample
+    # was recorded per decoded page, fleet-wide
+    assert fleet.hists["page_comp_bytes"].n == fd["pages"]
 
     # per-global-unit checksums: local slots filled, others zero; the
     # allgather + sum reconstructs the full vector on every process
@@ -97,7 +124,8 @@ def main():
         with open(out_path, "w") as f:
             json.dump({"checksums": gathered.tolist(),
                        "counts": counts.tolist(),
-                       "units": [list(u) for u in scan.global_units]},
+                       "units": [list(u) for u in scan.global_units],
+                       "fleet_stats": fd},
                       f)
     print(f"proc {pid}: {len(results)} local units ok", flush=True)
 

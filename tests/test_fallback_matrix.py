@@ -32,6 +32,7 @@ from tpuparquet import FileReader, FileWriter
 from tpuparquet.cpu.plain import ByteArrayColumn
 from tpuparquet.format.metadata import CompressionCodec, Encoding
 from tpuparquet.kernels.device import read_row_group_device
+from tpuparquet.obs import TRANSPORT_COUNTER, counter_counts
 from tpuparquet.stats import collect_stats
 
 N = 500
@@ -108,13 +109,24 @@ def test_fallback_matrix(tname, ename, dict_on):
         w.close()
         buf.seek(0)
         r = FileReader(buf)
-        with collect_stats() as st:
+        with collect_stats(events=True) as st:
             dev = read_row_group_device(r, 0)
             for c in dev.values():
                 c.block_until_ready()
         assert st.pages > 0
         label = (f"{tname}/{ename}/dict={dict_on}/{codec.name}/"
                  f"v2={v2}")
+        # telemetry contract alongside the routing contract: every data
+        # page emits exactly one event, and each transport counter
+        # equals the count of events claiming that transport — the
+        # event log and the counters cannot drift apart
+        assert len(st.events.pages) == st.pages, label
+        d = st.as_dict()
+        ev_counts = counter_counts(st.events.pages)
+        for counter in set(TRANSPORT_COUNTER.values()):
+            assert d.get(counter, 0) == ev_counts.get(counter, 0), (
+                f"{label}: {counter}={d.get(counter, 0)} but "
+                f"{ev_counts.get(counter, 0)} page events claim it")
         if expect_host:
             assert st.pages_host_values > 0, (
                 f"{label}: expected the host-decode fallback; a new "

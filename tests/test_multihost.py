@@ -72,3 +72,16 @@ def test_two_process_scan(tmp_path):
     want = [mh.unit_checksum(read_row_group_device(readers[fi], rgi))
             for fi, rgi in units]
     assert got["checksums"] == want, "\n".join(logs)
+
+    # fleet telemetry (allgather_stats): the children asserted the
+    # fleet totals equal the sum of their per-host as_dict outputs;
+    # the parent pins the absolute fleet numbers against the footers —
+    # every unit decoded exactly once across the two processes
+    fleet = got["fleet_stats"]
+    assert fleet["row_groups"] == len(units)
+    assert fleet["values"] == sum(
+        cc.meta_data.num_values
+        for r in readers for rg in r.meta.row_groups
+        for cc in rg.columns)
+    assert fleet["chunks"] == sum(
+        len(rg.columns) for r in readers for rg in r.meta.row_groups)

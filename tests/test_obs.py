@@ -1,0 +1,366 @@
+"""Structured decode telemetry (tpuparquet/obs/): per-page event log,
+log2 histograms with exact merges, export surfaces, aggregation.
+
+The companion of the routing contract in test_fallback_matrix.py
+(every device branch emits exactly one event matching its counter):
+here the telemetry machinery itself is pinned — opt-in semantics,
+worker-collector merge exactness, serialization round trips, the
+``parquet-tool profile`` surface, and the single-process degenerate
+case of ``allgather_stats``.
+"""
+
+import contextlib
+import io
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from tpuparquet import (CompressionCodec, FileReader, FileWriter,
+                        collect_stats, obs)
+from tpuparquet.cpu.plain import ByteArrayColumn
+from tpuparquet.obs.histogram import Histogram, N_BUCKETS
+from tpuparquet.stats import DecodeStats, current_stats, worker_stats
+
+
+def _file(n=6000, groups=2, codec=CompressionCodec.SNAPPY):
+    buf = io.BytesIO()
+    w = FileWriter(
+        buf, "message m { required int64 a; optional int32 b; }",
+        codec=codec)
+    rng = np.random.default_rng(11)
+    per = n // groups
+    for _ in range(groups):
+        m = rng.random(per) >= 0.25
+        w.write_columns(
+            {"a": 1_700_000_000_000
+             + rng.integers(0, 500, per).cumsum(),
+             "b": rng.integers(0, 7, size=int(m.sum()),
+                               dtype=np.int32)},
+            masks={"b": m})
+    w.close()
+    buf.seek(0)
+    return buf
+
+
+# ----------------------------------------------------------------------
+# histograms
+# ----------------------------------------------------------------------
+
+class TestHistogram:
+    def test_bucket_edges(self):
+        h = Histogram()
+        for v in (0, 1, 2, 3, 4, 1023, 1024):
+            h.record(v)
+        # 0 -> bucket 0; 1 -> 1; 2,3 -> 2; 4 -> 3; 1023 -> 10; 1024 -> 11
+        assert h.counts[0] == 1 and h.counts[1] == 1
+        assert h.counts[2] == 2 and h.counts[3] == 1
+        assert h.counts[10] == 1 and h.counts[11] == 1
+        assert h.n == 7 and h.total == 0 + 1 + 2 + 3 + 4 + 1023 + 1024
+
+    def test_huge_values_clamp_to_last_bucket(self):
+        h = Histogram()
+        h.record(1 << 70)
+        assert h.counts[N_BUCKETS - 1] == 1
+
+    def test_dict_roundtrip_exact(self):
+        h = Histogram()
+        for v in (0, 5, 5, 1 << 33):
+            h.record(v)
+        h2 = Histogram.from_dict(json.loads(json.dumps(h.as_dict())))
+        assert h2.counts == h.counts
+        assert (h2.n, h2.total) == (h.n, h.total)
+
+    def test_merge_is_exact_and_order_free(self):
+        rng = np.random.default_rng(3)
+        vals = rng.integers(0, 1 << 40, size=2000).tolist()
+        oracle = Histogram()
+        for v in vals:
+            oracle.record(v)
+        parts = [Histogram() for _ in range(4)]
+        for i, v in enumerate(vals):
+            parts[i % 4].record(v)
+        for order in ([0, 1, 2, 3], [3, 1, 0, 2]):
+            m = Histogram()
+            for i in order:
+                m.merge_from(parts[i])
+            assert m.counts == oracle.counts
+            assert (m.n, m.total) == (oracle.n, oracle.total)
+
+
+def test_histogram_merge_exact_across_worker_collectors():
+    """The satellite contract: folding worker_stats() collectors'
+    histograms into the coordinator is EXACT — the merged histogram is
+    bucket-for-bucket identical to one histogram over all samples."""
+    rng = np.random.default_rng(7)
+    vals = rng.integers(0, 1 << 48, size=3000).tolist()
+    oracle = Histogram()
+    for v in vals:
+        oracle.record(v)
+
+    with collect_stats() as st:
+        done = []
+        lock = threading.Lock()
+
+        def run(shard):
+            with worker_stats(like=st) as ws:
+                for v in shard:
+                    current_stats().hist("h").record(v)
+            with lock:
+                done.append(ws)
+
+        threads = [threading.Thread(target=run, args=(vals[i::3],))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for ws in done:
+            st.merge_from(ws)
+
+    h = st.hists["h"]
+    assert h.counts == oracle.counts
+    assert (h.n, h.total) == (oracle.n, oracle.total)
+
+
+# ----------------------------------------------------------------------
+# event log: opt-in, decode coverage, serialization
+# ----------------------------------------------------------------------
+
+def test_events_are_opt_in():
+    buf = _file()
+    r = FileReader(buf)
+    with collect_stats() as st:  # plain collector: counters only
+        r.read_row_group_arrays(0)
+    assert st.events is None
+    assert current_stats() is None  # and nothing active outside
+
+
+def test_cpu_path_emits_cpu_events():
+    r = FileReader(_file())
+    with collect_stats(events=True) as st:
+        for rg in range(r.row_group_count()):
+            r.read_row_group_arrays(rg)
+    assert len(st.events.pages) == st.pages > 0
+    assert set(st.events.transport_counts()) == {"cpu"}
+    assert {s["name"] for s in st.events.spans} == {"read_row_group"}
+    # page-size histograms recorded alongside
+    assert st.hists["page_comp_bytes"].n == st.pages
+
+
+def test_device_events_match_counters_and_pipeline():
+    from tpuparquet.kernels.device import read_row_groups_device
+    from tpuparquet.obs import TRANSPORT_COUNTER
+
+    r = FileReader(_file())
+    with collect_stats(events=True) as st:
+        for _rg, cols in read_row_groups_device(r):
+            for c in cols.values():
+                c.block_until_ready()
+    # one event per data page even through the pipelined (worker
+    # thread) path — worker logs merge into the coordinator's
+    assert len(st.events.pages) == st.pages > 0
+    d = st.as_dict()
+    counts = st.events.transport_counts()
+    for transport, counter in TRANSPORT_COUNTER.items():
+        assert counts.get(transport, 0) == d[counter], (transport,
+                                                        counts, d)
+    # phase spans present for the Perfetto export
+    names = {s["name"] for s in st.events.spans}
+    assert {"plan", "transfer", "dispatch"} <= names
+
+
+def test_event_gate_records_wire_numbers():
+    """A sorted int64 column under the delta-lane transport must carry
+    the competition's wire numbers and a human reason."""
+    from tpuparquet.kernels.device import read_row_group_device
+
+    buf = io.BytesIO()
+    w = FileWriter(buf, "message m { required int64 t; }",
+                   allow_dict=False)
+    w.write_columns(
+        {"t": np.arange(60_000, dtype=np.int64) * 12345})
+    w.close()
+    buf.seek(0)
+    with collect_stats(events=True) as st:
+        read_row_group_device(FileReader(buf), 0)
+    lanes = st.events.pages_for(transport="delta-lanes")
+    if not lanes:  # native pack unavailable: transport can't engage
+        pytest.skip("delta-lane transport did not engage")
+    e = lanes[0]
+    assert e.wire_bytes is not None and e.raw_bytes is not None
+    assert e.wire_bytes < e.raw_bytes
+    assert e.gate and e.gate["delta-lanes"] == e.wire_bytes
+    assert "beat raw" in e.reason
+    assert st.hists["wire_ratio_permille"].n >= 1
+
+
+def test_jsonl_roundtrip_and_chrome_trace():
+    from tpuparquet.kernels.device import read_row_group_device
+
+    r = FileReader(_file(groups=1))
+    with collect_stats(events=True) as st:
+        read_row_group_device(r, 0)
+    lines = [json.loads(ln) for ln in st.events.to_jsonl().splitlines()]
+    assert len(lines) == len(st.events.pages) + len(st.events.spans)
+    kinds = {ln["kind"] for ln in lines}
+    assert kinds == {"page", "span"}
+    for ln in lines:
+        if ln["kind"] == "page":
+            assert {"column", "page", "encoding", "codec",
+                    "transport", "plan_s"} <= set(ln)
+    trace = obs.chrome_trace(st.events)
+    assert len(trace["traceEvents"]) == len(lines)
+    for te in trace["traceEvents"]:
+        assert te["ph"] in ("X", "i")
+        assert te["ts"] >= 0
+    # and the file writer surface
+    sink = io.StringIO()
+    obs.write_chrome_trace(st.events, sink)
+    assert json.loads(sink.getvalue())["traceEvents"]
+
+
+def test_column_table_aggregates():
+    from tpuparquet.kernels.device import read_row_group_device
+
+    r = FileReader(_file(groups=1))
+    with collect_stats(events=True) as st:
+        read_row_group_device(r, 0)
+    rows = obs.column_table(st.events)
+    assert [row["column"] for row in rows] == ["a", "b"]
+    for row in rows:
+        assert row["pages"] >= 1 and row["values"] > 0
+        assert row["plan_s"] >= 0
+    text = obs.format_column_table(rows)
+    assert "column" in text and "transports" in text and "a" in text
+
+
+def test_event_summary_filters_cpu_pages():
+    from tpuparquet.kernels.device import read_row_group_device
+
+    r = FileReader(_file(groups=1))
+    with collect_stats(events=True) as st:
+        read_row_group_device(r, 0)
+        r.read_row_group_arrays(0)
+    s = obs.event_summary(st.events)
+    assert s["pages"] == st.pages // 2  # device half only
+    assert "cpu" not in s["transports"]
+    assert obs.event_summary(None) == {}
+
+
+# ----------------------------------------------------------------------
+# aggregation: exact state round trip + single-process allgather
+# ----------------------------------------------------------------------
+
+def test_decodestats_state_roundtrip_exact():
+    st = DecodeStats()
+    st.pages = 7
+    st.values = 123456789
+    st.plan_s = 0.123456789  # must survive UNrounded
+    st.wall_s = 2.5
+    st.hist("page_comp_bytes").record(5000)
+    st.hist("page_comp_bytes").record(0)
+    back = DecodeStats.from_state(json.loads(json.dumps(st.to_state())))
+    for f in DecodeStats._MERGE_FIELDS:
+        assert getattr(back, f) == getattr(st, f), f
+    assert back.wall_s == st.wall_s
+    assert back.hists["page_comp_bytes"].counts == \
+        st.hists["page_comp_bytes"].counts
+
+
+def test_allgather_stats_single_process_equals_local():
+    from tpuparquet.shard.distributed import allgather_stats
+
+    r = FileReader(_file())
+    with collect_stats() as st:
+        for rg in range(r.row_group_count()):
+            r.read_row_group_arrays(rg)
+    fleet = allgather_stats(st)
+    assert fleet.as_dict() == st.as_dict()
+    assert fleet.hists["page_comp_bytes"].counts == \
+        st.hists["page_comp_bytes"].counts
+    # and the fleet of one host merges exactly like two copies would
+    two = DecodeStats.from_state(st.to_state())
+    two.merge_from(DecodeStats.from_state(st.to_state()))
+    assert two.pages == 2 * st.pages
+    assert two.hists["page_comp_bytes"].n == \
+        2 * st.hists["page_comp_bytes"].n
+
+
+def test_allgather_bytes_single_process():
+    from tpuparquet.shard.distributed import allgather_bytes
+
+    assert allgather_bytes(b"abc") == [b"abc"]
+
+
+def test_sharded_scan_run_with_stats():
+    from tpuparquet.shard.scan import ShardedScan
+
+    bufs = [_file(), _file()]
+    scan = ShardedScan(bufs)
+    results, st = scan.run_with_stats(events=True)
+    assert len(results) == len(scan.units)
+    assert st.pages > 0
+    assert len(st.events.pages) == st.pages
+
+
+# ----------------------------------------------------------------------
+# CLI: parquet-tool profile
+# ----------------------------------------------------------------------
+
+def test_profile_cli(tmp_path):
+    from tpuparquet.cli import parquet_tool as pt
+
+    p = str(tmp_path / "t.parquet")
+    with open(p, "wb") as f:
+        f.write(_file().getvalue())
+    ev_path = str(tmp_path / "events.jsonl")
+    tr_path = str(tmp_path / "trace.json")
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = pt.main(["profile", "--events", ev_path,
+                      "--perfetto", tr_path, p])
+    assert rc == 0
+    text = out.getvalue()
+    assert "column" in text and "transports" in text
+    assert "phases: plan" in text and "values/s" in text
+    with open(ev_path) as f:
+        ev_lines = [json.loads(ln) for ln in f if ln.strip()]
+    assert any(ln["kind"] == "page" for ln in ev_lines)
+    with open(tr_path) as f:
+        assert json.load(f)["traceEvents"]
+
+    # CPU-path profile rides the same surface
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = pt.main(["profile", "--cpu", p])
+    assert rc == 0
+    assert "cpu" in out.getvalue()
+
+
+# ----------------------------------------------------------------------
+# satellite: intern rc=-1 saturation retry
+# ----------------------------------------------------------------------
+
+def test_intern_retries_with_doubled_table_on_saturation(monkeypatch):
+    from tpuparquet.native import intern_native
+
+    ni = intern_native()
+    if ni is None:
+        pytest.skip("native interner unavailable")
+    col = ByteArrayColumn.from_list([b"a", b"bb", b"a", b"ccc"])
+    calls = []
+    real = ni._intern
+
+    def fake(*args):
+        calls.append(args)
+        if len(calls) == 1:
+            return -1  # claim saturation once; the binding must retry
+        return real(*args)
+
+    monkeypatch.setattr(ni, "_intern", fake)
+    firsts, idx = ni.intern_var(col.data, col.offsets, 10)
+    assert len(calls) == 2
+    assert idx.tolist() == [0, 1, 0, 2]
+    assert firsts.tolist() == [0, 1, 3]

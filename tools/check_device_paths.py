@@ -7,7 +7,10 @@ is not sufficient).  Builds one small file per encoding family and
 runs the `parquet-tool verify` comparison (CPU oracle vs device path,
 bitwise).
 
-Usage: python tools/check_device_paths.py    (exit 0 = all bit-exact)
+Usage: python tools/check_device_paths.py [--events]
+(exit 0 = all bit-exact; --events additionally asserts PER-PAGE
+transport decisions against the aggregate counters and prints the
+exact page a gate regression demoted)
 """
 
 from __future__ import annotations
@@ -134,6 +137,12 @@ def _files():
         expect={"pages_device_planes": 1})
 
 
+def _device_pages(st):
+    """Device-path page events (the CPU-oracle half of verify emits
+    transport="cpu" events; those are not routing decisions)."""
+    return [e for e in st.events.pages if e.transport != "cpu"]
+
+
 def main() -> int:
     import jax
 
@@ -141,16 +150,37 @@ def main() -> int:
 
     from tpuparquet.stats import collect_stats
 
-    print(f"backend={jax.default_backend()}")
+    # --events: assert PER-PAGE transport decisions, not just aggregate
+    # counters — a gate regression is then localized to the exact page
+    # (column, page ordinal, gate numbers) on real silicon
+    events_mode = "--events" in sys.argv[1:]
+    print(f"backend={jax.default_backend()}"
+          + (" (per-page events mode)" if events_mode else ""))
     failures = 0
     for name, buf, expect in _files():
         class _A:
             file = buf
 
         out = io.StringIO()
-        with collect_stats() as st:
+        with collect_stats(events=events_mode) as st:
             rc = cmd_verify(_A, out=out)
         detail = out.getvalue().strip().splitlines()[-1]
+        if rc == 0 and events_mode:
+            from tpuparquet.obs import TRANSPORT_COUNTER, counter_counts
+
+            # counter/event agreement for EVERY transport counter: each
+            # counted page must have exactly one event claiming that
+            # transport (the event log and the counters cannot drift)
+            d = st.as_dict()
+            ev_counts = counter_counts(_device_pages(st))
+            for counter in sorted(set(TRANSPORT_COUNTER.values())):
+                if d.get(counter, 0) != ev_counts.get(counter, 0):
+                    rc = 1
+                    detail = (
+                        f"event/counter drift: {counter}="
+                        f"{d.get(counter, 0)} but "
+                        f"{ev_counts.get(counter, 0)} page events")
+                    break
         # transport pinning: bit-exactness alone is vacuous for the
         # cases whose point is WHICH path ran (a gate regression that
         # demotes the transport must fail here, not pass silently)
@@ -161,6 +191,14 @@ def main() -> int:
                     rc = 1
                     detail = (f"transport regression: {key}={d.get(key)}"
                               f" < {want} (decode was bit-exact)")
+                    if events_mode:
+                        # the per-page log names the page that demoted
+                        # and what the gate saw
+                        detail += "".join(
+                            f"\n    {e.column}[{e.page}] {e.encoding} "
+                            f"-> {e.transport}"
+                            + (f" ({e.reason})" if e.reason else "")
+                            for e in _device_pages(st))
                     break
         status = "OK" if rc == 0 else "FAIL"
         print(f"[{status}] {name}: {detail}")

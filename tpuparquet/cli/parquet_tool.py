@@ -3,7 +3,9 @@
 Subcommand parity with the reference's cobra tool
 (``/root/reference/cmd/parquet-tool/cmds/``): ``cat``, ``head``,
 ``meta``, ``schema``, ``rowcount``, ``split``; plus ``verify``
-(CPU-vs-device bit-exact decode comparison — TPU-build addition).
+(CPU-vs-device bit-exact decode comparison) and ``profile``
+(per-column transport/gate/timing telemetry with JSON-lines and
+Perfetto exports) — TPU-build additions.
 
 Run as ``python -m tpuparquet.cli.parquet_tool <cmd> <file>``.
 """
@@ -200,6 +202,50 @@ def cmd_verify(args, out=None) -> int:
     return rc
 
 
+def cmd_profile(args, out=None) -> int:
+    """Decode with full telemetry on and print the per-column
+    transport/timing table: which wire transport each column's pages
+    took, WHY the gate chose it (the competition's wire-size numbers),
+    and where the host wall went.  Optional dumps: ``--events`` writes
+    the raw per-page JSON-lines log, ``--perfetto`` a Chrome-trace
+    JSON of the host phase spans (load at ui.perfetto.dev).  No
+    reference analogue — this is the observability face of the device
+    decode backend."""
+    out = out or sys.stdout
+    from .. import obs
+    from ..stats import collect_stats
+
+    with FileReader(args.file) as r:
+        with collect_stats(events=True) as st:
+            if getattr(args, "cpu", False):
+                for rg in range(r.row_group_count()):
+                    r.read_row_group_arrays(rg)
+            else:
+                from ..kernels.device import read_row_groups_device
+
+                for _rg, cols in read_row_groups_device(r):
+                    for c in cols.values():
+                        c.block_until_ready()
+    print(obs.format_column_table(obs.column_table(st.events)), file=out)
+    d = st.as_dict()
+    print(f"\nphases: plan {d['plan_s']:.3f}s  "
+          f"transfer {d['transfer_s']:.3f}s  "
+          f"dispatch {d['dispatch_s']:.3f}s  wall {d['wall_s']:.3f}s",
+          file=out)
+    print(st.summary(), file=out)
+    h = st.hists.get("page_comp_bytes")
+    if h is not None and h.n:
+        print(f"compressed page size: p50 < {h.quantile(0.5):,}B, "
+              f"p99 < {h.quantile(0.99):,}B over {h.n} pages", file=out)
+    if getattr(args, "events", None):
+        st.events.write_jsonl(args.events)
+        print(f"wrote page events to {args.events}", file=out)
+    if getattr(args, "perfetto", None):
+        obs.write_chrome_trace(st.events, args.perfetto)
+        print(f"wrote Perfetto trace to {args.perfetto}", file=out)
+    return 0
+
+
 def cmd_split(args, out=None) -> int:
     """Re-shard into multiple files of ~--file-size each
     (``split.go:33-122``)."""
@@ -292,6 +338,21 @@ def build_parser() -> argparse.ArgumentParser:
         help="decode on the CPU and device paths and compare bit-exactly")
     v.add_argument("file")
     v.set_defaults(fn=cmd_verify)
+
+    pf = sub.add_parser(
+        "profile",
+        help="decode with telemetry on; print the per-column "
+             "transport/timing table")
+    pf.add_argument("--cpu", action="store_true",
+                    help="profile the CPU oracle path instead of the "
+                         "device path")
+    pf.add_argument("--events", metavar="FILE", default="",
+                    help="write the per-page event log as JSON-lines")
+    pf.add_argument("--perfetto", metavar="FILE", default="",
+                    help="write a Chrome-trace JSON of the host phase "
+                         "spans (ui.perfetto.dev)")
+    pf.add_argument("file")
+    pf.set_defaults(fn=cmd_profile)
 
     rc = sub.add_parser("rowcount", help="print the total row count")
     rc.add_argument("file")

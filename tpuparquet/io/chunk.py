@@ -14,6 +14,8 @@ statistics (min/max/null_count/distinct_count,
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from ..cpu import gather
@@ -84,6 +86,11 @@ def read_chunk(blob: "bytes | memoryview", cm: ColumnMetaData,
     values_read = 0
     total = cm.num_values
     st = current_stats()
+    # per-page event log (obs/): transport "cpu" marks oracle-path
+    # pages; with no collector (or a plain collect_stats()) every
+    # emission below is skipped without allocating anything
+    ev = None if st is None else st.events
+    col_path = ".".join(cm.path_in_schema) if ev is not None else None
     if st is not None:
         st.chunks += 1
         st.bytes_compressed += cm.total_compressed_size
@@ -118,18 +125,27 @@ def read_chunk(blob: "bytes | memoryview", cm: ColumnMetaData,
             # (chunk_reader.go:243-249).
             if r.pos != cm.data_page_offset:
                 r.pos = cm.data_page_offset
-        elif ptype == PageType.DATA_PAGE:
-            pg = decode_data_page_v1(ph, payload, codec, node, dictionary)
+        elif ptype in (PageType.DATA_PAGE, PageType.DATA_PAGE_V2):
+            v2 = ptype == PageType.DATA_PAGE_V2
+            t_pg = time.perf_counter() if ev is not None else 0.0
+            pg = (decode_data_page_v2 if v2 else decode_data_page_v1)(
+                ph, payload, codec, node, dictionary)
             values_read += pg.num_values
             pages.append(pg)
             if st is not None:
                 st.pages += 1
-        elif ptype == PageType.DATA_PAGE_V2:
-            pg = decode_data_page_v2(ph, payload, codec, node, dictionary)
-            values_read += pg.num_values
-            pages.append(pg)
-            if st is not None:
-                st.pages += 1
+                st.hist("page_comp_bytes").record(ph.compressed_page_size)
+                st.hist("page_uncomp_bytes").record(
+                    ph.uncompressed_page_size)
+                if ev is not None:
+                    h = ph.data_page_header_v2 if v2 \
+                        else ph.data_page_header
+                    ev.page(column=col_path, page=len(pages) - 1,
+                            page_type="v2" if v2 else "v1",
+                            encoding=Encoding(h.encoding).name,
+                            codec=codec.name, num_values=pg.num_values,
+                            non_null=None, transport="cpu",
+                            plan_s=time.perf_counter() - t_pg)
         elif ptype == PageType.INDEX_PAGE:
             continue  # skip (reference ignores index pages)
         else:

@@ -15,6 +15,7 @@ assembly — the shape the device path and columnar consumers want.
 from __future__ import annotations
 
 import io
+import time
 
 import numpy as np
 
@@ -113,8 +114,18 @@ class FileReader:
             st.row_groups += 1
         rg = self.meta.row_groups[rg_index]
         out = {}
+        # phase span for the Perfetto export; nothing runs (and nothing
+        # allocates) on this path without an event-carrying collector
+        ev = None if st is None else st.events
+        t0 = time.perf_counter() if ev is not None else 0.0
         for path, node, cm, blob, start in self.iter_selected_chunks(rg):
             out[path] = read_chunk(memoryview(blob), _rebase(cm, start), node)
+        if ev is not None:
+            import threading
+
+            ev.span("read_row_group", "cpu-decode", t0,
+                    time.perf_counter(), tid=threading.get_ident(),
+                    rg=rg_index, columns=len(out))
         return out
 
     def iter_selected_chunks(self, rg):

@@ -597,14 +597,25 @@ class FileWriter:
             from ..stats import current_stats
 
             _ws_sink = current_stats()
-            with ThreadPoolExecutor(
-                max_workers=min(len(jobs), n_workers)
-            ) as ex:
-                # consume in order as results land: each blob is
-                # written and dropped before the next is pulled, so
-                # buffering is bounded by completed-not-yet-consumed
-                # chunks rather than the whole row group
-                for blob, cc, ws in ex.map(lambda a: render(*a), jobs):
+            n_w = min(len(jobs), n_workers)
+            with ThreadPoolExecutor(max_workers=n_w) as ex:
+                # bounded submission window, matching pipelined_reads:
+                # at most n_workers+1 chunks are in flight (rendering
+                # or rendered-not-yet-written), so a slow file write
+                # cannot pile up every remaining column's blob in
+                # memory — job i+ahead is only submitted once job i's
+                # blob has been written and dropped
+                ahead = n_w + 1
+                futs = {}
+
+                def submit(j):
+                    if j < len(jobs):
+                        futs[j] = ex.submit(render, *jobs[j])
+
+                for j0 in range(min(ahead, len(jobs))):
+                    submit(j0)
+                for i in range(len(jobs)):
+                    blob, cc, ws = futs.pop(i).result()
                     base = self._pos
                     self._write(blob)
                     cc.file_offset += base
@@ -617,6 +628,8 @@ class FileWriter:
                     chunks.append(cc)
                     if _ws_sink is not None:
                         _ws_sink.merge_from(ws)
+                    del blob
+                    submit(i + ahead)
         else:
             # serial path writes straight into the file: no per-chunk
             # buffer or blob copy (identical to the pre-pool behavior)

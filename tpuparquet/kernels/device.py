@@ -88,6 +88,11 @@ def _lanes_for(ptype: Type, type_length) -> int:
 
 _DICT_ENCODINGS = (Encoding.PLAIN_DICTIONARY, Encoding.RLE_DICTIONARY)
 
+# competition winner -> event-log transport label (obs.TRANSPORT_COUNTER
+# maps these back to the DecodeStats counter each increments)
+_CHOSEN_TRANSPORT = {"planes": "planes", "delta": "delta-lanes",
+                     "snappy": "snappy-tokens"}
+
 # Device-side snappy decompression of PLAIN fixed-width value segments
 # (tokens + literals ship instead of the decompressed bytes).  Engages
 # only for genuinely-compressed blocks — single-literal blocks keep the
@@ -280,13 +285,14 @@ def _stage_token_expansion(plan, stager: "_Stager"):
 
 def _plan_device_snappy_blob(payload, expected_size: int,
                              wire_budget: float, stager: "_Stager"):
-    """Like :func:`_plan_device_snappy_words` but returning the raw u8
-    page expansion (for byte-granular consumers), engaged only when the
-    token tables fit ``wire_budget`` bytes."""
+    """Like :func:`_plan_device_snappy_words` but returning
+    ``(wire, blob)`` with the raw u8 page expansion (for byte-granular
+    consumers), engaged only when the token tables fit ``wire_budget``
+    bytes."""
     plan = _plan_token_expansion(payload, expected_size)
     if plan is None or plan[6] > wire_budget:
         return None
-    return _stage_token_expansion(plan, stager)
+    return plan[6], _stage_token_expansion(plan, stager)
 
 
 def _rle_table(plane: np.ndarray, count: int, val_dtype, bucket,
@@ -353,7 +359,11 @@ def _plan_plane_words(seg, count: int, lanes: int, stager: "_Stager",
     per engaged lane, no full-page 2-D materialization.
 
     ``budget``, when given, is a competing transport's exact wire cost
-    (snappy tokens): the planes engage only if they beat it."""
+    (snappy tokens): the planes engage only if they beat it.
+
+    Returns ``(wire, words_closure)`` — the wire cost recomputed from
+    the BUILT tables (what the gate actually accepted; the event log
+    reports it) — or None when the page rejects."""
     from .decode import bucket
 
     if count < 1024:
@@ -485,7 +495,7 @@ def _plan_plane_words(seg, count: int, lanes: int, stager: "_Stager",
             staged[_hs[3]], staged[_hs[4]], staged[_hs[5]],
             _spec, _count, _lanes)
 
-    return words
+    return actual, words
 
 
 def _stage_delta_plan(plan, stager: "_Stager", need_hi: bool):
@@ -976,12 +986,19 @@ class _Stager:
         from ..stats import current_stats
 
         _cs = current_stats()
+        _whist = None
         if _cs is not None:
             # counted at transfer time, post-split/padding: the pieces
             # ARE the wire
             _cs.bytes_staged += sum(p.nbytes for p in pieces)
+            # per-wave transfer wall (put -> the block that fences it):
+            # the tunnel-health observable — a congested link shows as
+            # the wave histogram's tail exploding while bytes_staged
+            # stays flat
+            _whist = _cs.hist("stager_wave_us")
         dev = [None] * len(pieces)
         prev = None
+        t_wave = 0.0
         i = 0
         while i < len(pieces):
             wave, wave_bytes = [], 0
@@ -993,11 +1010,17 @@ class _Stager:
                 i += 1
             if prev is not None:
                 jax.block_until_ready(prev)
+                if _whist is not None:
+                    _whist.record((time.perf_counter() - t_wave) * 1e6)
+            if _whist is not None:
+                t_wave = time.perf_counter()
             out = jax.device_put([pieces[j] for j in wave])
             for j, d in zip(wave, out):
                 dev[j] = d
             prev = out
         jax.block_until_ready(prev)
+        if _whist is not None and prev is not None:
+            _whist.record((time.perf_counter() - t_wave) * 1e6)
         return [
             dev[s] if n == 1 else jnp.concatenate(dev[s : s + n])
             for s, n in spec
@@ -1041,6 +1064,13 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
     codec = CompressionCodec(cm.codec)
     ptype = Type(node.element.type)
     _st = current_stats()
+    # per-page event log (obs/): only on when the active collector was
+    # opened with collect_stats(events=True) — the emission sites below
+    # all gate on `_ev is not None`, so a plain collector (or none)
+    # pays nothing per page
+    _ev = None if _st is None else _st.events
+    _col_path = ".".join(cm.path_in_schema) if _ev is not None else None
+    _page_i = 0
     if _st is not None:
         _st.chunks += 1
         _st.bytes_compressed += cm.total_compressed_size
@@ -1075,6 +1105,7 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
             raise ValueError(
                 f"column chunk exhausted at {values_read}/{total} values"
             )
+        _t_pg = time.perf_counter() if _ev is not None else 0.0
         ph = decode_struct(PageHeader, r)
         # same malformed-header checks as the CPU path (io/chunk.py,
         # io/pages.py) — thrift-optional fields may arrive as None
@@ -1233,6 +1264,9 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
             continue
         if _st is not None:
             _st.pages += 1
+            _st.hist("page_comp_bytes").record(ph.compressed_page_size)
+            _st.hist("page_uncomp_bytes").record(
+                ph.uncompressed_page_size)
 
         if not max_def:
             non_null = n
@@ -1298,14 +1332,19 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
 
         budgets = [c for c in (delta_wire, payload_bound)
                    if c is not None]
-        plan_words = _try_planes(min(budgets) if budgets else None)
+        planes_wire = None
+        _pl = _try_planes(min(budgets) if budgets else None)
+        if _pl is not None:
+            planes_wire, plan_words = _pl
         chosen = "planes" if plan_words is not None else None
         tok = None
+        tok_scanned = False
         if plan_words is None:
             if payload_bound is not None and not (
                     delta_wire is not None
                     and delta_wire < payload_bound):
                 # no competitor beats the token bound: pay the scan
+                tok_scanned = True
                 tok = _plan_device_snappy_words(
                     values_comp[0], values_comp[1],
                     non_null * _LANES[ptype], offset=values_comp[2],
@@ -1314,7 +1353,9 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                     # token transport unreachable after all: re-contest
                     # the planes without its payload bound (they may
                     # have been pruned ONLY by it)
-                    plan_words = _try_planes(delta_wire)
+                    _pl = _try_planes(delta_wire)
+                    if _pl is not None:
+                        planes_wire, plan_words = _pl
                     chosen = "planes" if plan_words is not None else None
             if plan_words is None:
                 if delta_cand is not None and (
@@ -1329,6 +1370,9 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                     # below needs the decompressed bytes after all
                     values_seg = decompress_block_into(
                         codec, values_comp[0], values_comp[1], arena)
+        chosen_wire = (planes_wire if chosen == "planes"
+                       else delta_wire if chosen == "delta"
+                       else tok[0] if chosen == "snappy" else None)
         if _st is not None and chosen is not None:
             if chosen == "planes":
                 _st.pages_device_planes += 1
@@ -1336,6 +1380,52 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 _st.pages_device_delta_lanes += 1
             else:
                 _st.pages_device_snappy += 1
+
+        # event-log fields for this page (filled by the dispatch chain
+        # below; emitted once at the end of the loop body).  The PLAIN
+        # fixed-width transports are decided right here, so their
+        # transport label, wire numbers and gate verdict resolve now.
+        _tr = _wire_ev = _raw_ev = _gate = _reason = None
+        if enc == Encoding.PLAIN and ptype in _LANES:
+            _raw_ev = non_null * _LANES[ptype] * 4
+            _tr = _CHOSEN_TRANSPORT.get(chosen, "raw")
+            _wire_ev = chosen_wire if chosen is not None else _raw_ev
+            if _st is not None and chosen is not None and _raw_ev:
+                _st.hist("wire_ratio_permille").record(
+                    chosen_wire * 1000 // _raw_ev)
+            if _ev is not None:
+                # "declined" = competed on wire cost (or in-planner
+                # gates) and lost; "n/a" = never eligible for this
+                # page — the distinction an operator needs when a
+                # transport they expected is absent
+                _gate = {"raw": _raw_ev}
+                _gate["delta-lanes"] = (
+                    delta_wire if delta_wire is not None
+                    else "declined" if delta_cand is not None
+                    or (_DEVICE_DELTA_LANES()
+                        and ptype in (Type.INT32, Type.INT64)
+                        and values_seg is not None)
+                    else "n/a (type/flag/compressed)")
+                _gate["planes"] = (
+                    planes_wire if planes_wire is not None
+                    else "declined" if (_DEVICE_PLANES() and non_null
+                                        and values_seg is not None)
+                    else "n/a (flag/empty/compressed)")
+                if tok is not None:
+                    _gate["snappy-tokens"] = tok[0]
+                elif payload_bound is None:
+                    _gate["snappy-tokens"] = "n/a (not device-snappy)"
+                elif tok_scanned:
+                    _gate["snappy-tokens"] = "declined"
+                else:
+                    _gate["snappy-tokens"] = (
+                        f"not-scanned (competitor under payload bound "
+                        f"{payload_bound}B)")
+                if chosen is not None:
+                    _reason = (f"{_tr} {chosen_wire}B beat raw "
+                               f"{_raw_ev}B")
+                else:
+                    _reason = "no transport beat raw staging"
 
         # Def-level plan, padded for the fused page kernels.  A page
         # whose value path can't fuse expands it standalone via
@@ -1371,6 +1461,7 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 ops.append(op)
 
         if enc in _DICT_ENCODINGS:
+            _tr = "dict"
             width = int(values_seg[0]) if len(values_seg) else 0
             if dict_fixed_h is not None:
                 from ..cpu.hybrid import scan_hybrid
@@ -1498,20 +1589,37 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 from .decode import bucket as _bucket
 
                 blob_plan = None
+                budget = None
                 if bytes_comp is not None:
                     budget = (0.9 * int(col.data.size)
                               - 4 * _bucket(non_null + 1))
                     if budget > 0:
                         blob_plan = _plan_device_snappy_blob(
                             bytes_comp[0], bytes_comp[1], budget, stager)
+                _raw_ev = int(col.data.size)
+                if _ev is not None:
+                    _gate = {"raw": _raw_ev,
+                             "snappy-tokens": (
+                                 blob_plan[0] if blob_plan is not None
+                                 else "declined" if budget is not None
+                                 else "n/a (not device-snappy)")}
                 if blob_plan is not None:
                     # compressed tokens + padded offsets ship; the
                     # device expands the page and gathers value bytes
                     # (length prefixes skipped arithmetically)
                     from .decode import bucket, plain_bytes_from_blob
 
+                    blob_wire, blob_plan = blob_plan
+                    _tr = "snappy-tokens"
+                    _wire_ev = blob_wire
                     if _st is not None:
                         _st.pages_device_snappy += 1
+                        if _raw_ev:
+                            _st.hist("wire_ratio_permille").record(
+                                blob_wire * 1000 // _raw_ev)
+                    if _ev is not None:
+                        _reason = (f"tokens {blob_wire}B under budget "
+                                   f"{int(budget)}B (raw {_raw_ev}B)")
                     nb = int(col.data.size)
                     cap = bucket(max(nb, 1))
                     ocap = bucket(non_null + 1)
@@ -1527,6 +1635,8 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
 
                     ops.append(op)
                 else:
+                    _tr = "raw"
+                    _wire_ev = _raw_ev
                     dh = stager.add(col.data)
                     ops.append(
                         lambda s, p, _dh=dh, _o=offs,
@@ -1573,6 +1683,7 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                     )
                 )
             else:
+                _tr = "raw"
                 _def_standalone()
                 # values_seg stays a zero-copy view (arena lifetime runs
                 # until the caller's release, after transfers complete)
@@ -1589,6 +1700,7 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 Type.FIXED_LEN_BYTE_ARRAY):
             from .decode import bss_to_lanes
 
+            _tr = "bss"
             _def_standalone()
             k = (node.element.type_length
                  if ptype == Type.FIXED_LEN_BYTE_ARRAY
@@ -1612,6 +1724,7 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
             # the V1 levels
             import struct
 
+            _tr = "rle"
             _def_standalone()
             if len(values_seg) < 4:
                 raise ValueError("boolean RLE stream missing length")
@@ -1632,6 +1745,7 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
             # string payload before staging
             from ..cpu.delta import scan_delta_length_byte_array
 
+            _tr = "dlba"
             _def_standalone()
             offs, dpos = scan_delta_length_byte_array(values_seg,
                                                       non_null)
@@ -1693,6 +1807,11 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 # actually expands; otherwise (or where bucket(expanded)
                 # would pass int32, cf. plan_tokens) assemble on host
                 # from the ALREADY-parsed streams — no re-parse
+                _tr = "dba-host"
+                if _ev is not None:
+                    _reason = (
+                        f"front coding non-expanding: host assembly "
+                        f"ships {compact}B vs expanded {expanded}B")
                 suffix_view = np.frombuffer(values_seg, np.uint8,
                                             n_suffix, spos)
                 col = assemble_delta_byte_array(prefix_lens, soffs,
@@ -1715,6 +1834,12 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
             else:
                 from .decode import bucket as _bucket
 
+                _tr = "dba"
+                if _ev is not None:
+                    _wire_ev = compact
+                    _raw_ev = expanded
+                    _reason = (f"copy-token expansion: {compact}B wire "
+                               f"vs {expanded}B expanded")
                 out_cap = _bucket(expanded)
                 T = _bucket(2 * non_null)
                 te = np.full(T, out_cap, dtype=np.int32)
@@ -1753,6 +1878,7 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 ops.append(op)
         elif enc == Encoding.DELTA_BINARY_PACKED and ptype in (
                 Type.INT32, Type.INT64):
+            _tr = "delta-bp"
             _def_standalone()
             if ptype == Type.INT32:
                 build = _stage_delta_plan(
@@ -1774,6 +1900,9 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 )
         else:
             # CPU fallback for the remaining encodings; stage the result.
+            _tr = "host"
+            if _ev is not None:
+                _reason = "no device kernel for this encoding"
             _def_standalone()
             if _st is not None:
                 _st.pages_host_values += 1
@@ -1791,6 +1920,26 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                     lambda s, p, _c=col, _nn=non_null:
                     p["val"].append((_stage_numpy_fixed(_c, ptype), _nn))
                 )
+
+        # one event per data page: the dispatch chain above resolved
+        # the transport; every branch reaches this point (dictionary
+        # pages `continue` before it and are not data pages).  A
+        # branch that forgot its `_tr = ...` label ships as "unknown"
+        # rather than a silent null — visible in transport_counts()
+        # and the profile table, so the gap can't hide.
+        if _ev is not None:
+            _ev.page(
+                column=_col_path, page=_page_i,
+                page_type=("v2" if ptype_page == PageType.DATA_PAGE_V2
+                           else "v1"),
+                encoding=Encoding(enc).name, codec=codec.name,
+                num_values=n, non_null=non_null,
+                transport=_tr if _tr is not None else "unknown",
+                wire_bytes=_wire_ev, raw_bytes=_raw_ev,
+                gate=_gate, reason=_reason,
+                plan_s=time.perf_counter() - _t_pg,
+            )
+        _page_i += 1
 
     type_length = node.element.type_length
 
@@ -1929,7 +2078,14 @@ def _plan_row_group(reader, rg, stager: _Stager, arena: HostArena):
         )
     _cs = current_stats()
     if _cs is not None:
-        _cs.plan_s += time.perf_counter() - t0
+        t1 = time.perf_counter()
+        _cs.plan_s += t1 - t0
+        if _cs.events is not None:
+            import threading
+
+            _cs.events.span("plan", "decode", t0, t1,
+                            tid=threading.get_ident(),
+                            columns=len(planned))
     return planned
 
 
@@ -1956,6 +2112,14 @@ def _finish_row_group(planned, st: _Stager):
         t2 = time.perf_counter()
         _cs.transfer_s += t1 - t0
         _cs.dispatch_s += t2 - t1
+        if _cs.events is not None:
+            import threading
+
+            tid = threading.get_ident()
+            _cs.events.span("transfer", "decode", t0, t1, tid=tid,
+                            columns=len(out))
+            _cs.events.span("dispatch", "decode", t1, t2, tid=tid,
+                            columns=len(out))
     return out
 
 
@@ -2027,8 +2191,10 @@ def pipelined_reads(readers, units, device_for=None, start: int = 0):
         st = _Stager()
         # per-thread collector, merged on the main thread below: a
         # shared collector's += from racing planners loses counts, and
-        # values/bytes_* feed headline bench fields
-        with worker_stats() as ws:
+        # values/bytes_* feed headline bench fields.  `like=_cs`
+        # propagates the event-log config (shared t0 clock) so per-page
+        # events and plan spans flow through the pipelined path too.
+        with worker_stats(like=_cs) as ws:
             planned = _plan_row_group(
                 reader, reader.meta.row_groups[rgi], st,
                 arenas[k % ahead])

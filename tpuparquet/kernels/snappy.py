@@ -27,6 +27,7 @@ host path as default.
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -59,7 +60,18 @@ def plan_tokens(block, expected_size: int | None = None):
     0) — or None when the int32 device path would overflow.  The single
     source of the pointer-doubling preconditions, shared by
     :func:`decompress_device` and the page planner's deferred path."""
+    from ..stats import current_stats
+
+    _st = current_stats()
+    _t0 = time.perf_counter() if _st is not None else 0.0
     tok_end, tok_src, lits, out_len = snappy_scan_tokens(block)
+    if _st is not None:
+        # the token scan is a third of the plan wall (see the lazy-scan
+        # comment in kernels/device.py) — its distribution says whether
+        # the laziness is still paying
+        _st.hist("snappy_scan_us").record(
+            (time.perf_counter() - _t0) * 1e6)
+        _st.hist("snappy_tokens_per_page").record(len(tok_end))
     if expected_size is not None and out_len != expected_size:
         raise ValueError(
             f"snappy: header size {out_len} != expected {expected_size}"

@@ -787,15 +787,28 @@ class NativeIntern:
         # short; the cap (not n) sizes the table, so high-cardinality
         # columns abort cheaply instead of growing the table
         tbits = max(16, (4 * max_d - 1).bit_length())
-        T = 1 << tbits
-        slots = np.full(T, -1, dtype=np.int32)
+        # rc=-1 is the C pass reporting table saturation ("caller
+        # resizes" in intern.c): unreachable under the 4x sizing above
+        # (at most max_d entries ever occupy T >= 4*max_d slots), but
+        # honored anyway — retry with a doubled table rather than
+        # failing a write on a contract bug.  Bounded at +3 doublings
+        # (32x occupancy headroom): a .so that STILL claims saturation
+        # is lying, and an unbounded ladder would allocate multi-GiB
+        # tables on its way to the error below.
+        max_tbits = min(tbits + 3, 31)
         firsts = np.empty(max_d, dtype=np.int64)
         indices = np.empty(max(n, 1), dtype=np.int32)[:n]
-        d = self._intern(buf.ctypes.data, buf.size,
-                         offs.ctypes.data, n,
-                         slots.ctypes.data, T - 1, tbits,
-                         firsts.ctypes.data, max_d,
-                         indices.ctypes.data)
+        while True:
+            T = 1 << tbits
+            slots = np.full(T, -1, dtype=np.int32)
+            d = self._intern(buf.ctypes.data, buf.size,
+                             offs.ctypes.data, n,
+                             slots.ctypes.data, T - 1, tbits,
+                             firsts.ctypes.data, max_d,
+                             indices.ctypes.data)
+            if d != -1 or tbits >= max_tbits:
+                break
+            tbits += 1
         if d == -2:
             return TOO_MANY_DISTINCT
         if d == -3:

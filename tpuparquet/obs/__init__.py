@@ -1,0 +1,48 @@
+"""Structured decode telemetry (SURVEY.md §5 "tracing / metrics").
+
+Three layers under the ``collect_stats()`` API:
+
+* :mod:`~tpuparquet.obs.events` — one record per decoded page with the
+  chosen transport and the wire-size numbers that chose it, plus
+  host-side phase spans; JSON-lines out, queryable in-process.
+* :mod:`~tpuparquet.obs.histogram` — fixed log2-bucket histograms
+  (page sizes, wire ratios, stager wave times) whose merges are exact
+  across threads and hosts.
+* :mod:`~tpuparquet.obs.export` — Chrome-trace/Perfetto JSON and the
+  ``parquet-tool profile`` column table.
+
+Entry points::
+
+    with tpuparquet.collect_stats(events=True) as st:
+        read_row_group_device(reader, 0)
+    st.events.transport_counts()      # {"planes": 3, "raw": 1, ...}
+    st.events.write_jsonl("pages.jsonl")
+    obs.write_chrome_trace(st.events, "trace.json")  # Perfetto
+
+Everything is zero-cost when no collector is active (the hot paths'
+``current_stats() is None`` check short-circuits before any event or
+histogram code runs), and event-log-free under a plain
+``collect_stats()`` (``st.events is None``).
+"""
+
+from .events import (  # noqa: F401
+    EventLog,
+    PageEvent,
+    TRANSPORT_COUNTER,
+    counter_counts,
+    event_summary,
+)
+from .export import (  # noqa: F401
+    chrome_trace,
+    column_table,
+    format_column_table,
+    write_chrome_trace,
+)
+from .histogram import Histogram, N_BUCKETS  # noqa: F401
+
+__all__ = [
+    "EventLog", "PageEvent", "TRANSPORT_COUNTER", "counter_counts",
+    "event_summary", "chrome_trace", "column_table",
+    "format_column_table", "write_chrome_trace", "Histogram",
+    "N_BUCKETS",
+]

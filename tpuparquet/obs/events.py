@@ -1,0 +1,206 @@
+"""Structured decode telemetry: the per-page event log.
+
+``DecodeStats`` says *how many* pages took each transport;
+this log says *which* pages, *why* the gate chose that transport (the
+wire-size numbers from the competition in ``kernels/device.py``), and
+where each page's host plan time went.  One :class:`PageEvent` per data
+page, plus host-side phase :meth:`spans <EventLog.span>` (plan /
+transfer / dispatch) that the Perfetto exporter (``obs.export``) turns
+into a timeline.
+
+Activation rides the existing collector fast path: the decode hot
+paths check ``current_stats() is None`` first and only then
+``st.events`` — with no collector (or a plain ``collect_stats()``)
+nothing is allocated per page.  Enable with
+``collect_stats(events=True)``.
+
+Thread model matches ``DecodeStats``: each worker thread records into
+its own ``EventLog`` (via ``worker_stats(like=parent)``) and the
+coordinator folds with :meth:`EventLog.merge_from` — no cross-thread
+appends.  Worker logs share the parent's ``t0`` so merged span
+timestamps stay on one clock.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+__all__ = ["PageEvent", "EventLog", "TRANSPORT_COUNTER",
+           "counter_counts", "event_summary"]
+
+# transport label -> the DecodeStats counter that transport increments
+# (transports absent here increment none of the per-transport counters:
+# they are dedicated device kernels — dict / bss / delta-bp / ... — or
+# the CPU-oracle path's "cpu").  tools/check_device_paths.py --events
+# and tests/test_fallback_matrix.py enforce event/counter agreement
+# through this table.
+TRANSPORT_COUNTER = {
+    "snappy-tokens": "pages_device_snappy",
+    "planes": "pages_device_planes",
+    "delta-lanes": "pages_device_delta_lanes",
+    "host": "pages_host_values",
+}
+
+
+class PageEvent:
+    """One decoded data page: identity, routing decision, and cost."""
+
+    __slots__ = ("column", "page", "page_type", "encoding", "codec",
+                 "num_values", "non_null", "transport", "wire_bytes",
+                 "raw_bytes", "gate", "reason", "plan_s", "t")
+
+    def __init__(self, column, page, page_type, encoding, codec,
+                 num_values, non_null, transport, wire_bytes=None,
+                 raw_bytes=None, gate=None, reason=None, plan_s=0.0,
+                 t=0.0):
+        self.column = column          # dotted path_in_schema
+        self.page = page              # ordinal within the chunk
+        self.page_type = page_type    # "v1" | "v2"
+        self.encoding = encoding      # Encoding name
+        self.codec = codec            # CompressionCodec name
+        self.num_values = num_values  # record slots (levels included)
+        self.non_null = non_null
+        self.transport = transport    # see TRANSPORT_COUNTER
+        self.wire_bytes = wire_bytes  # chosen transport's wire cost
+        self.raw_bytes = raw_bytes    # what raw staging would have cost
+        self.gate = gate              # {candidate: wire | "declined" ...}
+        self.reason = reason          # human gate verdict
+        self.plan_s = plan_s          # host plan wall for this page
+        self.t = t                    # log-relative start time (s)
+
+    def as_dict(self) -> dict:
+        d = {
+            "column": self.column, "page": self.page,
+            "page_type": self.page_type, "encoding": self.encoding,
+            "codec": self.codec, "num_values": self.num_values,
+            "non_null": self.non_null, "transport": self.transport,
+            "plan_s": round(self.plan_s, 6), "t": round(self.t, 6),
+        }
+        if self.wire_bytes is not None:
+            d["wire_bytes"] = self.wire_bytes
+        if self.raw_bytes is not None:
+            d["raw_bytes"] = self.raw_bytes
+        if self.gate:
+            d["gate"] = self.gate
+        if self.reason:
+            d["reason"] = self.reason
+        return d
+
+    def __repr__(self):
+        return (f"PageEvent({self.column}[{self.page}] {self.encoding}"
+                f" -> {self.transport})")
+
+
+class EventLog:
+    """In-process, queryable event store with a JSON-lines surface."""
+
+    __slots__ = ("pages", "spans", "t0")
+
+    def __init__(self, t0: float | None = None):
+        self.pages: list[PageEvent] = []
+        self.spans: list[dict] = []
+        self.t0 = time.perf_counter() if t0 is None else t0
+
+    # -- recording (single-thread per log; see module docstring) ---------
+
+    def page(self, **kw) -> None:
+        kw.setdefault("t", time.perf_counter() - self.t0)
+        self.pages.append(PageEvent(**kw))
+
+    def span(self, name: str, phase: str, start: float, end: float,
+             tid: int = 0, **args) -> None:
+        """One host-side phase span; ``start``/``end`` are
+        ``perf_counter()`` readings (rebased to ``t0`` on export)."""
+        self.spans.append({
+            "name": name, "phase": phase,
+            "start": start - self.t0, "dur": end - start,
+            "tid": tid, "args": args,
+        })
+
+    def merge_from(self, other: "EventLog") -> None:
+        self.pages.extend(other.pages)
+        self.spans.extend(other.spans)
+
+    # -- queries ---------------------------------------------------------
+
+    def transport_counts(self) -> dict:
+        out: dict[str, int] = {}
+        for e in self.pages:
+            out[e.transport] = out.get(e.transport, 0) + 1
+        return out
+
+    def by_column(self) -> dict:
+        out: dict[str, list[PageEvent]] = {}
+        for e in self.pages:
+            out.setdefault(e.column, []).append(e)
+        return out
+
+    def pages_for(self, column: str | None = None,
+                  transport: str | None = None) -> list[PageEvent]:
+        return [e for e in self.pages
+                if (column is None or e.column == column)
+                and (transport is None or e.transport == transport)]
+
+    # -- serialization ---------------------------------------------------
+
+    def to_jsonl(self) -> str:
+        """JSON-lines: one object per record, pages then spans, each
+        tagged with ``"kind"`` — greppable, streamable, diffable."""
+        lines = []
+        for e in self.pages:
+            d = e.as_dict()
+            d["kind"] = "page"
+            lines.append(json.dumps(d, sort_keys=True))
+        for s in self.spans:
+            d = dict(s)
+            d["kind"] = "span"
+            lines.append(json.dumps(d, sort_keys=True))
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def write_jsonl(self, path_or_file) -> None:
+        if hasattr(path_or_file, "write"):
+            path_or_file.write(self.to_jsonl())
+        else:
+            with open(path_or_file, "w") as f:
+                f.write(self.to_jsonl())
+
+
+def counter_counts(pages) -> dict:
+    """Fold page events into per-``DecodeStats``-counter tallies via
+    :data:`TRANSPORT_COUNTER` — the single definition of the
+    event/counter agreement invariant that
+    ``tests/test_fallback_matrix.py`` and
+    ``tools/check_device_paths.py --events`` both enforce: for every
+    transport counter, ``counter_counts(events)[counter] ==
+    st.as_dict()[counter]``."""
+    out: dict[str, int] = {}
+    for e in pages:
+        c = TRANSPORT_COUNTER.get(e.transport)
+        if c is not None:
+            out[c] = out.get(c, 0) + 1
+    return out
+
+
+def event_summary(log: "EventLog | None") -> dict:
+    """Compact per-run digest of an event log (what ``bench.py``
+    attaches to each config): device-path page count, transport mix,
+    and the wire-vs-raw ratio over the pages that had a competition.
+    CPU-oracle pages (transport ``"cpu"``) are excluded so a run that
+    decodes both paths (the bench parity gate) reports the device mix."""
+    if log is None:
+        return {}
+    dev = [e for e in log.pages if e.transport != "cpu"]
+    transports: dict[str, int] = {}
+    wire = raw = 0
+    for e in dev:
+        transports[e.transport] = transports.get(e.transport, 0) + 1
+        if e.wire_bytes is not None and e.raw_bytes:
+            wire += e.wire_bytes
+            raw += e.raw_bytes
+    out = {"pages": len(dev), "transports": transports}
+    if raw:
+        out["wire_bytes"] = wire
+        out["raw_bytes"] = raw
+        out["wire_to_raw"] = round(wire / raw, 3)
+    return out

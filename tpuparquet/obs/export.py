@@ -1,0 +1,111 @@
+"""Export surfaces for the decode telemetry.
+
+* :func:`chrome_trace` — the host-side phase spans (plan / transfer /
+  dispatch, per worker thread) and per-page instants as a Chrome
+  trace-event JSON object, loadable in Perfetto (ui.perfetto.dev) or
+  ``chrome://tracing``.  This is the host-side complement of
+  ``stats.trace`` (the JAX profiler covers device kernels; these spans
+  cover the planner/stager wall the profiler can't see).
+* :func:`column_table` — the per-column transport/timing aggregate the
+  ``parquet-tool profile`` subcommand prints.
+"""
+
+from __future__ import annotations
+
+import json
+
+from .events import EventLog
+
+__all__ = ["chrome_trace", "write_chrome_trace", "column_table",
+           "format_column_table"]
+
+
+def chrome_trace(log: EventLog) -> dict:
+    """Chrome trace-event format: spans as complete ("X") events,
+    pages as instant ("i") events carrying the gate decision in args.
+    Timestamps are microseconds relative to the log's ``t0``."""
+    events = []
+    for s in log.spans:
+        events.append({
+            "name": s["name"], "cat": s["phase"], "ph": "X",
+            "ts": round(s["start"] * 1e6, 1),
+            "dur": round(s["dur"] * 1e6, 1),
+            "pid": 0, "tid": s["tid"], "args": s["args"],
+        })
+    for e in log.pages:
+        events.append({
+            "name": f"{e.column}[{e.page}] {e.transport}",
+            "cat": "page", "ph": "i", "s": "t",
+            "ts": round(e.t * 1e6, 1),
+            "pid": 0, "tid": 0, "args": e.as_dict(),
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(log: EventLog, path_or_file) -> None:
+    obj = chrome_trace(log)
+    if hasattr(path_or_file, "write"):
+        json.dump(obj, path_or_file)
+    else:
+        with open(path_or_file, "w") as f:
+            json.dump(obj, f)
+
+
+def column_table(log: EventLog) -> list[dict]:
+    """Per-column aggregate rows, sorted by column path.
+
+    Each row: pages, values, transport mix, wire/raw ratio over the
+    gated pages, summed per-page plan wall, and a representative gate
+    reason (the modal transport's most recent reason)."""
+    rows = []
+    for col, events in sorted(log.by_column().items()):
+        transports: dict[str, int] = {}
+        wire = raw = 0
+        plan_s = 0.0
+        values = 0
+        for e in events:
+            transports[e.transport] = transports.get(e.transport, 0) + 1
+            values += e.num_values
+            plan_s += e.plan_s
+            if e.wire_bytes is not None and e.raw_bytes:
+                wire += e.wire_bytes
+                raw += e.raw_bytes
+        modal = max(transports, key=transports.get)
+        reason = next(
+            (e.reason for e in reversed(events)
+             if e.transport == modal and e.reason), "")
+        rows.append({
+            "column": col,
+            "pages": len(events),
+            "values": values,
+            "transports": transports,
+            "wire_to_raw": round(wire / raw, 3) if raw else None,
+            "plan_s": round(plan_s, 6),
+            "reason": reason,
+        })
+    return rows
+
+
+def format_column_table(rows: list[dict]) -> str:
+    """Fixed-width text rendering of :func:`column_table`."""
+    if not rows:
+        return "(no page events)"
+    headers = ["column", "pages", "values", "transports", "wire/raw",
+               "plan_ms", "gate reason"]
+    table = []
+    for r in rows:
+        mix = " ".join(f"{t}:{c}" for t, c in sorted(r["transports"]
+                                                     .items()))
+        table.append([
+            r["column"], str(r["pages"]), f"{r['values']:,}", mix,
+            "-" if r["wire_to_raw"] is None else f"{r['wire_to_raw']:.3f}",
+            f"{r['plan_s'] * 1e3:.1f}",
+            r["reason"] or "-",
+        ])
+    widths = [max(len(h), *(len(row[i]) for row in table))
+              for i, h in enumerate(headers)]
+    lines = ["  ".join(h.ljust(w) for h, w in zip(headers, widths))]
+    lines.append("  ".join("-" * w for w in widths))
+    for row in table:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+    return "\n".join(lines)

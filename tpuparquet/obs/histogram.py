@@ -1,0 +1,103 @@
+"""Fixed log2-bucket histograms whose merges are exact.
+
+Every collector records into buckets at the same fixed boundaries
+(bucket ``i`` holds the integer values whose ``bit_length()`` is ``i``,
+i.e. ``[2**(i-1), 2**i)``; bucket 0 holds exactly 0), so merging two
+histograms is elementwise integer addition — no re-binning, no float
+error, identical totals regardless of merge order or sharding.  That is
+the property the cross-thread (``stats.worker_stats``) and cross-host
+(``shard.distributed.allgather_stats``) folds rely on: the fleet
+histogram equals the histogram of the fleet.
+
+Values are non-negative integers by convention; callers quantize
+up-front (times as microseconds, ratios as permille) and name the unit
+in the histogram key (``stager_wave_us``, ``wire_ratio_permille``).
+"""
+
+from __future__ import annotations
+
+__all__ = ["Histogram", "N_BUCKETS", "bucket_lo", "bucket_hi"]
+
+# bucket 64 absorbs everything >= 2**63 (nothing we measure gets there)
+N_BUCKETS = 65
+
+
+def bucket_lo(i: int) -> int:
+    """Inclusive lower bound of bucket ``i``."""
+    return 0 if i == 0 else 1 << (i - 1)
+
+
+def bucket_hi(i: int) -> int:
+    """Exclusive upper bound of bucket ``i``."""
+    return 1 << i
+
+
+class Histogram:
+    """Counts per log2 bucket plus the exact sum and sample count.
+
+    ``counts`` is a plain list of ints — recording is two list ops and
+    three int adds, cheap enough to run on every page while a collector
+    is active.
+    """
+
+    __slots__ = ("counts", "n", "total")
+
+    def __init__(self):
+        self.counts = [0] * N_BUCKETS
+        self.n = 0
+        self.total = 0
+
+    def record(self, value) -> None:
+        v = int(value)
+        if v < 0:
+            v = 0
+        self.counts[min(v.bit_length(), N_BUCKETS - 1)] += 1
+        self.n += 1
+        self.total += v
+
+    def merge_from(self, other: "Histogram") -> None:
+        """Exact fold of another collector's buckets into this one."""
+        c, oc = self.counts, other.counts
+        for i in range(N_BUCKETS):
+            c[i] += oc[i]
+        self.n += other.n
+        self.total += other.total
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.n if self.n else 0.0
+
+    def quantile(self, q: float) -> int:
+        """Upper bound of the bucket containing the q-quantile (0<=q<=1).
+        Bucket-resolution only — exact enough to say 'p99 page is 1-2 MB'."""
+        if self.n == 0:
+            return 0
+        target = q * self.n
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= target and c:
+                return bucket_hi(i)
+        return bucket_hi(N_BUCKETS - 1)
+
+    def as_dict(self) -> dict:
+        """Sparse JSON form: only non-empty buckets ship (page-size
+        histograms touch a handful of the 65 buckets)."""
+        return {
+            "n": self.n,
+            "total": self.total,
+            "counts": {str(i): c for i, c in enumerate(self.counts) if c},
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Histogram":
+        h = cls()
+        h.n = int(d.get("n", 0))
+        h.total = int(d.get("total", 0))
+        for k, c in (d.get("counts") or {}).items():
+            h.counts[int(k)] = int(c)
+        return h
+
+    def __repr__(self):
+        return (f"Histogram(n={self.n}, total={self.total}, "
+                f"p50<{self.quantile(0.5)}, p99<{self.quantile(0.99)})")

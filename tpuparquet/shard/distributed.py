@@ -19,6 +19,8 @@ __all__ = [
     "process_units",
     "MultiHostScan",
     "allgather_host",
+    "allgather_bytes",
+    "allgather_stats",
 ]
 
 
@@ -78,6 +80,53 @@ def allgather_host(local_rows: np.ndarray) -> np.ndarray:
             res = res.reshape(res.shape[0])
         return res
     return np.asarray(multihost_utils.process_allgather(a))
+
+
+def allgather_bytes(payload: bytes) -> list[bytes]:
+    """All-gather one variable-length byte payload per process.
+
+    Two collectives: lengths first (so every process can pad to the
+    common maximum — ``process_allgather`` requires identical shapes),
+    then the padded u8 buffers.  Single-process: ``[payload]``."""
+    if jax.process_count() == 1:
+        return [payload]
+    lens = allgather_host(np.asarray(len(payload), dtype=np.int64))
+    lens = lens.reshape(-1)
+    L = max(int(lens.max()), 1)
+    buf = np.zeros(L, dtype=np.uint8)
+    buf[: len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+    out = allgather_host(buf).reshape(len(lens), L)
+    return [out[i, : int(lens[i])].tobytes() for i in range(len(lens))]
+
+
+def allgather_stats(st) -> "DecodeStats":
+    """Fold every host's ``DecodeStats`` — counters AND log2-bucket
+    histograms — into one fleet-wide collector, identical on every
+    process (rank 0 reports it; the others get it for free, the
+    all-gather is symmetric).
+
+    Counters ship EXACT (``to_state``, not the display-rounded
+    ``as_dict``) as JSON over :func:`allgather_bytes`, so the fleet
+    totals equal the elementwise sum of the per-host counters and the
+    fleet histograms are the exact bucket-wise sums (the
+    ``obs.Histogram`` merge property).  ``wall_s`` folds as the MAX
+    across hosts — the hosts decode concurrently, so the fleet
+    values/sec is fleet values over the slowest host's wall, not over
+    the summed walls.  Per-page event logs stay host-local (per-page
+    detail does not aggregate; export it per host instead)."""
+    import json
+
+    from ..stats import DecodeStats
+
+    payloads = allgather_bytes(json.dumps(st.to_state()).encode())
+    total = DecodeStats()
+    wall = 0.0
+    for p in payloads:
+        host = DecodeStats.from_state(json.loads(p))
+        total.merge_from(host)
+        wall = max(wall, host.wall_s)
+    total.wall_s = wall
+    return total
 
 
 class MultiHostScan:
@@ -153,6 +202,23 @@ class MultiHostScan:
         (same pipeline as :class:`~tpuparquet.shard.scan.ShardedScan`)."""
         self._next_local = 0
         return [out for _, out in self.run_iter()]
+
+    def run_with_stats(self, events: bool = False):
+        """Decode ALL of this process's units under a collector and
+        aggregate across the fleet.
+
+        Returns ``(results, fleet, local)``: this process's decoded
+        units (as :meth:`run`), the fleet-wide
+        :class:`~tpuparquet.stats.DecodeStats` (identical on every
+        process — ``fleet.summary()`` is the pod-level throughput
+        line), and this process's own collector (which carries the
+        per-page event log when ``events=True``; events stay
+        host-local by design)."""
+        from ..stats import collect_stats
+
+        with collect_stats(events=events) as local:
+            results = self.run()
+        return results, allgather_stats(local), local
 
     def counts_allgather(self) -> np.ndarray:
         """(global_units,) row counts, identical on every process."""

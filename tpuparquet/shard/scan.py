@@ -139,6 +139,19 @@ class ShardedScan:
         self._next_unit = 0
         return [out for _, out in self.run_iter()]
 
+    def run_with_stats(self, events: bool = False):
+        """:meth:`run` under a fresh collector; returns
+        ``(results, stats)``.  ``events=True`` attaches the per-page
+        event log (``stats.events``) — the single-process counterpart
+        of ``MultiHostScan.run_with_stats``, whose fleet aggregate
+        (``shard.distributed.allgather_stats``) folds exactly these
+        collectors across hosts."""
+        from ..stats import collect_stats
+
+        with collect_stats(events=events) as st:
+            results = self.run()
+        return results, st
+
     def close(self):
         for r in self.readers:
             r.close()

@@ -11,6 +11,24 @@ metric (values/sec/chip) as a library feature:
 Counters are plain Python ints collected only while a collector is
 active (zero overhead otherwise).  ``trace()`` wraps a scope in a JAX
 profiler trace for TensorBoard.
+
+THREAD-LOCAL SEMANTICS: the active collector is per-thread, not
+per-process.  ``collect_stats()`` registers its collector on the
+calling thread only — decode work an external caller dispatches to its
+OWN worker threads inside the scope is invisible to that collector
+unless each worker wraps its slice in :func:`worker_stats` and the
+coordinator folds the result with ``merge_from`` after joining (the
+pattern the library's internal thread pools use — see
+``kernels/device.pipelined_reads`` and ``io/writer._flush_prepared``).
+A shared collector incremented from racing threads would lose counts;
+the thread-local design makes that impossible rather than unlikely.
+
+Structured telemetry (``tpuparquet/obs/``) rides the same collector:
+``collect_stats(events=True)`` attaches a per-page
+:class:`~tpuparquet.obs.events.EventLog`, and log2-bucket histograms
+(:class:`~tpuparquet.obs.histogram.Histogram`) record whenever any
+collector is active.  Both merge exactly across ``worker_stats``
+collectors and across hosts (``shard.distributed.allgather_stats``).
 """
 
 from __future__ import annotations
@@ -77,6 +95,13 @@ class DecodeStats:
     dispatch_s: float = 0.0
     wall_s: float = 0.0
     _t0: float = dataclasses.field(default=0.0, repr=False)
+    # structured telemetry (tpuparquet/obs/): named log2-bucket
+    # histograms, recorded whenever this collector is active; and the
+    # per-page event log, attached only by collect_stats(events=True)
+    # (None otherwise — the hot paths check `st.events is not None`
+    # before any per-page event work)
+    hists: dict = dataclasses.field(default_factory=dict, repr=False)
+    events: object = dataclasses.field(default=None, repr=False)
 
     # counter fields merged across worker collectors (everything
     # cumulative; wall_s/_t0 belong to the owning scope alone)
@@ -90,9 +115,24 @@ class DecodeStats:
 
     def merge_from(self, other: "DecodeStats") -> None:
         """Fold a worker collector's counts into this one (called on
-        the coordinating thread after the worker is joined)."""
+        the coordinating thread after the worker is joined).  Histogram
+        folds are exact (integer bucket adds); the worker's event log,
+        if any, appends to this collector's."""
         for f in self._MERGE_FIELDS:
             setattr(self, f, getattr(self, f) + getattr(other, f))
+        for name, h in other.hists.items():
+            self.hist(name).merge_from(h)
+        if other.events is not None and self.events is not None:
+            self.events.merge_from(other.events)
+
+    def hist(self, name: str):
+        """Get-or-create the named histogram (obs.Histogram)."""
+        h = self.hists.get(name)
+        if h is None:
+            from .obs.histogram import Histogram
+
+            h = self.hists[name] = Histogram()
+        return h
 
     @property
     def values_per_sec(self) -> float:
@@ -144,6 +184,35 @@ class DecodeStats:
                if d["native_fallbacks"] else "")
         )
 
+    def histograms_dict(self) -> dict:
+        """Sparse JSON form of every recorded histogram."""
+        return {name: h.as_dict() for name, h in sorted(self.hists.items())}
+
+    # -- exact wire form (cross-host aggregation) -----------------------
+
+    def to_state(self) -> dict:
+        """JSON-serializable EXACT state: unrounded counters + wall +
+        histograms (``as_dict`` rounds for display; aggregation must
+        not).  The event log does not ship — it is per-host detail."""
+        d = {f: getattr(self, f) for f in self._MERGE_FIELDS}
+        d["wall_s"] = self.wall_s
+        if self.hists:
+            d["hists"] = self.histograms_dict()
+        return d
+
+    @classmethod
+    def from_state(cls, d: dict) -> "DecodeStats":
+        from .obs.histogram import Histogram
+
+        st = cls()
+        for f in cls._MERGE_FIELDS:
+            if f in d:
+                setattr(st, f, d[f])
+        st.wall_s = d.get("wall_s", 0.0)
+        for name, h in (d.get("hists") or {}).items():
+            st.hists[name] = Histogram.from_dict(h)
+        return st
+
 
 _tls = threading.local()
 
@@ -159,10 +228,21 @@ def current_stats() -> DecodeStats | None:
 
 
 @contextlib.contextmanager
-def collect_stats():
-    """Collect decode counters for the enclosed scope."""
+def collect_stats(events: bool = False):
+    """Collect decode counters for the enclosed scope (on THIS thread —
+    see the module docstring for the worker-thread contract).
+
+    ``events=True`` additionally attaches a per-page event log
+    (``st.events``, an :class:`~tpuparquet.obs.events.EventLog`): one
+    record per decoded page with the chosen transport and the gate's
+    wire-size numbers, plus host-side phase spans for the Perfetto
+    export.  Off by default — the event log allocates per page."""
     prev = getattr(_tls, "active", None)
     st = DecodeStats()
+    if events:
+        from .obs.events import EventLog
+
+        st.events = EventLog()
     st._t0 = time.perf_counter()
     _tls.active = st
     try:
@@ -173,13 +253,22 @@ def collect_stats():
 
 
 @contextlib.contextmanager
-def worker_stats():
+def worker_stats(like: "DecodeStats | None" = None):
     """Fresh per-thread collector for a pool worker; yields it.  The
     coordinating thread merges the result into ITS active collector
     (``merge_from``) after joining the worker — no cross-thread
-    increments, no lost counts."""
+    increments, no lost counts.
+
+    ``like`` is the coordinator's collector (or None): when it carries
+    an event log, the worker gets its own log on the SAME clock
+    (shared ``t0``), so merged span timestamps line up in one
+    timeline."""
     prev = getattr(_tls, "active", None)
     st = DecodeStats()
+    if like is not None and like.events is not None:
+        from .obs.events import EventLog
+
+        st.events = EventLog(t0=like.events.t0)
     _tls.active = st
     try:
         yield st
