@@ -81,8 +81,38 @@ ENC = {
 # device path.
 EXPECTED_HOST: set = set()
 
-CODECS = [CompressionCodec.UNCOMPRESSED, CompressionCodec.SNAPPY,
-          CompressionCodec.GZIP, CompressionCodec.ZSTD]
+# THE GOLDEN EXCEPTION LIST (host ASSEMBLY, not host fallback): the
+# only combinations whose pages MAY legitimately assemble values on
+# host — DELTA_BYTE_ARRAY pages whose front coding does not expand, a
+# per-page wire-cost decision (transport "dba-host"), not a missing
+# kernel.  This list is the executable form of the prose that used to
+# live only in the kernels/device.py module docstring; a "dba-host"
+# event from any other combination is a routing regression.
+HOST_ASSEMBLY_EXCEPTIONS = {
+    ("binary", "dba"):
+        "non-expanding front coding ships fewer bytes assembled",
+    ("flba4", "dba"):
+        "same gate; FLBA rides the byte-array assembly",
+}
+
+
+def _codec_available(codec) -> bool:
+    from tpuparquet.compress import get_block_compressor
+
+    try:
+        get_block_compressor(codec)
+        return True
+    except Exception:
+        return False
+
+
+# codecs whose compressor module is present in this image; a matrix
+# combination must not fail on an optional dependency being absent
+# (robustness round) — absence is visible in the parametrization
+CODECS = [c for c in (CompressionCodec.UNCOMPRESSED,
+                      CompressionCodec.SNAPPY,
+                      CompressionCodec.GZIP, CompressionCodec.ZSTD)
+          if _codec_available(c)]
 
 
 def _combos():
@@ -134,6 +164,15 @@ def test_fallback_matrix(tname, ename, dict_on):
         else:
             assert st.pages_host_values == 0, (
                 f"{label}: device path silently demoted to host decode")
+        # golden host-ASSEMBLY exceptions: "dba-host" pages are legal
+        # only for the combinations pinned above
+        for e in st.events.pages:
+            if e.transport == "dba-host":
+                assert (tname, ename) in HOST_ASSEMBLY_EXCEPTIONS, (
+                    f"{label}: page {e.page} host-assembled but "
+                    f"({tname}, {ename}) is not in "
+                    "HOST_ASSEMBLY_EXCEPTIONS — extend the golden "
+                    "list deliberately or fix the routing")
         # the routing claim is only meaningful if the decode is right
         cpu = r.read_row_group_arrays(0)
         for path, cd in cpu.items():
@@ -154,3 +193,48 @@ def test_host_counter_observable_in_stats_dict():
     from tpuparquet.stats import DecodeStats
 
     assert "pages_host_values" in DecodeStats().as_dict()
+
+
+def _dba_transports(values) -> set:
+    """Decode a one-column DELTA_BYTE_ARRAY file and return the set of
+    transports its data pages took."""
+    buf = io.BytesIO()
+    w = FileWriter(buf, "message m { required binary c; }",
+                   column_encodings={"c": Encoding.DELTA_BYTE_ARRAY},
+                   allow_dict=False)
+    w.write_columns({"c": values})
+    w.close()
+    buf.seek(0)
+    r = FileReader(buf)
+    with collect_stats(events=True) as st:
+        for c in read_row_group_device(r, 0).values():
+            c.block_until_ready()
+    return {e.transport for e in st.events.pages}
+
+
+class TestHostAssemblyGolden:
+    """Both sides of the golden exception: the excepted combination
+    really does host-assemble when the gate says so, and really does
+    NOT when front coding expands — the per-page decision the golden
+    list documents."""
+
+    def test_non_expanding_front_coding_host_assembles(self):
+        # no shared prefixes: compact form (suffixes + token table) is
+        # LARGER than the expanded bytes, so assembly ships fewer bytes
+        vals = ByteArrayColumn.from_list(
+            [(b"%08x" % (i * 2654435761 % 2**32)) for i in range(2000)])
+        assert _dba_transports(vals) == {"dba-host"}
+
+    def test_expanding_front_coding_stays_on_device(self):
+        # long shared prefixes: copy-token expansion pays, pages ship
+        # the compact form and expand on device
+        vals = ByteArrayColumn.from_list(
+            [("warehouse/region-7/shelf-%04d/item-%07d"
+              % (i // 40, i)).encode() for i in range(2000)])
+        assert _dba_transports(vals) == {"dba"}
+
+    def test_exceptions_and_expected_host_disjoint(self):
+        """The exception list is about host ASSEMBLY (a wire-cost win),
+        EXPECTED_HOST about host fallback (no kernel) — a combination
+        in both would be incoherent."""
+        assert not (set(HOST_ASSEMBLY_EXCEPTIONS) & EXPECTED_HOST)

@@ -25,18 +25,81 @@ skipping.  As of round 5 the probe result is:
 So pyarrow remains the single foreign implementation, and this test
 skips with that statement on the record.  The skip disappears — and the
 matrix runs — the moment a second implementation appears.
+
+Beyond plain importability, the probe now also tries a LOCAL WHEEL
+CACHE: ``pip install --no-index --find-links <dir>`` for each
+candidate package.  ``--no-index`` never contacts an index (zero
+egress by construction), so the attempt succeeds only if a wheel was
+pre-seeded into the image (``TPQ_WHEEL_CACHE``, ``/root/wheels``, or
+``tests/wheels/``).  Every attempt is logged and surfaces in the skip
+message, so "we tried X from Y and it failed because Z" is on the
+test record, not just "not installed".
 """
 
 import importlib
 import io
+import os
 import shutil
+import subprocess
+import sys
 
 import numpy as np
 import pytest
 
+_CANDIDATES = ("duckdb", "polars", "fastparquet")
+_WHEEL_DIRS = [
+    d for d in (
+        os.environ.get("TPQ_WHEEL_CACHE", ""),
+        "/root/wheels",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "wheels"),
+    ) if d and os.path.isdir(d)
+]
+_ATTEMPT_LOG: list[str] = []
+
+
+def _importable(mod: str) -> bool:
+    try:
+        importlib.import_module(mod)
+        return True
+    except ImportError:
+        return False
+
+
+def _try_wheel_cache() -> None:
+    """Attempt each candidate from each local wheel dir; log verdicts.
+    Called lazily from the module fixture — NOT at import — so plain
+    collection (--collect-only, -k filters) never spawns pip."""
+    if not _WHEEL_DIRS:
+        _ATTEMPT_LOG.append(
+            "no local wheel cache present (TPQ_WHEEL_CACHE, "
+            "/root/wheels, tests/wheels all absent)")
+        return
+    for pkg in _CANDIDATES:
+        if _importable(pkg):
+            _ATTEMPT_LOG.append(f"{pkg}: already importable")
+            continue
+        for d in _WHEEL_DIRS:
+            try:
+                proc = subprocess.run(
+                    [sys.executable, "-m", "pip", "install",
+                     "--no-index", "--find-links", d, pkg],
+                    capture_output=True, text=True, timeout=120)
+            except Exception as e:  # pip missing / timeout
+                _ATTEMPT_LOG.append(
+                    f"{pkg} from {d}: attempt died ({e})")
+                continue
+            if proc.returncode == 0:
+                _ATTEMPT_LOG.append(f"{pkg} from {d}: INSTALLED")
+                break
+            tail = (proc.stderr or proc.stdout).strip().splitlines()
+            _ATTEMPT_LOG.append(
+                f"{pkg} from {d}: rc={proc.returncode} "
+                f"({tail[-1] if tail else 'no output'})")
+
 
 def _find_second_impl():
-    for mod in ("duckdb", "polars", "fastparquet"):
+    for mod in _CANDIDATES:
         try:
             return mod, importlib.import_module(mod)
         except ImportError:
@@ -48,22 +111,38 @@ _NAME, _IMPL = _find_second_impl()
 _HAVE_GO = shutil.which("go") is not None
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _wheel_probe():
+    """Run the wheel-cache attempts once, before the first test of the
+    module actually executes (import/collection stays side-effect
+    free); re-probe importability afterwards so a seeded wheel flips
+    the matrix on within the same run."""
+    global _NAME, _IMPL
+    if _NAME is None:
+        _try_wheel_cache()
+        _NAME, _IMPL = _find_second_impl()
+    yield
+
+
 def test_probe_documented():
     """The probe itself always runs: pin WHY there is only one foreign
     implementation, so the absence is a recorded fact, not an oversight."""
     if _NAME is None and not _HAVE_GO:
+        assert len(_ATTEMPT_LOG) >= 1  # the wheel-cache probe ran
         pytest.skip(
             "no second parquet implementation installable in this image "
             "(duckdb/polars/fastparquet absent, zero egress; no Go to "
             "build the reference; no Java for parquet-mr) — pyarrow is "
-            "the sole foreign interop anchor, see module docstring"
+            "the sole foreign interop anchor.  Wheel-cache attempts: "
+            + "; ".join(_ATTEMPT_LOG)
         )
 
 
-@pytest.mark.skipif(_NAME != "duckdb", reason="duckdb not installed")
 def test_duckdb_reads_our_files(tmp_path):
     """Our writer's six-config matrix read back by DuckDB
     (≙ ``compatibility/run_tests.bash:14-19``)."""
+    if _NAME != "duckdb":  # runtime, so a wheel-probe install counts
+        pytest.skip("duckdb not installed")
     from tpuparquet import CompressionCodec, FileWriter
 
     duckdb = _IMPL
@@ -95,8 +174,9 @@ def test_duckdb_reads_our_files(tmp_path):
             assert got[0][0] == n
 
 
-@pytest.mark.skipif(_NAME != "duckdb", reason="duckdb not installed")
 def test_our_reader_reads_duckdb_files(tmp_path):
+    if _NAME != "duckdb":  # runtime, so a wheel-probe install counts
+        pytest.skip("duckdb not installed")
     from tpuparquet import FileReader
 
     duckdb = _IMPL

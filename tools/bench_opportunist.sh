@@ -33,7 +33,20 @@ while true; do
   fi
   if timeout "$PROBE_TIMEOUT" python -c "import jax; jax.devices()" \
       >/dev/null 2>&1; then
-    echo "$(date -Is) tunnel up: running official ladder"
+    # VERDICT round-5 item 1: convert the window into (a) a chip sweep
+    # then (b) the official ladder, IN THAT ORDER, within minutes of it
+    # opening.  The sweep proves every device branch bit-exact on the
+    # real chip (interpret-mode parity is not sufficient — the Mosaic
+    # straddle miscompile); its per-page --events assertions also catch
+    # gate regressions.  A sweep failure is loud but does NOT gate the
+    # ladder: a partial window should still produce a bench record.
+    echo "$(date -Is) tunnel up: chip sweep first (check_device_paths)"
+    if timeout 600 python tools/check_device_paths.py --events; then
+      echo "$(date -Is) chip sweep OK"
+    else
+      echo "$(date -Is) chip sweep FAILED (rc=$?) — see output above"
+    fi
+    echo "$(date -Is) running official ladder"
     TPQ_BENCH_PROBE_TIMEOUT=60 TPQ_BENCH_PROBE_ATTEMPTS=1 \
       python bench.py
     echo "$(date -Is) ladder attempt finished (rc=$?)"

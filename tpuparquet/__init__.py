@@ -41,5 +41,13 @@ from .format.builder import (  # noqa: F401
 from .format.dsl import SchemaDefinition, parse_schema_definition  # noqa: F401
 from .format.schema import Schema  # noqa: F401
 from . import obs  # noqa: F401  (pure-stdlib telemetry surface)
+from .errors import (  # noqa: F401  (structured error taxonomy)
+    CorruptChunkError,
+    CorruptPageError,
+    DeviceDispatchError,
+    ScanError,
+    TransientIOError,
+)
+from .faults import QuarantineReport, inject_faults, retry_transient  # noqa: F401
 from .io import FileReader, FileWriter  # noqa: F401
 from .stats import DecodeStats, collect_stats, trace  # noqa: F401

@@ -1,0 +1,123 @@
+"""Structured error taxonomy for the scan/decode path.
+
+The reference (and the seed) raised bare ``ValueError`` everywhere on
+the decode path, which gives a scan driver no way to tell *corruption*
+(permanent — quarantine the unit) from a *transient* I/O hiccup
+(retry) from a *device* failure (degrade to the CPU path).  This
+module is the taxonomy that makes those policies implementable:
+
+* :class:`CorruptPageError` / :class:`CorruptChunkError` — the bytes
+  are wrong (CRC mismatch, truncation, malformed header, impossible
+  counts).  Permanent for this file; a fault-tolerant scan quarantines
+  the unit and continues.  Both subclass ``ValueError`` so every
+  existing ``except ValueError`` caller (and the crash-corpus "clean
+  failure" contract in ``tests/test_corpus.py``) keeps working.
+* :class:`TransientIOError` — the read *might* succeed if repeated
+  (flaky NFS, throttled object store).  Subclasses ``OSError``;
+  :func:`tpuparquet.faults.retry_transient` retries these with bounded
+  exponential backoff.
+* :class:`DeviceDispatchError` — staging or kernel dispatch to the
+  accelerator failed.  The data is fine; the resilient read path
+  retries and then degrades to the bit-exact CPU decode
+  (``kernels.device.read_row_group_device_resilient``).
+
+Every class carries scan coordinates (file / row group / column /
+page).  Inner layers raise with what they know; outer layers
+:meth:`~ScanError.annotate` the rest as the error propagates, so by
+the time a quarantine report sees it the failing unit is pinpointed
+exactly.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "ScanError",
+    "CorruptPageError",
+    "CorruptChunkError",
+    "TransientIOError",
+    "DeviceDispatchError",
+    "QUARANTINE_ERRORS",
+]
+
+_COORD_FIELDS = ("file", "row_group", "column", "page")
+
+
+class ScanError(Exception):
+    """Base of the taxonomy: an error with scan coordinates.
+
+    ``file`` is a path or file index (whatever the raising layer
+    knows), ``row_group``/``page`` are ordinals, ``column`` is the
+    dotted ``path_in_schema``.  All optional — :meth:`annotate` fills
+    blanks as the error crosses layers without clobbering what an
+    inner layer already pinned.
+    """
+
+    def __init__(self, message: str = "", *, file=None, row_group=None,
+                 column=None, page=None):
+        super().__init__(message)
+        self.message = message
+        self.file = file
+        self.row_group = row_group
+        self.column = column
+        self.page = page
+
+    def coordinates(self) -> dict:
+        """The known coordinates, as a dict (omits unknowns)."""
+        return {
+            k: getattr(self, k)
+            for k in _COORD_FIELDS
+            if getattr(self, k) is not None
+        }
+
+    def annotate(self, **coords) -> "ScanError":
+        """Fill in *missing* coordinates; returns self for re-raise."""
+        for k, v in coords.items():
+            if k not in _COORD_FIELDS:
+                raise TypeError(f"unknown coordinate {k!r}")
+            if getattr(self, k) is None and v is not None:
+                setattr(self, k, v)
+        return self
+
+    def __str__(self) -> str:
+        c = self.coordinates()
+        if not c:
+            return self.message
+        at = ", ".join(f"{k}={v}" for k, v in c.items())
+        return f"{self.message} [{at}]"
+
+
+class CorruptPageError(ScanError, ValueError):
+    """One page's bytes are wrong (CRC mismatch, malformed header,
+    truncated payload, impossible value counts)."""
+
+
+class CorruptChunkError(ScanError, ValueError):
+    """A column chunk is structurally wrong beyond one page (byte
+    range out of bounds, short read, value-count mismatch)."""
+
+
+class TransientIOError(ScanError, OSError):
+    """An I/O failure that may succeed on retry."""
+
+
+class DeviceDispatchError(ScanError, RuntimeError):
+    """Staging/dispatching decode work to the accelerator failed; the
+    input bytes are fine and the CPU path can still decode them."""
+
+
+# What a quarantining scan may absorb per unit: the library's clean
+# failure taxonomy (ValueError covers Corrupt*/Thrift/codec errors,
+# EOFError truncation, TypeError/NotImplementedError foreign shapes,
+# OSError exhausted-retry I/O, RuntimeError exhausted device dispatch).
+# Raw crash types (IndexError, KeyError, ...) are BUGS and always
+# propagate — quarantine must never paper over them.  RecursionError
+# subclasses RuntimeError, so catch sites pair this tuple with
+# :func:`never_quarantine` to keep it (a crash, not a failure) loud.
+QUARANTINE_ERRORS = (ValueError, EOFError, TypeError,
+                     NotImplementedError, OSError, RuntimeError)
+
+
+def never_quarantine(exc: BaseException) -> bool:
+    """Crash types that must propagate even though they subclass a
+    member of :data:`QUARANTINE_ERRORS`."""
+    return isinstance(exc, RecursionError)

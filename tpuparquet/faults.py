@@ -1,0 +1,363 @@
+"""Deterministic fault injection + retry/backoff + quarantine report.
+
+The fault-tolerance layer has three moving parts, all here:
+
+* **Injection harness** — named fault sites instrumented into the read
+  and dispatch paths fire *deterministically* (site + occurrence
+  counting, no randomness) under an :func:`inject_faults` scope.  Used
+  by ``tests/test_faults.py`` to prove each fault class takes its
+  designed path; zero-cost in production (one module-global ``is
+  None`` check per site).
+* **Retry with bounded exponential backoff** —
+  :func:`retry_transient` for transient I/O,
+  :func:`backoff_delays` shared with the device-dispatch retry in
+  ``kernels/device.py``.
+* **Quarantine report** — :class:`QuarantineReport` accumulates the
+  exact coordinates (file / row group / column / page) and error class
+  of every unit a ``ShardedScan(on_error="quarantine")`` isolated.
+
+Fault sites (``site`` argument to :meth:`FaultInjector.inject`):
+
+====================================  =====================================
+site                                  instrumented where / supported kinds
+====================================  =====================================
+``io.reader.chunk_read``              ``FileReader.iter_selected_chunks``
+                                      — ``oserror``, ``transient``,
+                                      ``corrupt``, ``truncate``
+``io.chunk.page_payload``             CPU page loop (``io/chunk.py``)
+                                      — ``corrupt``, ``truncate``
+``io.pages.page_decode``              ``decode_data_page_v1/v2``
+                                      — ``corrupt``, ``truncate``
+``kernels.device.page_payload``       device plan page loop
+                                      — ``corrupt``, ``truncate``
+``kernels.device.page_dispatch``      device plan, per data page
+                                      — ``dispatch``
+``kernels.device.unit_dispatch``      ``_finish_row_group`` (per unit)
+                                      — ``dispatch``
+====================================  =====================================
+
+Kinds: ``oserror`` raises ``OSError(EIO)``; ``transient`` raises
+:class:`~tpuparquet.errors.TransientIOError`; ``dispatch`` raises
+:class:`~tpuparquet.errors.DeviceDispatchError`; ``corrupt`` XORs one
+byte of the stream (``offset=``, ``xor=``); ``truncate`` drops the
+tail (``keep=``).  Each rule fires on the first ``times`` matching
+calls after skipping ``after`` — "fail twice then succeed" is
+``times=2``, which a retry loop must survive.
+
+The active injector is a **process-global** (not thread-local): the
+pipelined reader plans on worker threads and faults must reach them.
+Each firing increments ``DecodeStats.faults_injected`` on the firing
+thread's collector and appends to :attr:`FaultInjector.log`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import errno as _errno
+import os
+import threading
+import time
+
+from .errors import DeviceDispatchError, TransientIOError
+
+__all__ = [
+    "FaultInjector",
+    "inject_faults",
+    "fault_point",
+    "filter_bytes",
+    "retry_transient",
+    "backoff_delays",
+    "is_transient",
+    "QuarantineReport",
+]
+
+_active: "FaultInjector | None" = None
+
+
+class _Rule:
+    __slots__ = ("site", "kind", "times", "after", "kw", "match",
+                 "seen", "fired")
+
+    def __init__(self, site, kind, times, after, match, kw):
+        self.site = site
+        self.kind = kind
+        self.times = times
+        self.after = after
+        self.match = match or {}
+        self.kw = kw
+        self.seen = 0    # matching calls observed
+        self.fired = 0   # faults actually delivered
+
+
+class FaultInjector:
+    """Deterministic fault plan: rules added with :meth:`inject`, a
+    :attr:`log` of ``(site, kind, ctx)`` for every fault delivered."""
+
+    def __init__(self):
+        self.rules: list[_Rule] = []
+        self.log: list[dict] = []
+        self._lock = threading.Lock()
+
+    def inject(self, site: str, kind: str, *, times: int = 1,
+               after: int = 0, match: dict | None = None, **kw) -> _Rule:
+        """Arm a rule: at ``site``, deliver ``kind`` on the first
+        ``times`` matching calls after skipping ``after``.  ``match``
+        restricts by context equality (e.g. ``match={"column": "a"}``).
+        Extra ``kw`` parameterize the kind (``offset``/``xor`` for
+        ``corrupt``, ``keep`` for ``truncate``)."""
+        r = _Rule(site, kind, times, after, match, kw)
+        with self._lock:
+            self.rules.append(r)
+        return r
+
+    # -- firing (called from the instrumented sites) ---------------------
+
+    def _next_rule(self, site: str, ctx: dict,
+                   kinds: tuple) -> "_Rule | None":
+        with self._lock:
+            for r in self.rules:
+                if r.site != site or r.kind not in kinds:
+                    continue
+                if any(ctx.get(k) != v for k, v in r.match.items()):
+                    continue
+                r.seen += 1
+                if r.seen <= r.after or r.fired >= r.times:
+                    continue
+                r.fired += 1
+                self.log.append(
+                    {"site": site, "kind": r.kind, **ctx})
+                return r
+        return None
+
+    def _record_stats(self, site: str, kind: str, ctx: dict) -> None:
+        from .stats import current_stats
+
+        st = current_stats()
+        if st is not None:
+            st.faults_injected += 1
+            if st.events is not None:
+                st.events.fault(site=site, kind=kind, **ctx)
+
+    def fire_raise(self, site: str, ctx: dict) -> None:
+        # byte-kinds (corrupt/truncate) never match here: a site name
+        # can host BOTH hooks (fault_point for failures, filter_bytes
+        # for the data it read), and a byte rule must wait for the
+        # byte hook rather than be consumed by this one
+        r = self._next_rule(site, ctx, ("oserror", "transient",
+                                        "dispatch"))
+        if r is None:
+            return
+        self._record_stats(site, r.kind, ctx)
+        if r.kind == "oserror":
+            raise OSError(_errno.EIO,
+                          f"injected I/O error at {site}")
+        if r.kind == "transient":
+            raise TransientIOError(
+                f"injected transient fault at {site}", **_coords(ctx))
+        raise DeviceDispatchError(
+            f"injected device dispatch failure at {site}",
+            **_coords(ctx))
+
+    def fire_bytes(self, site: str, data, ctx: dict):
+        r = self._next_rule(site, ctx, ("corrupt", "truncate"))
+        if r is None:
+            return data
+        self._record_stats(site, r.kind, ctx)
+        if r.kind == "truncate":
+            keep = r.kw.get("keep", len(data) // 2)
+            return bytes(data[:keep])
+        buf = bytearray(data)
+        if not buf:
+            return data
+        off = r.kw.get("offset", len(buf) // 2) % len(buf)
+        buf[off] ^= r.kw.get("xor", 0xFF) or 0xFF
+        return bytes(buf)
+
+
+def _coords(ctx: dict) -> dict:
+    return {k: ctx[k] for k in ("file", "row_group", "column", "page")
+            if k in ctx}
+
+
+@contextlib.contextmanager
+def inject_faults():
+    """Scope with a fresh active :class:`FaultInjector` (yields it).
+    Process-global and not reentrant — one scope at a time; intended
+    for tests and chaos drills."""
+    global _active
+    if _active is not None:
+        raise RuntimeError("inject_faults scopes do not nest")
+    inj = FaultInjector()
+    _active = inj
+    try:
+        yield inj
+    finally:
+        _active = None
+
+
+def fault_point(site: str, **ctx) -> None:
+    """Instrumentation hook: may raise an injected fault.  No-op (one
+    global ``is None`` check) when no injector is active."""
+    inj = _active
+    if inj is not None:
+        inj.fire_raise(site, ctx)
+
+
+def filter_bytes(site: str, data, **ctx):
+    """Instrumentation hook for byte streams: returns ``data`` (the
+    common case, zero-copy) or an injected corruption/truncation of
+    it; may also raise for read-failure kinds."""
+    inj = _active
+    if inj is not None:
+        return inj.fire_bytes(site, data, ctx)
+    return data
+
+
+# ----------------------------------------------------------------------
+# Retry with bounded exponential backoff
+# ----------------------------------------------------------------------
+
+_TRANSIENT_ERRNOS = frozenset(
+    getattr(_errno, name)
+    for name in ("EIO", "EAGAIN", "EBUSY", "EINTR", "ETIMEDOUT",
+                 "ENETRESET", "ECONNRESET", "ESTALE")
+    if hasattr(_errno, name)
+)
+
+_PERMANENT_OS = (FileNotFoundError, PermissionError, IsADirectoryError,
+                 NotADirectoryError)
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Is this failure worth retrying?  TransientIOError always;
+    plain OSError only for retryable errnos — a FileNotFoundError
+    will not heal with backoff."""
+    if isinstance(exc, TransientIOError):
+        return True
+    if isinstance(exc, _PERMANENT_OS):
+        return False
+    if isinstance(exc, (TimeoutError, InterruptedError, ConnectionError)):
+        return True
+    if isinstance(exc, OSError):
+        return exc.errno in _TRANSIENT_ERRNOS
+    return False
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+def backoff_delays(retries: int | None = None,
+                   base: float | None = None,
+                   cap: float | None = None) -> list[float]:
+    """The bounded exponential schedule: ``[base*2^0, base*2^1, ...]``
+    clamped to ``cap``, one entry per retry.  Knobs (env):
+    ``TPQ_IO_RETRIES`` (default 3), ``TPQ_RETRY_BASE_S`` (0.01),
+    ``TPQ_RETRY_MAX_S`` (0.5)."""
+    if retries is None:
+        retries = _env_int("TPQ_IO_RETRIES", 3)
+    if base is None:
+        base = _env_float("TPQ_RETRY_BASE_S", 0.01)
+    if cap is None:
+        cap = _env_float("TPQ_RETRY_MAX_S", 0.5)
+    return [min(base * (2 ** i), cap) for i in range(max(retries, 0))]
+
+
+def retry_transient(fn, *, retries: int | None = None,
+                    base: float | None = None, cap: float | None = None,
+                    sleep=time.sleep, counter: str = "io_retries"):
+    """Call ``fn()``; on a transient failure (:func:`is_transient`)
+    retry up to ``retries`` times with bounded exponential backoff.
+    Permanent errors and the final exhausted attempt propagate
+    unchanged.  Each retry increments ``DecodeStats.<counter>`` on the
+    active collector."""
+    from .stats import current_stats
+
+    delays = backoff_delays(retries, base, cap)
+    for delay in delays:
+        try:
+            return fn()
+        except Exception as e:
+            if not is_transient(e):
+                raise
+            st = current_stats()
+            if st is not None:
+                setattr(st, counter, getattr(st, counter) + 1)
+            sleep(delay)
+    return fn()
+
+
+# ----------------------------------------------------------------------
+# Quarantine report
+# ----------------------------------------------------------------------
+
+class QuarantineReport:
+    """Where the bad units went: one entry per quarantined scan unit,
+    carrying exact coordinates and the error class.  JSON-serializable
+    (:meth:`as_dicts` / :meth:`from_dicts`) so it rides scan cursors
+    and the cross-host all-gather."""
+
+    def __init__(self, entries: list[dict] | None = None):
+        self.entries: list[dict] = list(entries or [])
+
+    def add(self, *, unit: int, file, row_group: int,
+            error: BaseException) -> dict:
+        entry = {
+            "unit": unit,
+            "file": file,
+            "row_group": row_group,
+            "error": type(error).__name__,
+            "message": str(error),
+        }
+        # ScanErrors pinpoint deeper: column / page / a more precise
+        # file label from an inner layer
+        coords = getattr(error, "coordinates", None)
+        if callable(coords):
+            for k, v in coords().items():
+                if k == "file":
+                    entry["file_detail"] = v
+                elif k != "row_group":
+                    entry[k] = v
+        self.entries.append(entry)
+        return entry
+
+    def units(self) -> list[int]:
+        return [e["unit"] for e in self.entries]
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def __bool__(self) -> bool:
+        return bool(self.entries)
+
+    def as_dicts(self) -> list[dict]:
+        return [dict(e) for e in self.entries]
+
+    @classmethod
+    def from_dicts(cls, entries) -> "QuarantineReport":
+        return cls([dict(e) for e in entries or []])
+
+    def merge_from(self, other: "QuarantineReport") -> None:
+        self.entries.extend(dict(e) for e in other.entries)
+
+    def summary(self) -> str:
+        if not self.entries:
+            return "quarantine: empty"
+        lines = [f"quarantine: {len(self.entries)} unit(s)"]
+        for e in self.entries:
+            at = ", ".join(
+                f"{k}={e[k]}" for k in
+                ("file", "row_group", "column", "page") if k in e)
+            lines.append(f"  unit {e['unit']} [{at}]: "
+                         f"{e['error']}: {e['message']}")
+        return "\n".join(lines)

@@ -21,6 +21,8 @@ import numpy as np
 from ..cpu import gather
 from ..cpu.dictionary import build_dictionary
 from ..cpu.plain import ByteArrayColumn
+from ..errors import CorruptChunkError, CorruptPageError, ScanError
+from ..faults import filter_bytes
 from ..format.compact import CompactReader
 from ..format.metadata import (
     ColumnChunk,
@@ -37,9 +39,11 @@ from ..format.metadata import (
 from ..format.schema import SchemaNode
 from .pages import (
     DecodedPage,
+    crc_verify_default,
     decode_data_page_v1,
     decode_data_page_v2,
     decode_dictionary_page,
+    verify_page_crc,
     write_data_page_v1,
     write_data_page_v2,
     write_dictionary_page,
@@ -65,18 +69,24 @@ class ChunkData:
 
 
 def read_chunk(blob: "bytes | memoryview", cm: ColumnMetaData,
-               node: SchemaNode) -> ChunkData:
+               node: SchemaNode, verify_crc: bool | None = None) -> ChunkData:
     """Decode one column chunk from the file bytes.
 
     Pass a memoryview for zero-copy page payloads (a bytes blob still
-    works but its page slices copy)."""
+    works but its page slices copy).  ``verify_crc`` gates page CRC32
+    verification when headers carry one (None = env default, see
+    :func:`~tpuparquet.io.pages.crc_verify_default`)."""
     codec = CompressionCodec(cm.codec)
+    col_path = ".".join(cm.path_in_schema)
+    if verify_crc is None:
+        verify_crc = crc_verify_default()
     start = cm.data_page_offset
     if cm.dictionary_page_offset is not None:
         start = min(start, cm.dictionary_page_offset)
     end = start + cm.total_compressed_size
     if end > len(blob) or start < 0:
-        raise ValueError("column chunk byte range out of bounds")
+        raise CorruptChunkError("column chunk byte range out of bounds",
+                                column=col_path)
 
     from ..stats import current_stats
 
@@ -84,13 +94,13 @@ def read_chunk(blob: "bytes | memoryview", cm: ColumnMetaData,
     dictionary = None
     pages: list[DecodedPage] = []
     values_read = 0
+    page_i = 0  # walk ordinal (all page types) — the error coordinate
     total = cm.num_values
     st = current_stats()
     # per-page event log (obs/): transport "cpu" marks oracle-path
     # pages; with no collector (or a plain collect_stats()) every
     # emission below is skipped without allocating anything
     ev = None if st is None else st.events
-    col_path = ".".join(cm.path_in_schema) if ev is not None else None
     if st is not None:
         st.chunks += 1
         st.bytes_compressed += cm.total_compressed_size
@@ -98,61 +108,88 @@ def read_chunk(blob: "bytes | memoryview", cm: ColumnMetaData,
         st.values += total
     while values_read < total:
         if r.pos >= end:
-            raise ValueError(
-                f"column chunk exhausted at {values_read}/{total} values"
+            raise CorruptChunkError(
+                f"column chunk exhausted at {values_read}/{total} values",
+                column=col_path,
             )
         ph = decode_struct(PageHeader, r)
         if ph.compressed_page_size is None or ph.compressed_page_size < 0:
-            raise ValueError("page header missing compressed size")
+            raise CorruptPageError("page header missing compressed size",
+                                   column=col_path, page=page_i)
         if r.pos + ph.compressed_page_size > end:
-            raise ValueError("page payload overruns column chunk")
+            raise CorruptPageError("page payload overruns column chunk",
+                                   column=col_path, page=page_i)
         # zero-copy view: the codec layer's own bytes() conversion makes
         # the single owned copy (a bytes() here would copy every
         # compressed page a second time)
         payload = blob[r.pos : r.pos + ph.compressed_page_size]
         if len(payload) != ph.compressed_page_size:
-            raise ValueError("page payload truncated")
+            raise CorruptPageError("page payload truncated",
+                                   column=col_path, page=page_i)
+        payload = filter_bytes("io.chunk.page_payload", payload,
+                               column=col_path, page=page_i)
+        checked = verify_page_crc(ph, payload, enabled=verify_crc,
+                                  column=col_path, page=page_i)
+        if checked and st is not None:
+            st.pages_crc_verified += 1
         r.pos += ph.compressed_page_size
         ptype = PageType(ph.type)
-        if ptype == PageType.DICTIONARY_PAGE:
-            if dictionary is not None:
-                raise ValueError("only one dictionary page allowed per chunk")
-            if pages:
-                raise ValueError("dictionary page must precede data pages")
-            dictionary = decode_dictionary_page(ph, payload, codec, node)
-            # Some writers put the dictionary away from the data pages:
-            # after decoding it, continue at data_page_offset
-            # (chunk_reader.go:243-249).
-            if r.pos != cm.data_page_offset:
-                r.pos = cm.data_page_offset
-        elif ptype in (PageType.DATA_PAGE, PageType.DATA_PAGE_V2):
-            v2 = ptype == PageType.DATA_PAGE_V2
-            t_pg = time.perf_counter() if ev is not None else 0.0
-            pg = (decode_data_page_v2 if v2 else decode_data_page_v1)(
-                ph, payload, codec, node, dictionary)
-            values_read += pg.num_values
-            pages.append(pg)
-            if st is not None:
-                st.pages += 1
-                st.hist("page_comp_bytes").record(ph.compressed_page_size)
-                st.hist("page_uncomp_bytes").record(
-                    ph.uncompressed_page_size)
-                if ev is not None:
-                    h = ph.data_page_header_v2 if v2 \
-                        else ph.data_page_header
-                    ev.page(column=col_path, page=len(pages) - 1,
-                            page_type="v2" if v2 else "v1",
-                            encoding=Encoding(h.encoding).name,
-                            codec=codec.name, num_values=pg.num_values,
-                            non_null=None, transport="cpu",
-                            plan_s=time.perf_counter() - t_pg)
-        elif ptype == PageType.INDEX_PAGE:
-            continue  # skip (reference ignores index pages)
-        else:
-            raise ValueError(f"unexpected page type {ph.type}")
+        try:
+            if ptype == PageType.DICTIONARY_PAGE:
+                if dictionary is not None:
+                    raise CorruptChunkError(
+                        "only one dictionary page allowed per chunk")
+                if pages:
+                    raise CorruptChunkError(
+                        "dictionary page must precede data pages")
+                dictionary = decode_dictionary_page(ph, payload, codec,
+                                                    node)
+                # Some writers put the dictionary away from the data
+                # pages: after decoding it, continue at data_page_offset
+                # (chunk_reader.go:243-249).
+                if r.pos != cm.data_page_offset:
+                    r.pos = cm.data_page_offset
+            elif ptype in (PageType.DATA_PAGE, PageType.DATA_PAGE_V2):
+                v2 = ptype == PageType.DATA_PAGE_V2
+                t_pg = time.perf_counter() if ev is not None else 0.0
+                pg = (decode_data_page_v2 if v2 else decode_data_page_v1)(
+                    ph, payload, codec, node, dictionary)
+                values_read += pg.num_values
+                pages.append(pg)
+                if st is not None:
+                    st.pages += 1
+                    st.hist("page_comp_bytes").record(
+                        ph.compressed_page_size)
+                    st.hist("page_uncomp_bytes").record(
+                        ph.uncompressed_page_size)
+                    if ev is not None:
+                        h = ph.data_page_header_v2 if v2 \
+                            else ph.data_page_header
+                        ev.page(column=col_path, page=len(pages) - 1,
+                                page_type="v2" if v2 else "v1",
+                                encoding=Encoding(h.encoding).name,
+                                codec=codec.name,
+                                num_values=pg.num_values,
+                                non_null=None, transport="cpu",
+                                plan_s=time.perf_counter() - t_pg)
+            elif ptype == PageType.INDEX_PAGE:
+                page_i += 1
+                continue  # skip (reference ignores index pages)
+            else:
+                raise CorruptPageError(f"unexpected page type {ph.type}")
+        except ScanError as e:
+            raise e.annotate(column=col_path, page=page_i)
+        except ValueError as e:
+            # domain errors from the codec layer become taxonomy errors
+            # WITH coordinates; raw crash types still propagate as the
+            # bugs they are (tests/test_fuzz.py's _clean contract)
+            raise CorruptPageError(str(e), column=col_path,
+                                   page=page_i) from e
+        page_i += 1
     if values_read != total:
-        raise ValueError(
-            f"chunk decoded {values_read} values, metadata says {total}"
+        raise CorruptChunkError(
+            f"chunk decoded {values_read} values, metadata says {total}",
+            column=col_path,
         )
 
     rep = np.concatenate([p.rep_levels for p in pages]) if pages else \
@@ -306,7 +343,8 @@ def write_chunk(out, node: SchemaNode, column, rep, dl, *,
                 allow_dict: bool = True,
                 num_rows: int | None = None,
                 kv_metadata: dict | None = None,
-                write_stats: bool = True) -> ColumnChunk:
+                write_stats: bool = True,
+                page_crc: bool = True) -> ColumnChunk:
     """Write one column chunk at the current position of ``out`` (a
     position-tracking binary stream); returns its ColumnChunk metadata."""
     from .values import handler_for
@@ -333,7 +371,8 @@ def write_chunk(out, node: SchemaNode, column, rep, dl, *,
     distinct = None
     if dictionary is not None:
         dict_page_offset = pos0
-        c, u = write_dictionary_page(out, node, dictionary, codec)
+        c, u = write_dictionary_page(out, node, dictionary, codec,
+                                     page_crc=page_crc)
         total_comp += c
         total_uncomp += u
         distinct = len(dictionary) if isinstance(dictionary, ByteArrayColumn) \
@@ -371,12 +410,13 @@ def write_chunk(out, node: SchemaNode, column, rep, dl, *,
             out, node, page_column, rep, dl, codec, encoding,
             num_rows=num_rows if num_rows is not None else n_values,
             null_count=null_count, dictionary_size=dict_size,
-            statistics=stats,
+            statistics=stats, page_crc=page_crc,
         )
     else:
         c, u = write_data_page_v1(
             out, node, page_column, rep, dl, codec, encoding,
             dictionary_size=dict_size, statistics=stats,
+            page_crc=page_crc,
         )
     total_comp += c
     total_uncomp += u

@@ -18,9 +18,13 @@ Decoding returns either a materialized column or dictionary *indices*
 
 from __future__ import annotations
 
+import os
+import zlib
+
 import numpy as np
 
 from ..compress import compress_block, decompress_block
+from ..errors import CorruptPageError
 from ..cpu import (
     decode_byte_stream_split,
     decode_delta_binary_packed,
@@ -66,6 +70,10 @@ __all__ = [
     "write_data_page_v2",
     "write_dictionary_page",
     "SUPPORTED_DATA_ENCODINGS",
+    "page_crc_default",
+    "crc_verify_default",
+    "page_crc32",
+    "verify_page_crc",
 ]
 
 # Value encodings legal per physical type (reader dispatch; mirrors
@@ -86,6 +94,60 @@ SUPPORTED_DATA_ENCODINGS = {
 }
 
 _DICT_ENCODINGS = (Encoding.PLAIN_DICTIONARY, Encoding.RLE_DICTIONARY)
+
+
+# ----------------------------------------------------------------------
+# Page CRC32 (parquet.thrift PageHeader.crc: the standard gzip-polynomial
+# CRC over the page bytes "as they appear in the file" — i.e. everything
+# between the header and the next page: compressed body for V1 and
+# dictionary pages, raw levels + compressed values for V2.  Matches
+# parquet-mr's checksum path and pyarrow's write_page_checksum /
+# page_checksum_verification.)
+# ----------------------------------------------------------------------
+
+def page_crc_default() -> bool:
+    """Write-side gate: emit ``PageHeader.crc``?  Default ON (a few
+    bytes per page buy end-to-end corruption detection); disable with
+    ``TPQ_PAGE_CRC=0`` or per-writer via ``FileWriter(page_crc=...)``."""
+    return os.environ.get("TPQ_PAGE_CRC", "1") != "0"
+
+
+def crc_verify_default() -> bool:
+    """Read-side gate: verify CRCs when a page header carries one?
+    Default ON; disable with ``TPQ_PAGE_CRC_VERIFY=0`` or per-reader
+    via ``FileReader(verify_crc=...)``."""
+    return os.environ.get("TPQ_PAGE_CRC_VERIFY", "1") != "0"
+
+
+def page_crc32(*segments) -> int:
+    """CRC over the page's on-file body segments, as the SIGNED i32 the
+    thrift field stores (crc32 is unsigned; two's-complement fold)."""
+    crc = 0
+    for seg in segments:
+        crc = zlib.crc32(seg, crc)
+    return crc - (1 << 32) if crc >= (1 << 31) else crc
+
+
+def verify_page_crc(header: PageHeader, payload, *, enabled: bool,
+                    column=None, page=None) -> bool:
+    """Check ``payload`` (the page bytes after the header) against
+    ``header.crc``; raises :class:`CorruptPageError` on mismatch.
+    Returns True when a CRC was present and checked (callers count it).
+    No-op when the header has no CRC or verification is disabled."""
+    if header.crc is None or not enabled:
+        return False
+    want = header.crc & 0xFFFFFFFF
+    got = zlib.crc32(payload) & 0xFFFFFFFF
+    if got != want:
+        from ..stats import current_stats
+
+        st = current_stats()
+        if st is not None:
+            st.crc_mismatches += 1
+        raise CorruptPageError(
+            f"page CRC mismatch: header 0x{want:08x}, "
+            f"computed 0x{got:08x}", column=column, page=page)
+    return True
 
 
 class DecodedPage:
@@ -193,13 +255,16 @@ def encode_values(ptype: Type, encoding: Encoding, column,
 
 def decode_data_page_v1(header: PageHeader, payload, codec: CompressionCodec,
                         node, dictionary) -> DecodedPage:
+    from ..faults import filter_bytes
+
     h: DataPageHeader = header.data_page_header
     if h is None:
-        raise ValueError("DATA_PAGE header missing data_page_header")
+        raise CorruptPageError("DATA_PAGE header missing data_page_header")
     raw = decompress_block(codec, payload, header.uncompressed_page_size)
+    raw = filter_bytes("io.pages.page_decode", raw)
     n = h.num_values
     if n is None or n < 0:
-        raise ValueError("DATA_PAGE header missing num_values")
+        raise CorruptPageError("DATA_PAGE header missing num_values")
     pos = 0
     rep, pos = _decode_levels_dispatch_v1(
         raw, n, node.max_rep_level, h.repetition_level_encoding, pos
@@ -232,16 +297,20 @@ def _decode_levels_dispatch_v1(raw, n, max_level, encoding, pos):
 
 def decode_data_page_v2(header: PageHeader, payload, codec: CompressionCodec,
                         node, dictionary) -> DecodedPage:
+    from ..faults import filter_bytes
+
     h: DataPageHeaderV2 = header.data_page_header_v2
     if h is None:
-        raise ValueError("DATA_PAGE_V2 header missing data_page_header_v2")
+        raise CorruptPageError(
+            "DATA_PAGE_V2 header missing data_page_header_v2")
     n = h.num_values
     if n is None or n < 0:
-        raise ValueError("DATA_PAGE_V2 header missing num_values")
+        raise CorruptPageError("DATA_PAGE_V2 header missing num_values")
+    payload = filter_bytes("io.pages.page_decode", payload)
     rl_len = h.repetition_levels_byte_length or 0
     dl_len = h.definition_levels_byte_length or 0
     if rl_len + dl_len > len(payload):
-        raise ValueError("V2 level lengths exceed page size")
+        raise CorruptPageError("V2 level lengths exceed page size")
     rep = decode_levels_raw(payload[:rl_len], n, node.max_rep_level)
     dl = decode_levels_raw(
         payload[rl_len : rl_len + dl_len], n, node.max_def_level
@@ -260,7 +329,7 @@ def decode_data_page_v2(header: PageHeader, payload, codec: CompressionCodec,
     non_null = n - (h.num_nulls or 0)
     check = int((dl == node.max_def_level).sum()) if node.max_def_level else n
     if check != non_null:
-        raise ValueError(
+        raise CorruptPageError(
             f"V2 num_nulls {h.num_nulls} disagrees with def levels "
             f"({n - check} nulls)"
         )
@@ -294,11 +363,11 @@ def decode_dictionary_page(header: PageHeader, payload,
                            codec: CompressionCodec, node):
     h: DictionaryPageHeader = header.dictionary_page_header
     if h is None:
-        raise ValueError("DICTIONARY_PAGE header missing its struct")
+        raise CorruptPageError("DICTIONARY_PAGE header missing its struct")
     if h.encoding not in (Encoding.PLAIN, Encoding.PLAIN_DICTIONARY):
         raise ValueError(f"dictionary page encoding {h.encoding} unsupported")
     if h.num_values is None or h.num_values < 0:
-        raise ValueError("DICTIONARY_PAGE header missing num_values")
+        raise CorruptPageError("DICTIONARY_PAGE header missing num_values")
     raw = decompress_block(codec, payload, header.uncompressed_page_size)
     return decode_plain(
         Type(node.element.type), raw, h.num_values, node.element.type_length
@@ -316,7 +385,8 @@ def _page_header_bytes(ph: PageHeader) -> bytes:
 
 
 def write_data_page_v1(out, node, column, rep, dl, codec, encoding,
-                       dictionary_size=None, statistics=None) -> tuple[int, int]:
+                       dictionary_size=None, statistics=None,
+                       page_crc=True) -> tuple[int, int]:
     """Append a V1 data page; returns (compressed_size, uncompressed_size)
     including the header bytes (ColumnMetaData counts headers —
     ``chunk_writer.go:209-251``)."""
@@ -340,6 +410,7 @@ def write_data_page_v1(out, node, column, rep, dl, codec, encoding,
         type=PageType.DATA_PAGE,
         uncompressed_page_size=len(body),
         compressed_page_size=len(comp),
+        crc=page_crc32(comp) if page_crc else None,
         data_page_header=DataPageHeader(
             num_values=n,
             encoding=enc,
@@ -356,7 +427,7 @@ def write_data_page_v1(out, node, column, rep, dl, codec, encoding,
 
 def write_data_page_v2(out, node, column, rep, dl, codec, encoding,
                        num_rows, null_count, dictionary_size=None,
-                       statistics=None) -> tuple[int, int]:
+                       statistics=None, page_crc=True) -> tuple[int, int]:
     n = len(dl)
     rep_b = encode_levels_v2(rep, node.max_rep_level) if node.max_rep_level \
         else b""
@@ -376,6 +447,9 @@ def write_data_page_v2(out, node, column, rep, dl, codec, encoding,
         type=PageType.DATA_PAGE_V2,
         uncompressed_page_size=len(rep_b) + len(dl_b) + len(values_b),
         compressed_page_size=len(rep_b) + len(dl_b) + len(comp_values),
+        # V2 CRC spans the on-file body: uncompressed level streams +
+        # compressed values (parquet.thrift "as it appears in the file")
+        crc=page_crc32(rep_b, dl_b, comp_values) if page_crc else None,
         data_page_header_v2=DataPageHeaderV2(
             num_values=n,
             num_nulls=null_count,
@@ -398,7 +472,8 @@ def write_data_page_v2(out, node, column, rep, dl, codec, encoding,
     )
 
 
-def write_dictionary_page(out, node, dictionary, codec) -> tuple[int, int]:
+def write_dictionary_page(out, node, dictionary, codec,
+                          page_crc=True) -> tuple[int, int]:
     """PLAIN dictionary page (PLAIN_DICTIONARY is deprecated on write,
     ``page_dict.go:86``)."""
     body = encode_plain(
@@ -411,6 +486,7 @@ def write_dictionary_page(out, node, dictionary, codec) -> tuple[int, int]:
         type=PageType.DICTIONARY_PAGE,
         uncompressed_page_size=len(body),
         compressed_page_size=len(comp),
+        crc=page_crc32(comp) if page_crc else None,
         dictionary_page_header=DictionaryPageHeader(
             num_values=count, encoding=Encoding.PLAIN
         ),

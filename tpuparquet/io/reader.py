@@ -19,6 +19,8 @@ import time
 
 import numpy as np
 
+from ..errors import CorruptChunkError, ScanError
+from ..faults import fault_point, filter_bytes, retry_transient
 from ..format.footer import read_file_metadata
 from ..format.metadata import ColumnMetaData, FileMetaData
 from ..format.schema import Schema
@@ -29,17 +31,27 @@ __all__ = ["FileReader"]
 
 
 class FileReader:
-    """Reads a seekable binary file object (or a path)."""
+    """Reads a seekable binary file object (or a path).
 
-    def __init__(self, source, *columns: str):
+    ``verify_crc`` gates page CRC32 verification for headers that
+    carry one (None = env default ``TPQ_PAGE_CRC_VERIFY``, on).
+    Transient I/O failures on chunk reads are retried with bounded
+    exponential backoff (:func:`tpuparquet.faults.retry_transient`).
+    """
+
+    def __init__(self, source, *columns: str,
+                 verify_crc: bool | None = None):
         import threading
 
         if isinstance(source, (str, bytes)) and not hasattr(source, "read"):
             self._f = open(source, "rb")
             self._owns = True
+            self.name = source if isinstance(source, str) else None
         else:
             self._f = source
             self._owns = False
+            self.name = getattr(source, "name", None)
+        self._verify_crc = verify_crc
         # seek+read pairs must be atomic: the pipelined device reader
         # plans row group N+1 on a worker thread while the caller may
         # still use this reader from the main thread
@@ -118,8 +130,13 @@ class FileReader:
         # allocates) on this path without an event-carrying collector
         ev = None if st is None else st.events
         t0 = time.perf_counter() if ev is not None else 0.0
-        for path, node, cm, blob, start in self.iter_selected_chunks(rg):
-            out[path] = read_chunk(memoryview(blob), _rebase(cm, start), node)
+        try:
+            for path, node, cm, blob, start in self.iter_selected_chunks(rg):
+                out[path] = read_chunk(memoryview(blob),
+                                       _rebase(cm, start), node,
+                                       verify_crc=self._verify_crc)
+        except ScanError as e:
+            raise e.annotate(row_group=rg_index, file=self.name)
         if ev is not None:
             import threading
 
@@ -149,12 +166,27 @@ class FileReader:
                 if (start < 0 or cm.total_compressed_size < 0
                         or start + cm.total_compressed_size
                         > len(self._buf)):
-                    raise ValueError("column chunk overruns file")
+                    raise CorruptChunkError("column chunk overruns file",
+                                            column=path, file=self.name)
+                fault_point("io.reader.chunk_read", column=path)
                 blob = self._buf[start : start + cm.total_compressed_size]
             else:
-                with self._io_lock:
-                    self._f.seek(start)
-                    blob = self._f.read(cm.total_compressed_size)
+                def _read(start=start, size=cm.total_compressed_size):
+                    # the fault point sits INSIDE the retried callable:
+                    # an injected transient fault exercises the same
+                    # backoff loop a flaky filesystem would
+                    fault_point("io.reader.chunk_read", column=path)
+                    with self._io_lock:
+                        self._f.seek(start)
+                        return self._f.read(size)
+
+                blob = retry_transient(_read)
+                if len(blob) < cm.total_compressed_size:
+                    raise CorruptChunkError(
+                        f"column chunk short read: {len(blob)}/"
+                        f"{cm.total_compressed_size} bytes",
+                        column=path, file=self.name)
+            blob = filter_bytes("io.reader.chunk_read", blob, column=path)
             yield path, node, cm, blob, start
 
     def pre_load(self) -> None:

@@ -100,6 +100,7 @@ class FileWriter:
         column_encodings: dict | None = None,
         allow_dict: bool = True,
         write_stats: bool = True,
+        page_crc: bool | None = None,
     ):
         self._f = f
         self._pos = 0
@@ -114,6 +115,14 @@ class FileWriter:
         }
         self.allow_dict = allow_dict
         self.write_stats = write_stats
+        # page CRC32 in every PageHeader (None = env default, on):
+        # readers that care (ours, parquet-mr, pyarrow with
+        # page_checksum_verification) catch torn/corrupt pages exactly
+        if page_crc is None:
+            from .pages import page_crc_default
+
+            page_crc = page_crc_default()
+        self.page_crc = bool(page_crc)
 
         if schema is None:
             self.schema = Schema.empty()
@@ -576,6 +585,7 @@ class FileWriter:
                     num_rows=n_rows,
                     kv_metadata=kv or None,
                     write_stats=self.write_stats,
+                    page_crc=self.page_crc,
                 )
             return buf.getvalue(), cc, ws
 
@@ -643,6 +653,7 @@ class FileWriter:
                     num_rows=n_rows,
                     kv_metadata=kv or None,
                     write_stats=self.write_stats,
+                    page_crc=self.page_crc,
                 )
                 total_bytes += cc.meta_data.total_uncompressed_size
                 total_comp += cc.meta_data.total_compressed_size

@@ -18,14 +18,17 @@ Device coverage — every value encoding the format defines:
 * BYTE_STREAM_SPLIT int32/int64/float/double/FLBA (device transpose)
 * DELTA_LENGTH_BYTE_ARRAY (host length scan, zero-copy payload staging)
 * DELTA_BYTE_ARRAY (front coding = the snappy kernel's copy graph;
-  non-expanding pages assemble on host — the only remaining host path,
-  chosen per page because it ships FEWER bytes, not for lack of a
-  kernel)
+  non-expanding pages assemble on host, chosen per page because it
+  ships FEWER bytes, not for lack of a kernel — the golden exception
+  list ``HOST_ASSEMBLY_EXCEPTIONS`` in ``tests/test_fallback_matrix.py``
+  pins exactly which (type, encoding) combinations may do this)
 """
 
 from __future__ import annotations
 
+import contextlib
 import os
+import threading
 import time
 
 import numpy as np
@@ -35,6 +38,9 @@ import jax.numpy as jnp
 
 from ..compress import decompress_block, decompress_block_into
 from ..cpu import decode_plain
+from ..errors import CorruptChunkError, CorruptPageError, \
+    DeviceDispatchError, ScanError
+from ..faults import backoff_delays, fault_point, filter_bytes
 from ..native import plane_native
 from .arena import HostArena, discard_thread_arena, thread_arena
 from ..cpu.plain import ByteArrayColumn
@@ -65,7 +71,39 @@ from .decode import (
 )
 
 __all__ = ["DeviceColumn", "decode_chunk_device", "read_row_group_device",
-           "read_row_groups_device"]
+           "read_row_groups_device", "read_row_group_device_resilient",
+           "cpu_fallback_values"]
+
+
+# ----------------------------------------------------------------------
+# Graceful degradation: forced-host value decode.
+#
+# When device dispatch fails (simulated via the fault harness, or a
+# real accelerator error surfacing as DeviceDispatchError /
+# RuntimeError), the resilient read path re-plans the unit under this
+# thread-local flag: every page's VALUES decode on the bit-exact CPU
+# oracle and only finished buffers cross to the device — no device
+# decode kernels, no wire transports.  Pages planned this way report
+# transport "host-degraded" and count DecodeStats.pages_degraded.
+# ----------------------------------------------------------------------
+
+_degrade_tls = threading.local()
+
+
+def _host_values_only() -> bool:
+    return getattr(_degrade_tls, "host_only", False)
+
+
+@contextlib.contextmanager
+def cpu_fallback_values():
+    """Scope (this thread) forcing every page's values onto the CPU
+    oracle decode — the device→host graceful-degradation mode."""
+    prev = getattr(_degrade_tls, "host_only", False)
+    _degrade_tls.host_only = True
+    try:
+        yield
+    finally:
+        _degrade_tls.host_only = prev
 
 _LANES = {
     Type.INT32: 1, Type.FLOAT: 1, Type.INT64: 2, Type.DOUBLE: 2,
@@ -99,6 +137,8 @@ _CHOSEN_TRANSPORT = {"planes": "planes", "delta": "delta-lanes",
 # zero-copy host view, which is strictly cheaper.
 def _DEVICE_SNAPPY() -> bool:
     """Read per plan (not import) so same-process A/B runs can flip it."""
+    if _host_values_only():
+        return False
     return os.environ.get("TPQ_DEVICE_SNAPPY", "1") != "0"
 
 # Byte-plane RLE wire transport for PLAIN fixed-width segments (any
@@ -106,6 +146,8 @@ def _DEVICE_SNAPPY() -> bool:
 # nearly constant and ship as runs.  Gated per page by measured wire
 # size — pages whose planes are all random ship raw as before.
 def _DEVICE_DELTA_LANES() -> bool:
+    if _host_values_only():
+        return False
     return os.environ.get("TPQ_DEVICE_DELTA", "1") != "0"
 
 
@@ -242,6 +284,8 @@ def _plan_delta_lane_words(seg, count: int, ptype: Type):
 
 
 def _DEVICE_PLANES() -> bool:
+    if _host_values_only():
+        return False
     return os.environ.get("TPQ_DEVICE_PLANES", "1") != "0"
 
 
@@ -1049,18 +1093,24 @@ def decode_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
 
 def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                       base: int, stager: _Stager,
-                      arena: HostArena | None = None):
+                      arena: HostArena | None = None,
+                      verify_crc: bool | None = None):
     """Phase 1 (host): page-header walk, block decompression, run-table
     scans, staging-plan registration.  Returns ``finish(staged)`` which
     issues the fused device dispatches and assembles the DeviceColumn.
 
     ``blob`` holds the chunk's byte range; offsets in ``cm`` are absolute
-    minus ``base``.
+    minus ``base``.  ``verify_crc`` gates page CRC32 verification when
+    headers carry one (None = env default) — same semantics as the CPU
+    path in ``io/chunk.py``.
     """
+    from ..io.pages import crc_verify_default, verify_page_crc
     from ..stats import current_stats
 
     if arena is None:
         arena = HostArena()  # throwaway: no recycling, plain lifetime
+    if verify_crc is None:
+        verify_crc = crc_verify_default()
     codec = CompressionCodec(cm.codec)
     ptype = Type(node.element.type)
     _st = current_stats()
@@ -1069,8 +1119,10 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
     # all gate on `_ev is not None`, so a plain collector (or none)
     # pays nothing per page
     _ev = None if _st is None else _st.events
-    _col_path = ".".join(cm.path_in_schema) if _ev is not None else None
+    _col_path = ".".join(cm.path_in_schema)
+    _degraded = _host_values_only()
     _page_i = 0
+    _walk_i = 0  # all-page ordinal (dict pages included): error coords
     if _st is not None:
         _st.chunks += 1
         _st.bytes_compressed += cm.total_compressed_size
@@ -1088,6 +1140,7 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
     dict_data_h = None
     dict_lens_np = None
     dict_len = 0
+    dict_host = None       # host copy, kept only for the degraded path
 
     # Deferred device work: each op is a closure (staged, parts) -> None
     # appended during the host walk and executed by finish() after the
@@ -1102,28 +1155,48 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
 
     while values_read < total:
         if r.pos >= end:
-            raise ValueError(
-                f"column chunk exhausted at {values_read}/{total} values"
+            raise CorruptChunkError(
+                f"column chunk exhausted at {values_read}/{total} values",
+                column=_col_path,
             )
         _t_pg = time.perf_counter() if _ev is not None else 0.0
         ph = decode_struct(PageHeader, r)
         # same malformed-header checks as the CPU path (io/chunk.py,
         # io/pages.py) — thrift-optional fields may arrive as None
         if ph.compressed_page_size is None or ph.compressed_page_size < 0:
-            raise ValueError("page header missing compressed size")
+            raise CorruptPageError("page header missing compressed size",
+                                   column=_col_path, page=_walk_i)
         if ph.uncompressed_page_size is None or ph.uncompressed_page_size < 0:
-            raise ValueError("page header missing uncompressed size")
+            raise CorruptPageError("page header missing uncompressed size",
+                                   column=_col_path, page=_walk_i)
         if r.pos + ph.compressed_page_size > end:
-            raise ValueError("page payload overruns column chunk")
+            raise CorruptPageError("page payload overruns column chunk",
+                                   column=_col_path, page=_walk_i)
         # zero-copy view of the compressed bytes (the decompressors take
         # any buffer; a bytes() here would copy every page)
         payload = np.frombuffer(
-            blob[r.pos : r.pos + ph.compressed_page_size], dtype=np.uint8
+            filter_bytes("kernels.device.page_payload",
+                         blob[r.pos : r.pos + ph.compressed_page_size],
+                         column=_col_path, page=_walk_i),
+            dtype=np.uint8,
         )
         if payload.size != ph.compressed_page_size:
-            raise ValueError("page payload truncated")
+            raise CorruptPageError("page payload truncated",
+                                   column=_col_path, page=_walk_i)
+        if verify_page_crc(ph, payload, enabled=verify_crc,
+                           column=_col_path, page=_walk_i):
+            if _st is not None:
+                _st.pages_crc_verified += 1
         r.pos += ph.compressed_page_size
+        _walk_i += 1
         ptype_page = PageType(ph.type)
+        if ptype_page in (PageType.DATA_PAGE, PageType.DATA_PAGE_V2) \
+                and not _degraded:
+            # simulated device failures land here (harness site); the
+            # degraded re-plan skips it — the CPU decode it models
+            # doesn't touch the device kernels
+            fault_point("kernels.device.page_dispatch",
+                        column=_col_path, page=_page_i)
 
         if ptype_page == PageType.DICTIONARY_PAGE:
             dph = ph.dictionary_page_header
@@ -1137,6 +1210,12 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 ptype, raw, dph.num_values,
                 node.element.type_length,
             )
+            if _degraded:
+                # the host gather below needs the dictionary ON HOST;
+                # own the bytes — `raw` is an arena view that recycles
+                dict_host = (dict_np if isinstance(dict_np,
+                                                   ByteArrayColumn)
+                             else np.array(dict_np, copy=True))
             if isinstance(dict_np, ByteArrayColumn):
                 dict_offsets_h = stager.add(
                     dict_np.offsets.astype(np.int32))
@@ -1460,7 +1539,64 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
 
                 ops.append(op)
 
-        if enc in _DICT_ENCODINGS:
+        if _degraded:
+            # Graceful degradation (cpu_fallback_values): this page's
+            # VALUES decode on the bit-exact CPU oracle — the exact
+            # code path `read_row_group_arrays` runs — and only the
+            # finished buffers stage to the device.  No decode kernels,
+            # no wire transports; level expansion still rides the
+            # shared machinery above.
+            _tr = "host-degraded"
+            _wire_ev = _raw_ev = _gate = None
+            _reason = "device dispatch degraded: CPU oracle decode"
+            _def_standalone()
+            if _st is not None:
+                _st.pages_degraded += 1
+            if enc in _DICT_ENCODINGS:
+                from ..cpu import decode_dict_indices, gather
+
+                if dict_host is None:
+                    raise CorruptChunkError(
+                        "dictionary-encoded page but no dictionary "
+                        "page seen", column=_col_path)
+                # bytes(): the oracle decoder indexes scalars out of
+                # its input, and numpy-u8 scalars overflow its width
+                # arithmetic
+                idx = decode_dict_indices(bytes(memoryview(values_seg)),
+                                          non_null)
+                if idx.size and int(idx.max()) >= dict_len:
+                    raise CorruptPageError(
+                        f"dictionary index {int(idx.max())} out of "
+                        f"range (dictionary has {dict_len})",
+                        column=_col_path, page=_page_i)
+                col = gather(dict_host, idx)
+            else:
+                col = decode_values_cpu(ptype, enc, values_seg,
+                                        non_null,
+                                        node.element.type_length)
+            # own the bytes: the oracle decoders return VIEWS of the
+            # arena-backed page buffer, and on the CPU backend staging
+            # can be zero-copy — a recycled slab would silently rewrite
+            # this column under a later unit's decode
+            if isinstance(col, ByteArrayColumn):
+                col = ByteArrayColumn(np.array(col.offsets, copy=True),
+                                      np.array(col.data, copy=True))
+            else:
+                col = np.array(col, copy=True)
+            if isinstance(col, ByteArrayColumn):
+                dh = stager.add(col.data)
+                ops.append(
+                    lambda s, p, _dh=dh,
+                    _o=col.offsets.astype(np.int32),
+                    _nb=int(col.data.size):
+                    p["bytes"].append((_o, s[_dh], _nb))
+                )
+            else:
+                ops.append(
+                    lambda s, p, _c=col, _nn=non_null:
+                    p["val"].append((_stage_numpy_fixed(_c, ptype), _nn))
+                )
+        elif enc in _DICT_ENCODINGS:
             _tr = "dict"
             width = int(values_seg[0]) if len(values_seg) else 0
             if dict_fixed_h is not None:
@@ -1908,6 +2044,13 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 _st.pages_host_values += 1
             col = decode_values_cpu(ptype, enc, values_seg, non_null,
                                     node.element.type_length)
+            # own the bytes (see the degraded branch above): the
+            # decoders may return views of the recyclable arena slab
+            if isinstance(col, ByteArrayColumn):
+                col = ByteArrayColumn(np.array(col.offsets, copy=True),
+                                      np.array(col.data, copy=True))
+            elif isinstance(col, np.ndarray):
+                col = np.array(col, copy=True)
             if isinstance(col, ByteArrayColumn):
                 dh = stager.add(col.data)
                 ops.append(
@@ -2057,11 +2200,86 @@ def read_row_group_device(reader, rg_index: int) -> dict[str, DeviceColumn]:
         st = _Stager()
         planned = _plan_row_group(reader, rg, st, arena)
         out = _finish_row_group(planned, st)
+    except ScanError as e:
+        discard_thread_arena()
+        raise e.annotate(row_group=rg_index)
     except BaseException:
         discard_thread_arena()  # in-flight transfers may read the slabs
         raise
     arena.release_all()
     return out
+
+
+def read_row_group_device_resilient(reader, rg_index: int,
+                                    retries: int | None = None,
+                                    sleep=time.sleep):
+    """:func:`read_row_group_device` with the device-failure policy:
+    retry device dispatch with bounded exponential backoff, then
+    degrade to the bit-exact CPU decode (:func:`cpu_fallback_values`)
+    for this unit.  Corruption errors propagate unchanged — they are
+    permanent and belong to the quarantine layer, not retry.
+
+    Counts ``DecodeStats.dispatch_retries`` per retry and
+    ``units_degraded`` when the CPU fallback engages; the fallback is
+    also recorded as an obs fault event.  The retry schedule shares
+    the transient-I/O knobs (``TPQ_IO_RETRIES`` etc.).
+
+    Counter exactness: each attempt runs under a scratch collector
+    that merges into the caller's only on SUCCESS — a unit that
+    retried N times still counts its pages/values/bytes exactly once
+    and leaves no phantom page events from aborted attempts.  Failed
+    attempts contribute only their fault-layer observability
+    (``faults_injected``/``crc_mismatches``/``io_retries`` and fault
+    events)."""
+    from ..stats import current_stats, worker_stats
+
+    _FAULT_FIELDS = ("faults_injected", "crc_mismatches", "io_retries")
+
+    def attempt_once():
+        st = current_stats()
+        if st is None:
+            return read_row_group_device(reader, rg_index)
+        with worker_stats(like=st) as ws:
+            try:
+                out = read_row_group_device(reader, rg_index)
+            except BaseException:
+                for f in _FAULT_FIELDS:
+                    setattr(st, f, getattr(st, f) + getattr(ws, f))
+                if st.events is not None and ws.events is not None:
+                    st.events.faults.extend(ws.events.faults)
+                raise
+        st.merge_from(ws)
+        return out
+
+    last = None
+    delays = backoff_delays(retries)
+    for attempt in range(len(delays) + 1):
+        try:
+            return attempt_once()
+        except DeviceDispatchError as e:
+            last = e
+        except RuntimeError as e:
+            # a real accelerator failure surfaces as a JAX/XLA
+            # RuntimeError; treat it exactly like a dispatch fault
+            if isinstance(e, (NotImplementedError, RecursionError)):
+                raise
+            last = e
+        if attempt < len(delays):
+            st = current_stats()
+            if st is not None:
+                st.dispatch_retries += 1
+            sleep(delays[attempt])
+    # retries exhausted: degrade this unit to the CPU oracle decode
+    st = current_stats()
+    if st is not None:
+        st.units_degraded += 1
+        if st.events is not None:
+            st.events.fault(
+                site="kernels.device.unit_dispatch",
+                kind="degraded-to-host", row_group=rg_index,
+                error=type(last).__name__, message=str(last))
+    with cpu_fallback_values():
+        return attempt_once()
 
 
 def _plan_row_group(reader, rg, stager: _Stager, arena: HostArena):
@@ -2070,12 +2288,24 @@ def _plan_row_group(reader, rg, stager: _Stager, arena: HostArena):
 
     t0 = time.perf_counter()
     planned = []
+    verify_crc = getattr(reader, "_verify_crc", None)
     for path, node, cm, blob, start in reader.iter_selected_chunks(rg):
-        planned.append(
-            (path,
-             plan_chunk_device(memoryview(blob), cm, node, start, stager,
-                               arena))
-        )
+        try:
+            planned.append(
+                (path,
+                 plan_chunk_device(memoryview(blob), cm, node, start,
+                                   stager, arena, verify_crc=verify_crc))
+            )
+        except ScanError as e:
+            raise e.annotate(column=path, file=getattr(reader, "name",
+                                                       None))
+        except ValueError as e:
+            # codec-layer domain errors become taxonomy errors with
+            # coordinates; raw crash types propagate as the bugs they
+            # are (the crash-corpus clean-failure contract)
+            raise CorruptChunkError(
+                str(e), column=path,
+                file=getattr(reader, "name", None)) from e
     _cs = current_stats()
     if _cs is not None:
         t1 = time.perf_counter()
@@ -2092,6 +2322,11 @@ def _plan_row_group(reader, rg, stager: _Stager, arena: HostArena):
 def _finish_row_group(planned, st: _Stager):
     from ..stats import current_stats
 
+    if not _host_values_only():
+        # unit-level simulated device failure (harness site); skipped
+        # on the degraded re-plan, whose remaining device work is bare
+        # buffer staging
+        fault_point("kernels.device.unit_dispatch")
     t0 = time.perf_counter()
     staged = st.put()
     t1 = time.perf_counter()

@@ -40,6 +40,11 @@ TRANSPORT_COUNTER = {
     "planes": "pages_device_planes",
     "delta-lanes": "pages_device_delta_lanes",
     "host": "pages_host_values",
+    # graceful degradation (kernels/device.py cpu_fallback_values):
+    # pages decoded by the CPU oracle because device dispatch failed —
+    # deliberately NOT "host", so the fallback-matrix golden set stays
+    # about routing decisions, not fault handling
+    "host-degraded": "pages_degraded",
 }
 
 
@@ -95,11 +100,16 @@ class PageEvent:
 class EventLog:
     """In-process, queryable event store with a JSON-lines surface."""
 
-    __slots__ = ("pages", "spans", "t0")
+    __slots__ = ("pages", "spans", "faults", "t0")
 
     def __init__(self, t0: float | None = None):
         self.pages: list[PageEvent] = []
         self.spans: list[dict] = []
+        # fault-tolerance records: injected faults, retries, CRC
+        # rejections, degradations, quarantines — whatever the
+        # resilience layer wants on the timeline (tpuparquet/faults.py
+        # and the resilient read/scan paths emit these)
+        self.faults: list[dict] = []
         self.t0 = time.perf_counter() if t0 is None else t0
 
     # -- recording (single-thread per log; see module docstring) ---------
@@ -118,9 +128,16 @@ class EventLog:
             "tid": tid, "args": args,
         })
 
+    def fault(self, **kw) -> None:
+        """One fault-layer record (site/kind plus whatever coordinates
+        the site knew); timestamped like pages."""
+        kw.setdefault("t", time.perf_counter() - self.t0)
+        self.faults.append(kw)
+
     def merge_from(self, other: "EventLog") -> None:
         self.pages.extend(other.pages)
         self.spans.extend(other.spans)
+        self.faults.extend(other.faults)
 
     # -- queries ---------------------------------------------------------
 
@@ -145,8 +162,9 @@ class EventLog:
     # -- serialization ---------------------------------------------------
 
     def to_jsonl(self) -> str:
-        """JSON-lines: one object per record, pages then spans, each
-        tagged with ``"kind"`` — greppable, streamable, diffable."""
+        """JSON-lines: one object per record, pages then spans then
+        faults, each tagged with ``"kind"`` — greppable, streamable,
+        diffable."""
         lines = []
         for e in self.pages:
             d = e.as_dict()
@@ -156,6 +174,10 @@ class EventLog:
             d = dict(s)
             d["kind"] = "span"
             lines.append(json.dumps(d, sort_keys=True))
+        for fv in self.faults:
+            d = dict(fv)
+            d["kind"] = "fault"
+            lines.append(json.dumps(d, sort_keys=True, default=str))
         return "\n".join(lines) + ("\n" if lines else "")
 
     def write_jsonl(self, path_or_file) -> None:

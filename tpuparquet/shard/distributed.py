@@ -137,13 +137,26 @@ class MultiHostScan:
     units on its own mesh.  ``run`` returns this process's decoded
     units; ``counts_allgather`` exchanges per-unit row counts so every
     process knows the global shape (the usual precursor to a global
-    reshard)."""
+    reshard).
 
-    def __init__(self, sources, *columns: str, mesh=None, resume=None):
+    ``on_error="quarantine"`` isolates failing units per host instead
+    of aborting the fleet (coordinates + error class in
+    :attr:`quarantine`, same semantics as
+    :class:`~tpuparquet.shard.scan.ShardedScan`);
+    :meth:`allgather_quarantine` folds every host's report into the
+    fleet-wide list."""
+
+    def __init__(self, sources, *columns: str, mesh=None, resume=None,
+                 on_error: str = "raise", retries: int | None = None):
+        from ..faults import QuarantineReport
         from ..io.reader import FileReader
         from .mesh import make_mesh
         from .scan import scan_units
 
+        if on_error not in ("raise", "quarantine"):
+            raise ValueError(
+                f"on_error must be 'raise' or 'quarantine', "
+                f"not {on_error!r}")
         self.readers = [FileReader(s, *columns) for s in sources]
         self.global_units = scan_units(self.readers)
         self.local_units = process_units(self.global_units)
@@ -151,11 +164,15 @@ class MultiHostScan:
         # 2-process integration test caught the global-devices variant)
         self.mesh = mesh if mesh is not None else make_mesh()
         self.devices = list(self.mesh.devices.flat)
+        self.on_error = on_error
+        self.retries = retries
+        self.quarantine = QuarantineReport()
         self._next_local = 0
         if resume is not None:
             self._load_cursor(resume)
 
     def _load_cursor(self, cursor: dict) -> None:
+        from ..faults import QuarantineReport
         from .scan import cursor_load
 
         # process grid coordinates are identity: a cursor restored on
@@ -167,40 +184,76 @@ class MultiHostScan:
             process_count=jax.process_count(),
             process_index=jax.process_index(),
         )
+        self.quarantine = QuarantineReport.from_dicts(
+            cursor.get("quarantine"))
 
     def state(self) -> dict:
         """JSON-serializable per-process cursor (resume with
         ``MultiHostScan(sources, ..., resume=state)`` on the SAME
         process of the SAME grid).  Valid between :meth:`run_iter`
-        steps."""
+        steps; carries this host's quarantine report."""
         from .scan import cursor_state
 
         return cursor_state(
             self.global_units, "next_local_unit", self._next_local,
             process_count=jax.process_count(),
             process_index=jax.process_index(),
+            quarantine=self.quarantine.as_dicts(),
         )
 
     def run_iter(self):
         """Yield ``(local_index, {path: DeviceColumn})`` from the cursor
-        position, advancing it after each unit."""
+        position, advancing it after each unit.  Quarantine mode skips
+        (and records) failing units, like ``ShardedScan.run_iter``."""
         from .scan import pipelined_unit_scan
 
-        for k, out in pipelined_unit_scan(
+        if self.on_error == "raise":
+            for k, out in pipelined_unit_scan(
+                self.readers, self.local_units,
+                lambda i: self.devices[i % len(self.devices)],
+                start=self._next_local,
+            ):
+                self._next_local = k + 1
+                yield k, out
+            return
+        from .scan import resilient_unit_scan
+
+        for k, out in resilient_unit_scan(
             self.readers, self.local_units,
             lambda i: self.devices[i % len(self.devices)],
-            start=self._next_local,
+            start=self._next_local, retries=self.retries,
+            quarantine=self.quarantine,
+            entry_extra={"process_index": jax.process_index()},
         ):
             self._next_local = k + 1
-            yield k, out
+            if out is not None:
+                yield k, out
+
+    def allgather_quarantine(self) -> list[dict]:
+        """Every host's quarantine entries, identical on every process
+        (JSON over :func:`allgather_bytes`, like the stats fold)."""
+        import json
+
+        payloads = allgather_bytes(
+            json.dumps(self.quarantine.as_dicts()).encode())
+        out: list[dict] = []
+        for p in payloads:
+            out.extend(json.loads(p))
+        return out
 
     def run(self) -> list[dict]:
         """Decode ALL of this process's units (position i of the result
         is local unit i; always a full scan — resume via run_iter).
 
         Host planning of unit N+1 overlaps device transfer of unit N
-        (same pipeline as :class:`~tpuparquet.shard.scan.ShardedScan`)."""
+        (same pipeline as :class:`~tpuparquet.shard.scan.ShardedScan`).
+        In quarantine mode the result holds only the units that
+        decoded; :attr:`quarantine` names the rest."""
+        from ..faults import QuarantineReport
+
         self._next_local = 0
+        if self.on_error == "quarantine":
+            self.quarantine = QuarantineReport()
         return [out for _, out in self.run_iter()]
 
     def run_with_stats(self, events: bool = False):

@@ -15,12 +15,18 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..errors import QUARANTINE_ERRORS, never_quarantine
+from ..faults import QuarantineReport
 from ..io.reader import FileReader
 from ..kernels.decode import scatter_to_dense
-from ..kernels.device import DeviceColumn, read_row_group_device
+from ..kernels.device import (
+    DeviceColumn,
+    read_row_group_device,
+    read_row_group_device_resilient,
+)
 
 __all__ = ["ShardedScan", "scan_units", "pipelined_unit_scan",
-           "gather_column", "gather_byte_column"]
+           "resilient_unit_scan", "gather_column", "gather_byte_column"]
 
 
 def scan_units(readers: list[FileReader]) -> list[tuple[int, int]]:
@@ -76,6 +82,43 @@ def pipelined_unit_scan(readers, units, device_for=None, start: int = 0):
     yield from pipelined_reads(readers, units, device_for, start)
 
 
+def resilient_unit_scan(readers, units, device_for, *, start: int = 0,
+                        retries=None, quarantine: QuarantineReport,
+                        entry_extra: dict | None = None):
+    """The quarantine-mode unit loop shared by :class:`ShardedScan`
+    and :class:`MultiHostScan`: decode each unit with the full
+    resilience policy (transient-I/O retry, dispatch retry, CPU
+    degradation); absorb clean failures into ``quarantine`` (entries
+    get ``entry_extra`` merged in) and yield ``(k, None)`` for them so
+    callers can advance their cursor uniformly; yield ``(k, out)`` for
+    survivors.  Raw crash types propagate — quarantine never papers
+    over a bug."""
+    from ..stats import current_stats
+
+    for k in range(start, len(units)):
+        fi, rgi = units[k]
+        try:
+            with jax.default_device(device_for(k)):
+                out = read_row_group_device_resilient(
+                    readers[fi], rgi, retries=retries)
+        except QUARANTINE_ERRORS as e:
+            if never_quarantine(e):
+                raise
+            entry = quarantine.add(unit=k, file=fi, row_group=rgi,
+                                   error=e)
+            if entry_extra:
+                entry.update(entry_extra)
+            st = current_stats()
+            if st is not None:
+                st.units_quarantined += 1
+                if st.events is not None:
+                    st.events.fault(site="shard.scan.unit",
+                                    kind="quarantined", **entry)
+            yield k, None
+            continue
+        yield k, out
+
+
 class ShardedScan:
     """Decode many files' row groups data-parallel across a mesh.
 
@@ -90,15 +133,39 @@ class ShardedScan:
     :meth:`run_iter` steps; pass it back as ``resume=`` to continue from
     the first undecoded unit in a fresh process.  The cursor is plain
     JSON-serializable data.
+
+    Fault tolerance (``on_error``):
+
+    * ``"raise"`` (default) — first failure aborts the scan, exactly
+      the seed behavior, on the fully pipelined path.
+    * ``"quarantine"`` — each unit decodes independently (transient
+      I/O retried with backoff, device dispatch retried then degraded
+      to the bit-exact CPU decode); a unit that still fails is
+      isolated into :attr:`quarantine` (a
+      :class:`~tpuparquet.faults.QuarantineReport` with exact
+      file/row-group/column/page coordinates and the error class) and
+      the scan continues.  Decoded units are bit-exact or absent —
+      never wrong.  The cursor advances past quarantined units and
+      carries the report, so a resumed scan neither re-decodes nor
+      forgets them.  This mode trades the plan/transfer pipeline
+      overlap for isolation (units decode one at a time).
     """
 
-    def __init__(self, sources, *columns: str, mesh=None, resume=None):
+    def __init__(self, sources, *columns: str, mesh=None, resume=None,
+                 on_error: str = "raise", retries: int | None = None):
         from .mesh import make_mesh
 
+        if on_error not in ("raise", "quarantine"):
+            raise ValueError(
+                f"on_error must be 'raise' or 'quarantine', "
+                f"not {on_error!r}")
         self.mesh = mesh if mesh is not None else make_mesh()
         self.readers = [FileReader(s, *columns) for s in sources]
         self.units = scan_units(self.readers)
         self.devices = list(self.mesh.devices.flat)
+        self.on_error = on_error
+        self.retries = retries
+        self.quarantine = QuarantineReport()
         self._next_unit = 0
         if resume is not None:
             self._load_cursor(resume)
@@ -106,26 +173,42 @@ class ShardedScan:
     def _load_cursor(self, cursor: dict) -> None:
         self._next_unit = cursor_load(cursor, self.units, "next_unit",
                                       len(self.units))
+        self.quarantine = QuarantineReport.from_dicts(
+            cursor.get("quarantine"))
 
     def state(self) -> dict:
         """JSON-serializable cursor: resume with
         ``ShardedScan(sources, ..., resume=state)``.  Valid between
         :meth:`run_iter` steps; decoding restarts at the first unit not
-        yet yielded."""
-        return cursor_state(self.units, "next_unit", self._next_unit)
+        yet yielded.  Quarantined units ride along (coordinates +
+        error class), so a resumed scan's report stays complete."""
+        return cursor_state(self.units, "next_unit", self._next_unit,
+                            quarantine=self.quarantine.as_dicts())
 
     def device_for(self, unit_index: int):
         return self.devices[unit_index % len(self.devices)]
 
     def run_iter(self):
         """Yield ``(unit_index, {path: DeviceColumn})`` from the cursor
-        position, advancing it after each unit."""
-        for k, out in pipelined_unit_scan(
+        position, advancing it after each unit.  In quarantine mode,
+        failed units are skipped (recorded in :attr:`quarantine`), so
+        the yielded unit indices identify exactly what decoded."""
+        if self.on_error == "raise":
+            for k, out in pipelined_unit_scan(
+                self.readers, self.units, self.device_for,
+                start=self._next_unit,
+            ):
+                self._next_unit = k + 1
+                yield k, out
+            return
+        for k, out in resilient_unit_scan(
             self.readers, self.units, self.device_for,
-            start=self._next_unit,
+            start=self._next_unit, retries=self.retries,
+            quarantine=self.quarantine,
         ):
             self._next_unit = k + 1
-            yield k, out
+            if out is not None:
+                yield k, out
 
     def run(self) -> list[dict[str, DeviceColumn]]:
         """Decode ALL units (position i of the result is unit i).
@@ -135,8 +218,15 @@ class ShardedScan:
         silently stop matching unit indices (``gather_column`` et al.
         index results positionally).  To continue a partial scan from a
         cursor, use :meth:`run_iter`, which labels each result with its
-        unit index."""
+        unit index.
+
+        In quarantine mode the list holds only the units that decoded
+        (fewer, never wrong); :attr:`quarantine` names the missing ones
+        by exact coordinates, and :meth:`run_iter` labels survivors
+        with their true unit indices for positional consumers."""
         self._next_unit = 0
+        if self.on_error == "quarantine":
+            self.quarantine = QuarantineReport()
         return [out for _, out in self.run_iter()]
 
     def run_with_stats(self, events: bool = False):

@@ -83,6 +83,27 @@ class DecodeStats:
     # a stale .so forcing the numpy bp-stats fallback): nonzero means
     # perf has quietly regressed with no functional symptom
     native_fallbacks: int = 0
+    # -- fault-tolerance observables (tpuparquet/faults.py, errors.py) --
+    # pages whose header carried a CRC that was checked and matched;
+    # mismatches raise CorruptPageError AND count, so a fleet report
+    # can say "N pages verified, M rejected"
+    pages_crc_verified: int = 0
+    crc_mismatches: int = 0
+    # injected faults delivered by the harness (tests/chaos drills only;
+    # nonzero in production means an injector leaked)
+    faults_injected: int = 0
+    # transient-I/O retry attempts (faults.retry_transient) and
+    # device-dispatch retry attempts (read_row_group_device_resilient)
+    io_retries: int = 0
+    dispatch_retries: int = 0
+    # graceful degradation: pages planned under the forced-host decode
+    # (transport "host-degraded") and whole units that fell back to the
+    # bit-exact CPU decode after device dispatch kept failing
+    pages_degraded: int = 0
+    units_degraded: int = 0
+    # scan units isolated by on_error="quarantine" (coordinates live in
+    # the scan's QuarantineReport; this is the fleet-foldable total)
+    units_quarantined: int = 0
     # where the device-path wall went, accumulated per unit: host plan
     # phase (page walk, decompression, run-table scans — overlapped with
     # transfer by the pipelined reader, so plan_s can exceed the e2e
@@ -110,7 +131,10 @@ class DecodeStats:
         "pages_device_planes", "pages_device_delta_lanes",
         "pages_device_encoded", "pages_host_values", "values",
         "bytes_compressed", "bytes_uncompressed", "bytes_staged",
-        "native_fallbacks", "plan_s", "transfer_s", "dispatch_s",
+        "native_fallbacks", "pages_crc_verified", "crc_mismatches",
+        "faults_injected", "io_retries", "dispatch_retries",
+        "pages_degraded", "units_degraded", "units_quarantined",
+        "plan_s", "transfer_s", "dispatch_s",
     )
 
     def merge_from(self, other: "DecodeStats") -> None:
@@ -159,6 +183,14 @@ class DecodeStats:
             "bytes_uncompressed": self.bytes_uncompressed,
             "bytes_staged": self.bytes_staged,
             "native_fallbacks": self.native_fallbacks,
+            "pages_crc_verified": self.pages_crc_verified,
+            "crc_mismatches": self.crc_mismatches,
+            "faults_injected": self.faults_injected,
+            "io_retries": self.io_retries,
+            "dispatch_retries": self.dispatch_retries,
+            "pages_degraded": self.pages_degraded,
+            "units_degraded": self.units_degraded,
+            "units_quarantined": self.units_quarantined,
             "plan_s": round(self.plan_s, 6),
             "transfer_s": round(self.transfer_s, 6),
             "dispatch_s": round(self.dispatch_s, 6),
@@ -182,6 +214,18 @@ class DecodeStats:
                if d["transfer_s"] else "")
             + (f"; {d['native_fallbacks']} native fallbacks (stale .so?)"
                if d["native_fallbacks"] else "")
+            + (f"; crc verified {d['pages_crc_verified']} pages"
+               if d["pages_crc_verified"] else "")
+            + (f"; FAULTS: {d['crc_mismatches']} crc mismatches, "
+               f"{d['faults_injected']} injected, "
+               f"{d['io_retries']} io retries, "
+               f"{d['dispatch_retries']} dispatch retries, "
+               f"{d['pages_degraded']}p/{d['units_degraded']}u degraded "
+               f"to host, {d['units_quarantined']} quarantined"
+               if (d["crc_mismatches"] or d["faults_injected"]
+                   or d["io_retries"] or d["dispatch_retries"]
+                   or d["pages_degraded"] or d["units_degraded"]
+                   or d["units_quarantined"]) else "")
         )
 
     def histograms_dict(self) -> dict:
