@@ -3,8 +3,12 @@ known wire-format vectors from the Parquet spec, and hypothesis fuzz."""
 
 import numpy as np
 import pytest
-from hypothesis import given, settings
-from hypothesis import strategies as st
+
+# optional dep: without it these property tests SKIP rather than error
+# the whole module at collection (tier-1 must reflect real regressions)
+pytest.importorskip("hypothesis", reason="property tests need hypothesis")
+from hypothesis import given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
 
 from tpuparquet.cpu import (
     ByteArrayColumn,

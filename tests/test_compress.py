@@ -19,6 +19,13 @@ from tpuparquet.format.metadata import CompressionCodec
 
 rng = np.random.default_rng(3)
 
+# ZSTD is pluggable: the codec registers only when the optional
+# `zstandard` module is importable.  Images without it must SKIP the
+# zstd cases, not fail them (tier-1 reflects real regressions only).
+HAVE_ZSTD = CompressionCodec.ZSTD in registered_codecs()
+needs_zstd = pytest.mark.skipif(
+    not HAVE_ZSTD, reason="zstandard not installed in this image")
+
 PAYLOADS = [
     b"",
     b"x",
@@ -36,7 +43,8 @@ class TestRegistry:
         assert CompressionCodec.UNCOMPRESSED in codecs
         assert CompressionCodec.GZIP in codecs
         assert CompressionCodec.SNAPPY in codecs
-        assert CompressionCodec.ZSTD in codecs  # zstandard is in this image
+        if HAVE_ZSTD:
+            assert CompressionCodec.ZSTD in codecs
 
     def test_unregistered_raises(self):
         with pytest.raises(CompressionError, match="LZO.*not.*registered"):
@@ -73,7 +81,7 @@ class TestRegistry:
         CompressionCodec.UNCOMPRESSED,
         CompressionCodec.GZIP,
         CompressionCodec.SNAPPY,
-        CompressionCodec.ZSTD,
+        pytest.param(CompressionCodec.ZSTD, marks=needs_zstd),
     ],
 )
 @pytest.mark.parametrize("payload", PAYLOADS, ids=range(len(PAYLOADS)))

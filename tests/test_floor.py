@@ -875,6 +875,8 @@ class TestContainerBulkProperty:
     def test_random_objects_bulk_equals_row_path(self):
         import io as _io
 
+        pytest.importorskip("hypothesis",
+                            reason="property test needs hypothesis")
         from hypothesis import HealthCheck, given, settings
         from hypothesis import strategies as st
 

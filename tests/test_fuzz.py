@@ -14,8 +14,12 @@ import io
 
 import numpy as np
 import pytest
-from hypothesis import HealthCheck, given, settings
-from hypothesis import strategies as st
+
+# optional dep: without it these property tests SKIP rather than error
+# the whole module at collection (tier-1 must reflect real regressions)
+pytest.importorskip("hypothesis", reason="fuzz tests need hypothesis")
+from hypothesis import HealthCheck, given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
 
 from tpuparquet import CompressionCodec, FileReader, FileWriter
 from tpuparquet.cpu import bitpack, bss, delta, dictionary, hybrid, levels

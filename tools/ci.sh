@@ -1,7 +1,7 @@
 #!/bin/bash
 # In-repo CI gate (counterpart of the reference's .circleci/config.yml,
 # which pins go versions and runs `go test ./...` + the compatibility
-# corpus per commit).  Three stages, pinned env:
+# corpus per commit).  Four stages, pinned env:
 #
 #   1. tier-1 suite   — the ROADMAP.md verify command, gated on a PASS
 #                       FLOOR rather than rc: optional deps (zstandard,
@@ -15,6 +15,10 @@
 #   3. crash corpus + fault matrix — strict (rc=0): these are green in
 #                       every image; run standalone so a hang or flake
 #                       here is attributable
+#   4. salvage gate    — strict (rc=0): truncation sweep (every page
+#                       boundary + mid-page), strict metadata
+#                       validation over the pyarrow + crash corpora,
+#                       torn-fixture corpus, rescue round trip
 #
 # Usage: bash tools/ci.sh            (exit 0 = gate passed)
 # The tier-1 stage mirrors ROADMAP.md exactly — if you change one,
@@ -34,7 +38,7 @@ CI_PASS_FLOOR=${CI_PASS_FLOOR:-860}
 
 fail() { echo "ci.sh: FAILED at stage $1" >&2; exit 1; }
 
-echo "=== stage 1/3: tier-1 suite (pass floor $CI_PASS_FLOOR) ==="
+echo "=== stage 1/4: tier-1 suite (pass floor $CI_PASS_FLOOR) ==="
 rm -f /tmp/_t1.log
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
@@ -48,14 +52,18 @@ echo "DOTS_PASSED=$passed"
 [ "$passed" -ge "$CI_PASS_FLOOR" ] \
   || fail "tier-1 ($passed passed < floor $CI_PASS_FLOOR)"
 
-echo "=== stage 2/3: smoke bench (CPU backend, tiny target) ==="
+echo "=== stage 2/4: smoke bench (CPU backend, tiny target) ==="
 TPQ_BENCH_TARGET=60000 TPQ_BENCH_CPU=1 timeout -k 10 600 \
   python bench.py > /tmp/_ci_bench.json || fail "smoke bench"
 tail -1 /tmp/_ci_bench.json
 
-echo "=== stage 3/3: crash corpus + fault-injection matrix (strict) ==="
+echo "=== stage 3/4: crash corpus + fault-injection matrix (strict) ==="
 timeout -k 10 600 python -m pytest \
   "tests/test_corpus.py::TestCrashRegressions" tests/test_faults.py \
   -q -p no:cacheprovider || fail "corpus/faults"
+
+echo "=== stage 4/4: salvage + strict metadata (strict) ==="
+timeout -k 10 600 python -m pytest tests/test_salvage.py \
+  -q -p no:cacheprovider || fail "salvage"
 
 echo "ci.sh: gate PASSED"

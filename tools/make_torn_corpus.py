@@ -1,0 +1,145 @@
+"""Generate the checked-in torn-file corpus (tests/corpus/torn/).
+
+Writes a small deterministic multi-row-group file (the oracle), then
+derives torn variants from its bytes: truncations at a row-group
+boundary, at an interior page boundary, mid-page, plus a
+corrupted-footer variant and a hint-less truncation (salvage must come
+from a donor).  A manifest records, for each variant, how many complete
+row groups salvage is expected to recover — the truncation-sweep test
+(tests/test_salvage.py) asserts salvage recovers exactly those, bit
+exact against the oracle.
+
+Run from the repo root:  python tools/make_torn_corpus.py
+Regenerate only when the writer's byte layout intentionally changes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tpuparquet import CompressionCodec, FileWriter  # noqa: E402
+from tpuparquet.cpu.plain import ByteArrayColumn  # noqa: E402
+from tpuparquet.format.recover import forward_scan  # noqa: E402
+from tpuparquet.format.footer import read_file_metadata  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests", "corpus", "torn")
+
+N_RG = 3
+N = 120  # rows per row group
+
+
+def write_oracle(path: str, salvage_hint: bool) -> bytes:
+    rng = np.random.default_rng(20260804)
+    with open(path, "wb") as f:
+        w = FileWriter(
+            f,
+            "message torn { required int64 a; optional binary s (STRING);"
+            " required double x; }",
+            codec=CompressionCodec.SNAPPY,
+            salvage_hint=salvage_hint,
+        )
+        for rg in range(N_RG):
+            mask = (np.arange(N) % 6) != 0
+            w.write_columns(
+                {
+                    "a": np.arange(rg * N, (rg + 1) * N, dtype=np.int64),
+                    "s": ByteArrayColumn.from_list(
+                        [b"row-%05d" % v
+                         for v in rng.integers(0, 99999, int(mask.sum()))]),
+                    "x": rng.standard_normal(N),
+                },
+                masks={"s": mask},
+            )
+        w.close()
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def rg_ends(path: str) -> list[int]:
+    """Byte offset just past the last chunk of each row group."""
+    with open(path, "rb") as f:
+        meta = read_file_metadata(f)
+    ends = []
+    for rg in meta.row_groups:
+        end = 0
+        for cc in rg.columns:
+            cm = cc.meta_data
+            start = cm.data_page_offset
+            if cm.dictionary_page_offset is not None:
+                start = min(start, cm.dictionary_page_offset)
+            end = max(end, start + cm.total_compressed_size)
+        ends.append(end)
+    return ends
+
+
+def main() -> None:
+    os.makedirs(OUT, exist_ok=True)
+    manifest = {"description": __doc__.strip().splitlines()[0],
+                "rows_per_row_group": N, "row_groups": N_RG, "files": {}}
+
+    oracle = os.path.join(OUT, "oracle.parquet")
+    data = write_oracle(oracle, salvage_hint=True)
+    manifest["files"]["oracle.parquet"] = {
+        "kind": "intact", "expect_row_groups": N_RG}
+
+    pages, stop = forward_scan(data)
+    assert stop["reason"] == "bad-header", stop  # stops at the footer
+    ends = rg_ends(oracle)
+    assert len(ends) == N_RG
+
+    def emit(name, blob, expect_rgs, kind, **extra):
+        with open(os.path.join(OUT, name), "wb") as f:
+            f.write(blob)
+        manifest["files"][name] = {
+            "kind": kind, "expect_row_groups": expect_rgs,
+            "bytes": len(blob), **extra}
+
+    # cut exactly at the end of row group 2's bytes (all of rg 0+1 and
+    # rg 2's pages survive, but no footer): salvage recovers all three
+    emit("cut_rg_boundary.parquet", data[: ends[2]], 3,
+         "truncated-at-row-group-boundary", cut=ends[2])
+
+    # cut at an interior page boundary inside row group 1: every page of
+    # rg 0 survives plus a partial rg 1 -> exactly rg 0 recovers
+    mid = [p for p in pages if ends[0] < p.data_end < ends[1]]
+    cut = mid[len(mid) // 2].data_end
+    emit("cut_page_boundary.parquet", data[:cut], 1,
+         "truncated-at-page-boundary", cut=cut)
+
+    # cut mid-page inside row group 2's first page -> rg 0+1 recover
+    pg = next(p for p in pages if p.data_end > ends[1])
+    cut = (pg.data_start + pg.data_end) // 2
+    emit("cut_mid_page.parquet", data[:cut], 2,
+         "truncated-mid-page", cut=cut)
+
+    # footer torn: full data present but the thrift blob is damaged —
+    # valid-prefix salvage cannot trust it; forward scan recovers all 3
+    blob = bytearray(data)
+    for off in range(len(blob) - 40, len(blob) - 20):
+        blob[off] ^= 0x5A
+    emit("footer_torn.parquet", bytes(blob), 3, "corrupt-footer-thrift")
+
+    # hint-less torn file: salvage requires a donor (the oracle)
+    nohint = os.path.join(OUT, "_nohint_tmp.parquet")
+    nh = write_oracle(nohint, salvage_hint=False)
+    nh_ends = rg_ends(nohint)
+    os.unlink(nohint)
+    emit("nohint_cut.parquet", nh[: nh_ends[1]], 2,
+         "truncated-no-hint", needs_donor=True, cut=nh_ends[1])
+
+    with open(os.path.join(OUT, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {len(manifest['files'])} fixtures + manifest to {OUT}")
+
+
+if __name__ == "__main__":
+    main()

@@ -43,6 +43,7 @@ from .format.schema import Schema  # noqa: F401
 from . import obs  # noqa: F401  (pure-stdlib telemetry surface)
 from .errors import (  # noqa: F401  (structured error taxonomy)
     CorruptChunkError,
+    CorruptFooterError,
     CorruptPageError,
     DeviceDispatchError,
     ScanError,

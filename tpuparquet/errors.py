@@ -12,6 +12,10 @@ module is the taxonomy that makes those policies implementable:
   the unit and continues.  Both subclass ``ValueError`` so every
   existing ``except ValueError`` caller (and the crash-corpus "clean
   failure" contract in ``tests/test_corpus.py``) keeps working.
+* :class:`CorruptFooterError` — the file-level analogue: torn or
+  truncated footer, metadata that fails bounds validation.  A sharded
+  scan quarantines the whole *file* (or salvages its readable prefix,
+  ``format/recover.py``) and continues.
 * :class:`TransientIOError` — the read *might* succeed if repeated
   (flaky NFS, throttled object store).  Subclasses ``OSError``;
   :func:`tpuparquet.faults.retry_transient` retries these with bounded
@@ -34,6 +38,7 @@ __all__ = [
     "ScanError",
     "CorruptPageError",
     "CorruptChunkError",
+    "CorruptFooterError",
     "TransientIOError",
     "DeviceDispatchError",
     "QUARANTINE_ERRORS",
@@ -94,6 +99,29 @@ class CorruptPageError(ScanError, ValueError):
 class CorruptChunkError(ScanError, ValueError):
     """A column chunk is structurally wrong beyond one page (byte
     range out of bounds, short read, value-count mismatch)."""
+
+
+class CorruptFooterError(ScanError, ValueError):
+    """The file's framing or ``FileMetaData`` is wrong: bad magic, torn
+    or truncated footer, thrift that does not decode, or metadata whose
+    offsets/counts fail validation against the file
+    (``format/validate.py``).  Carries the byte ``offset`` of the
+    rejecting check (when one layer knows it) next to the usual scan
+    coordinates, and the structured validator ``findings`` when the
+    strict-metadata path raised it.  The legacy name
+    ``tpuparquet.format.footer.FormatError`` is an alias."""
+
+    def __init__(self, message: str = "", *, offset=None, findings=None,
+                 **coords):
+        super().__init__(message, **coords)
+        self.offset = offset
+        self.findings = list(findings) if findings else []
+
+    def coordinates(self) -> dict:
+        c = super().coordinates()
+        if self.offset is not None:
+            c["offset"] = self.offset
+        return c
 
 
 class TransientIOError(ScanError, OSError):

@@ -34,6 +34,13 @@ site                                  instrumented where / supported kinds
                                       — ``dispatch``
 ``kernels.device.unit_dispatch``      ``_finish_row_group`` (per unit)
                                       — ``dispatch``
+``format.footer.tail``                8-byte length+magic tail read
+                                      (``format/footer.py``)
+                                      — ``corrupt``, ``truncate``
+``format.footer.blob``                footer thrift blob read
+                                      — ``corrupt``, ``truncate``
+``io.reader.open``                    ``FileReader.__init__`` (per open)
+                                      — ``oserror``, ``transient``
 ====================================  =====================================
 
 Kinds: ``oserror`` raises ``OSError(EIO)``; ``transient`` raises
@@ -319,6 +326,24 @@ class QuarantineReport:
             "error": type(error).__name__,
             "message": str(error),
         }
+        return self._finish(entry, error)
+
+    def add_file(self, *, file, error: BaseException, **extra) -> dict:
+        """A FILE-granularity entry: the whole file was rejected at
+        open/validate time (torn footer, strict-metadata reject), or a
+        salvaged file's unreadable remainder.  ``unit``/``row_group``
+        are None — no unit ever existed for the lost data."""
+        entry = {
+            "unit": None,
+            "file": file,
+            "row_group": None,
+            "error": type(error).__name__,
+            "message": str(error),
+        }
+        entry.update(extra)
+        return self._finish(entry, error)
+
+    def _finish(self, entry: dict, error: BaseException) -> dict:
         # ScanErrors pinpoint deeper: column / page / a more precise
         # file label from an inner layer
         coords = getattr(error, "coordinates", None)
@@ -332,7 +357,14 @@ class QuarantineReport:
         return entry
 
     def units(self) -> list[int]:
-        return [e["unit"] for e in self.entries]
+        """Unit ordinals of unit-level entries (file-level entries have
+        no unit and are listed by :meth:`files`)."""
+        return [e["unit"] for e in self.entries if e["unit"] is not None]
+
+    def files(self) -> list:
+        """Files with a file-granularity entry (open/validate reject
+        or salvaged remainder)."""
+        return [e["file"] for e in self.entries if e["unit"] is None]
 
     def __len__(self) -> int:
         return len(self.entries)
@@ -353,11 +385,14 @@ class QuarantineReport:
     def summary(self) -> str:
         if not self.entries:
             return "quarantine: empty"
-        lines = [f"quarantine: {len(self.entries)} unit(s)"]
+        lines = [f"quarantine: {len(self.entries)} entr(y/ies)"]
         for e in self.entries:
             at = ", ".join(
                 f"{k}={e[k]}" for k in
-                ("file", "row_group", "column", "page") if k in e)
-            lines.append(f"  unit {e['unit']} [{at}]: "
+                ("file", "row_group", "column", "page")
+                if e.get(k) is not None)
+            head = f"unit {e['unit']}" if e.get("unit") is not None \
+                else "file"
+            lines.append(f"  {head} [{at}]: "
                          f"{e['error']}: {e['message']}")
         return "\n".join(lines)

@@ -1,5 +1,13 @@
-"""Host-side Parquet format layer: thrift metadata, footer framing, schema."""
+"""Host-side Parquet format layer: thrift metadata, footer framing,
+schema — plus the untrusted-metadata tools: strict validation
+(``validate``) and torn-file salvage (``recover``)."""
 
 from .compact import CompactReader, CompactWriter, ThriftError  # noqa: F401
 from .footer import MAGIC, FormatError, read_file_metadata, write_footer  # noqa: F401
 from .metadata import *  # noqa: F401,F403
+from .validate import Finding, validate_metadata  # noqa: F401
+from .recover import (  # noqa: F401
+    forward_scan,
+    read_salvage_hint,
+    recover_file_metadata,
+)

@@ -6,7 +6,12 @@ Layout (same checks as the reference's ``/root/reference/file_meta.go:14-62``):
 
 ``read_file_metadata`` validates the magic at both ends, reads the 4-byte
 little-endian footer length at EOF-8, then compact-thrift-decodes
-``FileMetaData``.
+``FileMetaData``.  Every framing failure raises
+:class:`~tpuparquet.errors.CorruptFooterError` (the error taxonomy's
+file-level class, carrying the rejecting byte offset); ``FormatError``
+remains as a backwards-compatible alias.  Deeper semantic validation
+(offset bounds, schema cross-checks) lives in ``format/validate.py``;
+salvage of files this module rejects lives in ``format/recover.py``.
 """
 
 from __future__ import annotations
@@ -14,16 +19,19 @@ from __future__ import annotations
 import os
 import struct
 
-from .compact import CompactReader, CompactWriter, ThriftError
+from ..errors import CorruptFooterError
+from .compact import CompactWriter, ThriftError
 from .metadata import FileMetaData, encode_struct
 
 MAGIC = b"PAR1"
 
 __all__ = ["MAGIC", "read_file_metadata", "write_footer", "FormatError"]
 
-
-class FormatError(ValueError):
-    """Raised when the file framing is malformed (bad magic, bad sizes)."""
+# Folded into the taxonomy (tpuparquet/errors.py): framing errors are
+# file-level corruption with coordinates, so quarantining scan drivers
+# can catch one class for both torn footers and bad chunks.  The old
+# name stays importable — tests and external callers use it.
+FormatError = CorruptFooterError
 
 
 def _file_size(f) -> int:
@@ -35,31 +43,43 @@ def _file_size(f) -> int:
 
 def read_file_metadata(f) -> FileMetaData:
     """Read and validate the footer of a seekable binary file object."""
+    from ..faults import filter_bytes
+
     size = _file_size(f)
     if size < len(MAGIC) * 2 + 4:
-        raise FormatError(f"file too small to be parquet ({size} bytes)")
+        raise FormatError(
+            f"file too small to be parquet ({size} bytes)", offset=0)
 
     f.seek(0)
     if f.read(4) != MAGIC:
-        raise FormatError("invalid magic at file head")
+        raise FormatError("invalid magic at file head", offset=0)
 
     f.seek(size - 8)
-    tail = f.read(8)
-    if tail[4:] != MAGIC:
-        raise FormatError("invalid magic at file tail")
+    tail = filter_bytes("format.footer.tail", f.read(8))
+    if len(tail) < 8 or tail[4:] != MAGIC:
+        raise FormatError(
+            f"invalid magic at file tail (offset {size - 4})",
+            offset=size - 4)
     (footer_len,) = struct.unpack("<I", tail[:4])
     footer_start = size - 8 - footer_len
+    # cap against the file: the footer cannot reach past the head magic
+    # (a corrupt length field would otherwise send the seek anywhere)
     if footer_len <= 0 or footer_start < 4:
-        raise FormatError(f"invalid footer length {footer_len}")
+        raise FormatError(
+            f"invalid footer length {footer_len} (footer would start at "
+            f"{footer_start} in a {size}-byte file)", offset=size - 8)
 
     f.seek(footer_start)
-    buf = f.read(footer_len)
+    buf = filter_bytes("format.footer.blob", f.read(footer_len))
     if len(buf) != footer_len:
-        raise FormatError("short read of footer")
+        raise FormatError(
+            f"short read of footer: {len(buf)}/{footer_len} bytes at "
+            f"offset {footer_start}", offset=footer_start)
     try:
         meta = FileMetaData.from_bytes(buf)
     except ThriftError as e:
-        raise FormatError(f"corrupt footer thrift: {e}") from e
+        raise FormatError(f"corrupt footer thrift: {e}",
+                          offset=footer_start) from e
     # Required-field validation: compact thrift is permissive enough that
     # corrupt bytes can decode to an empty struct, so enforce the fields
     # parquet.thrift marks `required` before trusting the result.
@@ -69,14 +89,17 @@ def read_file_metadata(f) -> FileMetaData:
         or meta.num_rows is None
         or meta.row_groups is None
     ):
-        raise FormatError("footer missing required FileMetaData fields")
+        raise FormatError("footer missing required FileMetaData fields",
+                          offset=footer_start)
     for rg in meta.row_groups:
         if rg.columns is None or rg.num_rows is None:
-            raise FormatError("row group missing required fields")
+            raise FormatError("row group missing required fields",
+                              offset=footer_start)
         for cc in rg.columns:
             cm = cc.meta_data
             if cm is None:
-                raise FormatError("column chunk missing metadata")
+                raise FormatError("column chunk missing metadata",
+                                  offset=footer_start)
             if (
                 cm.type is None
                 or cm.codec is None
@@ -86,10 +109,12 @@ def read_file_metadata(f) -> FileMetaData:
                 or cm.total_compressed_size is None
             ):
                 raise FormatError(
-                    "column metadata missing required fields")
+                    "column metadata missing required fields",
+                    offset=footer_start)
             if cm.num_values < 0 or cm.total_compressed_size < 0 \
                     or cm.data_page_offset < 0:
-                raise FormatError("negative sizes in column metadata")
+                raise FormatError("negative sizes in column metadata",
+                                  offset=footer_start)
     return meta
 
 

@@ -1,0 +1,529 @@
+"""Torn-file salvage: rebuild metadata for files with no usable footer.
+
+A Parquet writer that dies mid-write leaves the readable data pages on
+disk but no footer — and the footer is the only map.  This module
+rebuilds the map from the pages themselves (parquet-mr's footer
+recovery, cuDF's untrusted-metadata stance): forward-scan from the head
+magic decoding ``PageHeader`` structs back-to-back, reject garbage with
+the same sanity checks + page-CRC verification the decode path uses,
+group the surviving pages into column chunks and row groups, and emit a
+synthesized ``FileMetaData`` covering exactly the complete row-group
+prefix.  Decoded output is bit-exact or absent — never wrong: a page
+that fails any check ends the scan, and a row group missing any chunk
+is dropped.
+
+Page headers carry sizes and encodings but NOT the schema or codec, so
+recovery needs one of:
+
+* a **salvage hint** — ``FileWriter`` (``salvage_hint=``, env
+  ``TPQ_SALVAGE_HINT``, default on) frames a tiny thrift blob of the
+  schema + codec right after the leading magic (``TPQS`` + u32 length +
+  thrift ``FileMetaData``).  Spec-compatible: footers address pages by
+  absolute offset, so foreign readers (pyarrow, parquet-mr) skip the
+  frame without noticing it; torn files become self-salvaging.
+* a **sibling** — ``like=`` any ``FileMetaData``/path/reader with the
+  same schema (the usual case for a sharded dataset: every healthy
+  shard is a donor).
+
+Chunk grouping assumes the layout this library's writer emits — one
+data page per chunk, optionally preceded by its dictionary page.
+Multi-data-page chunks (some foreign writers) have no recoverable chunk
+boundary without a footer; the scan stops at the first page that
+doesn't fit the pattern and salvages the prefix before it.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from ..errors import CorruptFooterError
+from .compact import CompactReader, CompactWriter, ThriftError
+from .footer import MAGIC, _file_size
+from .metadata import (
+    ColumnChunk,
+    ColumnMetaData,
+    CompressionCodec,
+    Encoding,
+    FileMetaData,
+    KeyValue,
+    PageHeader,
+    PageType,
+    RowGroup,
+    decode_struct,
+    encode_struct,
+)
+from .schema import Schema
+
+__all__ = [
+    "SALVAGE_MAGIC",
+    "PageRec",
+    "encode_salvage_hint",
+    "read_salvage_hint",
+    "forward_scan",
+    "rebuild_row_groups",
+    "recover_file_metadata",
+    "salvage_valid_prefix",
+    "SALVAGED_KEY",
+]
+
+SALVAGE_MAGIC = b"TPQS"
+SALVAGED_KEY = "tpq.salvaged"       # kv marker on synthesized metadata
+_CODEC_KEY = "tpq.codec"            # kv slot in the hint frame
+_MAX_HINT = 1 << 24                 # 16 MiB: no real schema is bigger
+_MAX_HEADER = 1 << 16               # page headers are tens of bytes
+
+# data-page types a recovered chunk may contain
+_DATA_TYPES = (PageType.DATA_PAGE, PageType.DATA_PAGE_V2)
+
+
+# ----------------------------------------------------------------------
+# Salvage hint frame
+# ----------------------------------------------------------------------
+
+def encode_salvage_hint(schema: Schema, codec: CompressionCodec,
+                        created_by: str | None = None) -> bytes:
+    """The writer-side frame: schema + codec as a row-group-less
+    ``FileMetaData``, length-prefixed behind :data:`SALVAGE_MAGIC`."""
+    hint = FileMetaData(
+        version=1,
+        schema=schema.to_elements(),
+        num_rows=0,
+        row_groups=[],
+        key_value_metadata=[
+            KeyValue(key=_CODEC_KEY, value=CompressionCodec(codec).name)],
+        created_by=created_by,
+    )
+    w = CompactWriter()
+    encode_struct(hint, w)
+    blob = w.getvalue()
+    return SALVAGE_MAGIC + struct.pack("<I", len(blob)) + blob
+
+
+def read_salvage_hint(f) -> "tuple[FileMetaData, int] | None":
+    """Read the hint frame after the head magic; returns ``(hint_meta,
+    end_offset)`` — the offset where pages begin — or None when the
+    file has no (valid) hint.  Never raises: a corrupt hint is just an
+    absent hint (the frame sits in the torn region like everything
+    else)."""
+    size = _file_size(f)
+    if size < 4 + 8:
+        return None
+    f.seek(4)
+    head = f.read(8)
+    if head[:4] != SALVAGE_MAGIC:
+        return None
+    (n,) = struct.unpack("<I", head[4:])
+    if n <= 0 or n > _MAX_HINT or 12 + n > size:
+        return None
+    blob = f.read(n)
+    if len(blob) != n:
+        return None
+    try:
+        hint = FileMetaData.from_bytes(blob)
+    except ThriftError:
+        return None
+    if not hint.schema:
+        return None
+    return hint, 12 + n
+
+
+def hint_codec(hint: FileMetaData) -> "CompressionCodec | None":
+    for kv in hint.key_value_metadata or []:
+        if kv.key == _CODEC_KEY:
+            try:
+                return CompressionCodec[kv.value]
+            except KeyError:
+                return None
+    return None
+
+
+# ----------------------------------------------------------------------
+# Forward page scan
+# ----------------------------------------------------------------------
+
+class PageRec:
+    """One page found by the forward scan: absolute file coordinates."""
+
+    __slots__ = ("offset", "header", "header_len", "data_start",
+                 "data_end")
+
+    def __init__(self, offset, header, header_len, data_start, data_end):
+        self.offset = offset
+        self.header = header
+        self.header_len = header_len
+        self.data_start = data_start
+        self.data_end = data_end
+
+    def __repr__(self):
+        return (f"PageRec({PageType(self.header.type).name} "
+                f"@{self.offset}, {self.data_end - self.offset}B)")
+
+
+def _header_sane(ph: PageHeader, remaining: int) -> bool:
+    """The garbage rejector: does this decode look like a real page
+    header?  Thrift's permissiveness means random bytes sometimes
+    decode without error — but they essentially never produce a known
+    page type WITH its matching sub-header and sane sizes."""
+    try:
+        ptype = PageType(ph.type)
+    except (ValueError, TypeError):
+        return False
+    if ph.compressed_page_size is None or ph.compressed_page_size < 0 \
+            or ph.compressed_page_size > remaining:
+        return False
+    if ph.uncompressed_page_size is None or ph.uncompressed_page_size < 0:
+        return False
+    if ptype == PageType.DATA_PAGE:
+        h = ph.data_page_header
+        return h is not None and h.num_values is not None \
+            and h.num_values >= 0 and h.encoding is not None
+    if ptype == PageType.DATA_PAGE_V2:
+        h = ph.data_page_header_v2
+        return h is not None and h.num_values is not None \
+            and h.num_values >= 0 and h.encoding is not None
+    if ptype == PageType.DICTIONARY_PAGE:
+        h = ph.dictionary_page_header
+        return h is not None and h.num_values is not None \
+            and h.num_values >= 0
+    return ptype == PageType.INDEX_PAGE
+
+
+def forward_scan(buf, start: int = 4, end: int | None = None,
+                 verify_crc: bool = True) -> tuple[list[PageRec], dict]:
+    """Walk ``buf`` from ``start`` decoding page headers back-to-back.
+
+    Returns ``(pages, stop)`` where ``stop`` records why and where the
+    walk ended: ``reason`` is ``"end"`` (clean stop exactly at ``end``),
+    ``"bad-header"`` (bytes that are not a page header — in an intact
+    file this is simply the footer thrift), ``"truncated-page"`` (a
+    header whose payload overruns the bytes we have — the torn write),
+    or ``"crc-mismatch"`` (a page the PR-2 integrity check rejects).
+    Pages before the stop are trustworthy; nothing after is touched.
+    """
+    from ..io.pages import verify_page_crc
+
+    mv = memoryview(buf)
+    if end is None:
+        end = len(mv)
+    if start == 4 and bytes(mv[4:8]) == SALVAGE_MAGIC and end >= 12:
+        # default start on a hinted file: step over the hint frame
+        (n,) = struct.unpack("<I", mv[8:12])
+        if 0 < n <= _MAX_HINT and 12 + n <= end:
+            start = 12 + n
+    pages: list[PageRec] = []
+    pos = start
+    while pos < end:
+        r = CompactReader(mv, pos, min(pos + _MAX_HEADER, end))
+        try:
+            ph = decode_struct(PageHeader, r)
+        except ThriftError:
+            return pages, {"reason": "bad-header", "offset": pos}
+        if not _header_sane(ph, remaining=end - r.pos):
+            # distinguish "the payload would overrun" (torn tail) from
+            # "this never was a page header" (footer bytes / garbage)
+            if _header_sane(ph, remaining=1 << 62):
+                return pages, {"reason": "truncated-page", "offset": pos}
+            return pages, {"reason": "bad-header", "offset": pos}
+        data_start = r.pos
+        data_end = data_start + ph.compressed_page_size
+        if verify_crc and ph.crc is not None:
+            try:
+                verify_page_crc(ph, mv[data_start:data_end],
+                                enabled=True)
+            except ValueError:
+                return pages, {"reason": "crc-mismatch", "offset": pos}
+        pages.append(PageRec(pos, ph, data_start - pos, data_start,
+                             data_end))
+        pos = data_end
+    return pages, {"reason": "end", "offset": pos}
+
+
+# ----------------------------------------------------------------------
+# Metadata rebuild
+# ----------------------------------------------------------------------
+
+def rebuild_row_groups(pages: list[PageRec], schema: Schema,
+                       codec: CompressionCodec) -> tuple[list[RowGroup],
+                                                         dict]:
+    """Group scanned pages into chunks (leaf-order cycling: one data
+    page per chunk, optional leading dictionary page) and chunks into
+    complete row groups.  Returns ``(row_groups, info)`` where ``info``
+    counts what the incomplete tail lost."""
+    leaves = schema.leaves
+    L = len(leaves)
+    chunks: list[ColumnChunk] = []
+    rows_per_chunk: list[int] = []
+    i = 0
+    stop = None
+    while i < len(pages):
+        leaf = leaves[len(chunks) % L]
+        first = pages[i]
+        dict_page = None
+        if first.header.type == PageType.DICTIONARY_PAGE:
+            dict_page = first
+            i += 1
+            if i >= len(pages):
+                stop = "chunk-cut-mid"
+                break
+        data_page = pages[i]
+        if data_page.header.type not in _DATA_TYPES:
+            # two dictionary pages in a row / an index page where a
+            # data page belongs: not the layout we can rebuild
+            stop = "unrecognized-layout"
+            break
+        i += 1
+        v2 = data_page.header.type == PageType.DATA_PAGE_V2
+        h = data_page.header.data_page_header_v2 if v2 \
+            else data_page.header.data_page_header
+        start = dict_page.offset if dict_page is not None \
+            else data_page.offset
+        encodings = [Encoding.RLE]
+        try:
+            encodings.append(Encoding(h.encoding))
+        except ValueError:
+            pass
+        if dict_page is not None \
+                and Encoding.RLE_DICTIONARY not in encodings:
+            encodings.append(Encoding.RLE_DICTIONARY)
+        total_uncomp = data_page.header_len \
+            + data_page.header.uncompressed_page_size
+        if dict_page is not None:
+            total_uncomp += dict_page.header_len \
+                + dict_page.header.uncompressed_page_size
+        cm = ColumnMetaData(
+            type=leaf.type,
+            encodings=encodings,
+            path_in_schema=list(leaf.path),
+            codec=codec,
+            num_values=h.num_values,
+            total_uncompressed_size=total_uncomp,
+            total_compressed_size=data_page.data_end - start,
+            data_page_offset=data_page.offset,
+            dictionary_page_offset=(
+                dict_page.offset if dict_page is not None else None),
+        )
+        chunks.append(ColumnChunk(file_offset=start, meta_data=cm))
+        rows = None
+        if leaf.max_rep_level == 0:
+            rows = h.num_values
+        elif v2 and h.num_rows is not None:
+            rows = h.num_rows
+        rows_per_chunk.append(rows)
+
+    row_groups: list[RowGroup] = []
+    n_complete = len(chunks) // L
+    for rgi in range(n_complete):
+        cc = chunks[rgi * L : (rgi + 1) * L]
+        rows = [r for r in rows_per_chunk[rgi * L : (rgi + 1) * L]
+                if r is not None]
+        # every chunk that knows its row count must agree — a
+        # disagreement means the grouping drifted; trust ends here
+        if rows and any(r != rows[0] for r in rows):
+            stop = "row-count-disagreement"
+            n_complete = rgi
+            break
+        if not rows:
+            # no chunk knows its row count (all leaves repeated, V1
+            # pages): num_values counts elements, not records, and
+            # guessing would be WRONG, not absent — stop salvage here
+            stop = "unknown-row-count"
+            n_complete = rgi
+            break
+        num_rows = rows[0]
+        row_groups.append(RowGroup(
+            columns=cc,
+            total_byte_size=sum(
+                c.meta_data.total_uncompressed_size for c in cc),
+            total_compressed_size=sum(
+                c.meta_data.total_compressed_size for c in cc),
+            num_rows=num_rows,
+            ordinal=rgi,
+        ))
+    row_groups = row_groups[:n_complete]
+    info = {
+        "chunks_recovered": n_complete * L,
+        "chunks_dropped": len(chunks) - n_complete * L,
+        "pages_dropped": len(pages) - i,
+    }
+    if stop:
+        info["grouping_stop"] = stop
+    return row_groups, info
+
+
+def recover_file_metadata(f, *, like=None,
+                          verify_crc: bool = True
+                          ) -> tuple[FileMetaData, dict]:
+    """Synthesize ``FileMetaData`` for a file whose footer is unusable.
+
+    ``like`` donates the schema and codec: a ``FileMetaData``, a path,
+    or an open reader with ``.meta``.  When absent, the file's own
+    salvage hint is used; a file with neither raises
+    :class:`CorruptFooterError` (page headers alone cannot name columns
+    or types, and guessing would violate "never wrong").
+
+    Returns ``(meta, report)``; ``meta`` carries a
+    ``tpq.salvaged = "1"`` key-value marker so downstream consumers can
+    tell partial metadata from a real footer.
+    """
+    size = _file_size(f)
+    f.seek(0)
+    if size < 4 or f.read(4) != MAGIC:
+        raise CorruptFooterError(
+            f"invalid magic at file head: not a parquet file "
+            f"({size} bytes)", offset=0)
+
+    start = 4
+    hint = read_salvage_hint(f)
+    if hint is not None:
+        start = hint[1]
+    donor, codec, created_by = _donor_schema(like, hint)
+    schema = Schema.from_elements(donor)
+
+    # scan without materializing a copy of the file: BytesIO exposes
+    # its buffer zero-copy, real files mmap; only unseekable oddballs
+    # pay the full read.  forward_scan keeps no references into the
+    # buffer (PageRecs hold decoded structs + integer offsets), so the
+    # view/map is released as soon as the walk ends.
+    import io as _io
+    import mmap as _mmap
+
+    buf = close = None
+    if isinstance(f, _io.BytesIO):
+        buf = f.getbuffer().toreadonly()
+        close = buf.release
+    else:
+        try:
+            buf = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+            close = buf.close
+        except (OSError, ValueError, AttributeError,
+                _io.UnsupportedOperation):
+            f.seek(0)
+            buf = f.read()
+    try:
+        pages, stop = forward_scan(buf, start=start,
+                                   verify_crc=verify_crc)
+    finally:
+        if close is not None:
+            close()
+    row_groups, info = rebuild_row_groups(pages, schema, codec)
+    num_rows = sum(rg.num_rows for rg in row_groups)
+    if row_groups:
+        last = row_groups[-1].columns[-1]
+        recovered_end = (last.file_offset
+                         + last.meta_data.total_compressed_size)
+    else:
+        recovered_end = start
+    meta = FileMetaData(
+        version=1,
+        schema=donor,
+        num_rows=num_rows,
+        row_groups=row_groups,
+        key_value_metadata=[KeyValue(key=SALVAGED_KEY, value="1")],
+        created_by=created_by or "tpuparquet salvage",
+    )
+    report = {
+        "schema_source": ("like" if like is not None else "hint"),
+        "pages_scanned": len(pages),
+        "row_groups_recovered": len(row_groups),
+        "rows_recovered": num_rows,
+        "stop_reason": stop["reason"],
+        "stop_offset": stop["offset"],
+        "bytes_recovered": recovered_end,
+        "bytes_lost": max(size - recovered_end, 0),
+        "file_size": size,
+    }
+    report.update(info)
+    return meta, report
+
+
+def _donor_schema(like, hint):
+    """Resolve (schema elements, codec, created_by) from ``like`` or
+    the hint frame."""
+    if like is None:
+        if hint is None:
+            raise CorruptFooterError(
+                "cannot salvage: footer unusable and the file has no "
+                "salvage hint — pass salvage_like= a sibling file or "
+                "metadata with the same schema")
+        hm = hint[0]
+        codec = hint_codec(hm)
+        if codec is None:
+            codec = CompressionCodec.UNCOMPRESSED
+        return hm.schema, codec, hm.created_by
+    meta = like
+    if isinstance(like, (str, bytes)):
+        from .footer import read_file_metadata
+
+        with open(like, "rb") as df:
+            meta = read_file_metadata(df)
+    elif hasattr(like, "meta"):
+        meta = like.meta
+    if not isinstance(meta, FileMetaData) or not meta.schema:
+        raise CorruptFooterError(
+            f"salvage_like donor has no usable schema: {like!r}")
+    codec = CompressionCodec.UNCOMPRESSED
+    for rg in meta.row_groups or []:
+        if rg.columns and rg.columns[0].meta_data is not None \
+                and rg.columns[0].meta_data.codec is not None:
+            try:
+                codec = CompressionCodec(rg.columns[0].meta_data.codec)
+            except ValueError:
+                pass
+            break
+    return meta.schema, codec, meta.created_by
+
+
+# ----------------------------------------------------------------------
+# Valid-prefix salvage (footer readable, validation failed)
+# ----------------------------------------------------------------------
+
+def salvage_valid_prefix(meta: FileMetaData, file_size: int,
+                         findings=None
+                         ) -> "tuple[FileMetaData, dict] | None":
+    """For a footer that *decodes* but fails strict validation: keep
+    the longest row-group prefix with no error findings.  Returns
+    ``(trimmed_meta, report)`` or None when the damage is file-level
+    (schema missing/malformed) and nothing can be trusted.
+    ``findings`` may pass in a precomputed ``validate_metadata(meta,
+    file_size)`` result (it is a pure function of those inputs) to
+    avoid walking wide metadata twice."""
+    from .validate import validate_metadata
+
+    if findings is None:
+        findings = validate_metadata(meta, file_size)
+    errors = [f for f in findings if f.is_error]
+    if not errors:
+        return None  # nothing to salvage — the metadata is fine
+    # file-level errors that the trim itself repairs are tolerable;
+    # anything else file-level poisons the schema and with it every rg
+    repairable = {"num-rows-sum", "missing-num-rows",
+                  "negative-num-rows", "missing-version"}
+    for fd in errors:
+        if fd.row_group is None and fd.code not in repairable:
+            return None
+    # only repairable file-level errors -> every row group is clean and
+    # the trim itself repairs the file-level numbers: keep them ALL
+    rg_errors = [fd.row_group for fd in errors
+                 if fd.row_group is not None]
+    first_bad = min(rg_errors) if rg_errors else len(meta.row_groups)
+    kept = list(meta.row_groups[:first_bad])
+    kv = list(meta.key_value_metadata or [])
+    kv.append(KeyValue(key=SALVAGED_KEY, value="1"))
+    trimmed = FileMetaData(
+        version=meta.version if meta.version is not None else 1,
+        schema=meta.schema,
+        num_rows=sum(rg.num_rows for rg in kept),
+        row_groups=kept,
+        key_value_metadata=kv,
+        created_by=meta.created_by,
+        column_orders=meta.column_orders,
+    )
+    report = {
+        "schema_source": "footer",
+        "row_groups_recovered": len(kept),
+        "row_groups_rejected": len(meta.row_groups) - len(kept),
+        "rows_recovered": trimmed.num_rows,
+        "stop_reason": "metadata-invalid",
+        "findings": [f.as_dict() for f in findings],
+    }
+    return trimmed, report

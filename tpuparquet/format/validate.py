@@ -1,0 +1,315 @@
+"""Strict ``FileMetaData`` validation: treat the footer as untrusted.
+
+Compact thrift is permissive — corrupt bytes can decode into a struct
+whose *shape* is fine but whose numbers point anywhere.  The decode
+path bounds-checks lazily (each chunk as it is read), which means a bad
+footer aborts a scan halfway through, after work was done.  This module
+front-loads the whole check: :func:`validate_metadata` cross-checks
+every ``RowGroup``/``ColumnChunk`` against the file size and the schema
+tree and returns structured :class:`Finding`\\ s, so callers can reject
+a file at open time (``FileReader(strict_metadata=True)``, env
+``TPQ_STRICT_METADATA``), report findings (``parquet-tool meta
+--strict``), or salvage the valid row-group prefix
+(``FileReader(salvage=True)``).
+
+The bar is the SURVEY's "bit-exact or absent, never wrong", applied to
+metadata: an offset that escapes the file, a value count that disagrees
+with the row count, or a path that is not in the schema is an ``error``
+finding; oddities that decode fine but smell (unknown codec enum from a
+future writer, zero-byte chunk with values) are ``warn``.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .metadata import CompressionCodec, FileMetaData, Type
+from .schema import Schema
+
+__all__ = [
+    "Finding",
+    "validate_metadata",
+    "raise_on_errors",
+    "strict_metadata_default",
+]
+
+
+def strict_metadata_default() -> bool:
+    """Reader-side gate: validate the footer before trusting it?
+    Default OFF (validation walks every chunk's metadata; scans that
+    open thousands of known-good shards shouldn't pay it twice);
+    enable with ``TPQ_STRICT_METADATA=1`` or per-reader via
+    ``FileReader(strict_metadata=True)``."""
+    return os.environ.get("TPQ_STRICT_METADATA", "0") != "0"
+
+
+class Finding:
+    """One validator observation: ``level`` is ``"error"`` (metadata is
+    wrong — a strict reader must reject) or ``"warn"`` (legal but
+    suspicious).  ``code`` is a stable machine-readable slug; the
+    coordinate fields pinpoint the row group / column / byte offset
+    when known."""
+
+    __slots__ = ("level", "code", "message", "row_group", "column",
+                 "offset")
+
+    def __init__(self, level: str, code: str, message: str, *,
+                 row_group=None, column=None, offset=None):
+        self.level = level
+        self.code = code
+        self.message = message
+        self.row_group = row_group
+        self.column = column
+        self.offset = offset
+
+    @property
+    def is_error(self) -> bool:
+        return self.level == "error"
+
+    def as_dict(self) -> dict:
+        d = {"level": self.level, "code": self.code,
+             "message": self.message}
+        for k in ("row_group", "column", "offset"):
+            v = getattr(self, k)
+            if v is not None:
+                d[k] = v
+        return d
+
+    def __str__(self) -> str:
+        at = ", ".join(
+            f"{k}={getattr(self, k)}"
+            for k in ("row_group", "column", "offset")
+            if getattr(self, k) is not None)
+        return (f"{self.level}[{self.code}] {self.message}"
+                + (f" [{at}]" if at else ""))
+
+    def __repr__(self) -> str:
+        return f"Finding({self})"
+
+
+def _err(findings, code, msg, **at):
+    findings.append(Finding("error", code, msg, **at))
+
+
+def _warn(findings, code, msg, **at):
+    findings.append(Finding("warn", code, msg, **at))
+
+
+def validate_metadata(meta: FileMetaData, file_size: int) -> list[Finding]:
+    """Bounds- and cross-check a decoded footer against the file.
+
+    Pure function of ``(meta, file_size)`` — no I/O.  Returns every
+    finding (it does not stop at the first), so ``parquet-tool meta
+    --strict`` can report the full damage and the salvage path can tell
+    exactly which row-group prefix is clean.
+    """
+    findings: list[Finding] = []
+
+    # -- required file-level fields --------------------------------------
+    if meta.version is None:
+        _err(findings, "missing-version",
+             "FileMetaData.version is required but absent")
+    if not meta.schema:
+        _err(findings, "missing-schema",
+             "FileMetaData.schema is required but empty")
+    if meta.num_rows is None:
+        _err(findings, "missing-num-rows",
+             "FileMetaData.num_rows is required but absent")
+    elif meta.num_rows < 0:
+        _err(findings, "negative-num-rows",
+             f"FileMetaData.num_rows is {meta.num_rows}")
+    if meta.row_groups is None:
+        _err(findings, "missing-row-groups",
+             "FileMetaData.row_groups is required but absent")
+    if not meta.schema or meta.row_groups is None:
+        return findings  # nothing below is checkable
+
+    # -- schema tree -----------------------------------------------------
+    # Build the leaf map (dotted path -> node) via the same tree walk
+    # the reader uses; a tree that does not walk (num_children that
+    # overruns the element list, a leaf with no type) is one error.
+    try:
+        schema = Schema.from_elements(meta.schema)
+        leaves = {leaf.flat_name: leaf for leaf in schema.leaves}
+    except Exception as e:  # malformed tree: IndexError, ValueError, ...
+        _err(findings, "schema-tree",
+             f"schema element list does not form a tree: "
+             f"{type(e).__name__}: {e}")
+        return findings
+    if not leaves:
+        _err(findings, "schema-no-leaves", "schema has no leaf columns")
+        return findings
+
+    # -- row groups ------------------------------------------------------
+    total_rows = 0
+    seen_ranges: list[tuple[int, int, int, str]] = []
+    for rgi, rg in enumerate(meta.row_groups):
+        if rg.num_rows is None:
+            _err(findings, "rg-missing-num-rows",
+                 "row group missing required num_rows", row_group=rgi)
+            continue
+        if rg.num_rows < 0:
+            _err(findings, "rg-negative-num-rows",
+                 f"row group num_rows is {rg.num_rows}", row_group=rgi)
+            continue
+        total_rows += rg.num_rows
+        if not rg.columns:
+            _err(findings, "rg-missing-columns",
+                 "row group has no column chunks", row_group=rgi)
+            continue
+        if len(rg.columns) != len(leaves):
+            _err(findings, "rg-column-count",
+                 f"row group has {len(rg.columns)} column chunks, "
+                 f"schema has {len(leaves)} leaves", row_group=rgi)
+        for cc in rg.columns:
+            _validate_chunk(findings, cc, rgi, rg, leaves, file_size,
+                            seen_ranges)
+
+    if meta.num_rows is not None and meta.num_rows >= 0 \
+            and total_rows != meta.num_rows:
+        _err(findings, "num-rows-sum",
+             f"FileMetaData.num_rows {meta.num_rows} != sum of row-group "
+             f"rows {total_rows}")
+
+    # -- chunk byte ranges must not overlap ------------------------------
+    # sweep with a RUNNING max end, not adjacent-pair compares: a chunk
+    # whose lying size swallows several successors must conflict with
+    # every one of them, not just its immediate neighbor.  The finding
+    # anchors at the EARLIER row group of the pair — either member may
+    # be the liar, so a prefix trim must stop before both.
+    seen_ranges.sort()
+    cur = None  # (start, end, rgi, column) with the furthest end so far
+    for rng in seen_ranges:
+        s1, e1, rg1, c1 = rng
+        if cur is not None and s1 < cur[1]:
+            s0, e0, rg0, c0 = cur
+            _err(findings, "chunk-overlap",
+                 f"column chunk [{s1}, {e1}) (rg {rg1}, {c1}) overlaps "
+                 f"[{s0}, {e0}) (rg {rg0}, {c0})",
+                 row_group=min(rg0, rg1), column=c1, offset=s1)
+        if cur is None or e1 > cur[1]:
+            cur = rng
+    return findings
+
+
+def _validate_chunk(findings, cc, rgi, rg, leaves, file_size,
+                    seen_ranges) -> None:
+    cm = cc.meta_data
+    if cm is None:
+        _err(findings, "chunk-missing-metadata",
+             "column chunk missing meta_data", row_group=rgi)
+        return
+    path = ".".join(cm.path_in_schema) if cm.path_in_schema else None
+    at = {"row_group": rgi, "column": path}
+
+    # required fields
+    if not cm.path_in_schema:
+        _err(findings, "chunk-missing-path",
+             "column metadata missing path_in_schema", row_group=rgi)
+        return
+    missing = [name for name in ("type", "codec", "num_values",
+                                 "data_page_offset",
+                                 "total_compressed_size")
+               if getattr(cm, name) is None]
+    if missing:
+        _err(findings, "chunk-missing-fields",
+             f"column metadata missing required {', '.join(missing)}",
+             **at)
+        return
+
+    # schema cross-checks
+    leaf = leaves.get(path)
+    if leaf is None:
+        _err(findings, "chunk-unknown-column",
+             f"path_in_schema {path!r} is not a schema leaf", **at)
+        return
+    try:
+        ptype = Type(cm.type)
+    except ValueError:
+        _err(findings, "chunk-bad-type",
+             f"unknown physical type {cm.type}", **at)
+        return
+    if leaf.type is not None and ptype != leaf.type:
+        _err(findings, "chunk-type-mismatch",
+             f"chunk type {ptype.name} disagrees with schema leaf type "
+             f"{Type(leaf.type).name}", **at)
+    if not isinstance(cm.codec, CompressionCodec):
+        _warn(findings, "chunk-unknown-codec",
+              f"unknown compression codec enum {cm.codec}", **at)
+
+    # counts
+    if cm.num_values < 0:
+        _err(findings, "chunk-negative-values",
+             f"num_values is {cm.num_values}", **at)
+        return
+    if cm.total_compressed_size < 0:
+        _err(findings, "chunk-negative-size",
+             f"total_compressed_size is {cm.total_compressed_size}", **at)
+        return
+    if cm.total_uncompressed_size is not None \
+            and cm.total_uncompressed_size < 0:
+        _err(findings, "chunk-negative-size",
+             f"total_uncompressed_size is {cm.total_uncompressed_size}",
+             **at)
+    if rg.num_rows is not None:
+        # cross-check values against rows: a non-repeated leaf stores
+        # exactly one (possibly null) value slot per record
+        if leaf.max_rep_level == 0 and cm.num_values != rg.num_rows:
+            _err(findings, "chunk-values-vs-rows",
+                 f"num_values {cm.num_values} != row group num_rows "
+                 f"{rg.num_rows} for non-repeated column", **at)
+        if leaf.max_rep_level > 0 and rg.num_rows > 0 \
+                and cm.num_values == 0:
+            _warn(findings, "chunk-repeated-empty",
+                  "repeated column has 0 values in a non-empty row group",
+                  **at)
+    if cm.num_values > 0 and cm.total_compressed_size == 0:
+        _err(findings, "chunk-zero-bytes",
+             f"{cm.num_values} values in 0 compressed bytes", **at)
+    if cm.num_values == 0:
+        # empty chunk: the page loop never dereferences its offsets
+        # (pyarrow writes data_page_offset=0 with a dictionary-only
+        # chunk for empty row groups), so there is nothing to bound
+        return
+
+    # byte ranges against the file
+    start = cm.data_page_offset
+    if cm.dictionary_page_offset is not None:
+        if cm.dictionary_page_offset < 0:
+            _err(findings, "chunk-offset-oob",
+                 f"dictionary_page_offset {cm.dictionary_page_offset} "
+                 "is negative", offset=cm.dictionary_page_offset, **at)
+            return
+        if cm.dictionary_page_offset > cm.data_page_offset:
+            _err(findings, "chunk-dict-after-data",
+                 f"dictionary_page_offset {cm.dictionary_page_offset} > "
+                 f"data_page_offset {cm.data_page_offset}", **at)
+        start = min(start, cm.dictionary_page_offset)
+    if start < 4:
+        _err(findings, "chunk-offset-oob",
+             f"chunk starts at {start}, before the 4-byte magic",
+             offset=start, **at)
+        return
+    end = start + cm.total_compressed_size
+    if end > file_size:
+        _err(findings, "chunk-offset-oob",
+             f"chunk byte range [{start}, {end}) overruns the file "
+             f"({file_size} bytes)", offset=start, **at)
+        return
+    seen_ranges.append((start, end, rgi, path))
+
+
+def raise_on_errors(findings: list[Finding], *, file=None) -> None:
+    """Raise :class:`~tpuparquet.errors.CorruptFooterError` summarizing
+    the error-level findings (no-op when there are none)."""
+    errors = [f for f in findings if f.is_error]
+    if not errors:
+        return
+    from ..errors import CorruptFooterError
+
+    head = errors[0]
+    more = f" (+{len(errors) - 1} more)" if len(errors) > 1 else ""
+    raise CorruptFooterError(
+        f"metadata failed strict validation: {head}{more}",
+        file=file, offset=head.offset, findings=findings,
+        row_group=head.row_group, column=head.column)

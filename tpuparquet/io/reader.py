@@ -29,6 +29,8 @@ from .store import assemble_record, attach_stores
 
 __all__ = ["FileReader"]
 
+from ..format.footer import _file_size as _source_size  # noqa: E402
+
 
 class FileReader:
     """Reads a seekable binary file object (or a path).
@@ -37,10 +39,29 @@ class FileReader:
     carry one (None = env default ``TPQ_PAGE_CRC_VERIFY``, on).
     Transient I/O failures on chunk reads are retried with bounded
     exponential backoff (:func:`tpuparquet.faults.retry_transient`).
+
+    Untrusted-metadata knobs (file-level robustness round):
+
+    * ``strict_metadata`` — validate the whole footer against the file
+      before trusting it (``format/validate.py``); error findings raise
+      :class:`~tpuparquet.errors.CorruptFooterError` carrying them.
+      None = env default ``TPQ_STRICT_METADATA`` (off).
+    * ``salvage`` — when the footer is torn/truncated or fails
+      validation, recover the readable row-group prefix instead of
+      raising (``format/recover.py``).  The reader is then flagged
+      :attr:`salvaged` with a :attr:`salvage_report`, and the partial
+      metadata carries a ``tpq.salvaged`` key-value marker.  Recovered
+      data is bit-exact or absent — never wrong.
+    * ``salvage_like`` — schema/codec donor for salvage of files with
+      no embedded salvage hint: a sibling path, reader, or
+      ``FileMetaData``.
     """
 
     def __init__(self, source, *columns: str,
-                 verify_crc: bool | None = None):
+                 verify_crc: bool | None = None,
+                 strict_metadata: bool | None = None,
+                 salvage: bool = False,
+                 salvage_like=None):
         import threading
 
         if isinstance(source, (str, bytes)) and not hasattr(source, "read"):
@@ -57,23 +78,147 @@ class FileReader:
         # still use this reader from the main thread
         self._io_lock = threading.Lock()
         self._buf = None
-        self.meta: FileMetaData = read_file_metadata(self._f)
-        # In-memory sources serve chunk blobs as zero-copy views (the
-        # read() copy was ~25% of the 50M-value plan phase).  Taken only
-        # after the footer parses (a raised export would pin the caller's
-        # BytesIO), read-only (blob-derived arrays must not alias the
-        # file writably); pins the BytesIO against resize while open.
-        if isinstance(self._f, io.BytesIO):
-            self._buf = self._f.getbuffer().toreadonly()
-        self.schema = Schema.from_elements(self.meta.schema)
-        attach_stores(self.schema)
-        if columns:
-            self.schema.set_selected_columns(*columns)
+        self.salvaged = False
+        self.salvage_report = None
+        self.metadata_findings = None
+        try:
+            fault_point("io.reader.open", file=self.name)
+            self.meta: FileMetaData = self._resolve_metadata(
+                strict_metadata, salvage, salvage_like)
+            # In-memory sources serve chunk blobs as zero-copy views (the
+            # read() copy was ~25% of the 50M-value plan phase).  Taken
+            # only after the footer parses (a raised export would pin the
+            # caller's BytesIO), read-only (blob-derived arrays must not
+            # alias the file writably); pins the BytesIO against resize
+            # while open.
+            if isinstance(self._f, io.BytesIO):
+                self._buf = self._f.getbuffer().toreadonly()
+            self.schema = Schema.from_elements(self.meta.schema)
+            attach_stores(self.schema)
+            if columns:
+                self.schema.set_selected_columns(*columns)
+        except BaseException:
+            # a rejected open must not leak the fd it opened (nor pin
+            # an in-memory source via the exported buffer)
+            if self._buf is not None:
+                self._buf.release()
+                self._buf = None
+            if self._owns:
+                self._f.close()
+            raise
         self._rg_pos = 0          # next row group to load
         self._loaded = False      # current row group loaded into stores
         self._current_rg = 0      # last loaded (or next) row group index
         self._current_record = 0
         self._rg_records = 0
+
+    def _resolve_metadata(self, strict_metadata, salvage,
+                          salvage_like) -> FileMetaData:
+        """Footer read + optional strict validation + optional salvage.
+        All paths annotate raised errors with the file name and count
+        the salvage/reject observables on the active collector."""
+        from ..errors import CorruptFooterError
+        from ..format.validate import (
+            strict_metadata_default,
+            validate_metadata,
+            raise_on_errors,
+        )
+
+        if strict_metadata is None:
+            strict_metadata = strict_metadata_default()
+        try:
+            meta = read_file_metadata(self._f)
+        except CorruptFooterError as e:
+            if not salvage:
+                raise e.annotate(file=self.name)
+            # footer unusable: rebuild from the pages (forward scan)
+            from ..format.recover import recover_file_metadata
+
+            meta, report = recover_file_metadata(
+                self._f, like=salvage_like,
+                verify_crc=(self._verify_crc
+                            if self._verify_crc is not None else True))
+            report["footer_error"] = str(e)
+            self._mark_salvaged(meta, report)
+            return meta
+        if not (strict_metadata or salvage):
+            return meta
+        size = _source_size(self._f)
+        findings = validate_metadata(meta, size)
+        self.metadata_findings = findings
+        if not any(f.is_error for f in findings):
+            return meta
+        if salvage:
+            # footer decodes but lies.  Two independent salvage routes:
+            # trim to the validated row-group prefix (keeps the richer
+            # footer metadata), or rebuild from the pages themselves
+            # (donor schema / the file's own embedded hint — a lying
+            # footer over INTACT pages loses nothing that way).  Take
+            # whichever recovers more row groups; tie goes to the trim.
+            from ..format.recover import (
+                recover_file_metadata,
+                salvage_valid_prefix,
+            )
+
+            trimmed = salvage_valid_prefix(meta, size,
+                                           findings=findings)
+            if trimmed is not None and len(trimmed[0].row_groups) \
+                    == len(meta.row_groups):
+                # the trim kept everything (repairable file-level lie
+                # only): page recovery cannot beat it, skip the scan
+                meta, report = trimmed
+                self._mark_salvaged(meta, report)
+                return meta
+            try:
+                rebuilt = recover_file_metadata(
+                    self._f, like=salvage_like,
+                    verify_crc=(self._verify_crc
+                                if self._verify_crc is not None
+                                else True))
+            except CorruptFooterError:
+                rebuilt = None  # no donor and no hint
+            best = None
+            if trimmed is not None and (
+                    rebuilt is None
+                    or len(trimmed[0].row_groups)
+                    >= len(rebuilt[0].row_groups)):
+                best = trimmed
+            elif rebuilt is not None:
+                best = rebuilt
+            if best is not None:
+                meta, report = best
+                self._mark_salvaged(meta, report)
+                return meta
+            # neither route usable: fall through to the strict reject
+        from ..stats import current_stats
+
+        st = current_stats()
+        if st is not None:
+            st.metadata_rejects += 1
+            if st.events is not None:
+                st.events.fault(site="io.reader.footer",
+                                kind="metadata_reject", file=self.name)
+        try:
+            raise_on_errors(findings, file=self.name)
+        except CorruptFooterError as e:
+            raise e.annotate(file=self.name)
+        return meta
+
+    def _mark_salvaged(self, meta: FileMetaData, report: dict) -> None:
+        from ..stats import current_stats
+
+        self.salvaged = True
+        self.salvage_report = report
+        st = current_stats()
+        if st is not None:
+            st.files_salvaged += 1
+            st.row_groups_recovered += len(meta.row_groups or [])
+            if st.events is not None:
+                st.events.fault(
+                    site="io.reader.footer", kind="salvaged",
+                    file=self.name,
+                    row_groups=len(meta.row_groups or []),
+                    stop_reason=report.get("stop_reason"))
 
     # -- metadata accessors ------------------------------------------------
 

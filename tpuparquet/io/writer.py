@@ -101,6 +101,7 @@ class FileWriter:
         allow_dict: bool = True,
         write_stats: bool = True,
         page_crc: bool | None = None,
+        salvage_hint: bool | None = None,
     ):
         self._f = f
         self._pos = 0
@@ -123,6 +124,14 @@ class FileWriter:
 
             page_crc = page_crc_default()
         self.page_crc = bool(page_crc)
+        # salvage hint: a tiny schema+codec frame behind the head magic
+        # (format/recover.py) that makes a torn write self-salvaging.
+        # Spec-compatible — footers address pages absolutely, so foreign
+        # readers skip it.  Default ON; disable with TPQ_SALVAGE_HINT=0
+        # or per-writer.
+        if salvage_hint is None:
+            salvage_hint = os.environ.get("TPQ_SALVAGE_HINT", "1") != "0"
+        self.salvage_hint = bool(salvage_hint)
 
         if schema is None:
             self.schema = Schema.empty()
@@ -548,10 +557,19 @@ class FileWriter:
             reps={l.flat_name: r for (l, _c, _d, r) in prepared},
         )
 
+    def _write_head(self) -> None:
+        """Leading magic + (optionally) the salvage hint frame."""
+        self._write(MAGIC)
+        if self.salvage_hint and self.schema.leaves:
+            from ..format.recover import encode_salvage_hint
+
+            self._write(encode_salvage_hint(
+                self.schema, self.codec, created_by=self.created_by))
+
     def _flush_prepared(self, prepared, n_rows, kv_global, kv_per_column,
                         reps=None) -> None:
         if self._pos == 0:
-            self._write(MAGIC)
+            self._write_head()
         jobs = []
         for entry in prepared:
             leaf, column, dl = entry[0], entry[1], entry[2]
@@ -676,7 +694,7 @@ class FileWriter:
             return
         self.flush_row_group()
         if self._pos == 0:
-            self._write(MAGIC)  # valid empty file still needs framing
+            self._write_head()  # valid empty file still needs framing
         kv = [KeyValue(key=k, value=v)
               for k, v in sorted(self.kv_metadata.items())] or None
         meta = FileMetaData(

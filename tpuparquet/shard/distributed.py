@@ -142,22 +142,38 @@ class MultiHostScan:
     ``on_error="quarantine"`` isolates failing units per host instead
     of aborting the fleet (coordinates + error class in
     :attr:`quarantine`, same semantics as
-    :class:`~tpuparquet.shard.scan.ShardedScan`);
+    :class:`~tpuparquet.shard.scan.ShardedScan`); files whose footer
+    fails to open/validate are quarantined (or, with ``salvage=True``,
+    salvaged to their readable prefix) at FILE granularity — see
+    :func:`~tpuparquet.shard.scan.open_sources`;
     :meth:`allgather_quarantine` folds every host's report into the
     fleet-wide list."""
 
     def __init__(self, sources, *columns: str, mesh=None, resume=None,
-                 on_error: str = "raise", retries: int | None = None):
+                 on_error: str = "raise", retries: int | None = None,
+                 salvage: bool = False,
+                 strict_metadata: bool | None = None):
         from ..faults import QuarantineReport
-        from ..io.reader import FileReader
         from .mesh import make_mesh
-        from .scan import scan_units
+        from .scan import open_sources, scan_units
 
         if on_error not in ("raise", "quarantine"):
             raise ValueError(
                 f"on_error must be 'raise' or 'quarantine', "
                 f"not {on_error!r}")
-        self.readers = [FileReader(s, *columns) for s in sources]
+        # every process opens every source (salvage is deterministic,
+        # so all hosts derive the identical reader/unit list), but a
+        # failed/salvaged FILE is recorded by exactly one process
+        # (index mod grid) so fleet-folded counters and the
+        # allgathered quarantine count each file once
+        p, n = jax.process_index(), jax.process_count()
+        self._open_quarantine = QuarantineReport()
+        self.readers = open_sources(
+            sources, columns, on_error=on_error,
+            quarantine=self._open_quarantine, salvage=salvage,
+            strict_metadata=strict_metadata,
+            record_for=lambda i: i % n == p,
+            entry_extra={"process_index": p})
         self.global_units = scan_units(self.readers)
         self.local_units = process_units(self.global_units)
         # make_mesh defaults to LOCAL devices (see its docstring; the
@@ -166,7 +182,8 @@ class MultiHostScan:
         self.devices = list(self.mesh.devices.flat)
         self.on_error = on_error
         self.retries = retries
-        self.quarantine = QuarantineReport()
+        self.quarantine = QuarantineReport(
+            self._open_quarantine.as_dicts())
         self._next_local = 0
         if resume is not None:
             self._load_cursor(resume)
@@ -253,7 +270,8 @@ class MultiHostScan:
 
         self._next_local = 0
         if self.on_error == "quarantine":
-            self.quarantine = QuarantineReport()
+            self.quarantine = QuarantineReport(
+                self._open_quarantine.as_dicts())
         return [out for _, out in self.run_iter()]
 
     def run_with_stats(self, events: bool = False):

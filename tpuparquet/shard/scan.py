@@ -25,17 +25,155 @@ from ..kernels.device import (
     read_row_group_device_resilient,
 )
 
-__all__ = ["ShardedScan", "scan_units", "pipelined_unit_scan",
-           "resilient_unit_scan", "gather_column", "gather_byte_column"]
+__all__ = ["ShardedScan", "scan_units", "open_sources",
+           "pipelined_unit_scan", "resilient_unit_scan",
+           "gather_column", "gather_byte_column"]
 
 
 def scan_units(readers: list[FileReader]) -> list[tuple[int, int]]:
-    """Flatten files into (file_index, row_group_index) work units."""
+    """Flatten files into (file_index, row_group_index) work units.
+    ``None`` entries (files quarantined at open time) contribute no
+    units but keep the file-index space stable."""
     return [
         (fi, rgi)
         for fi, r in enumerate(readers)
+        if r is not None
         for rgi in range(r.row_group_count())
     ]
+
+
+def open_sources(sources, columns, *, on_error: str,
+                 quarantine: QuarantineReport,
+                 salvage: bool = False,
+                 strict_metadata: bool | None = None,
+                 record_for=None,
+                 entry_extra: dict | None = None) -> list:
+    """Open scan sources with the file-level fault policy.
+
+    Returns a reader list aligned with ``sources`` (``None`` where the
+    file was quarantined).  Under ``on_error="raise"`` any open or
+    strict-validation failure propagates — the seed behavior.  Under
+    ``"quarantine"``, a failing file is isolated into ``quarantine``
+    as a FILE-granularity entry and the scan proceeds without it; with
+    ``salvage=True`` a failing file is first retried through the
+    salvage path (its own hint, else the first healthy file as schema
+    donor — every shard of a homogeneous dataset is a donor), keeping
+    the recovered row-group prefix and quarantining only the torn
+    remainder.  Salvage is deterministic, so every host of a
+    multi-process scan derives the identical reader/unit list;
+    ``record_for(i)`` optionally filters which file indices THIS
+    process records (so fleet-folded counters count each file once).
+
+    Raw crash types propagate — same contract as the unit loop.
+    """
+    from ..stats import current_stats
+
+    if salvage and on_error != "quarantine":
+        # under "raise" the first open failure aborts before any
+        # salvage retry could run; accepting the kwarg would make the
+        # explicit salvage request silently inert
+        raise ValueError(
+            "salvage=True requires on_error='quarantine' (under "
+            "'raise' the first open failure aborts the scan)")
+
+    readers: list = [None] * len(sources)
+    failures: dict[int, BaseException] = {}
+    donor = None
+
+    def _record(i):
+        return record_for is None or record_for(i)
+
+    import contextlib
+
+    @contextlib.contextmanager
+    def _counters_only_if_recorded(i):
+        """FileReader increments files_salvaged / row_groups_recovered /
+        metadata_rejects (and emits salvage/reject fault events)
+        itself; on a multi-process scan every host opens every source,
+        so for files this host does NOT record, roll the collector
+        back — fleet-folded counters and event logs then count each
+        file exactly once (matching the quarantine entries)."""
+        st = current_stats()
+        if st is None or _record(i):
+            yield
+            return
+        # crc_mismatches/faults_injected too: the salvage forward scan
+        # counts CRC rejects on every host that runs it
+        fields = ("files_salvaged", "row_groups_recovered",
+                  "metadata_rejects", "crc_mismatches",
+                  "faults_injected")
+        before = tuple(getattr(st, f) for f in fields)
+        n_faults = len(st.events.faults) if st.events is not None \
+            else None
+        try:
+            yield
+        finally:
+            for f, v in zip(fields, before):
+                setattr(st, f, v)
+            if n_faults is not None:
+                del st.events.faults[n_faults:]
+
+    from ..faults import retry_transient
+
+    for i, src in enumerate(sources):
+        try:
+            with _counters_only_if_recorded(i):
+                # same retry policy as chunk reads: a flaky-store blip
+                # at open time gets backoff before it can cost the
+                # whole file (retry_transient re-raises non-transient
+                # errors immediately)
+                readers[i] = retry_transient(lambda src=src: FileReader(
+                    src, *columns, strict_metadata=strict_metadata))
+            if donor is None:
+                donor = readers[i].meta
+        except QUARANTINE_ERRORS as e:
+            if never_quarantine(e) or on_error != "quarantine":
+                raise
+            failures[i] = e
+
+    for i, err in sorted(failures.items()):
+        path = sources[i] if isinstance(sources[i], str) else None
+        if salvage:
+            try:
+                with _counters_only_if_recorded(i):
+                    r = FileReader(sources[i], *columns, salvage=True,
+                                   salvage_like=donor,
+                                   strict_metadata=strict_metadata)
+            except QUARANTINE_ERRORS as e2:
+                if never_quarantine(e2):
+                    raise
+            else:
+                readers[i] = r
+                if _record(i):
+                    extra = {"disposition": "salvaged",
+                             "row_groups_recovered":
+                                 r.row_group_count()}
+                    if path is not None:
+                        extra["path"] = path
+                    if r.salvage_report:
+                        for k in ("stop_reason", "bytes_lost"):
+                            if k in r.salvage_report:
+                                extra[k] = r.salvage_report[k]
+                    entry = quarantine.add_file(file=i, error=err,
+                                                **extra)
+                    if entry_extra:
+                        entry.update(entry_extra)
+                continue
+        if not _record(i):
+            continue
+        extra = {"disposition": "quarantined"}
+        if path is not None:
+            extra["path"] = path
+        entry = quarantine.add_file(file=i, error=err, **extra)
+        if entry_extra:
+            entry.update(entry_extra)
+        st = current_stats()
+        if st is not None:
+            st.files_quarantined += 1
+            if st.events is not None:
+                st.events.fault(site="shard.scan.file",
+                                kind="file_quarantined", **entry)
+    return readers
 
 
 def cursor_state(units, next_key: str, next_value: int, **extra) -> dict:
@@ -122,7 +260,10 @@ def resilient_unit_scan(readers, units, device_for, *, start: int = 0,
 class ShardedScan:
     """Decode many files' row groups data-parallel across a mesh.
 
-    ``sources`` are paths or file objects; ``columns`` optionally project.
+    ``sources`` are paths or file objects, opened by the scan itself
+    (lazily tolerant — see :func:`open_sources`), so a corrupt FILE is
+    a policy decision, not a constructor crash; ``columns`` optionally
+    project.
     :meth:`run` decodes every unit on its round-robin device and returns
     per-unit ``{path: DeviceColumn}`` dicts; results stay device-resident
     and sharded until explicitly gathered.  Host planning of unit N+1
@@ -149,10 +290,24 @@ class ShardedScan:
       carries the report, so a resumed scan neither re-decodes nor
       forgets them.  This mode trades the plan/transfer pipeline
       overlap for isolation (units decode one at a time).
+
+    File-level policy (this round):
+
+    * ``strict_metadata`` — validate every footer at open
+      (``format/validate.py``); under ``"quarantine"`` a rejected file
+      becomes a file-granularity quarantine entry instead of an abort.
+    * ``salvage`` — auto-salvage-then-quarantine-remainder: a file
+      whose footer is torn/invalid is reopened through the salvage
+      path (``FileReader(salvage=True)``, schema donated by its hint
+      or the first healthy file); its recovered row groups join the
+      unit list, and only the unreadable remainder lands in
+      :attr:`quarantine`.
     """
 
     def __init__(self, sources, *columns: str, mesh=None, resume=None,
-                 on_error: str = "raise", retries: int | None = None):
+                 on_error: str = "raise", retries: int | None = None,
+                 salvage: bool = False,
+                 strict_metadata: bool | None = None):
         from .mesh import make_mesh
 
         if on_error not in ("raise", "quarantine"):
@@ -160,12 +315,20 @@ class ShardedScan:
                 f"on_error must be 'raise' or 'quarantine', "
                 f"not {on_error!r}")
         self.mesh = mesh if mesh is not None else make_mesh()
-        self.readers = [FileReader(s, *columns) for s in sources]
+        # file-level entries recorded at open time live in their own
+        # report so run() can reset the unit-level entries without
+        # forgetting the files that never produced units
+        self._open_quarantine = QuarantineReport()
+        self.readers = open_sources(
+            sources, columns, on_error=on_error,
+            quarantine=self._open_quarantine, salvage=salvage,
+            strict_metadata=strict_metadata)
         self.units = scan_units(self.readers)
         self.devices = list(self.mesh.devices.flat)
         self.on_error = on_error
         self.retries = retries
-        self.quarantine = QuarantineReport()
+        self.quarantine = QuarantineReport(
+            self._open_quarantine.as_dicts())
         self._next_unit = 0
         if resume is not None:
             self._load_cursor(resume)
@@ -226,7 +389,8 @@ class ShardedScan:
         with their true unit indices for positional consumers."""
         self._next_unit = 0
         if self.on_error == "quarantine":
-            self.quarantine = QuarantineReport()
+            self.quarantine = QuarantineReport(
+                self._open_quarantine.as_dicts())
         return [out for _, out in self.run_iter()]
 
     def run_with_stats(self, events: bool = False):
@@ -244,7 +408,8 @@ class ShardedScan:
 
     def close(self):
         for r in self.readers:
-            r.close()
+            if r is not None:
+                r.close()
 
     def __enter__(self):
         return self

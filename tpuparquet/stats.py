@@ -104,6 +104,19 @@ class DecodeStats:
     # scan units isolated by on_error="quarantine" (coordinates live in
     # the scan's QuarantineReport; this is the fleet-foldable total)
     units_quarantined: int = 0
+    # -- file-level salvage observables (format/validate.py, recover.py) --
+    # whole files whose footer was torn/invalid and were opened through
+    # the salvage path (readable row-group prefix only), and the row
+    # groups those salvages recovered
+    files_salvaged: int = 0
+    row_groups_recovered: int = 0
+    # whole files a sharded scan quarantined at open time (footer
+    # unusable and salvage off/failed); per-file coordinates live in
+    # the scan's QuarantineReport
+    files_quarantined: int = 0
+    # footers rejected by strict metadata validation
+    # (FileReader(strict_metadata=True) / TPQ_STRICT_METADATA)
+    metadata_rejects: int = 0
     # where the device-path wall went, accumulated per unit: host plan
     # phase (page walk, decompression, run-table scans — overlapped with
     # transfer by the pipelined reader, so plan_s can exceed the e2e
@@ -134,6 +147,8 @@ class DecodeStats:
         "native_fallbacks", "pages_crc_verified", "crc_mismatches",
         "faults_injected", "io_retries", "dispatch_retries",
         "pages_degraded", "units_degraded", "units_quarantined",
+        "files_salvaged", "row_groups_recovered", "files_quarantined",
+        "metadata_rejects",
         "plan_s", "transfer_s", "dispatch_s",
     )
 
@@ -191,6 +206,10 @@ class DecodeStats:
             "pages_degraded": self.pages_degraded,
             "units_degraded": self.units_degraded,
             "units_quarantined": self.units_quarantined,
+            "files_salvaged": self.files_salvaged,
+            "row_groups_recovered": self.row_groups_recovered,
+            "files_quarantined": self.files_quarantined,
+            "metadata_rejects": self.metadata_rejects,
             "plan_s": round(self.plan_s, 6),
             "transfer_s": round(self.transfer_s, 6),
             "dispatch_s": round(self.dispatch_s, 6),
@@ -226,6 +245,12 @@ class DecodeStats:
                    or d["io_retries"] or d["dispatch_retries"]
                    or d["pages_degraded"] or d["units_degraded"]
                    or d["units_quarantined"]) else "")
+            + (f"; SALVAGE: {d['files_salvaged']} files salvaged "
+               f"({d['row_groups_recovered']} row groups recovered), "
+               f"{d['files_quarantined']} files quarantined, "
+               f"{d['metadata_rejects']} metadata rejects"
+               if (d["files_salvaged"] or d["files_quarantined"]
+                   or d["metadata_rejects"]) else "")
         )
 
     def histograms_dict(self) -> dict:
