@@ -1,0 +1,53 @@
+"""Subprocess body for the SIGKILL/resume durable-checkpoint sweep
+(``tests/test_checkpoint.py``).
+
+Scans the given files with a durable cursor (``resume_from=`` +
+``checkpoint_every=1``) and persists each decoded unit the way a
+crash-safe consumer must: atomic per-unit output files keyed by unit
+index (tmp + rename), plus an append-only decode log used by the
+parent to count re-decodes.  The parent SIGKILLs this process at
+arbitrary points and re-runs it until the scan completes; the union of
+outputs must be complete, duplicate-free (keyed), and bit-exact.
+
+Usage: python tests/checkpoint_child.py <ckpt> <outdir> <file>...
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the interpreter puts tests/ on sys.path (the script's directory);
+# the library lives one level up
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from tpuparquet.shard import ShardedScan  # noqa: E402
+
+
+def main() -> int:
+    ckpt, outdir = sys.argv[1], sys.argv[2]
+    paths = sys.argv[3:]
+    log = os.path.join(outdir, "decode.log")
+    scan = ShardedScan(paths, resume_from=ckpt, checkpoint_every=1,
+                       on_error="quarantine")
+    for k, out in scan.run_iter():
+        vals, _rep, _dl = out["a"].to_numpy()
+        arr = np.asarray(vals).ravel()
+        # the crash-safe consumer contract: log the decode, then
+        # persist the result atomically under its unit key BEFORE the
+        # scan checkpoints past it (checkpoint_every=1 checkpoints on
+        # the next iteration step)
+        with open(log, "a") as f:
+            f.write(f"{k}\n")
+            f.flush()
+            os.fsync(f.fileno())
+        tmp = os.path.join(outdir, f".unit{k}.tmp.npy")
+        np.save(tmp, arr)
+        os.replace(tmp, os.path.join(outdir, f"unit{k}.npy"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,317 @@
+"""Crash-safe durable cursor checkpoints: atomic save/load, auto-
+checkpoint cadence, quarantine dedup on resume, and the
+SIGKILL-and-resume consistency sweep (satellite of the deadline
+round): kill a subprocess scan at arbitrary points, resume from the
+durable checkpoint, and the union of decoded units must be complete,
+duplicate-free, and bit-exact.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from tpuparquet import FileWriter
+from tpuparquet.shard import (
+    MultiHostScan,
+    ShardedScan,
+    host_cursor_path,
+    load_cursor_file,
+    save_cursor_file,
+)
+
+N_RG = 3
+N = 150
+
+
+def write_file(path, n_rg: int = N_RG, base: int = 0) -> None:
+    buf = io.BytesIO()
+    w = FileWriter(buf, "message m { required int64 a; }")
+    for rg in range(n_rg):
+        lo = base + rg * N
+        w.write_columns({"a": np.arange(lo, lo + N, dtype=np.int64)})
+    w.close()
+    with open(path, "wb") as f:
+        f.write(buf.getvalue())
+
+
+def unit_values(out) -> np.ndarray:
+    vals, _rep, _dl = out["a"].to_numpy()
+    return np.asarray(vals).ravel()
+
+
+# ----------------------------------------------------------------------
+# Cursor file format
+# ----------------------------------------------------------------------
+
+class TestCursorFile:
+    def test_roundtrip(self, tmp_path):
+        cur = {"version": 1, "next_unit": 3,
+               "units": [[0, 0], [0, 1]], "quarantine": []}
+        p = tmp_path / "c.json"
+        save_cursor_file(cur, str(p))
+        assert load_cursor_file(str(p)) == cur
+
+    def test_atomic_no_tmp_left_behind(self, tmp_path):
+        p = tmp_path / "c.json"
+        for i in range(3):
+            save_cursor_file({"version": 1, "i": i}, str(p))
+        leftovers = [f for f in os.listdir(tmp_path) if "tmp" in f]
+        assert leftovers == []
+        assert load_cursor_file(str(p))["i"] == 2
+
+    def test_corruption_detected(self, tmp_path):
+        p = tmp_path / "c.json"
+        save_cursor_file({"version": 1, "next_unit": 2}, str(p))
+        raw = p.read_bytes()
+        # flip a digit inside the payload, keeping valid JSON
+        doc = json.loads(raw)
+        doc["cursor"]["next_unit"] = 7
+        p.write_text(json.dumps(doc))
+        with pytest.raises(ValueError, match="integrity checksum"):
+            load_cursor_file(str(p))
+
+    def test_not_json_rejected(self, tmp_path):
+        p = tmp_path / "c.json"
+        p.write_text("{torn")
+        with pytest.raises(ValueError, match="JSON"):
+            load_cursor_file(str(p))
+
+    def test_wrong_format_and_version_rejected(self, tmp_path):
+        p = tmp_path / "c.json"
+        p.write_text(json.dumps({"format": "other"}))
+        with pytest.raises(ValueError, match="not a tpq cursor"):
+            load_cursor_file(str(p))
+        p.write_text(json.dumps({"format": "tpq-cursor",
+                                 "file_version": 99}))
+        with pytest.raises(ValueError, match="file_version"):
+            load_cursor_file(str(p))
+
+
+# ----------------------------------------------------------------------
+# In-process auto-checkpoint / resume
+# ----------------------------------------------------------------------
+
+class TestAutoCheckpoint:
+    def test_resume_from_continues_where_left_off(self, tmp_path):
+        p = tmp_path / "f.parquet"
+        write_file(p)
+        ckpt = str(tmp_path / "ckpt.json")
+
+        scan = ShardedScan([str(p)], resume_from=ckpt,
+                           checkpoint_every=1)
+        it = scan.run_iter()
+        next(it)
+        next(it)  # consuming unit 1 checkpoints unit 0
+        it.close()
+        assert load_cursor_file(ckpt)["next_unit"] == 1
+
+        scan2 = ShardedScan([str(p)], resume_from=ckpt,
+                            checkpoint_every=1)
+        got = dict(scan2.run_iter())
+        assert sorted(got) == [1, 2]
+        for k in got:
+            np.testing.assert_array_equal(
+                unit_values(got[k]), np.arange(k * N, (k + 1) * N))
+        # scan completed: the final flush covers everything
+        assert load_cursor_file(ckpt)["next_unit"] == N_RG
+        scan3 = ShardedScan([str(p)], resume_from=ckpt)
+        assert list(scan3.run_iter()) == []
+
+    def test_checkpoint_every_cadence(self, tmp_path):
+        from tpuparquet import collect_stats
+
+        p = tmp_path / "f.parquet"
+        write_file(p)
+        ckpt = str(tmp_path / "ckpt.json")
+        with collect_stats() as st:
+            scan = ShardedScan([str(p)], resume_from=ckpt,
+                               checkpoint_every=2)
+            list(scan.run_iter())
+        # 3 units, cadence 2: one at unit 2, one final flush
+        assert st.checkpoints_written == 2
+        assert load_cursor_file(ckpt)["next_unit"] == N_RG
+
+    def test_checkpoint_env_default(self, tmp_path, monkeypatch):
+        from tpuparquet.shard.scan import checkpoint_every_default
+
+        monkeypatch.setenv("TPQ_CHECKPOINT_EVERY", "5")
+        assert checkpoint_every_default() == 5
+        monkeypatch.delenv("TPQ_CHECKPOINT_EVERY")
+        assert checkpoint_every_default() == 16
+
+    def test_explicit_cursor_save(self, tmp_path):
+        p = tmp_path / "f.parquet"
+        write_file(p)
+        scan = ShardedScan([str(p)])
+        it = scan.run_iter()
+        next(it)
+        it.close()
+        with pytest.raises(ValueError, match="no checkpoint path"):
+            scan.cursor_save()
+        out = str(tmp_path / "explicit.json")
+        scan.cursor_save(out)
+        assert load_cursor_file(out)["next_unit"] == 1
+
+    def test_resume_and_resume_from_conflict(self, tmp_path):
+        p = tmp_path / "f.parquet"
+        write_file(p)
+        scan = ShardedScan([str(p)])
+        cur = scan.state()
+        with pytest.raises(ValueError, match="not both"):
+            ShardedScan([str(p)], resume=cur,
+                        resume_from=str(tmp_path / "c.json"))
+
+    def test_quarantine_dedup_on_resume(self, tmp_path):
+        """Satellite fix: a resumed scan re-opens a file already
+        quarantined in the checkpointed cursor — the report must not
+        list the file twice."""
+        good = tmp_path / "good.parquet"
+        torn = tmp_path / "torn.parquet"
+        write_file(good)
+        write_file(torn, base=10_000)
+        data = torn.read_bytes()
+        torn.write_bytes(data[: len(data) - 11])  # tear the footer
+        ckpt = str(tmp_path / "ckpt.json")
+
+        scan = ShardedScan([str(good), str(torn)],
+                           on_error="quarantine", resume_from=ckpt,
+                           checkpoint_every=1)
+        n1 = len(list(scan.run_iter()))
+        assert n1 == N_RG
+        assert len(scan.quarantine) == 1
+        assert scan.quarantine.files() == [1]
+
+        scan2 = ShardedScan([str(good), str(torn)],
+                            on_error="quarantine", resume_from=ckpt,
+                            checkpoint_every=1)
+        assert list(scan2.run_iter()) == []
+        assert len(scan2.quarantine) == 1  # deduped, not doubled
+        assert scan2.quarantine.files() == [1]
+
+    def test_multihost_per_host_checkpoint(self, tmp_path):
+        p = tmp_path / "f.parquet"
+        write_file(p)
+        base = str(tmp_path / "mh.json")
+        scan = MultiHostScan([str(p)], resume_from=base,
+                             checkpoint_every=1)
+        it = scan.run_iter()
+        next(it)
+        next(it)
+        it.close()
+        host_file = host_cursor_path(base, 0)
+        assert os.path.exists(host_file)
+        assert not os.path.exists(base)  # only per-host files
+        cur = load_cursor_file(host_file)
+        assert cur["process_count"] == 1 and cur["process_index"] == 0
+
+        scan2 = MultiHostScan([str(p)], resume_from=base,
+                              checkpoint_every=1)
+        got = dict(scan2.run_iter())
+        assert sorted(got) == [1, 2]
+
+
+# ----------------------------------------------------------------------
+# SIGKILL-and-resume sweep (subprocess)
+# ----------------------------------------------------------------------
+
+CHILD = os.path.join(os.path.dirname(__file__), "checkpoint_child.py")
+
+
+def _child_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("TPQ_RETRY_BASE_S", "0.001")
+    env.setdefault("TPQ_RETRY_MAX_S", "0.002")
+    return env
+
+
+def _spawn(ckpt, outdir, paths):
+    return subprocess.Popen(
+        [sys.executable, CHILD, ckpt, str(outdir)] + paths,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(CHILD))),
+        env=_child_env(), stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL)
+
+
+def _unit_files(outdir):
+    return sorted(f for f in os.listdir(outdir)
+                  if f.startswith("unit") and f.endswith(".npy"))
+
+
+class TestKillResumeSweep:
+    """SIGKILL a subprocess scan at several points (after K completed
+    units, and at a pseudo-random delay past first output), resume
+    from the durable checkpoint, and assert the union of decoded
+    units is bit-exact, complete, and duplicate-free."""
+
+    def test_kill_and_resume_union_exact(self, tmp_path):
+        paths = []
+        for s in range(2):
+            p = tmp_path / f"f{s}.parquet"
+            write_file(p, base=s * 100_000)
+            paths.append(str(p))
+        n_units = 2 * N_RG
+        outdir = tmp_path / "out"
+        outdir.mkdir()
+        ckpt = str(tmp_path / "ckpt.json")
+
+        rng = np.random.default_rng(20260804)
+        kills = 0
+        # kill after 1 completed unit, after 3, then at a random
+        # delay past first output — then run to completion
+        for kill_at, delay in ((1, 0.0), (3, 0.0),
+                               (1, float(rng.uniform(0.01, 0.3)))):
+            if len(_unit_files(outdir)) >= n_units:
+                break
+            proc = _spawn(ckpt, outdir, paths)
+            deadline = time.monotonic() + 120
+            while (len(_unit_files(outdir)) < kill_at
+                   and proc.poll() is None
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            if proc.poll() is None:
+                if delay:
+                    time.sleep(delay)
+                proc.send_signal(signal.SIGKILL)
+                kills += 1
+            proc.wait(timeout=60)
+
+        # final uninterrupted run completes the scan
+        proc = _spawn(ckpt, outdir, paths)
+        assert proc.wait(timeout=180) == 0
+
+        # complete: every unit present exactly once (keyed files)
+        files = _unit_files(outdir)
+        assert files == sorted((f"unit{k}.npy" for k in range(n_units)),
+                               key=lambda s: int(s[4:-4]))
+
+        # bit-exact: the union equals the oracle decode
+        oracle = ShardedScan(paths)
+        expected = {k: unit_values(out)
+                    for k, out in oracle.run_iter()}
+        for k in range(n_units):
+            got = np.load(os.path.join(outdir, f"unit{k}.npy"))
+            np.testing.assert_array_equal(got, expected[k],
+                                          err_msg=f"unit {k}")
+
+        # duplicate-free modulo the at-least-once window: with
+        # checkpoint_every=1, each kill can force at most ONE unit to
+        # be re-decoded (the one consumed but not yet checkpointed)
+        with open(outdir / "decode.log") as f:
+            decoded = [int(line) for line in f if line.strip()]
+        counts = {k: decoded.count(k) for k in set(decoded)}
+        assert sorted(counts) == list(range(n_units))
+        re_decodes = sum(c - 1 for c in counts.values())
+        assert re_decodes <= kills
+        # the checkpoint made resume cheap: the scan was NOT restarted
+        # from scratch every time
+        assert len(decoded) <= n_units + kills

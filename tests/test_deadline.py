@@ -1,0 +1,436 @@
+"""Deadline-aware scans: watchdog timeouts, hedged reads over replica
+sources, and the time-domain knobs.
+
+Acceptance gate of the deadline round: with ``io.chunk.hang`` injected
+on the primary replica, a mirrored scan completes bit-exact via hedged
+reads (no quarantine needed); with no mirror, the hung unit lands in
+the QuarantineReport as a ``DeadlineExceededError`` instead of
+stalling; a hung device dispatch degrades to the bit-exact CPU decode
+via ``DispatchDeadlineError``.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import time
+
+import numpy as np
+import pytest
+
+from tpuparquet import (
+    DeadlineExceededError,
+    DispatchDeadlineError,
+    FileReader,
+    FileWriter,
+    TransientIOError,
+    collect_stats,
+    inject_faults,
+)
+from tpuparquet.deadline import (
+    LatencyTracker,
+    call_with_deadline,
+    hedge_delay_default,
+    hedged_call,
+    unit_deadline_default,
+)
+from tpuparquet.faults import backoff_delays
+from tpuparquet.kernels.device import read_row_group_device_resilient
+from tpuparquet.shard import ShardedScan
+
+
+@pytest.fixture(autouse=True)
+def _fast_backoff(monkeypatch):
+    monkeypatch.setenv("TPQ_RETRY_BASE_S", "0.0005")
+    monkeypatch.setenv("TPQ_RETRY_MAX_S", "0.002")
+
+
+N_RG = 3
+N = 200
+
+
+def write_file(path) -> None:
+    buf = io.BytesIO()
+    w = FileWriter(buf, "message m { required int64 a; }")
+    for rg in range(N_RG):
+        w.write_columns(
+            {"a": np.arange(rg * N, rg * N + N, dtype=np.int64)})
+    w.close()
+    with open(path, "wb") as f:
+        f.write(buf.getvalue())
+
+
+def unit_values(out) -> np.ndarray:
+    vals, _rep, _dl = out["a"].to_numpy()
+    return np.asarray(vals).ravel()
+
+
+def assert_scan_exact(results):
+    assert len(results) == N_RG
+    for rg, out in enumerate(results):
+        np.testing.assert_array_equal(
+            unit_values(out), np.arange(rg * N, rg * N + N))
+
+
+# ----------------------------------------------------------------------
+# backoff jitter (satellite): seedable, deterministic
+# ----------------------------------------------------------------------
+
+class TestBackoffJitter:
+    def test_default_schedule_is_exact(self):
+        # no jitter unless asked: timing assertions elsewhere rely on
+        # the exact exponential schedule
+        assert backoff_delays(retries=3, base=0.01, cap=0.05) == \
+            [0.01, 0.02, 0.04]
+
+    def test_jitter_is_deterministic_per_seed(self):
+        a = backoff_delays(retries=5, base=0.01, cap=1.0,
+                           jitter=0.5, seed=7)
+        b = backoff_delays(retries=5, base=0.01, cap=1.0,
+                           jitter=0.5, seed=7)
+        c = backoff_delays(retries=5, base=0.01, cap=1.0,
+                           jitter=0.5, seed=8)
+        assert a == b
+        assert a != c
+        base = [0.01 * 2 ** i for i in range(5)]
+        assert all(abs(d - e) <= 0.5 * e + 1e-12
+                   for d, e in zip(a, base))
+        assert all(d >= 0 for d in a)
+
+    def test_jitter_env_knobs(self, monkeypatch):
+        monkeypatch.setenv("TPQ_RETRY_JITTER", "0.3")
+        monkeypatch.setenv("TPQ_RETRY_SEED", "42")
+        a = backoff_delays(retries=4, base=0.01, cap=1.0)
+        b = backoff_delays(retries=4, base=0.01, cap=1.0)
+        assert a == b
+        assert a != [0.01 * 2 ** i for i in range(4)]
+
+
+# ----------------------------------------------------------------------
+# call_with_deadline / watchdog
+# ----------------------------------------------------------------------
+
+class TestCallWithDeadline:
+    def test_no_budget_is_plain_call(self):
+        calls = []
+        assert call_with_deadline(lambda: calls.append(1) or "x",
+                                  None, site="t") == "x"
+        assert call_with_deadline(lambda: "y", 0, site="t") == "y"
+        assert calls == [1]
+
+    def test_fast_call_returns_result(self):
+        assert call_with_deadline(lambda: 41 + 1, 5.0, site="t") == 42
+
+    def test_exception_propagates(self):
+        with pytest.raises(KeyError):
+            call_with_deadline(
+                lambda: {}["missing"], 5.0, site="t")
+
+    def test_expiry_raises_with_budget_and_coords(self):
+        with collect_stats(events=True) as st:
+            with pytest.raises(DeadlineExceededError) as ei:
+                call_with_deadline(lambda: time.sleep(3.0), 0.05,
+                                   site="test.hang", column="a",
+                                   row_group=2)
+        e = ei.value
+        assert e.budget == 0.05 and e.elapsed >= 0.05
+        assert e.column == "a" and e.row_group == 2
+        assert isinstance(e, TransientIOError)  # retry ladder class
+        assert st.deadline_exceeded == 1
+        kinds = [f["kind"] for f in st.events.faults]
+        assert "deadline_exceeded" in kinds
+
+    def test_worker_stats_merge_on_success(self):
+        from tpuparquet.stats import current_stats
+
+        def work():
+            st = current_stats()
+            st.io_retries += 3
+            return "ok"
+
+        with collect_stats() as st:
+            assert call_with_deadline(work, 5.0, site="t") == "ok"
+        assert st.io_retries == 3
+
+
+class TestHedgedCall:
+    def test_primary_wins_without_hedging(self):
+        with collect_stats() as st:
+            out = hedged_call([lambda: "p", lambda: "m"],
+                              delay=5.0, site="t")
+        assert out == "p"
+        assert st.hedges_issued == 0 and st.hedges_won == 0
+
+    def test_slow_primary_loses_to_mirror(self):
+        def slow():
+            time.sleep(1.0)
+            return "p"
+
+        with collect_stats(events=True) as st:
+            t0 = time.monotonic()
+            out = hedged_call([slow, lambda: "m"], delay=0.02,
+                              site="t")
+            wall = time.monotonic() - t0
+        assert out == "m"
+        assert wall < 0.9  # did not wait for the primary
+        assert st.hedges_issued == 1 and st.hedges_won == 1
+        kinds = [f["kind"] for f in st.events.faults]
+        assert kinds.count("hedge_issued") == 1
+        assert kinds.count("hedge_won") == 1
+
+    def test_failing_primary_hedges_immediately(self):
+        def bad():
+            raise TransientIOError("nope")
+
+        with collect_stats() as st:
+            out = hedged_call([bad, lambda: "m"], delay=5.0, site="t")
+        assert out == "m"
+        assert st.hedges_issued == 1 and st.hedges_won == 1
+
+    def test_all_branches_fail_raises_primary_error(self):
+        def bad(tag):
+            def f():
+                raise TransientIOError(tag)
+            return f
+
+        with pytest.raises(TransientIOError, match="primary"):
+            hedged_call([bad("primary"), bad("mirror")], delay=0.001,
+                        site="t")
+
+    def test_budget_bounds_hung_branches(self):
+        def hang():
+            time.sleep(3.0)
+            return "late"
+
+        with collect_stats() as st:
+            with pytest.raises(DeadlineExceededError):
+                hedged_call([hang, hang], delay=0.01, site="t",
+                            budget=0.1)
+        assert st.deadline_exceeded == 1
+        assert st.hedges_issued == 1
+
+
+class TestLatencyTracker:
+    def test_p95_drives_hedge_delay(self):
+        t = LatencyTracker(window=100, floor=0.001, default=0.5,
+                           min_samples=8)
+        assert t.hedge_delay() == 0.5  # too few samples
+        for _ in range(95):
+            t.record(0.010)
+        for _ in range(5):
+            t.record(0.200)
+        d = t.hedge_delay()
+        assert 0.010 <= d <= 0.200
+        assert t.quantile(0.5) == 0.010
+
+    def test_window_rolls(self):
+        t = LatencyTracker(window=4)
+        for v in (1.0, 2.0, 3.0, 4.0, 5.0, 6.0):
+            t.record(v)
+        assert len(t) == 4
+
+    def test_env_defaults(self, monkeypatch):
+        monkeypatch.setenv("TPQ_HEDGE_DELAY_S", "0.25")
+        monkeypatch.setenv("TPQ_UNIT_DEADLINE_S", "9")
+        assert hedge_delay_default() == 0.25
+        assert unit_deadline_default() == 9.0
+        monkeypatch.delenv("TPQ_HEDGE_DELAY_S")
+        monkeypatch.delenv("TPQ_UNIT_DEADLINE_S")
+        assert hedge_delay_default() is None
+        assert unit_deadline_default() is None
+        monkeypatch.setenv("TPQ_UNIT_DEADLINE_S", "0")
+        assert unit_deadline_default() is None
+
+
+# ----------------------------------------------------------------------
+# Hang-injection matrix (the acceptance gate)
+# ----------------------------------------------------------------------
+
+class TestHangMatrix:
+    def test_hang_once_read_deadline_retries_to_success(self, tmp_path):
+        """A read that hangs ONCE is abandoned at the deadline and the
+        retry succeeds — transparent recovery, bit-exact result."""
+        p = tmp_path / "f.parquet"
+        write_file(p)
+        with collect_stats() as st, inject_faults() as inj:
+            inj.inject("io.chunk.hang", "hang", times=1, seconds=5.0)
+            with FileReader(str(p), read_deadline=0.05) as r:
+                cols = r.read_row_group_arrays(0)
+        np.testing.assert_array_equal(
+            np.asarray(cols["a"].values), np.arange(N))
+        assert st.deadline_exceeded == 1
+        assert st.io_retries == 1
+
+    def test_expired_read_reopens_the_handle(self, tmp_path):
+        """A read abandoned at its deadline may be hung INSIDE the fd
+        holding the io lock — the reader swaps in a fresh fd + lock so
+        later reads don't queue behind the corpse."""
+        p = tmp_path / "f.parquet"
+        write_file(p)
+        with collect_stats(), inject_faults() as inj:
+            inj.inject("io.chunk.hang", "hang", times=1, seconds=5.0)
+            with FileReader(str(p), read_deadline=0.05) as r:
+                fd0 = r._io.f
+                cols = r.read_row_group_arrays(0)
+                assert r._io.f is not fd0  # reopened after expiry
+                # and the fresh handle serves subsequent units
+                r.read_row_group_arrays(1)
+        np.testing.assert_array_equal(
+            np.asarray(cols["a"].values), np.arange(N))
+
+    def test_hung_primary_hedged_to_mirror_bit_exact(self, tmp_path):
+        """THE acceptance case: primary replica hangs persistently, the
+        mirrored scan completes bit-exact through hedged reads with no
+        quarantine."""
+        p = tmp_path / "f.parquet"
+        m = tmp_path / "m.parquet"
+        write_file(p)
+        write_file(m)
+        with collect_stats() as st, inject_faults() as inj:
+            inj.inject("io.chunk.hang", "hang", times=1000,
+                       match={"file": str(p)}, seconds=10.0)
+            scan = ShardedScan([[str(p), str(m)]], hedge_delay=0.01,
+                               on_error="quarantine",
+                               scan_deadline=60.0)
+            t0 = time.monotonic()
+            results = scan.run()
+            wall = time.monotonic() - t0
+        assert_scan_exact(results)
+        assert len(scan.quarantine) == 0
+        assert st.hedges_issued >= N_RG
+        assert st.hedges_won >= N_RG
+        assert st.units_quarantined == 0
+        assert wall < 60.0
+
+    def test_wedged_primary_unpoisoned_without_deadline(self, tmp_path):
+        """mirrors but NO read_deadline: after two consecutive hedge
+        wins with no completing primary read, the reader swaps out the
+        primary handle on its own — a dead mount can't tax every
+        remaining read a hedge delay, and close() never blocks on the
+        corpse."""
+        p = tmp_path / "f.parquet"
+        m = tmp_path / "m.parquet"
+        write_file(p)
+        write_file(m)
+        with collect_stats() as st, inject_faults() as inj:
+            inj.inject("io.chunk.hang", "hang", times=1000,
+                       match={"file": str(p)}, seconds=30.0)
+            with FileReader(str(p), mirrors=[str(m)],
+                            hedge_delay=0.01) as r:
+                fd0 = r._io.f
+                for rg in range(N_RG):
+                    r.read_row_group_arrays(rg)
+                assert r._io.f is not fd0  # wedged primary swapped
+        assert st.hedges_won >= 2
+
+    def test_hung_primary_no_mirror_quarantined(self, tmp_path):
+        """No mirror: the hung unit costs its budget and lands in the
+        QuarantineReport as DeadlineExceededError — the scan never
+        stalls."""
+        p = tmp_path / "f.parquet"
+        write_file(p)
+        with collect_stats() as st, inject_faults() as inj:
+            inj.inject("io.chunk.hang", "hang", times=1000,
+                       seconds=30.0)
+            scan = ShardedScan([str(p)], on_error="quarantine",
+                               unit_deadline=0.15, retries=0)
+            t0 = time.monotonic()
+            results = scan.run()
+            wall = time.monotonic() - t0
+        assert results == []
+        assert len(scan.quarantine) == N_RG
+        assert all(e["error"] == "DeadlineExceededError"
+                   for e in scan.quarantine.entries)
+        # every entry carries unit coordinates + elapsed/budget
+        for e in scan.quarantine.entries:
+            assert e["row_group"] is not None
+            assert e["budget_s"] == 0.15
+            assert e["elapsed_s"] >= 0.15
+        assert st.units_quarantined == N_RG
+        assert st.deadline_exceeded >= N_RG
+        assert wall < 10.0  # bounded, not hung
+
+    def test_hung_dispatch_degrades_to_cpu(self, tmp_path):
+        """kernels.device.hang + dispatch deadline: the wedged dispatch
+        is abandoned per attempt, retried, then the unit degrades to
+        the bit-exact CPU decode (the hang site is skipped on the
+        degraded re-plan)."""
+        p = tmp_path / "f.parquet"
+        write_file(p)
+        with collect_stats() as st, inject_faults() as inj:
+            inj.inject("kernels.device.hang", "hang", times=1000,
+                       seconds=10.0)
+            with FileReader(str(p)) as r:
+                out = read_row_group_device_resilient(
+                    r, 0, retries=1, dispatch_deadline=0.05,
+                    sleep=lambda s: None)
+        np.testing.assert_array_equal(unit_values(out), np.arange(N))
+        assert st.units_degraded == 1
+        assert st.dispatch_retries == 1
+        assert st.deadline_exceeded == 2  # initial attempt + 1 retry
+
+    def test_dispatch_deadline_error_class(self, tmp_path):
+        from tpuparquet import DeviceDispatchError
+
+        assert issubclass(DispatchDeadlineError, DeviceDispatchError)
+        assert issubclass(DeadlineExceededError, TransientIOError)
+
+    def test_scan_deadline_stops_between_units_resumable(self, tmp_path):
+        p = tmp_path / "f.parquet"
+        write_file(p)
+        scan = ShardedScan([str(p)], scan_deadline=1e-9)
+        with pytest.raises(DeadlineExceededError, match="resume"):
+            scan.run()
+        # cursor intact: a fresh scan resumed from it finishes the job
+        cur = scan.state()
+        scan2 = ShardedScan([str(p)], resume=cur)
+        got = dict(scan2.run_iter())
+        assert sorted(got) == list(range(N_RG))
+
+    def test_open_failover_skips_known_bad_replica(self, tmp_path):
+        """A replica that failed to OPEN must not ride along as a
+        hedge mirror: the scan fails over to the good mirror and every
+        read (hedged or not) stays on healthy copies."""
+        p = tmp_path / "f.parquet"
+        m = tmp_path / "m.parquet"
+        write_file(p)
+        write_file(m)
+        data = p.read_bytes()
+        p.write_bytes(data[: len(data) - 9])  # tear the primary
+        scan = ShardedScan([[str(p), str(m)]], hedge_delay=0.0)
+        results = scan.run()
+        assert_scan_exact(results)
+        # the opened reader's mirror list excludes the torn primary
+        (reader,) = scan.readers
+        assert reader._mirrors == []
+
+    def test_unit_deadline_requires_quarantine_mode(self, tmp_path):
+        p = tmp_path / "f.parquet"
+        write_file(p)
+        with pytest.raises(ValueError, match="quarantine"):
+            ShardedScan([str(p)], unit_deadline=1.0)
+
+
+class TestProfileSurface:
+    def test_profile_reports_hedge_counters_per_column(self, tmp_path,
+                                                       capsys):
+        from tpuparquet.cli.parquet_tool import main
+
+        p = tmp_path / "f.parquet"
+        m = tmp_path / "m.parquet"
+        write_file(p)
+        write_file(m)
+        with inject_faults() as inj:
+            inj.inject("io.chunk.hang", "hang", times=1000,
+                       match={"file": str(p)}, seconds=10.0)
+            os.environ["TPQ_HEDGE_DELAY_S"] = "0.01"
+            try:
+                rc = main(["profile", "--cpu",
+                           "--mirror", str(m), str(p)])
+            finally:
+                del os.environ["TPQ_HEDGE_DELAY_S"]
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "hedges/deadlines per column" in out
+        assert "a: hedges issued" in out
+        assert "hedges issued 0" not in out

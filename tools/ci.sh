@@ -19,6 +19,10 @@
 #                       boundary + mid-page), strict metadata
 #                       validation over the pyarrow + crash corpora,
 #                       torn-fixture corpus, rescue round trip
+#   5. time/crash gate — strict (rc=0): hang-injection matrix
+#                       (watchdog deadlines, hedged reads over
+#                       replicas) and the SIGKILL/resume durable-
+#                       checkpoint sweep
 #
 # Usage: bash tools/ci.sh            (exit 0 = gate passed)
 # The tier-1 stage mirrors ROADMAP.md exactly — if you change one,
@@ -38,7 +42,7 @@ CI_PASS_FLOOR=${CI_PASS_FLOOR:-860}
 
 fail() { echo "ci.sh: FAILED at stage $1" >&2; exit 1; }
 
-echo "=== stage 1/4: tier-1 suite (pass floor $CI_PASS_FLOOR) ==="
+echo "=== stage 1/5: tier-1 suite (pass floor $CI_PASS_FLOOR) ==="
 rm -f /tmp/_t1.log
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
@@ -52,18 +56,22 @@ echo "DOTS_PASSED=$passed"
 [ "$passed" -ge "$CI_PASS_FLOOR" ] \
   || fail "tier-1 ($passed passed < floor $CI_PASS_FLOOR)"
 
-echo "=== stage 2/4: smoke bench (CPU backend, tiny target) ==="
+echo "=== stage 2/5: smoke bench (CPU backend, tiny target) ==="
 TPQ_BENCH_TARGET=60000 TPQ_BENCH_CPU=1 timeout -k 10 600 \
   python bench.py > /tmp/_ci_bench.json || fail "smoke bench"
 tail -1 /tmp/_ci_bench.json
 
-echo "=== stage 3/4: crash corpus + fault-injection matrix (strict) ==="
+echo "=== stage 3/5: crash corpus + fault-injection matrix (strict) ==="
 timeout -k 10 600 python -m pytest \
   "tests/test_corpus.py::TestCrashRegressions" tests/test_faults.py \
   -q -p no:cacheprovider || fail "corpus/faults"
 
-echo "=== stage 4/4: salvage + strict metadata (strict) ==="
+echo "=== stage 4/5: salvage + strict metadata (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_salvage.py \
   -q -p no:cacheprovider || fail "salvage"
+
+echo "=== stage 5/5: deadlines/hedging + kill-resume checkpoints (strict) ==="
+timeout -k 10 600 python -m pytest tests/test_deadline.py \
+  tests/test_checkpoint.py -q -p no:cacheprovider || fail "time/crash"
 
 echo "ci.sh: gate PASSED"

@@ -45,7 +45,9 @@ from .errors import (  # noqa: F401  (structured error taxonomy)
     CorruptChunkError,
     CorruptFooterError,
     CorruptPageError,
+    DeadlineExceededError,
     DeviceDispatchError,
+    DispatchDeadlineError,
     ScanError,
     TransientIOError,
 )

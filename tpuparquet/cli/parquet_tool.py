@@ -247,7 +247,8 @@ def cmd_profile(args, out=None) -> int:
     from .. import obs
     from ..stats import collect_stats
 
-    with FileReader(args.file) as r:
+    mirrors = [m for m in (getattr(args, "mirror", None) or []) if m]
+    with FileReader(args.file, mirrors=mirrors) as r:
         with collect_stats(events=True) as st:
             if getattr(args, "cpu", False):
                 for rg in range(r.row_group_count()):
@@ -265,6 +266,18 @@ def cmd_profile(args, out=None) -> int:
           f"dispatch {d['dispatch_s']:.3f}s  wall {d['wall_s']:.3f}s",
           file=out)
     print(st.summary(), file=out)
+    # per-column time-domain tallies: which column's reads hedged /
+    # expired (global counts alone can't localize a degraded replica)
+    tally = obs.fault_counts_by_column(st.events)
+    if tally:
+        print("\nhedges/deadlines per column:", file=out)
+        for col in sorted(tally):
+            row = tally[col]
+            print(f"  {col}: "
+                  f"hedges issued {row.get('hedge_issued', 0)}, "
+                  f"won {row.get('hedge_won', 0)}, "
+                  f"deadlines exceeded "
+                  f"{row.get('deadline_exceeded', 0)}", file=out)
     h = st.hists.get("page_comp_bytes")
     if h is not None and h.n:
         print(f"compressed page size: p50 < {h.quantile(0.5):,}B, "
@@ -509,6 +522,10 @@ def build_parser() -> argparse.ArgumentParser:
     pf.add_argument("--cpu", action="store_true",
                     help="profile the CPU oracle path instead of the "
                          "device path")
+    pf.add_argument("--mirror", action="append", metavar="FILE",
+                    help="replica copy to hedge chunk reads against "
+                         "(repeatable); hedge/deadline counters appear "
+                         "in the summary and per-column table")
     pf.add_argument("--events", metavar="FILE", default="",
                     help="write the per-page event log as JSON-lines")
     pf.add_argument("--perfetto", metavar="FILE", default="",

@@ -24,6 +24,15 @@ module is the taxonomy that makes those policies implementable:
   accelerator failed.  The data is fine; the resilient read path
   retries and then degrades to the bit-exact CPU decode
   (``kernels.device.read_row_group_device_resilient``).
+* :class:`DeadlineExceededError` / :class:`DispatchDeadlineError` —
+  the *time* domain (``tpuparquet/deadline.py``): a watched operation
+  ran past its budget.  A hung chunk read becomes
+  :class:`DeadlineExceededError` (a :class:`TransientIOError`, so the
+  retry/hedge ladder handles it); a hung device dispatch becomes
+  :class:`DispatchDeadlineError` (a :class:`DeviceDispatchError`, so
+  the dispatch-retry → CPU-fallback ladder handles it).  Both carry
+  ``elapsed`` and ``budget`` seconds next to the scan coordinates, so
+  a quarantine entry says exactly how long the unit hung.
 
 Every class carries scan coordinates (file / row group / column /
 page).  Inner layers raise with what they know; outer layers
@@ -41,6 +50,8 @@ __all__ = [
     "CorruptFooterError",
     "TransientIOError",
     "DeviceDispatchError",
+    "DeadlineExceededError",
+    "DispatchDeadlineError",
     "QUARANTINE_ERRORS",
 ]
 
@@ -131,6 +142,57 @@ class TransientIOError(ScanError, OSError):
 class DeviceDispatchError(ScanError, RuntimeError):
     """Staging/dispatching decode work to the accelerator failed; the
     input bytes are fine and the CPU path can still decode them."""
+
+
+class _DeadlineInfo:
+    """Shared elapsed/budget plumbing for the two deadline classes
+    (they must subclass *different* taxonomy parents — OSError for the
+    retry ladder, RuntimeError for the dispatch ladder — so the info
+    rides as a mixin)."""
+
+    def _set_deadline(self, elapsed, budget, site):
+        self.elapsed = elapsed   # seconds the operation actually ran
+        self.budget = budget     # seconds it was allowed
+        self.site = site         # watched site name (deadline.py)
+
+    def _deadline_coords(self, c: dict) -> dict:
+        if self.elapsed is not None:
+            c["elapsed_s"] = round(self.elapsed, 3)
+        if self.budget is not None:
+            c["budget_s"] = self.budget
+        return c
+
+
+class DeadlineExceededError(_DeadlineInfo, TransientIOError):
+    """A watched read ran past its time budget (hung NFS mount,
+    stalled object-store request).  Subclasses
+    :class:`TransientIOError`, so :func:`tpuparquet.faults.
+    retry_transient` retries it and a quarantining scan absorbs the
+    exhausted ladder — a hang becomes a bounded, classified failure
+    instead of a stalled fleet."""
+
+    def __init__(self, message: str = "", *, elapsed=None, budget=None,
+                 site=None, **coords):
+        super().__init__(message, **coords)
+        self._set_deadline(elapsed, budget, site)
+
+    def coordinates(self) -> dict:
+        return self._deadline_coords(super().coordinates())
+
+
+class DispatchDeadlineError(_DeadlineInfo, DeviceDispatchError):
+    """A watched device dispatch ran past its time budget (wedged
+    accelerator, dead tunnel).  Subclasses
+    :class:`DeviceDispatchError`, so the resilient read path's
+    retry → CPU-fallback ladder handles it."""
+
+    def __init__(self, message: str = "", *, elapsed=None, budget=None,
+                 site=None, **coords):
+        super().__init__(message, **coords)
+        self._set_deadline(elapsed, budget, site)
+
+    def coordinates(self) -> dict:
+        return self._deadline_coords(super().coordinates())
 
 
 # What a quarantining scan may absorb per unit: the library's clean

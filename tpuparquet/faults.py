@@ -41,15 +41,25 @@ site                                  instrumented where / supported kinds
                                       — ``corrupt``, ``truncate``
 ``io.reader.open``                    ``FileReader.__init__`` (per open)
                                       — ``oserror``, ``transient``
+``io.chunk.hang``                     chunk byte read (the hedgeable
+                                      primary/mirror read callable in
+                                      ``io/reader.py``; ctx carries
+                                      ``file`` so a rule can hang ONE
+                                      replica) — ``hang``
+``kernels.device.hang``               device dispatch
+                                      (``_finish_row_group``) — ``hang``
 ====================================  =====================================
 
 Kinds: ``oserror`` raises ``OSError(EIO)``; ``transient`` raises
 :class:`~tpuparquet.errors.TransientIOError`; ``dispatch`` raises
 :class:`~tpuparquet.errors.DeviceDispatchError`; ``corrupt`` XORs one
 byte of the stream (``offset=``, ``xor=``); ``truncate`` drops the
-tail (``keep=``).  Each rule fires on the first ``times`` matching
-calls after skipping ``after`` — "fail twice then succeed" is
-``times=2``, which a retry loop must survive.
+tail (``keep=``); ``hang`` BLOCKS the calling thread (``seconds=``,
+default 30) — but releases early the moment its :func:`inject_faults`
+scope exits, so abandoned hedge/deadline worker threads never outlive
+a test.  Each rule fires on the first ``times`` matching calls after
+skipping ``after`` — "fail twice then succeed" is ``times=2``, which a
+retry loop must survive.
 
 The active injector is a **process-global** (not thread-local): the
 pipelined reader plans on worker threads and faults must reach them.
@@ -151,10 +161,20 @@ class FaultInjector:
         # for the data it read), and a byte rule must wait for the
         # byte hook rather than be consumed by this one
         r = self._next_rule(site, ctx, ("oserror", "transient",
-                                        "dispatch"))
+                                        "dispatch", "hang"))
         if r is None:
             return
         self._record_stats(site, r.kind, ctx)
+        if r.kind == "hang":
+            # simulate a read/dispatch that never returns: block until
+            # the cap, or until this injector's scope exits (so
+            # abandoned hedge/deadline workers release with the test)
+            seconds = r.kw.get("seconds", 30.0)
+            t0 = time.monotonic()
+            while _active is self and \
+                    time.monotonic() - t0 < seconds:
+                time.sleep(0.005)
+            return
         if r.kind == "oserror":
             raise OSError(_errno.EIO,
                           f"injected I/O error at {site}")
@@ -266,18 +286,46 @@ def _env_int(name: str, default: int) -> int:
 
 def backoff_delays(retries: int | None = None,
                    base: float | None = None,
-                   cap: float | None = None) -> list[float]:
+                   cap: float | None = None,
+                   jitter: float | None = None,
+                   seed: int | None = None) -> list[float]:
     """The bounded exponential schedule: ``[base*2^0, base*2^1, ...]``
     clamped to ``cap``, one entry per retry.  Knobs (env):
     ``TPQ_IO_RETRIES`` (default 3), ``TPQ_RETRY_BASE_S`` (0.01),
-    ``TPQ_RETRY_MAX_S`` (0.5)."""
+    ``TPQ_RETRY_MAX_S`` (0.5).
+
+    ``jitter`` spreads each delay multiplicatively by up to ±that
+    fraction (decorrelates retry storms across a fleet; env
+    ``TPQ_RETRY_JITTER``, default 0.0 = the exact schedule).  The
+    jitter stream is drawn from a LOCAL PRNG, never global ``random``
+    state, seeded by ``seed`` (else ``TPQ_RETRY_SEED``, else a
+    per-process derivation from the pid — distinct hosts/processes
+    get distinct schedules, which is what breaks the herd).  With
+    ``seed``/``TPQ_RETRY_SEED`` pinned the schedule is fully
+    deterministic, so retry-timing assertions are reproducible rather
+    than flaky."""
     if retries is None:
         retries = _env_int("TPQ_IO_RETRIES", 3)
     if base is None:
         base = _env_float("TPQ_RETRY_BASE_S", 0.01)
     if cap is None:
         cap = _env_float("TPQ_RETRY_MAX_S", 0.5)
-    return [min(base * (2 ** i), cap) for i in range(max(retries, 0))]
+    if jitter is None:
+        jitter = _env_float("TPQ_RETRY_JITTER", 0.0)
+    delays = [min(base * (2 ** i), cap) for i in range(max(retries, 0))]
+    if jitter:
+        import random
+
+        if seed is None:
+            # per-process default: decorrelate across the fleet while
+            # staying stable within one process; pin TPQ_RETRY_SEED
+            # (or pass seed=) for cross-run determinism
+            seed = _env_int("TPQ_RETRY_SEED", os.getpid() ^ 0x7E9)
+        rng = random.Random(seed)
+        delays = [max(d * (1.0 + jitter * (2.0 * rng.random() - 1.0)),
+                      0.0)
+                  for d in delays]
+    return delays
 
 
 def retry_transient(fn, *, retries: int | None = None,
@@ -381,6 +429,33 @@ class QuarantineReport:
 
     def merge_from(self, other: "QuarantineReport") -> None:
         self.entries.extend(dict(e) for e in other.entries)
+
+    # identity of an entry for resume dedup: the coordinates + error
+    # class (NOT the message/extras — a re-opened bad file may phrase
+    # its failure slightly differently run to run)
+    _KEY_FIELDS = ("unit", "file", "row_group", "column", "page",
+                   "error")
+
+    @classmethod
+    def entry_key(cls, e: dict) -> tuple:
+        return tuple(e.get(k) for k in cls._KEY_FIELDS)
+
+    def merge_unique(self, entries) -> int:
+        """Append entries whose coordinate key isn't already present;
+        returns how many were added.  Used on cursor resume: a resumed
+        scan re-opens its sources, so a file already quarantined in
+        the checkpointed cursor is rejected AGAIN at open time — the
+        fresh entry must not duplicate the checkpointed one."""
+        seen = {self.entry_key(e) for e in self.entries}
+        added = 0
+        for e in entries or []:
+            k = self.entry_key(e)
+            if k in seen:
+                continue
+            seen.add(k)
+            self.entries.append(dict(e))
+            added += 1
+        return added
 
     def summary(self) -> str:
         if not self.entries:

@@ -19,6 +19,13 @@ import time
 
 import numpy as np
 
+from ..deadline import (
+    call_with_deadline,
+    hedge_delay_default,
+    hedged_call,
+    read_deadline_default,
+    read_latency,
+)
 from ..errors import CorruptChunkError, ScanError
 from ..faults import fault_point, filter_bytes, retry_transient
 from ..format.footer import read_file_metadata
@@ -32,6 +39,27 @@ __all__ = ["FileReader"]
 from ..format.footer import _file_size as _source_size  # noqa: E402
 
 
+class _IoHandle:
+    """One seekable handle + its serialization lock + an in-flight
+    read count.  The count lets ``close()`` and the un-poisoning path
+    distinguish an idle handle (safe to close) from one an abandoned
+    deadline/hedge worker may still be blocked inside (must be LEAKED
+    — closing an fd under a blocked read is undefined on some
+    platforms, and a buffered file's ``close()`` blocks on the
+    internal lock the hung reader holds)."""
+
+    __slots__ = ("f", "lock", "owns", "name", "inflight")
+
+    def __init__(self, f, owns: bool, name=None):
+        import threading
+
+        self.f = f
+        self.lock = threading.Lock()
+        self.owns = owns
+        self.name = name
+        self.inflight = 0   # guarded by the reader's _count_lock
+
+
 class FileReader:
     """Reads a seekable binary file object (or a path).
 
@@ -39,6 +67,22 @@ class FileReader:
     carry one (None = env default ``TPQ_PAGE_CRC_VERIFY``, on).
     Transient I/O failures on chunk reads are retried with bounded
     exponential backoff (:func:`tpuparquet.faults.retry_transient`).
+
+    Time-domain knobs (deadline/hedging round, ``deadline.py``):
+
+    * ``read_deadline`` — per chunk-read budget in seconds (None = env
+      ``TPQ_READ_DEADLINE_S``, off).  A read that runs past it raises
+      :class:`~tpuparquet.errors.DeadlineExceededError` (a
+      ``TransientIOError``, so the retry ladder handles it) instead of
+      hanging the scan.
+    * ``mirrors`` — replica sources holding byte-identical copies
+      (paths or file objects, opened lazily on first use).  Chunk
+      reads are *hedged*: if the primary hasn't answered after
+      ``hedge_delay`` seconds (None = env ``TPQ_HEDGE_DELAY_S``, else
+      the rolling p95 of observed read latency), the read is
+      duplicated against the next mirror and the first success wins.
+      Replicas must be bit-identical; the page CRC path rejects a
+      mirror that diverges exactly like corruption.
 
     Untrusted-metadata knobs (file-level robustness round):
 
@@ -61,7 +105,10 @@ class FileReader:
                  verify_crc: bool | None = None,
                  strict_metadata: bool | None = None,
                  salvage: bool = False,
-                 salvage_like=None):
+                 salvage_like=None,
+                 mirrors=(),
+                 hedge_delay: float | None = None,
+                 read_deadline: float | None = None):
         import threading
 
         if isinstance(source, (str, bytes)) and not hasattr(source, "read"):
@@ -73,10 +120,24 @@ class FileReader:
             self._owns = False
             self.name = getattr(source, "name", None)
         self._verify_crc = verify_crc
+        self._mirrors = list(mirrors)
+        # (fileobj, lock, name, owns) per mirror, opened lazily — a
+        # scan that never hedges never touches its mirrors
+        self._mirror_handles = [None] * len(self._mirrors)
+        self._mirror_lock = threading.Lock()
+        self._hedge_delay = hedge_delay
+        self._read_deadline = (read_deadline if read_deadline is not None
+                               else read_deadline_default())
         # seek+read pairs must be atomic: the pipelined device reader
         # plans row group N+1 on a worker thread while the caller may
-        # still use this reader from the main thread
-        self._io_lock = threading.Lock()
+        # still use this reader from the main thread.  The fd + its
+        # lock travel as ONE handle object: a deadline expiry may swap
+        # in a fresh one (_reopen_after_expiry) while other plan
+        # threads are mid-read on the old
+        self._io = _IoHandle(self._f, self._owns, self.name)
+        self._io_lock = self._io.lock
+        self._count_lock = threading.Lock()  # inflight + hedge streak
+        self._hedge_losses = 0  # consecutive mirror wins, no primary
         self._buf = None
         self.salvaged = False
         self.salvage_report = None
@@ -314,18 +375,11 @@ class FileReader:
                     raise CorruptChunkError("column chunk overruns file",
                                             column=path, file=self.name)
                 fault_point("io.reader.chunk_read", column=path)
+                fault_point("io.chunk.hang", file=self.name, column=path)
                 blob = self._buf[start : start + cm.total_compressed_size]
             else:
-                def _read(start=start, size=cm.total_compressed_size):
-                    # the fault point sits INSIDE the retried callable:
-                    # an injected transient fault exercises the same
-                    # backoff loop a flaky filesystem would
-                    fault_point("io.reader.chunk_read", column=path)
-                    with self._io_lock:
-                        self._f.seek(start)
-                        return self._f.read(size)
-
-                blob = retry_transient(_read)
+                blob = self._read_chunk_bytes(
+                    start, cm.total_compressed_size, path)
                 if len(blob) < cm.total_compressed_size:
                     raise CorruptChunkError(
                         f"column chunk short read: {len(blob)}/"
@@ -333,6 +387,182 @@ class FileReader:
                         column=path, file=self.name)
             blob = filter_bytes("io.reader.chunk_read", blob, column=path)
             yield path, node, cm, blob, start
+
+    # -- timed / hedged / deadline-bounded chunk reads ---------------------
+
+    def _read_chunk_bytes(self, start: int, size: int, path: str):
+        """One chunk's bytes with the full time-domain policy: retry
+        with backoff (transient errors AND deadline expiries), hedge
+        against mirrors after the hedge delay, bound each read by
+        ``read_deadline``.
+
+        With ``read_deadline`` set each read runs on a disposable
+        watchdog worker (~100µs of thread overhead per chunk read —
+        pennies next to a real I/O-bound read; leave the knob off for
+        in-memory or local-SSD sources)."""
+        import time as _time
+
+        from ..errors import DeadlineExceededError
+
+        def _read_primary(start=start, size=size, path=path):
+            # the fault points sit INSIDE the retried callable: an
+            # injected fault exercises the same ladder a flaky store
+            # would.  The hang site sits OUTSIDE the io lock — an
+            # injected hang models a slow read without pinning the
+            # lock that retry/hedge siblings need (a REAL hang pins
+            # it; _reopen_after_expiry un-poisons the reader then).
+            fault_point("io.reader.chunk_read", column=path)
+            fault_point("io.chunk.hang", file=self.name, column=path)
+            h = self._io
+            with self._count_lock:
+                h.inflight += 1
+            try:
+                with h.lock:
+                    h.f.seek(start)
+                    out = h.f.read(size)
+            finally:
+                with self._count_lock:
+                    h.inflight -= 1
+            # a COMPLETING primary read — even on an already-abandoned
+            # branch — proves the handle is alive: reset the
+            # hedge-loss streak (_note_hedge_win)
+            with self._count_lock:
+                self._hedge_losses = 0
+            return out
+
+        if self._mirrors:
+            branches = [_read_primary] + [
+                (lambda mi=mi: self._mirror_read(mi, start, size, path))
+                for mi in range(len(self._mirrors))
+            ]
+
+            def _hedged():
+                try:
+                    return hedged_call(
+                        branches, delay=self._resolve_hedge_delay(),
+                        site="io.reader.chunk_read",
+                        budget=self._read_deadline,
+                        tracker=read_latency,
+                        on_win=self._note_hedge_win,
+                        file=self.name, column=path)
+                except DeadlineExceededError:
+                    self._reopen_after_expiry()
+                    raise
+
+            return retry_transient(_hedged)
+        if self._read_deadline:
+            def _bounded():
+                try:
+                    return call_with_deadline(
+                        _read_primary, self._read_deadline,
+                        site="io.reader.chunk_read",
+                        file=self.name, column=path)
+                except DeadlineExceededError:
+                    self._reopen_after_expiry()
+                    raise
+            fn = _bounded
+        else:
+            fn = _read_primary
+
+        def _timed():
+            t0 = _time.monotonic()
+            out = fn()
+            # successful reads feed the rolling p95 the adaptive hedge
+            # delay is derived from
+            read_latency.record(_time.monotonic() - t0)
+            return out
+
+        return retry_transient(_timed)
+
+    def _note_hedge_win(self, i: int) -> None:
+        """Hedge outcome feedback: a mirror win means the primary lost
+        (slow OR hung — indistinguishable at win time).  A primary
+        read that completes resets the streak, even on an abandoned
+        branch; two consecutive mirror wins with NO primary completion
+        means the primary handle looks wedged (dead mount with no
+        ``read_deadline`` configured to expire it), so swap it out —
+        otherwise every later read queues behind the corpse at
+        +hedge_delay each, and ``close()`` would block on it."""
+        if i == 0:
+            return
+        with self._count_lock:
+            self._hedge_losses += 1
+            wedged = self._hedge_losses >= 2
+            if wedged:
+                self._hedge_losses = 0
+        if wedged:
+            self._reopen_after_expiry()
+
+    def _reopen_after_expiry(self) -> None:
+        """Un-poison the reader after an abandoned read: a worker hung
+        INSIDE ``fd.read()`` holds its io lock forever, so every later
+        read of this file would queue behind it and burn its own full
+        deadline.  Swap in a fresh fd + lock for the primary, and drop
+        the cached mirror handles so the next hedge reopens fresh ones
+        too (a hedge branch may have been the hung party).  Path-backed
+        handles only; caller-owned file objects cannot be reopened.
+        A dropped handle is closed only when idle — one an abandoned
+        worker may still be inside is leaked to that worker instead."""
+        with self._mirror_lock:
+            for i, h in enumerate(self._mirror_handles):
+                if h is not None and h.owns:  # we opened: re-openable
+                    if h.inflight == 0:
+                        h.f.close()
+                    self._mirror_handles[i] = None
+        if not (self._owns and self.name):
+            return  # caller-owned file object: nothing we can reopen
+        try:
+            f = open(self.name, "rb")
+        except OSError:
+            return  # keep the old handle; the retry ladder decides
+        old = self._io
+        self._f = f
+        self._io = _IoHandle(f, True, self.name)
+        self._io_lock = self._io.lock
+        if old.inflight == 0:
+            old.f.close()
+
+    def _mirror_handle(self, mi: int) -> _IoHandle:
+        h = self._mirror_handles[mi]
+        if h is not None:
+            return h
+        # the (blocking) open happens OUTSIDE the shared lock: a hung
+        # mount must never wedge _reopen_after_expiry or sibling hedge
+        # branches behind _mirror_lock, which only guards the list
+        src = self._mirrors[mi]
+        if hasattr(src, "read"):
+            nh = _IoHandle(src, False, getattr(src, "name", None))
+        else:
+            nh = _IoHandle(open(src, "rb"), True,
+                           src if isinstance(src, str) else None)
+        with self._mirror_lock:
+            cur = self._mirror_handles[mi]
+            if cur is None:
+                self._mirror_handles[mi] = nh
+                return nh
+        if nh.owns:  # lost the init race: discard ours
+            nh.f.close()
+        return cur
+
+    def _mirror_read(self, mi: int, start: int, size: int, path: str):
+        h = self._mirror_handle(mi)
+        fault_point("io.reader.chunk_read", column=path)
+        fault_point("io.chunk.hang", file=h.name, column=path)
+        with self._count_lock:
+            h.inflight += 1
+        try:
+            with h.lock:
+                h.f.seek(start)
+                return h.f.read(size)
+        finally:
+            with self._count_lock:
+                h.inflight -= 1
+
+    def _resolve_hedge_delay(self) -> float:
+        if self._hedge_delay is not None:
+            return self._hedge_delay
+        env = hedge_delay_default()
+        return env if env is not None else read_latency.hedge_delay()
 
     def pre_load(self) -> None:
         """Eagerly load the next row group (≙ ``PreLoad``)."""
@@ -396,8 +626,19 @@ class FileReader:
             # release the exported buffer or BytesIO.close() raises
             self._buf.release()
             self._buf = None
+        # close only IDLE handles we own: one with a reader still in
+        # flight (an abandoned hedge/deadline worker hung inside
+        # read()) is leaked to that worker — a buffered close() would
+        # block on the internal lock the hung reader holds, turning
+        # cleanup into exactly the unbounded stall this round removes
+        for i, h in enumerate(self._mirror_handles):
+            if h is not None and h.owns and h.inflight == 0:
+                h.f.close()
+            self._mirror_handles[i] = None
         if self._owns:
-            self._f.close()
+            h = self._io
+            if h.inflight == 0:
+                h.f.close()
 
     def __enter__(self):
         return self

@@ -2212,12 +2212,20 @@ def read_row_group_device(reader, rg_index: int) -> dict[str, DeviceColumn]:
 
 def read_row_group_device_resilient(reader, rg_index: int,
                                     retries: int | None = None,
-                                    sleep=time.sleep):
+                                    sleep=time.sleep,
+                                    dispatch_deadline: float | None = None):
     """:func:`read_row_group_device` with the device-failure policy:
     retry device dispatch with bounded exponential backoff, then
     degrade to the bit-exact CPU decode (:func:`cpu_fallback_values`)
     for this unit.  Corruption errors propagate unchanged — they are
     permanent and belong to the quarantine layer, not retry.
+
+    ``dispatch_deadline`` (None = env ``TPQ_DISPATCH_DEADLINE_S``,
+    off) bounds EACH attempt's wall: an attempt that runs past it —
+    a wedged accelerator or dead tunnel that neither fails nor
+    finishes — is abandoned and counted as a
+    :class:`~tpuparquet.errors.DispatchDeadlineError`, which takes
+    exactly the retry → CPU-fallback ladder a failing dispatch does.
 
     Counts ``DecodeStats.dispatch_retries`` per retry and
     ``units_degraded`` when the CPU fallback engages; the fallback is
@@ -2231,25 +2239,53 @@ def read_row_group_device_resilient(reader, rg_index: int,
     attempts contribute only their fault-layer observability
     (``faults_injected``/``crc_mismatches``/``io_retries`` and fault
     events)."""
-    from ..stats import current_stats, worker_stats
+    from ..deadline import call_with_deadline, dispatch_deadline_default
+    from ..errors import DispatchDeadlineError
+    from ..stats import current_stats, merge_worker_stats, worker_stats
 
-    _FAULT_FIELDS = ("faults_injected", "crc_mismatches", "io_retries")
+    if dispatch_deadline is None:
+        dispatch_deadline = dispatch_deadline_default()
+    # the deadline wrapper executes on a disposable worker thread, but
+    # both the degraded-decode flag and jax's default device are
+    # THREAD-LOCAL — the work callable re-enters them itself
+    _dev = getattr(jax.config, "jax_default_device", None)
 
-    def attempt_once():
+    def work(degraded: bool):
+        dev_ctx = (jax.default_device(_dev) if _dev is not None
+                   else contextlib.nullcontext())
+        deg_ctx = cpu_fallback_values() if degraded \
+            else contextlib.nullcontext()
+        with dev_ctx, deg_ctx:
+            return read_row_group_device(reader, rg_index)
+
+    def attempt_bare(degraded):
         st = current_stats()
         if st is None:
-            return read_row_group_device(reader, rg_index)
+            return work(degraded)
         with worker_stats(like=st) as ws:
             try:
-                out = read_row_group_device(reader, rg_index)
+                out = work(degraded)
             except BaseException:
-                for f in _FAULT_FIELDS:
-                    setattr(st, f, getattr(st, f) + getattr(ws, f))
-                if st.events is not None and ws.events is not None:
-                    st.events.faults.extend(ws.events.faults)
+                merge_worker_stats(st, ws, failed=True)
                 raise
-        st.merge_from(ws)
+        merge_worker_stats(st, ws, failed=False)
         return out
+
+    def attempt_once(degraded=False):
+        # the deadline wrapper already runs the attempt under a worker
+        # collector with the same merge policy; only the bare attempt
+        # needs its own.  The DEGRADED attempt is never bounded: the
+        # dispatch budget is sized for device-dispatch latency, and
+        # the CPU fallback is the last-resort path that must be
+        # allowed to finish (the unit-level deadline still bounds it
+        # in a quarantining scan).
+        if degraded or not dispatch_deadline:
+            return attempt_bare(degraded)
+        return call_with_deadline(
+            lambda: work(degraded),
+            dispatch_deadline, site="kernels.device.unit_dispatch",
+            error=DispatchDeadlineError,
+            file=getattr(reader, "name", None), row_group=rg_index)
 
     last = None
     delays = backoff_delays(retries)
@@ -2278,8 +2314,7 @@ def read_row_group_device_resilient(reader, rg_index: int,
                 site="kernels.device.unit_dispatch",
                 kind="degraded-to-host", row_group=rg_index,
                 error=type(last).__name__, message=str(last))
-    with cpu_fallback_values():
-        return attempt_once()
+    return attempt_once(degraded=True)
 
 
 def _plan_row_group(reader, rg, stager: _Stager, arena: HostArena):
@@ -2323,10 +2358,13 @@ def _finish_row_group(planned, st: _Stager):
     from ..stats import current_stats
 
     if not _host_values_only():
-        # unit-level simulated device failure (harness site); skipped
+        # unit-level simulated device failures (harness sites); skipped
         # on the degraded re-plan, whose remaining device work is bare
-        # buffer staging
+        # buffer staging.  The hang site simulates a wedged
+        # accelerator/tunnel: under a dispatch deadline it becomes a
+        # DispatchDeadlineError instead of a stalled scan.
         fault_point("kernels.device.unit_dispatch")
+        fault_point("kernels.device.hang")
     t0 = time.perf_counter()
     staged = st.put()
     t1 = time.perf_counter()

@@ -31,6 +31,7 @@ from .events import (  # noqa: F401
     TRANSPORT_COUNTER,
     counter_counts,
     event_summary,
+    fault_counts_by_column,
 )
 from .export import (  # noqa: F401
     chrome_trace,
@@ -42,7 +43,7 @@ from .histogram import Histogram, N_BUCKETS  # noqa: F401
 
 __all__ = [
     "EventLog", "PageEvent", "TRANSPORT_COUNTER", "counter_counts",
-    "event_summary", "chrome_trace", "column_table",
-    "format_column_table", "write_chrome_trace", "Histogram",
-    "N_BUCKETS",
+    "event_summary", "fault_counts_by_column", "chrome_trace",
+    "column_table", "format_column_table", "write_chrome_trace",
+    "Histogram", "N_BUCKETS",
 ]

@@ -27,7 +27,7 @@ import json
 import time
 
 __all__ = ["PageEvent", "EventLog", "TRANSPORT_COUNTER",
-           "counter_counts", "event_summary"]
+           "counter_counts", "event_summary", "fault_counts_by_column"]
 
 # transport label -> the DecodeStats counter that transport increments
 # (transports absent here increment none of the per-transport counters:
@@ -201,6 +201,27 @@ def counter_counts(pages) -> dict:
         c = TRANSPORT_COUNTER.get(e.transport)
         if c is not None:
             out[c] = out.get(c, 0) + 1
+    return out
+
+
+def fault_counts_by_column(log: "EventLog | None",
+                           kinds=("hedge_issued", "hedge_won",
+                                  "deadline_exceeded")) -> dict:
+    """Per-column tallies of time-domain fault records: ``{column:
+    {kind: count}}`` (records without a column fold under ``"-"``).
+    The observability face of the hedge/deadline layer — ``parquet-tool
+    profile`` prints this so a degraded replica shows up as WHICH
+    column's reads are hedging, not just a global count."""
+    out: dict[str, dict[str, int]] = {}
+    if log is None:
+        return out
+    for f in log.faults:
+        k = f.get("kind")
+        if k not in kinds:
+            continue
+        col = f.get("column") or "-"
+        row = out.setdefault(col, {})
+        row[k] = row.get(k, 0) + 1
     return out
 
 

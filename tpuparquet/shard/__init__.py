@@ -20,6 +20,9 @@ from .scan import (  # noqa: F401
     ShardedScan,
     gather_byte_column,
     gather_column,
+    host_cursor_path,
+    load_cursor_file,
+    save_cursor_file,
     scan_units,
 )
 from .distributed import (  # noqa: F401

@@ -11,6 +11,9 @@ script runs unchanged from a laptop to a pod.
 
 from __future__ import annotations
 
+import os
+import time
+
 import jax
 import numpy as np
 
@@ -22,6 +25,8 @@ __all__ = [
     "allgather_bytes",
     "allgather_stats",
 ]
+
+from .scan import DurableScanMixin as _DurableScanMixin  # noqa: E402
 
 
 def initialize(coordinator_address: str | None = None,
@@ -129,7 +134,7 @@ def allgather_stats(st) -> "DecodeStats":
     return total
 
 
-class MultiHostScan:
+class MultiHostScan(_DurableScanMixin):
     """Decode many files across processes *and* local devices.
 
     The global unit list (file x row-group) is strided over processes;
@@ -147,20 +152,58 @@ class MultiHostScan:
     salvaged to their readable prefix) at FILE granularity — see
     :func:`~tpuparquet.shard.scan.open_sources`;
     :meth:`allgather_quarantine` folds every host's report into the
-    fleet-wide list."""
+    fleet-wide list.
+
+    Time/crash domain (same knobs as ``ShardedScan``):
+    ``unit_deadline``/``scan_deadline`` bound hung units and the whole
+    scan.  CAUTION — ``scan_deadline`` is evaluated PER HOST on its
+    local clock and raises non-collectively: a host whose units finish
+    under budget never raises, so a caller that follows ``run_iter``
+    with a collective (``allgather_quarantine``, ``allgather_stats``,
+    a gather) must reach that collective on EVERY host — catch
+    ``DeadlineExceededError`` and fall through to the collective (the
+    cursor is already checkpointed), or exchange a done/expired flag
+    first; letting one host exit while its siblings enter the
+    collective stalls the fleet.  Sources may be replica groups hedged
+    after ``hedge_delay``;
+    ``resume_from=base`` checkpoints durably to a PER-HOST file
+    (``base.p<process_index>`` —
+    :func:`~tpuparquet.shard.scan.host_cursor_path`, so hosts never
+    race on one file) and resume validates fleet agreement: every
+    host must see the same unit list and the same
+    have-a-checkpoint answer, or the resume raises instead of
+    silently re-decoding or skipping a shard."""
 
     def __init__(self, sources, *columns: str, mesh=None, resume=None,
                  on_error: str = "raise", retries: int | None = None,
                  salvage: bool = False,
-                 strict_metadata: bool | None = None):
+                 strict_metadata: bool | None = None,
+                 unit_deadline: float | None = None,
+                 scan_deadline: float | None = None,
+                 hedge_delay: float | None = None,
+                 read_deadline: float | None = None,
+                 resume_from: str | None = None,
+                 checkpoint_every: int | None = None):
         from ..faults import QuarantineReport
         from .mesh import make_mesh
-        from .scan import open_sources, scan_units
+        from .scan import (
+            host_cursor_path,
+            load_cursor_file,
+            open_sources,
+            scan_units,
+        )
 
         if on_error not in ("raise", "quarantine"):
             raise ValueError(
                 f"on_error must be 'raise' or 'quarantine', "
                 f"not {on_error!r}")
+        p0 = jax.process_index()
+        self._init_durable(
+            on_error=on_error, unit_deadline=unit_deadline,
+            scan_deadline=scan_deadline, resume=resume,
+            resume_from=resume_from, checkpoint_every=checkpoint_every,
+            checkpoint_path=(None if resume_from is None
+                             else host_cursor_path(resume_from, p0)))
         # every process opens every source (salvage is deterministic,
         # so all hosts derive the identical reader/unit list), but a
         # failed/salvaged FILE is recorded by exactly one process
@@ -173,7 +216,8 @@ class MultiHostScan:
             quarantine=self._open_quarantine, salvage=salvage,
             strict_metadata=strict_metadata,
             record_for=lambda i: i % n == p,
-            entry_extra={"process_index": p})
+            entry_extra={"process_index": p},
+            hedge_delay=hedge_delay, read_deadline=read_deadline)
         self.global_units = scan_units(self.readers)
         self.local_units = process_units(self.global_units)
         # make_mesh defaults to LOCAL devices (see its docstring; the
@@ -185,8 +229,43 @@ class MultiHostScan:
         self.quarantine = QuarantineReport(
             self._open_quarantine.as_dicts())
         self._next_local = 0
+        if resume is None and self._checkpoint_path is not None:
+            found = os.path.exists(self._checkpoint_path)
+            if n > 1:
+                self._validate_resume_agreement(found)
+            if found:
+                resume = load_cursor_file(self._checkpoint_path)
         if resume is not None:
             self._load_cursor(resume)
+
+    def _validate_resume_agreement(self, found: bool) -> None:
+        """Collective resume sanity: every host must derive the same
+        global unit list AND give the same have-a-checkpoint answer.
+        A host resuming while a sibling starts fresh would silently
+        re-decode (or a diverged unit list silently misassign) its
+        stride of the fleet's work — fail loudly instead."""
+        import json
+        import zlib
+
+        units_crc = zlib.crc32(json.dumps(
+            [list(u) for u in self.global_units]).encode())
+        payloads = allgather_bytes(json.dumps(
+            {"found": bool(found), "units_crc": units_crc}).encode())
+        states = [json.loads(b) for b in payloads]
+        crcs = {s["units_crc"] for s in states}
+        if len(crcs) > 1:
+            raise ValueError(
+                "checkpoint resume: hosts disagree on the scan's unit "
+                "list (sources changed on some hosts?)")
+        founds = {s["found"] for s in states}
+        if len(founds) > 1:
+            missing = [i for i, s in enumerate(states)
+                       if not s["found"]]
+            raise ValueError(
+                "checkpoint resume: only some hosts have a checkpoint "
+                f"file (missing on process(es) {missing}); restore the "
+                "missing per-host file(s) or delete them all to start "
+                "fresh")
 
     def _load_cursor(self, cursor: dict) -> None:
         from ..faults import QuarantineReport
@@ -203,6 +282,9 @@ class MultiHostScan:
         )
         self.quarantine = QuarantineReport.from_dicts(
             cursor.get("quarantine"))
+        # dedup against the re-opened sources' fresh file entries —
+        # same fix as ShardedScan._load_cursor
+        self.quarantine.merge_unique(self._open_quarantine.as_dicts())
 
     def state(self) -> dict:
         """JSON-serializable per-process cursor (resume with
@@ -218,12 +300,19 @@ class MultiHostScan:
             quarantine=self.quarantine.as_dicts(),
         )
 
+    def _progress(self):
+        return self._next_local, len(self.local_units)
+
     def run_iter(self):
         """Yield ``(local_index, {path: DeviceColumn})`` from the cursor
         position, advancing it after each unit.  Quarantine mode skips
-        (and records) failing units, like ``ShardedScan.run_iter``."""
+        (and records) failing units, like ``ShardedScan.run_iter``;
+        the durable per-host checkpoint and the scan budget apply
+        exactly as there."""
         from .scan import pipelined_unit_scan
 
+        self._run_t0 = time.monotonic()
+        self._check_scan_deadline()
         if self.on_error == "raise":
             for k, out in pipelined_unit_scan(
                 self.readers, self.local_units,
@@ -232,6 +321,9 @@ class MultiHostScan:
             ):
                 self._next_local = k + 1
                 yield k, out
+                self._maybe_checkpoint()
+                self._check_scan_deadline()
+            self._flush_checkpoint()
             return
         from .scan import resilient_unit_scan
 
@@ -241,10 +333,14 @@ class MultiHostScan:
             start=self._next_local, retries=self.retries,
             quarantine=self.quarantine,
             entry_extra={"process_index": jax.process_index()},
+            unit_deadline=self.unit_deadline,
         ):
             self._next_local = k + 1
             if out is not None:
                 yield k, out
+            self._maybe_checkpoint()
+            self._check_scan_deadline()
+        self._flush_checkpoint()
 
     def allgather_quarantine(self) -> list[dict]:
         """Every host's quarantine entries, identical on every process

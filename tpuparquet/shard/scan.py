@@ -10,12 +10,21 @@ all-gather of the decoded column shards.
 
 from __future__ import annotations
 
+import json
+import os
+import time
+import zlib
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..errors import QUARANTINE_ERRORS, never_quarantine
+from ..errors import (
+    QUARANTINE_ERRORS,
+    DeadlineExceededError,
+    never_quarantine,
+)
 from ..faults import QuarantineReport
 from ..io.reader import FileReader
 from ..kernels.decode import scatter_to_dense
@@ -27,7 +36,9 @@ from ..kernels.device import (
 
 __all__ = ["ShardedScan", "scan_units", "open_sources",
            "pipelined_unit_scan", "resilient_unit_scan",
-           "gather_column", "gather_byte_column"]
+           "gather_column", "gather_byte_column",
+           "save_cursor_file", "load_cursor_file", "host_cursor_path",
+           "checkpoint_every_default"]
 
 
 def scan_units(readers: list[FileReader]) -> list[tuple[int, int]]:
@@ -42,12 +53,24 @@ def scan_units(readers: list[FileReader]) -> list[tuple[int, int]]:
     ]
 
 
+def _replicas(src) -> list:
+    """A source entry is either one source or a replica group
+    ``[primary, mirror, ...]`` of byte-identical copies."""
+    if isinstance(src, (list, tuple)):
+        if not src:
+            raise ValueError("empty replica group in sources")
+        return list(src)
+    return [src]
+
+
 def open_sources(sources, columns, *, on_error: str,
                  quarantine: QuarantineReport,
                  salvage: bool = False,
                  strict_metadata: bool | None = None,
                  record_for=None,
-                 entry_extra: dict | None = None) -> list:
+                 entry_extra: dict | None = None,
+                 hedge_delay: float | None = None,
+                 read_deadline: float | None = None) -> list:
     """Open scan sources with the file-level fault policy.
 
     Returns a reader list aligned with ``sources`` (``None`` where the
@@ -63,6 +86,13 @@ def open_sources(sources, columns, *, on_error: str,
     multi-process scan derives the identical reader/unit list;
     ``record_for(i)`` optionally filters which file indices THIS
     process records (so fleet-folded counters count each file once).
+
+    A source entry may be a replica group ``[primary, mirror, ...]``
+    (byte-identical copies on independent stores): the first replica
+    that OPENS becomes the reader and the others ride along as hedge
+    mirrors for its chunk reads (``FileReader(mirrors=)``, the
+    tail-at-scale path in ``deadline.py``); only if every replica
+    fails to open is the file quarantined/salvaged.
 
     Raw crash types propagate — same contract as the unit loop.
     """
@@ -115,15 +145,36 @@ def open_sources(sources, columns, *, on_error: str,
 
     from ..faults import retry_transient
 
-    for i, src in enumerate(sources):
-        try:
-            with _counters_only_if_recorded(i):
+    def _open_group(reps):
+        """First replica that opens wins; the replicas NOT yet tried
+        become its hedge mirrors (the ones that already failed to open
+        are known-bad copies — hedging a read against them could let a
+        truncated or diverged replica win the race).  All replicas
+        failing re-raises the PRIMARY's error (the group's identity
+        for quarantine purposes)."""
+        first_err = None
+        for j, rep in enumerate(reps):
+            others = reps[j + 1:]
+            try:
                 # same retry policy as chunk reads: a flaky-store blip
                 # at open time gets backoff before it can cost the
                 # whole file (retry_transient re-raises non-transient
                 # errors immediately)
-                readers[i] = retry_transient(lambda src=src: FileReader(
-                    src, *columns, strict_metadata=strict_metadata))
+                return retry_transient(lambda: FileReader(
+                    rep, *columns, strict_metadata=strict_metadata,
+                    mirrors=others, hedge_delay=hedge_delay,
+                    read_deadline=read_deadline))
+            except QUARANTINE_ERRORS as e:
+                if never_quarantine(e):
+                    raise
+                if first_err is None:
+                    first_err = e
+        raise first_err
+
+    for i, src in enumerate(sources):
+        try:
+            with _counters_only_if_recorded(i):
+                readers[i] = _open_group(_replicas(src))
             if donor is None:
                 donor = readers[i].meta
         except QUARANTINE_ERRORS as e:
@@ -132,11 +183,12 @@ def open_sources(sources, columns, *, on_error: str,
             failures[i] = e
 
     for i, err in sorted(failures.items()):
-        path = sources[i] if isinstance(sources[i], str) else None
+        primary = _replicas(sources[i])[0]
+        path = primary if isinstance(primary, str) else None
         if salvage:
             try:
                 with _counters_only_if_recorded(i):
-                    r = FileReader(sources[i], *columns, salvage=True,
+                    r = FileReader(primary, *columns, salvage=True,
                                    salvage_like=donor,
                                    strict_metadata=strict_metadata)
             except QUARANTINE_ERRORS as e2:
@@ -210,6 +262,107 @@ def cursor_load(cursor: dict, units, next_key: str, n_units: int,
     return nxt
 
 
+# ----------------------------------------------------------------------
+# Durable cursor checkpoints (crash-safe resume)
+# ----------------------------------------------------------------------
+
+CURSOR_FILE_FORMAT = "tpq-cursor"
+CURSOR_FILE_VERSION = 1
+
+
+def _canonical(obj) -> bytes:
+    """The byte form the integrity checksum is computed over: key-
+    sorted, separator-pinned JSON — identical before write and after a
+    read-back round trip."""
+    return json.dumps(obj, sort_keys=True,
+                      separators=(",", ":")).encode()
+
+
+def checkpoint_every_default() -> int:
+    """Auto-checkpoint cadence in completed units
+    (``TPQ_CHECKPOINT_EVERY``, default 16)."""
+    try:
+        v = int(os.environ.get("TPQ_CHECKPOINT_EVERY", ""))
+    except ValueError:
+        return 16
+    return max(v, 1)
+
+
+def save_cursor_file(cursor: dict, path: str) -> None:
+    """Write a scan cursor durably and atomically.
+
+    Versioned envelope with a CRC32 over the canonical cursor JSON;
+    written tmp-in-same-dir + flush + fsync + ``os.replace`` +
+    directory fsync — a SIGKILL at ANY point leaves either the
+    previous complete checkpoint or the new complete checkpoint,
+    never a torn one.  Counts ``DecodeStats.checkpoints_written``."""
+    from ..stats import current_stats
+
+    doc = {"format": CURSOR_FILE_FORMAT,
+           "file_version": CURSOR_FILE_VERSION,
+           "crc32": zlib.crc32(_canonical(cursor)),
+           "cursor": cursor}
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    tmp = os.path.join(
+        d, f".{os.path.basename(path)}.tmp.{os.getpid()}")
+    try:
+        with open(tmp, "w") as f:
+            json.dump(doc, f, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    # fsync the directory so the rename itself is durable (best
+    # effort: some filesystems refuse directory fds)
+    try:
+        dfd = os.open(d, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass
+    st = current_stats()
+    if st is not None:
+        st.checkpoints_written += 1
+
+
+def load_cursor_file(path: str) -> dict:
+    """Read back a :func:`save_cursor_file` checkpoint, validating
+    format, version, and integrity checksum.  Raises ``ValueError``
+    on anything that is not a complete, untampered cursor (atomic
+    writes mean a torn file here is damage, not a crash artifact)."""
+    with open(path) as f:
+        try:
+            doc = json.load(f)
+        except json.JSONDecodeError as e:
+            raise ValueError(
+                f"checkpoint {path!r} is not valid JSON: {e}") from e
+    if not isinstance(doc, dict) \
+            or doc.get("format") != CURSOR_FILE_FORMAT:
+        raise ValueError(f"{path!r} is not a tpq cursor checkpoint")
+    if doc.get("file_version") != CURSOR_FILE_VERSION:
+        raise ValueError(
+            f"unknown checkpoint file_version "
+            f"{doc.get('file_version')!r} in {path!r}")
+    cursor = doc.get("cursor")
+    if zlib.crc32(_canonical(cursor)) != doc.get("crc32"):
+        raise ValueError(
+            f"checkpoint {path!r} failed its integrity checksum")
+    return cursor
+
+
+def host_cursor_path(base: str, process_index: int) -> str:
+    """Per-host checkpoint file for a multi-process scan: each process
+    owns exactly one file (no cross-host write races)."""
+    return f"{base}.p{process_index}"
+
+
 def pipelined_unit_scan(readers, units, device_for=None, start: int = 0):
     """Yield ``(unit_index, {path: DeviceColumn})`` for ``units[start:]``,
     overlapping host planning with device transfer/dispatch — the shared
@@ -222,7 +375,8 @@ def pipelined_unit_scan(readers, units, device_for=None, start: int = 0):
 
 def resilient_unit_scan(readers, units, device_for, *, start: int = 0,
                         retries=None, quarantine: QuarantineReport,
-                        entry_extra: dict | None = None):
+                        entry_extra: dict | None = None,
+                        unit_deadline: float | None = None):
     """The quarantine-mode unit loop shared by :class:`ShardedScan`
     and :class:`MultiHostScan`: decode each unit with the full
     resilience policy (transient-I/O retry, dispatch retry, CPU
@@ -230,15 +384,35 @@ def resilient_unit_scan(readers, units, device_for, *, start: int = 0,
     get ``entry_extra`` merged in) and yield ``(k, None)`` for them so
     callers can advance their cursor uniformly; yield ``(k, out)`` for
     survivors.  Raw crash types propagate — quarantine never papers
-    over a bug."""
+    over a bug.
+
+    ``unit_deadline`` bounds each unit's WHOLE decode (read + retries
+    + dispatch + degradation) via the watchdog
+    (:func:`~tpuparquet.deadline.call_with_deadline`): a unit that
+    hangs past its budget raises
+    :class:`~tpuparquet.errors.DeadlineExceededError`, which this loop
+    absorbs into quarantine like any other exhausted failure — a hung
+    unit costs its budget, never the fleet."""
+    from ..deadline import call_with_deadline
     from ..stats import current_stats
 
     for k in range(start, len(units)):
         fi, rgi = units[k]
-        try:
+
+        def _decode(k=k, fi=fi, rgi=rgi):
+            # default_device is thread-local; the deadline wrapper may
+            # execute this on a worker thread, so enter it inside
             with jax.default_device(device_for(k)):
-                out = read_row_group_device_resilient(
+                return read_row_group_device_resilient(
                     readers[fi], rgi, retries=retries)
+
+        try:
+            if unit_deadline:
+                out = call_with_deadline(
+                    _decode, unit_deadline, site="shard.scan.unit",
+                    file=fi, row_group=rgi)
+            else:
+                out = _decode()
         except QUARANTINE_ERRORS as e:
             if never_quarantine(e):
                 raise
@@ -257,7 +431,101 @@ def resilient_unit_scan(readers, units, device_for, *, start: int = 0,
         yield k, out
 
 
-class ShardedScan:
+class DurableScanMixin:
+    """Durable-checkpoint + scan-budget plumbing shared by
+    :class:`ShardedScan` and
+    :class:`~tpuparquet.shard.distributed.MultiHostScan` (so cadence
+    and expiry semantics cannot drift between them).  Hosts provide
+    ``state()``, ``_checkpoint_path``/``_checkpoint_every``/
+    ``_since_checkpoint``, ``scan_deadline``/``_run_t0``, and
+    :meth:`_progress`."""
+
+    def _progress(self) -> tuple[int, int]:
+        raise NotImplementedError
+
+    def _init_durable(self, *, on_error, unit_deadline, scan_deadline,
+                      resume, resume_from, checkpoint_every,
+                      checkpoint_path) -> None:
+        """Validate and resolve the shared time/checkpoint knobs (one
+        owner for both drivers; ``checkpoint_path`` is the resolved
+        per-driver file — per-host for the multi-host scan).  Call
+        BEFORE opening sources: a bad knob must fail cheap."""
+        from ..deadline import scan_deadline_default, unit_deadline_default
+
+        if unit_deadline is not None and on_error != "quarantine":
+            raise ValueError(
+                "unit_deadline requires on_error='quarantine' (an "
+                "expired unit is absorbed by the quarantine ladder)")
+        if resume is not None and resume_from is not None:
+            raise ValueError("pass resume= or resume_from=, not both")
+        # env defaults apply only where the knob is usable: the unit
+        # deadline lives in the quarantine ladder
+        self.unit_deadline = unit_deadline if unit_deadline is not None \
+            else (unit_deadline_default()
+                  if on_error == "quarantine" else None)
+        self.scan_deadline = scan_deadline if scan_deadline is not None \
+            else scan_deadline_default()
+        self._checkpoint_path = checkpoint_path
+        self._checkpoint_every = (checkpoint_every
+                                  if checkpoint_every is not None
+                                  else checkpoint_every_default())
+        self._since_checkpoint = 0
+        self._run_t0 = None
+
+    def cursor_save(self, path: str | None = None) -> None:
+        """Durably checkpoint :meth:`state` (atomic tmp + fsync +
+        rename, integrity checksum — :func:`save_cursor_file`).
+        ``path`` defaults to this scan's configured checkpoint
+        file."""
+        path = path if path is not None else self._checkpoint_path
+        if path is None:
+            raise ValueError("no checkpoint path: pass path= or "
+                             "construct with resume_from=")
+        save_cursor_file(self.state(), path)
+        self._since_checkpoint = 0
+
+    def _maybe_checkpoint(self) -> None:
+        """Auto-checkpoint cadence: called once per completed unit
+        AFTER the consumer's iteration step returned, so a unit is
+        only ever covered by a checkpoint once the caller had its
+        chance to persist the result — a crash re-decodes at most the
+        units since the last checkpoint (bit-exact, so a keyed
+        consumer converges to the identical union)."""
+        if self._checkpoint_path is None:
+            return
+        self._since_checkpoint += 1
+        if self._since_checkpoint >= self._checkpoint_every:
+            self.cursor_save()
+
+    def _flush_checkpoint(self) -> None:
+        if self._checkpoint_path is not None and self._since_checkpoint:
+            self.cursor_save()
+
+    def _check_scan_deadline(self) -> None:
+        """Whole-scan budget, checked between units: expiry flushes a
+        fresh durable cursor (when checkpointing is on) and raises —
+        the caller reschedules and resumes, no work is lost."""
+        if not self.scan_deadline or self._run_t0 is None:
+            return
+        elapsed = time.monotonic() - self._run_t0
+        if elapsed <= self.scan_deadline:
+            return
+        from ..deadline import record_expiry
+        from ..stats import current_stats
+
+        done, total = self._progress()
+        record_expiry(current_stats(), "shard.scan", elapsed,
+                      self.scan_deadline, {"next_unit": done})
+        self._flush_checkpoint()
+        raise DeadlineExceededError(
+            f"scan exceeded its {self.scan_deadline:g}s budget at "
+            f"unit {done}/{total}; the cursor is intact — resume to "
+            "continue",
+            elapsed=elapsed, budget=self.scan_deadline,
+            site="shard.scan")
+
+
+class ShardedScan(DurableScanMixin):
     """Decode many files' row groups data-parallel across a mesh.
 
     ``sources`` are paths or file objects, opened by the scan itself
@@ -302,18 +570,51 @@ class ShardedScan:
       or the first healthy file); its recovered row groups join the
       unit list, and only the unreadable remainder lands in
       :attr:`quarantine`.
+
+    Time/crash domain (deadline round, ``tpuparquet/deadline.py``):
+
+    * ``unit_deadline`` (env ``TPQ_UNIT_DEADLINE_S``; quarantine mode
+      only) — watchdog budget per unit: a hung unit is abandoned and
+      quarantined as :class:`~tpuparquet.errors.DeadlineExceededError`
+      instead of stalling the scan.
+    * ``scan_deadline`` (env ``TPQ_SCAN_DEADLINE_S``) — whole-scan
+      budget, checked between units; expiry raises with the cursor
+      intact so the caller reschedules and resumes.
+    * replica groups + ``hedge_delay``/``read_deadline`` — a source
+      may be ``[primary, mirror, ...]``; slow chunk reads hedge
+      against the mirrors after the hedge delay (env
+      ``TPQ_HEDGE_DELAY_S``, default rolling p95), first success wins.
+    * ``resume_from=path`` + ``checkpoint_every`` (env
+      ``TPQ_CHECKPOINT_EVERY``, default 16) — durable crash-safe
+      cursor: the scan resumes from ``path`` when it exists and
+      auto-checkpoints to it atomically as units complete, so a
+      SIGKILL'd process resumes with no unit lost; re-decoded units
+      (at most one checkpoint window) are bit-exact, so a keyed
+      consumer converges to the identical union.  :meth:`cursor_save`
+      checkpoints explicitly.
     """
 
     def __init__(self, sources, *columns: str, mesh=None, resume=None,
                  on_error: str = "raise", retries: int | None = None,
                  salvage: bool = False,
-                 strict_metadata: bool | None = None):
+                 strict_metadata: bool | None = None,
+                 unit_deadline: float | None = None,
+                 scan_deadline: float | None = None,
+                 hedge_delay: float | None = None,
+                 read_deadline: float | None = None,
+                 resume_from: str | None = None,
+                 checkpoint_every: int | None = None):
         from .mesh import make_mesh
 
         if on_error not in ("raise", "quarantine"):
             raise ValueError(
                 f"on_error must be 'raise' or 'quarantine', "
                 f"not {on_error!r}")
+        self._init_durable(
+            on_error=on_error, unit_deadline=unit_deadline,
+            scan_deadline=scan_deadline, resume=resume,
+            resume_from=resume_from, checkpoint_every=checkpoint_every,
+            checkpoint_path=resume_from)
         self.mesh = mesh if mesh is not None else make_mesh()
         # file-level entries recorded at open time live in their own
         # report so run() can reset the unit-level entries without
@@ -322,7 +623,8 @@ class ShardedScan:
         self.readers = open_sources(
             sources, columns, on_error=on_error,
             quarantine=self._open_quarantine, salvage=salvage,
-            strict_metadata=strict_metadata)
+            strict_metadata=strict_metadata, hedge_delay=hedge_delay,
+            read_deadline=read_deadline)
         self.units = scan_units(self.readers)
         self.devices = list(self.mesh.devices.flat)
         self.on_error = on_error
@@ -330,6 +632,9 @@ class ShardedScan:
         self.quarantine = QuarantineReport(
             self._open_quarantine.as_dicts())
         self._next_unit = 0
+        if resume is None and resume_from is not None \
+                and os.path.exists(resume_from):
+            resume = load_cursor_file(resume_from)
         if resume is not None:
             self._load_cursor(resume)
 
@@ -338,6 +643,11 @@ class ShardedScan:
                                       len(self.units))
         self.quarantine = QuarantineReport.from_dicts(
             cursor.get("quarantine"))
+        # the resumed scan re-opened its sources, so a file already
+        # quarantined in the checkpointed cursor was rejected AGAIN at
+        # open time — merge the fresh open entries deduped by
+        # coordinates instead of double-listing the file
+        self.quarantine.merge_unique(self._open_quarantine.as_dicts())
 
     def state(self) -> dict:
         """JSON-serializable cursor: resume with
@@ -351,11 +661,23 @@ class ShardedScan:
     def device_for(self, unit_index: int):
         return self.devices[unit_index % len(self.devices)]
 
+    def _progress(self) -> tuple[int, int]:
+        return self._next_unit, len(self.units)
+
     def run_iter(self):
         """Yield ``(unit_index, {path: DeviceColumn})`` from the cursor
         position, advancing it after each unit.  In quarantine mode,
         failed units are skipped (recorded in :attr:`quarantine`), so
-        the yielded unit indices identify exactly what decoded."""
+        the yielded unit indices identify exactly what decoded.
+
+        With ``resume_from=`` configured the cursor auto-checkpoints
+        durably every ``checkpoint_every`` completed units (and at
+        scan end); with ``scan_deadline`` set the scan stops between
+        units once the budget is spent, raising
+        :class:`~tpuparquet.errors.DeadlineExceededError` with the
+        cursor intact."""
+        self._run_t0 = time.monotonic()
+        self._check_scan_deadline()
         if self.on_error == "raise":
             for k, out in pipelined_unit_scan(
                 self.readers, self.units, self.device_for,
@@ -363,15 +685,22 @@ class ShardedScan:
             ):
                 self._next_unit = k + 1
                 yield k, out
+                self._maybe_checkpoint()
+                self._check_scan_deadline()
+            self._flush_checkpoint()
             return
         for k, out in resilient_unit_scan(
             self.readers, self.units, self.device_for,
             start=self._next_unit, retries=self.retries,
             quarantine=self.quarantine,
+            unit_deadline=self.unit_deadline,
         ):
             self._next_unit = k + 1
             if out is not None:
                 yield k, out
+            self._maybe_checkpoint()
+            self._check_scan_deadline()
+        self._flush_checkpoint()
 
     def run(self) -> list[dict[str, DeviceColumn]]:
         """Decode ALL units (position i of the result is unit i).

@@ -39,7 +39,7 @@ import threading
 import time
 
 __all__ = ["DecodeStats", "collect_stats", "current_stats",
-           "worker_stats", "trace"]
+           "worker_stats", "merge_worker_stats", "trace"]
 
 
 @dataclasses.dataclass
@@ -117,6 +117,20 @@ class DecodeStats:
     # footers rejected by strict metadata validation
     # (FileReader(strict_metadata=True) / TPQ_STRICT_METADATA)
     metadata_rejects: int = 0
+    # -- time-domain observables (tpuparquet/deadline.py) --
+    # watched operations (chunk reads, device dispatches, whole units)
+    # that ran past their budget and were converted into
+    # DeadlineExceededError/DispatchDeadlineError by the watchdog path
+    deadline_exceeded: int = 0
+    # hedged reads: extra replica reads launched after the hedge
+    # delay, and how many of those actually won the race (a healthy
+    # store hedges rarely and wins rarely; a degraded primary shows
+    # hedges_won ~ hedges_issued)
+    hedges_issued: int = 0
+    hedges_won: int = 0
+    # durable cursor checkpoints written (shard.scan.save_cursor_file
+    # via the auto-checkpoint path or an explicit cursor_save)
+    checkpoints_written: int = 0
     # where the device-path wall went, accumulated per unit: host plan
     # phase (page walk, decompression, run-table scans — overlapped with
     # transfer by the pipelined reader, so plan_s can exceed the e2e
@@ -149,6 +163,8 @@ class DecodeStats:
         "pages_degraded", "units_degraded", "units_quarantined",
         "files_salvaged", "row_groups_recovered", "files_quarantined",
         "metadata_rejects",
+        "deadline_exceeded", "hedges_issued", "hedges_won",
+        "checkpoints_written",
         "plan_s", "transfer_s", "dispatch_s",
     )
 
@@ -210,6 +226,10 @@ class DecodeStats:
             "row_groups_recovered": self.row_groups_recovered,
             "files_quarantined": self.files_quarantined,
             "metadata_rejects": self.metadata_rejects,
+            "deadline_exceeded": self.deadline_exceeded,
+            "hedges_issued": self.hedges_issued,
+            "hedges_won": self.hedges_won,
+            "checkpoints_written": self.checkpoints_written,
             "plan_s": round(self.plan_s, 6),
             "transfer_s": round(self.transfer_s, 6),
             "dispatch_s": round(self.dispatch_s, 6),
@@ -245,6 +265,12 @@ class DecodeStats:
                    or d["io_retries"] or d["dispatch_retries"]
                    or d["pages_degraded"] or d["units_degraded"]
                    or d["units_quarantined"]) else "")
+            + (f"; TIME: {d['deadline_exceeded']} deadlines exceeded, "
+               f"{d['hedges_issued']} hedges issued "
+               f"({d['hedges_won']} won), "
+               f"{d['checkpoints_written']} checkpoints"
+               if (d["deadline_exceeded"] or d["hedges_issued"]
+                   or d["checkpoints_written"]) else "")
             + (f"; SALVAGE: {d['files_salvaged']} files salvaged "
                f"({d['row_groups_recovered']} row groups recovered), "
                f"{d['files_quarantined']} files quarantined, "
@@ -343,6 +369,40 @@ def worker_stats(like: "DecodeStats | None" = None):
         yield st
     finally:
         _tls.active = prev
+
+
+# counters that carry fault-layer observability (injected faults, CRC
+# rejects, retry attempts, deadline expiries, hedges): the only thing
+# a FAILED worker attempt may contribute to its coordinator —
+# everything else from a failed attempt would be a phantom count.
+# These must cover every counter the fault EVENTS (which DO merge on
+# failure) can record, or counters and events diverge.
+_FAULT_OBSERVABILITY_FIELDS = ("faults_injected", "crc_mismatches",
+                               "io_retries", "dispatch_retries",
+                               "deadline_exceeded", "hedges_issued",
+                               "hedges_won")
+
+
+def merge_worker_stats(st: "DecodeStats | None",
+                       ws: "DecodeStats | None", *,
+                       failed: bool) -> None:
+    """Fold a worker/attempt collector into the coordinator's with the
+    resilient-attempt exactness policy: EVERYTHING on success;
+    fault-layer observability only on failure (a unit that retried N
+    times still counts its pages/values/bytes exactly once, and
+    aborted attempts leave no phantom page events).  The single owner
+    of this policy — used by the retry ladder
+    (``kernels.device.read_row_group_device_resilient``) and the
+    deadline/hedge worker threads (``tpuparquet/deadline.py``)."""
+    if st is None or ws is None:
+        return
+    if not failed:
+        st.merge_from(ws)
+        return
+    for f in _FAULT_OBSERVABILITY_FIELDS:
+        setattr(st, f, getattr(st, f) + getattr(ws, f))
+    if st.events is not None and ws.events is not None:
+        st.events.faults.extend(ws.events.faults)
 
 
 @contextlib.contextmanager
