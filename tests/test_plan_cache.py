@@ -1,0 +1,217 @@
+"""Footer-keyed plan cache correctness (round 6, kernels/plancache.py).
+
+The cache remembers per-page transport verdicts keyed by
+``(footer fingerprint, rg, column)`` so re-reads skip the wire-cost
+competition.  Pinned here: warm hits are bit-exact (same decoded
+values, same staged bytes); salvaged and rewritten files can never be
+served stale plans; the LRU byte budget evicts; corruption invalidates
+a file's entries; and the hit/miss/evict counters merge exactly through
+``worker_stats`` and ``allgather_stats``.
+"""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from tpuparquet import FileReader, FileWriter
+from tpuparquet.cpu.plain import ByteArrayColumn
+from tpuparquet.errors import ScanError
+from tpuparquet.faults import inject_faults
+from tpuparquet.format.metadata import CompressionCodec
+from tpuparquet.kernels.device import read_row_groups_device
+from tpuparquet.kernels import plancache
+from tpuparquet.stats import DecodeStats, collect_stats
+
+TORN_DIR = os.path.join(os.path.dirname(__file__), "corpus", "torn")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    plancache.clear_plan_cache()
+    yield
+    plancache.clear_plan_cache()
+
+
+def _file(n=4000, n_groups=2, seed=5) -> bytes:
+    rng = np.random.default_rng(seed)
+    buf = io.BytesIO()
+    w = FileWriter(
+        buf,
+        """message m {
+            required int64 ts;
+            required int32 small;
+            required double x;
+            required binary s (STRING);
+        }""",
+        codec=CompressionCodec.SNAPPY,
+    )
+    for _ in range(n_groups):
+        w.write_columns({
+            "ts": np.int64(1_600_000_000_000)
+            + rng.integers(0, 9_000, n).cumsum(),
+            "small": rng.integers(0, 6, n).astype(np.int32),
+            "x": rng.random(n),
+            "s": ByteArrayColumn.from_list(
+                [f"row-{i % 80}".encode() for i in range(n)]),
+        })
+    w.close()
+    return buf.getvalue()
+
+
+def _decode(reader):
+    with collect_stats() as st:
+        outs = {}
+        for rg, cols in read_row_groups_device(reader):
+            outs[rg] = {p: c.to_numpy() for p, c in cols.items()}
+    return outs, st
+
+
+def _assert_identical(o1, o2):
+    assert o1.keys() == o2.keys()
+    for rg in o1:
+        for path in o1[rg]:
+            for a, b in zip(o1[rg][path], o2[rg][path]):
+                if isinstance(a, ByteArrayColumn):
+                    np.testing.assert_array_equal(a.offsets, b.offsets)
+                    np.testing.assert_array_equal(a.data, b.data)
+                else:
+                    np.testing.assert_array_equal(np.asarray(a),
+                                                  np.asarray(b))
+
+
+def test_hit_after_reopen_bit_exact(tmp_path, monkeypatch):
+    """Cold populate through one reader, warm hit through a FRESH
+    reader of the same file: hits counted, output bit-exact, staged
+    bytes identical."""
+    monkeypatch.setenv("TPQ_PLAN_CACHE_MB", "16")
+    path = tmp_path / "a.parquet"
+    path.write_bytes(_file())
+    with FileReader(str(path)) as r1:
+        fp1 = r1.plan_fingerprint
+        assert fp1 is not None
+        o1, s1 = _decode(r1)
+    assert s1.plan_cache_misses > 0 and s1.plan_cache_hits == 0
+    with FileReader(str(path)) as r2:
+        assert r2.plan_fingerprint == fp1  # identity survives reopen
+        o2, s2 = _decode(r2)
+    assert s2.plan_cache_misses == 0
+    assert s2.plan_cache_hits == s1.plan_cache_misses
+    assert s2.bytes_staged == s1.bytes_staged
+    _assert_identical(o1, o2)
+
+
+def test_disabled_by_default(tmp_path):
+    os.environ.pop("TPQ_PLAN_CACHE_MB", None)
+    path = tmp_path / "a.parquet"
+    path.write_bytes(_file())
+    with FileReader(str(path)) as r:
+        _, st = _decode(r)
+    assert st.plan_cache_hits == st.plan_cache_misses == 0
+    assert len(plancache._CACHE) == 0
+
+
+def test_rewritten_file_never_hits_stale(tmp_path, monkeypatch):
+    """Rewriting a file in place gives it a new footer fingerprint:
+    the re-read misses (no stale plans) and decodes the NEW bytes."""
+    monkeypatch.setenv("TPQ_PLAN_CACHE_MB", "16")
+    path = tmp_path / "a.parquet"
+    path.write_bytes(_file(seed=5))
+    with FileReader(str(path)) as r1:
+        o1, s1 = _decode(r1)
+    path.write_bytes(_file(seed=77))  # different data, new footer
+    with FileReader(str(path)) as r2:
+        o2, s2 = _decode(r2)
+    assert s2.plan_cache_hits == 0 and s2.plan_cache_misses > 0
+    with pytest.raises(AssertionError):
+        _assert_identical(o1, o2)  # genuinely different bytes decoded
+
+
+def test_salvaged_files_bypass_cache(monkeypatch):
+    """A salvage-opened file has no fingerprint: it neither populates
+    nor hits the cache (recovered metadata must never key plans)."""
+    monkeypatch.setenv("TPQ_PLAN_CACHE_MB", "16")
+    torn = os.path.join(TORN_DIR, "footer_torn.parquet")
+    if not os.path.exists(torn):
+        pytest.skip("torn corpus not present")
+    with FileReader(torn, salvage=True) as r:
+        assert r.salvaged
+        assert r.plan_fingerprint is None
+        if r.row_group_count():
+            _, st = _decode(r)
+            assert st.plan_cache_hits == st.plan_cache_misses == 0
+    assert len(plancache._CACHE) == 0
+
+
+def test_lru_eviction_under_tiny_budget(tmp_path, monkeypatch):
+    """A byte budget smaller than the working set evicts LRU entries
+    and counts them; the cache never exceeds its budget."""
+    monkeypatch.setenv("TPQ_PLAN_CACHE_MB", "0.001")  # ~1 KiB
+    path = tmp_path / "a.parquet"
+    path.write_bytes(_file())
+    with FileReader(str(path)) as r:
+        _, st = _decode(r)
+        _, st2 = _decode(r)
+    assert st.plan_cache_evictions > 0
+    assert plancache._CACHE.nbytes <= plancache.plan_cache_budget()
+    # a cache this small cannot hold the file: re-reads keep missing,
+    # and decode stays correct regardless
+    assert st2.plan_cache_misses > 0
+
+
+def test_corruption_invalidates_fingerprint(tmp_path, monkeypatch):
+    """A CRC-rejected page during planning drops every cached entry
+    under that file's fingerprint."""
+    monkeypatch.setenv("TPQ_PLAN_CACHE_MB", "16")
+    path = tmp_path / "a.parquet"
+    path.write_bytes(_file())
+    with FileReader(str(path), verify_crc=True) as r:
+        fp = r.plan_fingerprint
+        _decode(r)
+        n_cold = len(plancache._CACHE)
+        assert n_cold > 0
+        assert (fp, 0, "ts") in plancache._CACHE._entries
+        with inject_faults() as inj:
+            inj.inject("kernels.device.page_payload", "corrupt",
+                       match={"column": "ts"})
+            with pytest.raises(ScanError):
+                for _rg, cols in read_row_groups_device(r):
+                    for c in cols.values():
+                        c.block_until_ready()
+    # every pre-corruption entry was dropped; columns that re-planned
+    # cleanly after the invalidation may re-store FRESH verdicts, but
+    # the corrupt column's entry cannot come back (its re-plan raised)
+    assert (fp, 0, "ts") not in plancache._CACHE._entries
+    assert len(plancache._CACHE) < n_cold
+
+
+def test_counters_merge_exactly():
+    """plan_cache_* ride the standard merge fields: worker_stats folds
+    and the allgather wire form both sum exactly."""
+    a = DecodeStats()
+    a.plan_cache_hits, a.plan_cache_misses, a.plan_cache_evictions = 3, 5, 2
+    b = DecodeStats.from_state(a.to_state())  # exact wire round trip
+    assert (b.plan_cache_hits, b.plan_cache_misses,
+            b.plan_cache_evictions) == (3, 5, 2)
+    a.merge_from(b)
+    assert (a.plan_cache_hits, a.plan_cache_misses,
+            a.plan_cache_evictions) == (6, 10, 4)
+
+
+def test_counters_through_allgather(tmp_path, monkeypatch):
+    """End to end: a decode's cache counters survive allgather_stats
+    (single-process fleet: totals equal the local collector)."""
+    from tpuparquet.shard.distributed import allgather_stats
+
+    monkeypatch.setenv("TPQ_PLAN_CACHE_MB", "16")
+    path = tmp_path / "a.parquet"
+    path.write_bytes(_file())
+    with FileReader(str(path)) as r:
+        _, _ = _decode(r)
+        _, st = _decode(r)
+    assert st.plan_cache_hits > 0
+    fleet = allgather_stats(st)
+    assert fleet.plan_cache_hits == st.plan_cache_hits
+    assert fleet.plan_cache_misses == st.plan_cache_misses
+    assert fleet.plan_cache_evictions == st.plan_cache_evictions

@@ -1,0 +1,217 @@
+"""Parity pin for the column-parallel planner (round 6).
+
+The device plan phase schedules one task per column chunk on a shared
+work pool (``kernels/device.py``).  These tests pin the contract that
+thread count is UNOBSERVABLE in the output: ``TPQ_PLAN_THREADS=1``
+serial planning and a wide pool produce byte-identical decoded values,
+identical staged bytes, and identical transport routing across the
+fallback-matrix type×encoding grid — including under injected faults
+and with a dispatch deadline armed.  A scheduling change that leaked
+thread count into plan output would fail here, not in a profile.
+"""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from tpuparquet import FileReader, FileWriter
+from tpuparquet.cpu.plain import ByteArrayColumn
+from tpuparquet.errors import CorruptPageError, ScanError
+from tpuparquet.faults import inject_faults
+from tpuparquet.format.metadata import CompressionCodec, Encoding
+from tpuparquet.kernels.device import (
+    read_row_group_device,
+    read_row_group_device_resilient,
+    read_row_groups_device,
+)
+from tpuparquet.stats import collect_stats
+
+N = 3000
+_RNG = np.random.default_rng(11)
+
+
+def _grid_file(codec=CompressionCodec.SNAPPY, v2=False) -> io.BytesIO:
+    """One file holding the writable type×encoding grid as columns —
+    several row groups so the pipelined path runs too."""
+    cols_spec = [
+        ("b_plain", "boolean", None),
+        ("b_rle", "boolean", Encoding.RLE),
+        ("i32_plain", "int32", None),
+        ("i32_delta", "int32", Encoding.DELTA_BINARY_PACKED),
+        ("i32_bss", "int32", Encoding.BYTE_STREAM_SPLIT),
+        ("i64_plain", "int64", None),
+        ("i64_delta", "int64", Encoding.DELTA_BINARY_PACKED),
+        ("i64_bss", "int64", Encoding.BYTE_STREAM_SPLIT),
+        ("i96", "int96", None),
+        ("f32_plain", "float", None),
+        ("f64_bss", "double", Encoding.BYTE_STREAM_SPLIT),
+        ("bin_plain", "binary", None),
+        ("bin_dlba", "binary", Encoding.DELTA_LENGTH_BYTE_ARRAY),
+        ("bin_dba", "binary", Encoding.DELTA_BYTE_ARRAY),
+        ("flba_plain", "fixed_len_byte_array(4)", None),
+        ("flba_dba", "fixed_len_byte_array(4)", Encoding.DELTA_BYTE_ARRAY),
+    ]
+    dsl = "message grid {\n" + "\n".join(
+        f"  required {t} {name};" for name, t, _ in cols_spec) + "\n}"
+    enc = {name: e for name, t, e in cols_spec if e is not None}
+    buf = io.BytesIO()
+    w = FileWriter(buf, dsl, codec=codec, column_encodings=enc,
+                   data_page_v2=v2)
+    for g in range(2):
+        rng = np.random.default_rng(100 + g)
+        ba = ByteArrayColumn.from_list(
+            [f"value-{i % 60}".encode() for i in range(N)])
+        w.write_columns({
+            "b_plain": rng.integers(0, 2, N).astype(bool),
+            "b_rle": (np.arange(N) % 7 < 5),
+            "i32_plain": rng.integers(0, 50, N).astype(np.int32),
+            "i32_delta": rng.integers(-1000, 1000, N).astype(np.int32),
+            "i32_bss": rng.integers(0, 1 << 20, N).astype(np.int32),
+            "i64_plain": np.int64(1_700_000_000_000)
+            + rng.integers(0, 60_000, N).cumsum(),
+            "i64_delta": rng.integers(-(1 << 40), 1 << 40, N),
+            "i64_bss": rng.integers(0, 1 << 40, N),
+            "i96": rng.integers(0, 2**31, (N, 3)).astype(np.uint32),
+            "f32_plain": rng.random(N).astype(np.float32),
+            "f64_bss": rng.random(N),
+            "bin_plain": ba,
+            "bin_dlba": ba,
+            "bin_dba": ba,
+            "flba_plain": rng.integers(0, 37, (N, 4)).astype(np.uint8),
+            "flba_dba": rng.integers(0, 5, (N, 4)).astype(np.uint8),
+        })
+    w.close()
+    buf.seek(0)
+    return buf
+
+
+def _decode(reader, threads, monkeypatch, resilient=False):
+    monkeypatch.setenv("TPQ_PLAN_THREADS", str(threads))
+    with collect_stats(events=True) as st:
+        outs = {}
+        if resilient:
+            for rg in range(reader.row_group_count()):
+                cols = read_row_group_device_resilient(reader, rg)
+                outs[rg] = {p: c.to_numpy() for p, c in cols.items()}
+        else:
+            for rg, cols in read_row_groups_device(reader):
+                outs[rg] = {p: c.to_numpy() for p, c in cols.items()}
+    return outs, st
+
+
+def _assert_identical(o1, o2):
+    assert o1.keys() == o2.keys()
+    for rg in o1:
+        assert o1[rg].keys() == o2[rg].keys()
+        for path in o1[rg]:
+            for a, b in zip(o1[rg][path], o2[rg][path]):
+                if isinstance(a, ByteArrayColumn):
+                    np.testing.assert_array_equal(a.offsets, b.offsets,
+                                                  err_msg=path)
+                    np.testing.assert_array_equal(a.data, b.data,
+                                                  err_msg=path)
+                else:
+                    np.testing.assert_array_equal(
+                        np.asarray(a), np.asarray(b), err_msg=path)
+
+
+_ROUTING = ("pages_device_snappy", "pages_device_planes",
+            "pages_device_delta_lanes", "pages_host_values",
+            "pages_degraded")
+
+
+@pytest.mark.parametrize("codec,v2", [
+    (CompressionCodec.SNAPPY, False),
+    (CompressionCodec.UNCOMPRESSED, True),
+])
+def test_parallel_plan_byte_identical(codec, v2, monkeypatch):
+    """TPQ_PLAN_THREADS=1 vs a wide pool: same values, same staged
+    bytes, same transport routing, same per-page events."""
+    buf = _grid_file(codec, v2)
+    r = FileReader(buf)
+    o1, s1 = _decode(r, 1, monkeypatch)
+    o8, s8 = _decode(r, 8, monkeypatch)
+    _assert_identical(o1, o8)
+    assert s1.bytes_staged == s8.bytes_staged
+    d1, d8 = s1.as_dict(), s8.as_dict()
+    for k in ("pages", "chunks", "values", *_ROUTING):
+        assert d1[k] == d8[k], k
+    # per-page transports agree pagewise, not just in the aggregate
+    t1 = {(e.column, e.page): e.transport for e in s1.events.pages}
+    t8 = {(e.column, e.page): e.transport for e in s8.events.pages}
+    assert t1 == t8
+
+
+def test_parallel_plan_single_unit_fans_out(monkeypatch):
+    """A single row group decodes identically through the per-call
+    column pool (the single-large-unit shape)."""
+    buf = _grid_file()
+    r = FileReader(buf)
+    monkeypatch.setenv("TPQ_PLAN_THREADS", "1")
+    a = {p: c.to_numpy() for p, c in read_row_group_device(r, 0).items()}
+    monkeypatch.setenv("TPQ_PLAN_THREADS", "8")
+    b = {p: c.to_numpy() for p, c in read_row_group_device(r, 0).items()}
+    _assert_identical({0: a}, {0: b})
+
+
+def test_parity_under_transient_faults(monkeypatch, tmp_path):
+    """Injected transient I/O faults at the io.chunk/io.reader sites
+    retry identically at any thread count (file-backed source — the
+    retry ladder lives in the fd read path)."""
+    path = tmp_path / "grid.parquet"
+    path.write_bytes(_grid_file().getvalue())
+    monkeypatch.setenv("TPQ_RETRY_JITTER", "0")
+    results = []
+    for threads in (1, 8):
+        r = FileReader(str(path))
+        with inject_faults() as inj:
+            inj.inject("io.reader.chunk_read", "transient", times=2)
+            out, st = _decode(r, threads, monkeypatch)
+        r.close()
+        assert st.io_retries >= 1
+        results.append(out)
+    _assert_identical(*results)
+
+
+def test_parity_of_corruption_errors(monkeypatch):
+    """A corrupted page payload (io.chunk.* byte site feeding the CRC
+    check) raises the same taxonomy error with the same coordinates at
+    any thread count."""
+    buf = _grid_file()
+    errs = []
+    for threads in (1, 8):
+        monkeypatch.setenv("TPQ_PLAN_THREADS", str(threads))
+        r = FileReader(buf, verify_crc=True)
+        with inject_faults() as inj:
+            inj.inject("kernels.device.page_payload", "corrupt",
+                       match={"column": "i64_plain"})
+            with pytest.raises(ScanError) as ei:
+                for _rg, cols in read_row_groups_device(r):
+                    for c in cols.values():
+                        c.block_until_ready()
+        assert isinstance(ei.value, CorruptPageError)
+        errs.append((type(ei.value), ei.value.column, ei.value.page))
+    assert errs[0] == errs[1]
+
+
+def test_parity_under_dispatch_deadline_and_degrade(monkeypatch):
+    """With TPQ_DISPATCH_DEADLINE_S armed and device dispatch failing,
+    the resilient path degrades to the CPU oracle identically at any
+    thread count (the degraded flag must reach pool workers)."""
+    buf = _grid_file()
+    monkeypatch.setenv("TPQ_DISPATCH_DEADLINE_S", "30")
+    monkeypatch.setenv("TPQ_IO_RETRIES", "1")
+    results = []
+    for threads in (1, 8):
+        r = FileReader(buf)
+        with inject_faults() as inj:
+            # every dispatch attempt fails -> whole-unit CPU fallback
+            inj.inject("kernels.device.unit_dispatch", "dispatch",
+                       times=100)
+            out, st = _decode(r, threads, monkeypatch, resilient=True)
+        assert st.units_degraded == r.row_group_count()
+        assert st.pages_degraded > 0
+        results.append(out)
+    _assert_identical(*results)

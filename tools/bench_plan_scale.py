@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""Plan-phase scaling micro-bench: the measured curve behind the
+"wider plan parallelism" projection.
+
+Two observables per ``TPQ_PLAN_THREADS`` point over the driver's
+50M-value taxi shape (``bench.build_config2``):
+
+* ``plan_wall_s`` — the MAKESPAN of planning every column task of
+  every row group through a pool of that width, nothing else running.
+  This is the clean plan-wall number the north-star model consumes
+  (``wall ≈ plan_s + staged/BW``): on an N-core host it divides by
+  workers; on a 1-core container it is honestly flat.
+* ``pipelined_plan_s`` / ``e2e_wall_s`` — ``DecodeStats.plan_s`` and
+  wall through the full pipelined device decode, the protocol of the
+  round-5 record (its 1.10–1.16 s serial baseline is THIS metric).
+  Per-task spans time-share against dispatch on a 1-core box, so this
+  curve can inflate with thread count while e2e holds; both are
+  recorded.
+
+Then the footer-keyed plan cache's warm-re-read lever
+(``TPQ_PLAN_CACHE_MB``) is measured plan-only (no dispatch noise) on
+two shapes: the taxi file and the wide string/float shape (config 4).
+Emits ``PLAN_SCALE_r06.json`` in the repo root (or ``--out``).
+``TPQ_BENCH_TARGET`` scales the shapes down for smoke runs.
+
+Usage: JAX_PLATFORMS=cpu python tools/bench_plan_scale.py [--out PATH]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+THREADS = (1, 2, 4, 8)
+REPS = int(os.environ.get("TPQ_PLAN_SCALE_REPS", 2))
+
+
+def _plan_makespan(reader, threads: int):
+    """Wall seconds to plan every column task of every row group with
+    a ``threads``-wide pool (stats collected for counters/plan_s)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    import tpuparquet.kernels.device as D
+    from tpuparquet.kernels.arena import lease_arena, return_arena
+    from tpuparquet.stats import collect_stats
+
+    # arenas are leased per task and returned as each task finishes —
+    # plan-only never stages, so slabs recycle immediately (holding
+    # every unit's slabs to the end measurably distorts a 50M sweep)
+    def one(rgi, path, node, cm, like):
+        a = lease_arena()
+        try:
+            return D._plan_column_task(reader, rgi, path, node, cm, a,
+                                       like, False)
+        finally:
+            return_arena(a)
+
+    tasks = []
+    for rgi in range(reader.row_group_count()):
+        rg = reader.meta.row_groups[rgi]
+        for path, node, cm in reader.selected_chunks(rg):
+            tasks.append((rgi, path, node, cm))
+    with collect_stats() as st:
+        t0 = time.perf_counter()
+        if threads == 1:
+            for rgi, path, node, cm in tasks:
+                _, ws = one(rgi, path, node, cm, st)
+                st.merge_from(ws)
+        else:
+            with ThreadPoolExecutor(max_workers=threads) as ex:
+                futs = [ex.submit(one, rgi, path, node, cm, st)
+                        for rgi, path, node, cm in tasks]
+                for f in futs:
+                    _, ws = f.result()
+                    st.merge_from(ws)
+        wall = time.perf_counter() - t0
+    return wall, st
+
+
+def _decode_once(reader):
+    from tpuparquet.kernels.device import read_row_groups_device
+    from tpuparquet.stats import collect_stats
+
+    with collect_stats() as st:
+        t0 = time.perf_counter()
+        for _rg, cols in read_row_groups_device(reader):
+            for c in cols.values():
+                c.block_until_ready()
+        wall = time.perf_counter() - t0
+    return wall, st
+
+
+def _cache_leg(reader):
+    """Plan-only warm-cache measurement: no-cache re-read baseline,
+    cold cached pass (store overhead included), warm best."""
+    from tpuparquet.kernels.plancache import clear_plan_cache
+
+    os.environ.pop("TPQ_PLAN_CACHE_MB", None)
+    base = min(_plan_makespan(reader, 1)[0] for _ in range(REPS))
+    os.environ["TPQ_PLAN_CACHE_MB"] = "256"
+    clear_plan_cache()
+    cold = _plan_makespan(reader, 1)[0]
+    warm = None
+    warm_st = None
+    for _ in range(REPS):
+        w, st = _plan_makespan(reader, 1)
+        if warm is None or w < warm:
+            warm, warm_st = w, st
+    os.environ.pop("TPQ_PLAN_CACHE_MB", None)
+    return {
+        "budget_mb": 256,
+        "no_cache_reread_plan_s": round(base, 4),
+        "cold_plan_s": round(cold, 4),
+        "warm_plan_s": round(warm, 4),
+        "warm_reduction_vs_cold": round(1.0 - warm / cold, 4),
+        "warm_reduction_vs_no_cache": round(1.0 - warm / base, 4),
+        "hits": warm_st.plan_cache_hits,
+        "misses": warm_st.plan_cache_misses,
+    }
+
+
+def main(argv=None) -> int:
+    out_path = "PLAN_SCALE_r06.json"
+    args = list(argv if argv is not None else sys.argv[1:])
+    if "--out" in args:
+        out_path = args[args.index("--out") + 1]
+
+    import jax
+
+    import bench
+    from tpuparquet.io.reader import FileReader
+    from tpuparquet.kernels.device import _usable_cpus
+
+    target = bench.TARGET
+    print(f"building taxi shape at {target:,} values ...",
+          file=sys.stderr, flush=True)
+    reader = FileReader(bench.build_config2())
+    n_values = bench.total_values(reader)
+
+    os.environ.pop("TPQ_PLAN_CACHE_MB", None)
+    result = {
+        "metric": "plan wall vs TPQ_PLAN_THREADS, 50M taxi shape",
+        "n_values": n_values,
+        "usable_cpus": _usable_cpus(),
+        "backend": jax.default_backend(),
+        "reps": REPS,
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "sweep": [],
+    }
+
+    _decode_once(reader)  # warm compile: jit shapes off the clock
+    for t in THREADS:
+        os.environ["TPQ_PLAN_THREADS"] = str(t)
+        mk = min(_plan_makespan(reader, t)[0] for _ in range(REPS))
+        pipe = None
+        for _ in range(REPS):
+            wall, st = _decode_once(reader)
+            if pipe is None or st.plan_s < pipe[0]:
+                pipe = (st.plan_s, wall, st.bytes_staged)
+        point = {"threads": t, "plan_wall_s": round(mk, 4),
+                 "pipelined_plan_s": round(pipe[0], 4),
+                 "e2e_wall_s": round(pipe[1], 4),
+                 "bytes_staged": pipe[2]}
+        result["sweep"].append(point)
+        print(f"  threads={t}: plan_wall {point['plan_wall_s']}s  "
+              f"pipelined plan_s {point['pipelined_plan_s']}s  "
+              f"e2e {point['e2e_wall_s']}s", file=sys.stderr, flush=True)
+
+    os.environ["TPQ_PLAN_THREADS"] = "1"
+    result["plan_cache"] = {"taxi": _cache_leg(reader)}
+    print(f"  cache/taxi: {result['plan_cache']['taxi']}",
+          file=sys.stderr, flush=True)
+    # epoch-shard shape: the same taxi schema at a realistic
+    # per-shard-file size (2M values), where per-page DECISION work is
+    # a large slice of the plan — the shape the cache's "re-read pays
+    # transfer only" story is about (an epoch re-reads many such files)
+    shard = FileReader(bench.build_config2(n_values=2_000_000,
+                                           n_groups=8))
+    _plan_makespan(shard, 1)
+    result["plan_cache"]["taxi-2M-epoch-shard"] = _cache_leg(shard)
+    print(f"  cache/shard: "
+          f"{result['plan_cache']['taxi-2M-epoch-shard']}",
+          file=sys.stderr, flush=True)
+    print("building wide shape (config 4) ...", file=sys.stderr,
+          flush=True)
+    wide = FileReader(bench.build_config4())
+    _plan_makespan(wide, 1)
+    result["plan_cache"]["wide-string-float"] = _cache_leg(wide)
+    print(f"  cache/wide: {result['plan_cache']['wide-string-float']}",
+          file=sys.stderr, flush=True)
+    os.environ.pop("TPQ_PLAN_THREADS", None)
+
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,12 @@
 #                       (watchdog deadlines, hedged reads over
 #                       replicas) and the SIGKILL/resume durable-
 #                       checkpoint sweep
+#   6. plan matrix     — strict (rc=0): the column-parallel planner's
+#                       serial/parallel parity pin run under BOTH
+#                       TPQ_PLAN_THREADS=1 and the default pool, and
+#                       the plan-cache suite with the cache ON — the
+#                       serial path and the cache-off path can never
+#                       silently rot
 #
 # Usage: bash tools/ci.sh            (exit 0 = gate passed)
 # The tier-1 stage mirrors ROADMAP.md exactly — if you change one,
@@ -42,7 +48,7 @@ CI_PASS_FLOOR=${CI_PASS_FLOOR:-860}
 
 fail() { echo "ci.sh: FAILED at stage $1" >&2; exit 1; }
 
-echo "=== stage 1/5: tier-1 suite (pass floor $CI_PASS_FLOOR) ==="
+echo "=== stage 1/6: tier-1 suite (pass floor $CI_PASS_FLOOR) ==="
 rm -f /tmp/_t1.log
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
@@ -56,22 +62,33 @@ echo "DOTS_PASSED=$passed"
 [ "$passed" -ge "$CI_PASS_FLOOR" ] \
   || fail "tier-1 ($passed passed < floor $CI_PASS_FLOOR)"
 
-echo "=== stage 2/5: smoke bench (CPU backend, tiny target) ==="
+echo "=== stage 2/6: smoke bench (CPU backend, tiny target) ==="
 TPQ_BENCH_TARGET=60000 TPQ_BENCH_CPU=1 timeout -k 10 600 \
   python bench.py > /tmp/_ci_bench.json || fail "smoke bench"
 tail -1 /tmp/_ci_bench.json
 
-echo "=== stage 3/5: crash corpus + fault-injection matrix (strict) ==="
+echo "=== stage 3/6: crash corpus + fault-injection matrix (strict) ==="
 timeout -k 10 600 python -m pytest \
   "tests/test_corpus.py::TestCrashRegressions" tests/test_faults.py \
   -q -p no:cacheprovider || fail "corpus/faults"
 
-echo "=== stage 4/5: salvage + strict metadata (strict) ==="
+echo "=== stage 4/6: salvage + strict metadata (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_salvage.py \
   -q -p no:cacheprovider || fail "salvage"
 
-echo "=== stage 5/5: deadlines/hedging + kill-resume checkpoints (strict) ==="
+echo "=== stage 5/6: deadlines/hedging + kill-resume checkpoints (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_deadline.py \
   tests/test_checkpoint.py -q -p no:cacheprovider || fail "time/crash"
+
+echo "=== stage 6/6: plan matrix: serial vs parallel, cache on (strict) ==="
+# leg A: pinned-serial planning (the TPQ_PLAN_THREADS=1 reference path)
+TPQ_PLAN_THREADS=1 timeout -k 10 600 python -m pytest \
+  tests/test_plan_parallel.py tests/test_plan_cache.py \
+  -q -p no:cacheprovider || fail "plan matrix (serial leg)"
+# leg B: default pool width + the footer-keyed plan cache enabled for
+# the whole fallback-matrix routing pin (hints must not change routing)
+TPQ_PLAN_CACHE_MB=64 timeout -k 10 600 python -m pytest \
+  tests/test_plan_parallel.py tests/test_fallback_matrix.py \
+  -q -p no:cacheprovider || fail "plan matrix (cache-on leg)"
 
 echo "ci.sh: gate PASSED"

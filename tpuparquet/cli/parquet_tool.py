@@ -265,6 +265,14 @@ def cmd_profile(args, out=None) -> int:
           f"transfer {d['transfer_s']:.3f}s  "
           f"dispatch {d['dispatch_s']:.3f}s  wall {d['wall_s']:.3f}s",
           file=out)
+    # footer-keyed plan cache effectiveness (TPQ_PLAN_CACHE_MB): the
+    # per-span verdicts localize WHICH column plans hit vs re-derived
+    cache_spans = obs.plan_cache_span_counts(st.events)
+    if d["plan_cache_hits"] or d["plan_cache_misses"]:
+        print(f"plan cache: {d['plan_cache_hits']} hits  "
+              f"{d['plan_cache_misses']} misses  "
+              f"{d['plan_cache_evictions']} evictions  "
+              f"(spans: {cache_spans})", file=out)
     print(st.summary(), file=out)
     # per-column time-domain tallies: which column's reads hedged /
     # expired (global counts alone can't localize a degraded replica)

@@ -180,6 +180,17 @@ def decode_delta_binary_packed(data, dtype=np.int64, pos: int = 0):
     if st.total == 0:
         return np.empty(0, dtype=dtype), st.end_pos
 
+    from ..native import delta_native
+
+    nat = delta_native()
+    if nat is not None:
+        # one GIL-releasing C pass (unpack + min_delta + prefix sum):
+        # the numpy formulation below costs five full-size temporaries
+        out = nat.decode_all(data, st)
+        if out is not None:
+            return out.view(np.int64).astype(dtype, copy=False), \
+                st.end_pos
+
     # All arithmetic in uint64: two's-complement wraparound for free, for
     # both the 32- and 64-bit cases (final cast truncates to the target).
     n_deltas = st.total - 1

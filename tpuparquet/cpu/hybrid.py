@@ -28,11 +28,27 @@ from .bitpack import pack, unpack
 
 __all__ = [
     "scan_hybrid",
+    "slice_prefixed",
     "decode_hybrid",
     "decode_hybrid_prefixed",
     "encode_hybrid",
     "encode_hybrid_prefixed",
 ]
+
+
+def slice_prefixed(data, pos: int = 0):
+    """Validate a 4-byte-LE-length-prefixed hybrid stream and return
+    ``(stream, end_pos)`` where ``stream`` is exactly the prefixed
+    bytes — the single owner of the prefix bounds checks (shared by
+    :func:`decode_hybrid_prefixed` and the level decoders)."""
+    if pos + 4 > len(data):
+        raise ValueError("truncated hybrid length prefix")
+    (size,) = struct.unpack_from("<I", data, pos)
+    pos += 4
+    end = pos + size
+    if end > len(data):
+        raise ValueError(f"hybrid stream length {size} exceeds buffer")
+    return data[pos:end], end
 
 
 def scan_hybrid(data, count: int, width: int, pos: int = 0):
@@ -168,14 +184,8 @@ def decode_hybrid(data, count: int, width: int, pos: int = 0) -> np.ndarray:
 
 def decode_hybrid_prefixed(data, count: int, width: int, pos: int = 0):
     """Decode the 4-byte-length-prefixed form; returns (values, end_pos)."""
-    if pos + 4 > len(data):
-        raise ValueError("truncated hybrid length prefix")
-    (size,) = struct.unpack_from("<I", data, pos)
-    pos += 4
-    end = pos + size
-    if end > len(data):
-        raise ValueError(f"hybrid stream length {size} exceeds buffer")
-    return decode_hybrid(data[pos:end], count, width), end
+    stream, end = slice_prefixed(data, pos)
+    return decode_hybrid(stream, count, width), end
 
 
 _MIN_RLE_RUN = 8  # break even vs bit-packing for typical widths

@@ -22,6 +22,9 @@ from .hybrid import (
     decode_hybrid_prefixed,
     encode_hybrid,
     encode_hybrid_prefixed,
+    expand_scan,
+    scan_hybrid,
+    slice_prefixed,
 )
 
 __all__ = [
@@ -44,8 +47,8 @@ def decode_levels_v1(data, count: int, max_level: int, pos: int = 0):
     """Length-prefixed RLE level stream; returns (levels, end_pos)."""
     if max_level == 0:
         return np.zeros(count, dtype=np.int32), pos
-    vals, pos = decode_hybrid_prefixed(data, count, bit_width(max_level), pos)
-    return _check(vals, max_level), pos
+    stream, end = slice_prefixed(data, pos)
+    return _expand_checked(stream, count, max_level), end
 
 
 def decode_levels_raw(data, count: int, max_level: int):
@@ -53,7 +56,52 @@ def decode_levels_raw(data, count: int, max_level: int):
     page header, so ``data`` is exactly the stream)."""
     if max_level == 0:
         return np.zeros(count, dtype=np.int32)
-    return _check(decode_hybrid(data, count, bit_width(max_level)), max_level)
+    return _expand_checked(data, count, max_level)
+
+
+def _scan_max(sc, width: int):
+    """Max level over a run table's CONSUMED values without a full
+    expand: RLE run values are read straight off the table, bit-packed
+    segments get one native C pass over their consumed lanes
+    (``tpq_bp_stats``).  Returns None when the native scanner is
+    unavailable — the caller then validates on the expanded array (the
+    pre-round-6 full pass)."""
+    ends, is_rle, value, bp_start, bp_bytes, n_bp = sc[:6]
+    mx = 0
+    if is_rle.any():
+        mx = int(value[is_rle].max())
+    bp = ~is_rle
+    if bp.any() and n_bp:
+        from ..native import hybrid_native
+
+        nat = hybrid_native()
+        if nat is None or getattr(nat, "_bp_stats_fn", None) is None:
+            return None
+        lens = np.diff(ends, prepend=np.int32(0))
+        bp_mx, _ = nat.bp_stats(bp_bytes, width, bp_start[bp], lens[bp], 0)
+        if bp_mx is not None:
+            mx = max(mx, bp_mx)
+    return mx
+
+
+def _expand_checked(data, count: int, max_level: int) -> np.ndarray:
+    """One-scan level decode: run-table max validation (O(runs), native
+    bp pass) + vectorized expand, and a zero-copy int32 view of the
+    expanded uint32 instead of the old full-array ``astype`` — the
+    rep/def streams of a nested 50M-value chunk paid two extra full
+    passes here."""
+    width = bit_width(max_level)
+    sc = scan_hybrid(data, count, width)
+    mx = _scan_max(sc, width)
+    if mx is not None and mx > max_level:
+        raise ValueError(
+            f"level value {mx} exceeds max level {max_level}")
+    vals = expand_scan(*sc[:6], count, width)
+    out = (vals.view(np.int32) if vals.dtype == np.uint32
+           else vals.astype(np.int32))
+    if mx is None:
+        return _check(out, max_level)
+    return out
 
 
 def decode_levels_bitpacked(data, count: int, max_level: int):

@@ -192,10 +192,18 @@ def read_chunk(blob: "bytes | memoryview", cm: ColumnMetaData,
             column=col_path,
         )
 
-    rep = np.concatenate([p.rep_levels for p in pages]) if pages else \
-        np.empty(0, dtype=np.int32)
-    dl = np.concatenate([p.def_levels for p in pages]) if pages else \
-        np.empty(0, dtype=np.int32)
+    # single-page chunks (everything our writer emits) keep the page's
+    # level arrays as-is: np.concatenate of one array still copies, and
+    # at 50M values the two level streams paid ~100 MB of pure memcpy
+    if not pages:
+        rep = np.empty(0, dtype=np.int32)
+        dl = np.empty(0, dtype=np.int32)
+    elif len(pages) == 1:
+        rep = pages[0].rep_levels
+        dl = pages[0].def_levels
+    else:
+        rep = np.concatenate([p.rep_levels for p in pages])
+        dl = np.concatenate([p.def_levels for p in pages])
     null_count = int((dl != node.max_def_level).sum()) if node.max_def_level \
         else 0
 

@@ -26,7 +26,8 @@ from ..deadline import (
     read_deadline_default,
     read_latency,
 )
-from ..errors import CorruptChunkError, ScanError
+from ..errors import CorruptChunkError, CorruptPageError, \
+    ScanError
 from ..faults import fault_point, filter_bytes, retry_transient
 from ..format.footer import read_file_metadata
 from ..format.metadata import ColumnMetaData, FileMetaData
@@ -37,6 +38,9 @@ from .store import assemble_record, attach_stores
 __all__ = ["FileReader"]
 
 from ..format.footer import _file_size as _source_size  # noqa: E402
+
+
+_FP_UNSET = object()  # plan_fingerprint not computed yet
 
 
 class _IoHandle:
@@ -167,6 +171,14 @@ class FileReader:
             if self._owns:
                 self._f.close()
             raise
+        # footer fingerprint: the plan-cache key for this file identity
+        # (kernels/plancache.py), computed LAZILY on first access so
+        # cache-off opens never pay the extra footer read.  None for
+        # salvaged files — recovered metadata must never populate or
+        # hit the cache — and when the source cannot be fingerprinted.
+        # A rewritten file gets a new footer and therefore a new
+        # fingerprint, so stale plans age out.
+        self._plan_fp = _FP_UNSET
         self._rg_pos = 0          # next row group to load
         self._loaded = False      # current row group loaded into stores
         self._current_rg = 0      # last loaded (or next) row group index
@@ -265,6 +277,71 @@ class FileReader:
             raise e.annotate(file=self.name)
         return meta
 
+    @property
+    def plan_fingerprint(self):
+        """The plan-cache file identity (lazy; a benign compute race
+        between plan workers yields identical values)."""
+        if self._plan_fp is _FP_UNSET:
+            self._plan_fp = self._compute_fingerprint()
+        return self._plan_fp
+
+    def _compute_fingerprint(self):
+        """CRC32 of the footer thrift blob + file size + footer length,
+        as a hashable triple.  Lazy first access can come from a plan
+        worker while siblings run chunk reads, so the fd path holds the
+        SAME handle lock the chunk reads serialize on (an unlocked seek
+        here would move the fd position under a concurrent locked
+        seek+read pair)."""
+        import os as _os
+        import struct as _struct
+        import zlib
+
+        if self.salvaged:
+            return None
+        try:
+            if self._buf is not None:
+                size = len(self._buf)
+                if size < 12:
+                    return None
+                tail = bytes(self._buf[size - 8 : size - 4])
+                (flen,) = _struct.unpack("<I", tail)
+                if flen <= 0 or size - 8 - flen < 4:
+                    return None
+                crc = zlib.crc32(self._buf[size - 8 - flen : size - 8])
+            else:
+                with self._count_lock:
+                    h = self._io
+                    h.inflight += 1
+                try:
+                    with h.lock:
+                        f = h.f
+                        pos = f.tell()
+                        try:
+                            size = f.seek(0, _os.SEEK_END)
+                            if size < 12:
+                                return None
+                            f.seek(size - 8)
+                            tail = f.read(4)
+                            (flen,) = _struct.unpack("<I", tail)
+                            if flen <= 0 or size - 8 - flen < 4:
+                                return None
+                            f.seek(size - 8 - flen)
+                            crc = zlib.crc32(f.read(flen))
+                        finally:
+                            f.seek(pos)
+                finally:
+                    with self._count_lock:
+                        h.inflight -= 1
+        except (OSError, ValueError, _struct.error):
+            return None
+        return (crc, size, flen)
+
+    def cached_plan_fingerprint(self):
+        """The fingerprint IF already computed, else None — for cleanup
+        paths (quarantine invalidation) that must never trigger fresh
+        footer I/O on a possibly-wedged handle."""
+        return None if self._plan_fp is _FP_UNSET else self._plan_fp
+
     def _mark_salvaged(self, meta: FileMetaData, report: dict) -> None:
         from ..stats import current_stats
 
@@ -342,6 +419,18 @@ class FileReader:
                                        _rebase(cm, start), node,
                                        verify_crc=self._verify_crc)
         except ScanError as e:
+            if isinstance(e, (CorruptPageError, CorruptChunkError)):
+                # the file's bytes no longer match the footer's claims:
+                # cached plans under this fingerprint are unsafe to
+                # trust.  Transient/deadline errors do NOT invalidate —
+                # the bytes are fine, the link was slow (matching the
+                # device path's policy in kernels/device.py).
+                from ..kernels.plancache import invalidate_fingerprint
+
+                # only the ALREADY-COMPUTED fingerprint can have
+                # cache entries under it; never compute one here
+                if self._plan_fp is not _FP_UNSET:
+                    invalidate_fingerprint(self._plan_fp)
             raise e.annotate(row_group=rg_index, file=self.name)
         if ev is not None:
             import threading
@@ -351,10 +440,11 @@ class FileReader:
                     rg=rg_index, columns=len(out))
         return out
 
-    def iter_selected_chunks(self, rg):
-        """Yield (path, node, cm, chunk_bytes, start_offset) for each
-        selected chunk of a row group — the shared slurp used by both the
-        CPU and device decode paths."""
+    def selected_chunks(self, rg):
+        """``[(path, node, cm)]`` for the selected columns of a row
+        group — metadata only, no I/O.  The device path turns each
+        entry into an independent column plan task."""
+        out = []
         for cc in rg.columns:
             cm = cc.meta_data
             path = ".".join(cm.path_in_schema)
@@ -363,29 +453,45 @@ class FileReader:
                 raise ValueError(f"column {path!r} not in schema")
             if not self.schema.is_selected(node):
                 continue
-            start = cm.data_page_offset
-            if cm.dictionary_page_offset is not None:
-                start = min(start, cm.dictionary_page_offset)
-            if self._buf is not None:
-                # explicit bounds: negative offsets would WRAP on a
-                # memoryview slice (the old seek() raised instead)
-                if (start < 0 or cm.total_compressed_size < 0
-                        or start + cm.total_compressed_size
-                        > len(self._buf)):
-                    raise CorruptChunkError("column chunk overruns file",
-                                            column=path, file=self.name)
-                fault_point("io.reader.chunk_read", column=path)
-                fault_point("io.chunk.hang", file=self.name, column=path)
-                blob = self._buf[start : start + cm.total_compressed_size]
-            else:
-                blob = self._read_chunk_bytes(
-                    start, cm.total_compressed_size, path)
-                if len(blob) < cm.total_compressed_size:
-                    raise CorruptChunkError(
-                        f"column chunk short read: {len(blob)}/"
-                        f"{cm.total_compressed_size} bytes",
-                        column=path, file=self.name)
-            blob = filter_bytes("io.reader.chunk_read", blob, column=path)
+            out.append((path, node, cm))
+        return out
+
+    def chunk_blob(self, cm, path: str):
+        """One selected chunk's bytes: ``(blob, start_offset)``.
+        Zero-copy view for in-memory sources; the full time-domain read
+        policy (retry/hedge/deadline) otherwise.  Thread-safe — the
+        column-parallel planner calls this from pool workers."""
+        start = cm.data_page_offset
+        if cm.dictionary_page_offset is not None:
+            start = min(start, cm.dictionary_page_offset)
+        if self._buf is not None:
+            # explicit bounds: negative offsets would WRAP on a
+            # memoryview slice (the old seek() raised instead)
+            if (start < 0 or cm.total_compressed_size < 0
+                    or start + cm.total_compressed_size
+                    > len(self._buf)):
+                raise CorruptChunkError("column chunk overruns file",
+                                        column=path, file=self.name)
+            fault_point("io.reader.chunk_read", column=path)
+            fault_point("io.chunk.hang", file=self.name, column=path)
+            blob = self._buf[start : start + cm.total_compressed_size]
+        else:
+            blob = self._read_chunk_bytes(
+                start, cm.total_compressed_size, path)
+            if len(blob) < cm.total_compressed_size:
+                raise CorruptChunkError(
+                    f"column chunk short read: {len(blob)}/"
+                    f"{cm.total_compressed_size} bytes",
+                    column=path, file=self.name)
+        blob = filter_bytes("io.reader.chunk_read", blob, column=path)
+        return blob, start
+
+    def iter_selected_chunks(self, rg):
+        """Yield (path, node, cm, chunk_bytes, start_offset) for each
+        selected chunk of a row group — the shared slurp used by both the
+        CPU and device decode paths."""
+        for path, node, cm in self.selected_chunks(rg):
+            blob, start = self.chunk_blob(cm, path)
             yield path, node, cm, blob, start
 
     # -- timed / hedged / deadline-bounded chunk reads ---------------------
@@ -413,8 +519,11 @@ class FileReader:
             # it; _reopen_after_expiry un-poisons the reader then).
             fault_point("io.reader.chunk_read", column=path)
             fault_point("io.chunk.hang", file=self.name, column=path)
-            h = self._io
+            # capture + increment under ONE lock: the closers check
+            # inflight under the same lock before closing, so a handle
+            # can never be closed between capture and first use
             with self._count_lock:
+                h = self._io
                 h.inflight += 1
             try:
                 with h.lock:
@@ -506,7 +615,12 @@ class FileReader:
         with self._mirror_lock:
             for i, h in enumerate(self._mirror_handles):
                 if h is not None and h.owns:  # we opened: re-openable
-                    if h.inflight == 0:
+                    # idle-check + close under _count_lock: readers
+                    # capture + increment inflight under the same lock,
+                    # so an idle verdict cannot race a fresh capture
+                    with self._count_lock:
+                        idle = h.inflight == 0
+                    if idle:
                         h.f.close()
                     self._mirror_handles[i] = None
         if not (self._owns and self.name):
@@ -515,11 +629,17 @@ class FileReader:
             f = open(self.name, "rb")
         except OSError:
             return  # keep the old handle; the retry ladder decides
-        old = self._io
-        self._f = f
-        self._io = _IoHandle(f, True, self.name)
-        self._io_lock = self._io.lock
-        if old.inflight == 0:
+        nh = _IoHandle(f, True, self.name)
+        with self._count_lock:
+            # swap + idle-check atomically vs capture/increment: after
+            # the swap no new reader can capture `old`, and any that
+            # did has already incremented inflight
+            old = self._io
+            self._f = f
+            self._io = nh
+            self._io_lock = nh.lock
+            idle = old.inflight == 0
+        if idle:
             old.f.close()
 
     def _mirror_handle(self, mi: int) -> _IoHandle:
@@ -545,12 +665,22 @@ class FileReader:
         return cur
 
     def _mirror_read(self, mi: int, start: int, size: int, path: str):
-        h = self._mirror_handle(mi)
-        fault_point("io.reader.chunk_read", column=path)
-        fault_point("io.chunk.hang", file=h.name, column=path)
-        with self._count_lock:
-            h.inflight += 1
+        # capture + increment with the handle re-validated under
+        # _mirror_lock: the closers drop a handle from the list under
+        # that lock BEFORE closing it, so a handle that is still listed
+        # cannot be mid-close, and once inflight > 0 it stays open
+        while True:
+            h = self._mirror_handle(mi)
+            with self._mirror_lock:
+                if self._mirror_handles[mi] is h:
+                    with self._count_lock:
+                        h.inflight += 1
+                    break
         try:
+            # fault points inside the guarded region: an injected raise
+            # must still decrement inflight or the handle leaks forever
+            fault_point("io.reader.chunk_read", column=path)
+            fault_point("io.chunk.hang", file=h.name, column=path)
             with h.lock:
                 h.f.seek(start)
                 return h.f.read(size)
@@ -631,13 +761,26 @@ class FileReader:
         # read()) is leaked to that worker — a buffered close() would
         # block on the internal lock the hung reader holds, turning
         # cleanup into exactly the unbounded stall this round removes
-        for i, h in enumerate(self._mirror_handles):
-            if h is not None and h.owns and h.inflight == 0:
-                h.f.close()
-            self._mirror_handles[i] = None
+        # drop the slots under _mirror_lock FIRST, close after: the
+        # _mirror_read capture loop re-validates against the list under
+        # that lock, so a handle it can still validate is never
+        # mid-close (a hedge branch racing close() instead sees the
+        # emptied slot and, per the r09 policy, is leaked its handle)
+        with self._mirror_lock:
+            dropped = list(self._mirror_handles)
+            for i in range(len(self._mirror_handles)):
+                self._mirror_handles[i] = None
+        for h in dropped:
+            if h is not None and h.owns:
+                with self._count_lock:
+                    idle = h.inflight == 0
+                if idle:
+                    h.f.close()
         if self._owns:
-            h = self._io
-            if h.inflight == 0:
+            with self._count_lock:
+                h = self._io
+                idle = h.inflight == 0
+            if idle:
                 h.f.close()
 
     def __enter__(self):

@@ -19,7 +19,8 @@ import threading
 
 import numpy as np
 
-__all__ = ["HostArena", "thread_arena", "discard_thread_arena"]
+__all__ = ["HostArena", "ArenaPool", "lease_arena", "return_arena",
+           "trim_arena_pool", "thread_arena", "discard_thread_arena"]
 
 
 class HostArena:
@@ -61,6 +62,74 @@ class HostArena:
             free.sort(key=lambda s: s.size)
             free = free[-self.max_slabs:]
         self._free = free
+
+
+class ArenaPool:
+    """Process-wide pool of :class:`HostArena` leases for the
+    column-parallel plan tasks.
+
+    Each plan task leases a WHOLE arena for its duration, so racing
+    planners never share a slab (the old per-unit arena would be
+    written by several column planners at once).  Leases are returned
+    only after the unit's transfers have drained
+    (``_finish_row_group``'s batched ``block_until_ready``), which is
+    the same lifetime contract ``HostArena.release_all`` documents —
+    the pool just moves the recycling boundary from thread-local to
+    task-scoped.  Error paths simply DROP their lease references
+    (never ``give_back``): the slabs may still back in-flight
+    transfers, and numpy frees them once JAX's references drop."""
+
+    __slots__ = ("_lock", "_free", "max_arenas")
+
+    def __init__(self, max_arenas: int = 8):
+        self._lock = threading.Lock()
+        self._free: list[HostArena] = []
+        # retention cap on FREE arenas only (in-flight leases are
+        # unbounded — they are the scan's working set); a wide-core
+        # scan's give_backs beyond the cap free their slabs instead of
+        # pinning high-watermark memory for the process lifetime
+        self.max_arenas = max_arenas
+
+    def lease(self) -> HostArena:
+        with self._lock:
+            if self._free:
+                return self._free.pop()
+        return HostArena()
+
+    def give_back(self, arena: HostArena) -> None:
+        """Recycle an arena (caller guarantees every transfer sourced
+        from its slabs has completed)."""
+        arena.release_all()
+        with self._lock:
+            if len(self._free) < self.max_arenas:
+                self._free.append(arena)
+
+    def trim(self, keep: int = 0) -> None:
+        """Drop free arenas beyond ``keep`` (scan-end hook: long-lived
+        processes should not carry a finished scan's slab high-water
+        mark)."""
+        with self._lock:
+            del self._free[keep:]
+
+
+_POOL = ArenaPool()
+
+
+def lease_arena() -> HostArena:
+    """Lease a per-task arena from the shared pool."""
+    return _POOL.lease()
+
+
+def return_arena(arena: HostArena) -> None:
+    """Return a leased arena to the shared pool for recycling."""
+    _POOL.give_back(arena)
+
+
+def trim_arena_pool(keep: int = 0) -> None:
+    """Release the shared pool's retained free arenas (see
+    :meth:`ArenaPool.trim`); called by the pipelined reader when a
+    scan ends, and available to long-lived hosts."""
+    _POOL.trim(keep)
 
 
 _local = threading.local()

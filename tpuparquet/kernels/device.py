@@ -42,7 +42,8 @@ from ..errors import CorruptChunkError, CorruptPageError, \
     DeviceDispatchError, ScanError
 from ..faults import backoff_delays, fault_point, filter_bytes
 from ..native import plane_native
-from .arena import HostArena, discard_thread_arena, thread_arena
+from .arena import HostArena, discard_thread_arena, lease_arena, \
+    return_arena, thread_arena, trim_arena_pool
 from ..cpu.plain import ByteArrayColumn
 from ..format.compact import CompactReader
 from ..format.metadata import (
@@ -172,11 +173,18 @@ def _padded_u32_bytes(n_words: int) -> int:
     return total * 4
 
 
-def _plan_delta_lane_words(seg, count: int, ptype: Type):
+def _plan_delta_lane_words(seg, count: int, ptype: Type, params=None):
     """Plan the delta-lane transport for one PLAIN int32/int64 values
     segment: re-encode values as (first, per-page min_delta, packed
     delta offsets) on the host and rebuild them with the EXISTING
     delta expand kernels on device.
+
+    ``params`` is the plan cache's remembered ``(min_delta, width)``
+    for this page: the O(window) entropy rejection and the full
+    min/max pass are skipped, and a single max-reduce re-validates the
+    cached width against the actual deltas (a stale hint falls back to
+    the full computation rather than corrupting — hints stay
+    performance-only).
 
     Sorted/clustered columns (timestamps, counters, row ids) pack their
     deltas into a few bits per value where even the byte planes ship
@@ -214,19 +222,37 @@ def _plan_delta_lane_words(seg, count: int, ptype: Type):
             else int(np.uint32(hi - lo))
         return lo, span.bit_length()
 
-    # O(window) entropy rejection before any full pass (the adjacent
-    # plane planner samples for the same reason): the sample's delta
-    # span lower-bounds the full span, so a window that already needs
-    # full width proves the page rejects
-    win = 16384
-    if count > win:
-        _, w_s = _width((v[1 : win + 1] - v[:win]).view(
-            np.int64 if lanes == 2 else np.int32))
-        if w_s >= 32 * lanes:
-            return None
+    if params is None:
+        # O(window) entropy rejection before any full pass (the adjacent
+        # plane planner samples for the same reason): the sample's delta
+        # span lower-bounds the full span, so a window that already needs
+        # full width proves the page rejects
+        win = 16384
+        if count > win:
+            _, w_s = _width((v[1 : win + 1] - v[:win]).view(
+                np.int64 if lanes == 2 else np.int32))
+            if w_s >= 32 * lanes:
+                return None
     # wrap-consistent deltas: the device rebuild adds mod 2^(32*lanes)
     d = (v[1:] - v[:-1]).view(np.int64 if lanes == 2 else np.int32)
-    md, w = _width(d)
+    if params is not None:
+        # cached (min_delta, width): re-validate with ONE reduce over
+        # the offsets instead of the two-pass min/max — a stale hint
+        # (changed bytes under an unchanged footer) recomputes honestly
+        md, w = params
+        mask = (1 << (32 * lanes)) - 1
+        off_c = ((d.astype(np.int64) - md).astype(np.uint64)
+                 & np.uint64(mask)) if lanes == 1 \
+            else (d - np.int64(md)).view(np.uint64)
+        fits = (w < 32 * lanes
+                and (off_c.size == 0
+                     or int(off_c.max()).bit_length() <= w))
+        if not fits:
+            md, w = _width(d)
+            off_c = None
+    else:
+        md, w = _width(d)
+        off_c = None
     if w >= 32 * lanes:
         return None
     # Advertise the POST-SPLIT staged cost, not the packed byte count:
@@ -252,9 +278,12 @@ def _plan_delta_lane_words(seg, count: int, ptype: Type):
         from .decode import DeltaPlan
 
         mask = (1 << (32 * lanes)) - 1
-        off = ((d.astype(np.int64) - md).astype(np.uint64)
-               & np.uint64(mask)) if lanes == 1 \
-            else (d - md).view(np.uint64)
+        if off_c is not None:  # hint path already built the offsets
+            off = off_c
+        else:
+            off = ((d.astype(np.int64) - md).astype(np.uint64)
+                   & np.uint64(mask)) if lanes == 1 \
+                else (d - md).view(np.uint64)
         n_pad = n_pad32
         if n_pad != n_deltas:
             off = np.concatenate(
@@ -280,7 +309,7 @@ def _plan_delta_lane_words(seg, count: int, ptype: Type):
 
         return get_words
 
-    return wire, commit
+    return wire, commit, (md, w)
 
 
 def _DEVICE_PLANES() -> bool:
@@ -382,7 +411,7 @@ def _lane_contig(plane: np.ndarray) -> np.ndarray:
 
 
 def _plan_plane_words(seg, count: int, lanes: int, stager: "_Stager",
-                      budget: int | None = None):
+                      budget: int | None = None, lane_plans=None):
     """Plan the lane/byte-plane RLE transport for one PLAIN fixed-width
     values segment (``count`` values of ``lanes`` u32 words each).
 
@@ -404,10 +433,15 @@ def _plan_plane_words(seg, count: int, lanes: int, stager: "_Stager",
 
     ``budget``, when given, is a competing transport's exact wire cost
     (snappy tokens): the planes engage only if they beat it.
+    ``lane_plans`` is the plan cache's remembered per-lane verdict list
+    for this page: the sample windows and the estimate pre-gate are
+    skipped and the tables build directly — the actual-cost gate below
+    still re-checks what the BUILT tables weigh, so a stale hint ships
+    raw rather than a losing transport.
 
-    Returns ``(wire, words_closure)`` — the wire cost recomputed from
-    the BUILT tables (what the gate actually accepted; the event log
-    reports it) — or None when the page rejects."""
+    Returns ``(wire, words_closure, lane_plans)`` — the wire cost
+    recomputed from the BUILT tables (what the gate actually accepted;
+    the event log reports it) — or None when the page rejects."""
     from .decode import bucket
 
     if count < 1024:
@@ -418,36 +452,40 @@ def _plan_plane_words(seg, count: int, lanes: int, stager: "_Stager",
     if buf.size < nbytes:
         raise ValueError("PLAIN values segment shorter than value count")
     words_v = buf[:nbytes].view("<u4")  # value-interleaved lanes
-    win_n = min(count, 1 << 14)
-    mid = (count - win_n) // 2
-
-    plans = []  # per lane: ("raw32",) | ("rle32", est) | ("bytes", keep)
-    wire = 0
-    for lane in range(lanes):
-        lw = np.ascontiguousarray(
-            words_v[mid * lanes + lane : (mid + win_n) * lanes : lanes])
-        r32 = float((lw[1:] != lw[:-1]).mean()) if win_n > 1 else 1.0
-        est32 = 8 * bucket(int(r32 * count) + 1)
-        if est32 < 4 * count:  # beats the 4-bytes-per-value raw lane
-            plans.append(("rle32", est32))
-            wire += est32
-            continue
-        wb = lw.view(np.uint8).reshape(win_n, 4)
-        r8 = (wb[1:] != wb[:-1]).mean(axis=0)
-        cost8 = np.minimum(5 * np.array(
-            [bucket(int(r * count) + 1) for r in r8]), count)
-        if cost8.sum() < 0.75 * 4 * count:
-            plans.append(("bytes", cost8))
-            wire += int(cost8.sum())
-        else:
-            plans.append(("raw32",))
-            wire += 4 * count
-    # engage only on a solid win: the plan thread pays real host time
-    # per engaged lane, so marginal pages keep the raw path
     wire_cap = (0.75 * nbytes if budget is None
                 else min(0.75 * nbytes, budget))
-    if wire > wire_cap or nbytes - wire < 4096:
-        return None
+    if lane_plans is not None and len(lane_plans) == lanes:
+        plans = lane_plans
+    else:
+        win_n = min(count, 1 << 14)
+        mid = (count - win_n) // 2
+
+        plans = []  # per lane: ("raw32",) | ("rle32", est) | ("bytes", keep)
+        wire = 0
+        for lane in range(lanes):
+            lw = np.ascontiguousarray(
+                words_v[mid * lanes + lane
+                        : (mid + win_n) * lanes : lanes])
+            r32 = float((lw[1:] != lw[:-1]).mean()) if win_n > 1 else 1.0
+            est32 = 8 * bucket(int(r32 * count) + 1)
+            if est32 < 4 * count:  # beats the 4-bytes-per-value raw lane
+                plans.append(("rle32", est32))
+                wire += est32
+                continue
+            wb = lw.view(np.uint8).reshape(win_n, 4)
+            r8 = (wb[1:] != wb[:-1]).mean(axis=0)
+            cost8 = np.minimum(5 * np.array(
+                [bucket(int(r * count) + 1) for r in r8]), count)
+            if cost8.sum() < 0.75 * 4 * count:
+                plans.append(("bytes", cost8))
+                wire += int(cost8.sum())
+            else:
+                plans.append(("raw32",))
+                wire += 4 * count
+        # engage only on a solid win: the plan thread pays real host
+        # time per engaged lane, so marginal pages keep the raw path
+        if wire > wire_cap or nbytes - wire < 4096:
+            return None
 
     raw32_parts, raw8_parts = [], []
     e32_parts, v32_parts, e8_parts, v8_parts = [], [], [], []
@@ -539,7 +577,7 @@ def _plan_plane_words(seg, count: int, lanes: int, stager: "_Stager",
             staged[_hs[3]], staged[_hs[4]], staged[_hs[5]],
             _spec, _count, _lanes)
 
-    return actual, words
+    return actual, words, plans
 
 
 def _stage_delta_plan(plan, stager: "_Stager", need_hi: bool):
@@ -1020,55 +1058,71 @@ class _Stager:
         return [self.add(a, pad=pad) for a in arrs]
 
     def put(self):
-        if not self.arrays:
-            return []
-        pieces, spec = [], []
-        for i, a in enumerate(self.arrays):
-            ps = [a] if i in self.no_pad else _split_rows(a)
-            spec.append((len(pieces), len(ps)))
-            pieces.extend(ps)
-        from ..stats import current_stats
+        return _put_all([self])[0]
 
-        _cs = current_stats()
-        _whist = None
-        if _cs is not None:
-            # counted at transfer time, post-split/padding: the pieces
-            # ARE the wire
-            _cs.bytes_staged += sum(p.nbytes for p in pieces)
-            # per-wave transfer wall (put -> the block that fences it):
-            # the tunnel-health observable — a congested link shows as
-            # the wave histogram's tail exploding while bytes_staged
-            # stays flat
-            _whist = _cs.hist("stager_wave_us")
-        dev = [None] * len(pieces)
-        prev = None
-        t_wave = 0.0
-        i = 0
-        while i < len(pieces):
-            wave, wave_bytes = [], 0
-            while i < len(pieces) and (
-                not wave or wave_bytes + pieces[i].nbytes <= _WAVE_BYTES
-            ):
-                wave.append(i)
-                wave_bytes += pieces[i].nbytes
-                i += 1
-            if prev is not None:
-                jax.block_until_ready(prev)
-                if _whist is not None:
-                    _whist.record((time.perf_counter() - t_wave) * 1e6)
+
+def _put_all(stagers):
+    """One batched wave transfer across SEVERAL stagers (the per-column
+    stagers of one unit); returns each stager's staged list.
+
+    Pieces ship in column order, so the wave composition is identical
+    to the pre-column-parallel single-stager path (and independent of
+    how many plan threads built the stagers) — the parity pin's
+    staged-bytes guarantee."""
+    specs = []
+    pieces = []
+    for stg in stagers:
+        sp = []
+        for i, a in enumerate(stg.arrays):
+            ps = [a] if i in stg.no_pad else _split_rows(a)
+            sp.append((len(pieces), len(ps)))
+            pieces.extend(ps)
+        specs.append(sp)
+    if not pieces:
+        return [[] for _ in stagers]
+    from ..stats import current_stats
+
+    _cs = current_stats()
+    _whist = None
+    if _cs is not None:
+        # counted at transfer time, post-split/padding: the pieces
+        # ARE the wire
+        _cs.bytes_staged += sum(p.nbytes for p in pieces)
+        # per-wave transfer wall (put -> the block that fences it):
+        # the tunnel-health observable — a congested link shows as
+        # the wave histogram's tail exploding while bytes_staged
+        # stays flat
+        _whist = _cs.hist("stager_wave_us")
+    dev = [None] * len(pieces)
+    prev = None
+    t_wave = 0.0
+    i = 0
+    while i < len(pieces):
+        wave, wave_bytes = [], 0
+        while i < len(pieces) and (
+            not wave or wave_bytes + pieces[i].nbytes <= _WAVE_BYTES
+        ):
+            wave.append(i)
+            wave_bytes += pieces[i].nbytes
+            i += 1
+        if prev is not None:
+            jax.block_until_ready(prev)
             if _whist is not None:
-                t_wave = time.perf_counter()
-            out = jax.device_put([pieces[j] for j in wave])
-            for j, d in zip(wave, out):
-                dev[j] = d
-            prev = out
-        jax.block_until_ready(prev)
-        if _whist is not None and prev is not None:
-            _whist.record((time.perf_counter() - t_wave) * 1e6)
-        return [
-            dev[s] if n == 1 else jnp.concatenate(dev[s : s + n])
-            for s, n in spec
-        ]
+                _whist.record((time.perf_counter() - t_wave) * 1e6)
+        if _whist is not None:
+            t_wave = time.perf_counter()
+        out = jax.device_put([pieces[j] for j in wave])
+        for j, d in zip(wave, out):
+            dev[j] = d
+        prev = out
+    jax.block_until_ready(prev)
+    if _whist is not None and prev is not None:
+        _whist.record((time.perf_counter() - t_wave) * 1e6)
+    return [
+        [dev[s] if n == 1 else jnp.concatenate(dev[s : s + n])
+         for s, n in sp]
+        for sp in specs
+    ]
 
 
 def decode_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
@@ -1094,7 +1148,8 @@ def decode_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
 def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                       base: int, stager: _Stager,
                       arena: HostArena | None = None,
-                      verify_crc: bool | None = None):
+                      verify_crc: bool | None = None,
+                      cache_key=None, cache_state=None):
     """Phase 1 (host): page-header walk, block decompression, run-table
     scans, staging-plan registration.  Returns ``finish(staged)`` which
     issues the fused device dispatches and assembles the DeviceColumn.
@@ -1103,6 +1158,15 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
     minus ``base``.  ``verify_crc`` gates page CRC32 verification when
     headers carry one (None = env default) — same semantics as the CPU
     path in ``io/chunk.py``.
+
+    ``cache_key`` is this chunk's plan-cache identity
+    (``(footer fingerprint, rg, column)``, see ``kernels/plancache.py``):
+    on a hit the per-page transport competition is skipped and only the
+    remembered winner's planner runs; on a miss the verdicts are stored.
+    Hints are ROUTING-ONLY — they choose which lossless transport plans,
+    never what the decoded bytes are, so a stale hint degrades wire
+    choice at worst.  ``cache_state`` (a list, out-param) receives
+    "hit" / "miss" / "off" for span annotation.
     """
     from ..io.pages import crc_verify_default, verify_page_crc
     from ..stats import current_stats
@@ -1121,6 +1185,20 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
     _ev = None if _st is None else _st.events
     _col_path = ".".join(cm.path_in_schema)
     _degraded = _host_values_only()
+    # footer-keyed plan cache: hints index by DATA-page ordinal
+    _pc = _hints = _record = None
+    if cache_key is not None and not _degraded:
+        from .plancache import plan_cache
+
+        _pc = plan_cache()
+        if _pc is not None:
+            _hints = _pc.lookup(cache_key)
+            if _hints is None:
+                _record = []
+    if cache_state is not None:
+        cache_state.append(
+            "off" if _pc is None
+            else ("hit" if _hints is not None else "miss"))
     _page_i = 0
     _walk_i = 0  # all-page ordinal (dict pages included): error coords
     if _st is not None:
@@ -1369,6 +1447,16 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
             non_null = int((dl_host == max_def).sum())
         values_read += n
 
+        # plan-cache hint for THIS data page (routing-only: which
+        # transport planner to run; None entry = page had no cacheable
+        # decision).  _rec_entry collects the miss-path verdict; every
+        # data page appends exactly one entry so hint indices stay
+        # aligned with the data-page ordinal across re-reads.
+        _hint = (_hints[_page_i]
+                 if _hints is not None and _page_i < len(_hints)
+                 else None)
+        _rec_entry = None
+
         # Resolve deferred value-segment decompression.  The device
         # transports COMPETE on wire cost: snappy tokens (no host
         # decompress) vs byte planes vs delta lanes (both need the
@@ -1383,30 +1471,49 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
         # in the crossover region for ~30% of the plan phase.
         plan_words = None
         payload_bound = None
+        # cached verdict for a PLAIN fixed-width page: run ONLY the
+        # remembered winner's planner (or none, for a raw page) — the
+        # losers' sample windows and above all the token SCAN are what a
+        # warm re-read skips
+        _use_hint = (isinstance(_hint, tuple) and len(_hint) >= 2
+                     and _hint[0] == "plain")
+        _hchoice = _hint[1] if _use_hint else None
+        _hparams = (_hint[2] if _use_hint and len(_hint) > 2 else None)
         if values_comp is not None:
             payload_bound = len(values_comp[0])
             competitors = ((_DEVICE_PLANES()
                             or (_DEVICE_DELTA_LANES()
                                 and ptype in (Type.INT32, Type.INT64)))
                            and non_null >= 1024)
+            if _use_hint:
+                competitors = _hchoice in ("planes", "delta")
             if values_seg is None and competitors:
                 values_seg = decompress_block_into(
                     codec, values_comp[0], values_comp[1], arena)
         delta_cand = None
-        if (_DEVICE_DELTA_LANES() and enc == Encoding.PLAIN
+        if ((not _use_hint or _hchoice == "delta")
+                and _DEVICE_DELTA_LANES() and enc == Encoding.PLAIN
                 and ptype in (Type.INT32, Type.INT64)
                 and values_seg is not None):
-            delta_cand = _plan_delta_lane_words(values_seg, non_null,
-                                                ptype)
+            delta_cand = _plan_delta_lane_words(
+                values_seg, non_null, ptype,
+                params=(_hparams if _use_hint and _hchoice == "delta"
+                        else None))
         delta_wire = delta_cand[0] if delta_cand is not None else None
 
+        planes_spec = None
+
         def _try_planes(budget):
-            if (_DEVICE_PLANES() and non_null
+            if ((not _use_hint or _hchoice == "planes")
+                    and _DEVICE_PLANES() and non_null
                     and enc == Encoding.PLAIN and ptype in _LANES
                     and values_seg is not None):
                 return _plan_plane_words(
                     values_seg, non_null, _LANES[ptype], stager,
-                    budget=budget)
+                    budget=budget,
+                    lane_plans=(_hparams
+                                if _use_hint and _hchoice == "planes"
+                                else None))
             return None
 
         budgets = [c for c in (delta_wire, payload_bound)
@@ -1414,27 +1521,30 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
         planes_wire = None
         _pl = _try_planes(min(budgets) if budgets else None)
         if _pl is not None:
-            planes_wire, plan_words = _pl
+            planes_wire, plan_words, planes_spec = _pl
         chosen = "planes" if plan_words is not None else None
         tok = None
         tok_scanned = False
         if plan_words is None:
-            if payload_bound is not None and not (
-                    delta_wire is not None
-                    and delta_wire < payload_bound):
+            run_tok = payload_bound is not None and not (
+                delta_wire is not None and delta_wire < payload_bound)
+            if _use_hint:
+                run_tok = (_hchoice == "snappy"
+                           and payload_bound is not None)
+            if run_tok:
                 # no competitor beats the token bound: pay the scan
                 tok_scanned = True
                 tok = _plan_device_snappy_words(
                     values_comp[0], values_comp[1],
                     non_null * _LANES[ptype], offset=values_comp[2],
                 )
-                if tok is None:
+                if tok is None and not _use_hint:
                     # token transport unreachable after all: re-contest
                     # the planes without its payload bound (they may
                     # have been pruned ONLY by it)
                     _pl = _try_planes(delta_wire)
                     if _pl is not None:
-                        planes_wire, plan_words = _pl
+                        planes_wire, plan_words, planes_spec = _pl
                     chosen = "planes" if plan_words is not None else None
             if plan_words is None:
                 if delta_cand is not None and (
@@ -1445,10 +1555,17 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                     plan_words = tok[1](stager)
                     chosen = "snappy"
                 elif values_seg is None and values_comp is not None:
-                    # no device transport reachable: the PLAIN fallback
-                    # below needs the decompressed bytes after all
+                    # no device transport reachable (or a cached "raw"
+                    # verdict skipped the competition): the PLAIN
+                    # fallback below needs the decompressed bytes
                     values_seg = decompress_block_into(
                         codec, values_comp[0], values_comp[1], arena)
+        if _record is not None and enc == Encoding.PLAIN \
+                and ptype in _LANES:
+            _params = (planes_spec if chosen == "planes"
+                       else delta_cand[2] if chosen == "delta"
+                       else None)
+            _rec_entry = ("plain", chosen, _params)
         chosen_wire = (planes_wire if chosen == "planes"
                        else delta_wire if chosen == "delta"
                        else tok[0] if chosen == "snappy" else None)
@@ -1505,6 +1622,8 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                                f"{_raw_ev}B")
                 else:
                     _reason = "no transport beat raw staging"
+                if _use_hint:
+                    _reason += " (plan-cache hit)"
 
         # Def-level plan, padded for the fused page kernels.  A page
         # whose value path can't fuse expands it standalone via
@@ -1726,12 +1845,19 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
 
                 blob_plan = None
                 budget = None
-                if bytes_comp is not None:
+                # cached "raw" verdict skips the token scan outright; a
+                # cached "tokens" verdict (or no hint) pays it — the
+                # tables it builds ARE the staged content
+                _ba_skip = (isinstance(_hint, tuple) and len(_hint) == 2
+                            and _hint[0] == "ba" and _hint[1] is False)
+                if bytes_comp is not None and not _ba_skip:
                     budget = (0.9 * int(col.data.size)
                               - 4 * _bucket(non_null + 1))
                     if budget > 0:
                         blob_plan = _plan_device_snappy_blob(
                             bytes_comp[0], bytes_comp[1], budget, stager)
+                if _record is not None:
+                    _rec_entry = ("ba", blob_plan is not None)
                 _raw_ev = int(col.data.size)
                 if _ev is not None:
                     _gate = {"raw": _raw_ev,
@@ -2082,7 +2208,14 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 gate=_gate, reason=_reason,
                 plan_s=time.perf_counter() - _t_pg,
             )
+        if _record is not None:
+            _record.append(_rec_entry)
         _page_i += 1
+
+    if _record is not None and _pc is not None:
+        from .plancache import plan_cache_budget
+
+        _pc.store(cache_key, _record, plan_cache_budget())
 
     type_length = node.element.type_length
 
@@ -2182,31 +2315,70 @@ def read_row_group_device(reader, rg_index: int) -> dict[str, DeviceColumn]:
     """Decode the selected columns of one row group onto the device.
 
     The device-path sibling of ``FileReader.read_row_group_arrays``: same
-    selection semantics, device-resident results.  All chunks' plan
-    tables and page words ship in batched wave transfers (``_Stager``),
-    then the fused page kernels dispatch and are drained before
-    returning (async pile-up degrades the remote tunnel — see the
-    comment below).  For multi-row-group reads prefer
-    :func:`read_row_groups_device`, which overlaps row group N+1's host
-    planning with N's transfer on multi-core hosts."""
+    selection semantics, device-resident results.  Each column chunk
+    plans as an independent task — on multi-core hosts a SINGLE large
+    row group (the common TPU-input shape) fans its columns across the
+    plan pool — then all columns' plan tables and page words ship in one
+    batched wave transfer (``_put_all``) and the fused page kernels
+    dispatch and are drained before returning (async pile-up degrades
+    the remote tunnel — see the comment in ``_finish_row_group``).  For
+    multi-row-group reads prefer :func:`read_row_groups_device`, which
+    additionally overlaps row group N+1's host planning with N's
+    transfer."""
     from ..stats import current_stats
 
     _cs = current_stats()
     if _cs is not None:
         _cs.row_groups += 1
     rg = reader.meta.row_groups[rg_index]
-    arena = thread_arena()
+    arenas = []
     try:
-        st = _Stager()
-        planned = _plan_row_group(reader, rg, st, arena)
-        out = _finish_row_group(planned, st)
+        cols = reader.selected_chunks(rg)
+        n_workers = min(_plan_threads(), max(len(cols), 1))
+        if n_workers <= 1:
+            # serial path: plan on the calling thread under the caller's
+            # collector — byte-identical plans, no pool overhead.  One
+            # arena serves every column (no racing planners here), so
+            # decompression slabs recycle across columns like the
+            # pre-column-parallel planner's did.
+            a = lease_arena()
+            arenas.append(a)
+            planned = []
+            for path, node, cm in cols:
+                planned.append(
+                    _plan_one_column(reader, rg_index, path, node, cm, a))
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            degraded = _host_values_only()
+            with ThreadPoolExecutor(max_workers=n_workers) as ex:
+                futs = []
+                for path, node, cm in cols:
+                    a = lease_arena()
+                    arenas.append(a)
+                    futs.append(ex.submit(
+                        _plan_column_task, reader, rg_index, path, node,
+                        cm, a, _cs, degraded))
+                planned = []
+                err = None
+                for f in futs:
+                    try:
+                        entry, ws = f.result()
+                    except BaseException as e:
+                        err = err if err is not None else e
+                        continue
+                    if _cs is not None:
+                        _cs.merge_from(ws)
+                    planned.append(entry)
+                if err is not None:
+                    raise err
+        out = _finish_row_group(planned)
     except ScanError as e:
-        discard_thread_arena()
+        # arenas are dropped, not recycled: in-flight transfers (or
+        # abandoned plan tasks) may still read their slabs
         raise e.annotate(row_group=rg_index)
-    except BaseException:
-        discard_thread_arena()  # in-flight transfers may read the slabs
-        raise
-    arena.release_all()
+    for a in arenas:
+        return_arena(a)
     return out
 
 
@@ -2317,8 +2489,88 @@ def read_row_group_device_resilient(reader, rg_index: int,
     return attempt_once(degraded=True)
 
 
+def _plan_one_column(reader, rg_index: int, path, node, cm,
+                     arena: HostArena, degraded: bool = False):
+    """Plan ONE column chunk into its own stager — the unit of work the
+    column-parallel planner schedules.  Returns ``(path, finish,
+    stager)``; plan wall and the plan span (with its plan-cache verdict)
+    are recorded on the calling thread's collector.
+
+    ``degraded`` re-enters :func:`cpu_fallback_values` — the flag is
+    thread-local, so a pool worker must restore the submitting thread's
+    degradation state itself."""
+    from ..stats import current_stats
+
+    from .plancache import plan_cache
+
+    deg_ctx = (cpu_fallback_values() if degraded
+               else contextlib.nullcontext())
+    t0 = time.perf_counter()
+    stager = _Stager()
+    # fingerprint only when the cache is on: computing it lazily costs
+    # a footer re-read on file-backed sources, which cache-off scans
+    # must never pay
+    fingerprint = (getattr(reader, "plan_fingerprint", None)
+                   if plan_cache() is not None else None)
+    cache_key = (None if fingerprint is None
+                 else (fingerprint, rg_index, path))
+    cache_state = []
+    try:
+        with deg_ctx:
+            blob, start = reader.chunk_blob(cm, path)
+            finish = plan_chunk_device(
+                memoryview(blob), cm, node, start, stager, arena,
+                verify_crc=getattr(reader, "_verify_crc", None),
+                cache_key=cache_key, cache_state=cache_state)
+    except ScanError as e:
+        if isinstance(e, (CorruptPageError, CorruptChunkError)):
+            # the bytes no longer match the footer: cached plans for
+            # this file identity are stale
+            from .plancache import invalidate_fingerprint
+
+            invalidate_fingerprint(fingerprint)
+        raise e.annotate(column=path, file=getattr(reader, "name", None))
+    except ValueError as e:
+        # codec-layer domain errors become taxonomy errors with
+        # coordinates; raw crash types propagate as the bugs they
+        # are (the crash-corpus clean-failure contract)
+        from .plancache import invalidate_fingerprint
+
+        invalidate_fingerprint(fingerprint)
+        raise CorruptChunkError(
+            str(e), column=path,
+            file=getattr(reader, "name", None)) from e
+    _cs = current_stats()
+    if _cs is not None:
+        t1 = time.perf_counter()
+        _cs.plan_s += t1 - t0
+        if _cs.events is not None:
+            _cs.events.span(
+                "plan", "decode", t0, t1, tid=threading.get_ident(),
+                column=path,
+                cache=(cache_state[0] if cache_state else "off"))
+    return path, finish, stager
+
+
+def _plan_column_task(reader, rg_index: int, path, node, cm,
+                      arena: HostArena, like, degraded: bool):
+    """Pool-worker wrapper around :func:`_plan_one_column`: fresh
+    per-thread collector (``worker_stats(like=)`` — the coordinator
+    merges after joining, the exactness discipline ``stats.py``
+    documents) and the submitting thread's degradation state."""
+    from ..stats import worker_stats
+
+    with worker_stats(like=like) as ws:
+        entry = _plan_one_column(reader, rg_index, path, node, cm,
+                                 arena, degraded=degraded)
+    return entry, ws
+
+
 def _plan_row_group(reader, rg, stager: _Stager, arena: HostArena):
-    """Host phase shared by the per-row-group and pipelined readers."""
+    """Serial compat path (tools/exp_gap.py and friends): plan every
+    selected column of one row group into ONE shared stager on the
+    calling thread.  The production readers plan per-column stagers via
+    :func:`_plan_one_column` instead."""
     from ..stats import current_stats
 
     t0 = time.perf_counter()
@@ -2346,15 +2598,18 @@ def _plan_row_group(reader, rg, stager: _Stager, arena: HostArena):
         t1 = time.perf_counter()
         _cs.plan_s += t1 - t0
         if _cs.events is not None:
-            import threading
-
             _cs.events.span("plan", "decode", t0, t1,
                             tid=threading.get_ident(),
                             columns=len(planned))
     return planned
 
 
-def _finish_row_group(planned, st: _Stager):
+def _finish_row_group(planned):
+    """Stage + dispatch one unit's column plans: ``planned`` is
+    ``[(path, finish, stager)]`` from :func:`_plan_one_column`.  All
+    columns' arrays ship in ONE shared wave sequence (``_put_all``, in
+    column order — wave composition is identical to the old single-
+    stager path and independent of plan-thread count)."""
     from ..stats import current_stats
 
     if not _host_values_only():
@@ -2366,9 +2621,10 @@ def _finish_row_group(planned, st: _Stager):
         fault_point("kernels.device.unit_dispatch")
         fault_point("kernels.device.hang")
     t0 = time.perf_counter()
-    staged = st.put()
+    staged_lists = _put_all([stager for _, _, stager in planned])
     t1 = time.perf_counter()
-    out = {path: finish(staged) for path, finish in planned}
+    out = {path: finish(staged)
+           for (path, finish, _), staged in zip(planned, staged_lists)}
     # Drain the dispatched kernels before returning: on the
     # remote-attached TPU, letting async work pile up degrades every
     # subsequent transfer ~2x (measured 1.16s vs 0.53s over 8 row
@@ -2397,25 +2653,32 @@ def _finish_row_group(planned, st: _Stager):
 
 
 def _plan_threads() -> int:
-    """Plan-phase worker count for the pipelined reader.
+    """Plan-phase worker count (column-parallel planner).
 
-    On a good link the pipeline is PLAN-bound (50M taxi: plan 2.4 s
-    vs ~0.7 s of transfer at tunnel rates), and the plan phase is
+    On a good link the pipeline is PLAN-bound (50M taxi: plan 1.1-2.4 s
+    vs ~9 ms of transfer at PCIe rates), and the plan phase is
     GIL-releasing C/numpy whose file reads are already lock-protected
-    (``FileReader._io_lock``), so planning several row groups
-    concurrently is the direct lever on the e2e wall.  Default: one
-    worker per core up to 4; single-core hosts (and
-    ``TPQ_PLAN_THREADS=1``) keep the exact serial-plan behavior.
-    Stats stay exact at any worker count: each plan runs under a
-    per-thread collector (``stats.worker_stats``) merged on the main
-    thread when its future is consumed."""
+    (``FileReader._io_lock``), so planning many columns concurrently is
+    the direct lever on the e2e wall.  Default: one worker per USABLE
+    core (affinity/cpuset-aware — a 1-core container gets exactly one
+    planner and the exact serial-plan behavior; this is also the
+    oversubscription clamp).  ``TPQ_PLAN_THREADS`` is authoritative
+    when set.  The writer's encode pool (``TPQ_WRITE_THREADS``)
+    defaults to the same core count: a process that scans and writes
+    CONCURRENTLY should split the budget explicitly (e.g.
+    ``TPQ_PLAN_THREADS=N/2 TPQ_WRITE_THREADS=N/2``) — the library
+    never runs both pools for the same operation, so sequential
+    read-then-write workloads need no tuning.  Stats stay exact at any
+    worker count: each column plan runs under a per-thread collector
+    (``stats.worker_stats``) merged on the coordinating thread when its
+    future is consumed."""
     v = os.environ.get("TPQ_PLAN_THREADS")
     if v is not None:
         try:
             return max(int(v), 1)
         except ValueError:
             pass  # malformed override falls back to the default
-    return min(_usable_cpus(), 4)
+    return _usable_cpus()
 
 
 def _usable_cpus() -> int:
@@ -2433,17 +2696,22 @@ def pipelined_reads(readers, units, device_for=None, start: int = 0):
     ``units[start:]`` (each a ``(reader_index, rg_index)`` pair),
     overlapping host planning with device transfer.
 
-    Worker threads run upcoming units' plan phases (file reads, block
-    decompression, run-table scans — all GIL-releasing C/numpy work)
-    while the main thread transfers and dispatches unit N on its
-    assigned device (``device_for(unit_index)``, default device when
-    None; plans are device-independent, so the target only matters at
-    transfer time).  The arena ring matches the in-flight plan count,
-    so the planner never writes into slabs an in-flight transfer still
-    reads.  Results are identical to a serial
-    :func:`read_row_group_device` loop.  The single shared pipeline
-    under ``read_row_groups_device`` and the scan drivers in
-    ``shard/``."""
+    One shared pool of ``_plan_threads()`` workers runs PER-COLUMN plan
+    tasks (file reads, block decompression, run-table scans — all
+    GIL-releasing C/numpy work) while the main thread transfers and
+    dispatches unit N on its assigned device (``device_for(unit_index)``,
+    default device when None; plans are device-independent, so the
+    target only matters at transfer time).  Column granularity means
+    workers steal across units: a single wide row group fans out, and a
+    fast unit's idle workers pull the next unit's columns — not one
+    future per row group.  The submission window is derived from
+    in-flight TASKS (at least ``n_workers + 1`` column tasks and one
+    whole unit ahead), and every task leases its own arena from the
+    shared pool (``kernels/arena.py``) so racing planners never share a
+    slab; leases recycle only after the unit's transfers drain.
+    Results are identical to a serial :func:`read_row_group_device`
+    loop at any thread count.  The single shared pipeline under
+    ``read_row_groups_device`` and the scan drivers in ``shard/``."""
     from concurrent.futures import ThreadPoolExecutor
 
     from ..stats import current_stats
@@ -2453,55 +2721,81 @@ def pipelined_reads(readers, units, device_for=None, start: int = 0):
         return
     _cs = current_stats()
     n_workers = _plan_threads()
-    ahead = n_workers + 1  # in-flight plans (ring size)
-    arenas = [HostArena() for _ in range(ahead)]
-
-    from ..stats import worker_stats
-
-    def plan(k):
-        ri, rgi = units[k]
-        reader = readers[ri]
-        st = _Stager()
-        # per-thread collector, merged on the main thread below: a
-        # shared collector's += from racing planners loses counts, and
-        # values/bytes_* feed headline bench fields.  `like=_cs`
-        # propagates the event-log config (shared t0 clock) so per-page
-        # events and plan spans flow through the pipelined path too.
-        with worker_stats(like=_cs) as ws:
-            planned = _plan_row_group(
-                reader, reader.meta.row_groups[rgi], st,
-                arenas[k % ahead])
-        return planned, st, ws
+    degraded = _host_values_only()  # thread-local: workers re-enter it
 
     ex = ThreadPoolExecutor(max_workers=n_workers)
+    inflight = {}    # unit k -> [future per column, in column order]
+    arenas_of = {}   # unit k -> [leased arenas]
+    state = {"next_j": 0, "tasks": 0}
+
+    def submit_unit():
+        k = order[state["next_j"]]
+        state["next_j"] += 1
+        ri, rgi = units[k]
+        reader = readers[ri]
+        cols = reader.selected_chunks(reader.meta.row_groups[rgi])
+        futs, ars = [], []
+        # single-worker pools run a unit's column tasks sequentially,
+        # so one shared arena per unit keeps the old cross-column slab
+        # reuse; real parallelism needs a lease per racing task
+        shared = lease_arena() if n_workers == 1 and cols else None
+        if shared is not None:
+            ars.append(shared)
+        for path, node, cm in cols:
+            a = shared
+            if a is None:
+                a = lease_arena()
+                ars.append(a)
+            futs.append(ex.submit(_plan_column_task, reader, rgi, path,
+                                  node, cm, a, _cs, degraded))
+        inflight[k] = futs
+        arenas_of[k] = ars
+        state["tasks"] += len(futs)
+
+    def fill_window(min_units: int):
+        while state["next_j"] < len(order) and (
+                len(inflight) < min_units
+                or state["tasks"] < n_workers + 1):
+            submit_unit()
+
     try:
-        futs = {}
-
-        def submit(j):
-            if j < len(order):
-                futs[order[j]] = ex.submit(plan, order[j])
-
-        for j0 in range(ahead):
-            submit(j0)
-        for j, k in enumerate(order):
-            planned, st, ws = futs.pop(k).result()
-            if _cs is not None:
-                _cs.merge_from(ws)
+        fill_window(2)  # current unit + at least one planned ahead
+        for k in order:
+            futs = inflight.pop(k)
+            state["tasks"] -= len(futs)
+            planned = []
+            err = None
+            for f in futs:
+                try:
+                    entry, ws = f.result()
+                except BaseException as e:
+                    err = err if err is not None else e
+                    continue
+                if _cs is not None:
+                    _cs.merge_from(ws)
+                planned.append(entry)
+            if err is not None:
+                raise err
             if device_for is not None:
                 with jax.default_device(device_for(k)):
-                    out = _finish_row_group(planned, st)
+                    out = _finish_row_group(planned)
             else:
-                out = _finish_row_group(planned, st)  # drains; arena free
-            arenas[k % ahead].release_all()
-            submit(j + ahead)
+                out = _finish_row_group(planned)  # drains; arenas free
+            for a in arenas_of.pop(k):
+                return_arena(a)
+            fill_window(1)
             if _cs is not None:
                 _cs.row_groups += 1
             yield k, out
     finally:
-        # On error/early close just drop the arenas (never recycle slabs
-        # that in-flight transfers might still read); the worker is
-        # joined so no new borrows can race the interpreter shutdown.
+        # On error/early close just drop the leased arenas (never
+        # recycle slabs that in-flight transfers might still read); the
+        # workers are joined so no new borrows can race interpreter
+        # shutdown.  Trimming releases the scan's slab high-water mark
+        # back to the allocator (keep=2: the resilient per-unit path
+        # still reuses a couple of warm arenas between scans).
         ex.shutdown(wait=True)
+        trim_arena_pool(keep=2)
 
 
 def read_row_groups_device(reader, rg_indices=None):

@@ -393,6 +393,17 @@ class NativeDelta:
             ctypes.POINTER(ctypes.c_longlong),
             ctypes.POINTER(ctypes.c_longlong),
         ]
+        self._decode = getattr(lib, "tpq_delta_decode", None)
+        if self._decode is not None:
+            self._decode.restype = ctypes.c_longlong
+            self._decode.argtypes = [
+                ctypes.c_void_p, ctypes.c_longlong,
+                ctypes.c_void_p, ctypes.c_longlong,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
+                ctypes.c_longlong, ctypes.c_uint64,
+                ctypes.c_void_p,
+            ]
         self._gather = getattr(lib, "tpq_gather_segments", None)
         if self._gather is not None:
             self._gather.restype = ctypes.c_longlong
@@ -435,6 +446,31 @@ class NativeDelta:
                 ctypes.POINTER(ctypes.c_longlong),
                 ctypes.POINTER(ctypes.c_longlong),
             ]
+
+    def decode_all(self, data, st) -> "np.ndarray | None":
+        """Full DELTA_BINARY_PACKED decode from a scanned
+        :class:`~tpuparquet.cpu.delta.DeltaStructure` — unpack + per-block
+        min_delta + prefix sum in one GIL-releasing C pass.  Returns the
+        (total,) uint64 value array (two's-complement wrap, byte-exact
+        with the numpy decode), or None when the symbol is missing
+        (stale .so)."""
+        if self._decode is None:
+            return None
+        buf = _as_u8(data)
+        md = np.ascontiguousarray(st.md_blocks, dtype=np.int64)
+        w = np.ascontiguousarray(st.mb_w, dtype=np.int32)
+        p = np.ascontiguousarray(st.mb_pos, dtype=np.int64)
+        s = np.ascontiguousarray(st.mb_start, dtype=np.int64)
+        out = np.empty(max(st.total, 1), dtype=np.uint64)[: st.total]
+        rc = self._decode(
+            buf.ctypes.data, buf.size, md.ctypes.data, md.size,
+            w.ctypes.data, p.ctypes.data, s.ctypes.data, w.size,
+            st.mb_size, st.block_size, st.total,
+            ctypes.c_uint64(st.first & 0xFFFFFFFFFFFFFFFF),
+            out.ctypes.data)
+        if rc != 0:
+            raise ValueError(f"delta decode failed (rc={rc})")
+        return out
 
     def dba_assemble(self, prefix_lens, suffix_offs, suffix_data,
                      out_offsets, total: int):

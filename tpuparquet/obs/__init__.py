@@ -32,6 +32,7 @@ from .events import (  # noqa: F401
     counter_counts,
     event_summary,
     fault_counts_by_column,
+    plan_cache_span_counts,
 )
 from .export import (  # noqa: F401
     chrome_trace,
@@ -43,7 +44,8 @@ from .histogram import Histogram, N_BUCKETS  # noqa: F401
 
 __all__ = [
     "EventLog", "PageEvent", "TRANSPORT_COUNTER", "counter_counts",
-    "event_summary", "fault_counts_by_column", "chrome_trace",
+    "event_summary", "fault_counts_by_column",
+    "plan_cache_span_counts", "chrome_trace",
     "column_table", "format_column_table", "write_chrome_trace",
     "Histogram", "N_BUCKETS",
 ]

@@ -27,7 +27,8 @@ import json
 import time
 
 __all__ = ["PageEvent", "EventLog", "TRANSPORT_COUNTER",
-           "counter_counts", "event_summary", "fault_counts_by_column"]
+           "counter_counts", "event_summary", "fault_counts_by_column",
+           "plan_cache_span_counts"]
 
 # transport label -> the DecodeStats counter that transport increments
 # (transports absent here increment none of the per-transport counters:
@@ -222,6 +223,27 @@ def fault_counts_by_column(log: "EventLog | None",
         col = f.get("column") or "-"
         row = out.setdefault(col, {})
         row[k] = row.get(k, 0) + 1
+    return out
+
+
+def plan_cache_span_counts(log: "EventLog | None") -> dict:
+    """Plan-span cache verdicts: ``{"hit": n, "miss": n, "off": n}``
+    over the per-column plan spans (each carries the footer-keyed plan
+    cache's lookup outcome in its ``cache`` arg — ``kernels/device.py``
+    ``_plan_one_column``).  The observability face of the plan cache:
+    ``parquet-tool profile`` prints this next to the hit/miss counters
+    so cache effectiveness is visible per run, and a per-span ``plan_s``
+    comparison between hit and miss spans measures what a warm re-read
+    actually saves."""
+    out: dict[str, int] = {}
+    if log is None:
+        return out
+    for s in log.spans:
+        if s.get("name") != "plan":
+            continue
+        verdict = (s.get("args") or {}).get("cache")
+        if verdict:
+            out[verdict] = out.get(verdict, 0) + 1
     return out
 
 

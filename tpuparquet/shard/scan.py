@@ -420,6 +420,19 @@ def resilient_unit_scan(readers, units, device_for, *, start: int = 0,
                                    error=e)
             if entry_extra:
                 entry.update(entry_extra)
+            # a quarantined unit means this file's bytes can no longer
+            # be trusted against its footer: drop its cached plans so a
+            # later retry (or another scan in this process) re-derives
+            # them from the bytes it actually reads.  Only an
+            # ALREADY-COMPUTED fingerprint can have entries — never
+            # compute one here (fresh footer I/O on the possibly-wedged
+            # handle that just got this unit quarantined)
+            from ..kernels.plancache import invalidate_fingerprint
+
+            cached = getattr(readers[fi], "cached_plan_fingerprint",
+                             None)
+            if cached is not None:
+                invalidate_fingerprint(cached())
             st = current_stats()
             if st is not None:
                 st.units_quarantined += 1

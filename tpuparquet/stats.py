@@ -131,6 +131,14 @@ class DecodeStats:
     # durable cursor checkpoints written (shard.scan.save_cursor_file
     # via the auto-checkpoint path or an explicit cursor_save)
     checkpoints_written: int = 0
+    # -- footer-keyed plan cache (kernels/plancache.py) --
+    # per-(rg, column) lookups during device planning: hits skip the
+    # transport competition (sample windows, token scans), misses run
+    # it and store the verdicts; evictions are LRU drops under the
+    # TPQ_PLAN_CACHE_MB byte budget.  All zero when the cache is off.
+    plan_cache_hits: int = 0
+    plan_cache_misses: int = 0
+    plan_cache_evictions: int = 0
     # where the device-path wall went, accumulated per unit: host plan
     # phase (page walk, decompression, run-table scans — overlapped with
     # transfer by the pipelined reader, so plan_s can exceed the e2e
@@ -165,6 +173,7 @@ class DecodeStats:
         "metadata_rejects",
         "deadline_exceeded", "hedges_issued", "hedges_won",
         "checkpoints_written",
+        "plan_cache_hits", "plan_cache_misses", "plan_cache_evictions",
         "plan_s", "transfer_s", "dispatch_s",
     )
 
@@ -230,6 +239,9 @@ class DecodeStats:
             "hedges_issued": self.hedges_issued,
             "hedges_won": self.hedges_won,
             "checkpoints_written": self.checkpoints_written,
+            "plan_cache_hits": self.plan_cache_hits,
+            "plan_cache_misses": self.plan_cache_misses,
+            "plan_cache_evictions": self.plan_cache_evictions,
             "plan_s": round(self.plan_s, 6),
             "transfer_s": round(self.transfer_s, 6),
             "dispatch_s": round(self.dispatch_s, 6),
@@ -271,6 +283,11 @@ class DecodeStats:
                f"{d['checkpoints_written']} checkpoints"
                if (d["deadline_exceeded"] or d["hedges_issued"]
                    or d["checkpoints_written"]) else "")
+            + (f"; PLAN CACHE: {d['plan_cache_hits']} hits / "
+               f"{d['plan_cache_misses']} misses / "
+               f"{d['plan_cache_evictions']} evictions"
+               if (d["plan_cache_hits"] or d["plan_cache_misses"]
+                   or d["plan_cache_evictions"]) else "")
             + (f"; SALVAGE: {d['files_salvaged']} files salvaged "
                f"({d['row_groups_recovered']} row groups recovered), "
                f"{d['files_quarantined']} files quarantined, "
