@@ -293,3 +293,82 @@ class TestVerifyCommand:
         rc, out = self.run("verify", str(p))
         assert rc == 0, out
         assert "all row groups bit-exact" in out
+
+
+class TestProfileJson:
+    """profile --json machine-readable output and --from-events
+    replay of a saved pages.jsonl (round-11 satellites)."""
+
+    def run(self, *argv):
+        out = io.StringIO()
+        import contextlib
+        with contextlib.redirect_stdout(out):
+            rc = pt.main(list(argv))
+        return rc, out.getvalue()
+
+    def test_profile_json(self, sample_file):
+        import json
+
+        rc, out = self.run("profile", "--json", "--cpu", sample_file)
+        assert rc == 0
+        rep = json.loads(out)
+        assert rep["file"] == sample_file
+        cols = {r["column"]: r for r in rep["columns"]}
+        assert "id" in cols and cols["id"]["values"] == 25
+        assert rep["counters"]["row_groups"] == 1
+        assert rep["phases"]["wall_s"] > 0
+        assert "page_comp_bytes" in rep["histograms"]
+
+    def test_profile_from_saved_events(self, sample_file, tmp_path):
+        import json
+
+        events = str(tmp_path / "pages.jsonl")
+        rc, _ = self.run("profile", "--cpu", "--events", events,
+                         sample_file)
+        assert rc == 0 and os.path.exists(events)
+        # replay the SAVED log: same per-column page/value totals,
+        # no live re-run (and no file argument)
+        rc, out = self.run("profile", "--json", "--from-events",
+                           events)
+        assert rc == 0
+        rep = json.loads(out)
+        cols = {r["column"]: r for r in rep["columns"]}
+        assert cols["id"]["values"] == 25
+        assert "counters" not in rep  # events only: no collector
+        # human rendering works from the saved log too
+        rc, out = self.run("profile", "--from-events", events)
+        assert rc == 0
+        assert "id" in out
+
+    def test_from_events_conflicts_with_file(self, sample_file,
+                                             tmp_path):
+        events = str(tmp_path / "pages.jsonl")
+        self.run("profile", "--cpu", "--events", events, sample_file)
+        rc, _ = self.run("profile", "--from-events", events,
+                         sample_file)
+        assert rc == 1
+
+    def test_profile_without_args_errors(self):
+        rc, _ = self.run("profile")
+        assert rc == 1
+
+
+    def test_profile_json_with_events_stdout_stays_json(
+            self, sample_file, tmp_path):
+        """--json + --events: stdout is ONE parseable JSON document;
+        dump status lines go to stderr."""
+        import json
+        import subprocess
+        import sys
+
+        ev = str(tmp_path / "pages.jsonl")
+        out = subprocess.run(
+            [sys.executable, "-m", "tpuparquet.cli.parquet_tool",
+             "profile", "--json", "--cpu", "--events", ev,
+             sample_file],
+            capture_output=True, text=True, timeout=300)
+        assert out.returncode == 0, out.stderr[-500:]
+        rep = json.loads(out.stdout)  # whole stream parses
+        assert rep["file"] == sample_file
+        assert "wrote page events" in out.stderr
+        assert os.path.exists(ev)

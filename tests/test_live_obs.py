@@ -1,0 +1,754 @@
+"""Always-on telemetry layer: metrics registry, flight recorder,
+live progress, post-mortems, `parquet-tool top`.
+
+Covers the round's acceptance criteria:
+
+* the Prometheus snapshot parses and its counters match ``DecodeStats``
+  exactly;
+* an injected-fault quarantine produces a ``.postmortem.json``
+  containing the trigger's coordinates and the trailing flight-recorder
+  events;
+* ``parquet-tool top`` renders live progress for a running
+  ``ShardedScan``;
+* cross-host registry merges are exact (counters sum, histograms
+  bucket-wise) and equal the single-host totals on the same corpus;
+* the disabled-telemetry hot path stays zero-cost (the
+  ``current_stats() is None`` short-circuit holds with the recorder
+  compiled in).
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from tpuparquet import FileWriter, collect_stats
+from tpuparquet.faults import inject_faults
+from tpuparquet.io.reader import FileReader
+from tpuparquet.obs import live, postmortem, progress, recorder
+from tpuparquet.shard.scan import ShardedScan
+from tpuparquet.stats import DecodeStats, current_stats
+
+SCHEMA = ("message test { required int64 a; required double b; "
+          "optional binary s (STRING); }")
+
+
+def write_file(path, rows=200, rg_rows=50, seed=0):
+    with open(path, "wb") as f:
+        w = FileWriter(f, SCHEMA, max_row_group_size=rg_rows * 20)
+        for j in range(rows):
+            w.add_data({"a": j + seed, "b": (j + seed) * 0.5,
+                        "s": f"r{j}" if j % 3 else None})
+        w.close()
+    return str(path)
+
+
+@pytest.fixture
+def corpus(tmp_path):
+    return [write_file(tmp_path / f"f{i}.parquet", seed=i * 1000)
+            for i in range(2)]
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    """Each test sees its own process registry and a default-on
+    recorder (restored after)."""
+    reg = live.reset_registry()
+    rec = recorder.set_ring(256)
+    yield reg
+    live.reset_registry()
+    recorder.set_ring(recorder.ring_default())
+
+
+# ----------------------------------------------------------------------
+# MetricsRegistry
+# ----------------------------------------------------------------------
+
+class TestRegistry:
+    def test_counters_gauges_hists(self):
+        reg = live.MetricsRegistry()
+        reg.counter("x")
+        reg.counter("x", 4)
+        reg.counter("t", 0.5)
+        reg.gauge("g", 7)
+        reg.hist("h").record(100)
+        reg.hist("h").record(3000)
+        snap = reg.snapshot()
+        assert snap["counters"] == {"x": 5, "t": 0.5}
+        assert snap["gauges"] == {"g": 7}
+        assert snap["hists"]["h"]["n"] == 2
+        assert snap["hists"]["h"]["total"] == 3100
+
+    def test_thread_shards_merge_exactly(self):
+        reg = live.MetricsRegistry()
+        N, T = 5000, 8
+
+        def work():
+            for _ in range(N):
+                reg.counter("n")
+                reg.hist("h").record(7)
+
+        ts = [threading.Thread(target=work) for _ in range(T)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        snap = reg.snapshot()
+        assert snap["counters"]["n"] == N * T
+        assert snap["hists"]["h"]["n"] == N * T
+
+    def test_state_roundtrip_and_merge(self):
+        a = live.MetricsRegistry()
+        a.counter("x", 3)
+        a.hist("h").record(10)
+        b = live.MetricsRegistry.from_state(a.to_state())
+        assert b.snapshot() == a.snapshot()
+        m = live.MetricsRegistry()
+        m.merge_from(a)
+        m.merge_from(b)
+        assert m.snapshot()["counters"]["x"] == 6
+        assert m.snapshot()["hists"]["h"]["n"] == 2
+
+
+def parse_prometheus(text):
+    """Tiny exposition-format parser: metric -> value, plus per-metric
+    bucket lists — enough to prove the export is well-formed."""
+    values, buckets, types = {}, {}, {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE"):
+            _, _, name, typ = line.split()
+            types[name] = typ
+            continue
+        assert not line.startswith("#"), line
+        name, val = line.rsplit(" ", 1)
+        if "{" in name:
+            base, label = name.split("{", 1)
+            assert base.endswith("_bucket"), line
+            le = label[len('le="'):-2]
+            buckets.setdefault(base[: -len("_bucket")], []).append(
+                (le, float(val)))
+        else:
+            values[name] = float(val)
+    return values, buckets, types
+
+
+class TestPrometheus:
+    def test_export_parses_and_matches_decode_stats(self, corpus):
+        """Acceptance: the Prometheus snapshot parses; its counters
+        equal the DecodeStats of the scope that fed it, exactly."""
+        with collect_stats() as st:
+            with FileReader(corpus[0]) as r:
+                for rg in range(r.row_group_count()):
+                    r.read_row_group_arrays(rg)
+        text = live.registry().prometheus_text()
+        values, buckets, types = parse_prometheus(text)
+        for f in ("pages", "values", "chunks", "row_groups",
+                  "bytes_compressed", "bytes_uncompressed"):
+            assert values[f"tpq_{f}_total"] == getattr(st, f), f
+            assert types[f"tpq_{f}_total"] == "counter"
+        # histogram series: cumulative, +Inf == count == st's n
+        h = st.hists["page_comp_bytes"]
+        series = dict(buckets["tpq_page_comp_bytes"])
+        assert series["+Inf"] == h.n
+        assert values["tpq_page_comp_bytes_count"] == h.n
+        assert values["tpq_page_comp_bytes_sum"] == h.total
+        les = [le for le, _ in buckets["tpq_page_comp_bytes"]
+               if le != "+Inf"]
+        counts = [c for le, c in buckets["tpq_page_comp_bytes"]
+                  if le != "+Inf"]
+        assert counts == sorted(counts)  # cumulative
+        assert [float(le) for le in les] == sorted(float(le)
+                                                   for le in les)
+
+    def test_nested_scopes_fold_once_each(self, corpus):
+        with collect_stats() as outer:
+            with FileReader(corpus[0]) as r:
+                r.read_row_group_arrays(0)
+                with collect_stats() as inner:
+                    r.read_row_group_arrays(1)
+        snap = live.registry().snapshot()
+        # the inner scope shadowed the outer: registry total is the
+        # sum of both scopes, each folded exactly once
+        assert snap["counters"]["row_groups"] == \
+            outer.row_groups + inner.row_groups == 2
+
+    def test_live_metrics_off(self, corpus, monkeypatch):
+        monkeypatch.setenv("TPQ_LIVE_METRICS", "0")
+        with collect_stats():
+            with FileReader(corpus[0]) as r:
+                r.read_row_group_arrays(0)
+        assert live.registry().snapshot()["counters"] == {}
+
+    def test_snapshot_writer_thread(self, corpus, tmp_path,
+                                    monkeypatch):
+        out = tmp_path / "metrics.prom"
+        monkeypatch.setenv("TPQ_METRICS_EXPORT", str(out))
+        monkeypatch.setenv("TPQ_METRICS_INTERVAL_S", "0.05")
+        with collect_stats():
+            with FileReader(corpus[0]) as r:
+                r.read_row_group_arrays(0)
+        live.maybe_start_exporter()
+        deadline = 5.0
+        import time as _t
+        t0 = _t.monotonic()
+        while not out.exists() and _t.monotonic() - t0 < deadline:
+            _t.sleep(0.02)
+        assert out.exists()
+        values, _, _ = parse_prometheus(out.read_text())
+        assert values["tpq_row_groups_total"] >= 1
+        # JSON flavor via explicit export
+        j = tmp_path / "metrics.json"
+        assert live.export_now(str(j)) == str(j)
+        doc = json.loads(j.read_text())
+        assert doc["counters"]["row_groups"] >= 1
+
+
+# ----------------------------------------------------------------------
+# Always-on: scans feed the registry with no collector anywhere
+# ----------------------------------------------------------------------
+
+class TestAlwaysOn:
+    def test_scan_without_collector_moves_registry(self, corpus):
+        assert current_stats() is None
+        scan = ShardedScan(corpus)
+        outs = scan.run()
+        assert len(outs) == len(scan.units)
+        snap = live.registry().snapshot()
+        assert snap["counters"]["row_groups"] == len(scan.units)
+        assert snap["counters"]["values"] > 0
+        assert snap["counters"]["pages"] > 0
+        # progress gauges rode along
+        assert snap["gauges"]["scan_units_done"] == len(scan.units)
+        # and the ambient collector never leaked onto this thread
+        assert current_stats() is None
+
+    def test_user_collector_wins_no_double_count(self, corpus):
+        scan = ShardedScan(corpus)
+        with collect_stats() as st:
+            scan.run()
+        snap = live.registry().snapshot()
+        # exactly one fold: the user scope's (the ambient collector
+        # stayed idle while a user collector was active)
+        assert snap["counters"]["row_groups"] == st.row_groups \
+            == len(scan.units)
+
+    def test_incremental_folds_equal_final_totals(self, corpus):
+        scan = ShardedScan(corpus)
+        mid = []
+        for k, _ in scan.run_iter():
+            if k == len(scan.units) // 2:
+                mid.append(live.registry().snapshot()
+                           ["counters"].get("row_groups", 0))
+        snap = live.registry().snapshot()
+        # mid-scan the registry had already moved (unit-boundary
+        # folds), and the final total is exact
+        assert mid and 0 < mid[0] < len(scan.units)
+        assert snap["counters"]["row_groups"] == len(scan.units)
+
+
+# ----------------------------------------------------------------------
+# Flight recorder
+# ----------------------------------------------------------------------
+
+class TestRecorder:
+    def test_ring_bounded_and_ordered(self):
+        rec = recorder.FlightRecorder(ring=8)
+        for i in range(50):
+            rec.record("e", site="s", i=i)
+        snap = rec.snapshot()
+        assert len(snap) == 8
+        assert [e["i"] for e in snap] == list(range(42, 50))
+        assert all(a["t"] <= b["t"] for a, b in zip(snap, snap[1:]))
+
+    def test_per_thread_rings_fold(self):
+        rec = recorder.FlightRecorder(ring=16)
+
+        def work(tag):
+            for i in range(4):
+                rec.record("e", tag=tag, i=i)
+
+        ts = [threading.Thread(target=work, args=(t,)) for t in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        snap = rec.snapshot()
+        assert len(snap) == 12
+        assert {e["tag"] for e in snap} == {0, 1, 2}
+
+    def test_decode_records_pages_without_collector(self, corpus):
+        rec = recorder.set_ring(512)
+        assert current_stats() is None
+        with FileReader(corpus[0]) as r:
+            r.read_row_group_arrays(0)
+        kinds = {e["kind"] for e in rec.snapshot()}
+        assert "page" in kinds and "chunk_read" in kinds
+
+    def test_disabled_recorder_records_nothing(self, corpus):
+        """Overhead guard, structural half: with the recorder off and
+        no collector, the hot path's `current_stats() is None`
+        short-circuit holds and no telemetry work happens at all."""
+        recorder.set_ring(0)
+        assert recorder.recorder() is None
+        before = live.registry().snapshot()
+        with FileReader(corpus[0]) as r:
+            for rg in range(r.row_group_count()):
+                r.read_row_group_arrays(rg)
+        assert recorder.recorder() is None
+        assert live.registry().snapshot() == before
+        assert current_stats() is None
+
+
+# ----------------------------------------------------------------------
+# Live progress + parquet-tool top
+# ----------------------------------------------------------------------
+
+class TestProgress:
+    def test_eta_and_rates(self):
+        p = progress.ScanProgress(10)
+        p.begin()
+        for k in range(4):
+            p.unit_started(k)
+            p.unit_done(k, rows=100)
+        snap = p.snapshot()
+        assert snap["units_done"] == 4
+        assert snap["rows_done"] == 400
+        assert snap["ewma_unit_s"] is not None
+        assert snap["eta_s"] is not None and snap["eta_s"] >= 0
+        p.finish()
+        assert p.snapshot()["state"] == "done"
+        assert p.snapshot()["eta_s"] is None
+
+    def test_straggler_detection(self, monkeypatch):
+        p = progress.ScanProgress(10)
+        p.begin()
+        # prime the tracker with fast units
+        for k in range(6):
+            p.unit_started(k)
+            p.unit_done(k)
+        # fake an in-flight unit started long ago
+        import time as _t
+        with p._lock:
+            p._inflight[9] = _t.monotonic() - 100.0
+        s = p.stragglers()
+        assert s and s[0]["unit"] == 9
+        assert s[0]["elapsed_s"] > s[0]["p95_s"]
+
+    def test_export_file_roundtrip(self, tmp_path):
+        path = tmp_path / "p.json"
+        p = progress.ScanProgress(3, export=str(path),
+                                  min_export_interval=0.0)
+        p.begin()
+        p.unit_started(0)
+        p.unit_done(0, rows=5)
+        doc = progress.read_progress_file(str(path))
+        assert doc["units_done"] == 1 and doc["state"] == "running"
+        p.finish()
+        assert progress.read_progress_file(str(path))["state"] == "done"
+
+    def test_top_renders_running_scan(self, corpus, tmp_path, capsys):
+        """Acceptance: parquet-tool top shows live progress for a
+        RUNNING ShardedScan (mid-run_iter, state=running), then the
+        finished frame."""
+        from tpuparquet.cli.parquet_tool import main as pt_main
+
+        path = str(tmp_path / "scan.progress.json")
+        scan = ShardedScan(corpus, progress_export=path)
+        # consume a few units, then render while the scan is mid-flight
+        seen = 0
+        for k, _ in scan.run_iter():
+            seen += 1
+            if seen == 3:
+                # force a fresh frame (the throttle may have skipped)
+                scan.progress._export(force=True)
+                assert pt_main(["top", "--once", path]) == 0
+                mid = capsys.readouterr().out
+                assert "state=running" in mid
+                assert "3/" in mid and "units" in mid
+        assert pt_main(["top", "--once", path]) == 0
+        done = capsys.readouterr().out
+        assert "state=done" in done
+        assert f"{len(scan.units)}/{len(scan.units)} units" in done
+        assert "100.0%" in done
+
+    def test_top_missing_file(self, tmp_path, capsys):
+        from tpuparquet.cli.parquet_tool import main as pt_main
+
+        assert pt_main(["top", "--once",
+                        str(tmp_path / "nope.json")]) == 1
+        assert "waiting for" in capsys.readouterr().out
+
+
+# ----------------------------------------------------------------------
+# Automatic post-mortems
+# ----------------------------------------------------------------------
+
+class TestPostmortem:
+    def test_quarantine_writes_postmortem(self, corpus, tmp_path):
+        """Acceptance: an injected-fault quarantine produces a
+        .postmortem.json beside the durable cursor containing the
+        triggering fault's coordinates and the trailing
+        flight-recorder events."""
+        cur = str(tmp_path / "cursor.json")
+        with inject_faults() as inj:
+            inj.inject("kernels.device.page_payload", "corrupt",
+                       match={"column": "a"}, times=1)
+            scan = ShardedScan(corpus, on_error="quarantine",
+                               retries=0, resume_from=cur)
+            scan.run()
+        assert len(scan.quarantine) == 1
+        pm = cur + postmortem.POSTMORTEM_SUFFIX
+        doc = postmortem.load_postmortem(pm)
+        assert len(doc["incidents"]) == 1
+        inc = doc["incidents"][0]
+        trig = inc["trigger"]
+        entry = scan.quarantine.entries[0]
+        assert trig["kind"] == "quarantined"
+        assert trig["site"] == "shard.scan.unit"
+        for k in ("unit", "file", "row_group", "column", "page",
+                  "error"):
+            assert trig.get(k) == entry.get(k), k
+        # trailing flight-recorder events rode along, fault included
+        kinds = {e["kind"] for e in inc["recorder"]}
+        assert "fault:corrupt" in kinds
+        assert "quarantined" in kinds
+        assert "metrics" in inc and "counters" in inc["metrics"]
+        assert inc["stats"] is not None
+
+    def test_scan_deadline_writes_postmortem(self, corpus, tmp_path):
+        from tpuparquet.errors import DeadlineExceededError
+
+        cur = str(tmp_path / "cursor.json")
+        scan = ShardedScan(corpus, scan_deadline=1e-9, resume_from=cur)
+        with pytest.raises(DeadlineExceededError):
+            list(scan.run_iter())
+        doc = postmortem.load_postmortem(cur + postmortem.POSTMORTEM_SUFFIX)
+        assert doc["incidents"][-1]["trigger"]["kind"] == \
+            "scan_deadline"
+        # the progress frame reports the error state
+        assert scan.progress.snapshot()["state"] == "error"
+
+    def test_postmortem_dir_fallback(self, corpus, tmp_path,
+                                     monkeypatch):
+        monkeypatch.setenv("TPQ_POSTMORTEM_DIR", str(tmp_path))
+        with inject_faults() as inj:
+            inj.inject("kernels.device.page_payload", "corrupt",
+                       match={"column": "a"}, times=1)
+            scan = ShardedScan(corpus, on_error="quarantine",
+                               retries=0)
+            scan.run()
+        path = postmortem.postmortem_path_for(None)
+        assert os.path.exists(path)
+        os.unlink(path)
+
+    def test_postmortem_off_by_default(self, corpus):
+        with inject_faults() as inj:
+            inj.inject("kernels.device.page_payload", "corrupt",
+                       match={"column": "a"}, times=1)
+            scan = ShardedScan(corpus, on_error="quarantine",
+                               retries=0)
+            scan.run()
+        # no checkpoint, no TPQ_POSTMORTEM_DIR: no surprise files
+        assert scan._postmortem_path is None
+
+    def test_incident_cap(self, tmp_path):
+        path = str(tmp_path / "x.postmortem.json")
+        for i in range(postmortem.INCIDENT_CAP + 5):
+            postmortem.record_incident(path, {"kind": "k", "i": i})
+        doc = postmortem.load_postmortem(path)
+        assert len(doc["incidents"]) == postmortem.INCIDENT_CAP
+        assert doc["incidents"][-1]["trigger"]["i"] == \
+            postmortem.INCIDENT_CAP + 4
+
+
+# ----------------------------------------------------------------------
+# Cross-host metrics: merged host registries == single-host totals
+# ----------------------------------------------------------------------
+
+class TestCrossHost:
+    # float time counters vary run to run; the exactness contract is
+    # over the integer content counters and the histograms
+    INT_FIELDS = ("row_groups", "chunks", "pages", "values",
+                  "bytes_compressed", "bytes_uncompressed",
+                  "bytes_staged", "pages_device_snappy",
+                  "pages_device_planes", "pages_device_delta_lanes",
+                  "pages_host_values")
+
+    def _scan_into_registry(self, paths, units=None):
+        """Run a scan's units under a fresh collector and fold into a
+        fresh registry (one simulated host)."""
+        reg = live.MetricsRegistry()
+        with collect_stats() as st:
+            scan = ShardedScan(paths)
+            for k, _ in scan.run_iter():
+                pass
+        live.fold_stats(st, reg)
+        return reg
+
+    def test_merged_hosts_equal_single_host(self, tmp_path):
+        paths = [write_file(tmp_path / f"g{i}.parquet", seed=i * 7)
+                 for i in range(4)]
+        # two "hosts" scan disjoint halves; the fleet fold must equal
+        # the single-host scan of the union corpus, exactly
+        ra = self._scan_into_registry(paths[:2])
+        rb = self._scan_into_registry(paths[2:])
+        whole = self._scan_into_registry(paths)
+        fleet = live.MetricsRegistry()
+        fleet.merge_from(live.MetricsRegistry.from_state(ra.to_state()))
+        fleet.merge_from(live.MetricsRegistry.from_state(rb.to_state()))
+        fs, ws = fleet.snapshot(), whole.snapshot()
+        for f in self.INT_FIELDS:
+            assert fs["counters"].get(f, 0) == \
+                ws["counters"].get(f, 0), f
+        # content histograms: exact bucket-wise equality (time-valued
+        # histograms like stager_wave_us vary run to run by design)
+        for h in ("page_comp_bytes", "page_uncomp_bytes"):
+            assert fs["hists"][h] == ws["hists"][h], h
+
+    def test_allgather_metrics_single_process(self, corpus):
+        from tpuparquet.shard.distributed import allgather_metrics
+
+        scan = ShardedScan(corpus)
+        scan.run()
+        fleet = allgather_metrics()
+        snap = fleet.snapshot()
+        assert snap["counters"]["row_groups"] == len(scan.units)
+        # host gauges land prefixed (instantaneous, never summed)
+        assert snap["gauges"]["p0_scan_units_done"] == len(scan.units)
+
+    def test_multihost_scan_registry_equals_sharded(self, tmp_path):
+        """MultiHostScan (1-process degenerate grid) must feed the
+        registry identically to ShardedScan on the same corpus."""
+        from tpuparquet.shard.distributed import MultiHostScan
+
+        paths = [write_file(tmp_path / f"m{i}.parquet", seed=i)
+                 for i in range(2)]
+        mh = MultiHostScan(paths)
+        mh.run()
+        a = live.registry().snapshot()["counters"]
+        live.reset_registry()
+        sh = ShardedScan(paths)
+        sh.run()
+        b = live.registry().snapshot()["counters"]
+        for f in self.INT_FIELDS:
+            assert a.get(f, 0) == b.get(f, 0), f
+
+
+# ----------------------------------------------------------------------
+# LiveFold exactness
+# ----------------------------------------------------------------------
+
+class TestLiveFold:
+    def test_incremental_equals_whole(self):
+        reg_inc = live.MetricsRegistry()
+        reg_all = live.MetricsRegistry()
+        st = DecodeStats()
+        fold = live.LiveFold()
+        for step in range(5):
+            st.pages += step + 1
+            st.values += 100 * step
+            st.plan_s += 0.25
+            st.hist("h").record(1 << step)
+            fold.fold(st, reg_inc)
+        live.fold_stats(st, reg_all)
+        a, b = reg_inc.snapshot(), reg_all.snapshot()
+        assert a["counters"] == b["counters"]
+        assert a["hists"] == b["hists"]
+
+
+class TestReviewFixes:
+    """Round-11 review findings pinned: dead-thread ring retirement
+    and `top` staleness flagging."""
+
+    def test_dead_thread_rings_are_retired(self):
+        rec = recorder.FlightRecorder(ring=8)
+
+        def work(tag):
+            rec.record("e", tag=tag)
+
+        for tag in range(50):
+            t = threading.Thread(target=work, args=(tag,))
+            t.start()
+            t.join()
+        # one more registration retires the corpses
+        rec.record("e", tag="main")
+        with rec._slots._lock:
+            live_rings = len(rec._slots._slots)
+        # only threads still alive hold a ring (main + possibly a few
+        # not-yet-retired); memory is bounded by live threads + one
+        # retired ring, not by total thread churn
+        assert live_rings <= threading.active_count() + 1
+        # the retired ring kept the TRAILING dead-thread records
+        tags = [e["tag"] for e in rec.snapshot()]
+        assert "main" in tags
+        assert 49 in tags  # most recent dead worker survived
+
+    def test_top_flags_stale_running_frame(self, tmp_path, capsys):
+        import time as _t
+
+        from tpuparquet.cli.parquet_tool import main as pt_main
+
+        p = progress.ScanProgress(4, export=str(tmp_path / "s.json"),
+                                  min_export_interval=0.0)
+        p.begin()
+        p.unit_started(0)
+        p.unit_done(0)
+        # backdate the frame: the writer has been silent a long time
+        doc = progress.read_progress_file(str(tmp_path / "s.json"))
+        doc["ts"] -= 3600
+        (tmp_path / "s.json").write_text(json.dumps(doc))
+        assert pt_main(["top", "--once", str(tmp_path / "s.json")]) == 0
+        out = capsys.readouterr().out
+        assert "STALE" in out and "state=running" in out
+
+    def test_multihost_progress_export_disable(self, tmp_path,
+                                               monkeypatch):
+        """progress_export="" disables even with the env default set
+        (and never re-enables the unsuffixed env path)."""
+        from tpuparquet.shard.distributed import MultiHostScan
+
+        monkeypatch.setenv("TPQ_PROGRESS_EXPORT",
+                           str(tmp_path / "env.json"))
+        paths = [write_file(tmp_path / "d.parquet")]
+        mh = MultiHostScan(paths, progress_export="")
+        assert mh.progress.export_path is None
+        mh.run()
+        assert not (tmp_path / "env.json").exists()
+
+    def test_dead_thread_shards_are_retired(self):
+        reg = live.MetricsRegistry()
+
+        def work():
+            reg.counter("n")
+
+        for _ in range(50):
+            t = threading.Thread(target=work)
+            t.start()
+            t.join()
+        reg.counter("n")  # registration retires the corpses, exactly
+        with reg._slots._lock:
+            live_shards = len(reg._slots._slots)
+        assert live_shards <= threading.active_count() + 1
+        assert reg.snapshot()["counters"]["n"] == 51
+
+    def test_gauges_keyed_by_label_no_clobber(self):
+        """Two concurrent scans with distinct labels keep separate
+        registry gauges (and dotted labels become Prometheus-safe)."""
+        a = progress.ScanProgress(4, label="scan")
+        b = progress.ScanProgress(2, label="scan.p1")
+        a.begin(), b.begin()
+        a.unit_started(0), a.unit_done(0, rows=10)
+        b.unit_started(0), b.unit_done(0, rows=5)
+        g = live.registry().snapshot()["gauges"]
+        assert g["scan_units_done"] == 1
+        assert g["scan_units_total"] == 4
+        assert g["scan_p1_units_done"] == 1
+        assert g["scan_p1_units_total"] == 2
+
+    def test_concurrent_incidents_never_lost(self, tmp_path):
+        """record_incident's load-append-write is serialized: two
+        scans sharing one post-mortem file never drop an incident."""
+        path = str(tmp_path / "pm.postmortem.json")
+        ts = [threading.Thread(
+                  target=postmortem.record_incident,
+                  args=(path, {"kind": "k", "unit": i}))
+              for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        doc = postmortem.load_postmortem(path)
+        units = sorted(i["trigger"]["unit"] for i in doc["incidents"])
+        assert units == list(range(8))
+
+    def test_env_export_path_suffixed_by_label(self, corpus, tmp_path,
+                                               monkeypatch):
+        """The env-default status file is per-label, so concurrent
+        scans with distinct labels never interleave frames in one
+        file (an explicit progress_export= stays verbatim)."""
+        env = str(tmp_path / "env.json")
+        monkeypatch.setenv("TPQ_PROGRESS_EXPORT", env)
+        a = ShardedScan(corpus, progress_label="tenant_a")
+        assert a.progress.export_path == env + ".tenant_a"
+        b = ShardedScan(corpus)
+        assert b.progress.export_path == env
+        ex = str(tmp_path / "explicit.json")
+        c = ShardedScan(corpus, progress_label="tenant_a",
+                        progress_export=ex)
+        assert c.progress.export_path == ex
+
+    def test_bytes_staged_under_user_collector(self, corpus, tmp_path):
+        """A user collect_stats scope shadows the ambient collector —
+        progress must read staged bytes from the collector that
+        actually metered the units, not report 0."""
+        scan = ShardedScan(corpus,
+                           progress_export=str(tmp_path / "p.json"))
+        with collect_stats() as st:
+            scan.run()
+        assert st.bytes_staged > 0
+        assert scan.progress.snapshot()["bytes_staged"] \
+            == st.bytes_staged
+
+    def test_progress_label_kwarg(self, corpus):
+        """ShardedScan(progress_label=) keys this scan's gauges, so
+        concurrent scans in one serve process can keep them apart."""
+        scan = ShardedScan(corpus, progress_label="tenant_a")
+        scan.run()
+        g = live.registry().snapshot()["gauges"]
+        assert g["tenant_a_units_done"] == len(scan.units)
+        assert "scan_units_done" not in g
+
+    def test_prometheus_hist_monotone_under_torn_read(self):
+        """Histogram.record bumps the bucket before n; a snapshot in
+        that window must still render a monotone exposition
+        (+Inf >= every cumulative bucket, _count == +Inf)."""
+        reg = live.MetricsRegistry()
+        h = reg.hist("h")
+        h.record(4)
+        h.counts[3] += 1  # racing record: bucket bumped, n not yet
+        text = reg.prometheus_text()
+        buckets = [int(line.rsplit(" ", 1)[1])
+                   for line in text.splitlines()
+                   if line.startswith("tpq_h_bucket")]
+        inf = buckets[-1]
+        assert all(b <= inf for b in buckets)
+        count = [line for line in text.splitlines()
+                 if line.startswith("tpq_h_count")][0]
+        assert int(count.rsplit(" ", 1)[1]) == inf == 2
+
+    def test_atomic_write_tmp_is_thread_unique(self, tmp_path):
+        """Concurrent writers of one path never share a tmp inode."""
+        results = []
+        path = str(tmp_path / "snap.json")
+
+        def work():
+            results.append(live.atomic_write_text(path, "x" * 4096))
+
+        ts = [threading.Thread(target=work) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert all(results)
+        assert (tmp_path / "snap.json").read_text() == "x" * 4096
+        # no tmp litter left behind
+        assert [p.name for p in tmp_path.iterdir()] == ["snap.json"]
+
+    def test_continued_run_iter_restarts_clock(self, corpus):
+        import time as _t
+
+        scan = ShardedScan(corpus)
+        it = scan.run_iter()
+        for _ in range(3):
+            next(it)
+        it.close()  # consumer stops mid-scan
+        assert scan.progress.snapshot()["state"] == "stopped"
+        _t.sleep(0.3)  # idle gap that must NOT count as elapsed
+        list(scan.run_iter())  # continue from the cursor
+        snap = scan.progress.snapshot()
+        assert snap["state"] == "done"
+        assert snap["units_done"] == len(scan.units)
+        assert snap["elapsed_s"] < 0.3  # fresh clock, no idle gap

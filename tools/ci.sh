@@ -29,6 +29,14 @@
 #                       the plan-cache suite with the cache ON — the
 #                       serial path and the cache-off path can never
 #                       silently rot
+#   7. live obs gate   — strict (rc=0): the always-on telemetry layer
+#                       (metrics registry / flight recorder / progress
+#                       / post-mortems) + the env-knob catalog test,
+#                       then the overhead guard: bench_obs.py asserts
+#                       the always-on default stays within a
+#                       noise-proof bound of the all-off hot path
+#                       (measured ~1-3%, bound 25%; the structural
+#                       zero-cost pin lives in the pytest half)
 #
 # Usage: bash tools/ci.sh            (exit 0 = gate passed)
 # The tier-1 stage mirrors ROADMAP.md exactly — if you change one,
@@ -44,11 +52,14 @@ export PYTHONHASHSEED=0
 export TPQ_PAGE_CRC=1
 export TPQ_PAGE_CRC_VERIFY=1
 
-CI_PASS_FLOOR=${CI_PASS_FLOOR:-860}
+# floor history: 860 (r7-r10) -> 1000 (r11: suite grew to ~1041-1087
+# passing depending on optional deps; keep ~40-80 of headroom for
+# image variance, not 200+)
+CI_PASS_FLOOR=${CI_PASS_FLOOR:-1000}
 
 fail() { echo "ci.sh: FAILED at stage $1" >&2; exit 1; }
 
-echo "=== stage 1/6: tier-1 suite (pass floor $CI_PASS_FLOOR) ==="
+echo "=== stage 1/7: tier-1 suite (pass floor $CI_PASS_FLOOR) ==="
 rm -f /tmp/_t1.log
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
@@ -62,25 +73,25 @@ echo "DOTS_PASSED=$passed"
 [ "$passed" -ge "$CI_PASS_FLOOR" ] \
   || fail "tier-1 ($passed passed < floor $CI_PASS_FLOOR)"
 
-echo "=== stage 2/6: smoke bench (CPU backend, tiny target) ==="
+echo "=== stage 2/7: smoke bench (CPU backend, tiny target) ==="
 TPQ_BENCH_TARGET=60000 TPQ_BENCH_CPU=1 timeout -k 10 600 \
   python bench.py > /tmp/_ci_bench.json || fail "smoke bench"
 tail -1 /tmp/_ci_bench.json
 
-echo "=== stage 3/6: crash corpus + fault-injection matrix (strict) ==="
+echo "=== stage 3/7: crash corpus + fault-injection matrix (strict) ==="
 timeout -k 10 600 python -m pytest \
   "tests/test_corpus.py::TestCrashRegressions" tests/test_faults.py \
   -q -p no:cacheprovider || fail "corpus/faults"
 
-echo "=== stage 4/6: salvage + strict metadata (strict) ==="
+echo "=== stage 4/7: salvage + strict metadata (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_salvage.py \
   -q -p no:cacheprovider || fail "salvage"
 
-echo "=== stage 5/6: deadlines/hedging + kill-resume checkpoints (strict) ==="
+echo "=== stage 5/7: deadlines/hedging + kill-resume checkpoints (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_deadline.py \
   tests/test_checkpoint.py -q -p no:cacheprovider || fail "time/crash"
 
-echo "=== stage 6/6: plan matrix: serial vs parallel, cache on (strict) ==="
+echo "=== stage 6/7: plan matrix: serial vs parallel, cache on (strict) ==="
 # leg A: pinned-serial planning (the TPQ_PLAN_THREADS=1 reference path)
 TPQ_PLAN_THREADS=1 timeout -k 10 600 python -m pytest \
   tests/test_plan_parallel.py tests/test_plan_cache.py \
@@ -90,5 +101,16 @@ TPQ_PLAN_THREADS=1 timeout -k 10 600 python -m pytest \
 TPQ_PLAN_CACHE_MB=64 timeout -k 10 600 python -m pytest \
   tests/test_plan_parallel.py tests/test_fallback_matrix.py \
   -q -p no:cacheprovider || fail "plan matrix (cache-on leg)"
+
+echo "=== stage 7/7: live obs gate + overhead guard (strict) ==="
+timeout -k 10 600 python -m pytest tests/test_live_obs.py \
+  tests/test_env_docs.py -q -p no:cacheprovider || fail "live obs"
+# overhead guard: the always-on default must stay within a generous
+# noise-proof bound of the all-off hot path (the structural zero-cost
+# pin already ran above; this catches a per-value hook sneaking in)
+timeout -k 10 600 python tools/bench_obs.py --values 2000000 \
+  --reps 2 --assert-overhead 25 > /tmp/_ci_obs.json \
+  || fail "obs overhead guard"
+tail -5 /tmp/_ci_obs.json
 
 echo "ci.sh: gate PASSED"

@@ -5,10 +5,11 @@ Subcommand parity with the reference's cobra tool
 ``meta``, ``schema``, ``rowcount``, ``split``; plus ``verify``
 (CPU-vs-device bit-exact decode comparison + strict metadata
 validation), ``profile`` (per-column transport/gate/timing telemetry
-with JSON-lines and Perfetto exports), ``meta --strict`` (metadata
-validator findings with nonzero exit) and ``rescue`` (rewrite a torn
-file's recoverable row groups into a clean file) — TPU-build
-additions.
+with JSON-lines/Perfetto/``--json`` exports and ``--from-events``
+replay of a saved log), ``top`` (live view of a running scan's
+exported progress), ``meta --strict`` (metadata validator findings
+with nonzero exit) and ``rescue`` (rewrite a torn file's recoverable
+row groups into a clean file) — TPU-build additions.
 
 Run as ``python -m tpuparquet.cli.parquet_tool <cmd> <file>``.
 """
@@ -234,49 +235,131 @@ def cmd_verify(args, out=None) -> int:
     return rc
 
 
+def profile_report(events, stats=None) -> dict:
+    """Machine-readable profile digest: everything the human table
+    prints, as one JSON-safe dict.  ``stats`` optional — a profile
+    rebuilt from a saved ``pages.jsonl`` has events only, so the
+    counter/histogram sections derive from the events where they can
+    and are omitted where they can't."""
+    from .. import obs
+
+    rep: dict = {
+        "columns": obs.column_table(events),
+        "transport_counts": events.transport_counts(),
+        "event_summary": obs.event_summary(events),
+        "plan_cache_spans": obs.plan_cache_span_counts(events),
+        "fault_tallies": obs.fault_counts_by_column(events),
+        "faults": len(events.faults),
+    }
+    # phase walls: exact from the collector when present, else the
+    # span sums (the same numbers, minus wall_s which only a live
+    # collector can know)
+    if stats is not None:
+        d = stats.as_dict()
+        rep["counters"] = d
+        rep["histograms"] = stats.histograms_dict()
+        rep["phases"] = {k: d[k] for k in
+                         ("plan_s", "transfer_s", "dispatch_s",
+                          "wall_s")}
+    else:
+        phases: dict = {}
+        for s in events.spans:
+            key = {"plan": "plan_s", "transfer": "transfer_s",
+                   "dispatch": "dispatch_s"}.get(s.get("name"))
+            if key:
+                phases[key] = round(phases.get(key, 0.0) + s["dur"], 6)
+        rep["phases"] = phases
+    return rep
+
+
 def cmd_profile(args, out=None) -> int:
     """Decode with full telemetry on and print the per-column
     transport/timing table: which wire transport each column's pages
     took, WHY the gate chose it (the competition's wire-size numbers),
     and where the host wall went.  Optional dumps: ``--events`` writes
     the raw per-page JSON-lines log, ``--perfetto`` a Chrome-trace
-    JSON of the host phase spans (load at ui.perfetto.dev).  No
-    reference analogue — this is the observability face of the device
-    decode backend."""
+    JSON of the host phase spans (load at ui.perfetto.dev),
+    ``--json`` the whole digest as machine-readable JSON.
+    ``--from-events pages.jsonl`` analyzes a SAVED event log instead
+    of re-running the decode (no file argument needed).  No reference
+    analogue — this is the observability face of the device decode
+    backend."""
     out = out or sys.stdout
     from .. import obs
     from ..stats import collect_stats
 
-    mirrors = [m for m in (getattr(args, "mirror", None) or []) if m]
-    with FileReader(args.file, mirrors=mirrors) as r:
-        with collect_stats(events=True) as st:
-            if getattr(args, "cpu", False):
-                for rg in range(r.row_group_count()):
-                    r.read_row_group_arrays(rg)
-            else:
-                from ..kernels.device import read_row_groups_device
+    saved = getattr(args, "from_events", None)
+    if saved:
+        if args.file:
+            raise ValueError(
+                "profile --from-events analyzes the saved log; drop "
+                "the file argument (or drop --from-events to re-run)")
+        log = obs.load_jsonl(saved)
+        st = None
+    elif not args.file:
+        raise ValueError("profile needs a parquet file "
+                         "(or --from-events pages.jsonl)")
+    else:
+        mirrors = [m for m in (getattr(args, "mirror", None) or []) if m]
+        with FileReader(args.file, mirrors=mirrors) as r:
+            with collect_stats(events=True) as st:
+                if getattr(args, "cpu", False):
+                    for rg in range(r.row_group_count()):
+                        r.read_row_group_arrays(rg)
+                else:
+                    from ..kernels.device import read_row_groups_device
 
-                for _rg, cols in read_row_groups_device(r):
-                    for c in cols.values():
-                        c.block_until_ready()
-    print(obs.format_column_table(obs.column_table(st.events)), file=out)
-    d = st.as_dict()
-    print(f"\nphases: plan {d['plan_s']:.3f}s  "
-          f"transfer {d['transfer_s']:.3f}s  "
-          f"dispatch {d['dispatch_s']:.3f}s  wall {d['wall_s']:.3f}s",
-          file=out)
-    # footer-keyed plan cache effectiveness (TPQ_PLAN_CACHE_MB): the
-    # per-span verdicts localize WHICH column plans hit vs re-derived
-    cache_spans = obs.plan_cache_span_counts(st.events)
-    if d["plan_cache_hits"] or d["plan_cache_misses"]:
-        print(f"plan cache: {d['plan_cache_hits']} hits  "
-              f"{d['plan_cache_misses']} misses  "
-              f"{d['plan_cache_evictions']} evictions  "
-              f"(spans: {cache_spans})", file=out)
-    print(st.summary(), file=out)
+                    for _rg, cols in read_row_groups_device(r):
+                        for c in cols.values():
+                            c.block_until_ready()
+        log = st.events
+    if getattr(args, "json", False):
+        import json as _json
+
+        rep = profile_report(log, st)
+        rep["file"] = args.file or saved
+        _json.dump(rep, out, sort_keys=True, default=str)
+        print(file=out)
+        # stdout is now a JSON document consumers parse whole: the
+        # dump status lines must not corrupt it
+        status = sys.stderr
+    else:
+        _print_profile(log, st, out)
+        status = out
+    if getattr(args, "events", None):
+        log.write_jsonl(args.events)
+        print(f"wrote page events to {args.events}", file=status)
+    if getattr(args, "perfetto", None):
+        obs.write_chrome_trace(log, args.perfetto)
+        print(f"wrote Perfetto trace to {args.perfetto}", file=status)
+    return 0
+
+
+def _print_profile(log, st, out) -> None:
+    """The human rendering of a profile (live collector or saved
+    events)."""
+    from .. import obs
+
+    print(obs.format_column_table(obs.column_table(log)), file=out)
+    if st is not None:
+        d = st.as_dict()
+        print(f"\nphases: plan {d['plan_s']:.3f}s  "
+              f"transfer {d['transfer_s']:.3f}s  "
+              f"dispatch {d['dispatch_s']:.3f}s  "
+              f"wall {d['wall_s']:.3f}s",
+              file=out)
+        # footer-keyed plan cache effectiveness (TPQ_PLAN_CACHE_MB):
+        # per-span verdicts localize WHICH column plans hit
+        cache_spans = obs.plan_cache_span_counts(log)
+        if d["plan_cache_hits"] or d["plan_cache_misses"]:
+            print(f"plan cache: {d['plan_cache_hits']} hits  "
+                  f"{d['plan_cache_misses']} misses  "
+                  f"{d['plan_cache_evictions']} evictions  "
+                  f"(spans: {cache_spans})", file=out)
+        print(st.summary(), file=out)
     # per-column time-domain tallies: which column's reads hedged /
     # expired (global counts alone can't localize a degraded replica)
-    tally = obs.fault_counts_by_column(st.events)
+    tally = obs.fault_counts_by_column(log)
     if tally:
         print("\nhedges/deadlines per column:", file=out)
         for col in sorted(tally):
@@ -286,17 +369,106 @@ def cmd_profile(args, out=None) -> int:
                   f"won {row.get('hedge_won', 0)}, "
                   f"deadlines exceeded "
                   f"{row.get('deadline_exceeded', 0)}", file=out)
-    h = st.hists.get("page_comp_bytes")
+    h = None if st is None else st.hists.get("page_comp_bytes")
     if h is not None and h.n:
         print(f"compressed page size: p50 < {h.quantile(0.5):,}B, "
               f"p99 < {h.quantile(0.99):,}B over {h.n} pages", file=out)
-    if getattr(args, "events", None):
-        st.events.write_jsonl(args.events)
-        print(f"wrote page events to {args.events}", file=out)
-    if getattr(args, "perfetto", None):
-        obs.write_chrome_trace(st.events, args.perfetto)
-        print(f"wrote Perfetto trace to {args.perfetto}", file=out)
-    return 0
+
+
+def _fmt_eta(s) -> str:
+    if s is None:
+        return "-"
+    s = int(s)
+    if s >= 3600:
+        return f"{s // 3600}h{(s % 3600) // 60:02d}m"
+    if s >= 60:
+        return f"{s // 60}m{s % 60:02d}s"
+    return f"{s}s"
+
+
+def render_top_frame(frames: list[dict], width: int = 40) -> str:
+    """One ``top`` screen for one or more scan status frames (a
+    multi-host scan exports one file per host)."""
+    lines = []
+    for f in frames:
+        done, total = f["units_done"], f["units_total"]
+        frac = done / total if total else 1.0
+        filled = int(frac * width)
+        bar = "#" * filled + "-" * (width - filled)
+        lines.append(
+            f"{f.get('label', 'scan')} [{bar}] "
+            f"{done}/{total} units ({frac * 100:.1f}%)  "
+            f"state={f['state']}")
+        lines.append(
+            f"  rows {f['rows_done']:,} @ {f['rows_per_s']:,.0f}/s  "
+            f"elapsed {f['elapsed_s']:.1f}s  "
+            f"eta {_fmt_eta(f.get('eta_s'))}  "
+            f"inflight {f.get('units_inflight', 0)}"
+            + (f"  QUARANTINED {f['units_quarantined']}"
+               if f.get("units_quarantined") else "")
+            + (f"  staged {f['bytes_staged']:,}B"
+               if f.get("bytes_staged") else ""))
+        if f.get("_stale_s") is not None:
+            lines.append(
+                f"  STALE: no update for {f['_stale_s']:.0f}s "
+                f"(writer pid {f.get('pid', '?')} dead or hung? "
+                "the cursor, if any, is resumable)")
+        for s in f.get("stragglers") or []:
+            lines.append(
+                f"  STRAGGLER unit {s['unit']}: "
+                f"{s['elapsed_s']}s in flight "
+                f"(p95 {s['p95_s']}s)")
+    return "\n".join(lines)
+
+
+def cmd_top(args, out=None) -> int:
+    """Live view of running scans: tail the JSON status file(s) a
+    ``ShardedScan``/``MultiHostScan`` exports (``progress_export=`` /
+    ``TPQ_PROGRESS_EXPORT``) and render progress bars, rates, ETA and
+    stragglers, refreshing until every scan leaves the running state.
+    ``--once`` prints a single frame and exits (scripts/tests).  No
+    reference analogue — this is the operator's window into the
+    always-on telemetry layer."""
+    import time as _time
+
+    from ..obs.progress import read_progress_file
+
+    out = out or sys.stdout
+    interval = max(getattr(args, "interval", 1.0), 0.05)
+    once = getattr(args, "once", False)
+    while True:
+        frames = []
+        missing = []
+        for path in args.status:
+            try:
+                f = read_progress_file(path)
+            except (OSError, ValueError):
+                missing.append(path)
+                continue
+            # a "running" frame whose writer went silent well past its
+            # own unit cadence is flagged STALE — a SIGKILLed scan
+            # never writes its "done"/"error" frame, and a frozen bar
+            # with no indication would lie to the operator.  Frames
+            # export at unit boundaries (start AND done), so the
+            # tolerance scales with the frame's own EWMA unit wall: a
+            # scan of 30s units is not "stale" 10s into a unit.
+            age = _time.time() - f.get("ts", 0)
+            stale_after = max(10.0, 5.0 * interval,
+                              10.0 * (f.get("ewma_unit_s") or 0.0))
+            if f.get("state") == "running" and age > stale_after:
+                f["_stale_s"] = age
+            frames.append(f)
+        if frames:
+            print(render_top_frame(frames), file=out)
+        for path in missing:
+            print(f"(waiting for {path})", file=out)
+        if once:
+            return 0 if frames else 1
+        if frames and not missing and \
+                all(f["state"] != "running" for f in frames):
+            return 0
+        _time.sleep(interval)
+        print(file=out)
 
 
 def cmd_rescue(args, out=None) -> int:
@@ -539,8 +711,28 @@ def build_parser() -> argparse.ArgumentParser:
     pf.add_argument("--perfetto", metavar="FILE", default="",
                     help="write a Chrome-trace JSON of the host phase "
                          "spans (ui.perfetto.dev)")
-    pf.add_argument("file")
+    pf.add_argument("--json", action="store_true",
+                    help="emit the whole profile digest as "
+                         "machine-readable JSON instead of the table")
+    pf.add_argument("--from-events", metavar="FILE", default="",
+                    dest="from_events",
+                    help="analyze a SAVED pages.jsonl event log "
+                         "instead of re-running the decode")
+    pf.add_argument("file", nargs="?", default="")
     pf.set_defaults(fn=cmd_profile)
+
+    tp = sub.add_parser(
+        "top",
+        help="live view of a running scan's exported progress "
+             "status file(s)")
+    tp.add_argument("--once", action="store_true",
+                    help="print one frame and exit")
+    tp.add_argument("--interval", type=float, default=1.0,
+                    help="refresh interval in seconds")
+    tp.add_argument("status", nargs="+",
+                    help="progress status file(s) a scan exports via "
+                         "progress_export= / TPQ_PROGRESS_EXPORT")
+    tp.set_defaults(fn=cmd_top)
 
     rc = sub.add_parser("rowcount", help="print the total row count")
     rc.add_argument("file")

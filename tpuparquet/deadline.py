@@ -260,6 +260,12 @@ def record_expiry(st, site: str, elapsed: float, budget: float,
     the single owner of the expiry-recording contract (used by the
     watchdog paths here and the scan-level budget in
     ``shard.scan.DurableScanMixin``)."""
+    from .obs.recorder import flight
+
+    # the flight recorder sees every expiry, collector or not — this
+    # is exactly the record a post-mortem wants on its timeline
+    flight("deadline_exceeded", site=site,
+           elapsed_s=round(elapsed, 3), budget_s=budget, **coords)
     if st is None:
         return
     st.deadline_exceeded += 1
@@ -383,7 +389,10 @@ def hedged_call(fns, *, delay: float, site: str,
         _spawn_worker(run, f"tpq-hedge:{site}:{i}")
 
     def hedge_next() -> None:
+        from .obs.recorder import flight
+
         i = len(starts)
+        flight("hedge_issued", site=site, replica=i, **coords)
         if st is not None:
             st.hedges_issued += 1
             if st.events is not None:
@@ -425,11 +434,15 @@ def hedged_call(fns, *, delay: float, site: str,
             _merge_worker(st, ws, failed=False)
             if tracker is not None:
                 tracker.record(time.monotonic() - starts[i])
-            if i > 0 and st is not None:
-                st.hedges_won += 1
-                if st.events is not None:
-                    st.events.fault(site=site, kind="hedge_won",
-                                    replica=i, **coords)
+            if i > 0:
+                from .obs.recorder import flight
+
+                flight("hedge_won", site=site, replica=i, **coords)
+                if st is not None:
+                    st.hedges_won += 1
+                    if st.events is not None:
+                        st.events.fault(site=site, kind="hedge_won",
+                                        replica=i, **coords)
             if on_win is not None:
                 on_win(i)
             return val

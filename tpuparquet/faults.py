@@ -147,8 +147,11 @@ class FaultInjector:
         return None
 
     def _record_stats(self, site: str, kind: str, ctx: dict) -> None:
+        from .obs.recorder import flight
         from .stats import current_stats
 
+        # flight recorder sees every delivered fault, collector or not
+        flight(f"fault:{kind}", site=site, **ctx)
         st = current_stats()
         if st is not None:
             st.faults_injected += 1

@@ -23,6 +23,7 @@ from ..cpu.dictionary import build_dictionary
 from ..cpu.plain import ByteArrayColumn
 from ..errors import CorruptChunkError, CorruptPageError, ScanError
 from ..faults import filter_bytes
+from ..obs import recorder as _flightrec
 from ..format.compact import CompactReader
 from ..format.metadata import (
     ColumnChunk,
@@ -156,6 +157,14 @@ def read_chunk(blob: "bytes | memoryview", cm: ColumnMetaData,
                     ph, payload, codec, node, dictionary)
                 values_read += pg.num_values
                 pages.append(pg)
+                # flight recorder: page coordinates ride the ring even
+                # with no collector (one `is None` check when off —
+                # guarded here so the disabled path skips the kwargs
+                # build too; this is the per-page hot loop)
+                if _flightrec._active is not None:
+                    _flightrec.flight(
+                        "page", site="io.chunk", column=col_path,
+                        page=len(pages) - 1, values=pg.num_values)
                 if st is not None:
                     st.pages += 1
                     st.hist("page_comp_bytes").record(

@@ -29,6 +29,8 @@ from ..deadline import (
 from ..errors import CorruptChunkError, CorruptPageError, \
     ScanError
 from ..faults import fault_point, filter_bytes, retry_transient
+from ..obs import recorder as _flightrec
+from ..obs.recorder import flight
 from ..format.footer import read_file_metadata
 from ..format.metadata import ColumnMetaData, FileMetaData
 from ..format.schema import Schema
@@ -265,6 +267,8 @@ class FileReader:
             # neither route usable: fall through to the strict reject
         from ..stats import current_stats
 
+        flight("metadata_reject", site="io.reader.footer",
+               file=self.name)
         st = current_stats()
         if st is not None:
             st.metadata_rejects += 1
@@ -345,6 +349,9 @@ class FileReader:
     def _mark_salvaged(self, meta: FileMetaData, report: dict) -> None:
         from ..stats import current_stats
 
+        flight("salvaged", site="io.reader.footer", file=self.name,
+               row_groups=len(meta.row_groups or []),
+               stop_reason=report.get("stop_reason"))
         self.salvaged = True
         self.salvage_report = report
         st = current_stats()
@@ -484,6 +491,13 @@ class FileReader:
                     f"{cm.total_compressed_size} bytes",
                     column=path, file=self.name)
         blob = filter_bytes("io.reader.chunk_read", blob, column=path)
+        # flight recorder: one record per chunk read (file/column
+        # coordinates are exactly what a post-mortem wants trailing;
+        # guarded so the disabled path skips the kwargs build)
+        if _flightrec._active is not None:
+            _flightrec.flight("chunk_read", site="io.reader",
+                              file=self.name, column=path,
+                              bytes=cm.total_compressed_size)
         return blob, start
 
     def iter_selected_chunks(self, rg):

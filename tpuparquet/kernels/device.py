@@ -42,6 +42,8 @@ from ..errors import CorruptChunkError, CorruptPageError, \
     DeviceDispatchError, ScanError
 from ..faults import backoff_delays, fault_point, filter_bytes
 from ..native import plane_native
+from ..obs import recorder as _flightrec
+from ..obs.recorder import flight
 from .arena import HostArena, discard_thread_arena, lease_arena, \
     return_arena, thread_arena, trim_arena_pool
 from ..cpu.plain import ByteArrayColumn
@@ -1419,6 +1421,12 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
             enc = h.encoding
         else:
             continue
+        # flight recorder: page coordinates ride the ring even with no
+        # collector active (guarded so the disabled path skips the
+        # kwargs build too — this is the per-page hot loop)
+        if _flightrec._active is not None:
+            _flightrec.flight("page", site="kernels.device",
+                              column=_col_path, page=_page_i, values=n)
         if _st is not None:
             _st.pages += 1
             _st.hist("page_comp_bytes").record(ph.compressed_page_size)
@@ -2473,11 +2481,16 @@ def read_row_group_device_resilient(reader, rg_index: int,
                 raise
             last = e
         if attempt < len(delays):
+            flight("dispatch_retry", site="kernels.device.unit_dispatch",
+                   row_group=rg_index, error=type(last).__name__)
             st = current_stats()
             if st is not None:
                 st.dispatch_retries += 1
             sleep(delays[attempt])
     # retries exhausted: degrade this unit to the CPU oracle decode
+    flight("degraded-to-host", site="kernels.device.unit_dispatch",
+           row_group=rg_index, error=type(last).__name__,
+           message=str(last))
     st = current_stats()
     if st is not None:
         st.units_degraded += 1
@@ -2540,9 +2553,14 @@ def _plan_one_column(reader, rg_index: int, path, node, cm,
         raise CorruptChunkError(
             str(e), column=path,
             file=getattr(reader, "name", None)) from e
+    t1 = time.perf_counter()
+    if _flightrec._active is not None:
+        _flightrec.flight(
+            "span:plan", site="kernels.device", column=path,
+            s=round(t1 - t0, 6),
+            cache=(cache_state[0] if cache_state else "off"))
     _cs = current_stats()
     if _cs is not None:
-        t1 = time.perf_counter()
         _cs.plan_s += t1 - t0
         if _cs.events is not None:
             _cs.events.span(
@@ -2636,9 +2654,14 @@ def _finish_row_group(planned):
     # x 6 buffers cost ~0.6s — the entire e2e-vs-internals gap).
     jax.block_until_ready(
         [x for c in out.values() for x in c._buffers()])
+    t2 = time.perf_counter()
+    if _flightrec._active is not None:
+        _flightrec.flight(
+            "span:stage", site="kernels.device", columns=len(out),
+            transfer_s=round(t1 - t0, 6),
+            dispatch_s=round(t2 - t1, 6))
     _cs = current_stats()
     if _cs is not None:
-        t2 = time.perf_counter()
         _cs.transfer_s += t1 - t0
         _cs.dispatch_s += t2 - t1
         if _cs.events is not None:

@@ -125,8 +125,11 @@ class PlanCache:
                 self._bytes -= nb
                 evicted += 1
         if evicted:
+            from ..obs.recorder import flight
             from ..stats import current_stats
 
+            flight("plan_cache_evict", site="kernels.plancache",
+                   evicted=evicted)
             st = current_stats()
             if st is not None:
                 st.plan_cache_evictions += evicted
@@ -140,6 +143,13 @@ class PlanCache:
             for k in stale:
                 _, nb = self._entries.pop(k)
                 self._bytes -= nb
+        if stale:
+            from ..obs.recorder import flight
+
+            # an invalidation marks a corruption event — exactly the
+            # kind of trailing context a post-mortem wants
+            flight("plan_cache_invalidate", site="kernels.plancache",
+                   entries=len(stale))
 
     def clear(self) -> None:
         with self._lock:

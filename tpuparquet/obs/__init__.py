@@ -1,6 +1,8 @@
 """Structured decode telemetry (SURVEY.md §5 "tracing / metrics").
 
-Three layers under the ``collect_stats()`` API:
+Two regimes share this package:
+
+**Post-hoc** (scoped, rich) — under the ``collect_stats()`` API:
 
 * :mod:`~tpuparquet.obs.events` — one record per decoded page with the
   chosen transport and the wire-size numbers that chose it, plus
@@ -11,6 +13,26 @@ Three layers under the ``collect_stats()`` API:
 * :mod:`~tpuparquet.obs.export` — Chrome-trace/Perfetto JSON and the
   ``parquet-tool profile`` column table.
 
+**Always-on** (process-lifetime, low-overhead) — no scope required:
+
+* :mod:`~tpuparquet.obs.live` — the process-wide
+  :class:`~tpuparquet.obs.live.MetricsRegistry`
+  (counters/gauges/histograms, per-thread shards, exact merges),
+  Prometheus text + JSON export, optional background snapshot writer
+  (``TPQ_METRICS_EXPORT`` / ``TPQ_METRICS_INTERVAL_S``).  Every
+  outermost ``collect_stats()`` scope and every scan unit folds into
+  it exactly.
+* :mod:`~tpuparquet.obs.recorder` — the flight recorder: bounded
+  per-thread rings of the last N span/fault/page records
+  (``TPQ_FLIGHT_RECORDER``, default 256; 0 disables).
+* :mod:`~tpuparquet.obs.progress` — live scan progress
+  (units/rows/s/EWMA ETA/stragglers), exported as a JSON status file
+  (``TPQ_PROGRESS_EXPORT``) the ``parquet-tool top`` view tails.
+* :mod:`~tpuparquet.obs.postmortem` — automatic ``.postmortem.json``
+  dumps (trigger coordinates + flight-recorder tail + metrics
+  snapshot) beside the durable cursor when quarantine/salvage/
+  deadline events fire.
+
 Entry points::
 
     with tpuparquet.collect_stats(events=True) as st:
@@ -19,10 +41,16 @@ Entry points::
     st.events.write_jsonl("pages.jsonl")
     obs.write_chrome_trace(st.events, "trace.json")  # Perfetto
 
+    obs.registry().prometheus_text()   # always-on counters, any time
+    obs.flight_recorder().snapshot()   # what just happened, per thread
+
 Everything is zero-cost when no collector is active (the hot paths'
 ``current_stats() is None`` check short-circuits before any event or
 histogram code runs), and event-log-free under a plain
-``collect_stats()`` (``st.events is None``).
+``collect_stats()`` (``st.events is None``).  The always-on layer
+keeps the same discipline: one global ``is None`` check when the
+recorder is off, one ~40-field fold per scope/unit for the registry,
+nothing per value.
 """
 
 from .events import (  # noqa: F401
@@ -32,6 +60,7 @@ from .events import (  # noqa: F401
     counter_counts,
     event_summary,
     fault_counts_by_column,
+    load_jsonl,
     plan_cache_span_counts,
 )
 from .export import (  # noqa: F401
@@ -41,11 +70,33 @@ from .export import (  # noqa: F401
     write_chrome_trace,
 )
 from .histogram import Histogram, N_BUCKETS  # noqa: F401
+from .live import (  # noqa: F401
+    MetricsRegistry,
+    export_now,
+    fold_stats,
+    live_enabled,
+    registry,
+)
+from .postmortem import (  # noqa: F401
+    load_postmortem,
+    postmortem_path_for,
+    record_incident,
+)
+from .progress import ScanProgress, read_progress_file  # noqa: F401
+# the accessor is re-exported as `flight_recorder` so the package
+# attribute `obs.recorder` stays the MODULE, not the function
+from .recorder import FlightRecorder, flight, set_ring  # noqa: F401
+from .recorder import recorder as flight_recorder  # noqa: F401
 
 __all__ = [
     "EventLog", "PageEvent", "TRANSPORT_COUNTER", "counter_counts",
-    "event_summary", "fault_counts_by_column",
+    "event_summary", "fault_counts_by_column", "load_jsonl",
     "plan_cache_span_counts", "chrome_trace",
     "column_table", "format_column_table", "write_chrome_trace",
     "Histogram", "N_BUCKETS",
+    "MetricsRegistry", "registry", "fold_stats", "live_enabled",
+    "export_now",
+    "FlightRecorder", "flight", "flight_recorder", "set_ring",
+    "ScanProgress", "read_progress_file",
+    "record_incident", "postmortem_path_for", "load_postmortem",
 ]

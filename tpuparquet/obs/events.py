@@ -28,7 +28,7 @@ import time
 
 __all__ = ["PageEvent", "EventLog", "TRANSPORT_COUNTER",
            "counter_counts", "event_summary", "fault_counts_by_column",
-           "plan_cache_span_counts"]
+           "plan_cache_span_counts", "load_jsonl"]
 
 # transport label -> the DecodeStats counter that transport increments
 # (transports absent here increment none of the per-transport counters:
@@ -165,7 +165,11 @@ class EventLog:
     def to_jsonl(self) -> str:
         """JSON-lines: one object per record, pages then spans then
         faults, each tagged with ``"kind"`` — greppable, streamable,
-        diffable."""
+        diffable.  Fault records carry their OWN kind (``hedge_won``,
+        ``deadline_exceeded``, ...) which the envelope tag must not
+        clobber: it moves to ``"fault_kind"`` on the wire and
+        :func:`load_jsonl` moves it back, so the round trip is
+        lossless."""
         lines = []
         for e in self.pages:
             d = e.as_dict()
@@ -177,6 +181,8 @@ class EventLog:
             lines.append(json.dumps(d, sort_keys=True))
         for fv in self.faults:
             d = dict(fv)
+            if "kind" in d:
+                d["fault_kind"] = d.pop("kind")
             d["kind"] = "fault"
             lines.append(json.dumps(d, sort_keys=True, default=str))
         return "\n".join(lines) + ("\n" if lines else "")
@@ -187,6 +193,43 @@ class EventLog:
         else:
             with open(path_or_file, "w") as f:
                 f.write(self.to_jsonl())
+
+
+def load_jsonl(path_or_file) -> EventLog:
+    """Rebuild an :class:`EventLog` from a :meth:`EventLog.write_jsonl`
+    dump — the round trip that lets ``parquet-tool profile`` analyze a
+    SAVED ``pages.jsonl`` instead of re-running the decode.  Unknown
+    keys on page records are dropped (a newer writer's extra fields
+    must not break an older analyzer); span/fault records pass through
+    as the dicts they are."""
+    if hasattr(path_or_file, "read"):
+        lines = path_or_file.read().splitlines()
+    else:
+        with open(path_or_file) as f:
+            lines = f.read().splitlines()
+    log = EventLog(t0=0.0)
+    page_keys = set(PageEvent.__slots__)
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        d = json.loads(line)
+        kind = d.pop("kind", None)
+        if kind == "page":
+            log.pages.append(
+                PageEvent(**{k: v for k, v in d.items()
+                             if k in page_keys}))
+        elif kind == "span":
+            log.spans.append(d)
+        elif kind == "fault":
+            if "fault_kind" in d:
+                d["kind"] = d.pop("fault_kind")
+            log.faults.append(d)
+        else:
+            raise ValueError(
+                f"not a tpq event log line (kind={kind!r}): "
+                f"{line[:80]!r}")
+    return log
 
 
 def counter_counts(pages) -> dict:

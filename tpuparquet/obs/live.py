@@ -1,0 +1,441 @@
+"""Always-on live metrics: the process-wide MetricsRegistry.
+
+``DecodeStats`` is *post-hoc* telemetry — it exists only inside a
+``collect_stats()`` scope and is read after the scan finishes.  A
+long-lived serve process needs the other regime: metrics that are
+always there, observable *while* work runs and *after* it died,
+without anyone having opened a scope first (Dapper's lesson: the
+tracing you need most is the tracing that was on before the
+incident).
+
+One process-wide :class:`MetricsRegistry` (:func:`registry`) holds
+
+* **counters** — monotonic floats/ints (``pages``, ``values``,
+  ``hedges_won``, ``plan_s`` ...), fed by exact folds of every
+  outermost ``collect_stats()`` scope (``stats.collect_stats`` calls
+  :func:`fold_stats` on exit) and, incrementally per scan unit, by the
+  scan drivers' own ambient collectors (``shard/scan.py``) — so a scan
+  nobody wrapped in a collector still shows up;
+* **gauges** — last-write-wins instantaneous values (scan progress,
+  ring sizes);
+* **histograms** — the same fixed log2-bucket
+  :class:`~tpuparquet.obs.histogram.Histogram` as ``DecodeStats``,
+  merged bucket-wise.
+
+Exactness discipline matches ``DecodeStats``: writes land on
+**per-thread shards** (no cross-thread ``+=``, no lost increments);
+:meth:`~MetricsRegistry.snapshot` folds the shards with integer adds,
+so the registry total equals the sum of everything folded into it,
+regardless of thread interleaving.  ``to_state``/``from_state``/
+``merge_from`` give the exact cross-host wire form
+(``shard.distributed.allgather_metrics``): merged host registries
+equal the single-host registry of the union corpus, counter for
+counter and bucket for bucket.
+
+Export surfaces:
+
+* :meth:`~MetricsRegistry.prometheus_text` — Prometheus text
+  exposition (counters as ``tpq_<name>_total``, gauges as
+  ``tpq_<name>``, histograms as cumulative ``_bucket{le=...}``
+  series at the log2 boundaries);
+* :meth:`~MetricsRegistry.as_json` — the same snapshot as JSON;
+* an optional background snapshot-writer thread: set
+  ``TPQ_METRICS_EXPORT`` to a path (``.json`` → JSON, else
+  Prometheus text) and snapshots are written atomically every
+  ``TPQ_METRICS_INTERVAL_S`` seconds (default 10) — node-exporter
+  textfile-collector style, no HTTP server to babysit.
+
+``TPQ_LIVE_METRICS=0`` disables the folds (the registry then never
+moves); the fold itself costs one pass over ~40 fields per outermost
+collector scope or scan unit — nothing per page.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from .histogram import Histogram
+
+__all__ = [
+    "MetricsRegistry",
+    "registry",
+    "live_enabled",
+    "fold_stats",
+    "LiveFold",
+    "maybe_start_exporter",
+    "export_now",
+    "reset_registry",
+    "atomic_write_text",
+]
+
+
+def atomic_write_text(path: str, body: str) -> bool:
+    """Best-effort atomic file publish shared by every always-on
+    export surface (metrics snapshots here, progress frames, post-
+    mortems): dot-prefixed ``tmp.<pid>`` in the same directory +
+    ``os.replace``, so readers only ever see complete files.  Returns
+    False (after cleaning the tmp) instead of raising on ``OSError``
+    — telemetry must never fail the work it describes.  The durable
+    cursor checkpoint (``shard.scan.save_cursor_file``) deliberately
+    does NOT use this: it fsyncs and raises, because a checkpoint
+    that silently didn't happen is data loss, not missing telemetry."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    # pid AND thread id in the tmp name: the background exporter and
+    # an on-demand export_now() may write the same path concurrently,
+    # and two writers truncating one shared tmp inode could promote a
+    # torn body through os.replace
+    tmp = os.path.join(
+        d, f".{os.path.basename(path)}.tmp."
+           f"{os.getpid()}.{threading.get_ident()}")
+    try:
+        with open(tmp, "w") as f:
+            f.write(body)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+    return True
+
+
+def live_enabled() -> bool:
+    """Live-metrics master switch (``TPQ_LIVE_METRICS``, default on)."""
+    return os.environ.get("TPQ_LIVE_METRICS", "1") != "0"
+
+
+class _Shard:
+    """One thread's private write surface: plain dict writes, no locks
+    on the hot path (the GIL serializes dict item ops; the snapshot
+    reader tolerates a momentarily-stale view, never a lost add)."""
+
+    __slots__ = ("counters", "hists")
+
+    def __init__(self):
+        self.counters: dict = {}
+        self.hists: dict[str, Histogram] = {}
+
+
+def _fold_shard(dst: _Shard, src: _Shard) -> None:
+    """Exact fold of one shard into another (dead-shard retirement)."""
+    for k, v in src.counters.items():
+        dst.counters[k] = dst.counters.get(k, 0) + v
+    for k, h in src.hists.items():
+        tot = dst.hists.get(k)
+        if tot is None:
+            tot = dst.hists[k] = Histogram()
+        tot.merge_from(h)
+
+
+class MetricsRegistry:
+    """Process-wide counters/gauges/histograms with exact merges.
+
+    Shards live in a :class:`~tpuparquet.obs.recorder.ThreadSlots`
+    (per-thread registration, dead-owner retirement folding into one
+    base shard — exact, counters are cumulative and a dead thread can
+    no longer write), so a serve process running scopes on
+    short-lived threads keeps live-threads + 1 shards, not
+    threads-ever."""
+
+    def __init__(self):
+        from .recorder import ThreadSlots
+
+        self._lock = threading.Lock()  # guards _gauges only
+        self._slots = ThreadSlots(make=_Shard, fold=_fold_shard)
+        self._gauges: dict = {}
+
+    # -- writing ---------------------------------------------------------
+
+    def _shard(self) -> _Shard:
+        return self._slots.get()
+
+    def counter(self, name: str, n=1) -> None:
+        """Add ``n`` (int or float seconds) to a monotonic counter."""
+        c = self._shard().counters
+        c[name] = c.get(name, 0) + n
+
+    def gauge(self, name: str, value) -> None:
+        """Set an instantaneous value (last write wins, process-wide)."""
+        self._gauges[name] = value
+
+    def hist(self, name: str) -> Histogram:
+        """This thread's shard of the named log2 histogram."""
+        h = self._shard().hists.get(name)
+        if h is None:
+            h = self._shard().hists[name] = Histogram()
+        return h
+
+    # -- reading ---------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Exact fold of every thread shard:
+        ``{"counters": {...}, "gauges": {...}, "hists": {name:
+        Histogram.as_dict()}}``.  Monotonic-read consistent: an
+        increment racing the snapshot lands in this snapshot or the
+        next, never nowhere."""
+        counters: dict = {}
+        hists: dict[str, Histogram] = {}
+        shards = self._slots.all()
+        with self._lock:
+            gauges = dict(self._gauges)
+        for s in shards:
+            for k, v in list(s.counters.items()):
+                counters[k] = counters.get(k, 0) + v
+            for k, h in list(s.hists.items()):
+                tot = hists.get(k)
+                if tot is None:
+                    tot = hists[k] = Histogram()
+                tot.merge_from(h)
+        return {
+            "counters": counters,
+            "gauges": gauges,
+            "hists": {k: h.as_dict() for k, h in sorted(hists.items())},
+        }
+
+    # -- exact wire form (cross-host aggregation) ------------------------
+
+    def to_state(self) -> dict:
+        """JSON-serializable exact state (== :meth:`snapshot`)."""
+        return self.snapshot()
+
+    @classmethod
+    def from_state(cls, d: dict) -> "MetricsRegistry":
+        reg = cls()
+        s = reg._shard()
+        s.counters.update(d.get("counters") or {})
+        for k, h in (d.get("hists") or {}).items():
+            s.hists[k] = Histogram.from_dict(h)
+        reg._gauges.update(d.get("gauges") or {})
+        return reg
+
+    def merge_from(self, other: "MetricsRegistry") -> None:
+        """Exact fold of another registry's snapshot into this one
+        (counters sum, histograms bucket-wise; gauges last-write-wins
+        — they are instantaneous, not cumulative)."""
+        snap = other.snapshot()
+        s = self._shard()
+        for k, v in snap["counters"].items():
+            s.counters[k] = s.counters.get(k, 0) + v
+        for k, hd in snap["hists"].items():
+            h = s.hists.get(k)
+            if h is None:
+                h = s.hists[k] = Histogram()
+            h.merge_from(Histogram.from_dict(hd))
+        self._gauges.update(snap["gauges"])
+
+    # -- export surfaces -------------------------------------------------
+
+    def as_json(self) -> str:
+        snap = self.snapshot()
+        snap["ts"] = time.time()
+        return json.dumps(snap, sort_keys=True)
+
+    def prometheus_text(self, prefix: str = "tpq") -> str:
+        """Prometheus text exposition format, parseable by any scraper.
+
+        Counters append ``_total`` (convention); histogram buckets are
+        cumulative at the log2 upper bounds, sparse below the highest
+        non-empty bucket, always closed by ``+Inf``."""
+        from .histogram import bucket_hi
+
+        snap = self.snapshot()
+        lines: list[str] = []
+        for name in sorted(snap["counters"]):
+            v = snap["counters"][name]
+            m = f"{prefix}_{name}_total"
+            lines.append(f"# TYPE {m} counter")
+            lines.append(f"{m} {_fmt_value(v)}")
+        for name in sorted(snap["gauges"]):
+            v = snap["gauges"][name]
+            if not isinstance(v, (int, float)) \
+                    or isinstance(v, bool):
+                continue  # text/labels don't fit the gauge line format
+            m = f"{prefix}_{name}"
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f"{m} {_fmt_value(v)}")
+        for name in sorted(snap["hists"]):
+            d = snap["hists"][name]
+            m = f"{prefix}_{name}"
+            lines.append(f"# TYPE {m} histogram")
+            counts = {int(k): c for k, c in
+                      (d.get("counts") or {}).items()}
+            cum = 0
+            for i in sorted(counts):
+                cum += counts[i]
+                lines.append(
+                    f'{m}_bucket{{le="{bucket_hi(i)}"}} {cum}')
+            # Histogram.record bumps the bucket BEFORE n, so a snapshot
+            # racing a record can carry a bucket sum one ahead of n;
+            # render +Inf/_count from the larger so the exposition
+            # stays monotone (a scraper's histogram_quantile chokes on
+            # a cumulative bucket above +Inf)
+            n = max(cum, d["n"])
+            lines.append(f'{m}_bucket{{le="+Inf"}} {n}')
+            lines.append(f"{m}_sum {d['total']}")
+            lines.append(f"{m}_count {n}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _fmt_value(v) -> str:
+    if isinstance(v, float):
+        return repr(v)
+    return str(v)
+
+
+# ----------------------------------------------------------------------
+# The process registry + DecodeStats folds
+# ----------------------------------------------------------------------
+
+_registry = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide registry (and the exporter trigger: first
+    access after ``TPQ_METRICS_EXPORT`` is set arms the writer)."""
+    maybe_start_exporter()
+    return _registry
+
+
+def reset_registry() -> MetricsRegistry:
+    """Fresh process registry (tests / explicit reset); returns it."""
+    global _registry
+    _registry = MetricsRegistry()
+    return _registry
+
+
+def fold_stats(st, reg: MetricsRegistry | None = None) -> None:
+    """Fold one ``DecodeStats`` collector into a registry, exactly:
+    every ``_MERGE_FIELDS`` counter adds, every histogram merges
+    bucket-wise.  Called by ``collect_stats`` for each OUTERMOST scope
+    on exit (inner scopes shadow the outer and are folded on their own
+    exits, so each count lands exactly once) — the bridge that makes
+    the Prometheus counters equal the sum of every collector that ever
+    ran in this process.  No-op under ``TPQ_LIVE_METRICS=0``."""
+    if not live_enabled():
+        return
+    if reg is None:
+        reg = registry()
+    s = reg._shard()
+    c = s.counters
+    for f in st._MERGE_FIELDS:
+        v = getattr(st, f)
+        if v:
+            c[f] = c.get(f, 0) + v
+    if st.wall_s:
+        c["wall_s"] = c.get("wall_s", 0) + st.wall_s
+    for name, h in st.hists.items():
+        tot = s.hists.get(name)
+        if tot is None:
+            tot = s.hists[name] = Histogram()
+        tot.merge_from(h)
+
+
+class LiveFold:
+    """Incremental fold of a LONG-LIVED collector into the registry.
+
+    The scan drivers meter their units into one scan-lifetime
+    ``DecodeStats`` (stable identity — the pipelined reader captures
+    its collector once); folding that collector whole at scan end
+    would leave the registry flat for the whole scan.  ``fold(st)``
+    instead folds the delta since the previous fold — called at each
+    unit boundary, so a Prometheus scrape mid-scan sees the units
+    decoded so far.  Exact: baselines are remembered per counter and
+    per histogram bucket, so sum(deltas) == final totals."""
+
+    def __init__(self):
+        self._base: dict = {}
+        self._hist_base: dict[str, list[int]] = {}
+
+    def fold(self, st, reg: MetricsRegistry | None = None) -> None:
+        if not live_enabled():
+            return
+        if reg is None:
+            reg = registry()
+        s = reg._shard()
+        c = s.counters
+        for f in st._MERGE_FIELDS:
+            v = getattr(st, f)
+            d = v - self._base.get(f, 0)
+            if d:
+                c[f] = c.get(f, 0) + d
+                self._base[f] = v
+        for name, h in st.hists.items():
+            base = self._hist_base.get(name)
+            if base is None:
+                base = self._hist_base[name] = [0] * len(h.counts)
+            tot = s.hists.get(name)
+            if tot is None:
+                tot = s.hists[name] = Histogram()
+            for i, n in enumerate(h.counts):
+                d = n - base[i]
+                if d:
+                    tot.counts[i] += d
+                    tot.n += d
+                    base[i] = n
+            # total tracks the value sum, not the sample count: fold
+            # its delta separately so hist sums stay exact too
+            dt = h.total - self._base.get(("hist_total", name), 0)
+            if dt:
+                tot.total += dt
+                self._base[("hist_total", name)] = h.total
+
+
+# ----------------------------------------------------------------------
+# Background snapshot writer (TPQ_METRICS_EXPORT)
+# ----------------------------------------------------------------------
+
+_exporter_lock = threading.Lock()
+_exporter: threading.Thread | None = None
+
+
+def _metrics_interval() -> float:
+    try:
+        v = float(os.environ.get("TPQ_METRICS_INTERVAL_S", ""))
+    except ValueError:
+        return 10.0
+    return max(v, 0.05)
+
+
+def export_now(path: str | None = None) -> str | None:
+    """Write one snapshot atomically (tmp + ``os.replace``); returns
+    the path written or None when no path is configured.  ``.json``
+    suffix → JSON, anything else → Prometheus text."""
+    if path is None:
+        path = os.environ.get("TPQ_METRICS_EXPORT") or None
+    if not path:
+        return None
+    body = (_registry.as_json() if path.endswith(".json")
+            else _registry.prometheus_text())
+    return path if atomic_write_text(path, body) else None
+
+
+def maybe_start_exporter() -> None:
+    """Arm the background snapshot-writer daemon if
+    ``TPQ_METRICS_EXPORT`` is set and it isn't running (restart-safe
+    across fork — threads do not survive one)."""
+    if not os.environ.get("TPQ_METRICS_EXPORT"):
+        return
+    global _exporter
+    t = _exporter
+    if t is not None and t.is_alive():
+        return
+    with _exporter_lock:
+        t = _exporter
+        if t is not None and t.is_alive():
+            return
+
+        def run():
+            while True:
+                time.sleep(_metrics_interval())
+                if not os.environ.get("TPQ_METRICS_EXPORT"):
+                    return  # unset: stand down (tests flip this)
+                export_now()
+
+        t = threading.Thread(target=run, daemon=True,
+                             name="tpq-metrics-export")
+        t.start()
+        _exporter = t

@@ -1,0 +1,148 @@
+"""Automatic post-mortems: dump the evidence when something dies.
+
+When a quarantine, salvage, or deadline event fires, the scan drivers
+call :func:`record_incident` and a ``.postmortem.json`` lands beside
+the durable cursor checkpoint (or under ``TPQ_POSTMORTEM_DIR`` for
+checkpoint-less scans).  Each incident carries everything an operator
+needs to start the investigation without reproducing the failure:
+
+* the **trigger** — kind, site, and the exact
+  file/row-group/column/page coordinates plus error class/message the
+  quarantine entry recorded;
+* the trailing **flight-recorder ring**
+  (:mod:`~tpuparquet.obs.recorder`) — what every thread was doing in
+  the moments before;
+* a **metrics snapshot** of the live registry
+  (:mod:`~tpuparquet.obs.live`) — cumulative counters at incident
+  time;
+* process identity and wall-clock timestamps.
+
+File format (spec — the README documents this verbatim)::
+
+    {
+      "format": "tpq-postmortem",
+      "version": 1,
+      "incidents": [                     // oldest first, capped
+        {
+          "t": 1700000000.123,           // unix seconds
+          "iso": "2023-11-14T22:13:20Z",
+          "pid": 4242,
+          "trigger": {"kind": "quarantined",
+                      "site": "shard.scan.unit",
+                      "unit": 3, "file": 1, "row_group": 0,
+                      "column": "fare", "page": 2,
+                      "error": "CorruptPageError",
+                      "message": "..."},
+          "recorder": [ {"t": ..., "kind": ..., "site": ..., ...} ],
+          "metrics": {"counters": {...}, "gauges": {...},
+                      "hists": {...}},
+          "stats": {...} | null      // in-flight DecodeStats.to_state()
+        }
+      ]
+    }
+
+Writes are read-modify-write with the atomic tmp + ``os.replace``
+discipline of the checkpoint layer, capped at :data:`INCIDENT_CAP`
+incidents (oldest dropped) so a pathological corpus cannot grow the
+file without bound.  Post-mortems are best-effort telemetry: an
+``OSError`` writing one is swallowed — the quarantine/deadline event
+it describes already handled the failure.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["record_incident", "postmortem_path_for", "load_postmortem",
+           "INCIDENT_CAP", "POSTMORTEM_SUFFIX"]
+
+POSTMORTEM_SUFFIX = ".postmortem.json"
+INCIDENT_CAP = 16
+
+# serializes the load-append-write below: two scans in one process can
+# share a post-mortem file (TPQ_POSTMORTEM_DIR keys on pid alone), and
+# an unlocked read-modify-write would silently drop the loser's
+# incident even with atomic replaces
+_write_lock = threading.Lock()
+
+#: recorder records attached per incident (the trailing window)
+_RECORDER_TAIL = 128
+
+
+def postmortem_path_for(checkpoint_path: str | None) -> str | None:
+    """Resolve where a scan's post-mortems go: beside the durable
+    cursor checkpoint when one is configured, else under
+    ``TPQ_POSTMORTEM_DIR`` (one file per process), else nowhere
+    (None — post-mortems off)."""
+    if checkpoint_path:
+        return checkpoint_path + POSTMORTEM_SUFFIX
+    d = os.environ.get("TPQ_POSTMORTEM_DIR")
+    if d:
+        return os.path.join(d, f"scan-{os.getpid()}{POSTMORTEM_SUFFIX}")
+    return None
+
+
+def record_incident(path: str | None, trigger: dict) -> str | None:
+    """Append one incident to the post-mortem file at ``path``
+    (no-op returning None when ``path`` is None).  Returns the path
+    on success; swallows ``OSError`` (best-effort — see module
+    docstring)."""
+    if not path:
+        return None
+    from ..stats import current_stats
+    from .live import registry
+    from .recorder import recorder
+
+    rec = recorder()
+    now = time.time()
+    st = current_stats()
+    incident = {
+        "t": now,
+        "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(now)),
+        "pid": os.getpid(),
+        "trigger": _jsonable(trigger),
+        "recorder": ([] if rec is None
+                     else rec.snapshot(last=_RECORDER_TAIL)),
+        "metrics": registry().snapshot(),
+        # the in-flight collector (scan-ambient or user scope): exact
+        # counters AT incident time, ahead of the unit-boundary fold
+        "stats": None if st is None else st.to_state(),
+    }
+    from .live import atomic_write_text
+
+    with _write_lock:
+        try:
+            doc = load_postmortem(path)
+        except (OSError, ValueError):
+            doc = {"format": "tpq-postmortem", "version": 1,
+                   "incidents": []}
+        doc["incidents"].append(incident)
+        del doc["incidents"][:-INCIDENT_CAP]
+        body = json.dumps(doc, sort_keys=True, default=str)
+        return path if atomic_write_text(path, body) else None
+
+
+def load_postmortem(path: str) -> dict:
+    """Read back a post-mortem file, validating the envelope."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) \
+            or doc.get("format") != "tpq-postmortem" \
+            or not isinstance(doc.get("incidents"), list):
+        raise ValueError(f"{path!r} is not a tpq post-mortem file")
+    return doc
+
+
+def _jsonable(d: dict) -> dict:
+    """Coerce a trigger dict to JSON-safe values (error objects and
+    exotic coordinates stringify rather than fail the dump)."""
+    out = {}
+    for k, v in d.items():
+        if isinstance(v, (str, int, float, bool)) or v is None:
+            out[k] = v
+        else:
+            out[k] = str(v)
+    return out

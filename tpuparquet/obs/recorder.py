@@ -1,0 +1,187 @@
+"""Flight recorder: the last N telemetry events, always.
+
+The event log (``obs/events.py``) is rich but scoped — it exists only
+under ``collect_stats(events=True)`` and grows without bound.  The
+flight recorder is its always-on complement: a **bounded ring buffer
+per thread** that keeps the most recent span/fault/page records at
+near-zero cost, independent of any collector scope, so that when a
+scan dies the post-mortem (:mod:`~tpuparquet.obs.postmortem`) can say
+what the process was doing in the seconds before — the Dapper
+discipline of having the trace on *before* the incident.
+
+Cost model: one module-global load + ``is None`` check when disabled
+(the same shape as ``faults.fault_point``); when enabled, one bounded
+``deque.append`` of a small dict per record.  Recording sites are
+chunk/page/span/fault granularity — never per value — and the rings
+are ``TPQ_FLIGHT_RECORDER`` entries deep per thread (default 256;
+``0`` disables recording entirely).
+
+Thread model matches the rest of the telemetry layer: each thread
+appends to its OWN ring (registered with the recorder under a lock at
+first use); :meth:`FlightRecorder.snapshot` folds the rings into one
+time-sorted list.  No cross-thread appends, no locks on the record
+path.
+
+Record shape: ``{"t": unix_time, "kind": ..., "site": ...,
+**coordinates}`` — the same site/kind vocabulary as the event log's
+fault records, so a post-mortem reads like a ``pages.jsonl`` tail.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+__all__ = ["FlightRecorder", "ThreadSlots", "flight", "recorder",
+           "set_ring", "ring_default"]
+
+
+class ThreadSlots:
+    """Per-thread write slots with dead-owner retirement — the shared
+    registration machinery under the flight recorder's rings and the
+    metrics registry's shards (one owner so the retirement logic
+    can't drift between them).
+
+    Each thread lazily gets its own slot (``make()``) registered
+    under a lock; when a NEW thread registers, slots whose owner
+    thread has exited are folded into one retained ``retired`` slot
+    (``fold(retired, dead_slot)`` — exact, the dead owner can no
+    longer write) and dropped.  Total slots stay bounded by live
+    threads + 1 under arbitrary thread churn (the deadline/hedge
+    layers spawn a disposable worker per bounded unit/read)."""
+
+    def __init__(self, make, fold):
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._slots: list[tuple] = []   # [(owner_thread, slot)]
+        self._make = make
+        self._fold = fold
+        self.retired = make()
+
+    def get(self):
+        """The calling thread's slot (created + registered on first
+        use)."""
+        s = getattr(self._tls, "slot", None)
+        if s is None:
+            s = self._make()
+            me = threading.current_thread()
+            with self._lock:
+                self._retire_dead_locked()
+                self._slots.append((me, s))
+            self._tls.slot = s
+        return s
+
+    def _retire_dead_locked(self) -> None:
+        live = []
+        for owner, s in self._slots:
+            if owner.is_alive():
+                live.append((owner, s))
+            else:
+                self._fold(self.retired, s)
+        if len(live) != len(self._slots):
+            self._slots = live
+
+    def all(self) -> list:
+        """Every live slot plus the retired fold (snapshot reads)."""
+        with self._lock:
+            return [s for _, s in self._slots] + [self.retired]
+
+
+def ring_default() -> int:
+    """Per-thread ring depth from ``TPQ_FLIGHT_RECORDER`` (default
+    256; 0/invalid-negative disables)."""
+    try:
+        v = int(os.environ.get("TPQ_FLIGHT_RECORDER", "256"))
+    except ValueError:
+        return 256
+    return max(v, 0)
+
+
+class FlightRecorder:
+    """Per-thread bounded rings of recent telemetry records.
+
+    Rings live in a :class:`ThreadSlots` (per-thread registration,
+    dead-owner retirement), so memory stays bounded under thread
+    churn; a dead worker's trailing records survive in the retired
+    ring — an abandoned hedge worker's last reads are exactly the
+    records a post-mortem wants."""
+
+    def __init__(self, ring: int = 256):
+        self.ring = ring
+        self._slots = ThreadSlots(
+            make=lambda: deque(maxlen=ring),
+            fold=lambda retired, dead: retired.extend(dead))
+
+    def record(self, kind: str, site: str | None = None, **fields):
+        rec = {"t": time.time(), "kind": kind}
+        if site is not None:
+            rec["site"] = site
+        if fields:
+            rec.update(fields)
+        self._slots.get().append(rec)
+
+    def snapshot(self, last: int | None = None) -> list[dict]:
+        """All rings (live + retired) folded into one time-sorted
+        list (oldest first); ``last`` trims to the trailing N
+        records.  Safe against concurrent appends (each ring is
+        copied under the GIL)."""
+        out: list[dict] = []
+        for r in self._slots.all():
+            out.extend(list(r))
+        out.sort(key=lambda e: e["t"])
+        if last is not None and len(out) > last:
+            out = out[-last:]
+        return out
+
+    def clear(self) -> None:
+        for r in self._slots.all():
+            r.clear()
+
+    def __len__(self) -> int:
+        return len(self.snapshot())
+
+
+#: The active recorder, or None when disabled — the single gate every
+#: hot-path hook checks (one global load + `is None`, exactly the
+#: fault_point discipline).  Initialized from the environment at
+#: import; reconfigure at runtime with :func:`set_ring`.
+_active: FlightRecorder | None = None
+
+
+def _init_from_env() -> None:
+    global _active
+    n = ring_default()
+    _active = FlightRecorder(n) if n > 0 else None
+
+
+_init_from_env()
+
+
+def recorder() -> FlightRecorder | None:
+    """The active recorder (None when disabled)."""
+    return _active
+
+
+def set_ring(n: int) -> FlightRecorder | None:
+    """Reconfigure at runtime: ``n > 0`` installs a FRESH recorder
+    with that ring depth, ``0`` disables.  Returns the new recorder
+    (tests and A/B benches flip this without re-importing)."""
+    global _active
+    _active = FlightRecorder(n) if n > 0 else None
+    return _active
+
+
+def flight(kind: str, site: str | None = None, **fields) -> None:
+    """Instrumentation hook: record onto the calling thread's ring.
+    No-op (one global ``is None`` check) when the recorder is off.
+
+    Hot per-page/per-chunk sites guard the CALL itself with
+    ``recorder._active is not None`` so the disabled path skips even
+    the kwargs construction and argument evaluation — the same shape
+    as the ``st is not None`` stats discipline.  Cold sites (faults,
+    quarantines, retries) just call ``flight`` directly."""
+    rec = _active
+    if rec is not None:
+        rec.record(kind, site, **fields)

@@ -24,6 +24,7 @@ __all__ = [
     "allgather_host",
     "allgather_bytes",
     "allgather_stats",
+    "allgather_metrics",
 ]
 
 from .scan import DurableScanMixin as _DurableScanMixin  # noqa: E402
@@ -134,6 +135,35 @@ def allgather_stats(st) -> "DecodeStats":
     return total
 
 
+def allgather_metrics(reg=None) -> "MetricsRegistry":
+    """Fold every host's live metrics registry
+    (:mod:`tpuparquet.obs.live`) into one fleet-wide registry,
+    identical on every process — the always-on counterpart of
+    :func:`allgather_stats`, same wire (exact JSON state over
+    :func:`allgather_bytes`), same exactness: fleet counters are the
+    elementwise sums and fleet histograms the exact bucket-wise sums
+    of the per-host registries, so the merged snapshot equals the
+    single-host snapshot of the union corpus.  Gauges are
+    instantaneous, not cumulative — each host's land under a
+    ``p<idx>_`` prefix instead of being summed.  ``reg`` defaults to
+    this process's registry."""
+    import json as _json
+
+    from ..obs.live import MetricsRegistry, registry
+
+    if reg is None:
+        reg = registry()
+    payloads = allgather_bytes(_json.dumps(reg.to_state()).encode())
+    total = MetricsRegistry()
+    for i, p in enumerate(payloads):
+        state = _json.loads(p)
+        gauges = state.pop("gauges", {}) or {}
+        total.merge_from(MetricsRegistry.from_state(state))
+        for k, v in gauges.items():
+            total.gauge(f"p{i}_{k}", v)
+    return total
+
+
 class MultiHostScan(_DurableScanMixin):
     """Decode many files across processes *and* local devices.
 
@@ -183,8 +213,11 @@ class MultiHostScan(_DurableScanMixin):
                  hedge_delay: float | None = None,
                  read_deadline: float | None = None,
                  resume_from: str | None = None,
-                 checkpoint_every: int | None = None):
+                 checkpoint_every: int | None = None,
+                 progress_export: str | None = None,
+                 postmortem=None):
         from ..faults import QuarantineReport
+        from ..obs.progress import progress_export_default
         from .mesh import make_mesh
         from .scan import (
             host_cursor_path,
@@ -203,7 +236,8 @@ class MultiHostScan(_DurableScanMixin):
             scan_deadline=scan_deadline, resume=resume,
             resume_from=resume_from, checkpoint_every=checkpoint_every,
             checkpoint_path=(None if resume_from is None
-                             else host_cursor_path(resume_from, p0)))
+                             else host_cursor_path(resume_from, p0)),
+            postmortem=postmortem)
         # every process opens every source (salvage is deterministic,
         # so all hosts derive the identical reader/unit list), but a
         # failed/salvaged FILE is recorded by exactly one process
@@ -217,9 +251,22 @@ class MultiHostScan(_DurableScanMixin):
             strict_metadata=strict_metadata,
             record_for=lambda i: i % n == p,
             entry_extra={"process_index": p},
-            hedge_delay=hedge_delay, read_deadline=read_deadline)
+            hedge_delay=hedge_delay, read_deadline=read_deadline,
+            postmortem=self._postmortem_path)
         self.global_units = scan_units(self.readers)
         self.local_units = process_units(self.global_units)
+        # per-host status file (base.p<idx>, like the checkpoints) so
+        # hosts never race on one progress file; parquet-tool top takes
+        # several paths and renders the fleet side by side.  The path
+        # is fully resolved HERE ("" when disabled — never None, which
+        # _init_telemetry would re-default from the env without the
+        # per-host suffix)
+        pe = (progress_export if progress_export is not None
+              else progress_export_default())
+        self._init_telemetry(
+            len(self.local_units),
+            (f"{pe}.p{p0}" if pe and n > 1 else pe) or "",
+            f"scan.p{p0}")
         # make_mesh defaults to LOCAL devices (see its docstring; the
         # 2-process integration test caught the global-devices variant)
         self.mesh = mesh if mesh is not None else make_mesh()
@@ -303,44 +350,37 @@ class MultiHostScan(_DurableScanMixin):
     def _progress(self):
         return self._next_local, len(self.local_units)
 
+    def _advance(self, k: int) -> None:
+        self._next_local = k + 1
+
+    def _unit_coords(self, k: int) -> tuple[int, int]:
+        return self.local_units[k]
+
     def run_iter(self):
         """Yield ``(local_index, {path: DeviceColumn})`` from the cursor
         position, advancing it after each unit.  Quarantine mode skips
         (and records) failing units, like ``ShardedScan.run_iter``;
-        the durable per-host checkpoint and the scan budget apply
-        exactly as there."""
-        from .scan import pipelined_unit_scan
+        the durable per-host checkpoint, the scan budget, and the live
+        telemetry (per-host :attr:`progress` status file, ambient
+        metrics, automatic post-mortems) apply exactly as there."""
+        from .scan import pipelined_unit_scan, resilient_unit_scan
 
         self._run_t0 = time.monotonic()
-        self._check_scan_deadline()
         if self.on_error == "raise":
-            for k, out in pipelined_unit_scan(
+            gen = pipelined_unit_scan(
                 self.readers, self.local_units,
                 lambda i: self.devices[i % len(self.devices)],
-                start=self._next_local,
-            ):
-                self._next_local = k + 1
-                yield k, out
-                self._maybe_checkpoint()
-                self._check_scan_deadline()
-            self._flush_checkpoint()
-            return
-        from .scan import resilient_unit_scan
-
-        for k, out in resilient_unit_scan(
-            self.readers, self.local_units,
-            lambda i: self.devices[i % len(self.devices)],
-            start=self._next_local, retries=self.retries,
-            quarantine=self.quarantine,
-            entry_extra={"process_index": jax.process_index()},
-            unit_deadline=self.unit_deadline,
-        ):
-            self._next_local = k + 1
-            if out is not None:
-                yield k, out
-            self._maybe_checkpoint()
-            self._check_scan_deadline()
-        self._flush_checkpoint()
+                start=self._next_local)
+        else:
+            gen = resilient_unit_scan(
+                self.readers, self.local_units,
+                lambda i: self.devices[i % len(self.devices)],
+                start=self._next_local, retries=self.retries,
+                quarantine=self.quarantine,
+                entry_extra={"process_index": jax.process_index()},
+                unit_deadline=self.unit_deadline,
+                postmortem=self._postmortem_path)
+        yield from self._drive(gen)
 
     def allgather_quarantine(self) -> list[dict]:
         """Every host's quarantine entries, identical on every process

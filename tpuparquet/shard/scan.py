@@ -10,6 +10,7 @@ all-gather of the decoded column shards.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import time
@@ -27,6 +28,8 @@ from ..errors import (
 )
 from ..faults import QuarantineReport
 from ..io.reader import FileReader
+from ..obs.postmortem import postmortem_path_for, record_incident
+from ..obs.recorder import flight
 from ..kernels.decode import scatter_to_dense
 from ..kernels.device import (
     DeviceColumn,
@@ -70,7 +73,8 @@ def open_sources(sources, columns, *, on_error: str,
                  record_for=None,
                  entry_extra: dict | None = None,
                  hedge_delay: float | None = None,
-                 read_deadline: float | None = None) -> list:
+                 read_deadline: float | None = None,
+                 postmortem: str | None = None) -> list:
     """Open scan sources with the file-level fault policy.
 
     Returns a reader list aligned with ``sources`` (``None`` where the
@@ -94,6 +98,12 @@ def open_sources(sources, columns, *, on_error: str,
     tail-at-scale path in ``deadline.py``); only if every replica
     fails to open is the file quarantined/salvaged.
 
+    ``postmortem`` (a path or None) receives an automatic
+    ``.postmortem.json`` incident for every file this call salvages or
+    quarantines (:mod:`tpuparquet.obs.postmortem`), gated by the same
+    ``record_for`` policy as the counters so a fleet writes each file's
+    incident once.
+
     Raw crash types propagate — same contract as the unit loop.
     """
     from ..stats import current_stats
@@ -112,8 +122,6 @@ def open_sources(sources, columns, *, on_error: str,
 
     def _record(i):
         return record_for is None or record_for(i)
-
-    import contextlib
 
     @contextlib.contextmanager
     def _counters_only_if_recorded(i):
@@ -210,6 +218,9 @@ def open_sources(sources, columns, *, on_error: str,
                                                 **extra)
                     if entry_extra:
                         entry.update(entry_extra)
+                    record_incident(postmortem, {
+                        "kind": "file_salvaged",
+                        "site": "shard.scan.file", **entry})
                 continue
         if not _record(i):
             continue
@@ -219,6 +230,10 @@ def open_sources(sources, columns, *, on_error: str,
         entry = quarantine.add_file(file=i, error=err, **extra)
         if entry_extra:
             entry.update(entry_extra)
+        flight("file_quarantined", site="shard.scan.file", **entry)
+        record_incident(postmortem, {
+            "kind": "file_quarantined", "site": "shard.scan.file",
+            **entry})
         st = current_stats()
         if st is not None:
             st.files_quarantined += 1
@@ -376,7 +391,8 @@ def pipelined_unit_scan(readers, units, device_for=None, start: int = 0):
 def resilient_unit_scan(readers, units, device_for, *, start: int = 0,
                         retries=None, quarantine: QuarantineReport,
                         entry_extra: dict | None = None,
-                        unit_deadline: float | None = None):
+                        unit_deadline: float | None = None,
+                        postmortem: str | None = None):
     """The quarantine-mode unit loop shared by :class:`ShardedScan`
     and :class:`MultiHostScan`: decode each unit with the full
     resilience policy (transient-I/O retry, dispatch retry, CPU
@@ -433,6 +449,13 @@ def resilient_unit_scan(readers, units, device_for, *, start: int = 0,
                              None)
             if cached is not None:
                 invalidate_fingerprint(cached())
+            # automatic post-mortem: the trigger's exact coordinates
+            # plus the flight-recorder tail and a metrics snapshot,
+            # dumped beside the durable cursor (obs/postmortem.py)
+            flight("quarantined", site="shard.scan.unit", **entry)
+            record_incident(postmortem, {
+                "kind": "quarantined", "site": "shard.scan.unit",
+                **entry})
             st = current_stats()
             if st is not None:
                 st.units_quarantined += 1
@@ -445,24 +468,37 @@ def resilient_unit_scan(readers, units, device_for, *, start: int = 0,
 
 
 class DurableScanMixin:
-    """Durable-checkpoint + scan-budget plumbing shared by
-    :class:`ShardedScan` and
+    """Durable-checkpoint + scan-budget + live-telemetry plumbing
+    shared by :class:`ShardedScan` and
     :class:`~tpuparquet.shard.distributed.MultiHostScan` (so cadence
     and expiry semantics cannot drift between them).  Hosts provide
     ``state()``, ``_checkpoint_path``/``_checkpoint_every``/
-    ``_since_checkpoint``, ``scan_deadline``/``_run_t0``, and
-    :meth:`_progress`."""
+    ``_since_checkpoint``, ``scan_deadline``/``_run_t0``,
+    :meth:`_progress`, :meth:`_advance`, and :meth:`_unit_coords`."""
 
     def _progress(self) -> tuple[int, int]:
         raise NotImplementedError
 
+    def _advance(self, k: int) -> None:
+        """Move the cursor past unit ``k``."""
+        raise NotImplementedError
+
+    def _unit_coords(self, k: int) -> tuple[int, int]:
+        """``(file_index, row_group_index)`` of this driver's unit k."""
+        raise NotImplementedError
+
     def _init_durable(self, *, on_error, unit_deadline, scan_deadline,
                       resume, resume_from, checkpoint_every,
-                      checkpoint_path) -> None:
+                      checkpoint_path, postmortem=None) -> None:
         """Validate and resolve the shared time/checkpoint knobs (one
         owner for both drivers; ``checkpoint_path`` is the resolved
         per-driver file — per-host for the multi-host scan).  Call
-        BEFORE opening sources: a bad knob must fail cheap."""
+        BEFORE opening sources: a bad knob must fail cheap.
+
+        ``postmortem``: where automatic incident dumps go — a path to
+        set it explicitly, ``False`` to disable, None to derive
+        (beside the checkpoint, else ``TPQ_POSTMORTEM_DIR``, else
+        off) — see :func:`tpuparquet.obs.postmortem.postmortem_path_for`."""
         from ..deadline import scan_deadline_default, unit_deadline_default
 
         if unit_deadline is not None and on_error != "quarantine":
@@ -484,6 +520,131 @@ class DurableScanMixin:
                                   else checkpoint_every_default())
         self._since_checkpoint = 0
         self._run_t0 = None
+        self._postmortem_path = (
+            postmortem if isinstance(postmortem, str)
+            else None if postmortem is False
+            else postmortem_path_for(checkpoint_path))
+
+    # -- live telemetry (obs/: progress, registry, flight recorder) ------
+
+    def _init_telemetry(self, n_units: int,
+                        progress_export: str | None,
+                        label: str) -> None:
+        """Arm the always-on surfaces: the :class:`~tpuparquet.obs.
+        progress.ScanProgress` (exported to ``progress_export`` /
+        ``TPQ_PROGRESS_EXPORT`` for ``parquet-tool top``) and, when
+        live metrics are enabled, a scan-lifetime ambient collector
+        that meters units nobody wrapped in ``collect_stats()`` into
+        the process metrics registry.  Call AFTER the unit list
+        exists."""
+        from ..obs.live import LiveFold, live_enabled
+        from ..obs.progress import (
+            ScanProgress,
+            label_slug,
+            progress_export_default,
+        )
+        from ..stats import DecodeStats
+
+        if progress_export is not None:
+            path = progress_export
+        else:
+            path = progress_export_default()
+            if path and label != "scan":
+                # the env default names ONE file: concurrent scans
+                # with distinct labels get their own (same shape as
+                # the multi-host .p<idx> suffix), so two scans never
+                # interleave frames in one status file
+                path = f"{path}.{label_slug(label)}"
+        self.progress = ScanProgress(n_units, label=label,
+                                     export=path or None)
+        self._live_stats = DecodeStats() if live_enabled() else None
+        self._live_fold = LiveFold()
+
+    def _adopted(self):
+        """Context installing the scan's ambient collector for one
+        bounded step — ONLY when the caller has no collector of their
+        own (a user's ``collect_stats`` always wins, and its scope
+        exit folds to the registry instead)."""
+        from ..stats import adopt_stats, current_stats
+
+        if self._live_stats is not None and current_stats() is None:
+            return adopt_stats(self._live_stats)
+        return contextlib.nullcontext()
+
+    def _fold_live(self) -> None:
+        """Incrementally fold the ambient collector's delta into the
+        process registry (unit-boundary cadence: a Prometheus scrape
+        mid-scan sees the units decoded so far)."""
+        if self._live_stats is not None:
+            self._live_fold.fold(self._live_stats)
+
+    def _drive(self, gen):
+        """The shared unit loop around an inner unit generator
+        (pipelined or resilient): progress ticks, ambient metering,
+        registry folds, then the checkpoint/deadline bookkeeping —
+        one owner for both drivers.  Yields ``(k, out)`` for units
+        that decoded; quarantine-mode ``None`` results tick progress
+        but are not yielded (the existing contract)."""
+        from ..stats import current_stats
+
+        prog = self.progress
+        nxt0, _ = self._progress()
+        if prog.units_done != nxt0 or prog.state != "pending":
+            # a fresh drive of an already-used progress: run() after a
+            # partial run_iter (cursor reset to 0), a cursor resume
+            # (resumed units count as already done), or CONTINUING a
+            # stopped run_iter — all restart the clock and tallies, so
+            # elapsed/rows_per_s describe this run, not the idle gap
+            prog.restart(done=nxt0)
+        prog.begin()
+        try:
+            with self._adopted():
+                self._check_scan_deadline()
+            while True:
+                nxt, _ = self._progress()
+                prog.unit_started(nxt)
+                try:
+                    with self._adopted():
+                        k, out = next(gen)
+                except StopIteration:
+                    prog.unit_cancelled(nxt)
+                    break
+                self._advance(k)
+                fi, rgi = self._unit_coords(k)
+                rows = (self.readers[fi].meta.row_groups[rgi].num_rows
+                        if out is not None else 0)
+                # staged bytes come from whichever collector actually
+                # metered this unit: the caller's (a user collect_stats
+                # scope shadows the ambient collector) or the ambient
+                # one — else `top` would show staged 0 exactly on the
+                # post-hoc-regime path
+                st = current_stats() or self._live_stats
+                prog.unit_done(
+                    k, rows=rows, quarantined=out is None,
+                    bytes_staged=(st.bytes_staged
+                                  if st is not None else None))
+                flight("unit_done" if out is not None
+                       else "unit_quarantined",
+                       site="shard.scan", unit=k, file=fi,
+                       row_group=rgi, rows=rows)
+                self._fold_live()
+                if out is not None:
+                    yield k, out
+                with self._adopted():
+                    self._maybe_checkpoint()
+                    self._check_scan_deadline()
+        except GeneratorExit:
+            prog.finish("stopped")
+            self._fold_live()
+            raise
+        except BaseException:
+            prog.finish("error")
+            self._fold_live()
+            raise
+        with self._adopted():
+            self._flush_checkpoint()
+        self._fold_live()
+        prog.finish("done")
 
     def cursor_save(self, path: str | None = None) -> None:
         """Durably checkpoint :meth:`state` (atomic tmp + fsync +
@@ -529,6 +690,11 @@ class DurableScanMixin:
         done, total = self._progress()
         record_expiry(current_stats(), "shard.scan", elapsed,
                       self.scan_deadline, {"next_unit": done})
+        record_incident(self._postmortem_path, {
+            "kind": "scan_deadline", "site": "shard.scan",
+            "elapsed_s": round(elapsed, 3),
+            "budget_s": self.scan_deadline, "next_unit": done,
+            "units_total": total})
         self._flush_checkpoint()
         raise DeadlineExceededError(
             f"scan exceeded its {self.scan_deadline:g}s budget at "
@@ -616,7 +782,10 @@ class ShardedScan(DurableScanMixin):
                  hedge_delay: float | None = None,
                  read_deadline: float | None = None,
                  resume_from: str | None = None,
-                 checkpoint_every: int | None = None):
+                 checkpoint_every: int | None = None,
+                 progress_export: str | None = None,
+                 progress_label: str = "scan",
+                 postmortem=None):
         from .mesh import make_mesh
 
         if on_error not in ("raise", "quarantine"):
@@ -627,7 +796,7 @@ class ShardedScan(DurableScanMixin):
             on_error=on_error, unit_deadline=unit_deadline,
             scan_deadline=scan_deadline, resume=resume,
             resume_from=resume_from, checkpoint_every=checkpoint_every,
-            checkpoint_path=resume_from)
+            checkpoint_path=resume_from, postmortem=postmortem)
         self.mesh = mesh if mesh is not None else make_mesh()
         # file-level entries recorded at open time live in their own
         # report so run() can reset the unit-level entries without
@@ -637,8 +806,14 @@ class ShardedScan(DurableScanMixin):
             sources, columns, on_error=on_error,
             quarantine=self._open_quarantine, salvage=salvage,
             strict_metadata=strict_metadata, hedge_delay=hedge_delay,
-            read_deadline=read_deadline)
+            read_deadline=read_deadline,
+            postmortem=self._postmortem_path)
         self.units = scan_units(self.readers)
+        # progress_label keys this scan's registry gauges (see
+        # obs/progress.py): concurrent scans in one serve process pass
+        # distinct labels so their gauges don't clobber each other
+        self._init_telemetry(len(self.units), progress_export,
+                             progress_label)
         self.devices = list(self.mesh.devices.flat)
         self.on_error = on_error
         self.retries = retries
@@ -677,6 +852,12 @@ class ShardedScan(DurableScanMixin):
     def _progress(self) -> tuple[int, int]:
         return self._next_unit, len(self.units)
 
+    def _advance(self, k: int) -> None:
+        self._next_unit = k + 1
+
+    def _unit_coords(self, k: int) -> tuple[int, int]:
+        return self.units[k]
+
     def run_iter(self):
         """Yield ``(unit_index, {path: DeviceColumn})`` from the cursor
         position, advancing it after each unit.  In quarantine mode,
@@ -688,32 +869,27 @@ class ShardedScan(DurableScanMixin):
         scan end); with ``scan_deadline`` set the scan stops between
         units once the budget is spent, raising
         :class:`~tpuparquet.errors.DeadlineExceededError` with the
-        cursor intact."""
+        cursor intact.
+
+        Live telemetry (this round): :attr:`progress` ticks at every
+        unit boundary (``parquet-tool top`` watches the exported
+        status file), units decode under the scan's ambient collector
+        when the caller has none (so the always-on metrics registry
+        moves mid-scan), and quarantine/deadline events dump automatic
+        post-mortems beside the durable cursor."""
         self._run_t0 = time.monotonic()
-        self._check_scan_deadline()
         if self.on_error == "raise":
-            for k, out in pipelined_unit_scan(
+            gen = pipelined_unit_scan(
                 self.readers, self.units, self.device_for,
-                start=self._next_unit,
-            ):
-                self._next_unit = k + 1
-                yield k, out
-                self._maybe_checkpoint()
-                self._check_scan_deadline()
-            self._flush_checkpoint()
-            return
-        for k, out in resilient_unit_scan(
-            self.readers, self.units, self.device_for,
-            start=self._next_unit, retries=self.retries,
-            quarantine=self.quarantine,
-            unit_deadline=self.unit_deadline,
-        ):
-            self._next_unit = k + 1
-            if out is not None:
-                yield k, out
-            self._maybe_checkpoint()
-            self._check_scan_deadline()
-        self._flush_checkpoint()
+                start=self._next_unit)
+        else:
+            gen = resilient_unit_scan(
+                self.readers, self.units, self.device_for,
+                start=self._next_unit, retries=self.retries,
+                quarantine=self.quarantine,
+                unit_deadline=self.unit_deadline,
+                postmortem=self._postmortem_path)
+        yield from self._drive(gen)
 
     def run(self) -> list[dict[str, DeviceColumn]]:
         """Decode ALL units (position i of the result is unit i).

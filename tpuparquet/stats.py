@@ -39,7 +39,8 @@ import threading
 import time
 
 __all__ = ["DecodeStats", "collect_stats", "current_stats",
-           "worker_stats", "merge_worker_stats", "trace"]
+           "worker_stats", "merge_worker_stats", "adopt_stats",
+           "trace"]
 
 
 @dataclasses.dataclass
@@ -361,6 +362,33 @@ def collect_stats(events: bool = False):
         yield st
     finally:
         st.wall_s = time.perf_counter() - st._t0
+        _tls.active = prev
+        # always-on regime bridge (obs/live.py): every collect_stats
+        # scope folds into the process-wide metrics registry on exit,
+        # exactly once per count — a nested scope SHADOWS the outer
+        # (its counts never reach the outer collector), and worker
+        # collectors merge into their coordinator instead of folding,
+        # so no count lands twice.  One ~40-field pass per scope;
+        # TPQ_LIVE_METRICS=0 disables.
+        from .obs.live import fold_stats
+
+        fold_stats(st)
+
+
+@contextlib.contextmanager
+def adopt_stats(st: "DecodeStats"):
+    """Temporarily install an EXISTING collector as this thread's
+    active one (no wall bookkeeping — the owner keeps its own clock).
+    The scan drivers use this to meter unit decodes into a
+    scan-lifetime collector when the caller has no collector of their
+    own, so the always-on metrics registry sees scans nobody wrapped
+    in ``collect_stats()``.  Same restore discipline as the scopes
+    above; never nest around a scope you don't own."""
+    prev = getattr(_tls, "active", None)
+    _tls.active = st
+    try:
+        yield st
+    finally:
         _tls.active = prev
 
 
