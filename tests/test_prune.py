@@ -1,0 +1,929 @@
+"""Predicate pushdown: pruning correctness, counters, and parity.
+
+The contract under test is the SURVEY's "bit-exact or absent, never
+wrong" applied to filters: a filtered read returns exactly the rows a
+full decode + post-filter would, no matter which pruning layer (chunk
+statistics, bloom filters, page index) fired, which plan path ran
+(serial/parallel, CPU/device/degraded), or how corrupt the pruning
+metadata is (a lying index degrades to "no pruning", never to wrong
+rows).  ``tools/ci.sh`` stage 8 runs this file as the pruning-parity
+gate, including a ``TPQ_PRUNE=0`` leg over ``TestParity``.
+"""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from tpuparquet import FileReader, FileWriter
+from tpuparquet.cpu.plain import ByteArrayColumn
+from tpuparquet.faults import inject_faults
+from tpuparquet.filter import (
+    In,
+    bind_filter,
+    candidate_mask,
+    col,
+    evaluate_exact,
+    gather_chunk_rows,
+    may_match_stats,
+    parse_filter,
+    read_row_group_filtered,
+)
+from tpuparquet.format.bloom import SplitBlockBloom, optimal_bytes, xxh64, \
+    xxh64_py
+from tpuparquet.stats import collect_stats
+
+RNG = np.random.default_rng(20260804)
+
+
+# ----------------------------------------------------------------------
+# corpus helpers
+# ----------------------------------------------------------------------
+
+SCHEMA = ("message m { required int64 x; optional double v; "
+          "optional binary s (STRING); repeated int32 tags; }")
+
+
+def _write_corpus(n_rgs=4, rows=500, bloom=(), seed=0, **kw) -> bytes:
+    """Mixed-shape corpus: ``x`` clustered (stats-prunable), ``v``
+    random with nulls, ``s`` dictionary-ish with nulls, ``tags`` a
+    repeated list column (late-materialization must gather records)."""
+    rng = np.random.default_rng(seed)
+    buf = io.BytesIO()
+    # None (not an empty list) when no blooms: an explicit [] would
+    # override the TPQ_BLOOM_COLUMNS env default under test
+    w = FileWriter(buf, SCHEMA,
+                   bloom_columns=list(bloom) if bloom else None, **kw)
+    for rg in range(n_rgs):
+        lo = rg * rows
+        mask_v = rng.random(rows) > 0.15
+        mask_s = rng.random(rows) > 0.1
+        counts = rng.integers(0, 4, rows)
+        offs = np.zeros(rows + 1, np.int64)
+        np.cumsum(counts, out=offs[1:])
+        w.write_columns(
+            {"x": np.arange(lo, lo + rows, dtype=np.int64),
+             "v": rng.normal(size=int(mask_v.sum())),
+             "s": [f"k{int(i) % 13}" for i in
+                   rng.integers(0, 1000, int(mask_s.sum()))],
+             "tags": rng.integers(0, 99, int(offs[-1])).astype(np.int32)},
+            masks={"v": mask_v, "s": mask_s}, offsets={"tags": offs})
+    w.close()
+    return buf.getvalue()
+
+
+def _oracle(reader, rg, f):
+    """Full decode + exact post-filter: the reference the pushdown
+    path must match bit for bit."""
+    full = reader.read_row_group_arrays(rg)
+    n = reader.meta.row_groups[rg].num_rows
+    cols = {}
+    for path in sorted(f.columns()):
+        node = reader.schema.leaf(path)
+        cd = full[path]
+        valid = (cd.def_levels == node.max_def_level
+                 if node.max_def_level else np.ones(n, dtype=bool))
+        cols[path] = (cd.values, valid)
+    bind_filter(f, reader.schema)
+    sel = np.flatnonzero(evaluate_exact(f, cols, n))
+    out = {}
+    for path in full:
+        node = reader.schema.leaf(path)
+        out[path] = gather_chunk_rows(full[path], node, sel)
+    return out, sel
+
+
+def _assert_chunks_equal(got, want, ctx=""):
+    assert np.array_equal(got.rep_levels, want.rep_levels), ctx
+    assert np.array_equal(got.def_levels, want.def_levels), ctx
+    if isinstance(want.values, ByteArrayColumn):
+        assert got.values == want.values, ctx
+    else:
+        a = np.ascontiguousarray(np.asarray(got.values))
+        b = np.ascontiguousarray(np.asarray(want.values))
+        assert a.shape == b.shape and a.dtype == b.dtype \
+            and a.tobytes() == b.tobytes(), ctx
+
+
+PREDICATES = [
+    lambda: (col("x") >= 700) & (col("x") < 830),
+    lambda: col("x") < 120,
+    lambda: col("x") >= 10**9,                    # matches nothing
+    lambda: col("v") > 1.2,
+    lambda: (col("v") > 0.5) & (col("s").isin(["k1", "k7"])),
+    lambda: (col("x") < 300) | (col("x") >= 1700),
+    lambda: col("s") == "k3",
+    lambda: col("s").is_null(),
+    lambda: col("s").not_null() & (col("v") <= -0.8),
+    lambda: col("s").isin(["nope", "k2"]),
+    lambda: col("v") != 0.0,
+    lambda: (col("x") >= 250) & (col("x") < 260) & (col("v") > 0),
+]
+
+
+# ----------------------------------------------------------------------
+# expression layer
+# ----------------------------------------------------------------------
+
+class TestFilterExpr:
+    def test_build_and_describe(self):
+        f = (col("a") > 3) & col("b").isin([1, 2]) | col("c").is_null()
+        assert f.columns() == {"a", "b", "c"}
+        assert "a > 3" in f.describe()
+
+    def test_parse_filter_round_trip(self):
+        f = parse_filter("x > 100 & s in ('a','b') | v is not null")
+        assert f.columns() == {"x", "s", "v"}
+        g = parse_filter("(x <= 5 | x != 7) & name == 'q u o'")
+        assert g.columns() == {"x", "name"}
+
+    def test_parse_filter_errors(self):
+        for bad in ("x >", "x ?? 3", "x > 1 extra", "in (1)", ""):
+            with pytest.raises(ValueError):
+                parse_filter(bad)
+
+    def test_none_and_empty_in_rejected(self):
+        with pytest.raises(ValueError):
+            col("a") == None  # noqa: E711 - the rejection under test
+        with pytest.raises(ValueError):
+            col("a").isin([])
+        with pytest.raises(ValueError):
+            In("a", [1, None])
+
+    def test_bind_rejects_unknown_and_repeated(self):
+        r = FileReader(io.BytesIO(_write_corpus(1)))
+        with pytest.raises(ValueError):
+            bind_filter(col("zzz") > 1, r.schema)
+        with pytest.raises(ValueError):
+            bind_filter(col("tags") > 1, r.schema)
+        r.close()
+
+    def test_bind_coerces_to_column_domain(self):
+        r = FileReader(io.BytesIO(_write_corpus(1)))
+        f = bind_filter(col("x") > 3, r.schema)
+        assert f._stored == 3
+        # a constant the column cannot hold is a bind-time TypeError,
+        # before any decode work
+        with pytest.raises(TypeError):
+            bind_filter(col("x") > 3.5, r.schema)
+        r.close()
+
+
+# ----------------------------------------------------------------------
+# write side: page index + bloom serialization
+# ----------------------------------------------------------------------
+
+class TestWriteIndexes:
+    def test_offsets_recorded_and_parse(self):
+        data = _write_corpus(3, bloom=("s",))
+        r = FileReader(io.BytesIO(data))
+        for rg in range(3):
+            pi = r.page_index(rg)
+            assert set(pi) == {"x", "v", "s", "tags"}
+            for pages in pi.values():
+                (r0, r1, _mn, _mx, _nulls, _np_) = pages[0]
+                assert r0 == 0 and r1 == r.meta.row_groups[rg].num_rows
+            assert r.bloom_filter(rg, "s") is not None
+            assert r.bloom_filter(rg, "x") is None
+        r.close()
+
+    def test_page_locations_point_at_page_headers(self):
+        from tpuparquet.format.compact import CompactReader
+        from tpuparquet.format.metadata import PageHeader, PageType, \
+            decode_struct
+
+        # parallel flush path: enough values + enough columns
+        os.environ["TPQ_WRITE_THREADS"] = "4"
+        try:
+            data = _write_corpus(2, rows=30000)
+        finally:
+            del os.environ["TPQ_WRITE_THREADS"]
+        r = FileReader(io.BytesIO(data))
+        for rg in r.meta.row_groups:
+            for cc in rg.columns:
+                assert cc.offset_index_offset is not None
+                from tpuparquet.format.metadata import OffsetIndex
+
+                blob = data[cc.offset_index_offset:
+                            cc.offset_index_offset
+                            + cc.offset_index_length]
+                oi = OffsetIndex.from_bytes(blob)
+                for loc in oi.page_locations:
+                    ph = decode_struct(
+                        PageHeader, CompactReader(data, loc.offset))
+                    assert PageType(ph.type) in (PageType.DATA_PAGE,
+                                                 PageType.DATA_PAGE_V2)
+        r.close()
+
+    def test_page_index_gate(self):
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required int64 x; }",
+                       page_index=False)
+        w.write_columns({"x": np.arange(10, dtype=np.int64)})
+        w.close()
+        r = FileReader(io.BytesIO(buf.getvalue()))
+        assert r.page_index(0) == {}
+        r.close()
+
+    def test_page_index_env_gate(self, monkeypatch):
+        monkeypatch.setenv("TPQ_PAGE_INDEX", "0")
+        data = _write_corpus(1)
+        r = FileReader(io.BytesIO(data))
+        assert r.meta.row_groups[0].columns[0].column_index_offset is None
+        r.close()
+
+    def test_no_stats_means_no_index(self):
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required int64 x; }",
+                       write_stats=False)
+        w.write_columns({"x": np.arange(10, dtype=np.int64)})
+        w.close()
+        r = FileReader(io.BytesIO(buf.getvalue()))
+        assert r.page_index(0) == {}
+        r.close()
+
+    def test_bloom_env_gate(self, monkeypatch):
+        monkeypatch.setenv("TPQ_BLOOM_COLUMNS", "s")
+        data = _write_corpus(1)
+        r = FileReader(io.BytesIO(data))
+        assert r.bloom_filter(0, "s") is not None
+        r.close()
+
+    def test_bloom_unknown_column_rejected(self):
+        with pytest.raises(ValueError):
+            FileWriter(io.BytesIO(), "message m { required int64 x; }",
+                       bloom_columns=["nope"])
+
+
+# ----------------------------------------------------------------------
+# bloom filter unit level
+# ----------------------------------------------------------------------
+
+class TestBloom:
+    def test_xxh64_reference_vectors(self):
+        # reference vectors from the xxHash spec repository
+        assert xxh64(b"") == 0xEF46DB3751D8E999
+        assert xxh64(b"a") == 0xD24EC4F1A98C6E5B
+        assert xxh64(b"abc") == 0x44BC2CF5AD770999
+        data = bytes(range(101))
+        assert xxh64_py(data) == xxh64(data)
+        assert xxh64_py(data, seed=2654435761) == \
+            xxh64(data, seed=2654435761)
+
+    def test_no_false_negatives_and_round_trip(self):
+        b = SplitBlockBloom(optimal_bytes(500))
+        vals = [f"v{i}".encode() for i in range(500)]
+        for v in vals:
+            b.insert(v)
+        assert all(b.check(v) for v in vals)
+        b2 = SplitBlockBloom.from_bytes(b.to_bytes())
+        assert all(b2.check(v) for v in vals)
+        # false-positive rate sane (sized for ~1%)
+        fp = sum(b2.check(f"absent{i}".encode()) for i in range(2000))
+        assert fp < 200
+
+    def test_from_bytes_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            SplitBlockBloom.from_bytes(b"\x00\x01garbage")
+        blob = SplitBlockBloom(64).to_bytes()
+        with pytest.raises(ValueError):
+            SplitBlockBloom.from_bytes(blob[:-8])  # bitset truncated
+
+    def test_bloom_refutes_equality(self):
+        data = _write_corpus(2, bloom=("s",))
+        r = FileReader(io.BytesIO(data))
+        # in lexical range [k0..k9] but never written
+        v = r.prune_row_group(col("s") == "k360", 0)
+        assert v.skip and v.reason == "bloom" and v.bloom_hits == 1
+        assert not r.prune_row_group(col("s") == "k3", 0).skip
+        r.close()
+
+
+# ----------------------------------------------------------------------
+# verdict layers
+# ----------------------------------------------------------------------
+
+class TestVerdicts:
+    def test_stats_prune_and_keep(self):
+        data = _write_corpus(4)
+        r = FileReader(io.BytesIO(data))
+        assert r.prune_row_group(col("x") < 0, 0).skip
+        assert r.prune_row_group(col("x") > 10**9, 3).skip
+        v = r.prune_row_group((col("x") >= 600) & (col("x") < 620), 1)
+        assert not v.skip
+        assert r.prune_row_group((col("x") >= 600) & (col("x") < 620),
+                                 0).skip
+        r.close()
+
+    def test_null_predicates(self):
+        # all-required column: is_null can never match
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required int64 x; }")
+        w.write_columns({"x": np.arange(50, dtype=np.int64)})
+        w.close()
+        r = FileReader(io.BytesIO(buf.getvalue()))
+        assert r.prune_row_group(col("x").is_null(), 0).skip
+        assert not r.prune_row_group(col("x").not_null(), 0).skip
+        r.close()
+
+    def test_float_ne_never_prunes_constant_chunk(self):
+        # NaN rows match != but are invisible to min/max: a constant
+        # float chunk must NOT be pruned for != const
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required double v; }")
+        w.write_columns({"v": np.full(32, 7.0)})
+        w.close()
+        r = FileReader(io.BytesIO(buf.getvalue()))
+        assert not r.prune_row_group(col("v") != 7.0, 0).skip
+        r.close()
+
+    def test_int_ne_prunes_constant_chunk(self):
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required int64 x; }")
+        w.write_columns({"x": np.full(32, 7, dtype=np.int64)})
+        w.close()
+        r = FileReader(io.BytesIO(buf.getvalue()))
+        assert r.prune_row_group(col("x") != 7, 0).skip
+        assert not r.prune_row_group(col("x") != 8, 0).skip
+        r.close()
+
+    def test_unsigned_logical_order(self):
+        buf = io.BytesIO()
+        w = FileWriter(buf, "message m { required int32 u (UINT_32); }")
+        w.write_columns({"u": np.array([1, 2**31 + 5], dtype=np.uint32)})
+        w.close()
+        r = FileReader(io.BytesIO(buf.getvalue()))
+        # logical max is 2**31+5: a predicate above it prunes, one
+        # inside the (unsigned) range does not
+        assert r.prune_row_group(col("u") > 2**31 + 6, 0).skip
+        assert not r.prune_row_group(col("u") > 2**31, 0).skip
+        r.close()
+
+    def test_float16_flba_bounds_unusable(self):
+        # pyarrow FLOAT16 stats sort as IEEE halves, not bytewise:
+        # pruning must not trust them (negative halves have the sign
+        # bit set, so bytewise min/max invert) and strict validation
+        # must not reject them as min > max
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        t = pa.table({"h": pa.array(np.array(
+            [-1.5, -0.25, 0.5, 1.0], dtype=np.float16))})
+        buf = io.BytesIO()
+        pq.write_table(t, buf)
+        data = buf.getvalue()
+        r = FileReader(io.BytesIO(data))
+        out = r.read_row_group_arrays(
+            0, filter=col("h") == np.float16(-0.25).tobytes())
+        assert out["h"].num_values == 1
+        r.close()
+        with FileReader(io.BytesIO(data), strict_metadata=True) as r2:
+            assert r2.num_rows == 4  # opens clean
+
+    def test_decimal_flba_bounds_unusable(self):
+        import decimal
+
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        t = pa.table({"dec": pa.array(
+            [decimal.Decimal("-1.00"), decimal.Decimal("2.50")],
+            type=pa.decimal128(9, 2))})
+        buf = io.BytesIO()
+        pq.write_table(t, buf)
+        with FileReader(io.BytesIO(buf.getvalue()),
+                        strict_metadata=True) as r:
+            assert r.num_rows == 2  # signed-order stats open clean
+
+    def test_prune_disabled_env(self, monkeypatch):
+        monkeypatch.setenv("TPQ_PRUNE", "0")
+        data = _write_corpus(2)
+        r = FileReader(io.BytesIO(data))
+        v = r.prune_row_group(col("x") < 0, 0)
+        assert not v.skip and v.candidate is None
+        r.close()
+
+
+# ----------------------------------------------------------------------
+# parity: filtered == full decode + post-filter (the ci.sh stage-8 pin)
+# ----------------------------------------------------------------------
+
+class TestParity:
+    @pytest.mark.parametrize("pred_i", range(len(PREDICATES)))
+    def test_cpu_filtered_vs_oracle(self, pred_i):
+        data = _write_corpus(4, bloom=("s",))
+        r = FileReader(io.BytesIO(data))
+        f = PREDICATES[pred_i]()
+        for rg in range(4):
+            want, sel = _oracle(r, rg, f)
+            got, rows = read_row_group_filtered(r, rg, f)
+            assert np.array_equal(rows, sel)
+            for path in want:
+                _assert_chunks_equal(got[path], want[path],
+                                     f"pred {pred_i} rg {rg} {path}")
+        r.close()
+
+    def test_randomized_predicates(self):
+        data = _write_corpus(3, rows=400, seed=5)
+        r = FileReader(io.BytesIO(data))
+        rng = np.random.default_rng(99)
+        for _ in range(12):
+            lo = int(rng.integers(0, 1200))
+            hi = lo + int(rng.integers(1, 400))
+            t = float(rng.normal())
+            f = (col("x") >= lo) & (col("x") < hi) | (col("v") > t)
+            rg = int(rng.integers(0, 3))
+            want, sel = _oracle(r, rg, f)
+            got, rows = read_row_group_filtered(r, rg, f)
+            assert np.array_equal(rows, sel)
+            for path in want:
+                _assert_chunks_equal(got[path], want[path])
+        r.close()
+
+    def test_device_filtered_vs_oracle(self):
+        from tpuparquet.kernels.device import read_row_group_device
+
+        data = _write_corpus(3, bloom=("s",))
+        r = FileReader(io.BytesIO(data))
+        for pred in (PREDICATES[0], PREDICATES[4], PREDICATES[7]):
+            f = pred()
+            for rg in range(3):
+                want, _sel = _oracle(r, rg, f)
+                dev = read_row_group_device(r, rg, filter=f)
+                for path in want:
+                    vals, rep, dl = dev[path].to_numpy()
+                    w = want[path]
+                    assert np.array_equal(rep, w.rep_levels)
+                    assert np.array_equal(dl, w.def_levels)
+                    if isinstance(w.values, ByteArrayColumn):
+                        assert vals == w.values
+                    else:
+                        a = np.ascontiguousarray(np.asarray(vals))
+                        b = np.ascontiguousarray(np.asarray(w.values))
+                        assert a.tobytes() == b.tobytes() \
+                            and a.dtype == b.dtype
+        r.close()
+
+    def test_degraded_filtered_vs_oracle(self):
+        from tpuparquet.kernels.device import (
+            cpu_fallback_values,
+            read_row_group_device,
+        )
+
+        data = _write_corpus(2)
+        r = FileReader(io.BytesIO(data))
+        f = PREDICATES[0]()
+        want, _sel = _oracle(r, 1, f)
+        with cpu_fallback_values():
+            dev = read_row_group_device(r, 1, filter=f)
+        for path in want:
+            vals, rep, dl = dev[path].to_numpy()
+            assert np.array_equal(dl, want[path].def_levels)
+        r.close()
+
+    def test_projection_with_filter_column_outside(self):
+        # filter on v, project only x+s: v decodes for evaluation but
+        # is absent from the result
+        data = _write_corpus(2)
+        r = FileReader(io.BytesIO(data), "x", "s")
+        f = col("v") > 0.5
+        got, rows = read_row_group_filtered(r, 0, f)
+        assert set(got) == {"x", "s"}
+        r2 = FileReader(io.BytesIO(data))
+        _want, sel = _oracle(r2, 0, f)
+        assert np.array_equal(rows, sel)
+        r.close(), r2.close()
+
+    def test_empty_match_returns_schema_shaped_zero_rows(self):
+        data = _write_corpus(1)
+        r = FileReader(io.BytesIO(data))
+        got, rows = read_row_group_filtered(r, 0, col("x") < 0)
+        assert rows.size == 0
+        assert set(got) == {"x", "v", "s", "tags"}
+        for cd in got.values():
+            assert cd.num_values == 0
+        r.close()
+
+
+# ----------------------------------------------------------------------
+# sharded scan integration + counter exactness
+# ----------------------------------------------------------------------
+
+def _scan_paths(tmp_path, n_files=2, n_rgs=3, rows=400):
+    paths = []
+    for fi in range(n_files):
+        p = tmp_path / f"f{fi}.parquet"
+        rng = np.random.default_rng(fi)
+        with open(p, "wb") as fh:
+            w = FileWriter(fh, "message m { required int64 x; "
+                               "optional double v; }")
+            for rg in range(n_rgs):
+                lo = (fi * n_rgs + rg) * rows
+                m = rng.random(rows) > 0.1
+                w.write_columns(
+                    {"x": np.arange(lo, lo + rows, dtype=np.int64),
+                     "v": rng.normal(size=int(m.sum()))},
+                    masks={"v": m})
+            w.close()
+        paths.append(str(p))
+    return paths
+
+
+class TestShardedScan:
+    def test_filtered_scan_parity_and_counters(self, tmp_path):
+        from tpuparquet.shard.scan import ShardedScan
+
+        paths = _scan_paths(tmp_path)
+        total = 2 * 3 * 400
+        f = (col("x") >= 900) & (col("x") < 1500)
+        s = ShardedScan(paths, filter=f)
+        res, st = s.run_with_stats()
+        got = np.sort(np.concatenate(
+            [np.asarray(r["x"].to_numpy()[0]) for r in res])) \
+            if res else np.empty(0, np.int64)
+        assert np.array_equal(got, np.arange(900, 1500))
+        # exact accounting: every row is pruned, filtered out, or kept
+        assert st.rows_pruned + st.filter_rows_in == total
+        assert st.filter_rows_out == 600
+        assert st.row_groups_pruned == 6 - len(s.units)
+        s.close()
+
+    def test_quarantine_mode_filtered(self, tmp_path):
+        from tpuparquet.shard.scan import ShardedScan
+
+        paths = _scan_paths(tmp_path)
+        f = col("x") < 500
+        s = ShardedScan(paths, on_error="quarantine", filter=f)
+        res, st = s.run_with_stats()
+        got = np.sort(np.concatenate(
+            [np.asarray(r["x"].to_numpy()[0]) for r in res]))
+        assert np.array_equal(got, np.arange(0, 500))
+        assert not s.quarantine.as_dicts()
+        s.close()
+
+    def test_filtered_scan_under_faults(self, tmp_path):
+        from tpuparquet.shard.scan import ShardedScan
+
+        paths = _scan_paths(tmp_path)
+        f = col("x") < 1000
+        with inject_faults() as inj:
+            inj.inject("io.reader.chunk_read", "transient", times=2)
+            s = ShardedScan(paths, on_error="quarantine", filter=f)
+            res, st = s.run_with_stats()
+        got = np.sort(np.concatenate(
+            [np.asarray(r["x"].to_numpy()[0]) for r in res]))
+        assert np.array_equal(got, np.arange(0, 1000))
+        s.close()
+
+    def test_cursor_resume_filtered(self, tmp_path):
+        from tpuparquet.shard.scan import ShardedScan
+
+        paths = _scan_paths(tmp_path)
+        f = col("x") < 1600
+        s = ShardedScan(paths, filter=f)
+        it = s.run_iter()
+        first = next(it)
+        cur = s.state()
+        it.close()
+        s2 = ShardedScan(paths, filter=f, resume=cur)
+        rest = list(s2.run_iter())
+        ks = [first[0]] + [k for k, _ in rest]
+        assert ks == sorted(ks) and len(set(ks)) == len(ks)
+        s.close(), s2.close()
+
+    def test_multihost_single_process_filtered(self, tmp_path):
+        from tpuparquet.shard.distributed import MultiHostScan
+
+        paths = _scan_paths(tmp_path)
+        f = (col("x") >= 400) & (col("x") < 900)
+        s = MultiHostScan(paths, filter=f)
+        res, fleet, _local = s.run_with_stats()
+        got = np.sort(np.concatenate(
+            [np.asarray(r["x"].to_numpy()[0]) for r in res]))
+        assert np.array_equal(got, np.arange(400, 900))
+        assert fleet.rows_pruned + fleet.filter_rows_in == 2400
+        for r in s.readers:
+            if r is not None:
+                r.close()
+
+    def test_salvaged_file_filtered(self, tmp_path):
+        from tpuparquet.shard.scan import ShardedScan
+
+        paths = _scan_paths(tmp_path)
+        # tear the second file's footer: salvage recovers a prefix
+        raw = open(paths[1], "rb").read()
+        open(paths[1], "wb").write(raw[: len(raw) - 40])
+        f = col("x") < 10**9
+        s = ShardedScan(paths, on_error="quarantine", salvage=True,
+                        filter=f)
+        res, st = s.run_with_stats()
+        xs = np.sort(np.concatenate(
+            [np.asarray(r["x"].to_numpy()[0]) for r in res]))
+        # file 0 complete, file 1 a bit-exact prefix: whatever came
+        # back must be exactly the right rows (never wrong)
+        assert np.array_equal(xs[:1200], np.arange(0, 1200))
+        assert np.array_equal(np.unique(xs), xs)
+        s.close()
+
+
+# ----------------------------------------------------------------------
+# pyarrow interop (both directions)
+# ----------------------------------------------------------------------
+
+class TestPyarrowInterop:
+    pa = pytest.importorskip("pyarrow")
+
+    def test_pyarrow_reads_our_page_index(self, tmp_path):
+        import pyarrow.parquet as pq
+
+        data = _write_corpus(3)
+        p = tmp_path / "ours.parquet"
+        p.write_bytes(data)
+        md = pq.ParquetFile(str(p)).metadata
+        for rgi in range(md.num_row_groups):
+            for ci in range(md.num_columns):
+                assert md.row_group(rgi).column(ci).has_column_index
+        # pyarrow's own pruning over our index gives the right answer
+        t = pq.read_table(str(p), filters=[("x", ">=", 1000),
+                                           ("x", "<", 1010)])
+        assert sorted(t.column("x").to_pylist()) == list(range(1000, 1010))
+
+    @pytest.mark.parametrize("dpv", ["1.0", "2.0"])
+    def test_we_prune_pyarrow_page_index(self, dpv, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        n = 40000
+        t = pa.table({"x": np.arange(n, dtype=np.int64),
+                      "s": [f"g{i % 31}" for i in range(n)]})
+        buf = io.BytesIO()
+        pq.write_table(t, buf, write_page_index=True,
+                       data_page_size=4096, row_group_size=20000,
+                       data_page_version=dpv, compression="snappy")
+        r = FileReader(io.BytesIO(buf.getvalue()))
+        f = (col("x") >= 23456) & (col("x") < 23500)
+        with collect_stats() as st:
+            out0, rows0 = read_row_group_filtered(r, 0, f)
+            out1, rows1 = read_row_group_filtered(r, 1, f)
+        assert rows0.size == 0 and st.row_groups_pruned == 1
+        assert np.array_equal(np.asarray(out1["x"].values),
+                              np.arange(23456, 23500))
+        assert st.pages_pruned > 0  # multi-page chunks actually pruned
+        r.close()
+
+    def test_we_prune_pyarrow_bloom(self, tmp_path):
+        import inspect
+
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        if "bloom_filter_columns" not in inspect.signature(
+                pq.write_table).parameters:
+            pytest.skip("pyarrow too old for bloom filter writes")
+        t = pa.table({"s": [f"w{i % 11}" for i in range(5000)]})
+        buf = io.BytesIO()
+        pq.write_table(t, buf, bloom_filter_columns=["s"],
+                       compression="snappy")
+        r = FileReader(io.BytesIO(buf.getvalue()))
+        b = r.bloom_filter(0, "s")
+        assert b is not None
+        assert all(b.check(f"w{i}".encode()) for i in range(11))
+        v = r.prune_row_group(col("s") == "w100x", 0)
+        assert v.skip and v.reason == "bloom"
+        r.close()
+
+
+# ----------------------------------------------------------------------
+# corrupt / lying indexes degrade to no pruning, never wrong rows
+# ----------------------------------------------------------------------
+
+class TestCorruptIndex:
+    def test_corrupt_column_index_degrades(self):
+        data = bytearray(_write_corpus(2))
+        r0 = FileReader(io.BytesIO(bytes(data)))
+        cc = r0.meta.row_groups[0].columns[0]
+        off = cc.column_index_offset
+        r0.close()
+        data[off] ^= 0xFF  # smash the ColumnIndex thrift
+        r = FileReader(io.BytesIO(bytes(data)))
+        pi = r.page_index(0)
+        assert "x" not in pi  # degraded, other columns intact
+        f = (col("x") >= 100) & (col("x") < 140)
+        got, rows = read_row_group_filtered(r, 0, f)
+        assert np.array_equal(np.asarray(got["x"].values),
+                              np.arange(100, 140))
+        r.close()
+
+    def test_lying_column_index_caught_by_validator(self):
+        from tpuparquet.format.metadata import ColumnIndex
+
+        data = bytearray(_write_corpus(1))
+        r0 = FileReader(io.BytesIO(bytes(data)))
+        cc = r0.meta.row_groups[0].columns[0]
+        blob = bytes(data[cc.column_index_offset:
+                          cc.column_index_offset
+                          + cc.column_index_length])
+        ci = ColumnIndex.from_bytes(blob)
+        # swap min and max: still perfectly valid thrift, same length,
+        # but min > max — the validator must refuse it
+        lying = ColumnIndex(
+            null_pages=ci.null_pages, min_values=ci.max_values,
+            max_values=ci.min_values, boundary_order=ci.boundary_order,
+            null_counts=ci.null_counts).to_bytes()
+        assert len(lying) == len(blob)
+        r0.close()
+        data[cc.column_index_offset:
+             cc.column_index_offset + len(blob)] = lying
+        r = FileReader(io.BytesIO(bytes(data)))
+        assert "x" not in r.page_index(0)
+        assert any(f.code == "pageindex-min-gt-max"
+                   for f in r.pageindex_findings)
+        # results still exact
+        got, rows = read_row_group_filtered(r, 0, col("x") < 25)
+        assert np.array_equal(np.asarray(got["x"].values), np.arange(25))
+        r.close()
+
+    def test_fault_site_injection_degrades(self):
+        data = _write_corpus(1)
+        with inject_faults() as inj:
+            inj.inject("format.pageindex", "corrupt", times=99)
+            r = FileReader(io.BytesIO(data))
+            assert r.page_index(0) == {}
+            got, rows = read_row_group_filtered(r, 0, col("x") < 30)
+            assert np.array_equal(np.asarray(got["x"].values),
+                                  np.arange(30))
+            r.close()
+
+    def test_corrupt_bloom_degrades(self):
+        data = bytearray(_write_corpus(1, bloom=("s",)))
+        r0 = FileReader(io.BytesIO(bytes(data)))
+        cm = r0.meta.row_groups[0].columns[2].meta_data
+        assert ".".join(cm.path_in_schema) == "s"
+        off = cm.bloom_filter_offset
+        r0.close()
+        data[off] ^= 0xFF
+        r = FileReader(io.BytesIO(bytes(data)))
+        assert r.bloom_filter(0, "s") is None
+        assert not r.prune_row_group(col("s") == "k360", 0).skip
+        r.close()
+
+    def test_strict_validator_flags_bad_offsets(self):
+        from tpuparquet.format.validate import validate_metadata
+
+        data = _write_corpus(1)
+        r = FileReader(io.BytesIO(data))
+        meta = r.metadata()
+        cc = meta.row_groups[0].columns[0]
+        cc.column_index_offset = len(data) + 100
+        findings = validate_metadata(meta, len(data))
+        assert any(f.code == "pageindex-oob" for f in findings)
+        r.close()
+
+    def test_strict_validator_flags_lying_stats(self):
+        from tpuparquet.format.validate import validate_metadata
+
+        data = _write_corpus(1)
+        r = FileReader(io.BytesIO(data))
+        meta = r.metadata()
+        st = meta.row_groups[0].columns[0].meta_data.statistics
+        st.min_value, st.max_value = st.max_value, st.min_value
+        findings = validate_metadata(meta, len(data))
+        assert any(f.code == "stats-min-gt-max" for f in findings)
+        r.close()
+
+
+# ----------------------------------------------------------------------
+# plan-cache page-prune hints
+# ----------------------------------------------------------------------
+
+class TestPlanCacheHints:
+    def test_page_index_cached_across_reopen(self, monkeypatch):
+        from tpuparquet.kernels.plancache import clear_plan_cache
+
+        monkeypatch.setenv("TPQ_PLAN_CACHE_MB", "8")
+        clear_plan_cache()
+        try:
+            data = _write_corpus(2)
+            r1 = FileReader(io.BytesIO(data))
+            with collect_stats() as st1:
+                pi1 = r1.page_index(0)
+            assert st1.plan_cache_misses == 1
+            r1.close()
+            r2 = FileReader(io.BytesIO(data))
+            with collect_stats() as st2:
+                pi2 = r2.page_index(0)
+            assert st2.plan_cache_hits == 1
+            assert pi1 == pi2
+            r2.close()
+        finally:
+            clear_plan_cache()
+
+    def test_invalidation_shared_with_corruption_hooks(self, monkeypatch):
+        from tpuparquet.kernels.plancache import (
+            clear_plan_cache,
+            invalidate_fingerprint,
+        )
+
+        monkeypatch.setenv("TPQ_PLAN_CACHE_MB", "8")
+        clear_plan_cache()
+        try:
+            data = _write_corpus(1)
+            r1 = FileReader(io.BytesIO(data))
+            r1.page_index(0)
+            invalidate_fingerprint(r1.plan_fingerprint)
+            r1.close()
+            r2 = FileReader(io.BytesIO(data))
+            with collect_stats() as st:
+                r2.page_index(0)
+            assert st.plan_cache_misses == 1  # entry was dropped
+            r2.close()
+        finally:
+            clear_plan_cache()
+
+
+# ----------------------------------------------------------------------
+# counters + CLI surface
+# ----------------------------------------------------------------------
+
+class TestCountersAndCli:
+    def test_pages_pruned_counter_exact(self):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        n = 30000
+        t = pa.table({"x": np.arange(n, dtype=np.int64)})
+        buf = io.BytesIO()
+        pq.write_table(t, buf, write_page_index=True,
+                       data_page_size=4096, row_group_size=n)
+        r = FileReader(io.BytesIO(buf.getvalue()))
+        pages = r.page_index(0)["x"]
+        f = col("x") < 100
+        keep_pages = sum(1 for (r0, r1, *_rest) in pages if r0 < 100)
+        with collect_stats() as st:
+            read_row_group_filtered(r, 0, f)
+        assert st.pages_pruned == len(pages) - keep_pages
+        assert st.rows_pruned == n - pages[keep_pages - 1][1] \
+            if keep_pages else n
+        r.close()
+
+    def test_summary_and_as_dict_carry_pruning(self):
+        data = _write_corpus(2)
+        r = FileReader(io.BytesIO(data))
+        with collect_stats() as st:
+            read_row_group_filtered(r, 0, col("x") < 10)
+            read_row_group_filtered(r, 1, col("x") < 10)
+        d = st.as_dict()
+        assert d["row_groups_pruned"] == 1
+        assert d["selectivity"] is not None
+        assert "PRUNE" in st.summary()
+        r.close()
+
+    def test_stats_merge_exact(self):
+        from tpuparquet.stats import DecodeStats
+
+        a, b = DecodeStats(), DecodeStats()
+        a.rows_pruned, b.rows_pruned = 5, 7
+        a.bloom_hits, b.bloom_hits = 1, 2
+        a.filter_rows_in, b.filter_rows_in = 10, 20
+        a.merge_from(b)
+        assert (a.rows_pruned, a.bloom_hits, a.filter_rows_in) == \
+            (12, 3, 30)
+
+    def test_cli_meta_shows_stats_and_flags(self, tmp_path):
+        from tpuparquet.cli.parquet_tool import build_parser, cmd_meta
+
+        p = tmp_path / "m.parquet"
+        p.write_bytes(_write_corpus(1, bloom=("s",)))
+        out = io.StringIO()
+        args = build_parser().parse_args(["meta", str(p)])
+        assert cmd_meta(args, out=out) == 0
+        text = out.getvalue()
+        assert "stats=[" in text and "page-index=column+offset" in text
+        assert "bloom=yes" in text
+
+    def test_cli_profile_filter(self, tmp_path):
+        from tpuparquet.cli.parquet_tool import build_parser, cmd_profile
+
+        p = tmp_path / "m.parquet"
+        p.write_bytes(_write_corpus(2))
+        out = io.StringIO()
+        args = build_parser().parse_args(
+            ["profile", "--cpu", "--filter", "x < 100", str(p)])
+        assert cmd_profile(args, out=out) == 0
+        assert "pruning:" in out.getvalue()
+
+    def test_cli_profile_filter_json(self, tmp_path):
+        import json
+
+        from tpuparquet.cli.parquet_tool import build_parser, cmd_profile
+
+        p = tmp_path / "m.parquet"
+        p.write_bytes(_write_corpus(2))
+        out = io.StringIO()
+        args = build_parser().parse_args(
+            ["profile", "--cpu", "--json", "--filter", "x < 100",
+             str(p)])
+        assert cmd_profile(args, out=out) == 0
+        rep = json.loads(out.getvalue())
+        assert rep["counters"]["row_groups_pruned"] == 1

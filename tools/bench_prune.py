@@ -1,0 +1,202 @@
+"""Predicate-pushdown bench: e2e scan wall vs selectivity.
+
+Writes a clustered corpus (``x`` sorted across row groups — the shape
+production partition/cluster keys have), then times a full unfiltered
+``ShardedScan`` against filtered scans at ~1%, ~10% and ~50%
+selectivity.  The acceptance bar (ISSUE 7): filtered e2e time scales
+with selectivity — >= 5x speedup at 1% vs unfiltered — and the
+pruning counters account exactly for every row: every row is either
+statically pruned, filtered out exactly, or returned.
+
+Output: ``PRUNE_r01.json`` at the repo root (or ``--out``).
+
+Knobs: ``TPQ_PRUNE_BENCH_ROWS`` (default 50_000_000),
+``TPQ_PRUNE_BENCH_FILES`` (default 8), ``TPQ_PRUNE_BENCH_REPS``
+(default 2; best-of wall per leg).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+def write_corpus(d: str, total_rows: int, n_files: int,
+                 rows_per_rg: int) -> list:
+    from tpuparquet.format.metadata import CompressionCodec
+    from tpuparquet.io.writer import FileWriter
+
+    paths = []
+    rng = np.random.default_rng(42)
+    written = 0
+    per_file = (total_rows + n_files - 1) // n_files
+    for fi in range(n_files):
+        p = os.path.join(d, f"prune_{fi:02d}.parquet")
+        with open(p, "wb") as fh:
+            w = FileWriter(fh, "message m { required int64 x; "
+                               "required double v; required int64 t; }",
+                           codec=CompressionCodec.SNAPPY)
+            left = min(per_file, total_rows - written)
+            while left > 0:
+                n = min(rows_per_rg, left)
+                lo = written
+                w.write_columns({
+                    "x": np.arange(lo, lo + n, dtype=np.int64),
+                    "v": rng.random(n),
+                    "t": rng.integers(0, 1 << 40, n),
+                })
+                written += n
+                left -= n
+            w.close()
+        paths.append(p)
+    return paths
+
+
+def run_scan(paths, filt):
+    """One e2e ShardedScan; returns (wall_s, rows_out, stats)."""
+    from tpuparquet.shard.scan import ShardedScan
+    from tpuparquet.stats import collect_stats
+
+    t0 = time.perf_counter()
+    with collect_stats() as st:
+        s = ShardedScan(paths, filter=filt)
+        try:
+            rows = 0
+            for _k, out in s.run_iter():
+                c = out["x"]
+                c.block_until_ready()
+                rows += c.num_values
+        finally:
+            s.close()
+    return time.perf_counter() - t0, rows, st
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int,
+                    default=_env_int("TPQ_PRUNE_BENCH_ROWS", 50_000_000))
+    ap.add_argument("--files", type=int,
+                    default=_env_int("TPQ_PRUNE_BENCH_FILES", 8))
+    ap.add_argument("--reps", type=int,
+                    default=_env_int("TPQ_PRUNE_BENCH_REPS", 2))
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "PRUNE_r01.json"))
+    ap.add_argument("--keep-corpus", default="")
+    args = ap.parse_args(argv)
+
+    from tpuparquet.filter import col
+
+    total = args.rows
+    rows_per_rg = max(total // (args.files * 8), 1)
+    d = args.keep_corpus or tempfile.mkdtemp(prefix="tpq_prune_")
+    t0 = time.perf_counter()
+    paths = write_corpus(d, total, args.files, rows_per_rg)
+    write_s = time.perf_counter() - t0
+    print(f"corpus: {total:,} rows in {len(paths)} files "
+          f"({rows_per_rg:,} rows/rg), wrote in {write_s:.1f}s",
+          flush=True)
+
+    legs = {
+        "unfiltered": (None, total),
+        "sel_50pct": (col("x") < int(total * 0.50), int(total * 0.50)),
+        "sel_10pct": (col("x") < int(total * 0.10), int(total * 0.10)),
+        "sel_1pct": (col("x") < int(total * 0.01), int(total * 0.01)),
+    }
+    report = {"rows": total, "files": len(paths),
+              "rows_per_rg": rows_per_rg, "write_s": round(write_s, 3),
+              "reps": args.reps, "legs": {}}
+    ok = True
+    notes = []
+    walls = {}
+    for name, (filt, expect) in legs.items():
+        best = None
+        leg = None
+        for _rep in range(max(args.reps, 1)):
+            wall, rows, st = run_scan(paths, filt)
+            if best is None or wall < best:
+                best = wall
+                d_st = st.as_dict()
+                leg = {
+                    "wall_s": round(wall, 3),
+                    "rows_out": rows,
+                    "row_groups_pruned": d_st["row_groups_pruned"],
+                    "pages_pruned": d_st["pages_pruned"],
+                    "rows_pruned": d_st["rows_pruned"],
+                    "filter_rows_in": d_st["filter_rows_in"],
+                    "filter_rows_out": d_st["filter_rows_out"],
+                    "selectivity": d_st["selectivity"],
+                }
+        walls[name] = best
+        if leg["rows_out"] != expect:
+            ok = False
+            notes.append(f"{name}: rows_out {leg['rows_out']} != "
+                         f"expected {expect}")
+        if filt is not None:
+            # exact accounting: every row pruned, filtered, or kept
+            if leg["rows_pruned"] + leg["filter_rows_in"] != total:
+                ok = False
+                notes.append(
+                    f"{name}: rows_pruned {leg['rows_pruned']} + "
+                    f"filter_rows_in {leg['filter_rows_in']} != {total}")
+            if leg["filter_rows_out"] != leg["rows_out"]:
+                ok = False
+                notes.append(f"{name}: filter_rows_out != rows_out")
+        report["legs"][name] = leg
+        print(f"  {name}: {leg['wall_s']}s, {leg['rows_out']:,} rows, "
+              f"{leg['row_groups_pruned']} rgs pruned", flush=True)
+
+    base = walls["unfiltered"]
+    for name, floor in (("sel_1pct", 5.0), ("sel_10pct", 1.5),
+                        ("sel_50pct", 1.0)):
+        sp = base / walls[name] if walls[name] else float("inf")
+        report["legs"][name]["speedup_vs_unfiltered"] = round(sp, 2)
+        if sp < floor:
+            ok = False
+            notes.append(f"{name}: speedup {sp:.2f}x < floor {floor}x")
+    # monotone: tighter predicates are never slower
+    if not (walls["sel_1pct"] <= walls["sel_10pct"] * 1.25
+            <= walls["sel_50pct"] * 1.25 * 1.25):
+        notes.append("walls not monotone in selectivity (noise?)")
+
+    report["ok"] = ok
+    report["notes"] = notes
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({"ok": ok, "speedup_1pct":
+                      report["legs"]["sel_1pct"]
+                      ["speedup_vs_unfiltered"],
+                      "out": args.out}))
+    if not args.keep_corpus:
+        for p in paths:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        try:
+            os.rmdir(d)
+        except OSError:
+            pass
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

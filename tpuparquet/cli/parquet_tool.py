@@ -103,10 +103,56 @@ def _cat(path: str, n: int, out, trace: bool = False) -> int:
     return 0
 
 
+def _fmt_stat(v, limit: int = 24) -> str:
+    if isinstance(v, bytes):
+        s = repr(v.decode("utf-8", "replace"))
+    else:
+        s = repr(v)
+    return s if len(s) <= limit else s[: limit - 2] + ".."
+
+
+def _chunk_extras(r, cc) -> str:
+    """Per-chunk Statistics (decoded to LOGICAL values) + pruning-index
+    presence flags for ``meta`` — the operator's view of what predicate
+    pushdown has to work with."""
+    from ..io.values import handler_for
+
+    cm = cc.meta_data
+    bits = []
+    st = cm.statistics
+    if st is not None:
+        node = r.schema.leaf(".".join(cm.path_in_schema))
+        if node is not None and (st.min_value is not None
+                                 or st.max_value is not None):
+            h = handler_for(node.element)
+            try:
+                mn = (h.decode_stat_logical(st.min_value)
+                      if st.min_value is not None else None)
+                mx = (h.decode_stat_logical(st.max_value)
+                      if st.max_value is not None else None)
+                bits.append(f"stats=[{_fmt_stat(mn)} .. {_fmt_stat(mx)}]")
+            except (ValueError, TypeError):
+                bits.append("stats=<undecodable>")
+        if st.null_count is not None:
+            bits.append(f"nulls={st.null_count}")
+    idx = []
+    if cc.column_index_offset is not None:
+        idx.append("column")
+    if cc.offset_index_offset is not None:
+        idx.append("offset")
+    if idx:
+        bits.append(f"page-index={'+'.join(idx)}")
+    if cm.bloom_filter_offset is not None:
+        bits.append("bloom=yes")
+    return ("  " + "  ".join(bits)) if bits else ""
+
+
 def cmd_meta(args, out=None) -> int:
-    """Flat schema with repetition + R/D levels (``readfile.go:75-104``);
-    ``--strict`` additionally runs the metadata validator
-    (``format/validate.py``) and exits nonzero on error findings."""
+    """Flat schema with repetition + R/D levels (``readfile.go:75-104``),
+    per-chunk statistics decoded to logical values, and page-index /
+    bloom presence flags; ``--strict`` additionally runs the metadata
+    validator (``format/validate.py``) and exits nonzero on error
+    findings."""
     out = out or sys.stdout
     rc = 0
     with FileReader(args.file) as r:
@@ -125,7 +171,8 @@ def cmd_meta(args, out=None) -> int:
                       f"{cm.type.name} {cm.codec.name} "
                       f"values={cm.num_values} "
                       f"compressed={cm.total_compressed_size} "
-                      f"uncompressed={cm.total_uncompressed_size}",
+                      f"uncompressed={cm.total_uncompressed_size}"
+                      + _chunk_extras(r, cc),
                       file=out)
         if getattr(args, "strict", False):
             rc = _report_findings(r, args.file, out)
@@ -301,9 +348,27 @@ def cmd_profile(args, out=None) -> int:
                          "(or --from-events pages.jsonl)")
     else:
         mirrors = [m for m in (getattr(args, "mirror", None) or []) if m]
+        filt = None
+        if getattr(args, "filter", None):
+            from ..filter import parse_filter
+
+            filt = parse_filter(args.filter)
         with FileReader(args.file, mirrors=mirrors) as r:
             with collect_stats(events=True) as st:
-                if getattr(args, "cpu", False):
+                if filt is not None:
+                    # predicate-pushdown profile: the pruning section
+                    # below shows what the filter statically skipped
+                    from ..kernels.device import read_row_group_device
+
+                    for rg in range(r.row_group_count()):
+                        if getattr(args, "cpu", False):
+                            r.read_row_group_arrays(rg, filter=filt)
+                        else:
+                            cols = read_row_group_device(
+                                r, rg, filter=filt)
+                            for c in cols.values():
+                                c.block_until_ready()
+                elif getattr(args, "cpu", False):
                     for rg in range(r.row_group_count()):
                         r.read_row_group_arrays(rg)
                 else:
@@ -356,6 +421,19 @@ def _print_profile(log, st, out) -> None:
                   f"{d['plan_cache_misses']} misses  "
                   f"{d['plan_cache_evictions']} evictions  "
                   f"(spans: {cache_spans})", file=out)
+        # predicate-pushdown section: what the filter statically skipped
+        # and what the exact pass kept (tpuparquet/filter.py)
+        if (d["row_groups_pruned"] or d["pages_pruned"]
+                or d["rows_pruned"] or d["bloom_hits"]
+                or d["filter_rows_in"]):
+            sel = (f"  selectivity {d['selectivity']:.4f}"
+                   if d.get("selectivity") is not None else "")
+            print(f"pruning: {d['row_groups_pruned']} row groups  "
+                  f"{d['pages_pruned']} pages  "
+                  f"{d['rows_pruned']:,} rows skipped  "
+                  f"{d['bloom_hits']} bloom hits  "
+                  f"exact {d['filter_rows_out']:,}/"
+                  f"{d['filter_rows_in']:,} rows{sel}", file=out)
         print(st.summary(), file=out)
     # per-column time-domain tallies: which column's reads hedged /
     # expired (global counts alone can't localize a degraded replica)
@@ -561,6 +639,7 @@ def _rescue(args, like, out, CompressionCodec, created: list) -> int:
                 # page/bloom indexes are NOT copied: drop their offsets
                 ncm.index_page_offset = None
                 ncm.bloom_filter_offset = None
+                ncm.bloom_filter_length = None
                 cols.append(ColumnChunk(file_offset=pos, meta_data=ncm))
             new_rgs.append(RowGroup(
                 columns=cols,
@@ -714,6 +793,10 @@ def build_parser() -> argparse.ArgumentParser:
     pf.add_argument("--json", action="store_true",
                     help="emit the whole profile digest as "
                          "machine-readable JSON instead of the table")
+    pf.add_argument("--filter", default="",
+                    help="predicate to push down, e.g. "
+                         "\"x > 100 & s in ('a','b')\" — the profile "
+                         "then shows the pruning counters")
     pf.add_argument("--from-events", metavar="FILE", default="",
                     dest="from_events",
                     help="analyze a SAVED pages.jsonl event log "

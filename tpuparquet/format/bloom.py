@@ -1,0 +1,235 @@
+"""Split-block bloom filters (parquet-format BloomFilter.md).
+
+The format's bloom filter is a *split-block* bloom filter (SBBF):
+the bitset is an array of 32-byte blocks (8 u32 words); a value's
+XXH64 hash picks one block (top 32 bits scaled by the block count)
+and sets/checks one bit per word, chosen by 8 fixed odd salt
+constants multiplied against the low 32 bits.  Membership tests have
+no false negatives — a "definitely absent" answer licenses pruning a
+whole column chunk for ``==`` / ``IN`` predicates.
+
+Hash input is the value's PLAIN encoding without a length prefix
+(little-endian bytes for numerics, the raw bytes for BYTE_ARRAY /
+FIXED_LEN_BYTE_ARRAY) — exactly what
+:meth:`~tpuparquet.io.values.ValueHandler.encode_stat_value` emits, so
+the statistics and bloom layers share one value-encoding contract.
+
+Serialization (``format/metadata.py`` structs): a compact-thrift
+:class:`~tpuparquet.format.metadata.BloomFilterHeader` (numBytes +
+algorithm/hash/compression unions) immediately followed by the raw
+bitset, at ``ColumnMetaData.bloom_filter_offset``.  XXH64 is
+implemented here in pure Python (the container has no xxhash module);
+bloom columns are opt-in and dictionary-ish, so the handful of
+thousands of hashes per chunk cost milliseconds.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from .metadata import (
+    BloomFilterAlgorithm,
+    BloomFilterCompression,
+    BloomFilterHash,
+    BloomFilterHeader,
+    SplitBlockAlgorithm,
+    Uncompressed,
+    XxHash,
+)
+from .compact import CompactReader, ThriftError
+
+__all__ = ["xxh64", "xxh64_py", "SplitBlockBloom", "optimal_bytes",
+           "MAX_BLOOM_BYTES"]
+
+_M64 = (1 << 64) - 1
+_P1 = 0x9E3779B185EBCA87
+_P2 = 0xC2B2AE3D27D4EB4F
+_P3 = 0x165667B19E3779F9
+_P4 = 0x85EBCA77C2B2AE63
+_P5 = 0x27D4EB2F165667C5
+
+# the 8 salt constants of the split-block algorithm (BloomFilter.md)
+_SALT = (0x47b6137b, 0x44974d91, 0x8824ad5b, 0xa2b7289d,
+         0x705495c7, 0x2df1424b, 0x9efc4947, 0x5c6bfb31)
+
+# refuse to read absurd bitsets from untrusted metadata (a corrupt
+# numBytes must degrade to "no bloom", not an allocation bomb)
+MAX_BLOOM_BYTES = 64 << 20
+
+
+def _rotl(x: int, r: int) -> int:
+    return ((x << r) | (x >> (64 - r))) & _M64
+
+
+def _round(acc: int, inp: int) -> int:
+    acc = (acc + inp * _P2) & _M64
+    return (_rotl(acc, 31) * _P1) & _M64
+
+
+try:  # the C library when present (pure-Python fallback below is
+    # bit-identical — pinned by tests — just slower)
+    import xxhash as _xxhash_mod
+except ImportError:
+    _xxhash_mod = None
+
+
+def xxh64(data: bytes, seed: int = 0) -> int:
+    """XXH64 of ``data`` (C library when installed, else the pure-Python
+    fallback :func:`xxh64_py`; both match the reference vectors)."""
+    if _xxhash_mod is not None:
+        return _xxhash_mod.xxh64(data, seed=seed).intdigest()
+    return xxh64_py(data, seed)
+
+
+def xxh64_py(data: bytes, seed: int = 0) -> int:
+    """Pure-Python XXH64 (the no-dependency fallback)."""
+    n = len(data)
+    pos = 0
+    if n >= 32:
+        v1 = (seed + _P1 + _P2) & _M64
+        v2 = (seed + _P2) & _M64
+        v3 = seed & _M64
+        v4 = (seed - _P1) & _M64
+        limit = n - 32
+        while pos <= limit:
+            lanes = struct.unpack_from("<4Q", data, pos)
+            v1 = _round(v1, lanes[0])
+            v2 = _round(v2, lanes[1])
+            v3 = _round(v3, lanes[2])
+            v4 = _round(v4, lanes[3])
+            pos += 32
+        h = (_rotl(v1, 1) + _rotl(v2, 7) + _rotl(v3, 12)
+             + _rotl(v4, 18)) & _M64
+        for v in (v1, v2, v3, v4):
+            h = ((h ^ _round(0, v)) * _P1 + _P4) & _M64
+    else:
+        h = (seed + _P5) & _M64
+    h = (h + n) & _M64
+    while pos + 8 <= n:
+        (k,) = struct.unpack_from("<Q", data, pos)
+        h = ((_rotl(h ^ _round(0, k), 27) * _P1) + _P4) & _M64
+        pos += 8
+    if pos + 4 <= n:
+        (k,) = struct.unpack_from("<I", data, pos)
+        h = ((_rotl(h ^ (k * _P1) & _M64, 23) * _P2) + _P3) & _M64
+        pos += 4
+    while pos < n:
+        h = (_rotl(h ^ (data[pos] * _P5) & _M64, 11) * _P1) & _M64
+        pos += 1
+    h ^= h >> 33
+    h = (h * _P2) & _M64
+    h ^= h >> 29
+    h = (h * _P3) & _M64
+    h ^= h >> 32
+    return h
+
+
+def optimal_bytes(ndv: int, fpp: float = 0.01) -> int:
+    """Bitset size for ``ndv`` distinct values at ~``fpp`` false-positive
+    rate, rounded up to a power-of-two number of 32-byte blocks
+    (BloomFilter.md sizing: c = -8 / log(1 - fpp^(1/8)) bits/value)."""
+    import math
+
+    if ndv <= 0:
+        return 32
+    c = -8.0 / math.log(1.0 - fpp ** 0.125)
+    bits = int(ndv * c)
+    nbytes = max((bits + 7) // 8, 32)
+    blocks = 1 << max((nbytes + 31) // 32 - 1, 0).bit_length()
+    return min(blocks * 32, MAX_BLOOM_BYTES)
+
+
+class SplitBlockBloom:
+    """One column chunk's split-block bloom filter."""
+
+    __slots__ = ("bitset",)
+
+    def __init__(self, num_bytes: int = 32, bitset=None):
+        if bitset is not None:
+            self.bitset = np.asarray(bitset, dtype=np.uint32)
+            if self.bitset.size % 8:
+                raise ValueError("bloom bitset must be whole 32B blocks")
+        else:
+            if num_bytes < 32 or num_bytes % 32:
+                raise ValueError(
+                    f"bloom bitset bytes must be a positive multiple "
+                    f"of 32, not {num_bytes}")
+            self.bitset = np.zeros(num_bytes // 4, dtype=np.uint32)
+
+    @property
+    def num_bytes(self) -> int:
+        return int(self.bitset.nbytes)
+
+    @property
+    def _num_blocks(self) -> int:
+        return self.bitset.size // 8
+
+    def _block_and_mask(self, h: int):
+        block = ((h >> 32) * self._num_blocks) >> 32
+        lo = h & 0xFFFFFFFF
+        mask = [np.uint32((lo * s) & 0xFFFFFFFF) >> np.uint32(27)
+                for s in _SALT]
+        return block, mask
+
+    def insert_hash(self, h: int) -> None:
+        block, mask = self._block_and_mask(h)
+        base = block * 8
+        for i, bit in enumerate(mask):
+            self.bitset[base + i] |= np.uint32(1) << bit
+
+    def check_hash(self, h: int) -> bool:
+        """False = definitely absent; True = possibly present."""
+        block, mask = self._block_and_mask(h)
+        base = block * 8
+        for i, bit in enumerate(mask):
+            if not (int(self.bitset[base + i]) >> int(bit)) & 1:
+                return False
+        return True
+
+    def insert(self, encoded: bytes) -> None:
+        self.insert_hash(xxh64(encoded))
+
+    def check(self, encoded: bytes) -> bool:
+        return self.check_hash(xxh64(encoded))
+
+    # -- wire form (BloomFilterHeader thrift + raw bitset) ---------------
+
+    def to_bytes(self) -> bytes:
+        header = BloomFilterHeader(
+            numBytes=self.num_bytes,
+            algorithm=BloomFilterAlgorithm(BLOCK=SplitBlockAlgorithm()),
+            hash=BloomFilterHash(XXHASH=XxHash()),
+            compression=BloomFilterCompression(
+                UNCOMPRESSED=Uncompressed()),
+        )
+        return header.to_bytes() + self.bitset.astype("<u4").tobytes()
+
+    @classmethod
+    def from_bytes(cls, buf, pos: int = 0) -> "SplitBlockBloom":
+        """Parse header + bitset at ``pos``; raises ``ValueError`` on
+        anything that is not a well-formed uncompressed XXH64 SBBF (the
+        callers degrade to "no bloom")."""
+        r = CompactReader(buf, pos)
+        try:
+            from .metadata import decode_struct
+
+            header = decode_struct(BloomFilterHeader, r)
+        except (ThriftError, IndexError, struct.error) as e:
+            raise ValueError(f"corrupt bloom filter header: {e}") from e
+        nb = header.numBytes
+        if nb is None or nb < 32 or nb % 32 or nb > MAX_BLOOM_BYTES:
+            raise ValueError(f"bloom filter numBytes {nb} out of range")
+        if header.algorithm is None or header.algorithm.BLOCK is None:
+            raise ValueError("bloom filter algorithm is not split-block")
+        if header.hash is None or header.hash.XXHASH is None:
+            raise ValueError("bloom filter hash is not XXH64")
+        if (header.compression is None
+                or header.compression.UNCOMPRESSED is None):
+            raise ValueError("bloom filter compression unsupported")
+        end = r.pos + nb
+        if end > len(buf):
+            raise ValueError("bloom filter bitset overruns the buffer")
+        bits = np.frombuffer(bytes(buf[r.pos:end]), dtype="<u4")
+        return cls(bitset=bits.astype(np.uint32))

@@ -400,6 +400,10 @@ class UUIDType(ThriftStruct):
     FIELDS = []
 
 
+class Float16Type(ThriftStruct):
+    FIELDS = []
+
+
 class MapType(ThriftStruct):
     FIELDS = []
 
@@ -483,6 +487,7 @@ class LogicalType(ThriftStruct):
         (12, "JSON", _S(JsonType)),
         (13, "BSON", _S(BsonType)),
         (14, "UUID", _S(UUIDType)),
+        (15, "FLOAT16", _S(Float16Type)),
     ]
 
     def set_member(self):
@@ -626,6 +631,7 @@ class ColumnMetaData(ThriftStruct):
         (12, "statistics", _S(Statistics)),
         (13, "encoding_stats", _TList(_S(PageEncodingStats))),
         (14, "bloom_filter_offset", I64),
+        (15, "bloom_filter_length", I32),
     ]
 
 

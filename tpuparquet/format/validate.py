@@ -29,6 +29,7 @@ from .schema import Schema
 __all__ = [
     "Finding",
     "validate_metadata",
+    "validate_page_index",
     "raise_on_errors",
     "strict_metadata_default",
 ]
@@ -297,6 +298,162 @@ def _validate_chunk(findings, cc, rgi, rg, leaves, file_size,
              f"({file_size} bytes)", offset=start, **at)
         return
     seen_ranges.append((start, end, rgi, path))
+
+    # statistics self-consistency: decoded min must not exceed max
+    # under the column's own order, and null_count must fit the chunk
+    # (predicate pushdown trusts these bounds to prune — a lying
+    # summary must be a structured finding, not a wrong result)
+    st = cm.statistics
+    if st is not None:
+        if st.null_count is not None and (
+                st.null_count < 0 or st.null_count > cm.num_values):
+            _err(findings, "stats-null-count",
+                 f"statistics null_count {st.null_count} outside "
+                 f"[0, {cm.num_values}]", **at)
+        if st.min_value is not None and st.max_value is not None:
+            try:
+                from ..io.values import handler_for
+                h = handler_for(leaf.element)
+                if not h.stats_bytewise_comparable():
+                    mn = mx = None  # order not bytewise: uncheckable
+                else:
+                    mn = h.decode_stat_logical(st.min_value)
+                    mx = h.decode_stat_logical(st.max_value)
+            except Exception:
+                mn = mx = None  # undecodable bounds: bounded below
+            if mn is not None and mx is not None:
+                try:
+                    bad = mn > mx
+                except TypeError:
+                    bad = False
+                if bad:
+                    _err(findings, "stats-min-gt-max",
+                         f"statistics min {mn!r} > max {mx!r}", **at)
+
+    # page-index / bloom pointers must land inside the file.  WARN,
+    # not error: an unreadable index only costs pruning efficiency
+    # (reads degrade to "no pruning"), and a truncated-but-salvageable
+    # file has every row group's index pointer dangling — error-level
+    # findings here would wreck the salvage valid-prefix trim for
+    # row groups whose DATA is intact.
+    for off_name, len_name in (
+            ("column_index_offset", "column_index_length"),
+            ("offset_index_offset", "offset_index_length")):
+        off = getattr(cc, off_name)
+        ln = getattr(cc, len_name)
+        if off is None and ln is None:
+            continue
+        if off is None or ln is None or off < 4 or ln <= 0 \
+                or off + ln > file_size:
+            _warn(findings, "pageindex-oob",
+                  f"{off_name}/{len_name} [{off}, "
+                  f"{off if off is None or ln is None else off + ln}) "
+                  f"outside the file ({file_size} bytes)", **at)
+    boff, blen = cm.bloom_filter_offset, cm.bloom_filter_length
+    if boff is not None and (
+            boff < 4 or boff >= file_size
+            or (blen is not None
+                and (blen <= 0 or boff + blen > file_size))):
+        _warn(findings, "bloom-oob",
+              f"bloom_filter_offset/length [{boff}, "
+              f"{boff if blen is None else boff + blen}) outside the "
+              f"file ({file_size} bytes)", **at)
+
+
+def validate_page_index(ci, oi, cm, num_rows: int, file_size: int, *,
+                        element=None, row_group=None) -> list[Finding]:
+    """Cross-check one column's decoded ``ColumnIndex``/``OffsetIndex``
+    pair against its chunk metadata — the read-side guard that turns a
+    lying page index into structured findings so pruning degrades to
+    "decode everything" instead of skipping rows it shouldn't.
+
+    Checks: the two structs agree on the page count, per-page bounds
+    decode with min ≤ max (column order), page locations stay inside
+    the chunk's byte range, and ``first_row_index`` is 0-based, strictly
+    increasing and within the row group.  Pure function — the caller
+    already read and thrift-decoded the structs."""
+    findings: list[Finding] = []
+    path = ".".join(cm.path_in_schema) if cm.path_in_schema else None
+    at = {"row_group": row_group, "column": path}
+
+    locs = oi.page_locations if oi is not None else None
+    if not locs:
+        _err(findings, "pageindex-empty",
+             "OffsetIndex has no page locations", **at)
+        return findings
+    n = len(locs)
+    for name, lst in (("null_pages", ci.null_pages),
+                      ("min_values", ci.min_values),
+                      ("max_values", ci.max_values)):
+        if lst is None or len(lst) != n:
+            _err(findings, "pageindex-count",
+                 f"ColumnIndex.{name} has "
+                 f"{0 if lst is None else len(lst)} entries, OffsetIndex "
+                 f"has {n} pages", **at)
+            return findings
+    if ci.null_counts is not None and len(ci.null_counts) != n:
+        _err(findings, "pageindex-count",
+             f"ColumnIndex.null_counts has {len(ci.null_counts)} "
+             f"entries, OffsetIndex has {n} pages", **at)
+
+    start = cm.data_page_offset
+    if cm.dictionary_page_offset is not None:
+        start = min(start, cm.dictionary_page_offset)
+    chunk_end = start + cm.total_compressed_size
+    prev_row = -1
+    for i, loc in enumerate(locs):
+        if loc.offset is None or loc.compressed_page_size is None \
+                or loc.first_row_index is None:
+            _err(findings, "pageindex-missing-fields",
+                 f"page location {i} missing required fields", **at)
+            return findings
+        if loc.offset < start or loc.compressed_page_size <= 0 \
+                or loc.offset + loc.compressed_page_size > chunk_end \
+                or loc.offset + loc.compressed_page_size > file_size:
+            _err(findings, "pageindex-loc-oob",
+                 f"page {i} byte range [{loc.offset}, "
+                 f"{loc.offset + loc.compressed_page_size}) escapes the "
+                 f"chunk [{start}, {chunk_end})",
+                 offset=loc.offset, **at)
+        fr = loc.first_row_index
+        if fr <= prev_row or fr >= max(num_rows, 1) \
+                or (i == 0 and fr != 0):
+            _err(findings, "pageindex-rows",
+                 f"page {i} first_row_index {fr} is not strictly "
+                 f"increasing from 0 within {num_rows} rows", **at)
+            return findings
+        prev_row = fr
+
+    handler = None
+    if element is not None:
+        try:
+            from ..io.values import handler_for
+
+            handler = handler_for(element)
+            if not handler.stats_bytewise_comparable():
+                handler = None  # order not bytewise: bounds uncheckable
+        except Exception:
+            handler = None
+    for i in range(n):
+        if ci.null_pages[i]:
+            continue
+        mn_b, mx_b = ci.min_values[i], ci.max_values[i]
+        if mn_b is None or mx_b is None or mn_b == b"" or mx_b == b"":
+            _err(findings, "pageindex-bounds",
+                 f"non-null page {i} carries empty min/max", **at)
+            continue
+        if handler is None:
+            continue
+        try:
+            mn = handler.decode_stat_logical(mn_b)
+            mx = handler.decode_stat_logical(mx_b)
+            bad = mn is not None and mx is not None and mn > mx
+        except Exception:
+            bad = True  # bounds that don't decode cannot be trusted
+        if bad:
+            _err(findings, "pageindex-min-gt-max",
+                 f"page {i} min > max under the column's order", **at)
+    return findings
 
 
 def raise_on_errors(findings: list[Finding], *, file=None) -> None:

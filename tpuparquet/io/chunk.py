@@ -70,17 +70,38 @@ class ChunkData:
 
 
 def read_chunk(blob: "bytes | memoryview", cm: ColumnMetaData,
-               node: SchemaNode, verify_crc: bool | None = None) -> ChunkData:
+               node: SchemaNode, verify_crc: bool | None = None,
+               keep_rows=None):
     """Decode one column chunk from the file bytes.
 
     Pass a memoryview for zero-copy page payloads (a bytes blob still
     works but its page slices copy).  ``verify_crc`` gates page CRC32
     verification when headers carry one (None = env default, see
-    :func:`~tpuparquet.io.pages.crc_verify_default`)."""
+    :func:`~tpuparquet.io.pages.crc_verify_default`).
+
+    ``keep_rows`` (predicate-pushdown page pruning; flat non-repeated
+    columns only) is a bool mask over the chunk's rows: data pages
+    whose whole row range is False are SKIPPED — header parsed, body
+    neither decompressed nor decoded (``DecodeStats.pages_pruned``).
+    The return becomes ``(ChunkData, kept)`` where ``kept`` holds the
+    global row indices of the decoded rows (the rows of every kept
+    page — a superset of the True rows, exact at page granularity)."""
     codec = CompressionCodec(cm.codec)
     col_path = ".".join(cm.path_in_schema)
     if verify_crc is None:
         verify_crc = crc_verify_default()
+    if keep_rows is not None:
+        if node.max_rep_level:
+            raise ValueError(
+                f"page pruning needs a non-repeated column, not "
+                f"{col_path!r}")
+        keep_rows = np.asarray(keep_rows, dtype=bool)
+        if keep_rows.size != cm.num_values:
+            raise ValueError(
+                f"keep_rows has {keep_rows.size} entries for a "
+                f"{cm.num_values}-value chunk")
+    kept_parts: list = []  # per kept page: (row_start, n)
+    row_base = 0
     start = cm.data_page_offset
     if cm.dictionary_page_offset is not None:
         start = min(start, cm.dictionary_page_offset)
@@ -129,12 +150,33 @@ def read_chunk(blob: "bytes | memoryview", cm: ColumnMetaData,
                                    column=col_path, page=page_i)
         payload = filter_bytes("io.chunk.page_payload", payload,
                                column=col_path, page=page_i)
+        r.pos += ph.compressed_page_size
+        ptype = PageType(ph.type)
+        if keep_rows is not None and ptype in (
+                PageType.DATA_PAGE, PageType.DATA_PAGE_V2):
+            h = (ph.data_page_header_v2
+                 if ptype == PageType.DATA_PAGE_V2
+                 else ph.data_page_header)
+            n_pg = None if h is None else h.num_values
+            if n_pg is not None and n_pg >= 0 \
+                    and not keep_rows[row_base:row_base + n_pg].any():
+                # pruned page: header walked, body never verified,
+                # decompressed, nor decoded — the predicate proved no
+                # row of it survives
+                values_read += n_pg
+                row_base += n_pg
+                page_i += 1
+                if st is not None:
+                    st.pages_pruned += 1
+                if _flightrec._active is not None:
+                    _flightrec.flight(
+                        "page_pruned", site="io.chunk",
+                        column=col_path, page=page_i - 1, values=n_pg)
+                continue
         checked = verify_page_crc(ph, payload, enabled=verify_crc,
                                   column=col_path, page=page_i)
         if checked and st is not None:
             st.pages_crc_verified += 1
-        r.pos += ph.compressed_page_size
-        ptype = PageType(ph.type)
         try:
             if ptype == PageType.DICTIONARY_PAGE:
                 if dictionary is not None:
@@ -156,6 +198,9 @@ def read_chunk(blob: "bytes | memoryview", cm: ColumnMetaData,
                 pg = (decode_data_page_v2 if v2 else decode_data_page_v1)(
                     ph, payload, codec, node, dictionary)
                 values_read += pg.num_values
+                if keep_rows is not None:
+                    kept_parts.append((row_base, pg.num_values))
+                    row_base += pg.num_values
                 pages.append(pg)
                 # flight recorder: page coordinates ride the ring even
                 # with no collector (one `is None` check when off —
@@ -217,7 +262,13 @@ def read_chunk(blob: "bytes | memoryview", cm: ColumnMetaData,
         else 0
 
     values = _merge_page_values(pages, dictionary, node)
-    return ChunkData(values, rep, dl, null_count)
+    cd = ChunkData(values, rep, dl, null_count)
+    if keep_rows is None:
+        return cd
+    kept = (np.concatenate([np.arange(s, s + n, dtype=np.int64)
+                            for s, n in kept_parts])
+            if kept_parts else np.empty(0, dtype=np.int64))
+    return cd, kept
 
 
 def _merge_page_values(pages, dictionary, node):
@@ -361,9 +412,19 @@ def write_chunk(out, node: SchemaNode, column, rep, dl, *,
                 num_rows: int | None = None,
                 kv_metadata: dict | None = None,
                 write_stats: bool = True,
-                page_crc: bool = True) -> ColumnChunk:
+                page_crc: bool = True,
+                page_index: bool = False,
+                bloom: bool = False) -> ColumnChunk:
     """Write one column chunk at the current position of ``out`` (a
-    position-tracking binary stream); returns its ColumnChunk metadata."""
+    position-tracking binary stream); returns its ColumnChunk metadata.
+
+    ``page_index=True`` attaches a per-page ``ColumnIndex``/
+    ``OffsetIndex`` pair as ``cc._page_index`` (page offsets relative
+    to this stream's positions; the writer serializes them after the
+    row groups and records their offsets — see
+    ``FileWriter._write_indexes``).  ``bloom=True`` attaches a
+    split-block bloom filter over the chunk's distinct values as
+    ``cc._bloom`` (``format/bloom.py``)."""
     from .values import handler_for
 
     handler = handler_for(node.element)
@@ -422,6 +483,7 @@ def write_chunk(out, node: SchemaNode, column, rep, dl, *,
     data_page_offset = out.tell()
     page_column = indices if dictionary is not None else column
     dict_size = distinct if dictionary is not None else None
+    data_page_start = data_page_offset  # page-index coordinates
     if page_version == 2:
         c, u = write_data_page_v2(
             out, node, page_column, rep, dl, codec, encoding,
@@ -459,4 +521,104 @@ def write_chunk(out, node: SchemaNode, column, rep, dl, *,
         statistics=stats,
         key_value_metadata=kv,
     )
-    return ColumnChunk(file_offset=pos0, meta_data=cm)
+    cc = ColumnChunk(file_offset=pos0, meta_data=cm)
+    if page_index and stats is not None:
+        pi = _build_page_index(node, stats, n_values, data_page_start, c)
+        if pi is not None:
+            cc._page_index = pi
+    if bloom:
+        b = _build_bloom(node, column, dictionary)
+        if b is not None:
+            cc._bloom = b
+    return cc
+
+
+def _build_page_index(node, stats: Statistics, n_values: int,
+                      page_offset: int, page_size: int):
+    """Per-page ``(ColumnIndex, OffsetIndex)`` for this writer's
+    single-data-page chunks (page summary == chunk statistics; the
+    structs generalize to any page count).  Returns None when the
+    column's order admits no index (INT96, or stats carry no bounds
+    for a non-empty page)."""
+    from ..format.metadata import (
+        BoundaryOrder,
+        ColumnIndex,
+        OffsetIndex,
+        PageLocation,
+    )
+
+    all_null = (stats.null_count is not None
+                and stats.null_count == n_values)
+    if stats.min_value is None or stats.max_value is None:
+        if not all_null:
+            return None  # unordered type (INT96): no index possible
+        mins, maxs, null_pages = [b""], [b""], [True]
+    else:
+        mins = [stats.min_value]
+        maxs = [stats.max_value]
+        null_pages = [all_null]
+    ci = ColumnIndex(
+        null_pages=null_pages,
+        min_values=mins,
+        max_values=maxs,
+        boundary_order=BoundaryOrder.ASCENDING,
+        null_counts=([stats.null_count]
+                     if stats.null_count is not None else None),
+    )
+    oi = OffsetIndex(page_locations=[PageLocation(
+        offset=page_offset,
+        compressed_page_size=page_size,
+        first_row_index=0,
+    )])
+    return ci, oi
+
+
+# skip bloom construction past this many distinct values: the filter
+# would be megabytes and the column is not "dictionary-ish"
+MAX_BLOOM_DISTINCT = 1 << 16
+
+
+def _build_bloom(node, column, dictionary):
+    """Split-block bloom filter over the chunk's distinct values, or
+    None when the column is unsuitable (too many distinct, undefined
+    order, empty).  The dictionary, when one was built, IS the
+    distinct set; otherwise distinct values are derived here."""
+    from ..format.bloom import SplitBlockBloom, optimal_bytes
+    from .values import handler_for, is_device_values
+
+    handler = handler_for(node.element)
+    if handler.ptype in (Type.INT96, Type.BOOLEAN):
+        return None  # undefined order / 1-bit domain: bloom is useless
+    src = dictionary if dictionary is not None else column
+    if is_device_values(src):
+        src = src.to_numpy()  # device columns: pull once for hashing
+    if isinstance(src, ByteArrayColumn):
+        distinct = set(src.to_list())
+        if len(distinct) > MAX_BLOOM_DISTINCT:
+            return None
+        encoded = distinct
+    else:
+        arr = np.asarray(src)
+        if arr.size == 0:
+            return None
+        if arr.ndim == 2:  # FLBA byte rows
+            view = np.ascontiguousarray(arr).view(
+                np.dtype((np.void, arr.shape[1]))).reshape(-1)
+            uniq = np.unique(view)
+            if uniq.size > MAX_BLOOM_DISTINCT:
+                return None
+            encoded = [bytes(v) for v in uniq]
+        else:
+            uniq = np.unique(arr)
+            if uniq.size > MAX_BLOOM_DISTINCT:
+                return None
+            # PLAIN little-endian bytes of each distinct value — the
+            # same framing encode_stat_value uses, one bulk tobytes
+            encoded = [uniq[i:i + 1].tobytes()
+                       for i in range(uniq.size)]
+    if not encoded:
+        return None
+    b = SplitBlockBloom(optimal_bytes(len(encoded)))
+    for e in encoded:
+        b.insert(e)
+    return b

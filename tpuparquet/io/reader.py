@@ -181,6 +181,11 @@ class FileReader:
         # A rewritten file gets a new footer and therefore a new
         # fingerprint, so stale plans age out.
         self._plan_fp = _FP_UNSET
+        # page-index / bloom caches (predicate pushdown): parsed once
+        # per (rg, column); a corrupt index parses to None = no pruning
+        self._pageindex_cache: dict = {}
+        self._bloom_cache: dict = {}
+        self.pageindex_findings: list = []
         self._rg_pos = 0          # next row group to load
         self._loaded = False      # current row group loaded into stores
         self._current_rg = 0      # last loaded (or next) row group index
@@ -397,13 +402,324 @@ class FileReader:
     def get_schema_definition(self):
         return self.schema.definition()
 
+    # -- predicate pushdown: page index, bloom filters, prune verdicts ----
+
+    def _read_range(self, start: int, size: int) -> bytes:
+        """Small absolute-range read off the primary handle (page-index
+        and bloom blobs); zero-copy for in-memory sources.  Raises
+        ``ValueError`` when the range escapes the file."""
+        if start < 0 or size <= 0:
+            raise ValueError(f"bad byte range [{start}, {start + size})")
+        if self._buf is not None:
+            if start + size > len(self._buf):
+                raise ValueError("byte range overruns the file")
+            return bytes(self._buf[start : start + size])
+        with self._count_lock:
+            h = self._io
+            h.inflight += 1
+        try:
+            with h.lock:
+                h.f.seek(start)
+                out = h.f.read(size)
+        finally:
+            with self._count_lock:
+                h.inflight -= 1
+        if len(out) != size:
+            raise ValueError(
+                f"short read: {len(out)}/{size} bytes at {start}")
+        return out
+
+    def page_index(self, rg_index: int, columns=None) -> dict:
+        """Parsed page index of one row group: ``{column: pages}`` where
+        ``pages`` is a list of ``(row_start, row_end, min, max,
+        null_count, null_page)`` per data page (bounds decoded to
+        LOGICAL values) — exactly the shape
+        :func:`tpuparquet.filter.candidate_mask` consumes.  Columns
+        without an index (or whose index fails validation — fault site
+        ``format.pageindex``) are absent: conservative "no pruning".
+        Results cache per reader, and in the footer-keyed plan cache
+        (``TPQ_PLAN_CACHE_MB``) across reopens of the same file."""
+        from ..faults import fault_point, filter_bytes
+        from ..format.compact import ThriftError
+        from ..format.metadata import ColumnIndex, OffsetIndex
+        from ..format.validate import validate_page_index
+        from ..kernels.plancache import plan_cache
+        from .values import handler_for
+
+        want = None if columns is None else set(columns)
+
+        def _view(parsed: dict) -> dict:
+            return ({k: v for k, v in parsed.items() if k in want}
+                    if want is not None else dict(parsed))
+
+        cached = self._pageindex_cache.get(rg_index)
+        if cached is not None:
+            return _view(cached)
+
+        pc = plan_cache()
+        pc_key = None
+        if pc is not None and self.plan_fingerprint is not None:
+            pc_key = (self.plan_fingerprint, rg_index, "__pageindex__")
+            got = pc.lookup(pc_key)
+            if got is not None:
+                out = {col: pages for col, pages in got
+                       if pages is not None}
+                self._pageindex_cache[rg_index] = out
+                return _view(out)
+
+        from ..errors import TransientIOError
+
+        rg = self.meta.row_groups[rg_index]
+        size = _source_size(self._f) if self._buf is None \
+            else len(self._buf)
+        out: dict = {}
+        absent: set = set()
+        transient = False
+        for cc in rg.columns:
+            cm = cc.meta_data
+            path = ".".join(cm.path_in_schema)
+            if cc.column_index_offset is None \
+                    or cc.column_index_length is None \
+                    or cc.offset_index_offset is None \
+                    or cc.offset_index_length is None:
+                absent.add(path)
+                continue
+            node = self.schema.leaf(path)
+            try:
+                fault_point("format.pageindex", file=self.name,
+                            column=path)
+                # same retry policy as chunk reads: a flaky-store blip
+                # must not masquerade as a corrupt index
+                ci_blob = filter_bytes(
+                    "format.pageindex",
+                    retry_transient(lambda: self._read_range(
+                        cc.column_index_offset,
+                        cc.column_index_length)),
+                    column=path)
+                oi_blob = retry_transient(lambda: self._read_range(
+                    cc.offset_index_offset, cc.offset_index_length))
+                ci = ColumnIndex.from_bytes(ci_blob)
+                oi = OffsetIndex.from_bytes(oi_blob)
+                findings = validate_page_index(
+                    ci, oi, cm, rg.num_rows, size,
+                    element=None if node is None else node.element,
+                    row_group=rg_index)
+                if any(f.is_error for f in findings):
+                    self.pageindex_findings.extend(findings)
+                    raise ValueError(
+                        f"page index failed validation: "
+                        f"{[f for f in findings if f.is_error][0]}")
+                handler = (handler_for(node.element)
+                           if node is not None else None)
+                if handler is not None \
+                        and not handler.stats_bytewise_comparable():
+                    handler = None  # bounds unusable: rows kept
+                locs = oi.page_locations
+                pages = []
+                for i, loc in enumerate(locs):
+                    r0 = loc.first_row_index
+                    r1 = (locs[i + 1].first_row_index
+                          if i + 1 < len(locs) else rg.num_rows)
+                    null_page = bool(ci.null_pages[i])
+                    if null_page or handler is None:
+                        mn = mx = None
+                    else:
+                        mn = handler.decode_stat_logical(
+                            ci.min_values[i])
+                        mx = handler.decode_stat_logical(
+                            ci.max_values[i])
+                    nulls = (ci.null_counts[i]
+                             if ci.null_counts is not None else None)
+                    pages.append((r0, r1, mn, mx, nulls, null_page))
+                out[path] = pages
+            except (ScanError, OSError, ValueError, ThriftError,
+                    IndexError, KeyError, TypeError,
+                    OverflowError) as e:
+                # corrupt/lying index: degrade this COLUMN to
+                # "no pruning" — results stay exact, only efficiency
+                # is lost.  The incident is observable: flight record
+                # + fault event with coordinates.  A TRANSIENT failure
+                # that outlived its retries degrades this scan the
+                # same way, but must not be remembered as
+                # "index absent" by the cross-reopen plan cache.
+                if isinstance(e, (TransientIOError, OSError)) \
+                        and not isinstance(e, ValueError):
+                    transient = True
+                absent.add(path)
+                flight("pageindex_reject", site="format.pageindex",
+                       file=self.name, row_group=rg_index, column=path,
+                       error=type(e).__name__)
+                from ..stats import current_stats
+
+                st = current_stats()
+                if st is not None and st.events is not None:
+                    st.events.fault(site="format.pageindex",
+                                    kind="pageindex_reject",
+                                    file=self.name, row_group=rg_index,
+                                    column=path,
+                                    error=type(e).__name__)
+        if not transient:
+            self._pageindex_cache[rg_index] = out
+        if pc_key is not None and not transient:
+            from ..kernels.plancache import plan_cache_budget
+
+            record = [(col, out.get(col)) for col in
+                      sorted(out.keys() | absent)]
+            pc.store(pc_key, record, plan_cache_budget())
+        return _view(out)
+
+    def bloom_filter(self, rg_index: int, column: str):
+        """The split-block bloom filter of one column chunk, or None
+        (absent / corrupt — fault site ``format.pageindex`` covers the
+        whole index family).  Cached per reader."""
+        from ..format.bloom import SplitBlockBloom
+        from ..format.compact import CompactReader, ThriftError
+        from ..format.metadata import BloomFilterHeader, decode_struct
+        from ..faults import fault_point, filter_bytes
+
+        from ..errors import TransientIOError
+
+        key = (rg_index, column)
+        if key in self._bloom_cache:
+            return self._bloom_cache[key]
+        got = None
+        transient = False
+        rg = self.meta.row_groups[rg_index]
+        for cc in rg.columns:
+            cm = cc.meta_data
+            if ".".join(cm.path_in_schema) != column:
+                continue
+            if cm.bloom_filter_offset is None:
+                break
+            try:
+                fault_point("format.pageindex", file=self.name,
+                            column=column)
+
+                def _read():
+                    if cm.bloom_filter_length is not None:
+                        return self._read_range(cm.bloom_filter_offset,
+                                                cm.bloom_filter_length)
+                    # no length in the footer (older writers): read the
+                    # header window first, then exactly the bitset
+                    head = self._read_range(
+                        cm.bloom_filter_offset,
+                        min(256, _source_size(self._f)
+                            - cm.bloom_filter_offset
+                            if self._buf is None
+                            else len(self._buf)
+                            - cm.bloom_filter_offset))
+                    r = CompactReader(head)
+                    header = decode_struct(BloomFilterHeader, r)
+                    nb = header.numBytes or 0
+                    return self._read_range(cm.bloom_filter_offset,
+                                            r.pos + nb)
+
+                blob = filter_bytes("format.pageindex",
+                                    retry_transient(_read),
+                                    column=column)
+                got = SplitBlockBloom.from_bytes(blob)
+            except (ScanError, OSError, ValueError, ThriftError,
+                    IndexError, KeyError, TypeError,
+                    OverflowError) as e:
+                if isinstance(e, (TransientIOError, OSError)) \
+                        and not isinstance(e, ValueError):
+                    transient = True  # don't cache a flaky-store miss
+                flight("bloom_reject", site="format.pageindex",
+                       file=self.name, row_group=rg_index,
+                       column=column, error=type(e).__name__)
+                got = None
+            break
+        if not transient:
+            self._bloom_cache[key] = got
+        return got
+
+    def prune_row_group(self, f, rg_index: int, *, pages: bool = True):
+        """Static pruning verdict of one row group against a bound
+        filter: chunk ``Statistics``, then bloom filters (``==``/``IN``
+        refutation, counted as ``bloom_hits``), then the page index's
+        candidate row mask.  Conservative by construction — ``skip``
+        only when NO row can match.  With pruning disabled
+        (``TPQ_PRUNE=0``) returns an all-rows verdict."""
+        from ..filter import (
+            PruneVerdict,
+            _walk_leaves,
+            bind_filter,
+            candidate_mask,
+            may_match_stats,
+            prune_enabled,
+            row_group_stats,
+        )
+
+        bind_filter(f, self.schema)
+        if not prune_enabled():
+            return PruneVerdict()
+        rg = self.meta.row_groups[rg_index]
+        wanted = f.columns()
+        stats = row_group_stats(rg, self.schema, wanted)
+        hits = [0]
+
+        def bloom_probe(column, probes):
+            b = self.bloom_filter(rg_index, column)
+            if b is None:
+                return True
+            h = None
+            for leaf, _neg in _walk_leaves(f):
+                if leaf.column == column \
+                        and getattr(leaf, "_h", None) is not None:
+                    h = leaf._h
+                    break
+            if h is None:
+                return True
+            for v in probes:
+                try:
+                    enc = h.encode_stat_value(v)
+                except (TypeError, ValueError, OverflowError):
+                    return True
+                if enc is None or b.check(enc):
+                    return True
+            hits[0] += 1
+            return False
+
+        # bloom_hits ride the VERDICT, not the collector: the scan
+        # drivers prune at construction time (often before any
+        # collector opens) and fold verdict counters at run start, so
+        # counting here too would double-count under an active
+        # collector
+        ok = may_match_stats(f, stats, bloom_probe)
+        if not ok:
+            return PruneVerdict(skip=True,
+                                reason="bloom" if hits[0] else "stats",
+                                bloom_hits=hits[0])
+        if not pages:
+            return PruneVerdict(bloom_hits=hits[0])
+        pages_by_col = self.page_index(rg_index, columns=wanted)
+        if not pages_by_col:
+            return PruneVerdict(bloom_hits=hits[0])
+        cand = candidate_mask(f, pages_by_col, rg.num_rows)
+        if not cand.any():
+            return PruneVerdict(skip=True, reason="pages",
+                                pages_by_col=pages_by_col,
+                                bloom_hits=hits[0])
+        if cand.all():
+            cand = None  # all rows are candidates: no static narrowing
+        return PruneVerdict(candidate=cand, pages_by_col=pages_by_col,
+                            bloom_hits=hits[0])
+
     # -- row-group loading -------------------------------------------------
 
-    def read_row_group_arrays(self, rg_index: int) -> dict[str, ChunkData]:
+    def read_row_group_arrays(self, rg_index: int,
+                              filter=None) -> dict[str, ChunkData]:
         """Decode the selected columns of one row group into codec-layer
         arrays (no row assembly).  Only selected chunks are read from the
         file at all — projection skips both I/O and decode (≙ skipChunk,
-        ``chunk_reader.go:286``)."""
+        ``chunk_reader.go:286``).
+
+        ``filter`` (a :mod:`tpuparquet.filter` expression) switches to
+        the late-materialized predicate-pushdown path: row groups /
+        pages the metadata proves empty are never decoded, the filter
+        columns decode first, and the returned chunks hold exactly the
+        surviving rows — bit-identical to a full decode followed by a
+        post-filter."""
         if not 0 <= rg_index < len(self.meta.row_groups):
             raise IndexError(
                 f"row group {rg_index} out of range "
@@ -414,6 +730,15 @@ class FileReader:
         st = current_stats()
         if st is not None:
             st.row_groups += 1
+        if filter is not None:
+            from ..filter import read_row_group_filtered
+
+            try:
+                chunks, _rows = read_row_group_filtered(
+                    self, rg_index, filter)
+            except ScanError as e:
+                raise e.annotate(row_group=rg_index, file=self.name)
+            return chunks
         rg = self.meta.row_groups[rg_index]
         out = {}
         # phase span for the Perfetto export; nothing runs (and nothing

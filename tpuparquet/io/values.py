@@ -312,6 +312,45 @@ class ValueHandler:
             return float(np.frombuffer(b, dtype="<f8")[0])
         return bytes(b)
 
+    def stats_bytewise_comparable(self) -> bool:
+        """False when the column's declared sort order is NOT the raw
+        byte order of its statistics values — DECIMAL over
+        BYTE_ARRAY/FLBA sorts as a signed big-endian two's-complement
+        number, so ``b'\\xff..'`` (negative) < ``b'\\x05..'`` while
+        bytewise compare says the opposite.  Pruning and the strict
+        validator treat such bounds as absent (conservative: no
+        pruning, no false min>max finding)."""
+        el = self.element
+        if el.type not in (Type.BYTE_ARRAY, Type.FIXED_LEN_BYTE_ARRAY):
+            return True
+        from ..format.metadata import ConvertedType
+
+        if getattr(el, "converted_type", None) == ConvertedType.DECIMAL:
+            return False
+        lt = getattr(el, "logicalType", None)
+        if lt is not None:
+            try:
+                # DECIMAL sorts as a signed big-endian number, FLOAT16
+                # as an IEEE half — neither matches raw byte order
+                if lt.set_member()[0] in ("DECIMAL", "FLOAT16"):
+                    return False
+            except (TypeError, IndexError):
+                pass
+        return True
+
+    def decode_stat_logical(self, b: bytes):
+        """Decode a Statistics min/max value to its LOGICAL value —
+        unsigned columns come back as the non-negative logical int (the
+        stored bytes are two's-complement signed storage).  This is the
+        form predicate pushdown and the strict validator compare in
+        (``tpuparquet/filter.py``, ``format/validate.py``)."""
+        v = self.decode_stat_value(b)
+        if (v is not None and self.unsigned
+                and self.ptype in (Type.INT32, Type.INT64)
+                and v < 0):
+            v += 1 << (32 if self.ptype == Type.INT32 else 64)
+        return v
+
 
 def _refine_lex(rows: np.ndarray, reduce_fn) -> bytes:
     """Lexicographic (unsigned byte order) extreme of a (k, L) byte

@@ -102,6 +102,8 @@ class FileWriter:
         write_stats: bool = True,
         page_crc: bool | None = None,
         salvage_hint: bool | None = None,
+        page_index: bool | None = None,
+        bloom_columns=None,
     ):
         self._f = f
         self._pos = 0
@@ -132,6 +134,22 @@ class FileWriter:
         if salvage_hint is None:
             salvage_hint = os.environ.get("TPQ_SALVAGE_HINT", "1") != "0"
         self.salvage_hint = bool(salvage_hint)
+        # per-page ColumnIndex/OffsetIndex, serialized after the row
+        # groups with their offsets recorded in ColumnChunk (the read
+        # side's page-pruning input).  Default ON (TPQ_PAGE_INDEX=0
+        # disables); needs statistics — write_stats=False wins.
+        if page_index is None:
+            page_index = os.environ.get("TPQ_PAGE_INDEX", "1") != "0"
+        self.page_index = bool(page_index) and self.write_stats
+        # split-block bloom filters for the named (dictionary-ish)
+        # columns: kwarg, else TPQ_BLOOM_COLUMNS ("a,b.c"), else none
+        if bloom_columns is None:
+            env = os.environ.get("TPQ_BLOOM_COLUMNS", "")
+            bloom_columns = [c for c in env.split(",") if c.strip()]
+        if isinstance(bloom_columns, str):
+            bloom_columns = [c for c in bloom_columns.split(",")
+                             if c.strip()]
+        self.bloom_columns = {c.strip() for c in bloom_columns}
 
         if schema is None:
             self.schema = Schema.empty()
@@ -145,6 +163,10 @@ class FileWriter:
             raise TypeError(f"unsupported schema type {type(schema).__name__}")
         attach_stores(self.schema)
         self._validate_column_encodings()
+        for path in sorted(self.bloom_columns):
+            if self.schema.leaf(path) is None:
+                raise ValueError(
+                    f"bloom_columns names no such column {path!r}")
 
         self.row_groups: list[RowGroup] = []
         self.total_rows = 0
@@ -604,6 +626,8 @@ class FileWriter:
                     kv_metadata=kv or None,
                     write_stats=self.write_stats,
                     page_crc=self.page_crc,
+                    page_index=self.page_index,
+                    bloom=leaf.flat_name in self.bloom_columns,
                 )
             return buf.getvalue(), cc, ws
 
@@ -651,6 +675,12 @@ class FileWriter:
                     cm.data_page_offset += base
                     if cm.dictionary_page_offset is not None:
                         cm.dictionary_page_offset += base
+                    pi = getattr(cc, "_page_index", None)
+                    if pi is not None:
+                        # page locations were recorded against the
+                        # chunk's private buffer; make them absolute
+                        for loc in pi[1].page_locations:
+                            loc.offset += base
                     total_bytes += cm.total_uncompressed_size
                     total_comp += cm.total_compressed_size
                     chunks.append(cc)
@@ -672,6 +702,8 @@ class FileWriter:
                     kv_metadata=kv or None,
                     write_stats=self.write_stats,
                     page_crc=self.page_crc,
+                    page_index=self.page_index,
+                    bloom=leaf.flat_name in self.bloom_columns,
                 )
                 total_bytes += cc.meta_data.total_uncompressed_size
                 total_comp += cc.meta_data.total_compressed_size
@@ -689,12 +721,49 @@ class FileWriter:
 
     # -- close -------------------------------------------------------------
 
+    def _write_indexes(self) -> None:
+        """Serialize the collected bloom filters and per-page
+        ``ColumnIndex``/``OffsetIndex`` structs between the last row
+        group and the footer (the parquet-format layout), recording
+        their offsets/lengths in each ``ColumnChunk``/``ColumnMetaData``
+        so readers can seek straight to them.  Spec order: blooms,
+        then every ColumnIndex, then every OffsetIndex (grouped by row
+        group, columns in schema order)."""
+        for rg in self.row_groups:
+            for cc in rg.columns:
+                b = getattr(cc, "_bloom", None)
+                if b is None:
+                    continue
+                blob = b.to_bytes()
+                cc.meta_data.bloom_filter_offset = self._pos
+                cc.meta_data.bloom_filter_length = len(blob)
+                self._write(blob)
+        for rg in self.row_groups:
+            for cc in rg.columns:
+                pi = getattr(cc, "_page_index", None)
+                if pi is None:
+                    continue
+                blob = pi[0].to_bytes()
+                cc.column_index_offset = self._pos
+                cc.column_index_length = len(blob)
+                self._write(blob)
+        for rg in self.row_groups:
+            for cc in rg.columns:
+                pi = getattr(cc, "_page_index", None)
+                if pi is None:
+                    continue
+                blob = pi[1].to_bytes()
+                cc.offset_index_offset = self._pos
+                cc.offset_index_length = len(blob)
+                self._write(blob)
+
     def close(self) -> None:
         if self._closed:
             return
         self.flush_row_group()
         if self._pos == 0:
             self._write_head()  # valid empty file still needs framing
+        self._write_indexes()
         kv = [KeyValue(key=k, value=v)
               for k, v in sorted(self.kv_metadata.items())] or None
         meta = FileMetaData(

@@ -2319,7 +2319,91 @@ def _merge_parts(parts, lanes: int = 1):
     return jnp.concatenate(arrs), sum(m for _, m in parts)
 
 
-def read_row_group_device(reader, rg_index: int) -> dict[str, DeviceColumn]:
+def stage_chunkdata(cd, node) -> DeviceColumn:
+    """Stage one host-decoded :class:`~tpuparquet.io.chunk.ChunkData`
+    as a :class:`DeviceColumn` — the transfer step of the
+    late-materialization path: the predicate already ran on host, so
+    only the SURVIVING rows' bytes cross the link.  Buffer layout
+    matches the fused-kernel path exactly (flat u32 lanes / byte-array
+    offsets+data), so downstream consumers (``gather_column`` et al.)
+    cannot tell the difference."""
+    ptype = Type(node.element.type)
+    dl = np.asarray(cd.def_levels, dtype=np.int32)
+    rep = np.asarray(cd.rep_levels, dtype=np.int32)
+    num = dl.shape[0]
+    max_def = node.max_def_level
+    mask_h = pos_h = None
+    if max_def:
+        valid = dl == max_def
+        if not valid.all():
+            pidx = np.cumsum(valid, dtype=np.int64) - 1
+            mask_h = valid
+            pos_h = np.maximum(pidx, 0).astype(np.int32)
+    vals = cd.values
+    offsets = None
+    n_bytes = None
+    if isinstance(vals, ByteArrayColumn):
+        offs = np.asarray(vals.offsets)
+        n_bytes = int(offs[-1]) if offs.size else 0
+        odt = np.int32 if n_bytes <= np.iinfo(np.int32).max else np.int64
+        offsets = jnp.asarray(offs.astype(odt))
+        data = jnp.asarray(np.asarray(vals.data, dtype=np.uint8))
+        n_packed = max(offs.size - 1, 0)
+    else:
+        arr = np.asarray(vals)
+        n_packed = arr.shape[0]
+        if ptype == Type.BOOLEAN:
+            flat = arr.astype(np.uint32)
+        elif ptype == Type.FIXED_LEN_BYTE_ARRAY:
+            flat = _stage_byte_rows_np(arr)
+        elif ptype == Type.INT96:
+            flat = np.ascontiguousarray(arr, dtype="<u4").reshape(-1)
+        else:
+            flat = np.ascontiguousarray(arr).view("<u4").reshape(-1)
+        data = jnp.asarray(flat)
+    return DeviceColumn(
+        ptype, node.element.type_length, data, offsets,
+        None if mask_h is None else jnp.asarray(mask_h),
+        None if pos_h is None else jnp.asarray(pos_h),
+        jnp.asarray(rep) if node.max_rep_level else None,
+        jnp.asarray(dl) if max_def else None,
+        num, n_packed=n_packed, n_bytes=n_bytes)
+
+
+def _read_row_group_device_filtered(reader, rg_index: int, filt,
+                                    verdict) -> dict[str, DeviceColumn]:
+    """Late-materialized device read: filter columns decode on host,
+    the predicate evaluates exactly, and only surviving rows stage to
+    the device (``stage_chunkdata``).  Pruned pages are never
+    decompressed; pruned row groups return schema-shaped empty
+    columns.  Bit-exact vs decoding everything and post-filtering on
+    device."""
+    from ..filter import read_row_group_filtered
+
+    chunks, _rows = read_row_group_filtered(reader, rg_index, filt,
+                                            verdict)
+    t0 = time.perf_counter()
+    out = {}
+    for path, cd in chunks.items():
+        node = reader.schema.leaf(path)
+        out[path] = stage_chunkdata(cd, node)
+    jax.block_until_ready(
+        [x for c in out.values() for x in c._buffers()])
+    t1 = time.perf_counter()
+    from ..stats import current_stats
+
+    _cs = current_stats()
+    if _cs is not None:
+        _cs.transfer_s += t1 - t0
+        if _cs.events is not None:
+            _cs.events.span("transfer", "decode", t0, t1,
+                            tid=threading.get_ident(),
+                            columns=len(out))
+    return out
+
+
+def read_row_group_device(reader, rg_index: int, filter=None,
+                          verdict=None) -> dict[str, DeviceColumn]:
     """Decode the selected columns of one row group onto the device.
 
     The device-path sibling of ``FileReader.read_row_group_arrays``: same
@@ -2332,12 +2416,24 @@ def read_row_group_device(reader, rg_index: int) -> dict[str, DeviceColumn]:
     the remote tunnel — see the comment in ``_finish_row_group``).  For
     multi-row-group reads prefer :func:`read_row_groups_device`, which
     additionally overlaps row group N+1's host planning with N's
-    transfer."""
+    transfer.
+
+    ``filter`` (a :mod:`tpuparquet.filter` expression, optionally with
+    a precomputed ``verdict``) switches to the late-materialized
+    pushdown path: filter columns decode on host first, pruned pages
+    are never decompressed, and only surviving rows transfer —
+    bit-exact vs decode-everything-then-post-filter."""
     from ..stats import current_stats
 
     _cs = current_stats()
     if _cs is not None:
         _cs.row_groups += 1
+    if filter is not None:
+        try:
+            return _read_row_group_device_filtered(
+                reader, rg_index, filter, verdict)
+        except ScanError as e:
+            raise e.annotate(row_group=rg_index)
     rg = reader.meta.row_groups[rg_index]
     arenas = []
     try:
@@ -2393,7 +2489,8 @@ def read_row_group_device(reader, rg_index: int) -> dict[str, DeviceColumn]:
 def read_row_group_device_resilient(reader, rg_index: int,
                                     retries: int | None = None,
                                     sleep=time.sleep,
-                                    dispatch_deadline: float | None = None):
+                                    dispatch_deadline: float | None = None,
+                                    filter=None, verdict=None):
     """:func:`read_row_group_device` with the device-failure policy:
     retry device dispatch with bounded exponential backoff, then
     degrade to the bit-exact CPU decode (:func:`cpu_fallback_values`)
@@ -2436,7 +2533,8 @@ def read_row_group_device_resilient(reader, rg_index: int,
         deg_ctx = cpu_fallback_values() if degraded \
             else contextlib.nullcontext()
         with dev_ctx, deg_ctx:
-            return read_row_group_device(reader, rg_index)
+            return read_row_group_device(reader, rg_index,
+                                         filter=filter, verdict=verdict)
 
     def attempt_bare(degraded):
         st = current_stats()
@@ -2714,6 +2812,78 @@ def _usable_cpus() -> int:
         return os.cpu_count() or 1
 
 
+def filtered_pipelined_reads(readers, units, device_for=None,
+                             start: int = 0, *, filter=None,
+                             verdicts=None):
+    """The late-materialization sibling of :func:`pipelined_reads`:
+    each unit's filtered host decode (filter columns first, pruned
+    pages skipped, survivors gathered) runs as one pool task while the
+    main thread stages the previous unit's survivors on its device —
+    plan/transfer overlap is preserved, just at unit granularity
+    (filtered decode is one fused host pass, not per-column plan
+    tasks).  ``verdicts`` optionally maps ``(file, rg)`` to a
+    precomputed :class:`~tpuparquet.filter.PruneVerdict` so the scan's
+    unit-forming pass is not re-run per unit."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ..filter import read_row_group_filtered
+    from ..stats import current_stats, worker_stats
+
+    order = list(range(start, len(units)))
+    if not order:
+        return
+    _cs = current_stats()
+    n_workers = _plan_threads()
+    degraded = _host_values_only()
+
+    def task(ri, rgi):
+        deg_ctx = (cpu_fallback_values() if degraded
+                   else contextlib.nullcontext())
+        t0 = time.perf_counter()
+        with worker_stats(like=_cs) as ws, deg_ctx:
+            v = None if verdicts is None else verdicts.get((ri, rgi))
+            chunks, _rows = read_row_group_filtered(
+                readers[ri], rgi, filter, v)
+            ws.plan_s += time.perf_counter() - t0
+        return chunks, ws
+
+    ex = ThreadPoolExecutor(max_workers=n_workers)
+    inflight = {}
+    state = {"next_j": 0}
+
+    def fill(window: int):
+        while state["next_j"] < len(order) and len(inflight) < window:
+            k = order[state["next_j"]]
+            state["next_j"] += 1
+            ri, rgi = units[k]
+            inflight[k] = ex.submit(task, ri, rgi)
+
+    try:
+        fill(n_workers + 1)
+        for k in order:
+            chunks, ws = inflight.pop(k).result()
+            if _cs is not None:
+                _cs.merge_from(ws)
+                _cs.row_groups += 1
+            ri, _rgi = units[k]
+            reader = readers[ri]
+            t0 = time.perf_counter()
+            dev_ctx = (jax.default_device(device_for(k))
+                       if device_for is not None
+                       else contextlib.nullcontext())
+            with dev_ctx:
+                out = {path: stage_chunkdata(cd, reader.schema.leaf(path))
+                       for path, cd in chunks.items()}
+                jax.block_until_ready(
+                    [x for c in out.values() for x in c._buffers()])
+            if _cs is not None:
+                _cs.transfer_s += time.perf_counter() - t0
+            fill(n_workers + 1)
+            yield k, out
+    finally:
+        ex.shutdown(wait=True)
+
+
 def pipelined_reads(readers, units, device_for=None, start: int = 0):
     """Yield ``(unit_index, {path: DeviceColumn})`` for
     ``units[start:]`` (each a ``(reader_index, rg_index)`` pair),
@@ -2821,14 +2991,42 @@ def pipelined_reads(readers, units, device_for=None, start: int = 0):
         trim_arena_pool(keep=2)
 
 
-def read_row_groups_device(reader, rg_indices=None):
+def read_row_groups_device(reader, rg_indices=None, filter=None):
     """Yield ``(rg_index, {path: DeviceColumn})`` for several row groups,
     overlapping host planning with device transfer (see
     :func:`pipelined_reads`).  Results are identical to calling
-    :func:`read_row_group_device` per index."""
+    :func:`read_row_group_device` per index.  With ``filter``, row
+    groups the static verdict proves empty are skipped entirely (not
+    yielded) and the rest decode late-materialized."""
+    from ..stats import current_stats
+
     if rg_indices is None:
         rg_indices = range(reader.row_group_count())
     indices = list(rg_indices)
+    if filter is not None:
+        from ..filter import bind_filter
+
+        bind_filter(filter, reader.schema)
+        kept, verdicts = [], {}
+        st = current_stats()
+        for i in indices:
+            v = reader.prune_row_group(filter, i)
+            if v.skip:
+                if st is not None:
+                    st.row_groups_pruned += 1
+                    st.rows_pruned += \
+                        reader.meta.row_groups[i].num_rows
+                    st.bloom_hits += v.bloom_hits
+                continue
+            if st is not None:
+                st.bloom_hits += v.bloom_hits
+            verdicts[(0, i)] = v
+            kept.append(i)
+        for k, out in filtered_pipelined_reads(
+                [reader], [(0, i) for i in kept], filter=filter,
+                verdicts=verdicts):
+            yield kept[k], out
+        return
     for k, out in pipelined_reads([reader], [(0, i) for i in indices]):
         yield indices[k], out
 

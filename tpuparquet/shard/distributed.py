@@ -215,7 +215,8 @@ class MultiHostScan(_DurableScanMixin):
                  resume_from: str | None = None,
                  checkpoint_every: int | None = None,
                  progress_export: str | None = None,
-                 postmortem=None):
+                 postmortem=None,
+                 filter=None):
         from ..faults import QuarantineReport
         from ..obs.progress import progress_export_default
         from .mesh import make_mesh
@@ -253,7 +254,13 @@ class MultiHostScan(_DurableScanMixin):
             entry_extra={"process_index": p},
             hedge_delay=hedge_delay, read_deadline=read_deadline,
             postmortem=self._postmortem_path)
-        self.global_units = scan_units(self.readers)
+        # pruning verdicts are a pure function of the footers, so every
+        # host derives the identical filtered unit list (the same
+        # determinism contract salvage relies on)
+        self._init_filter(filter, self.readers)
+        self.global_units = scan_units(self.readers, filter=self.filter,
+                                       verdicts=self._verdicts,
+                                       pruned=self._pruned)
         self.local_units = process_units(self.global_units)
         # per-host status file (base.p<idx>, like the checkpoints) so
         # hosts never race on one progress file; parquet-tool top takes
@@ -366,11 +373,20 @@ class MultiHostScan(_DurableScanMixin):
         from .scan import pipelined_unit_scan, resilient_unit_scan
 
         self._run_t0 = time.monotonic()
+        if self.filter is not None and self._next_local == 0:
+            # each dropped row group / kept verdict counts on exactly
+            # one host, so the fleet-folded counters stay exact
+            p, n = jax.process_index(), jax.process_count()
+            local = set(self.local_units)
+            self._count_pruned(
+                select_pruned=lambda j: j % n == p,
+                select_kept=lambda key: key in local)
         if self.on_error == "raise":
             gen = pipelined_unit_scan(
                 self.readers, self.local_units,
                 lambda i: self.devices[i % len(self.devices)],
-                start=self._next_local)
+                start=self._next_local, filter=self.filter,
+                verdicts=self._verdicts)
         else:
             gen = resilient_unit_scan(
                 self.readers, self.local_units,
@@ -379,7 +395,8 @@ class MultiHostScan(_DurableScanMixin):
                 quarantine=self.quarantine,
                 entry_extra={"process_index": jax.process_index()},
                 unit_deadline=self.unit_deadline,
-                postmortem=self._postmortem_path)
+                postmortem=self._postmortem_path,
+                filter=self.filter, verdicts=self._verdicts)
         yield from self._drive(gen)
 
     def allgather_quarantine(self) -> list[dict]:

@@ -44,16 +44,48 @@ __all__ = ["ShardedScan", "scan_units", "open_sources",
            "checkpoint_every_default"]
 
 
-def scan_units(readers: list[FileReader]) -> list[tuple[int, int]]:
+def scan_units(readers: list[FileReader], filter=None,
+               verdicts: dict | None = None,
+               pruned: list | None = None) -> list[tuple[int, int]]:
     """Flatten files into (file_index, row_group_index) work units.
     ``None`` entries (files quarantined at open time) contribute no
-    units but keep the file-index space stable."""
-    return [
-        (fi, rgi)
-        for fi, r in enumerate(readers)
-        if r is not None
-        for rgi in range(r.row_group_count())
-    ]
+    units but keep the file-index space stable.
+
+    With ``filter`` (a bound :mod:`tpuparquet.filter` expression), row
+    groups the static verdict proves empty are DROPPED before units
+    form — the scan never reads them.  Surviving verdicts land in
+    ``verdicts`` (keyed ``(file, rg)``) so the per-unit decode reuses
+    the candidate masks; dropped coordinates land in ``pruned`` as
+    ``(file, rg, num_rows, reason, bloom_hits)`` for the driver's
+    counters.  Deterministic given the footers, so every host of a
+    multi-process scan derives the identical filtered unit list.
+
+    The verdicts read the page-index / bloom blobs serially on the
+    constructor's path — a handful of small seeks per filtered row
+    group, fine for today's local seekable sources.  When remote
+    object-store sources land (ROADMAP item 3), the page-index level
+    should defer to the per-unit decode (pipelined + hedged) and unit
+    forming should stop at the footer-only stats level."""
+    units = []
+    for fi, r in enumerate(readers):
+        if r is None:
+            continue
+        for rgi in range(r.row_group_count()):
+            if filter is not None:
+                v = r.prune_row_group(filter, rgi)
+                if v.skip:
+                    if pruned is not None:
+                        pruned.append(
+                            (fi, rgi,
+                             r.meta.row_groups[rgi].num_rows,
+                             v.reason, v.bloom_hits))
+                    flight("row_group_pruned", site="shard.scan",
+                           file=fi, row_group=rgi, reason=v.reason)
+                    continue
+                if verdicts is not None:
+                    verdicts[(fi, rgi)] = v
+            units.append((fi, rgi))
+    return units
 
 
 def _replicas(src) -> list:
@@ -378,11 +410,21 @@ def host_cursor_path(base: str, process_index: int) -> str:
     return f"{base}.p{process_index}"
 
 
-def pipelined_unit_scan(readers, units, device_for=None, start: int = 0):
+def pipelined_unit_scan(readers, units, device_for=None, start: int = 0,
+                        filter=None, verdicts=None):
     """Yield ``(unit_index, {path: DeviceColumn})`` for ``units[start:]``,
     overlapping host planning with device transfer/dispatch — the shared
     pipeline in :func:`tpuparquet.kernels.device.pipelined_reads`, with
-    (file, row-group) units and per-unit device placement."""
+    (file, row-group) units and per-unit device placement.  With
+    ``filter`` the late-materialized pushdown pipeline runs instead
+    (:func:`~tpuparquet.kernels.device.filtered_pipelined_reads`)."""
+    if filter is not None:
+        from ..kernels.device import filtered_pipelined_reads
+
+        yield from filtered_pipelined_reads(
+            readers, units, device_for, start, filter=filter,
+            verdicts=verdicts)
+        return
     from ..kernels.device import pipelined_reads
 
     yield from pipelined_reads(readers, units, device_for, start)
@@ -392,7 +434,8 @@ def resilient_unit_scan(readers, units, device_for, *, start: int = 0,
                         retries=None, quarantine: QuarantineReport,
                         entry_extra: dict | None = None,
                         unit_deadline: float | None = None,
-                        postmortem: str | None = None):
+                        postmortem: str | None = None,
+                        filter=None, verdicts=None):
     """The quarantine-mode unit loop shared by :class:`ShardedScan`
     and :class:`MultiHostScan`: decode each unit with the full
     resilience policy (transient-I/O retry, dispatch retry, CPU
@@ -420,7 +463,9 @@ def resilient_unit_scan(readers, units, device_for, *, start: int = 0,
             # execute this on a worker thread, so enter it inside
             with jax.default_device(device_for(k)):
                 return read_row_group_device_resilient(
-                    readers[fi], rgi, retries=retries)
+                    readers[fi], rgi, retries=retries, filter=filter,
+                    verdict=(None if verdicts is None
+                             else verdicts.get((fi, rgi))))
 
         try:
             if unit_deadline:
@@ -577,6 +622,54 @@ class DurableScanMixin:
         mid-scan sees the units decoded so far)."""
         if self._live_stats is not None:
             self._live_fold.fold(self._live_stats)
+
+    def _init_filter(self, filter, readers) -> None:
+        """Shared filter plumbing: bind once against the (homogeneous)
+        dataset schema, then let :func:`scan_units` prune row groups
+        statically.  Call BEFORE forming units."""
+        self.filter = filter
+        self._verdicts: dict = {}
+        self._pruned: list = []
+        if filter is None:
+            return
+        from ..filter import bind_filter
+
+        for r in readers:
+            if r is not None:
+                bind_filter(filter, r.schema)
+                break
+
+    def _count_pruned(self, select_pruned=None,
+                      select_kept=None) -> None:
+        """Fold the unit-forming pruning decisions into the active (or
+        ambient) collector — called at RUN start, not construction, so
+        ``run_with_stats``/``collect_stats`` wrappers see them.  The
+        selectors filter which pruned entries / kept-unit verdicts
+        THIS process records (multi-host: each row group counts once
+        across the fleet)."""
+        if self.filter is None:
+            return
+        from ..stats import current_stats
+
+        with self._adopted():
+            st = current_stats()
+            if st is None:
+                return
+            hits = 0
+            for j, (_fi, _rgi, n_rows, _reason, bh) in enumerate(
+                    self._pruned):
+                if select_pruned is not None and not select_pruned(j):
+                    continue
+                st.row_groups_pruned += 1
+                st.rows_pruned += n_rows
+                hits += bh
+            # kept row groups' verdicts may also carry refuting probes
+            # (an Or branch the bloom killed while another matched)
+            for key, v in self._verdicts.items():
+                if select_kept is not None and not select_kept(key):
+                    continue
+                hits += v.bloom_hits
+            st.bloom_hits += hits
 
     def _drive(self, gen):
         """The shared unit loop around an inner unit generator
@@ -771,6 +864,18 @@ class ShardedScan(DurableScanMixin):
       (at most one checkpoint window) are bit-exact, so a keyed
       consumer converges to the identical union.  :meth:`cursor_save`
       checkpoints explicitly.
+
+    Predicate pushdown (this round): ``filter=`` takes a
+    :mod:`tpuparquet.filter` expression (``col("x") > 5``).  Row
+    groups the chunk statistics / bloom filters / page index prove
+    empty are dropped BEFORE units form (``row_groups_pruned``/
+    ``rows_pruned``); surviving units decode late-materialized —
+    filter columns first, exact predicate, only surviving rows of the
+    other columns staged — so each yielded unit holds exactly the
+    matching rows, bit-identical to a full scan post-filtered
+    (``TPQ_PRUNE=0`` forces that reference path).  A cursor taken
+    under one filter resumes only under the same filter (the unit
+    list is part of the cursor's identity).
     """
 
     def __init__(self, sources, *columns: str, mesh=None, resume=None,
@@ -785,7 +890,8 @@ class ShardedScan(DurableScanMixin):
                  checkpoint_every: int | None = None,
                  progress_export: str | None = None,
                  progress_label: str = "scan",
-                 postmortem=None):
+                 postmortem=None,
+                 filter=None):
         from .mesh import make_mesh
 
         if on_error not in ("raise", "quarantine"):
@@ -808,7 +914,10 @@ class ShardedScan(DurableScanMixin):
             strict_metadata=strict_metadata, hedge_delay=hedge_delay,
             read_deadline=read_deadline,
             postmortem=self._postmortem_path)
-        self.units = scan_units(self.readers)
+        self._init_filter(filter, self.readers)
+        self.units = scan_units(self.readers, filter=self.filter,
+                                verdicts=self._verdicts,
+                                pruned=self._pruned)
         # progress_label keys this scan's registry gauges (see
         # obs/progress.py): concurrent scans in one serve process pass
         # distinct labels so their gauges don't clobber each other
@@ -878,17 +987,23 @@ class ShardedScan(DurableScanMixin):
         moves mid-scan), and quarantine/deadline events dump automatic
         post-mortems beside the durable cursor."""
         self._run_t0 = time.monotonic()
+        if self.filter is not None and self._next_unit == 0:
+            # fresh run: fold the unit-forming prune decisions exactly
+            # once (a cursor resume already counted them in its run)
+            self._count_pruned()
         if self.on_error == "raise":
             gen = pipelined_unit_scan(
                 self.readers, self.units, self.device_for,
-                start=self._next_unit)
+                start=self._next_unit, filter=self.filter,
+                verdicts=self._verdicts)
         else:
             gen = resilient_unit_scan(
                 self.readers, self.units, self.device_for,
                 start=self._next_unit, retries=self.retries,
                 quarantine=self.quarantine,
                 unit_deadline=self.unit_deadline,
-                postmortem=self._postmortem_path)
+                postmortem=self._postmortem_path,
+                filter=self.filter, verdicts=self._verdicts)
         yield from self._drive(gen)
 
     def run(self) -> list[dict[str, DeviceColumn]]:

@@ -132,6 +132,28 @@ class DecodeStats:
     # durable cursor checkpoints written (shard.scan.save_cursor_file
     # via the auto-checkpoint path or an explicit cursor_save)
     checkpoints_written: int = 0
+    # -- predicate pushdown / pruning (tpuparquet/filter.py) --
+    # row groups skipped entirely by a filter verdict (chunk Statistics,
+    # bloom filters, or the page index proving no row can match) — the
+    # scan never forms/decodes a unit for them
+    row_groups_pruned: int = 0
+    # data pages skipped inside surviving row groups (not decompressed,
+    # not decoded, not staged), summed over column chunks
+    pages_pruned: int = 0
+    # rows statically eliminated by pruning decisions: the rows of
+    # pruned row groups plus, per surviving filtered row group, the
+    # rows outside the page-index candidate set (counted once per row
+    # group, NOT once per column)
+    rows_pruned: int = 0
+    # bloom-filter probes that answered "definitely absent" (each such
+    # verdict licenses a prune; blooms have no false negatives)
+    bloom_hits: int = 0
+    # exact-filter selectivity accounting: rows that entered exact
+    # predicate evaluation vs rows that survived it (selectivity =
+    # filter_rows_out / filter_rows_in); rows pruned statically never
+    # enter these — rows_pruned covers them
+    filter_rows_in: int = 0
+    filter_rows_out: int = 0
     # -- footer-keyed plan cache (kernels/plancache.py) --
     # per-(rg, column) lookups during device planning: hits skip the
     # transport competition (sample windows, token scans), misses run
@@ -174,6 +196,8 @@ class DecodeStats:
         "metadata_rejects",
         "deadline_exceeded", "hedges_issued", "hedges_won",
         "checkpoints_written",
+        "row_groups_pruned", "pages_pruned", "rows_pruned",
+        "bloom_hits", "filter_rows_in", "filter_rows_out",
         "plan_cache_hits", "plan_cache_misses", "plan_cache_evictions",
         "plan_s", "transfer_s", "dispatch_s",
     )
@@ -240,6 +264,15 @@ class DecodeStats:
             "hedges_issued": self.hedges_issued,
             "hedges_won": self.hedges_won,
             "checkpoints_written": self.checkpoints_written,
+            "row_groups_pruned": self.row_groups_pruned,
+            "pages_pruned": self.pages_pruned,
+            "rows_pruned": self.rows_pruned,
+            "bloom_hits": self.bloom_hits,
+            "filter_rows_in": self.filter_rows_in,
+            "filter_rows_out": self.filter_rows_out,
+            "selectivity": round(
+                self.filter_rows_out / self.filter_rows_in, 6)
+            if self.filter_rows_in else None,
             "plan_cache_hits": self.plan_cache_hits,
             "plan_cache_misses": self.plan_cache_misses,
             "plan_cache_evictions": self.plan_cache_evictions,
@@ -284,6 +317,15 @@ class DecodeStats:
                f"{d['checkpoints_written']} checkpoints"
                if (d["deadline_exceeded"] or d["hedges_issued"]
                    or d["checkpoints_written"]) else "")
+            + (f"; PRUNE: {d['row_groups_pruned']} row groups / "
+               f"{d['pages_pruned']} pages / {d['rows_pruned']} rows "
+               f"pruned, {d['bloom_hits']} bloom hits"
+               + (f", selectivity {d['selectivity']:.4f} "
+                  f"({d['filter_rows_out']:,}/{d['filter_rows_in']:,})"
+                  if d["filter_rows_in"] else "")
+               if (d["row_groups_pruned"] or d["pages_pruned"]
+                   or d["rows_pruned"] or d["bloom_hits"]
+                   or d["filter_rows_in"]) else "")
             + (f"; PLAN CACHE: {d['plan_cache_hits']} hits / "
                f"{d['plan_cache_misses']} misses / "
                f"{d['plan_cache_evictions']} evictions"
