@@ -1,0 +1,614 @@
+"""tpq-analyze: each pass catches its seeded bug and accepts the
+clean twin; the real tree is gate-clean.
+
+Fixture trees are in-memory ``{relpath: source}`` dicts — a
+:class:`tools.analyze.RepoTree` built from one is indistinguishable
+from a repo on disk as far as the passes can tell, so every check
+here is the exact code path the CI gate runs.
+"""
+
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.analyze import (  # noqa: E402
+    Allowlist,
+    RepoTree,
+    atomicwrite,
+    counters,
+    envknobs,
+    faultsites,
+    recorderguard,
+    run_analysis,
+    threads,
+)
+
+
+def _tree(files, readme=None):
+    return RepoTree({k: textwrap.dedent(v) for k, v in files.items()},
+                    readme=readme)
+
+
+def _codes(findings):
+    return sorted(f.code for f in findings)
+
+
+def _keys(findings, code):
+    return sorted(f.key for f in findings if f.code == code)
+
+
+# ----------------------------------------------------------------------
+# counters
+# ----------------------------------------------------------------------
+
+_STATS_OK = """
+    import dataclasses
+
+    @dataclasses.dataclass
+    class DecodeStats:
+        pages: int = 0
+        values: int = 0
+        io_retries: int = 0
+        wall_s: float = 0.0
+        _t0: float = dataclasses.field(default=0.0)
+        hists: dict = dataclasses.field(default_factory=dict)
+        events: object = None
+
+        _MERGE_FIELDS = ("pages", "values", "io_retries")
+
+    _FAULT_OBSERVABILITY_FIELDS = ("io_retries",)
+"""
+
+_BUMPS_OK = """
+    from .stats import current_stats
+
+    def decode_page():
+        st = current_stats()
+        if st is not None:
+            st.pages += 1
+            st.values += 128
+
+    def retry(counter="io_retries"):
+        pass
+"""
+
+
+class TestCountersPass:
+    def test_clean_tree_accepted(self):
+        t = _tree({"tpuparquet/stats.py": _STATS_OK,
+                   "tpuparquet/io.py": _BUMPS_OK})
+        assert counters.run(t) == []
+
+    def test_unmerged_counter_flagged(self):
+        bad = _STATS_OK.replace(
+            '_MERGE_FIELDS = ("pages", "values", "io_retries")',
+            '_MERGE_FIELDS = ("pages", "io_retries")')
+        t = _tree({"tpuparquet/stats.py": bad,
+                   "tpuparquet/io.py": _BUMPS_OK})
+        assert _keys(counters.run(t), "unmerged-counter") == ["values"]
+
+    def test_merge_of_undeclared_flagged(self):
+        bad = _STATS_OK.replace(
+            '("pages", "values", "io_retries")',
+            '("pages", "values", "io_retries", "ghost")')
+        t = _tree({"tpuparquet/stats.py": bad,
+                   "tpuparquet/io.py": _BUMPS_OK})
+        assert _keys(counters.run(t), "merge-of-undeclared") == ["ghost"]
+
+    def test_dead_counter_flagged(self):
+        bumps = _BUMPS_OK.replace("st.values += 128", "pass")
+        t = _tree({"tpuparquet/stats.py": _STATS_OK,
+                   "tpuparquet/io.py": bumps})
+        assert _keys(counters.run(t), "dead-counter") == ["values"]
+
+    def test_typo_bump_flagged(self):
+        bumps = _BUMPS_OK.replace("st.values += 128",
+                                  "st.valuse += 128")
+        t = _tree({"tpuparquet/stats.py": _STATS_OK,
+                   "tpuparquet/io.py": bumps})
+        found = counters.run(t)
+        assert "valuse" in _keys(found, "undeclared-counter-bump")
+
+    def test_fault_field_must_merge(self):
+        bad = _STATS_OK.replace(
+            '_FAULT_OBSERVABILITY_FIELDS = ("io_retries",)',
+            '_FAULT_OBSERVABILITY_FIELDS = ("io_retries", "values2")')
+        t = _tree({"tpuparquet/stats.py": bad,
+                   "tpuparquet/io.py": _BUMPS_OK})
+        assert _keys(counters.run(t), "fault-field-unmerged") \
+            == ["values2"]
+
+    def test_real_registry_extraction(self):
+        # the real stats.py parses and the three sets line up
+        t = RepoTree.from_disk(_REPO)
+        reg = counters.read_registry(t)
+        assert reg is not None
+        assert "pages" in reg["declared"]
+        assert set(reg["fault"]) <= set(reg["merge"])
+
+
+# ----------------------------------------------------------------------
+# fault-sites
+# ----------------------------------------------------------------------
+
+_FAULTS_OK = '''
+    """Sites table:
+
+    ``io.fake.read``                      reader — ``oserror``
+    """
+
+    SITES: dict = {
+        "io.fake.read": ("oserror", "corrupt"),
+    }
+'''
+
+_HOOKED_OK = """
+    from ..faults import fault_point
+
+    def read():
+        fault_point("io.fake.read")
+"""
+
+
+class TestFaultSitesPass:
+    def test_clean_tree_accepted(self):
+        t = _tree({"tpuparquet/faults.py": _FAULTS_OK,
+                   "tpuparquet/io/reader.py": _HOOKED_OK,
+                   "tests/test_x.py": """
+                       def test_y(inj):
+                           inj.inject("io.fake.read", "oserror")
+                   """})
+        assert faultsites.run(t) == []
+
+    def test_unregistered_site_flagged(self):
+        hooked = _HOOKED_OK.replace("io.fake.read", "io.fake.raed")
+        t = _tree({"tpuparquet/faults.py": _FAULTS_OK,
+                   "tpuparquet/io/reader.py": hooked})
+        found = faultsites.run(t)
+        assert "io.fake.raed" in _keys(found, "unregistered-site")
+        assert "io.fake.read" in _keys(found, "dead-site")
+
+    def test_test_drift_flagged(self):
+        t = _tree({"tpuparquet/faults.py": _FAULTS_OK,
+                   "tpuparquet/io/reader.py": _HOOKED_OK,
+                   "tests/test_x.py": """
+                       def test_y(inj):
+                           inj.inject("io.fake.gone", "oserror")
+                           inj.inject("io.fake.read", "hang")
+                   """})
+        found = faultsites.run(t)
+        assert "io.fake.gone" in _keys(found, "unknown-test-site")
+        assert "io.fake.read:hang" in _keys(found, "kind-mismatch")
+
+    def test_docstring_drift_flagged(self):
+        bad = _FAULTS_OK.replace("``io.fake.read`` ",
+                                 "``io.fake.old`` ")
+        t = _tree({"tpuparquet/faults.py": bad,
+                   "tpuparquet/io/reader.py": _HOOKED_OK})
+        keys = _keys(faultsites.run(t), "docstring-drift")
+        assert keys == ["io.fake.old", "io.fake.read"]
+
+
+# ----------------------------------------------------------------------
+# env-knobs
+# ----------------------------------------------------------------------
+
+_README = ("## Env knobs\n\n| `TPQ_ALPHA` | x | y |\n"
+           "| `TPQ_BETA` | x | y |\n\n## Next\n")
+
+_ENV_OK = """
+    import os
+
+    def _env_int(name, default):
+        try:
+            return int(os.environ.get(name, ""))
+        except ValueError:
+            return default
+
+    def alpha():
+        return os.environ.get("TPQ_ALPHA", "1")
+
+    def beta():
+        return _env_int("TPQ_BETA", 3)
+"""
+
+
+class TestEnvKnobsPass:
+    def test_clean_tree_accepted(self):
+        t = _tree({"tpuparquet/mod.py": _ENV_OK}, readme=_README)
+        assert envknobs.run(t) == []
+
+    def test_indirect_read_detected(self):
+        t = _tree({"tpuparquet/mod.py": _ENV_OK}, readme=_README)
+        ks = envknobs.source_knobs(t)
+        assert ks["TPQ_BETA"]["evidence"] == "indirect"
+        assert ks["TPQ_ALPHA"]["evidence"] == "direct"
+
+    def test_undocumented_knob_flagged(self):
+        src = _ENV_OK + (
+            "\n    def gamma():\n"
+            "        import os\n"
+            "        return os.environ.get('TPQ_GAMMA')\n")
+        t = _tree({"tpuparquet/mod.py": src}, readme=_README)
+        assert _keys(envknobs.run(t), "undocumented-knob") \
+            == ["TPQ_GAMMA"]
+
+    def test_stale_doc_flagged(self):
+        src = _ENV_OK.replace('"TPQ_ALPHA"', '"TPQ_ALPHA2"')
+        readme = _README.replace("| `TPQ_BETA` | x | y |",
+                                 "| `TPQ_BETA` | x | y |\n"
+                                 "| `TPQ_ALPHA2` | x | y |")
+        t = _tree({"tpuparquet/mod.py": src}, readme=readme)
+        assert _keys(envknobs.run(t), "stale-doc-knob") == ["TPQ_ALPHA"]
+
+    def test_grep_blindspot_is_covered(self):
+        # a knob whose literal appears ONLY at the helper call site —
+        # the class of read the retired source-grep could not
+        # attribute to an environ access at all
+        src = """
+            import os
+
+            def _budget(name):
+                return float(os.environ.get(name, "0"))
+
+            DELTA = _budget("TPQ_DELTA")
+        """
+        t = _tree({"tpuparquet/mod.py": src},
+                  readme=_README.replace(
+                      "| `TPQ_BETA` | x | y |",
+                      "| `TPQ_BETA` | x | y |\n| `TPQ_DELTA` | x | y |"))
+        ks = envknobs.source_knobs(t)
+        assert ks["TPQ_DELTA"]["evidence"] == "indirect"
+
+
+# ----------------------------------------------------------------------
+# atomic-write
+# ----------------------------------------------------------------------
+
+class TestAtomicWritePass:
+    def test_tmp_replace_accepted(self):
+        t = _tree({"tpuparquet/obs/x.py": """
+            import os
+
+            def publish(path, body):
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write(body)
+                os.replace(tmp, path)
+        """})
+        assert atomicwrite.run(t) == []
+
+    def test_bare_status_write_flagged(self):
+        t = _tree({"tpuparquet/obs/x.py": """
+            def publish(path, body):
+                with open(path, "w") as f:
+                    f.write(body)
+        """})
+        assert _keys(atomicwrite.run(t), "non-atomic-write") \
+            == ["publish"]
+
+    def test_binary_data_writes_out_of_scope(self):
+        t = _tree({"tpuparquet/io/x.py": """
+            def write_parquet(path, blob):
+                with open(path, "wb") as f:
+                    f.write(blob)
+        """})
+        assert atomicwrite.run(t) == []
+
+
+# ----------------------------------------------------------------------
+# recorder-guard
+# ----------------------------------------------------------------------
+
+class TestRecorderGuardPass:
+    def test_guarded_hot_site_accepted(self):
+        t = _tree({"tpuparquet/io/x.py": """
+            from .obs import recorder as _flightrec
+
+            def decode(pages):
+                for p in pages:
+                    if _flightrec._active is not None:
+                        _flightrec.flight("page", page=p)
+        """})
+        assert recorderguard.run(t) == []
+
+    def test_unguarded_qualified_flagged(self):
+        t = _tree({"tpuparquet/io/x.py": """
+            from .obs import recorder as _flightrec
+
+            def decode(pages):
+                for p in pages:
+                    _flightrec.flight("page", page=p)
+        """})
+        assert _keys(recorderguard.run(t), "unguarded-hot-flight") \
+            == ["decode:page"]
+
+    def test_bare_call_in_loop_flagged(self):
+        t = _tree({"tpuparquet/io/x.py": """
+            from .obs.recorder import flight
+
+            def scan(units):
+                for u in units:
+                    flight("unit_done", unit=u)
+        """})
+        assert _keys(recorderguard.run(t), "unguarded-hot-flight") \
+            == ["scan:unit_done"]
+
+    def test_cold_exception_path_accepted(self):
+        t = _tree({"tpuparquet/io/x.py": """
+            from .obs.recorder import flight
+
+            def scan(units):
+                for u in units:
+                    try:
+                        u.decode()
+                    except ValueError:
+                        flight("quarantined", unit=u)
+        """})
+        assert recorderguard.run(t) == []
+
+
+# ----------------------------------------------------------------------
+# thread-safety
+# ----------------------------------------------------------------------
+
+class TestThreadSafetyPass:
+    def test_locked_container_accepted(self):
+        t = _tree({"tpuparquet/reg.py": """
+            import threading
+
+            _registry = {}
+            _lock = threading.Lock()
+
+            def register(k, v):
+                with _lock:
+                    _registry[k] = v
+        """})
+        assert threads.run(t) == []
+
+    def test_unlocked_container_flagged(self):
+        t = _tree({"tpuparquet/reg.py": """
+            import threading
+
+            _registry = {}
+            _lock = threading.Lock()
+
+            def register(k, v):
+                _registry[k] = v
+        """})
+        assert _keys(threads.run(t), "unlocked-module-state") \
+            == ["_registry"]
+
+    def test_unlocked_global_rebind_flagged(self):
+        t = _tree({"tpuparquet/reg.py": """
+            import threading
+
+            _active = None
+
+            def install(x):
+                global _active
+                _active = x
+        """})
+        assert _keys(threads.run(t), "unlocked-global-rebind") \
+            == ["_active"]
+
+    def test_threading_local_accepted(self):
+        t = _tree({"tpuparquet/reg.py": """
+            import threading
+
+            _tls = threading.local()
+
+            def set_active(x):
+                _tls.active = x
+        """})
+        assert threads.run(t) == []
+
+    def test_self_synchronized_instance_accepted(self):
+        t = _tree({"tpuparquet/reg.py": """
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._free = []
+
+            _POOL = Pool()
+        """})
+        assert threads.run(t) == []
+
+    def test_unsynchronized_instance_flagged(self):
+        t = _tree({"tpuparquet/reg.py": """
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._free = []
+
+            _POOL = Pool()
+        """})
+        assert _keys(threads.run(t),
+                     "unsynchronized-module-instance") == ["_POOL"]
+
+    def test_lock_cycle_flagged(self):
+        t = _tree({"tpuparquet/a.py": """
+            import threading
+
+            _la = threading.Lock()
+
+            def fa():
+                with _la:
+                    fb_helper()
+
+            def fb_helper():
+                from .b import fb
+                fb()
+        """, "tpuparquet/b.py": """
+            import threading
+            from .a import fa
+
+            _lb = threading.Lock()
+
+            def fb():
+                with _lb:
+                    pass
+
+            def outer():
+                with _lb:
+                    fa()
+        """})
+        found = threads.run(t)
+        assert "lock-cycle" in _codes(found)
+
+    def test_nested_ordering_accepted(self):
+        # consistent A-then-B nesting is fine — only a cycle deadlocks
+        t = _tree({"tpuparquet/a.py": """
+            import threading
+
+            _la = threading.Lock()
+            _lb = threading.Lock()
+
+            def f():
+                with _la:
+                    with _lb:
+                        pass
+
+            def g():
+                with _la:
+                    with _lb:
+                        pass
+        """})
+        assert threads.run(t) == []
+
+    def test_self_deadlock_flagged(self):
+        t = _tree({"tpuparquet/a.py": """
+            import threading
+
+            _la = threading.Lock()
+
+            def inner():
+                with _la:
+                    pass
+
+            def outer():
+                with _la:
+                    inner()
+        """})
+        found = threads.run(t)
+        assert "lock-cycle" in _codes(found)
+
+    def test_cycle_through_mutual_recursion_not_hidden(self):
+        # regression: reachability is a whole-graph fixpoint — a
+        # memoized DFS would cache cycle-truncated results for the
+        # mutually recursive f/g pair and lose the L2->L1 edge,
+        # hiding the L1<->L2 deadlock
+        t = _tree({"tpuparquet/a.py": """
+            import threading
+
+            _l1 = threading.Lock()
+            _l2 = threading.Lock()
+
+            def f(n):
+                with _l1:
+                    pass
+                g(n)
+
+            def g(n):
+                if n:
+                    f(n - 1)
+
+            def outer_a():
+                with _l2:
+                    g(3)
+
+            def takes_l2():
+                with _l2:
+                    pass
+
+            def outer_b():
+                with _l1:
+                    takes_l2()
+        """})
+        assert "lock-cycle" in _codes(threads.run(t))
+
+    def test_real_threaded_module_census(self):
+        # the pass sees the modules the round-13 issue names
+        t = RepoTree.from_disk(_REPO)
+        mods = threads.threaded_modules(t)
+        for expect in ("tpuparquet/deadline.py",
+                       "tpuparquet/obs/live.py",
+                       "tpuparquet/obs/postmortem.py",
+                       "tpuparquet/kernels/arena.py",
+                       "tpuparquet/kernels/plancache.py"):
+            assert expect in mods, mods
+
+
+# ----------------------------------------------------------------------
+# allowlist + gate
+# ----------------------------------------------------------------------
+
+class TestAllowlist:
+    def test_reason_is_mandatory(self):
+        with pytest.raises(ValueError, match="reason"):
+            Allowlist([{"pass": "p", "file": "f", "key": "k"}])
+
+    def test_suppression_and_staleness(self):
+        t = _tree({"tpuparquet/obs/x.py": """
+            def publish(path, body):
+                with open(path, "w") as f:
+                    f.write(body)
+        """})
+        al = Allowlist([
+            {"pass": "atomic-write", "file": "tpuparquet/obs/x.py",
+             "key": "publish", "reason": "fixture"},
+            {"pass": "atomic-write", "file": "tpuparquet/obs/gone.py",
+             "key": "nothing", "reason": "stale fixture"},
+        ])
+        res = run_analysis(tree=t, allowlist=al,
+                           passes=["atomic-write"])
+        assert res["findings"] == []
+        assert len(res["suppressed"]) == 1
+        assert [e["key"] for e in res["stale_allowlist"]] == ["nothing"]
+        assert not res["ok"]  # stale entry fails the gate
+
+    def test_unknown_pass_rejected(self):
+        with pytest.raises(ValueError, match="unknown pass"):
+            run_analysis(tree=_tree({}), passes=["nope"],
+                         allowlist=Allowlist([]))
+
+
+class TestSelfRun:
+    def test_repo_tree_is_gate_clean(self):
+        # THE acceptance criterion: zero findings on the real tree
+        # with the checked-in allowlist (stale entries included)
+        res = run_analysis(root=_REPO)
+        assert res["ok"], json.dumps(
+            {"findings": res["findings"],
+             "stale_allowlist": res["stale_allowlist"]}, indent=2)
+
+    def test_every_pass_ran(self):
+        res = run_analysis(root=_REPO)
+        assert sorted(res["counts"]) == [
+            "atomic-write", "counters", "env-knobs", "fault-sites",
+            "recorder-guard", "thread-safety"]
+
+    def test_allowlist_entries_all_used(self):
+        # the shipped allowlist holds only LIVE justified exceptions
+        res = run_analysis(root=_REPO)
+        assert res["stale_allowlist"] == []
+
+    def test_cli_json_digest(self, capsys):
+        from tools.analyze.__main__ import main
+
+        rc = main(["--json", "--root", _REPO])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0 and out["ok"]
+        assert set(out["counts"]) == set(
+            ["atomic-write", "counters", "env-knobs", "fault-sites",
+             "recorder-guard", "thread-safety"])

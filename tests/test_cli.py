@@ -118,6 +118,21 @@ class TestParquetTool:
             with FileReader(str(target / part)) as r:
                 assert r.num_rows == 1
 
+    def test_analyze_gate_and_json(self):
+        rc, out = self.run("analyze")
+        assert rc == 0
+        assert "gate PASSED" in out
+        rc, out = self.run("analyze", "--json", "--pass", "counters")
+        assert rc == 0
+        import json
+
+        doc = json.loads(out)
+        assert doc["ok"] and list(doc["counts"]) == ["counters"]
+
+    def test_analyze_bad_root_errors(self, tmp_path):
+        rc, _ = self.run("analyze", "--root", str(tmp_path))
+        assert rc == 1
+
     def test_missing_file_errors(self, tmp_path):
         rc, _ = self.run("rowcount", str(tmp_path / "nope.parquet"))
         assert rc == 1

@@ -1,75 +1,75 @@
-"""Env-knob catalog stays complete: every ``TPQ_*`` knob the source
-reads must have a row in the README table, and every documented knob
-must still exist in the source — docs and code cannot drift apart
-silently.
+"""Env-knob catalog stays complete — now delegated to the analyzer.
 
-Detector: quoted ``"TPQ_..."`` string literals in Python sources are
-exactly the environment reads (helpers like ``_env_budget("TPQ_X")``
-included); docstring mentions use backticks, not quotes, so they
-don't false-positive.  Generated/native C sources (whose ``TPQ_OK``
-style constants are not env knobs) are excluded by construction.
+The original round-11 version of this test grepped the source for
+quoted ``"TPQ_*"`` literals; that detector missed reads where the
+knob name reaches ``os.environ.get(name)`` through a helper
+parameter, and it could not tell a knob *read* from a knob *named in
+a pass's own documentation*.  The AST env-knob pass in
+``tools/analyze`` (``envknobs.py``) replaces it: direct environ
+reads/writes, helper-parameter indirection, env-dict construction,
+and literal fallback, checked both ways against the README catalog.
+
+This file stays as the tier-1 wrapper so the catalog contract keeps
+its place in the suite (and in ci.sh stage 7) — the assertions and
+their failure messages are the analyzer's findings.
 """
 
 import os
-import re
+import sys
+
+import pytest
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-_QUOTED = re.compile(r"""["'](TPQ_[A-Z0-9_]+)["']""")
-# README table rows: | `TPQ_X` | ... ; plus the tool-only prose list
-_DOCUMENTED = re.compile(r"`(TPQ_[A-Z0-9_]+)`")
-
-
-def _py_files(*roots):
-    for root in roots:
-        for dirpath, _dirnames, filenames in os.walk(root):
-            if "__pycache__" in dirpath:
-                continue
-            for fn in filenames:
-                if fn.endswith(".py"):
-                    yield os.path.join(dirpath, fn)
+from tools.analyze import RepoTree  # noqa: E402
+from tools.analyze import envknobs  # noqa: E402
 
 
-def source_knobs():
-    """Every quoted TPQ_ literal in the library, tools, and bench."""
-    knobs = set()
-    files = list(_py_files(os.path.join(_REPO, "tpuparquet"),
-                           os.path.join(_REPO, "tools")))
-    files.append(os.path.join(_REPO, "bench.py"))
-    for path in files:
-        with open(path, encoding="utf-8") as f:
-            knobs.update(_QUOTED.findall(f.read()))
-    return knobs
+@pytest.fixture(scope="module")
+def tree():
+    return RepoTree.from_disk(_REPO)
 
 
-def readme_knobs():
-    with open(os.path.join(_REPO, "README.md"), encoding="utf-8") as f:
-        text = f.read()
-    start = text.index("## Env knobs")
-    end = text.index("## ", start + 3)
-    return set(_DOCUMENTED.findall(text[start:end]))
-
-
-def test_every_source_knob_is_documented():
-    missing = source_knobs() - readme_knobs()
+def test_every_source_knob_is_documented(tree):
+    missing = {
+        f["key"]: f for f in
+        (x.as_dict() for x in envknobs.run(tree))
+        if f["code"] == "undocumented-knob"
+    }
     assert not missing, (
-        f"TPQ_ knobs read by the source but missing from the README "
+        f"TPQ_ knobs used by the source but missing from the README "
         f"'Env knobs' table: {sorted(missing)} — add a row (knob, "
-        f"default, effect)")
+        f"default, effect).  Evidence: "
+        f"{ {k: (v['file'], v['line']) for k, v in missing.items()} }")
 
 
-def test_every_documented_knob_exists_in_source():
-    stale = readme_knobs() - source_knobs()
+def test_every_documented_knob_exists_in_source(tree):
+    stale = sorted(
+        f.key for f in envknobs.run(tree) if f.code == "stale-doc-knob")
     assert not stale, (
         f"README 'Env knobs' table documents knobs no source reads "
-        f"anymore: {sorted(stale)} — drop the stale rows")
+        f"anymore: {stale} — drop the stale rows")
 
 
-def test_catalog_is_nontrivial():
+def test_catalog_is_nontrivial(tree):
     # the round-11 catalog consolidated ~30 knobs; a collapsing
-    # detector (regex rot, section rename) must fail loudly, not
+    # detector (AST rot, section rename) must fail loudly, not
     # vacuously pass on two empty sets
-    knobs = source_knobs()
+    knobs = envknobs.source_knobs(tree)
     assert len(knobs) >= 30, sorted(knobs)
     assert "TPQ_PLAN_THREADS" in knobs
     assert "TPQ_METRICS_EXPORT" in knobs
+    assert len(envknobs.readme_knobs(tree)) >= 30
+
+
+def test_indirect_reads_are_attributed(tree):
+    # the whole point of retiring the grep: knobs that reach
+    # os.environ only through a helper parameter are still detected,
+    # with the evidence classified as such
+    knobs = envknobs.source_knobs(tree)
+    # deadline budgets flow through _env_budget(name)
+    assert knobs["TPQ_UNIT_DEADLINE_S"]["evidence"] in (
+        "direct", "indirect")
+    assert "TPQ_RETRY_BASE_S" in knobs  # via faults._env_float

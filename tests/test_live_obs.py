@@ -301,6 +301,25 @@ class TestRecorder:
         assert live.registry().snapshot() == before
         assert current_stats() is None
 
+    def test_scan_unit_records_survive_the_hot_guard(self, corpus):
+        """Regression pin for the round-13 recorder-guard fixes: the
+        scan-loop flight sites (`unit_done`, per-unit coordinates)
+        were converted to the guarded `_active is not None` idiom —
+        the records must still land when the recorder IS on, and the
+        scan must run clean (no records, no errors) when it is off."""
+        rec = recorder.set_ring(512)
+        scan = ShardedScan(corpus)
+        outs = scan.run()
+        done = [e for e in rec.snapshot() if e["kind"] == "unit_done"]
+        assert len(done) == len(outs) == len(scan.units)
+        # coordinates ride along exactly as before the guard
+        assert {(e["file"], e["row_group"]) for e in done} == {
+            tuple(u) for u in scan.units}
+        recorder.set_ring(0)
+        outs2 = ShardedScan(corpus).run()
+        assert len(outs2) == len(outs)
+        assert recorder.recorder() is None
+
 
 # ----------------------------------------------------------------------
 # Live progress + parquet-tool top

@@ -1,0 +1,131 @@
+"""tpq-analyze: the repo's conventions as machine-checked contracts.
+
+Six AST invariant passes over the library (plus the native sanitizer
+leg in ``tools/analyze/native.sh``) turn documented disciplines —
+exact counter merges, registered fault sites, the env-knob catalog,
+atomic durable writes, guarded flight-recorder hot sites, lock-guarded
+module state with an acyclic lock graph — into a zero-findings CI
+gate.  Run::
+
+    python -m tools.analyze [--json] [--pass NAME]
+
+The gate is **zero findings, not zero noise**: real, justified
+exceptions live in ``tools/analyze/allowlist.json`` with a reason
+each, matched by ``(pass, file, key)`` where ``key`` is a stable
+symbol/site/knob name (never a line number).  A stale allowlist entry
+— one that matches nothing anymore — is itself a finding, so the
+exception list can only shrink truthfully.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from . import (atomicwrite, counters, envknobs, faultsites,
+               recorderguard, threads)
+from .astutil import Finding, RepoTree
+
+__all__ = ["PASSES", "RepoTree", "Finding", "Allowlist",
+           "run_analysis", "repo_root", "DEFAULT_ALLOWLIST"]
+
+#: registry of invariant passes, in report order
+PASSES = {
+    counters.PASS: counters.run,
+    faultsites.PASS: faultsites.run,
+    envknobs.PASS: envknobs.run,
+    atomicwrite.PASS: atomicwrite.run,
+    recorderguard.PASS: recorderguard.run,
+    threads.PASS: threads.run,
+}
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+DEFAULT_ALLOWLIST = os.path.join(_DIR, "allowlist.json")
+
+
+def repo_root() -> str:
+    """The repo root this analyzer ships in (tools/analyze/../..)."""
+    return os.path.dirname(os.path.dirname(_DIR))
+
+
+class Allowlist:
+    """Justified exceptions: entries ``{pass, file, key, reason}``.
+
+    Matching is exact on ``(pass, file, key)``; a ``reason`` is
+    mandatory — an allowlist row without one is rejected at load so
+    "TODO" exceptions can't accrete."""
+
+    def __init__(self, entries: list[dict] | None = None):
+        self.entries = list(entries or [])
+        for e in self.entries:
+            for field in ("pass", "file", "key", "reason"):
+                if not e.get(field):
+                    raise ValueError(
+                        f"allowlist entry {e!r} missing {field!r} — "
+                        f"every exception needs pass/file/key and a "
+                        f"reason")
+        self._used: set[int] = set()
+
+    @classmethod
+    def load(cls, path: str | None) -> "Allowlist":
+        if not path or not os.path.exists(path):
+            return cls([])
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        return cls(doc.get("entries") or [])
+
+    def suppresses(self, finding: Finding) -> bool:
+        for i, e in enumerate(self.entries):
+            if (e["pass"] == finding.pass_name
+                    and e["file"] == finding.file
+                    and e["key"] == finding.key):
+                self._used.add(i)
+                return True
+        return False
+
+    def stale_entries(self) -> list[dict]:
+        """Entries that suppressed nothing in the last run."""
+        return [e for i, e in enumerate(self.entries)
+                if i not in self._used]
+
+
+def run_analysis(root: str | None = None,
+                 passes: list[str] | None = None,
+                 allowlist: "Allowlist | str | None" = DEFAULT_ALLOWLIST,
+                 tree: RepoTree | None = None) -> dict:
+    """Run the selected passes and fold in the allowlist.
+
+    Returns ``{"findings": [...], "suppressed": [...], "stale":
+    [...], "counts": {...}, "ok": bool}`` — ``ok`` is the gate:
+    no live findings, no parse errors, no stale allowlist entries."""
+    if tree is None:
+        tree = RepoTree.from_disk(root or repo_root())
+    if isinstance(allowlist, str) or allowlist is None:
+        allowlist = Allowlist.load(allowlist)
+    selected = passes or list(PASSES)
+    unknown = [p for p in selected if p not in PASSES]
+    if unknown:
+        raise ValueError(f"unknown pass(es) {unknown}; "
+                         f"have {sorted(PASSES)}")
+    live: list[Finding] = []
+    suppressed: list[Finding] = []
+    counts: dict[str, int] = {}
+    for name in selected:
+        found = PASSES[name](tree)
+        counts[name] = len(found)
+        for f in found:
+            (suppressed if allowlist.suppresses(f) else live).append(f)
+    for path, err in tree.parse_errors:
+        live.append(Finding("analyze", path, 1, "parse-error", path,
+                            f"unparseable source: {err}"))
+    # staleness is judged only for entries whose pass actually ran —
+    # a --pass subset must not condemn the other passes' exceptions
+    stale = [e for e in allowlist.stale_entries()
+             if e["pass"] in selected]
+    return {
+        "findings": [f.as_dict() for f in live],
+        "suppressed": [f.as_dict() for f in suppressed],
+        "stale_allowlist": stale,
+        "counts": counts,
+        "ok": not live and not stale,
+    }

@@ -1,0 +1,65 @@
+"""CLI entry: ``python -m tools.analyze``.
+
+Exit 0 = gate passed (zero unsuppressed findings, no stale allowlist
+entries); 1 = violations; 2 = usage error.  ``--json`` emits the
+whole result as one machine-readable document (the same digest
+``parquet-tool analyze --json`` prints).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import DEFAULT_ALLOWLIST, PASSES, run_analysis
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpq-analyze",
+        description="static invariant passes over the tpuparquet tree")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: autodetected from this "
+                        "file's location)")
+    p.add_argument("--pass", dest="passes", action="append",
+                   metavar="NAME", choices=sorted(PASSES),
+                   help="run only this pass (repeatable; default all; "
+                        "stale-allowlist checking needs the full run)")
+    p.add_argument("--allowlist", default=DEFAULT_ALLOWLIST,
+                   help="allowlist JSON path (default: the checked-in "
+                        "tools/analyze/allowlist.json)")
+    p.add_argument("--no-allowlist", action="store_true",
+                   help="report raw findings with no suppression")
+    p.add_argument("--json", action="store_true",
+                   help="emit the full result as JSON on stdout")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    res = run_analysis(
+        root=args.root, passes=args.passes,
+        allowlist=None if args.no_allowlist else args.allowlist)
+    if args.json:
+        json.dump(res, sys.stdout, indent=2, sort_keys=True)
+        print()
+    else:
+        for f in res["findings"]:
+            print(f"{f['file']}:{f['line']}: [{f['pass']}/{f['code']}]"
+                  f" {f['key']}: {f['why']}")
+        for e in res["stale_allowlist"]:
+            print(f"allowlist: stale entry ({e['pass']}, {e['file']}, "
+                  f"{e['key']}) suppresses nothing — drop it "
+                  f"(reason was: {e['reason']})")
+        total = sum(res["counts"].values())
+        print(f"tpq-analyze: {len(res['findings'])} finding(s) "
+              f"({total} raw, {len(res['suppressed'])} allowlisted"
+              f"{', ' + str(len(res['stale_allowlist'])) + ' stale allowlist entr(y/ies)' if res['stale_allowlist'] else ''}) "
+              f"across {len(res['counts'])} pass(es): "
+              + ("gate PASSED" if res["ok"] else "gate FAILED"))
+    return 0 if res["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,111 @@
+"""Recorder-guard pass: hot flight-recorder sites skip kwargs when off.
+
+``obs.recorder.flight`` is internally a no-op when the recorder is
+disabled — but the *call site* still evaluates and boxes its keyword
+arguments first.  On per-page/per-chunk paths that cost is real, so
+the repo's discipline (``obs/recorder.py`` docstring) is to guard the
+call itself::
+
+    if _flightrec._active is not None:
+        _flightrec.flight("page", site=..., file=..., page=...)
+
+This pass enforces the pattern structurally:
+
+* every *module-qualified* call (``<alias>.flight(...)`` — the form
+  hot sites use precisely so they can reach ``_active``) must sit
+  under an ``if`` whose test checks ``_active is not None`` (or
+  ``recorder() is not None``);
+* every *bare* ``flight(...)`` call that lives inside a ``for``/
+  ``while`` loop is treated as hot and held to the same rule — unless
+  it is on an exceptional path (inside an ``except`` handler), which
+  is the cold-site idiom (faults, quarantines, retries fire rarely
+  and keep the plain call).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .astutil import Finding, RepoTree, ancestors, enclosing_function
+
+PASS = "recorder-guard"
+
+EXCLUDE = ("tpuparquet/obs/recorder.py",)
+
+
+def _is_guard_test(test: ast.AST) -> bool:
+    """Does this if-test (or any part of it) check the recorder gate?"""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Attribute) and node.attr == "_active":
+            return True
+        if isinstance(node, ast.Name) and node.id == "_active":
+            return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) \
+                else f.id if isinstance(f, ast.Name) else None
+            if name == "recorder":
+                return True
+    return False
+
+
+def _context(node, fn):
+    """(guarded, in_loop, in_except) from the ancestor chain, scoped
+    to the enclosing function."""
+    guarded = in_loop = in_except = False
+    prev = node
+    for a in ancestors(node):
+        if a is fn:
+            break
+        if isinstance(a, ast.If) and prev in a.body \
+                and _is_guard_test(a.test):
+            guarded = True
+        if isinstance(a, (ast.For, ast.While)):
+            in_loop = True
+        if isinstance(a, ast.ExceptHandler):
+            in_except = True
+        prev = a
+    return guarded, in_loop, in_except
+
+
+def run(tree: RepoTree) -> list[Finding]:
+    findings: list[Finding] = []
+    for path, mod in tree.modules("tpuparquet/"):
+        if path in EXCLUDE:
+            continue
+        for node in ast.walk(mod):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            qualified = isinstance(f, ast.Attribute) and \
+                f.attr == "flight"
+            bare = isinstance(f, ast.Name) and f.id == "flight"
+            if not (qualified or bare):
+                continue
+            fn = enclosing_function(node)
+            guarded, in_loop, in_except = _context(node, fn)
+            if guarded:
+                continue
+            fname = fn.name if fn is not None else "<module>"
+            kind = ""
+            if node.args and isinstance(node.args[0], ast.Constant):
+                kind = str(node.args[0].value)
+            key = f"{fname}:{kind}" if kind else fname
+            if qualified:
+                findings.append(Finding(
+                    PASS, path, node.lineno, "unguarded-hot-flight",
+                    key,
+                    f"module-qualified flight() call in {fname}() "
+                    f"without the `_active is not None` guard — the "
+                    f"qualified form exists exactly so hot sites can "
+                    f"skip kwargs construction when the recorder is "
+                    f"off"))
+            elif in_loop and not in_except:
+                findings.append(Finding(
+                    PASS, path, node.lineno, "unguarded-hot-flight",
+                    key,
+                    f"flight() call inside a loop in {fname}() "
+                    f"constructs kwargs even with the recorder "
+                    f"disabled — guard with `_active is not None` "
+                    f"(hot) or move to an exceptional path (cold)"))
+    return findings

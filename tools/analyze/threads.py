@@ -1,0 +1,471 @@
+"""Thread-safety pass: module-level state is guarded; locks are acyclic.
+
+The serve-regime roadmap (long-lived multi-tenant process) makes
+"module global mutated off-thread" the highest-risk latent bug class:
+it works in every test and loses state under production concurrency.
+This pass inventories **module-level mutable state** in every module
+of ``tpuparquet/`` that imports ``threading`` and requires each piece
+to be one of:
+
+* ``threading.local()`` — per-thread by construction;
+* a lock/condition itself;
+* an instance of a *self-synchronized* class (its ``__init__`` binds
+  a ``threading.Lock``/``RLock``, or delegates to another
+  self-synchronized class such as ``ThreadSlots``);
+* mutated **only under a module-level lock** (every rebind of a
+  ``global``, and every container mutation, lexically inside
+  ``with <lock>:``);
+* or explicitly allowlisted with a reason (the atomic
+  reference-swap globals like ``faults._active`` are the intended
+  tenants).
+
+It also extracts the **static lock-acquisition graph** — "while
+holding lock A, code may call into something that takes lock B" —
+across the threaded modules and rejects cycles (including self-loops:
+``threading.Lock`` is not reentrant).  Call resolution is
+name-based and conservative: same-module functions, imported
+module members, ``self.`` methods, and attribute calls whose method
+name is defined by analyzed classes (ambiguous names fan out to every
+definer — a false edge can only *add* scrutiny, never hide a cycle).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .astutil import Finding, RepoTree, call_name
+
+PASS = "thread-safety"
+
+_LOCK_CTORS = ("Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore")
+_CONTAINER_CTORS = ("dict", "list", "set", "deque", "OrderedDict",
+                    "defaultdict", "WeakSet", "WeakValueDictionary",
+                    "WeakKeyDictionary", "Counter")
+_MUTATORS = ("append", "add", "update", "extend", "insert", "remove",
+             "discard", "clear", "pop", "popitem", "setdefault",
+             "appendleft", "extendleft")
+#: method names too generic to resolve call edges through
+_GENERIC_METHODS = frozenset({
+    "get", "pop", "update", "add", "append", "items", "keys",
+    "values", "copy", "clear", "extend", "remove", "discard",
+    "setdefault", "popitem", "join", "start", "put", "read", "write",
+    "close", "acquire", "release", "wait", "notify", "notify_all",
+    "sort", "insert", "index", "count", "encode", "decode", "format",
+    "split", "strip", "startswith", "endswith", "record",
+})
+
+
+def _imports_threading(mod: ast.AST) -> bool:
+    for node in ast.walk(mod):
+        if isinstance(node, ast.Import):
+            if any(a.name == "threading" for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "threading":
+                return True
+    return False
+
+
+def _ctor_name(value) -> str | None:
+    """The constructor name of a call expression, if any."""
+    if isinstance(value, ast.Call):
+        return call_name(value)
+    return None
+
+
+class _Module:
+    """Per-module facts the pass reasons over."""
+
+    def __init__(self, path: str, mod: ast.AST):
+        self.path = path
+        self.mod = mod
+        self.locks: set[str] = set()       # module-level lock names
+        self.locals_: set[str] = set()     # threading.local names
+        self.containers: dict[str, int] = {}   # name -> def line
+        self.instances: dict[str, tuple] = {}  # name -> (ctor, line)
+        self.scalars: dict[str, int] = {}  # every other module name
+        self.globals_: set[str] = set()    # names rebound via global
+        self.classes: dict[str, ast.ClassDef] = {}
+        self.functions: dict[str, ast.AST] = {}
+        self.imports: dict[str, str] = {}  # local alias -> source name
+        self._scan()
+
+    def _scan(self) -> None:
+        for node in self.mod.body:
+            if isinstance(node, ast.ClassDef):
+                self.classes[node.name] = node
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = [t for t in node.targets
+                           if isinstance(t, ast.Name)]
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Name):
+                targets = [node.target]
+                value = node.value
+            else:
+                continue
+            ctor = _ctor_name(value)
+            for t in targets:
+                if t.id == "__all__":
+                    continue
+                if ctor in _LOCK_CTORS:
+                    self.locks.add(t.id)
+                elif ctor == "local":
+                    self.locals_.add(t.id)
+                elif ctor in _CONTAINER_CTORS or \
+                        isinstance(value, (ast.Dict, ast.List,
+                                           ast.Set)):
+                    self.containers[t.id] = node.lineno
+                elif ctor is not None and ctor[:1].isupper():
+                    self.instances[t.id] = (ctor, node.lineno)
+                else:
+                    self.scalars[t.id] = node.lineno
+        for node in ast.walk(self.mod):
+            if isinstance(node, ast.Global):
+                self.globals_.update(node.names)
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    self.imports[a.asname or a.name] = a.name
+
+
+def _held_module_locks(node, module: _Module) -> set[str]:
+    """Module-level lock names held (via ``with``) at ``node``."""
+    from .astutil import ancestors
+
+    held: set[str] = set()
+    for a in ancestors(node):
+        if isinstance(a, ast.With):
+            for item in a.items:
+                ctx = item.context_expr
+                if isinstance(ctx, ast.Name) and ctx.id in module.locks:
+                    held.add(ctx.id)
+    return held
+
+
+def _self_synchronized(ctor: str, mod: _Module,
+                       mods: dict[str, _Module],
+                       _seen: frozenset = frozenset()) -> bool:
+    """Is class ``ctor`` self-synchronized?  True when its __init__
+    binds a lock attribute, or binds an attribute to another
+    self-synchronized class (``ThreadSlots`` delegation)."""
+    if ctor in _seen:
+        return False
+    cls = mod.classes.get(ctor)
+    home = mod
+    if cls is None:
+        # imported class: resolve by name across analyzed modules
+        for m in mods.values():
+            if ctor in m.classes:
+                cls, home = m.classes[ctor], m
+                break
+    if cls is None:
+        return False
+    init = next((n for n in cls.body
+                 if isinstance(n, ast.FunctionDef)
+                 and n.name == "__init__"), None)
+    if init is None:
+        return False
+    for node in ast.walk(init):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call):
+            name = call_name(node.value)
+            if name in _LOCK_CTORS or name == "local":
+                return True
+            if name and name[:1].isupper() and _self_synchronized(
+                    name, home, mods, _seen | {ctor}):
+                return True
+    return False
+
+
+# ----------------------------------------------------------------------
+# Mutable-state findings
+# ----------------------------------------------------------------------
+
+def _state_findings(module: _Module,
+                    mods: dict[str, _Module]) -> list[Finding]:
+    findings: list[Finding] = []
+
+    # unsynchronized module-level instances
+    for name, (ctor, line) in sorted(module.instances.items()):
+        if _self_synchronized(ctor, module, mods):
+            continue
+        findings.append(Finding(
+            PASS, module.path, line, "unsynchronized-module-instance",
+            name,
+            f"module-level {name} = {ctor}(...) in a threaded module, "
+            f"and {ctor} has no lock of its own — concurrent use "
+            f"races unless every access is externally serialized "
+            f"(allowlist with the reason if so)"))
+
+    # unguarded rebinds of globals
+    interesting = (set(module.scalars) | set(module.containers)
+                   | set(module.instances)) & module.globals_
+    for node in ast.walk(module.mod):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            if not (isinstance(t, ast.Name) and t.id in interesting):
+                continue
+            fn = _enclosing_fn(node)
+            if fn is None:
+                continue  # the module-level definition itself
+            if _held_module_locks(node, module):
+                continue
+            findings.append(Finding(
+                PASS, module.path, node.lineno,
+                "unlocked-global-rebind", t.id,
+                f"global {t.id} rebound in {fn.name}() outside any "
+                f"module lock — racing rebinds can lose one writer's "
+                f"update (allowlist only if this is a deliberate "
+                f"atomic reference swap)"))
+
+    # unguarded container mutations
+    for node in ast.walk(module.mod):
+        name = mut = None
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.attr in _MUTATORS:
+            name, mut = node.func.value.id, node.func.attr
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            tgts = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in tgts:
+                if isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Name):
+                    name, mut = t.value.id, "[]="
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Name):
+                    name, mut = t.value.id, "del[]"
+        if name is None or name not in module.containers:
+            continue
+        if _enclosing_fn(node) is None:
+            continue  # import-time population is single-threaded
+        if _held_module_locks(node, module):
+            continue
+        findings.append(Finding(
+            PASS, module.path, node.lineno, "unlocked-module-state",
+            name,
+            f"module-level container {name} mutated (.{mut}) outside "
+            f"any module lock in a threaded module — concurrent "
+            f"mutation corrupts or loses entries"))
+    return findings
+
+
+def _enclosing_fn(node):
+    from .astutil import enclosing_function
+
+    return enclosing_function(node)
+
+
+# ----------------------------------------------------------------------
+# Lock-acquisition graph
+# ----------------------------------------------------------------------
+
+def _lock_exprs(item_ctx, module: _Module, cls_locks: set[str]):
+    """Lock identity of a with-item context expr, or None."""
+    if isinstance(item_ctx, ast.Name) and item_ctx.id in module.locks:
+        return (module.path, item_ctx.id)
+    if isinstance(item_ctx, ast.Attribute) and \
+            isinstance(item_ctx.value, ast.Name) and \
+            item_ctx.value.id == "self" and item_ctx.attr in cls_locks:
+        return (module.path, f"self.{item_ctx.attr}")
+    return None
+
+
+def _class_locks(cls: ast.ClassDef) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                call_name(node.value) in _LOCK_CTORS:
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    out.add(t.attr)
+    return out
+
+
+def _build_lock_graph(mods: dict[str, _Module]):
+    """Edges (lockA, lockB, file, line): holding A, a call chain can
+    acquire B.  Lock identity: (module-path, name) for module locks,
+    (module-path, Class._attr) for instance locks."""
+    # function universe: (path, qualname) -> (fnnode, module, class|None)
+    funcs: dict[tuple, tuple] = {}
+    method_index: dict[str, list[tuple]] = {}
+    for m in mods.values():
+        for fname, fn in m.functions.items():
+            funcs[(m.path, fname)] = (fn, m, None)
+        for cname, cls in m.classes.items():
+            for node in cls.body:
+                if isinstance(node, ast.FunctionDef):
+                    funcs[(m.path, f"{cname}.{node.name}")] = \
+                        (node, m, cls)
+                    method_index.setdefault(node.name, []).append(
+                        (m.path, f"{cname}.{node.name}"))
+
+    def resolve_call(call: ast.Call, m: _Module, cls) -> list[tuple]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            if (m.path, f.id) in funcs:
+                return [(m.path, f.id)]
+            src = m.imports.get(f.id)
+            if src:
+                for om in mods.values():
+                    if (om.path, src) in funcs:
+                        return [(om.path, src)]
+            return []
+        if isinstance(f, ast.Attribute):
+            if isinstance(f.value, ast.Name) and f.value.id == "self" \
+                    and cls is not None:
+                key = (m.path, f"{cls.name}.{f.attr}")
+                return [key] if key in funcs else []
+            if f.attr in _GENERIC_METHODS:
+                return []
+            return method_index.get(f.attr, [])
+        return []
+
+    # locks each function acquires directly
+    def direct_locks(fnkey) -> set[tuple]:
+        fn, m, cls = funcs[fnkey]
+        cls_locks = _class_locks(cls) if cls is not None else set()
+        out = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    lk = _lock_exprs(item.context_expr, m, cls_locks)
+                    if lk is not None:
+                        name = lk[1]
+                        if name.startswith("self.") and cls is not None:
+                            lk = (lk[0],
+                                  f"{cls.name}.{name[5:]}")
+                        out.add(lk)
+        return out
+
+    # transitive: locks reachable from calling fnkey, computed as a
+    # fixpoint over the whole call graph — recursion with memoization
+    # would cache cycle-truncated partial results for mutually
+    # recursive functions and silently hide edges (and with them,
+    # deadlock cycles)
+    callees: dict[tuple, set[tuple]] = {}
+    reach: dict[tuple, set[tuple]] = {}
+    for fnkey, (fn, m, cls) in funcs.items():
+        outs: set[tuple] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                outs.update(resolve_call(node, m, cls))
+        callees[fnkey] = outs
+        reach[fnkey] = set(direct_locks(fnkey))
+    changed = True
+    while changed:
+        changed = False
+        for fnkey, outs in callees.items():
+            r = reach[fnkey]
+            before = len(r)
+            for c in outs:
+                r |= reach[c]
+            if len(r) != before:
+                changed = True
+
+    def reachable_locks(fnkey) -> set[tuple]:
+        return reach[fnkey]
+
+    edges: set[tuple] = set()
+    for fnkey, (fn, m, cls) in funcs.items():
+        cls_locks = _class_locks(cls) if cls is not None else set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.With):
+                continue
+            held = []
+            for item in node.items:
+                lk = _lock_exprs(item.context_expr, m, cls_locks)
+                if lk is not None:
+                    name = lk[1]
+                    if name.startswith("self.") and cls is not None:
+                        lk = (lk[0], f"{cls.name}.{name[5:]}")
+                    held.append(lk)
+            if not held:
+                continue
+            acquired: set[tuple] = set()
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.With):
+                        for item in sub.items:
+                            lk = _lock_exprs(item.context_expr, m,
+                                             cls_locks)
+                            if lk is not None:
+                                name = lk[1]
+                                if name.startswith("self.") and \
+                                        cls is not None:
+                                    lk = (lk[0],
+                                          f"{cls.name}.{name[5:]}")
+                                acquired.add(lk)
+                    elif isinstance(sub, ast.Call):
+                        for callee in resolve_call(sub, m, cls):
+                            acquired |= reachable_locks(callee)
+            for a in held:
+                for b in acquired:
+                    edges.add((a, b, m.path, node.lineno))
+    return edges
+
+
+def _find_cycles(edges) -> list[list]:
+    graph: dict = {}
+    meta: dict = {}
+    for a, b, path, line in edges:
+        graph.setdefault(a, set()).add(b)
+        meta[(a, b)] = (path, line)
+    cycles: list[list] = []
+    seen_cycles: set = set()
+
+    def dfs(start, node, stack, visited):
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start:
+                cyc = tuple(stack)
+                key = frozenset(cyc)
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    cycles.append(list(stack) + [start])
+            elif nxt not in visited and len(stack) < 8:
+                visited.add(nxt)
+                dfs(start, nxt, stack + [nxt], visited)
+    for n in sorted(graph):
+        dfs(n, n, [n], {n})
+    return [(c, meta.get((c[0], c[1]), ("", 0))) for c in cycles]
+
+
+def threaded_modules(tree: RepoTree) -> list[str]:
+    out = []
+    for path, mod in tree.modules("tpuparquet/"):
+        if _imports_threading(mod):
+            out.append(path)
+    return out
+
+
+def run(tree: RepoTree) -> list[Finding]:
+    findings: list[Finding] = []
+    mods: dict[str, _Module] = {}
+    for path, mod in tree.modules("tpuparquet/"):
+        if _imports_threading(mod):
+            mods[path] = _Module(path, mod)
+    for m in mods.values():
+        findings.extend(_state_findings(m, mods))
+    for cyc, (path, line) in _find_cycles(_build_lock_graph(mods)):
+        names = " -> ".join(f"{p.split('/')[-1]}:{n}" for p, n in cyc)
+        findings.append(Finding(
+            PASS, path or cyc[0][0], line, "lock-cycle", names,
+            f"static lock-acquisition cycle {names} — two threads "
+            f"entering from different ends deadlock (threading.Lock "
+            f"is not reentrant, so a self-loop deadlocks one thread "
+            f"alone)"))
+    return findings

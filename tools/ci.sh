@@ -1,7 +1,7 @@
 #!/bin/bash
 # In-repo CI gate (counterpart of the reference's .circleci/config.yml,
 # which pins go versions and runs `go test ./...` + the compatibility
-# corpus per commit).  Four stages, pinned env:
+# corpus per commit).  Nine stages, pinned env:
 #
 #   1. tier-1 suite   — the ROADMAP.md verify command, gated on a PASS
 #                       FLOOR rather than rc: optional deps (zstandard,
@@ -43,6 +43,15 @@
 #                       corpora, serial AND parallel plans, with fault
 #                       injection and salvage=True, plus the
 #                       corrupt-index degrade-to-no-pruning pin
+#   9. static analysis — strict (rc=0): the tpq-analyze invariant
+#                       passes (counters / fault sites / env knobs /
+#                       atomic writes / recorder guards / thread
+#                       safety + lock graph) must report ZERO
+#                       unsuppressed findings, the analyzer's own
+#                       seeded-bug suite must pass, and the native
+#                       ASan+UBSan + C-static-analysis leg runs
+#                       (skipping loudly when no sanitizer-capable
+#                       compiler is on the box)
 #
 # Usage: bash tools/ci.sh            (exit 0 = gate passed)
 # The tier-1 stage mirrors ROADMAP.md exactly — if you change one,
@@ -65,7 +74,7 @@ CI_PASS_FLOOR=${CI_PASS_FLOOR:-1000}
 
 fail() { echo "ci.sh: FAILED at stage $1" >&2; exit 1; }
 
-echo "=== stage 1/8: tier-1 suite (pass floor $CI_PASS_FLOOR) ==="
+echo "=== stage 1/9: tier-1 suite (pass floor $CI_PASS_FLOOR) ==="
 rm -f /tmp/_t1.log
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
@@ -79,25 +88,25 @@ echo "DOTS_PASSED=$passed"
 [ "$passed" -ge "$CI_PASS_FLOOR" ] \
   || fail "tier-1 ($passed passed < floor $CI_PASS_FLOOR)"
 
-echo "=== stage 2/8: smoke bench (CPU backend, tiny target) ==="
+echo "=== stage 2/9: smoke bench (CPU backend, tiny target) ==="
 TPQ_BENCH_TARGET=60000 TPQ_BENCH_CPU=1 timeout -k 10 600 \
   python bench.py > /tmp/_ci_bench.json || fail "smoke bench"
 tail -1 /tmp/_ci_bench.json
 
-echo "=== stage 3/8: crash corpus + fault-injection matrix (strict) ==="
+echo "=== stage 3/9: crash corpus + fault-injection matrix (strict) ==="
 timeout -k 10 600 python -m pytest \
   "tests/test_corpus.py::TestCrashRegressions" tests/test_faults.py \
   -q -p no:cacheprovider || fail "corpus/faults"
 
-echo "=== stage 4/8: salvage + strict metadata (strict) ==="
+echo "=== stage 4/9: salvage + strict metadata (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_salvage.py \
   -q -p no:cacheprovider || fail "salvage"
 
-echo "=== stage 5/8: deadlines/hedging + kill-resume checkpoints (strict) ==="
+echo "=== stage 5/9: deadlines/hedging + kill-resume checkpoints (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_deadline.py \
   tests/test_checkpoint.py -q -p no:cacheprovider || fail "time/crash"
 
-echo "=== stage 6/8: plan matrix: serial vs parallel, cache on (strict) ==="
+echo "=== stage 6/9: plan matrix: serial vs parallel, cache on (strict) ==="
 # leg A: pinned-serial planning (the TPQ_PLAN_THREADS=1 reference path)
 TPQ_PLAN_THREADS=1 timeout -k 10 600 python -m pytest \
   tests/test_plan_parallel.py tests/test_plan_cache.py \
@@ -108,7 +117,7 @@ TPQ_PLAN_CACHE_MB=64 timeout -k 10 600 python -m pytest \
   tests/test_plan_parallel.py tests/test_fallback_matrix.py \
   -q -p no:cacheprovider || fail "plan matrix (cache-on leg)"
 
-echo "=== stage 7/8: live obs gate + overhead guard (strict) ==="
+echo "=== stage 7/9: live obs gate + overhead guard (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_live_obs.py \
   tests/test_env_docs.py -q -p no:cacheprovider || fail "live obs"
 # overhead guard: the always-on default must stay within a generous
@@ -119,7 +128,7 @@ timeout -k 10 600 python tools/bench_obs.py --values 2000000 \
   || fail "obs overhead guard"
 tail -5 /tmp/_ci_obs.json
 
-echo "=== stage 8/8: pruning parity gate (strict) ==="
+echo "=== stage 8/9: pruning parity gate (strict) ==="
 # leg A: the whole pushdown suite (write/read page index + bloom,
 # verdicts, late materialization, counter exactness, corrupt-index
 # degrade, pyarrow interop) on the default pool width
@@ -131,5 +140,11 @@ timeout -k 10 600 python -m pytest tests/test_prune.py \
 TPQ_PLAN_THREADS=1 TPQ_PRUNE=0 timeout -k 10 600 python -m pytest \
   "tests/test_prune.py::TestParity" \
   -q -p no:cacheprovider || fail "pruning parity (prune-off leg)"
+
+echo "=== stage 9/9: tpq-analyze invariant passes + sanitizer leg (strict) ==="
+timeout -k 10 300 python -m tools.analyze || fail "tpq-analyze"
+timeout -k 10 600 python -m pytest tests/test_analyze.py \
+  -q -p no:cacheprovider || fail "analyzer self-test"
+timeout -k 10 900 bash tools/analyze/native.sh || fail "native sanitizers"
 
 echo "ci.sh: gate PASSED"

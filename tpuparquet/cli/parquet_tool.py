@@ -678,6 +678,45 @@ def _rescue(args, like, out, CompressionCodec, created: list) -> int:
     return 0
 
 
+def cmd_analyze(args, out=None) -> int:
+    """Run the tpq-analyze invariant passes (``tools/analyze``) and
+    report findings — the same gate ``python -m tools.analyze`` and
+    ci.sh stage 9 run, surfaced as a tool subcommand with ``--json``
+    output consistent with ``profile --json``.  Exits nonzero when
+    the gate fails.  Source-tree only: the analyzer ships with the
+    repo, not the installed wheel."""
+    import json as _json
+
+    out = out or sys.stdout
+    root = args.root or os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if not os.path.isdir(os.path.join(root, "tools", "analyze")):
+        raise ValueError(
+            f"no tools/analyze under {root!r} — parquet-tool analyze "
+            f"runs from a source checkout (pass --root)")
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from tools.analyze import run_analysis
+
+    res = run_analysis(root=root, passes=args.passes or None)
+    if getattr(args, "json", False):
+        res["root"] = root
+        _json.dump(res, out, sort_keys=True)
+        print(file=out)
+    else:
+        for f in res["findings"]:
+            print(f"{f['file']}:{f['line']}: [{f['pass']}/"
+                  f"{f['code']}] {f['key']}: {f['why']}", file=out)
+        for e in res["stale_allowlist"]:
+            print(f"allowlist: stale entry ({e['pass']}, {e['file']}, "
+                  f"{e['key']}) suppresses nothing — drop it",
+                  file=out)
+        print(f"analyze: {len(res['findings'])} finding(s), "
+              f"{len(res['suppressed'])} allowlisted — gate "
+              + ("PASSED" if res["ok"] else "FAILED"), file=out)
+    return 0 if res["ok"] else 1
+
+
 def cmd_split(args, out=None) -> int:
     """Re-shard into multiple files of ~--file-size each
     (``split.go:33-122``)."""
@@ -831,6 +870,21 @@ def build_parser() -> argparse.ArgumentParser:
     rs.add_argument("file")
     rs.add_argument("output")
     rs.set_defaults(fn=cmd_rescue)
+
+    an = sub.add_parser(
+        "analyze",
+        help="run the tpq-analyze static invariant passes over the "
+             "source tree (tools/analyze)")
+    an.add_argument("--json", action="store_true",
+                    help="emit the full findings digest as "
+                         "machine-readable JSON (like profile --json)")
+    an.add_argument("--pass", dest="passes", action="append",
+                    metavar="NAME",
+                    help="run only this pass (repeatable)")
+    an.add_argument("--root", default="",
+                    help="repo root (default: the checkout this "
+                         "module ships in)")
+    an.set_defaults(fn=cmd_analyze)
 
     sp = sub.add_parser("split", help="split into multiple parquet files")
     sp.add_argument("-s", "--file-size", default="100MB",

@@ -226,12 +226,19 @@ def watchdog() -> Watchdog:
 #: case where the slow operation completed shortly after being
 #: abandoned.
 _workers: "weakref.WeakSet[threading.Thread]" = weakref.WeakSet()
+# WeakSet is pure Python and not thread-safe; hedge/deadline
+# coordinators on different threads spawn workers concurrently, and
+# an unguarded add can race the GC-driven discard of a dead worker's
+# weakref (and the exit drain's iteration) inside the set's own
+# bookkeeping
+_workers_lock = threading.Lock()
 _EXIT_GRACE_S = 5.0
 
 
 def _spawn_worker(target, name: str) -> threading.Thread:
     t = threading.Thread(target=target, daemon=True, name=name)
-    _workers.add(t)
+    with _workers_lock:
+        _workers.add(t)
     t.start()
     return t
 
@@ -239,7 +246,9 @@ def _spawn_worker(target, name: str) -> threading.Thread:
 @atexit.register
 def _drain_workers_at_exit() -> None:
     stop_at = time.monotonic() + _EXIT_GRACE_S
-    for t in list(_workers):
+    with _workers_lock:
+        pending = list(_workers)
+    for t in pending:
         t.join(max(stop_at - time.monotonic(), 0.0))
 
 
@@ -435,9 +444,11 @@ def hedged_call(fns, *, delay: float, site: str,
             if tracker is not None:
                 tracker.record(time.monotonic() - starts[i])
             if i > 0:
-                from .obs.recorder import flight
+                from .obs import recorder as _flightrec
 
-                flight("hedge_won", site=site, replica=i, **coords)
+                if _flightrec._active is not None:
+                    _flightrec.flight("hedge_won", site=site,
+                                      replica=i, **coords)
                 if st is not None:
                     st.hedges_won += 1
                     if st.events is not None:

@@ -48,6 +48,10 @@ site                                  instrumented where / supported kinds
                                       replica) — ``hang``
 ``kernels.device.hang``               device dispatch
                                       (``_finish_row_group``) — ``hang``
+``format.pageindex``                  page-index / bloom-filter blob
+                                      reads (``io/reader.py``) —
+                                      ``oserror``, ``transient``,
+                                      ``corrupt``, ``truncate``
 ====================================  =====================================
 
 Kinds: ``oserror`` raises ``OSError(EIO)``; ``transient`` raises
@@ -86,7 +90,32 @@ __all__ = [
     "backoff_delays",
     "is_transient",
     "QuarantineReport",
+    "SITES",
 ]
+
+#: The fault-site registry: every instrumented site name and the
+#: fault kinds it supports.  Sites match rules by STRING EQUALITY, so
+#: a drifted name doesn't error — it just never fires; this registry
+#: is the single source of truth that the instrumentation hooks, the
+#: docstring table above, and the matrices in ``tests/test_faults.py``
+#: are all checked against (``tools/analyze`` fault-site pass).  Add
+#: the row HERE first when instrumenting a new site.
+SITES: dict[str, tuple] = {
+    "io.reader.open": ("oserror", "transient"),
+    "io.reader.chunk_read": ("oserror", "transient",
+                             "corrupt", "truncate"),
+    "io.chunk.page_payload": ("corrupt", "truncate"),
+    "io.chunk.hang": ("hang",),
+    "io.pages.page_decode": ("corrupt", "truncate"),
+    "kernels.device.page_payload": ("corrupt", "truncate"),
+    "kernels.device.page_dispatch": ("dispatch",),
+    "kernels.device.unit_dispatch": ("dispatch",),
+    "kernels.device.hang": ("hang",),
+    "format.footer.tail": ("corrupt", "truncate"),
+    "format.footer.blob": ("corrupt", "truncate"),
+    "format.pageindex": ("oserror", "transient",
+                         "corrupt", "truncate"),
+}
 
 _active: "FaultInjector | None" = None
 

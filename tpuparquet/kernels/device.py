@@ -2579,8 +2579,11 @@ def read_row_group_device_resilient(reader, rg_index: int,
                 raise
             last = e
         if attempt < len(delays):
-            flight("dispatch_retry", site="kernels.device.unit_dispatch",
-                   row_group=rg_index, error=type(last).__name__)
+            if _flightrec._active is not None:
+                _flightrec.flight(
+                    "dispatch_retry",
+                    site="kernels.device.unit_dispatch",
+                    row_group=rg_index, error=type(last).__name__)
             st = current_stats()
             if st is not None:
                 st.dispatch_retries += 1

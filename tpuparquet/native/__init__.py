@@ -70,7 +70,18 @@ def _lib() -> "ctypes.CDLL | None":
     with _lock:
         if _cached is False:
             _cached = None
-            if _build():
+            # TPQ_NATIVE_SO: load a prebuilt shared library instead of
+            # building from the checked-in sources — the sanitizer leg
+            # (tools/analyze/native.sh) points this at its ASan+UBSan
+            # instrumented build so the whole test suite exercises the
+            # instrumented codecs without touching the cached .so
+            override = os.environ.get("TPQ_NATIVE_SO")
+            if override:
+                try:
+                    _cached = ctypes.CDLL(override)
+                except OSError:
+                    _cached = None
+            elif _build():
                 try:
                     _cached = ctypes.CDLL(_SO)
                 except OSError:

@@ -28,6 +28,7 @@ from ..errors import (
 )
 from ..faults import QuarantineReport
 from ..io.reader import FileReader
+from ..obs import recorder as _flightrec
 from ..obs.postmortem import postmortem_path_for, record_incident
 from ..obs.recorder import flight
 from ..kernels.decode import scatter_to_dense
@@ -79,8 +80,10 @@ def scan_units(readers: list[FileReader], filter=None,
                             (fi, rgi,
                              r.meta.row_groups[rgi].num_rows,
                              v.reason, v.bloom_hits))
-                    flight("row_group_pruned", site="shard.scan",
-                           file=fi, row_group=rgi, reason=v.reason)
+                    if _flightrec._active is not None:
+                        _flightrec.flight(
+                            "row_group_pruned", site="shard.scan",
+                            file=fi, row_group=rgi, reason=v.reason)
                     continue
                 if verdicts is not None:
                     verdicts[(fi, rgi)] = v
@@ -262,7 +265,9 @@ def open_sources(sources, columns, *, on_error: str,
         entry = quarantine.add_file(file=i, error=err, **extra)
         if entry_extra:
             entry.update(entry_extra)
-        flight("file_quarantined", site="shard.scan.file", **entry)
+        if _flightrec._active is not None:
+            _flightrec.flight("file_quarantined",
+                              site="shard.scan.file", **entry)
         record_incident(postmortem, {
             "kind": "file_quarantined", "site": "shard.scan.file",
             **entry})
@@ -716,10 +721,12 @@ class DurableScanMixin:
                     k, rows=rows, quarantined=out is None,
                     bytes_staged=(st.bytes_staged
                                   if st is not None else None))
-                flight("unit_done" if out is not None
-                       else "unit_quarantined",
-                       site="shard.scan", unit=k, file=fi,
-                       row_group=rgi, rows=rows)
+                if _flightrec._active is not None:
+                    _flightrec.flight(
+                        "unit_done" if out is not None
+                        else "unit_quarantined",
+                        site="shard.scan", unit=k, file=fi,
+                        row_group=rgi, rows=rows)
                 self._fold_live()
                 if out is not None:
                     yield k, out
