@@ -195,9 +195,9 @@ def test_host_counter_observable_in_stats_dict():
     assert "pages_host_values" in DecodeStats().as_dict()
 
 
-def _dba_transports(values) -> set:
-    """Decode a one-column DELTA_BYTE_ARRAY file and return the set of
-    transports its data pages took."""
+def _dba_events(values):
+    """Decode a one-column DELTA_BYTE_ARRAY file and return its data
+    page events (transport + the gate's wire numbers)."""
     buf = io.BytesIO()
     w = FileWriter(buf, "message m { required binary c; }",
                    column_encodings={"c": Encoding.DELTA_BYTE_ARRAY},
@@ -209,7 +209,11 @@ def _dba_transports(values) -> set:
     with collect_stats(events=True) as st:
         for c in read_row_group_device(r, 0).values():
             c.block_until_ready()
-    return {e.transport for e in st.events.pages}
+    return st.events.pages
+
+
+def _dba_transports(values) -> set:
+    return {e.transport for e in _dba_events(values)}
 
 
 class TestHostAssemblyGolden:
@@ -238,3 +242,49 @@ class TestHostAssemblyGolden:
         EXPECTED_HOST about host fallback (no kernel) — a combination
         in both would be incoherent."""
         assert not (set(HOST_ASSEMBLY_EXCEPTIONS) & EXPECTED_HOST)
+
+    def test_host_assembly_wire_numbers_pinned(self):
+        """The per-page wire numbers that JUSTIFY host assembly are
+        part of the contract, not prose: every dba-host page must
+        carry the gate's (expanded, compact) byte counts and must
+        have shipped STRICTLY fewer bytes assembled than the compact
+        wire form would have — equality routes through the device
+        copy-graph kernel (see the wire-neutral test below)."""
+        vals = ByteArrayColumn.from_list(
+            [(b"%08x" % (i * 2654435761 % 2**32)) for i in range(2000)])
+        events = _dba_events(vals)
+        assert events
+        for e in events:
+            assert e.transport == "dba-host"
+            assert e.gate and {"expanded", "compact"} <= set(e.gate)
+            # host assembly ships the expanded bytes; the justification
+            # is that this is strictly fewer than the compact wire form
+            assert e.wire_bytes == e.gate["expanded"] > 0
+            assert e.gate["expanded"] < e.gate["compact"], (
+                "host-assembled page did not ship strictly fewer "
+                f"bytes: {e.gate}")
+
+    def test_device_pages_pin_their_wire_numbers_too(self):
+        """Symmetric pin for the device branch: the compact wire form
+        it ships must be no larger than the expansion it avoids."""
+        vals = ByteArrayColumn.from_list(
+            [("warehouse/region-7/shelf-%04d/item-%07d"
+              % (i // 40, i)).encode() for i in range(2000)])
+        events = _dba_events(vals)
+        assert events
+        for e in events:
+            assert e.transport == "dba"
+            assert e.wire_bytes == e.gate["compact"]
+            assert e.gate["compact"] <= e.gate["expanded"]
+
+    def test_wire_neutral_front_coding_stays_on_device(self):
+        """expanded == compact (two identical 16-byte values: expanded
+        = 32B, compact = 16B suffix + 2*8B token table = 32B): shipping
+        either form costs the same wire, so the page takes the device
+        copy-graph kernel rather than burning host CPU on assembly —
+        the 'route when wire-neutral' half of the golden contract."""
+        vals = ByteArrayColumn.from_list([b"0123456789abcdef"] * 2)
+        events = _dba_events(vals)
+        assert {e.transport for e in events} == {"dba"}
+        for e in events:
+            assert e.gate["expanded"] == e.gate["compact"], e.gate

@@ -50,6 +50,20 @@ while true; do
     TPQ_BENCH_PROBE_TIMEOUT=60 TPQ_BENCH_PROBE_ATTEMPTS=1 \
       python bench.py
     echo "$(date -Is) ladder attempt finished (rc=$?)"
+    # scan-scale sweep with the output-placement legs (gather wall,
+    # ROADMAP item 5): capture the real-ICI curve once per session,
+    # queued after the sweep+ladder so it never delays the official
+    # record
+    if [ ! -f SCAN_SCALE_DEVICE_r06.json ]; then
+      echo "$(date -Is) running scan-scale placement sweep"
+      if TPQ_SCAN_SCALE_BACKEND=device timeout 1200 \
+          python tools/bench_scan_scale.py \
+          SCAN_SCALE_DEVICE_r06.json; then
+        echo "$(date -Is) scan-scale sweep OK"
+      else
+        echo "$(date -Is) scan-scale sweep FAILED (rc=$?)"
+      fi
+    fi
   else
     echo "$(date -Is) tunnel down"
   fi

@@ -1,7 +1,7 @@
 #!/bin/bash
 # In-repo CI gate (counterpart of the reference's .circleci/config.yml,
 # which pins go versions and runs `go test ./...` + the compatibility
-# corpus per commit).  Nine stages, pinned env:
+# corpus per commit).  Ten stages, pinned env:
 #
 #   1. tier-1 suite   — the ROADMAP.md verify command, gated on a PASS
 #                       FLOOR rather than rc: optional deps (zstandard,
@@ -52,6 +52,15 @@
 #                       ASan+UBSan + C-static-analysis leg runs
 #                       (skipping loudly when no sanitizer-capable
 #                       compiler is on the box)
+#  10. gather parity    — strict (rc=0): consumer-aligned output
+#                       placement must stay byte-identical to the
+#                       replicated gather across the hard scan paths
+#                       (filter pruning, quarantine, salvage, cursor
+#                       resume, multi-host), then the whole placement
+#                       suite re-runs under TPQ_GATHER_TO=0 (every
+#                       scan's default placement armed) — the env
+#                       knob cannot change values or leak into the
+#                       free functions
 #
 # Usage: bash tools/ci.sh            (exit 0 = gate passed)
 # The tier-1 stage mirrors ROADMAP.md exactly — if you change one,
@@ -74,7 +83,7 @@ CI_PASS_FLOOR=${CI_PASS_FLOOR:-1000}
 
 fail() { echo "ci.sh: FAILED at stage $1" >&2; exit 1; }
 
-echo "=== stage 1/9: tier-1 suite (pass floor $CI_PASS_FLOOR) ==="
+echo "=== stage 1/10: tier-1 suite (pass floor $CI_PASS_FLOOR) ==="
 rm -f /tmp/_t1.log
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
@@ -88,25 +97,25 @@ echo "DOTS_PASSED=$passed"
 [ "$passed" -ge "$CI_PASS_FLOOR" ] \
   || fail "tier-1 ($passed passed < floor $CI_PASS_FLOOR)"
 
-echo "=== stage 2/9: smoke bench (CPU backend, tiny target) ==="
+echo "=== stage 2/10: smoke bench (CPU backend, tiny target) ==="
 TPQ_BENCH_TARGET=60000 TPQ_BENCH_CPU=1 timeout -k 10 600 \
   python bench.py > /tmp/_ci_bench.json || fail "smoke bench"
 tail -1 /tmp/_ci_bench.json
 
-echo "=== stage 3/9: crash corpus + fault-injection matrix (strict) ==="
+echo "=== stage 3/10: crash corpus + fault-injection matrix (strict) ==="
 timeout -k 10 600 python -m pytest \
   "tests/test_corpus.py::TestCrashRegressions" tests/test_faults.py \
   -q -p no:cacheprovider || fail "corpus/faults"
 
-echo "=== stage 4/9: salvage + strict metadata (strict) ==="
+echo "=== stage 4/10: salvage + strict metadata (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_salvage.py \
   -q -p no:cacheprovider || fail "salvage"
 
-echo "=== stage 5/9: deadlines/hedging + kill-resume checkpoints (strict) ==="
+echo "=== stage 5/10: deadlines/hedging + kill-resume checkpoints (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_deadline.py \
   tests/test_checkpoint.py -q -p no:cacheprovider || fail "time/crash"
 
-echo "=== stage 6/9: plan matrix: serial vs parallel, cache on (strict) ==="
+echo "=== stage 6/10: plan matrix: serial vs parallel, cache on (strict) ==="
 # leg A: pinned-serial planning (the TPQ_PLAN_THREADS=1 reference path)
 TPQ_PLAN_THREADS=1 timeout -k 10 600 python -m pytest \
   tests/test_plan_parallel.py tests/test_plan_cache.py \
@@ -117,7 +126,7 @@ TPQ_PLAN_CACHE_MB=64 timeout -k 10 600 python -m pytest \
   tests/test_plan_parallel.py tests/test_fallback_matrix.py \
   -q -p no:cacheprovider || fail "plan matrix (cache-on leg)"
 
-echo "=== stage 7/9: live obs gate + overhead guard (strict) ==="
+echo "=== stage 7/10: live obs gate + overhead guard (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_live_obs.py \
   tests/test_env_docs.py -q -p no:cacheprovider || fail "live obs"
 # overhead guard: the always-on default must stay within a generous
@@ -128,7 +137,7 @@ timeout -k 10 600 python tools/bench_obs.py --values 2000000 \
   || fail "obs overhead guard"
 tail -5 /tmp/_ci_obs.json
 
-echo "=== stage 8/9: pruning parity gate (strict) ==="
+echo "=== stage 8/10: pruning parity gate (strict) ==="
 # leg A: the whole pushdown suite (write/read page index + bloom,
 # verdicts, late materialization, counter exactness, corrupt-index
 # degrade, pyarrow interop) on the default pool width
@@ -141,10 +150,23 @@ TPQ_PLAN_THREADS=1 TPQ_PRUNE=0 timeout -k 10 600 python -m pytest \
   "tests/test_prune.py::TestParity" \
   -q -p no:cacheprovider || fail "pruning parity (prune-off leg)"
 
-echo "=== stage 9/9: tpq-analyze invariant passes + sanitizer leg (strict) ==="
+echo "=== stage 9/10: tpq-analyze invariant passes + sanitizer leg (strict) ==="
 timeout -k 10 300 python -m tools.analyze || fail "tpq-analyze"
 timeout -k 10 600 python -m pytest tests/test_analyze.py \
   -q -p no:cacheprovider || fail "analyzer self-test"
 timeout -k 10 900 bash tools/analyze/native.sh || fail "native sanitizers"
+
+echo "=== stage 10/10: gather placement parity gate (strict) ==="
+# leg A: the placement suite — byte parity placed vs replicated across
+# filter/quarantine/salvage/resume/multi-host, placement + counter pins,
+# mesh-mismatch errors
+timeout -k 10 600 python -m pytest tests/test_gather_placement.py \
+  -q -p no:cacheprovider || fail "gather placement"
+# leg B: the same suite with the env default armed on every scan —
+# values must not change, and the knob must not leak into the free
+# functions' ndarray contract
+TPQ_GATHER_TO=0 timeout -k 10 600 python -m pytest \
+  tests/test_gather_placement.py \
+  -q -p no:cacheprovider || fail "gather placement (env leg)"
 
 echo "ci.sh: gate PASSED"

@@ -421,6 +421,12 @@ def _print_profile(log, st, out) -> None:
                   f"{d['plan_cache_misses']} misses  "
                   f"{d['plan_cache_evictions']} evictions  "
                   f"(spans: {cache_spans})", file=out)
+        # gather/output-placement section: what the reshard to the
+        # consumer placement actually shipped (shard/scan.py gathers)
+        if d["gather_bytes_moved"] or d["gather_reshard_s"]:
+            print(f"gather: {d['gather_bytes_moved']:,}B to consumers  "
+                  f"{d['gather_bytes_replicated']:,}B replication  "
+                  f"reshard {d['gather_reshard_s']:.3f}s", file=out)
         # predicate-pushdown section: what the filter statically skipped
         # and what the exact pass kept (tpuparquet/filter.py)
         if (d["row_groups_pruned"] or d["pages_pruned"]

@@ -19,9 +19,12 @@ Device coverage — every value encoding the format defines:
 * DELTA_LENGTH_BYTE_ARRAY (host length scan, zero-copy payload staging)
 * DELTA_BYTE_ARRAY (front coding = the snappy kernel's copy graph;
   non-expanding pages assemble on host, chosen per page because it
-  ships FEWER bytes, not for lack of a kernel — the golden exception
-  list ``HOST_ASSEMBLY_EXCEPTIONS`` in ``tests/test_fallback_matrix.py``
-  pins exactly which (type, encoding) combinations may do this)
+  ships STRICTLY fewer bytes, not for lack of a kernel — wire-neutral
+  pages take the device kernel.  The golden exception list
+  ``HOST_ASSEMBLY_EXCEPTIONS`` in ``tests/test_fallback_matrix.py``
+  pins exactly which (type, encoding) combinations may do this, and
+  its wire-number pin asserts every host-assembled page really
+  shipped fewer bytes than the compact wire form)
 """
 
 from __future__ import annotations
@@ -2072,16 +2075,23 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
             n_suffix = int(soffs[-1]) if non_null else 0
             compact = n_suffix + 8 * non_null  # suffixes + token table
             if (non_null == 0 or expanded > (1 << 30)
-                    or expanded <= compact):
-                # device expansion only pays when the front coding
-                # actually expands; otherwise (or where bucket(expanded)
-                # would pass int32, cf. plan_tokens) assemble on host
-                # from the ALREADY-parsed streams — no re-parse
+                    or expanded < compact):
+                # host assembly only when it ships STRICTLY fewer
+                # bytes than the compact wire form (wire-neutral pages
+                # take the copy-graph kernel below); the empty-page and
+                # bucket(expanded)-past-int32 guards (cf. plan_tokens)
+                # stay host for correctness.  Assembles from the
+                # ALREADY-parsed streams — no re-parse.  The per-page
+                # wire numbers that justify the choice ride the event
+                # gate and are pinned by tests/test_fallback_matrix.py.
                 _tr = "dba-host"
                 if _ev is not None:
+                    _wire_ev = expanded
+                    _raw_ev = expanded
+                    _gate = {"expanded": expanded, "compact": compact}
                     _reason = (
                         f"front coding non-expanding: host assembly "
-                        f"ships {compact}B vs expanded {expanded}B")
+                        f"ships {expanded}B vs compact wire {compact}B")
                 suffix_view = np.frombuffer(values_seg, np.uint8,
                                             n_suffix, spos)
                 col = assemble_delta_byte_array(prefix_lens, soffs,
@@ -2108,6 +2118,7 @@ def plan_chunk_device(blob, cm: ColumnMetaData, node: SchemaNode,
                 if _ev is not None:
                     _wire_ev = compact
                     _raw_ev = expanded
+                    _gate = {"expanded": expanded, "compact": compact}
                     _reason = (f"copy-token expansion: {compact}B wire "
                                f"vs {expanded}B expanded")
                 out_cap = _bucket(expanded)
@@ -2994,15 +3005,35 @@ def pipelined_reads(readers, units, device_for=None, start: int = 0):
         trim_arena_pool(keep=2)
 
 
-def read_row_groups_device(reader, rg_indices=None, filter=None):
+def read_row_groups_device(reader, rg_indices=None, filter=None,
+                           out_sharding=None, gather_to=None):
     """Yield ``(rg_index, {path: DeviceColumn})`` for several row groups,
     overlapping host planning with device transfer (see
     :func:`pipelined_reads`).  Results are identical to calling
     :func:`read_row_group_device` per index.  With ``filter``, row
     groups the static verdict proves empty are skipped entirely (not
-    yielded) and the rest decode late-materialized."""
+    yielded) and the rest decode late-materialized.
+
+    ``out_sharding`` (a ``NamedSharding`` over the consumer's mesh) /
+    ``gather_to`` (a device or local-device index) place the decode
+    itself: row groups round-robin the TARGET's devices, so every
+    decoded buffer is born on a shard that will consume it — the
+    device-read face of the scan layer's consumer-aligned output
+    placement (:func:`tpuparquet.shard.scan.gather_column`).  Explicit
+    only — the ``TPQ_GATHER_TO`` env default is a scan-level knob and
+    does not reach this surface."""
     from ..stats import current_stats
 
+    device_for = None
+    if out_sharding is not None or gather_to is not None:
+        from ..shard.mesh import placement_devices, resolve_out_sharding
+
+        target = resolve_out_sharding(None, out_sharding, gather_to,
+                                      env_default=False)
+        # "replicated" resolves to None: the default decode placement
+        if target is not None:
+            devs = placement_devices(target)
+            device_for = lambda k: devs[k % len(devs)]  # noqa: E731
     if rg_indices is None:
         rg_indices = range(reader.row_group_count())
     indices = list(rg_indices)
@@ -3026,11 +3057,12 @@ def read_row_groups_device(reader, rg_indices=None, filter=None):
             verdicts[(0, i)] = v
             kept.append(i)
         for k, out in filtered_pipelined_reads(
-                [reader], [(0, i) for i in kept], filter=filter,
-                verdicts=verdicts):
+                [reader], [(0, i) for i in kept], device_for,
+                filter=filter, verdicts=verdicts):
             yield kept[k], out
         return
-    for k, out in pipelined_reads([reader], [(0, i) for i in indices]):
+    for k, out in pipelined_reads([reader], [(0, i) for i in indices],
+                                  device_for):
         yield indices[k], out
 
 

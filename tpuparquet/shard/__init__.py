@@ -13,6 +13,7 @@ from .mesh import (  # noqa: F401
     assign_units,
     decode_step_spmd,
     make_mesh,
+    resolve_out_sharding,
     sharded_dict_decode,
     stack_hybrid_plans,
 )

@@ -202,7 +202,15 @@ class MultiHostScan(_DurableScanMixin):
     race on one file) and resume validates fleet agreement: every
     host must see the same unit list and the same
     have-a-checkpoint answer, or the resume raises instead of
-    silently re-decoding or skipping a shard."""
+    silently re-decoding or skipping a shard.
+
+    Output placement: ``out_sharding=``/``gather_to=`` (env
+    ``TPQ_GATHER_TO``) set this PROCESS's default for
+    :meth:`gather_column`/:meth:`gather_byte_column` — each host
+    gathers its own local units onto its local target (the spec must
+    be fully addressable from the process; cross-host exchange stays
+    with the DCN collectives above).  Semantics otherwise identical to
+    :class:`~tpuparquet.shard.scan.ShardedScan`."""
 
     def __init__(self, sources, *columns: str, mesh=None, resume=None,
                  on_error: str = "raise", retries: int | None = None,
@@ -216,10 +224,11 @@ class MultiHostScan(_DurableScanMixin):
                  checkpoint_every: int | None = None,
                  progress_export: str | None = None,
                  postmortem=None,
-                 filter=None):
+                 filter=None,
+                 out_sharding=None, gather_to=None):
         from ..faults import QuarantineReport
         from ..obs.progress import progress_export_default
-        from .mesh import make_mesh
+        from .mesh import make_mesh, resolve_out_sharding
         from .scan import (
             host_cursor_path,
             load_cursor_file,
@@ -277,6 +286,11 @@ class MultiHostScan(_DurableScanMixin):
         # make_mesh defaults to LOCAL devices (see its docstring; the
         # 2-process integration test caught the global-devices variant)
         self.mesh = mesh if mesh is not None else make_mesh()
+        # scan-level output placement default (per PROCESS: each host
+        # gathers its own units onto its local target — the resolver
+        # rejects non-addressable specs; see resolve_out_sharding)
+        self.out_sharding = resolve_out_sharding(
+            self.mesh, out_sharding, gather_to)
         self.devices = list(self.mesh.devices.flat)
         self.on_error = on_error
         self.retries = retries

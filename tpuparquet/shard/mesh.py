@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -37,6 +38,9 @@ from ..kernels.hybrid import HybridPlan, expand_hybrid_core, plan_hybrid
 __all__ = [
     "make_mesh",
     "assign_units",
+    "resolve_out_sharding",
+    "placement_devices",
+    "dim0_partitions",
     "BatchedHybridPlan",
     "stack_hybrid_plans",
     "decode_step_spmd",
@@ -75,6 +79,147 @@ def assign_units(n_units: int, n_shards: int) -> list[list[int]]:
     for i in range(n_units):
         out[i % n_shards].append(i)
     return out
+
+
+# ----------------------------------------------------------------------
+# Consumer-aligned output placement (the gather-wall fix)
+# ----------------------------------------------------------------------
+
+def _gather_to_env():
+    """``TPQ_GATHER_TO``: default ``gather_to`` device INDEX (into this
+    process's ``jax.local_devices()``) for scans and the free gather
+    functions when no explicit placement is passed.  Unset/empty =
+    replicated (the seed behavior).  A malformed or out-of-range value
+    raises — a placement knob that silently replicated everything
+    would defeat its own purpose."""
+    raw = os.environ.get("TPQ_GATHER_TO", "")
+    if not raw:
+        return None
+    try:
+        idx = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"TPQ_GATHER_TO={raw!r} is not a device index") from None
+    devs = jax.local_devices()
+    if not 0 <= idx < len(devs):
+        raise ValueError(
+            f"TPQ_GATHER_TO={idx} out of range: this process has "
+            f"{len(devs)} addressable devices")
+    return devs[idx]
+
+
+def resolve_out_sharding(mesh, out_sharding=None, gather_to=None,
+                         env_default: bool = True):
+    """Resolve a consumer placement request into a ``jax.sharding.
+    Sharding`` — or None, meaning the seed's replicate-everywhere
+    gather.
+
+    ``out_sharding`` is a ``NamedSharding`` over the CONSUMER's mesh
+    (preferred — it carries its own mesh), a bare ``PartitionSpec``
+    (interpreted over ``mesh``, the scan's mesh), an already-resolved
+    ``NamedSharding``/``SingleDeviceSharding``, or the string
+    ``"replicated"`` (the explicit spelling of the seed gather, for
+    overriding an armed scan-level/env default).  ``gather_to`` is a
+    single target device (a ``jax.Device`` or an index into this
+    process's ``jax.local_devices()``) — sugar for a
+    ``SingleDeviceSharding``.  At most one may be given; with
+    neither, the ``TPQ_GATHER_TO`` env default applies (when
+    ``env_default``), else replicated.
+
+    Multi-host semantics: the gather assembles THIS process's decoded
+    units on this process's mesh, so the target must be fully
+    addressable from this process — each host of a ``MultiHostScan``
+    places its own shard of the results (cross-host exchange stays
+    with the DCN collectives in ``shard.distributed``).  A target
+    naming non-addressable devices is rejected loudly.
+    """
+    from jax.sharding import SingleDeviceSharding
+
+    if out_sharding is not None and gather_to is not None:
+        raise ValueError("pass out_sharding= or gather_to=, not both "
+                         "(they are two spellings of one placement)")
+    if out_sharding == "replicated":
+        # the explicit spelling of the seed replicate-everywhere
+        # gather: None cannot express it where a scan-level or env
+        # default is armed (None means "use the default" there)
+        return None
+    if out_sharding is None and gather_to is None:
+        if not env_default:
+            return None
+        gather_to = _gather_to_env()
+        if gather_to is None:
+            return None
+    if gather_to is not None:
+        if isinstance(gather_to, int):
+            devs = jax.local_devices()
+            if not 0 <= gather_to < len(devs):
+                raise ValueError(
+                    f"gather_to={gather_to} out of range: this process "
+                    f"has {len(devs)} addressable devices")
+            gather_to = devs[gather_to]
+        return SingleDeviceSharding(gather_to)
+    if isinstance(out_sharding, P):
+        if mesh is None:
+            raise ValueError(
+                "a bare PartitionSpec has no mesh to bind against "
+                "here; pass a NamedSharding over the consumer's mesh")
+        try:
+            return NamedSharding(mesh, out_sharding)
+        except ValueError as e:
+            raise ValueError(
+                f"out_sharding {out_sharding} does not fit the scan "
+                f"mesh (axes {tuple(mesh.axis_names)}): {e}; pass a "
+                "NamedSharding over the consumer's mesh to shard "
+                "along consumer axes") from e
+    if isinstance(out_sharding, jax.sharding.Sharding):
+        if not isinstance(out_sharding, (NamedSharding,
+                                         SingleDeviceSharding)):
+            # the gather's unit-axis padding (dim0_partitions) cannot
+            # be derived from other sharding flavors; accepting one
+            # would trade this loud rejection for a raw divisibility
+            # crash deep inside jax
+            raise ValueError(
+                f"out_sharding must be a NamedSharding or a single "
+                f"device, not {type(out_sharding).__name__}; wrap "
+                "the consumer's layout in a NamedSharding over its "
+                "mesh")
+        if not out_sharding.is_fully_addressable:
+            raise ValueError(
+                "out_sharding places shards on devices this process "
+                "cannot address; a multi-host scan gathers each "
+                "host's results onto its LOCAL mesh — pass a "
+                "per-process sharding (see MultiHostScan docs)")
+        return out_sharding
+    raise ValueError(
+        f"out_sharding must be a NamedSharding, a PartitionSpec, or "
+        f"a Sharding, not {type(out_sharding).__name__}")
+
+
+def placement_devices(sharding) -> list:
+    """The ordered device list of a resolved placement target — the
+    order unit round-robin placement uses when decoding directly onto
+    consumer shards (``read_row_groups_device(out_sharding=)``)."""
+    if isinstance(sharding, NamedSharding):
+        return list(sharding.mesh.devices.flat)
+    return sorted(sharding.device_set, key=lambda d: d.id)
+
+
+def dim0_partitions(sharding) -> int:
+    """How many ways a resolved placement splits axis 0 (the unit
+    axis of every gathered global).  The gather pads its unit axis to
+    a multiple of this so the placed arrays satisfy jax's divisible-
+    sharding requirement."""
+    if isinstance(sharding, NamedSharding):
+        spec = sharding.spec
+        if len(spec) == 0 or spec[0] is None:
+            return 1
+        names = spec[0] if isinstance(spec[0], tuple) else (spec[0],)
+        t = 1
+        shape = dict(sharding.mesh.shape)
+        for nm in names:
+            t *= shape[nm]
+        return t
+    return 1
 
 
 class BatchedHybridPlan:

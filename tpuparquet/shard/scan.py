@@ -746,6 +746,43 @@ class DurableScanMixin:
         self._fold_live()
         prog.finish("done")
 
+    # -- consumer-aligned gathers (scan-level placement default) ---------
+
+    def _gather_placement(self, out_sharding, gather_to):
+        """An explicit per-call spec wins; else the scan-level default
+        (which already folded the ``TPQ_GATHER_TO`` env).
+        ``out_sharding="replicated"`` explicitly requests the seed
+        replicated-ndarray gather even when a scan default is armed —
+        None cannot express that (it means "use the default")."""
+        if out_sharding is not None or gather_to is not None:
+            from .mesh import resolve_out_sharding
+
+            return resolve_out_sharding(self.mesh, out_sharding,
+                                        gather_to)
+        return self.out_sharding
+
+    def gather_column(self, results, path: str, *, out_sharding=None,
+                      gather_to=None):
+        """:func:`gather_column` over this scan's mesh, defaulting to
+        the placement the scan was constructed with
+        (``out_sharding="replicated"`` forces the seed replicated
+        gather past an armed default)."""
+        return gather_column(
+            self.mesh, results, path,
+            out_sharding=self._gather_placement(out_sharding,
+                                                gather_to))
+
+    def gather_byte_column(self, results, path: str, *,
+                           out_sharding=None, gather_to=None):
+        """:func:`gather_byte_column` over this scan's mesh,
+        defaulting to the placement the scan was constructed with
+        (``out_sharding="replicated"`` forces the seed replicated
+        gather past an armed default)."""
+        return gather_byte_column(
+            self.mesh, results, path,
+            out_sharding=self._gather_placement(out_sharding,
+                                                gather_to))
+
     def cursor_save(self, path: str | None = None) -> None:
         """Durably checkpoint :meth:`state` (atomic tmp + fsync +
         rename, integrity checksum — :func:`save_cursor_file`).
@@ -883,6 +920,18 @@ class ShardedScan(DurableScanMixin):
     (``TPQ_PRUNE=0`` forces that reference path).  A cursor taken
     under one filter resumes only under the same filter (the unit
     list is part of the cursor's identity).
+
+    Output placement (this round): ``out_sharding=`` (a
+    ``NamedSharding`` over the consumer's mesh, or a ``PartitionSpec``
+    over the scan mesh) or ``gather_to=`` (a single device, or its
+    index in ``jax.local_devices()``; env default ``TPQ_GATHER_TO``)
+    set the scan-level default placement for :meth:`gather_column` /
+    :meth:`gather_byte_column` — decoded columns assemble directly
+    onto the shards that will consume them instead of being
+    all-gathered to every device (cost flat in mesh size for a
+    singular consumer, proportional to true fan-out otherwise).
+    Decode placement is unchanged (units still round-robin the scan
+    mesh); only the gather's output layout moves.
     """
 
     def __init__(self, sources, *columns: str, mesh=None, resume=None,
@@ -898,8 +947,9 @@ class ShardedScan(DurableScanMixin):
                  progress_export: str | None = None,
                  progress_label: str = "scan",
                  postmortem=None,
-                 filter=None):
-        from .mesh import make_mesh
+                 filter=None,
+                 out_sharding=None, gather_to=None):
+        from .mesh import make_mesh, resolve_out_sharding
 
         if on_error not in ("raise", "quarantine"):
             raise ValueError(
@@ -911,6 +961,10 @@ class ShardedScan(DurableScanMixin):
             resume_from=resume_from, checkpoint_every=checkpoint_every,
             checkpoint_path=resume_from, postmortem=postmortem)
         self.mesh = mesh if mesh is not None else make_mesh()
+        # resolve the scan-level placement default EARLY: a bad spec
+        # must fail before any source opens
+        self.out_sharding = resolve_out_sharding(
+            self.mesh, out_sharding, gather_to)
         # file-level entries recorded at open time live in their own
         # report so run() can reset the unit-level entries without
         # forgetting the files that never produced units
@@ -1058,16 +1112,44 @@ class ShardedScan(DurableScanMixin):
         self.close()
 
 
-def gather_column(mesh, results: list[dict[str, DeviceColumn]], path: str):
-    """All-gather one fixed-width column across the mesh.
+def gather_column(mesh, results: list[dict[str, DeviceColumn]], path: str,
+                  *, out_sharding=None, gather_to=None):
+    """Gather one fixed-width column across the mesh, placed where the
+    consumer wants it.
 
     Builds a (U, L, lanes) global array sharded unit-wise over the "rg"
     axis from the per-device results (null slots zero-filled, units
-    padded to a common length L), then runs one jitted identity with
-    replicated output sharding — which XLA lowers to the all-gather
-    collective over ICI.  Returns (values (U, L, lanes) ndarray,
-    per-unit true counts); callers unpad with the counts.
+    padded to a common length L), then reshards it to the requested
+    output placement:
+
+    * default (no spec) — replicate everywhere: one jitted identity
+      whose replicated out-sharding XLA lowers to the all-gather
+      collective over ICI.  Returns ``(values (U, L, lanes) ndarray,
+      per-unit true counts)`` — the seed contract, unchanged.  (The
+      ``TPQ_GATHER_TO`` env default applies at the SCAN level —
+      ``ShardedScan(gather_to=)`` and the scan's gather methods — not
+      here: an env knob must not silently change this function's
+      return type under existing callers.)
+    * ``out_sharding=`` (a ``NamedSharding`` over the consumer's mesh,
+      or a ``PartitionSpec`` over the scan mesh) / ``gather_to=`` (a
+      single device) — assemble directly onto the shards that will
+      consume the column instead of all-gathering every byte to every
+      device.  Cost is flat in mesh size for a singular consumer and
+      proportional only to true fan-out otherwise.  Returns a
+      device-resident ``jax.Array`` of shape (U', L, lanes) under the
+      requested sharding, where U' pads the unit axis up to the
+      spec's unit-axis partition count (rows ``>= len(counts)`` are
+      zero); slice with the counts as usual.
+
+    Placement resolution (and the mesh-mismatch errors) live in
+    :func:`~tpuparquet.shard.mesh.resolve_out_sharding`.  The phase is
+    metered: ``DecodeStats.gather_bytes_moved`` / ``_replicated`` /
+    ``gather_reshard_s`` decompose what the reshard actually shipped.
     """
+    from .mesh import resolve_out_sharding
+
+    placement = resolve_out_sharding(mesh, out_sharding, gather_to,
+                                     env_default=False)
     cols = [r[path] for r in results]
     if any(c.offsets is not None for c in cols):
         raise TypeError("gather_column handles fixed-width columns; "
@@ -1086,16 +1168,50 @@ def gather_column(mesh, results: list[dict[str, DeviceColumn]], path: str):
     padded = [jnp.pad(d.astype(jnp.uint32), (0, L * lanes - d.shape[0]))
               for d in dense]
     (gathered,), perm = _assemble_and_gather(
-        mesh, [(padded, (L * lanes,), jnp.uint32)])
+        mesh, [(padded, (L * lanes,), jnp.uint32)],
+        placement=placement, out_row_shapes=[(L, lanes)])
+    if placement is not None:
+        return gathered, counts
     # host-side reshape to the (U, L, lanes) view callers index; the
     # shard-major assembly order un-permutes here
     out = np.asarray(gathered).reshape(gathered.shape[0], L, lanes)
     return out[perm[: len(dense)]], counts
 
 
-def _assemble_and_gather(mesh, streams):
-    """All-gather per-unit device arrays into replicated globals,
-    WITHOUT funneling them through a single device.
+def _count_gather(arrays, placement) -> None:
+    """Meter one gather's reshard outcome: what each destination shard
+    actually received (``gather_bytes_moved``), how much of that was
+    pure replication beyond one copy of each global byte
+    (``gather_bytes_replicated``).  Exact integers off the output
+    shardings — no estimation."""
+    from ..stats import current_stats
+
+    st = current_stats()
+    if st is None and _flightrec._active is None:
+        return
+    moved = extra = 0
+    for a in arrays:
+        nb = int(np.prod(a.shape, dtype=np.int64)) * a.dtype.itemsize
+        per = int(np.prod(a.sharding.shard_shape(a.shape),
+                          dtype=np.int64)) * a.dtype.itemsize
+        tot = per * len(a.sharding.device_set)
+        moved += tot
+        extra += max(0, tot - nb)
+    if st is not None:
+        st.gather_bytes_moved += moved
+        st.gather_bytes_replicated += extra
+    if _flightrec._active is not None:
+        _flightrec.flight(
+            "gather", site="shard.scan.gather", streams=len(arrays),
+            bytes_moved=moved, bytes_replicated=extra,
+            placement=("replicated" if placement is None
+                       else repr(placement)))
+
+
+def _assemble_and_gather(mesh, streams, placement=None,
+                         out_row_shapes=None):
+    """Reshard per-unit device arrays into globals under the requested
+    output placement, WITHOUT funneling them through a single device.
 
     The naive route (``jnp.stack`` then ``device_put`` with the sharded
     layout) materializes the whole global on ONE device before the
@@ -1105,13 +1221,27 @@ def _assemble_and_gather(mesh, streams):
     block's units on the block's own device (units were placed
     round-robin, so rows are grouped shard-major), assemble each global
     zero-copy with :func:`jax.make_array_from_single_device_arrays`,
-    and run ONE jitted identity over all streams whose replicated
-    out-shardings lower to the all-gather collectives.
+    then reshard in ONE step:
+
+    * ``placement is None`` — one jitted identity over all streams
+      whose replicated out-shardings lower to the all-gather
+      collectives (the seed behavior, byte-identical).
+    * ``placement`` (a resolved ``Sharding``) — one jitted
+      permute-to-unit-order whose out-shardings ARE the consumer's
+      spec, so each destination shard receives exactly its rows (plus
+      the spec's true fan-out); when the target's device set differs
+      from the mesh's (a single device, a consumer sub-mesh), the
+      assembled globals hop via ``jax.device_put`` resharding first —
+      still one data-sized move, never an all-gather.
 
     ``streams`` is a list of ``(padded_units, row_shape, dtype)`` — all
-    streams must have the same unit count.  Returns ``(gathered_list,
-    perm)`` where ``gathered[i]`` is the unit at shard-major row i and
-    ``perm`` maps unit index -> gathered row.
+    streams must have the same unit count.  ``out_row_shapes``
+    optionally reshapes each placed stream's rows (placed outputs
+    cannot reshape host-side).  Returns ``(arrays, perm)``: with no
+    placement, ``arrays[i]`` holds the unit at shard-major row i and
+    ``perm`` maps unit index -> row; with a placement, ``arrays[i]``
+    is already unit-ordered (rows past the true unit count are zero)
+    and ``perm`` still maps unit -> shard-major assembly row.
     """
     # generalize over mesh rank: an rg-only mesh (no "sp" axis) is the
     # sp == 1 layout — callers may build their own 1-D mesh
@@ -1121,6 +1251,34 @@ def _assemble_and_gather(mesh, streams):
     n_dev = n_rg * sp
     n_true = len(streams[0][0])
     U = max(((n_true + n_dev - 1) // n_dev) * n_dev, n_dev)
+    t_parts = 1
+    if placement is not None:
+        from .mesh import dim0_partitions
+
+        # the assembled global may itself hop through a device_put
+        # reshard to the target, so its unit axis must divide by the
+        # target's unit-axis partition count too (jax requires
+        # divisible shardings)
+        t_parts = dim0_partitions(placement)
+        while U % t_parts:
+            U += n_dev
+        if placement.device_set != set(mesh.devices.flat) \
+                and _dim0_only(placement):
+            # consumer outside the scan mesh (single sink device,
+            # consumer sub-mesh): skip the shard-major global — each
+            # unit row goes point-to-point to its destination shard,
+            # once.  The whole step is the reshard.
+            from ..stats import current_stats
+
+            st = current_stats()
+            t0 = time.perf_counter()
+            out = _assemble_direct(placement, streams, n_true, t_parts,
+                                   out_row_shapes)
+            jax.block_until_ready(out)
+            if st is not None:
+                st.gather_reshard_s += time.perf_counter() - t0
+            _count_gather(out, placement)
+            return list(out), np.arange(n_true, dtype=np.int64)
     rows_per_block = U // n_rg
     order = []   # shard-major: unit index per gathered row
     # P("rg") shards rows over rg only: rg block r spans the units the
@@ -1155,35 +1313,156 @@ def _assemble_and_gather(mesh, streams):
         global_shape = (U,) + tuple(shards[0].shape[1:])
         stacked_all.append(jax.make_array_from_single_device_arrays(
             global_shape, sharding, shards))
-    rep = NamedSharding(mesh, P())
-    gathered = jax.jit(
-        lambda *xs: xs, out_shardings=tuple(rep for _ in stacked_all)
-    )(*stacked_all)
-    # perm[u] = gathered row of unit u
+    # perm[u] = shard-major assembly row of unit u
     perm = np.empty(n_true, dtype=np.int64)
     for row, u in enumerate(order):
         if u >= 0:
             perm[u] = row
-    return list(gathered), perm
+    from ..stats import current_stats
+
+    st = current_stats()
+    t0 = time.perf_counter()
+    if placement is None:
+        rep = NamedSharding(mesh, P())
+        out = jax.jit(
+            lambda *xs: xs, out_shardings=tuple(rep for _ in stacked_all)
+        )(*stacked_all)
+    else:
+        out = _place_streams(mesh, stacked_all, placement, perm, n_true,
+                             t_parts, out_row_shapes)
+    jax.block_until_ready(out)
+    if st is not None:
+        st.gather_reshard_s += time.perf_counter() - t0
+    _count_gather(out, placement)
+    return list(out), perm
+
+
+def _place_streams(mesh, stacked, placement, perm, n_true: int,
+                   t_parts: int, out_row_shapes):
+    """The consumer-aligned reshard: permute the shard-major assembly
+    rows back to unit order INSIDE the placing computation, so the
+    collective and the un-permute are one step and no byte detours
+    through the host.  Output unit axis pads to a multiple of the
+    target's partition count (rows >= ``n_true`` zeroed)."""
+    u_out = ((max(n_true, 1) + t_parts - 1) // t_parts) * t_parts
+    rows = np.zeros(u_out, dtype=np.int64)
+    rows[:n_true] = perm
+    valid = (np.arange(u_out) < n_true)
+    shapes = [tuple(x.shape[1:]) if out_row_shapes is None
+              else tuple(out_row_shapes[i])
+              for i, x in enumerate(stacked)]
+
+    def place(*xs):
+        outs = []
+        for x, shp in zip(xs, shapes):
+            y = x[rows]
+            mask = valid.reshape((u_out,) + (1,) * (y.ndim - 1))
+            y = jnp.where(mask, y, jnp.zeros((), dtype=y.dtype))
+            outs.append(y.reshape((u_out,) + shp))
+        return tuple(outs)
+
+    specs = tuple(placement for _ in stacked)
+    if placement.device_set == set(mesh.devices.flat):
+        # same device set: the permute + reshard compile as one
+        # program; XLA emits exactly the collectives the spec implies
+        return jax.jit(place, out_shardings=specs)(*stacked)
+    # different device set (single consumer device, consumer
+    # sub-mesh): hop the assembled shards to the target layout first —
+    # one data-sized reshard, flat in mesh size — then permute locally
+    # on the consumer's devices.  (Reached only for specs that shard
+    # more than the unit axis; dim0-only specs take the cheaper direct
+    # assembly in _assemble_and_gather and never build `stacked`.)
+    # The hop carries only the spec's UNIT-axis partitioning: the
+    # assembled intermediates are flat 2-D (U, row) — the full spec
+    # describes the reshaped outputs and would mis-rank (or
+    # mis-divide) against them; the jit below applies it.
+    if isinstance(placement, NamedSharding):
+        spec = placement.spec
+        hop = NamedSharding(placement.mesh,
+                            P(spec[0] if len(spec) else None))
+    else:
+        hop = placement
+    moved = [jax.device_put(x, hop) for x in stacked]
+    return jax.jit(place, out_shardings=specs)(*moved)
+
+
+def _dim0_only(placement) -> bool:
+    """Does this placement shard nothing beyond the unit axis?  (The
+    precondition for direct per-destination assembly: a unit's whole
+    row then lives on each of its destination devices.)"""
+    if isinstance(placement, NamedSharding):
+        spec = placement.spec
+        return all(spec[i] is None for i in range(1, len(spec)))
+    return True  # SingleDeviceSharding
+
+
+def _assemble_direct(placement, streams, n_true: int, t_parts: int,
+                     out_row_shapes):
+    """Point-to-point assembly for consumer targets OUTSIDE the scan
+    mesh's device set (a single sink device, a consumer sub-mesh):
+    each unit's padded row hops straight to its destination shard(s)
+    and stacks there in unit order.  The data moves exactly once per
+    destination copy — true fan-out only, no collective, no permute,
+    no intermediate global.  Requires a dim-0-only spec
+    (:func:`_dim0_only`); rows >= ``n_true`` are zero."""
+    u_out = ((max(n_true, 1) + t_parts - 1) // t_parts) * t_parts
+    outs = []
+    for i, (padded, row_shape, dtype) in enumerate(streams):
+        shp = tuple(row_shape) if out_row_shapes is None \
+            else tuple(out_row_shapes[i])
+        gshape = (u_out,) + shp
+        zero = None
+        shards = []
+        for dev, idx in placement.devices_indices_map(gshape).items():
+            sl = idx[0]
+            start = sl.start or 0
+            stop = u_out if sl.stop is None else sl.stop
+            rows = []
+            for u in range(start, stop):
+                if u < n_true:
+                    rows.append(jax.device_put(padded[u], dev))
+                else:
+                    if zero is None:
+                        zero = np.zeros(row_shape, dtype=dtype)
+                    rows.append(jax.device_put(zero, dev))
+            block = jnp.stack(rows).reshape((stop - start,) + shp)
+            shards.append(jax.device_put(block, dev))
+        outs.append(jax.make_array_from_single_device_arrays(
+            gshape, placement, shards))
+    return tuple(outs)
 
 
 def gather_byte_column(mesh, results: list[dict[str, DeviceColumn]],
-                       path: str):
-    """All-gather one BYTE_ARRAY column across the mesh.
+                       path: str, *, out_sharding=None, gather_to=None):
+    """Gather one BYTE_ARRAY column across the mesh, placed where the
+    consumer wants it.
 
     Each unit's shard densifies on its own device first: null record
     slots become zero-length values (their bytes are already absent, so
     the packed data buffer IS the dense data buffer — only the offsets
     re-derive), then padded (offsets to Lmax+1 with the byte total,
     keeping them monotone; data to Bmax with zeros) and stacked into
-    (U, Lmax+1) / (U, Bmax) globals sharded unit-wise over "rg".  One
-    jitted identity with replicated out-sharding lowers to the
-    all-gather over ICI, exactly like :func:`gather_column`.
+    (U, Lmax+1) / (U, Bmax) globals sharded unit-wise over "rg",
+    resharded to the requested placement exactly like
+    :func:`gather_column` (same ``out_sharding=``/``gather_to=``
+    semantics, same default-replicated seed contract, same counters).
 
-    Returns ``(offsets (U, Lmax+1) ndarray, data (U, Bmax) u8 ndarray,
-    row_counts, byte_counts)``; row i of unit u spans
-    ``data[u, offsets[u, i]:offsets[u, i+1]]``.
+    Returns ``(offsets (U, Lmax+1), data (U, Bmax) u8, row_counts,
+    byte_counts)``; row i of unit u spans
+    ``data[u, offsets[u, i]:offsets[u, i+1]]``.  Offsets are PER-UNIT
+    relative (each row's offsets start at 0), which makes them
+    placement-invariant: a destination shard holds matching
+    (offsets, data) rows, so the rebase is already per-destination-
+    shard and no global offset rebase is needed under any spec.  With
+    a placement the two returned arrays are device-resident
+    ``jax.Array``\\ s whose unit axis pads to the spec's partition
+    count (rows ``>= len(row_counts)`` zero) and whose dim-0
+    shardings match, row for row.
     """
+    from .mesh import resolve_out_sharding
+
+    placement = resolve_out_sharding(mesh, out_sharding, gather_to,
+                                     env_default=False)
     cols = [r[path] for r in results]
     if any(c.offsets is None for c in cols):
         raise TypeError("gather_byte_column handles BYTE_ARRAY columns; "
@@ -1217,7 +1496,10 @@ def gather_byte_column(mesh, results: list[dict[str, DeviceColumn]],
     data_padded = [jnp.pad(d, (0, B - d.shape[0])) for d in datas]
     (o_g, d_g), perm = _assemble_and_gather(
         mesh, [(offs_padded, (L,), offs_dtype),
-               (data_padded, (B,), jnp.uint8)])
+               (data_padded, (B,), jnp.uint8)],
+        placement=placement)
+    if placement is not None:
+        return o_g, d_g, row_counts, byte_counts
     return (np.asarray(o_g)[perm[: len(cols)]],
             np.asarray(d_g)[perm[: len(cols)]],
             row_counts, byte_counts)

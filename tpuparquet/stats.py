@@ -154,6 +154,26 @@ class DecodeStats:
     # enter these — rows_pruned covers them
     filter_rows_in: int = 0
     filter_rows_out: int = 0
+    # -- gather / output placement (shard/scan.py gather_column et al.) --
+    # bytes of assembled column globals that LANDED on destination
+    # shards during the gather's reshard step: per-destination-shard
+    # received bytes summed over the target's devices (padding
+    # included).  Replicated out-sharding pays global_bytes x n_devices;
+    # a 1:1 consumer-aligned placement pays ~global_bytes — flat in
+    # mesh size.  The r05 "is the gather volume irreducible?" question
+    # is answered by this counter, not conjecture.
+    gather_bytes_moved: int = 0
+    # the share of gather_bytes_moved that is pure replication (every
+    # copy of a global byte beyond the first): replicated out-sharding
+    # contributes global_bytes x (n_devices - 1); an evenly-sharded
+    # consumer placement contributes 0.  True consumer fan-out (a spec
+    # that replicates over some mesh axis) shows up here too —
+    # proportional to the fan-out actually requested.
+    gather_bytes_replicated: int = 0
+    # wall spent in the gather's reshard/collective step (the
+    # device-side half of gather time; host-side densify/pad/stack
+    # assembly is the rest of the caller's gather wall)
+    gather_reshard_s: float = 0.0
     # -- footer-keyed plan cache (kernels/plancache.py) --
     # per-(rg, column) lookups during device planning: hits skip the
     # transport competition (sample windows, token scans), misses run
@@ -198,6 +218,8 @@ class DecodeStats:
         "checkpoints_written",
         "row_groups_pruned", "pages_pruned", "rows_pruned",
         "bloom_hits", "filter_rows_in", "filter_rows_out",
+        "gather_bytes_moved", "gather_bytes_replicated",
+        "gather_reshard_s",
         "plan_cache_hits", "plan_cache_misses", "plan_cache_evictions",
         "plan_s", "transfer_s", "dispatch_s",
     )
@@ -273,6 +295,9 @@ class DecodeStats:
             "selectivity": round(
                 self.filter_rows_out / self.filter_rows_in, 6)
             if self.filter_rows_in else None,
+            "gather_bytes_moved": self.gather_bytes_moved,
+            "gather_bytes_replicated": self.gather_bytes_replicated,
+            "gather_reshard_s": round(self.gather_reshard_s, 6),
             "plan_cache_hits": self.plan_cache_hits,
             "plan_cache_misses": self.plan_cache_misses,
             "plan_cache_evictions": self.plan_cache_evictions,
@@ -326,6 +351,11 @@ class DecodeStats:
                if (d["row_groups_pruned"] or d["pages_pruned"]
                    or d["rows_pruned"] or d["bloom_hits"]
                    or d["filter_rows_in"]) else "")
+            + (f"; GATHER: {d['gather_bytes_moved']:,}B to consumers "
+               f"({d['gather_bytes_replicated']:,}B replication), "
+               f"reshard {d['gather_reshard_s']:.3f}s"
+               if (d["gather_bytes_moved"] or d["gather_reshard_s"])
+               else "")
             + (f"; PLAN CACHE: {d['plan_cache_hits']} hits / "
                f"{d['plan_cache_misses']} misses / "
                f"{d['plan_cache_evictions']} evictions"
