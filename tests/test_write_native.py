@@ -1,0 +1,466 @@
+"""Native write pipeline parity suite (round 15).
+
+``TPQ_WRITE_NATIVE=1`` (the default) assembles data pages through the
+one-pass native pipeline (``native/page.c``: body encode into an
+arena-backed buffer, in-place block compress, native CRC32).  This
+suite pins the contract that flipping the knob, the thread budget, or
+the ``page_rows`` split NEVER changes the file bytes; that CRC, page
+index, and bloom filters are unaffected; that pyarrow reads our output
+and we read pyarrow's; that a fault on the native span drops cleanly
+to the pure writer; and that the new counters account for every page
+written.  The stats-once regression (null_count/Statistics computed
+once during prepare and reused) is pinned at the bottom.
+"""
+
+import io
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from tpuparquet import CompressionCodec, FileReader, FileWriter
+from tpuparquet.compress import snappy_native_settings
+from tpuparquet.cpu.plain import ByteArrayColumn
+from tpuparquet.faults import inject_faults
+from tpuparquet.native import page_native
+from tpuparquet.stats import collect_stats
+
+# whether this environment actually engages the native page pipeline
+# (ci.sh stage 11 re-runs this whole suite with TPQ_WRITE_NATIVE=0;
+# parity tests hold either way, engagement pins adapt)
+_NATIVE_ON = (os.environ.get("TPQ_WRITE_NATIVE", "1") != "0"
+              and page_native() is not None
+              and snappy_native_settings() is not None)
+
+_SCHEMA = """message taxi {
+    required int64 pickup_ts;
+    required int32 passenger_count;
+    required int32 rate_code;
+    required int64 trip_distance_mm;
+    optional int32 payment_type;
+    required binary vendor (STRING);
+    optional double tip;
+}"""
+
+
+def _columns(n=20_000, seed=52):
+    rng = np.random.default_rng(seed)
+    pay_mask = rng.random(n) >= 0.05
+    tip_mask = rng.random(n) >= 0.3
+    vocab = [f"vendor-{i:03d}".encode() for i in range(50)]
+    return {
+        "pickup_ts": 1_700_000_000_000
+        + rng.integers(0, 3_600_000, size=n).cumsum(),
+        "passenger_count": rng.integers(1, 7, size=n, dtype=np.int32),
+        "rate_code": rng.integers(1, 6, size=n, dtype=np.int32),
+        "trip_distance_mm": rng.integers(100, 50_000, size=n),
+        "payment_type": rng.integers(
+            0, 5, size=int(pay_mask.sum()), dtype=np.int32),
+        "vendor": ByteArrayColumn.from_list(
+            [vocab[i] for i in rng.integers(0, len(vocab), size=n)]),
+        "tip": rng.random(int(tip_mask.sum())) * 20.0,
+    }, {"payment_type": pay_mask, "tip": tip_mask}
+
+
+def _build(cols, masks, codec=CompressionCodec.SNAPPY, **kw):
+    buf = io.BytesIO()
+    w = FileWriter(buf, _SCHEMA, codec=codec, **kw)
+    w.write_columns(cols, masks=masks)
+    w.close()
+    return buf.getvalue()
+
+
+@pytest.fixture()
+def corpus():
+    return _columns()
+
+
+class TestByteParity:
+    """native-on vs native-off byte identity, across the thread budget
+    and the page split — the ci.sh stage-11 contract."""
+
+    @pytest.mark.parametrize("threads", ["1", "2", "4"])
+    @pytest.mark.parametrize("page_rows", [0, 3_000])
+    def test_parity_snappy_v1(self, corpus, monkeypatch, threads,
+                              page_rows):
+        cols, masks = corpus
+        monkeypatch.setenv("TPQ_WRITE_THREADS", threads)
+        native = _build(cols, masks, page_rows=page_rows)
+        monkeypatch.setenv("TPQ_WRITE_NATIVE", "0")
+        pure = _build(cols, masks, page_rows=page_rows)
+        assert native == pure
+
+    @pytest.mark.parametrize("codec", [CompressionCodec.SNAPPY,
+                                       CompressionCodec.UNCOMPRESSED])
+    @pytest.mark.parametrize("v2", [False, True])
+    def test_parity_codec_matrix(self, corpus, monkeypatch, codec, v2):
+        cols, masks = corpus
+        native = _build(cols, masks, codec=codec, data_page_v2=v2)
+        monkeypatch.setenv("TPQ_WRITE_NATIVE", "0")
+        pure = _build(cols, masks, codec=codec, data_page_v2=v2)
+        assert native == pure
+
+    def test_parity_gzip_is_pure_both_ways(self, corpus, monkeypatch):
+        """An unsupported codec never takes the native page path (the
+        registered compressor keeps full control of the bytes)."""
+        cols, masks = corpus
+        with collect_stats() as st:
+            a = _build(cols, masks, codec=CompressionCodec.GZIP)
+        assert st.pages_assembled_native == 0
+        assert st.pages_written > 0
+        monkeypatch.setenv("TPQ_WRITE_NATIVE", "0")
+        assert a == _build(cols, masks, codec=CompressionCodec.GZIP)
+
+    def test_parity_row_path(self, monkeypatch):
+        """add_data -> flush_row_group (null_count derived in the chunk
+        layer) stays byte-identical too."""
+        rows = [{"pickup_ts": 10 + i, "passenger_count": i % 4,
+                 "rate_code": 1, "trip_distance_mm": 7 * i,
+                 "payment_type": (i % 5) if i % 3 else None,
+                 "vendor": b"v%d" % (i % 9),
+                 "tip": float(i) if i % 2 else None}
+                for i in range(4_000)]
+
+        def build():
+            buf = io.BytesIO()
+            w = FileWriter(buf, _SCHEMA, codec=CompressionCodec.SNAPPY)
+            for r in rows:
+                w.add_data(r)
+            w.close()
+            return buf.getvalue()
+
+        native = build()
+        monkeypatch.setenv("TPQ_WRITE_NATIVE", "0")
+        assert native == build()
+
+    def test_parity_list_column(self, monkeypatch):
+        """Repeated columns (rep levels through the native encoder,
+        single-page always) match byte for byte."""
+        rng = np.random.default_rng(7)
+        n = 3_000
+        counts = rng.integers(0, 5, size=n)
+        offs = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(counts, out=offs[1:])
+        vals = rng.integers(0, 1000, size=int(offs[-1]))
+
+        def build():
+            buf = io.BytesIO()
+            w = FileWriter(
+                buf,
+                "message m { repeated int64 xs; }",
+                codec=CompressionCodec.SNAPPY)
+            w.write_columns({"xs": vals}, offsets={"xs": offs})
+            w.close()
+            return buf.getvalue()
+
+        native = build()
+        monkeypatch.setenv("TPQ_WRITE_NATIVE", "0")
+        assert native == build()
+
+
+class TestReadBack:
+    """Decode identity and foreign-reader interop for the native (and
+    multi-page) output."""
+
+    def _assert_decodes(self, blob, cols, masks):
+        r = FileReader(io.BytesIO(blob))
+        out = {}
+        for rg in range(r.row_group_count()):
+            a = r.read_row_group_arrays(rg)
+            for k, cd in a.items():
+                out.setdefault(k, []).append(cd)
+        assert np.array_equal(out["pickup_ts"][0].values,
+                              cols["pickup_ts"])
+        assert np.array_equal(out["payment_type"][0].values,
+                              cols["payment_type"])
+        assert out["payment_type"][0].null_count == int(
+            (~masks["payment_type"]).sum())
+        assert np.array_equal(
+            out["vendor"][0].values.offsets, cols["vendor"].offsets)
+
+    def test_native_roundtrip(self, corpus):
+        cols, masks = corpus
+        self._assert_decodes(_build(cols, masks), cols, masks)
+
+    def test_multipage_roundtrip(self, corpus):
+        cols, masks = corpus
+        self._assert_decodes(_build(cols, masks, page_rows=3_000),
+                             cols, masks)
+
+    def test_pyarrow_reads_ours_and_we_read_pyarrows(self, corpus):
+        pa = pytest.importorskip("pyarrow")
+        pq = pytest.importorskip("pyarrow.parquet")
+        cols, masks = corpus
+        single = pq.read_table(io.BytesIO(_build(cols, masks)))
+        multi = pq.read_table(
+            io.BytesIO(_build(cols, masks, page_rows=3_000)))
+        assert single.equals(multi)
+        assert np.array_equal(single["pickup_ts"].to_numpy(),
+                              cols["pickup_ts"])
+        # and back: pyarrow's own snappy output through our reader
+        buf = io.BytesIO()
+        pq.write_table(pa.table({"x": cols["pickup_ts"]}), buf,
+                       compression="snappy")
+        r = FileReader(io.BytesIO(buf.getvalue()))
+        got = np.concatenate([
+            np.asarray(r.read_row_group_arrays(rg)["x"].values)
+            for rg in range(r.row_group_count())])
+        assert np.array_equal(got, cols["pickup_ts"])
+
+    def test_pyarrow_verifies_our_page_checksums(self, corpus):
+        pq = pytest.importorskip("pyarrow.parquet")
+        cols, masks = corpus
+        blob = _build(cols, masks, page_rows=3_000)
+        t = pq.read_table(io.BytesIO(blob),
+                          page_checksum_verification=True)
+        assert t.num_rows == len(cols["pickup_ts"])
+
+
+class TestCrcIndexBloom:
+    """The native path's CRC/page-index/bloom must be exactly what the
+    pure path wrote (parity already pins bytes; these pin semantics)."""
+
+    def test_crc_catches_corruption(self, corpus):
+        cols, masks = corpus
+        blob = bytearray(_build(cols, masks))
+        r = FileReader(io.BytesIO(bytes(blob)))
+        cm = r.meta.row_groups[0].columns[0].meta_data
+        # flip one byte inside the first column's data page BODY (walk
+        # the header first — its length varies)
+        from tpuparquet.format.compact import CompactReader
+        from tpuparquet.format.metadata import PageHeader, decode_struct
+
+        cr = CompactReader(bytes(blob), cm.data_page_offset,
+                           cm.data_page_offset
+                           + cm.total_compressed_size)
+        decode_struct(PageHeader, cr)
+        blob[cr.pos + 10] ^= 0xFF
+        from tpuparquet.errors import CorruptPageError
+
+        r2 = FileReader(io.BytesIO(bytes(blob)))
+        with pytest.raises(CorruptPageError, match="CRC"):
+            r2.read_row_group_arrays(0)
+
+    def test_multipage_page_index(self, corpus):
+        cols, masks = corpus
+        n = len(cols["pickup_ts"])
+        blob = _build(cols, masks, page_rows=3_000)
+        r = FileReader(io.BytesIO(blob))
+        pages = r.page_index(0, columns=["pickup_ts"])["pickup_ts"]
+        n_pages = -(-n // 3_000)
+        assert len(pages) == n_pages
+        assert [p[0] for p in pages] == [i * 3_000
+                                         for i in range(n_pages)]
+        # exact per-page bounds on the sorted column: page i's min is
+        # the first value of its slice, its max the last
+        assert pages[1][2] == cols["pickup_ts"][3_000]
+        assert pages[0][3] == cols["pickup_ts"][2_999]
+
+    def test_multipage_pruning_skips_pages(self, corpus):
+        from tpuparquet.filter import col
+
+        cols, masks = corpus
+        blob = _build(cols, masks, page_rows=3_000)
+        r = FileReader(io.BytesIO(blob))
+        lo = int(cols["pickup_ts"][0])
+        with collect_stats() as st:
+            out = r.read_row_group_arrays(
+                0, filter=(col("pickup_ts") <= lo))
+        assert st.pages_pruned > 0
+        assert len(out["pickup_ts"].values) >= 1
+
+    def test_bloom_written_and_hits(self, corpus):
+        cols, masks = corpus
+        blob = _build(cols, masks, bloom_columns=["vendor"])
+        r = FileReader(io.BytesIO(blob))
+        b = r.bloom_filter(0, "vendor")
+        assert b is not None
+        assert b.check(b"vendor-001")
+        assert not b.check(b"no-such-vendor")
+
+
+@pytest.mark.skipif(not _NATIVE_ON,
+                    reason="native write pipeline not engaged")
+class TestFaultFallback:
+    """An injected fault on the native span drops that page to the pure
+    writer — file bytes identical, fault visible in the counters."""
+
+    def test_all_pages_fall_back(self, corpus, monkeypatch):
+        cols, masks = corpus
+        monkeypatch.setenv("TPQ_WRITE_NATIVE", "0")
+        pure = _build(cols, masks)
+        monkeypatch.delenv("TPQ_WRITE_NATIVE")
+        with inject_faults() as inj:
+            inj.inject("io.pages.page_write", "transient", times=100)
+            with collect_stats() as st:
+                faulted = _build(cols, masks)
+        assert faulted == pure
+        assert st.pages_assembled_native == 0
+        assert st.faults_injected > 0
+
+    def test_single_page_falls_back(self, corpus):
+        cols, masks = corpus
+        clean = _build(cols, masks)
+        with inject_faults() as inj:
+            inj.inject("io.pages.page_write", "transient", times=1)
+            with collect_stats() as st:
+                faulted = _build(cols, masks)
+        assert faulted == clean
+        assert st.faults_injected == 1
+        n_dict = sum(
+            1 for rg in FileReader(io.BytesIO(clean)).meta.row_groups
+            for cc in rg.columns
+            if cc.meta_data.dictionary_page_offset is not None)
+        # dictionary pages are always pure; exactly one data page
+        # dropped to the pure path
+        assert st.pages_assembled_native == st.pages_written - n_dict - 1
+
+
+class TestCounters:
+    """pages_written / pages_assembled_native / write-stage seconds:
+    exact accounting for every page, merged exactly across the
+    column-worker threads."""
+
+    def _expected_pages(self, blob):
+        """Count pages the slow way: walk every chunk's page headers."""
+        from tpuparquet.format.compact import CompactReader
+        from tpuparquet.format.metadata import PageHeader, decode_struct
+
+        r = FileReader(io.BytesIO(blob))
+        pages = 0
+        for rg in r.meta.row_groups:
+            for cc in rg.columns:
+                cm = cc.meta_data
+                start = cm.data_page_offset
+                if cm.dictionary_page_offset is not None:
+                    start = min(start, cm.dictionary_page_offset)
+                cr = CompactReader(blob, start,
+                                   start + cm.total_compressed_size)
+                while cr.pos < start + cm.total_compressed_size:
+                    ph = decode_struct(PageHeader, cr)
+                    cr.pos += ph.compressed_page_size
+                    pages += 1
+        return pages
+
+    @pytest.mark.parametrize("threads", ["1", "4"])
+    @pytest.mark.parametrize("page_rows", [0, 3_000])
+    def test_every_page_accounted(self, corpus, monkeypatch, threads,
+                                  page_rows):
+        cols, masks = corpus
+        monkeypatch.setenv("TPQ_WRITE_THREADS", threads)
+        with collect_stats() as st:
+            blob = _build(cols, masks, page_rows=page_rows)
+        assert st.pages_written == self._expected_pages(blob)
+        # dictionary pages stay pure; every data page is native when
+        # the pipeline is engaged, none otherwise
+        n_dict = sum(
+            1 for rg in FileReader(io.BytesIO(blob)).meta.row_groups
+            for cc in rg.columns
+            if cc.meta_data.dictionary_page_offset is not None)
+        expected = st.pages_written - n_dict if _NATIVE_ON else 0
+        assert st.pages_assembled_native == expected
+        assert st.write_encode_s >= 0.0
+        assert st.write_compress_s >= 0.0
+        assert st.write_assemble_s >= 0.0
+
+    def test_stage_seconds_move_only_with_native(self, corpus,
+                                                 monkeypatch):
+        cols, masks = corpus
+        monkeypatch.setenv("TPQ_WRITE_NATIVE", "0")
+        with collect_stats() as st:
+            _build(cols, masks)
+        assert st.pages_assembled_native == 0
+        assert st.write_encode_s == 0.0
+        assert st.write_compress_s == 0.0
+        assert st.write_assemble_s == 0.0
+        assert st.pages_written > 0
+
+
+class TestStatsOnce:
+    """Satellite: null_count/Statistics are computed once during the
+    columnar prepare (O(1) from the masks) and reused by the chunk
+    layer — metadata must equal the recompute-from-levels path."""
+
+    def test_precomputed_null_count_matches_recompute(self, corpus):
+        cols, masks = corpus
+        blob = _build(cols, masks)
+        r = FileReader(io.BytesIO(blob))
+        dl = r.read_row_group_arrays(0)["payment_type"].def_levels
+        recomputed = int((dl != 1).sum())
+        st = r.meta.row_groups[0].columns[4].meta_data.statistics
+        assert st.null_count == recomputed
+        assert st.null_count == int((~masks["payment_type"]).sum())
+
+    def test_row_path_and_columnar_path_agree(self):
+        """Same logical data through write_columns (precomputed nulls)
+        and add_data (chunk-layer recompute): identical Statistics."""
+        n = 2_000
+        rng = np.random.default_rng(21)
+        mask = rng.random(n) >= 0.25
+        vals = rng.integers(0, 1000, size=int(mask.sum()))
+
+        buf_c = io.BytesIO()
+        w = FileWriter(buf_c, "message m { optional int64 x; }",
+                       codec=CompressionCodec.SNAPPY)
+        w.write_columns({"x": vals}, masks={"x": mask})
+        w.close()
+
+        buf_r = io.BytesIO()
+        w = FileWriter(buf_r, "message m { optional int64 x; }",
+                       codec=CompressionCodec.SNAPPY)
+        it = iter(vals)
+        for present in mask:
+            w.add_data({"x": int(next(it)) if present else None})
+        w.close()
+
+        sc = FileReader(io.BytesIO(buf_c.getvalue()))
+        sr = FileReader(io.BytesIO(buf_r.getvalue()))
+        stc = sc.meta.row_groups[0].columns[0].meta_data.statistics
+        str_ = sr.meta.row_groups[0].columns[0].meta_data.statistics
+        assert stc.null_count == str_.null_count == int((~mask).sum())
+        assert stc.min_value == str_.min_value
+        assert stc.max_value == str_.max_value
+
+    def test_chunk_stats_identical_across_page_split(self, corpus):
+        """Chunk-level Statistics are independent of the page split
+        (computed once per chunk, not re-derived per page)."""
+        cols, masks = corpus
+        a = FileReader(io.BytesIO(_build(cols, masks)))
+        b = FileReader(io.BytesIO(_build(cols, masks, page_rows=3_000)))
+        for cca, ccb in zip(a.meta.row_groups[0].columns,
+                            b.meta.row_groups[0].columns):
+            sa, sb = cca.meta_data.statistics, ccb.meta_data.statistics
+            assert sa.null_count == sb.null_count
+            assert sa.min_value == sb.min_value
+            assert sa.max_value == sb.max_value
+
+
+class TestCrcFieldExact:
+    """PageHeader.crc written by the native path equals the pure
+    formula (zlib CRC over the on-file body, signed i32 fold)."""
+
+    def test_crc_values_match_zlib_recompute(self, corpus):
+        cols, masks = corpus
+        blob = _build(cols, masks)
+        from tpuparquet.format.compact import CompactReader
+        from tpuparquet.format.metadata import PageHeader, decode_struct
+
+        r = FileReader(io.BytesIO(blob))
+        checked = 0
+        for rg in r.meta.row_groups:
+            for cc in rg.columns:
+                cm = cc.meta_data
+                start = cm.data_page_offset
+                if cm.dictionary_page_offset is not None:
+                    start = min(start, cm.dictionary_page_offset)
+                end = start + cm.total_compressed_size
+                cr = CompactReader(blob, start, end)
+                while cr.pos < end:
+                    ph = decode_struct(PageHeader, cr)
+                    body = blob[cr.pos:cr.pos + ph.compressed_page_size]
+                    assert ph.crc is not None
+                    assert ph.crc & 0xFFFFFFFF == zlib.crc32(body)
+                    cr.pos += ph.compressed_page_size
+                    checked += 1
+        assert checked >= 9

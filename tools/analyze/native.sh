@@ -1,8 +1,9 @@
 #!/bin/bash
 # Native sanitizer + static-analysis leg of tpq-analyze.
 #
-# The six C codecs (delta.c, hybrid.c, intern.c, pack.c, plane.c,
-# snappy.c) run with the GIL released on attacker-influenced bytes;
+# The seven C codecs (delta.c, hybrid.c, intern.c, pack.c, page.c,
+# plane.c, snappy.c) run with the GIL released on attacker-influenced
+# bytes (and, on the write side, on whole column bodies);
 # Python-level tests structurally cannot see a heap overrun that
 # happens to land in mapped memory, or UB the optimizer hasn't
 # punished yet.  This script:
@@ -27,7 +28,8 @@ cd "$(dirname "$0")/../.."
 
 SRC_DIR=tpuparquet/native
 SRCS=("$SRC_DIR"/delta.c "$SRC_DIR"/hybrid.c "$SRC_DIR"/intern.c \
-      "$SRC_DIR"/pack.c "$SRC_DIR"/plane.c "$SRC_DIR"/snappy.c)
+      "$SRC_DIR"/pack.c "$SRC_DIR"/page.c "$SRC_DIR"/plane.c \
+      "$SRC_DIR"/snappy.c)
 BUILD_DIR=${TMPDIR:-/tmp}/tpq-native-san.$$
 SAN_SO="$BUILD_DIR/_tpq_native_san.so"
 trap 'rm -rf "$BUILD_DIR"' EXIT
@@ -100,6 +102,7 @@ env JAX_PLATFORMS=cpu \
     UBSAN_OPTIONS=halt_on_error=1:print_stacktrace=1 \
     timeout -k 10 600 python -m pytest \
       tests/test_native.py tests/test_codecs.py tests/test_fuzz.py \
+      tests/test_write_native.py \
       "tests/test_corpus.py::TestCrashRegressions" \
       -q -p no:cacheprovider \
   || fail "sanitized test run (a failure here that does not reproduce \

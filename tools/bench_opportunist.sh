@@ -64,6 +64,18 @@ while true; do
         echo "$(date -Is) scan-scale sweep FAILED (rc=$?)"
       fi
     fi
+    # write-pipeline bench (VERDICT item 3 / round-15 write wall):
+    # CPU-bound, but queued here so every session leaves a record on
+    # the same box the ladder ran on (per-stage split + pyarrow
+    # anchors + thread sweep -> WRITE_r01.json)
+    if [ ! -f WRITE_r01.json ]; then
+      echo "$(date -Is) running write-pipeline bench"
+      if timeout 1800 python tools/bench_write.py; then
+        echo "$(date -Is) write bench OK"
+      else
+        echo "$(date -Is) write bench FAILED (rc=$?)"
+      fi
+    fi
   else
     echo "$(date -Is) tunnel down"
   fi

@@ -1,0 +1,287 @@
+#!/usr/bin/env python
+"""Write-path bench: per-stage timings + pyarrow anchors (round 15).
+
+VERDICT item 3's observable: config-2 write (dict-int + snappy) vs
+pyarrow writing the SAME logical data with matched settings, plus the
+per-stage split the native page pipeline exposes
+(``DecodeStats.write_encode_s/write_compress_s/write_assemble_s`` and
+the ``pages_written``/``pages_assembled_native`` conservation pair).
+
+Three shapes mirroring the decode ladder's configs:
+
+* **config1** — one int64 PLAIN column, uncompressed (pure assembly:
+  no codec, no dictionary — the floor of the write path)
+* **config2** — the NYC-taxi dict-int + snappy shape (the historical
+  0.62–0.71x wall this round demolishes; ``write_vs_pyarrow`` here is
+  the headline number)
+* **config3** — DELTA_BINARY_PACKED timestamps in a nullable LIST
+  (level streams + delta emit through the pipeline; the pyarrow leg
+  uses its own defaults — an anchor, not a parity)
+
+Each shape runs a ``TPQ_WRITE_THREADS`` sweep (columns in parallel,
+pages pipelined on the serial path), a native-off leg
+(``TPQ_WRITE_NATIVE=0``) for the pipeline's own speedup, and — for
+config2 — a ``TPQ_PAGE_ROWS`` leg exercising the multi-page pipeline.
+Counters must account for every page written (asserted here, not just
+reported).  Emits ``WRITE_r01.json`` in the repo root (or ``--out``).
+``TPQ_BENCH_TARGET`` scales the corpus for smoke runs.
+
+Usage: JAX_PLATFORMS=cpu python tools/bench_write.py [--out PATH]
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+TARGET = int(os.environ.get("TPQ_BENCH_TARGET", 50_000_000))
+REPS = int(os.environ.get("TPQ_WRITE_BENCH_REPS", 3))
+THREADS = (1, 2, 4)
+
+
+def _build_config1():
+    rng = np.random.default_rng(1)
+    cols = {"v": rng.integers(-(2 ** 62), 2 ** 62, size=TARGET)}
+    schema = "message m { required int64 v; }"
+
+    def ours():
+        from tpuparquet import CompressionCodec, FileWriter
+
+        buf = io.BytesIO()
+        w = FileWriter(buf, schema,
+                       codec=CompressionCodec.UNCOMPRESSED)
+        w.write_columns(cols)
+        w.close()
+
+    import pyarrow as pa
+    table = pa.table({"v": cols["v"]})
+
+    def theirs():
+        import pyarrow.parquet as pq
+
+        pq.write_table(table, io.BytesIO(), compression="none",
+                       use_dictionary=False)
+
+    return TARGET, ours, theirs, {}
+
+
+def _build_config2():
+    rng = np.random.default_rng(52)
+    per = TARGET // 5
+    pay_mask = rng.random(per) >= 0.05
+    cols = {
+        "pickup_ts": 1_700_000_000_000
+        + rng.integers(0, 3_600_000, size=per).cumsum(),
+        "passenger_count": rng.integers(1, 7, size=per, dtype=np.int32),
+        "rate_code": rng.integers(1, 6, size=per, dtype=np.int32),
+        "trip_distance_mm": rng.integers(100, 50_000, size=per),
+        "payment_type": rng.integers(0, 5, size=int(pay_mask.sum()),
+                                     dtype=np.int32),
+    }
+    schema = """message taxi {
+        required int64 pickup_ts;
+        required int32 passenger_count;
+        required int32 rate_code;
+        required int64 trip_distance_mm;
+        optional int32 payment_type;
+    }"""
+
+    def ours():
+        from tpuparquet import CompressionCodec, FileWriter
+
+        buf = io.BytesIO()
+        w = FileWriter(buf, schema, codec=CompressionCodec.SNAPPY)
+        w.write_columns(cols, masks={"payment_type": pay_mask})
+        w.close()
+
+    import pyarrow as pa
+    pay_full = np.zeros(per, dtype=np.int32)
+    pay_full[pay_mask] = cols["payment_type"]
+    table = pa.table({
+        "pickup_ts": cols["pickup_ts"],
+        "passenger_count": cols["passenger_count"],
+        "rate_code": cols["rate_code"],
+        "trip_distance_mm": cols["trip_distance_mm"],
+        "payment_type": pa.array(pay_full, mask=~pay_mask),
+    })
+
+    def theirs():
+        import pyarrow.parquet as pq
+
+        pq.write_table(table, io.BytesIO(), compression="snappy",
+                       use_dictionary=True)
+
+    # multi-page pipeline leg: ~8 pages per column
+    page_rows = max(per // 8, 1)
+    return 5 * per, ours, theirs, {"page_rows": page_rows}
+
+
+def _build_config3():
+    rng = np.random.default_rng(3)
+    rows = TARGET // 3
+    lens = rng.integers(0, 8, size=rows)
+    row_mask = rng.random(rows) >= 0.03
+    lens[~row_mask] = 0
+    offs = np.zeros(rows + 1, dtype=np.int64)
+    np.cumsum(lens, out=offs[1:])
+    n_slots = int(offs[-1])
+    elem_mask = rng.random(n_slots) >= 0.02
+    n_vals = int(elem_mask.sum())
+    ts = 1_600_000_000_000 + rng.integers(0, 60_000,
+                                          size=n_vals).cumsum()
+    schema = """message m {
+        optional group events (LIST) {
+            repeated group list {
+                optional int64 element (TIMESTAMP(MILLIS, true));
+            }
+        }
+    }"""
+
+    def ours():
+        from tpuparquet import CompressionCodec, Encoding, FileWriter
+
+        buf = io.BytesIO()
+        w = FileWriter(
+            buf, schema, codec=CompressionCodec.SNAPPY,
+            column_encodings={
+                "events.list.element": Encoding.DELTA_BINARY_PACKED})
+        w.write_columns({"events": ts}, offsets={"events": offs},
+                        masks={"events": row_mask},
+                        element_masks={"events": elem_mask})
+        w.close()
+
+    import pyarrow as pa
+    # pyarrow leg: the same logical list column, its own defaults
+    ts_full = np.zeros(n_slots, dtype=np.int64)
+    ts_full[elem_mask] = ts
+    arr = pa.ListArray.from_arrays(
+        pa.array(offs, type=pa.int32()),
+        pa.array(ts_full, mask=~elem_mask,
+                 type=pa.timestamp("ms", tz="UTC")))
+    table = pa.table({"events": arr})
+
+    def theirs():
+        import pyarrow.parquet as pq
+
+        pq.write_table(table, io.BytesIO(), compression="snappy")
+
+    # num_values counts level slots (nulls + empties included)
+    n_levels = int(np.maximum(lens, 1).sum())
+    return n_levels, ours, theirs, {}
+
+
+_BUILDERS = {"config1": _build_config1, "config2": _build_config2,
+             "config3": _build_config3}
+
+
+def _best(fn, reps=REPS) -> float:
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _staged_run(fn) -> dict:
+    """One instrumented write: stage seconds + page conservation."""
+    from tpuparquet.stats import collect_stats
+
+    with collect_stats() as st:
+        fn()
+    assert st.pages_written > 0, "write produced no pages?"
+    assert 0 <= st.pages_assembled_native <= st.pages_written
+    return {
+        "pages_written": st.pages_written,
+        "pages_assembled_native": st.pages_assembled_native,
+        "write_encode_s": round(st.write_encode_s, 6),
+        "write_compress_s": round(st.write_compress_s, 6),
+        "write_assemble_s": round(st.write_assemble_s, 6),
+        "wall_s": round(st.wall_s, 6),
+    }
+
+
+def bench_one(name: str) -> dict:
+    n_values, ours, theirs, extras = _BUILDERS[name]()
+    out: dict = {"n_values": n_values}
+
+    ours()  # warm natives + allocator
+    sweep = {}
+    for t in THREADS:
+        os.environ["TPQ_WRITE_THREADS"] = str(t)
+        sweep[str(t)] = round(_best(ours), 6)
+    os.environ.pop("TPQ_WRITE_THREADS", None)
+    best_us = min(sweep.values())
+    out["threads_sweep_s"] = sweep
+    out["write_s"] = round(best_us, 6)
+    out["write_vps"] = round(n_values / best_us, 1)
+    out["stages"] = _staged_run(ours)
+
+    os.environ["TPQ_WRITE_NATIVE"] = "0"
+    try:
+        out["write_native_off_s"] = round(_best(ours), 6)
+    finally:
+        del os.environ["TPQ_WRITE_NATIVE"]
+    out["native_speedup"] = round(
+        out["write_native_off_s"] / best_us, 3)
+
+    best_pa = _best(theirs)
+    out["pyarrow_write_s"] = round(best_pa, 6)
+    out["pyarrow_write_vps"] = round(n_values / best_pa, 1)
+    out["write_vs_pyarrow"] = round(best_pa / best_us, 3)
+
+    if "page_rows" in extras:
+        os.environ["TPQ_PAGE_ROWS"] = str(extras["page_rows"])
+        try:
+            pr = {"page_rows": extras["page_rows"],
+                  "write_s": round(_best(ours), 6),
+                  "stages": _staged_run(ours)}
+            pr_sweep = {}
+            for t in THREADS:
+                os.environ["TPQ_WRITE_THREADS"] = str(t)
+                pr_sweep[str(t)] = round(_best(ours), 6)
+            os.environ.pop("TPQ_WRITE_THREADS", None)
+            pr["threads_sweep_s"] = pr_sweep
+            out["paged"] = pr
+        finally:
+            del os.environ["TPQ_PAGE_ROWS"]
+    return out
+
+
+def main(argv=None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    out_path = "WRITE_r01.json"
+    if "--out" in args:
+        out_path = args[args.index("--out") + 1]
+    rec = {
+        "bench": "write_pipeline",
+        "target_values": TARGET,
+        "reps": REPS,
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "usable_cores": len(os.sched_getaffinity(0))
+        if hasattr(os, "sched_getaffinity") else os.cpu_count(),
+        "configs": {},
+    }
+    for name in ("config1", "config2", "config3"):
+        print(f"[bench_write] {name} ...", flush=True)
+        rec["configs"][name] = bench_one(name)
+        print(json.dumps({name: rec["configs"][name]}, indent=None),
+              flush=True)
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"[bench_write] wrote {out_path}")
+    c2 = rec["configs"]["config2"]["write_vs_pyarrow"]
+    print(f"[bench_write] config2 write_vs_pyarrow = {c2}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -427,6 +427,15 @@ def _print_profile(log, st, out) -> None:
             print(f"gather: {d['gather_bytes_moved']:,}B to consumers  "
                   f"{d['gather_bytes_replicated']:,}B replication  "
                   f"reshard {d['gather_reshard_s']:.3f}s", file=out)
+        # write-pipeline section (io/pages.py native page assembly):
+        # how many pages this scope wrote, how many took the native
+        # one-pass path, and where the write wall went
+        if d["pages_written"]:
+            print(f"write: {d['pages_written']} pages "
+                  f"({d['pages_assembled_native']} native)  "
+                  f"encode {d['write_encode_s']:.3f}s  "
+                  f"compress {d['write_compress_s']:.3f}s  "
+                  f"assemble {d['write_assemble_s']:.3f}s", file=out)
         # predicate-pushdown section: what the filter statically skipped
         # and what the exact pass kept (tpuparquet/filter.py)
         if (d["row_groups_pruned"] or d["pages_pruned"]

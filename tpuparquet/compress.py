@@ -463,6 +463,35 @@ class _Snappy(BlockCompressor):
         return snappy_decompress(block, decompressed_size)
 
 
+def builtin_uncompressed_registered() -> bool:
+    """True when the UNCOMPRESSED slot still holds the built-in
+    pass-through — the condition for the native page pipeline to skip
+    the compressor entirely.  A user-registered transform on the
+    UNCOMPRESSED codec id (the registry allows it) must keep full
+    control of the bytes, so callers take the pure page path then."""
+    with _registry_lock:
+        return type(
+            _registry.get(int(CompressionCodec.UNCOMPRESSED))
+        ) is _Uncompressed
+
+
+def snappy_native_settings():
+    """``(native_codec, min_match)`` when the REGISTERED snappy block
+    compressor is the built-in :class:`_Snappy` backed by the native C
+    codec — the condition under which the write-side native page
+    pipeline (``io/pages.py``) produces exactly the bytes
+    ``compress_block`` would.  None otherwise (a custom compressor was
+    registered, or no compiler): callers must then take the pure page
+    path so registered-codec semantics are honored."""
+    with _registry_lock:
+        c = _registry.get(int(CompressionCodec.SNAPPY))
+    if type(c) is _Snappy:
+        nat = c._nat()
+        if nat is not None:
+            return nat, c.min_match
+    return None
+
+
 register_block_compressor(CompressionCodec.UNCOMPRESSED, _Uncompressed())
 register_block_compressor(CompressionCodec.GZIP, _Gzip())
 register_block_compressor(CompressionCodec.SNAPPY, _Snappy())

@@ -20,6 +20,7 @@ from .dictionary import (  # noqa: F401
     gather,
 )
 from .hybrid import (  # noqa: F401
+    as_uint32,
     decode_hybrid,
     decode_hybrid_prefixed,
     encode_hybrid,

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from .hybrid import decode_hybrid, encode_hybrid
+from .hybrid import as_uint32, decode_hybrid, encode_hybrid
 from .plain import ByteArrayColumn
 
 __all__ = [
@@ -42,9 +42,7 @@ def decode_dict_indices(data, count: int) -> np.ndarray:
 def encode_dict_indices(indices, dict_size: int) -> bytes:
     """Encode int indices as (bit_width byte + hybrid stream)."""
     width = max(int(dict_size - 1).bit_length(), 1) if dict_size > 1 else 1
-    return bytes([width]) + encode_hybrid(
-        np.asarray(indices, dtype=np.uint32), width
-    )
+    return bytes([width]) + encode_hybrid(as_uint32(indices), width)
 
 
 def gather(dictionary, indices: np.ndarray):
@@ -366,6 +364,18 @@ def _build_int_dictionary_smallrange(arr: np.ndarray):
     # unique path is cheaper than touching rng-sized arrays
     if rng > 4 * n or rng > 1 << 24:
         return None
+    if arr.itemsize in (4, 8):
+        # one-pass C intern (intern.c tpq_intern_range32/64): indices
+        # and first-occurrence order fall out of the sequential scan,
+        # replacing the widen/scatter/argsort/gather numpy passes below
+        from ..native import intern_native
+
+        nat = intern_native()
+        if nat is not None:
+            out = nat.intern_range(np.ascontiguousarray(arr), amin, rng)
+            if out is not None:
+                uniq_pos, indices = out
+                return arr[uniq_pos], indices
     # Signed dtypes must widen BEFORE subtracting: an int8 span of 200
     # wraps under own-dtype subtraction, aliasing distinct values into
     # one slot.  Unsigned stays in its own dtype (a Python-int amin

@@ -33,7 +33,20 @@ __all__ = [
     "decode_hybrid_prefixed",
     "encode_hybrid",
     "encode_hybrid_prefixed",
+    "as_uint32",
 ]
+
+
+def as_uint32(values) -> np.ndarray:
+    """u32 array of non-negative level/index values WITHOUT the copy
+    ``np.asarray(..., dtype=np.uint32)`` pays for the int32 arrays the
+    write path actually holds (a reinterpreting view is exact for the
+    non-negative domain; a stray negative becomes a huge value the
+    encoder's width check refuses, same as the widening path would)."""
+    a = np.asarray(values)
+    if a.dtype == np.int32:
+        return a.view(np.uint32)
+    return np.asarray(a, dtype=np.uint32)
 
 
 def slice_prefixed(data, pos: int = 0):
@@ -196,17 +209,30 @@ def encode_hybrid(values, width: int) -> bytes:
 
     Bit-packed runs cover groups of 8 values; the final partial group is
     padded with zeros (readers stop at the value count)."""
-    v = np.asarray(values, dtype=np.uint64)
-    out = bytearray()
-    if width == 0 or v.size == 0:
-        return bytes(out)
+    v0 = np.asarray(values)
+    if width == 0 or v0.size == 0:
+        return b""
     from ..native import pack_native
 
     nat = pack_native()
     if nat is not None:
-        enc = nat.hybrid_encode(v, width)
+        if 0 < width <= 32 and (
+                v0.dtype == np.uint32
+                or (v0.dtype == np.int32 and width < 32)):
+            # dict indices / levels arrive as (u)int32: encode straight
+            # from them instead of paying the u64-widening copy.  int32
+            # is excluded at width 32 only: there a negative's u32 view
+            # would fit and encode silently where the widening path
+            # refuses it.
+            enc = nat.hybrid_encode32(as_uint32(v0), width)
+            if enc is not None:
+                return enc.tobytes()
+        enc = nat.hybrid_encode(
+            np.asarray(values, dtype=np.uint64), width)
         if enc is not None:
             return enc.tobytes()
+    v = np.asarray(values, dtype=np.uint64)
+    out = bytearray()
     vbytes = (width + 7) // 8
 
     # Find constant runs via change points, then consider only the runs

@@ -18,6 +18,7 @@ import numpy as np
 
 from .bitpack import unpack_msb
 from .hybrid import (
+    as_uint32,
     decode_hybrid,
     decode_hybrid_prefixed,
     encode_hybrid,
@@ -123,17 +124,13 @@ def _check(vals, max_level: int) -> np.ndarray:
 def encode_levels_v1(levels, max_level: int) -> bytes:
     if max_level == 0:
         return b""
-    return encode_hybrid_prefixed(
-        np.asarray(levels, dtype=np.uint32), bit_width(max_level)
-    )
+    return encode_hybrid_prefixed(as_uint32(levels), bit_width(max_level))
 
 
 def encode_levels_v2(levels, max_level: int) -> bytes:
     if max_level == 0:
         return b""
-    return encode_hybrid(
-        np.asarray(levels, dtype=np.uint32), bit_width(max_level)
-    )
+    return encode_hybrid(as_uint32(levels), bit_width(max_level))
 
 
 def null_mask(def_levels: np.ndarray, max_def: int) -> np.ndarray:

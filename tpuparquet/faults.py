@@ -28,6 +28,11 @@ site                                  instrumented where / supported kinds
                                       — ``corrupt``, ``truncate``
 ``io.pages.page_decode``              ``decode_data_page_v1/v2``
                                       — ``corrupt``, ``truncate``
+``io.pages.page_write``               native page assembly
+                                      (``_write_page_native``; firing
+                                      drops the page to the pure
+                                      writer, bytes identical)
+                                      — ``transient``
 ``kernels.device.page_payload``       device plan page loop
                                       — ``corrupt``, ``truncate``
 ``kernels.device.page_dispatch``      device plan, per data page
@@ -107,6 +112,7 @@ SITES: dict[str, tuple] = {
     "io.chunk.page_payload": ("corrupt", "truncate"),
     "io.chunk.hang": ("hang",),
     "io.pages.page_decode": ("corrupt", "truncate"),
+    "io.pages.page_write": ("transient",),
     "kernels.device.page_payload": ("corrupt", "truncate"),
     "kernels.device.page_dispatch": ("dispatch",),
     "kernels.device.unit_dispatch": ("dispatch",),

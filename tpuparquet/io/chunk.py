@@ -40,6 +40,7 @@ from ..format.metadata import (
 from ..format.schema import SchemaNode
 from .pages import (
     DecodedPage,
+    _native_page_ctx,
     crc_verify_default,
     decode_data_page_v1,
     decode_data_page_v2,
@@ -246,9 +247,10 @@ def read_chunk(blob: "bytes | memoryview", cm: ColumnMetaData,
             column=col_path,
         )
 
-    # single-page chunks (everything our writer emits) keep the page's
-    # level arrays as-is: np.concatenate of one array still copies, and
-    # at 50M values the two level streams paid ~100 MB of pure memcpy
+    # single-page chunks (our writer's default layout; TPQ_PAGE_ROWS
+    # opts into splits) keep the page's level arrays as-is:
+    # np.concatenate of one array still copies, and at 50M values the
+    # two level streams paid ~100 MB of pure memcpy
     if not pages:
         rep = np.empty(0, dtype=np.int32)
         dl = np.empty(0, dtype=np.int32)
@@ -405,6 +407,55 @@ def _dict_size_gate(column, dictionary, indices, n: int):
     return dictionary, indices
 
 
+def _page_bounds(node, page_column, n_values: int, page_rows: int):
+    """Level-position page boundaries for one chunk: the single page
+    the writer always emitted, or ``page_rows``-sized splits when the
+    knob is set and the column is splittable (flat/struct columns
+    only — a repeated column's pages must break at record boundaries,
+    which stay single-page; device-resident values can't slice)."""
+    from .values import is_device_values
+
+    if (page_rows and page_rows > 0 and n_values > page_rows
+            and node.max_rep_level == 0
+            and not is_device_values(page_column)
+            and isinstance(page_column, (np.ndarray, ByteArrayColumn))):
+        return [(a, min(a + page_rows, n_values))
+                for a in range(0, n_values, page_rows)]
+    return [(0, n_values)]
+
+
+def _slice_column(column, va: int, vb: int):
+    """Zero-copy value slice [va, vb) of a page column (ndarray view or
+    a ByteArrayColumn over rebased offset views)."""
+    if isinstance(column, ByteArrayColumn):
+        offs = column.offsets
+        return ByteArrayColumn(offs[va:vb + 1] - offs[va],
+                               column.data[offs[va]:offs[vb]])
+    return column[va:vb]
+
+
+def _page_statistics(handler, node, values, pg_null: int, chunk_stats,
+                     dictionary):
+    """Per-page Statistics for a multi-page chunk.  Exact bounds from
+    the page's value slice for direct columns; dictionary-encoded pages
+    reuse the CHUNK bounds (always valid page bounds — every page value
+    appears in the dictionary — without paying a per-page gather)."""
+    if chunk_stats is None:
+        return None
+    if dictionary is not None or len(values) == 0:
+        mn_b, mx_b = chunk_stats.min_value, chunk_stats.max_value
+    else:
+        mn, mx = handler.min_max(values)
+        mn_b = handler.encode_stat_value(mn)
+        mx_b = handler.encode_stat_value(mx)
+    st = Statistics(null_count=pg_null, distinct_count=None,
+                    min_value=mn_b, max_value=mx_b)
+    if chunk_stats.min is not None:
+        st.min = st.min_value
+        st.max = st.max_value
+    return st
+
+
 def write_chunk(out, node: SchemaNode, column, rep, dl, *,
                 codec: CompressionCodec, page_version: int = 1,
                 encoding: Encoding = Encoding.PLAIN,
@@ -414,7 +465,10 @@ def write_chunk(out, node: SchemaNode, column, rep, dl, *,
                 write_stats: bool = True,
                 page_crc: bool = True,
                 page_index: bool = False,
-                bloom: bool = False) -> ColumnChunk:
+                bloom: bool = False,
+                null_count: int | None = None,
+                page_rows: int = 0,
+                pipeline_workers: int = 1) -> ColumnChunk:
     """Write one column chunk at the current position of ``out`` (a
     position-tracking binary stream); returns its ColumnChunk metadata.
 
@@ -424,7 +478,15 @@ def write_chunk(out, node: SchemaNode, column, rep, dl, *,
     row groups and records their offsets — see
     ``FileWriter._write_indexes``).  ``bloom=True`` attaches a
     split-block bloom filter over the chunk's distinct values as
-    ``cc._bloom`` (``format/bloom.py``)."""
+    ``cc._bloom`` (``format/bloom.py``).
+
+    ``null_count`` is the precomputed ``(dl != max_def).sum()`` when
+    the caller already knows it (the columnar prepare step derives it
+    from the masks in O(1)); None recomputes it here.  ``page_rows``
+    splits flat columns into multiple data pages of that many level
+    positions (0 = the single page this writer always emitted);
+    ``pipeline_workers > 1`` overlaps encode(page N+1) with
+    compress+write(page N) on an encode-ahead worker."""
     from .values import handler_for
 
     handler = handler_for(node.element)
@@ -432,8 +494,9 @@ def write_chunk(out, node: SchemaNode, column, rep, dl, *,
     dl = np.asarray(dl, dtype=np.int32)
     rep = np.asarray(rep, dtype=np.int32)
     n_values = len(dl)
-    null_count = int((dl != node.max_def_level).sum()) if node.max_def_level \
-        else 0
+    if null_count is None:
+        null_count = int((dl != node.max_def_level).sum()) \
+            if node.max_def_level else 0
 
     # Booleans never dict-encode: PLAIN is already 1 bit/value and other
     # readers reject it (the reference's boolean store also disallows dict).
@@ -447,58 +510,85 @@ def write_chunk(out, node: SchemaNode, column, rep, dl, *,
     total_uncomp = 0
     dict_page_offset = None
     distinct = None
-    if dictionary is not None:
-        dict_page_offset = pos0
-        c, u = write_dictionary_page(out, node, dictionary, codec,
-                                     page_crc=page_crc)
-        total_comp += c
-        total_uncomp += u
-        distinct = len(dictionary) if isinstance(dictionary, ByteArrayColumn) \
-            else dictionary.shape[0]
+    from ..kernels.arena import lease_arena, return_arena
 
-    stats = None
-    if write_stats:
-        # min/max over the DICTIONARY when one was built: every distinct
-        # value appears in it, so the reduction runs over D entries
-        # instead of materializing n Python objects (byte columns paid
-        # a 2M-element to_list here)
-        mn, mx = handler.min_max(
-            dictionary if dictionary is not None else column)
-        stats = Statistics(
-            null_count=null_count,
-            distinct_count=distinct,
-            min_value=handler.encode_stat_value(mn),
-            max_value=handler.encode_stat_value(mx),
-        )
-        # The deprecated min/max fields are defined under SIGNED comparison
-        # only (parquet.thrift Statistics doc); writing them for
-        # unsigned-ordered or byte-wise-ordered columns can make legacy
-        # readers mis-prune (min > max two's-complement).
-        if not handler.unsigned and node.element.type not in (
-            Type.BYTE_ARRAY, Type.FIXED_LEN_BYTE_ARRAY
-        ):
-            stats.min = stats.min_value
-            stats.max = stats.max_value
+    arena = lease_arena()
+    try:
+        if dictionary is not None:
+            dict_page_offset = pos0
+            c, u = write_dictionary_page(out, node, dictionary, codec,
+                                         page_crc=page_crc)
+            total_comp += c
+            total_uncomp += u
+            distinct = len(dictionary) \
+                if isinstance(dictionary, ByteArrayColumn) \
+                else dictionary.shape[0]
 
-    data_page_offset = out.tell()
-    page_column = indices if dictionary is not None else column
-    dict_size = distinct if dictionary is not None else None
-    data_page_start = data_page_offset  # page-index coordinates
-    if page_version == 2:
-        c, u = write_data_page_v2(
-            out, node, page_column, rep, dl, codec, encoding,
-            num_rows=num_rows if num_rows is not None else n_values,
-            null_count=null_count, dictionary_size=dict_size,
-            statistics=stats, page_crc=page_crc,
-        )
-    else:
-        c, u = write_data_page_v1(
-            out, node, page_column, rep, dl, codec, encoding,
-            dictionary_size=dict_size, statistics=stats,
-            page_crc=page_crc,
-        )
-    total_comp += c
-    total_uncomp += u
+        stats = None
+        if write_stats:
+            # min/max over the DICTIONARY when one was built: every
+            # distinct value appears in it, so the reduction runs over
+            # D entries instead of materializing n Python objects
+            # (byte columns paid a 2M-element to_list here)
+            mn, mx = handler.min_max(
+                dictionary if dictionary is not None else column)
+            stats = Statistics(
+                null_count=null_count,
+                distinct_count=distinct,
+                min_value=handler.encode_stat_value(mn),
+                max_value=handler.encode_stat_value(mx),
+            )
+            # The deprecated min/max fields are defined under SIGNED
+            # comparison only (parquet.thrift Statistics doc); writing
+            # them for unsigned-ordered or byte-wise-ordered columns
+            # can make legacy readers mis-prune (min > max
+            # two's-complement).
+            if not handler.unsigned and node.element.type not in (
+                Type.BYTE_ARRAY, Type.FIXED_LEN_BYTE_ARRAY
+            ):
+                stats.min = stats.min_value
+                stats.max = stats.max_value
+
+        data_page_offset = out.tell()
+        page_column = indices if dictionary is not None else column
+        dict_size = distinct if dictionary is not None else None
+        bounds = _page_bounds(node, page_column, n_values, page_rows)
+        # resolve the native-pipeline verdict once per chunk (env read
+        # + registry lock); every page below inherits it
+        nat_ctx = _native_page_ctx(codec)
+        if len(bounds) == 1:
+            # the single-page fast path: whole arrays, chunk stats in
+            # the page header (byte-identical to the pre-split writer)
+            if page_version == 2:
+                c, u = write_data_page_v2(
+                    out, node, page_column, rep, dl, codec, encoding,
+                    num_rows=num_rows if num_rows is not None
+                    else n_values,
+                    null_count=null_count, dictionary_size=dict_size,
+                    statistics=stats, page_crc=page_crc, arena=arena,
+                    native_ctx=nat_ctx,
+                )
+            else:
+                c, u = write_data_page_v1(
+                    out, node, page_column, rep, dl, codec, encoding,
+                    dictionary_size=dict_size, statistics=stats,
+                    page_crc=page_crc, arena=arena, native_ctx=nat_ctx,
+                )
+            total_comp += c
+            total_uncomp += u
+            page_entries = [(stats, data_page_offset, c, 0)]
+        else:
+            c, page_entries = _write_split_pages(
+                out, node, handler, page_column, dl, codec, encoding,
+                bounds, dict_size, stats, dictionary, page_version,
+                page_crc, arena, pipeline_workers, nat_ctx)
+            total_comp += sum(e[2] for e in page_entries)
+            total_uncomp += c
+    finally:
+        # page bodies have been copied into the output stream; slabs
+        # recycle for the next chunk on this thread
+        arena.release_all()
+        return_arena(arena)
 
     encodings = [Encoding.RLE, encoding]
     if dictionary is not None:
@@ -523,7 +613,7 @@ def write_chunk(out, node: SchemaNode, column, rep, dl, *,
     )
     cc = ColumnChunk(file_offset=pos0, meta_data=cm)
     if page_index and stats is not None:
-        pi = _build_page_index(node, stats, n_values, data_page_start, c)
+        pi = _build_page_index(node, page_entries, n_values)
         if pi is not None:
             cc._page_index = pi
     if bloom:
@@ -533,13 +623,128 @@ def write_chunk(out, node: SchemaNode, column, rep, dl, *,
     return cc
 
 
-def _build_page_index(node, stats: Statistics, n_values: int,
-                      page_offset: int, page_size: int):
-    """Per-page ``(ColumnIndex, OffsetIndex)`` for this writer's
-    single-data-page chunks (page summary == chunk statistics; the
-    structs generalize to any page count).  Returns None when the
-    column's order admits no index (INT96, or stats carry no bounds
-    for a non-empty page)."""
+def _write_split_pages(out, node, handler, page_column, dl, codec,
+                       encoding, bounds, dict_size, chunk_stats,
+                       dictionary, page_version, page_crc, arena,
+                       pipeline_workers, nat_ctx):
+    """The multi-page data loop behind ``page_rows``: one data page per
+    ``bounds`` entry, each with exact per-page statistics.  With
+    ``pipeline_workers > 1`` an encode-ahead worker renders page N+1
+    (native encode + compress, GIL released across both) while this
+    thread writes page N — the write pipeline's intra-column overlap.
+    Returns ``(total_uncompressed, page_entries)`` with one
+    ``(stats, offset, compressed_size, first_row)`` entry per page."""
+    max_def = node.max_def_level
+    if max_def:
+        nnp = np.zeros(len(dl) + 1, dtype=np.int64)
+        np.cumsum(dl == max_def, out=nnp[1:])
+    rep0 = np.zeros(0, dtype=np.int32)  # flat columns: no rep stream
+
+    def page_args(a, b):
+        dl_pg = dl[a:b]
+        va, vb = (int(nnp[a]), int(nnp[b])) if max_def else (a, b)
+        vals = _slice_column(page_column, va, vb)
+        pg_null = (b - a) - (vb - va)
+        pg_stats = _page_statistics(
+            handler, node,
+            vals if dict_size is None else None,
+            pg_null, chunk_stats,
+            dictionary if dict_size is not None else None)
+        return vals, dl_pg, pg_null, pg_stats
+
+    def render(a, b, like):
+        # render one page's bytes into a private buffer (pipelined
+        # mode): offsets rebase at append time, stats merge at join
+        from ..stats import worker_stats
+
+        buf = _CountingBuf()
+        with worker_stats(like) as ws:
+            c, u, pg_stats = write_page(buf, a, b)
+        return buf.parts, c, u, pg_stats, ws
+
+    def write_page(sink, a, b):
+        vals, dl_pg, pg_null, pg_stats = page_args(a, b)
+        if page_version == 2:
+            c, u = write_data_page_v2(
+                sink, node, vals, rep0, dl_pg, codec, encoding,
+                num_rows=b - a, null_count=pg_null,
+                dictionary_size=dict_size, statistics=pg_stats,
+                page_crc=page_crc,
+                arena=arena if sink is out else None,
+                native_ctx=nat_ctx,
+            )
+        else:
+            c, u = write_data_page_v1(
+                sink, node, vals, rep0, dl_pg, codec, encoding,
+                dictionary_size=dict_size, statistics=pg_stats,
+                page_crc=page_crc, arena=arena if sink is out else None,
+                native_ctx=nat_ctx,
+            )
+        return c, u, pg_stats
+
+    entries = []
+    total_uncomp = 0
+    if pipeline_workers > 1 and len(bounds) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ..stats import current_stats
+
+        st = current_stats()
+        # bounded encode-ahead (one in-flight page beyond the one being
+        # written): encode(N+1) overlaps compress/write(N) with at most
+        # two page buffers alive
+        with ThreadPoolExecutor(max_workers=1) as ex:
+            futs = {}
+            for j in range(min(2, len(bounds))):
+                futs[j] = ex.submit(render, *bounds[j], st)
+            for i, (a, b) in enumerate(bounds):
+                parts, c, u, pg_stats, ws = futs.pop(i).result()
+                off = out.tell()
+                for p in parts:
+                    out.write(p)
+                if st is not None:
+                    st.merge_from(ws)
+                entries.append((pg_stats, off, c, a))
+                total_uncomp += u
+                j = i + 2
+                if j < len(bounds):
+                    futs[j] = ex.submit(render, *bounds[j], st)
+    else:
+        for a, b in bounds:
+            off = out.tell()
+            c, u, pg_stats = write_page(out, a, b)
+            entries.append((pg_stats, off, c, a))
+            total_uncomp += u
+    return total_uncomp, entries
+
+
+class _CountingBuf:
+    """Minimal position-tracking sink for pipelined page rendering:
+    collects the written segments so the coordinator can append them
+    without a concatenation copy."""
+
+    __slots__ = ("parts", "_pos")
+
+    def __init__(self):
+        self.parts = []
+        self._pos = 0
+
+    def tell(self) -> int:
+        return self._pos
+
+    def write(self, data) -> None:
+        self.parts.append(bytes(data))
+        self._pos += len(data)
+
+
+def _build_page_index(node, page_entries, n_values: int):
+    """Per-page ``(ColumnIndex, OffsetIndex)`` from one entry
+    ``(stats, offset, compressed_size, first_row)`` per data page —
+    the historical single-page chunk (page summary == chunk
+    statistics, ASCENDING) and the ``page_rows`` multi-page splits
+    (exact per-page bounds; UNORDERED, since page order is the data's
+    order).  Returns None when the column's order admits no index
+    (INT96, or stats carry no bounds for a non-empty page)."""
     from ..format.metadata import (
         BoundaryOrder,
         ColumnIndex,
@@ -547,29 +752,41 @@ def _build_page_index(node, stats: Statistics, n_values: int,
         PageLocation,
     )
 
-    all_null = (stats.null_count is not None
-                and stats.null_count == n_values)
-    if stats.min_value is None or stats.max_value is None:
-        if not all_null:
-            return None  # unordered type (INT96): no index possible
-        mins, maxs, null_pages = [b""], [b""], [True]
-    else:
-        mins = [stats.min_value]
-        maxs = [stats.max_value]
-        null_pages = [all_null]
+    mins, maxs, null_pages, null_counts = [], [], [], []
+    n_pages = len(page_entries)
+    for i, (stats, _off, _size, first_row) in enumerate(page_entries):
+        if stats is None:
+            return None
+        pg_values = (page_entries[i + 1][3] if i + 1 < n_pages
+                     else n_values) - first_row
+        all_null = (stats.null_count is not None
+                    and stats.null_count == pg_values)
+        if stats.min_value is None or stats.max_value is None:
+            if not all_null:
+                return None  # unordered type (INT96): no index possible
+            mins.append(b"")
+            maxs.append(b"")
+            null_pages.append(True)
+        else:
+            mins.append(stats.min_value)
+            maxs.append(stats.max_value)
+            null_pages.append(all_null)
+        null_counts.append(stats.null_count)
     ci = ColumnIndex(
         null_pages=null_pages,
         min_values=mins,
         max_values=maxs,
-        boundary_order=BoundaryOrder.ASCENDING,
-        null_counts=([stats.null_count]
-                     if stats.null_count is not None else None),
+        boundary_order=(BoundaryOrder.ASCENDING if n_pages == 1
+                        else BoundaryOrder.UNORDERED),
+        null_counts=(null_counts
+                     if all(c is not None for c in null_counts)
+                     else None),
     )
-    oi = OffsetIndex(page_locations=[PageLocation(
-        offset=page_offset,
-        compressed_page_size=page_size,
-        first_row_index=0,
-    )])
+    oi = OffsetIndex(page_locations=[
+        PageLocation(offset=off, compressed_page_size=size,
+                     first_row_index=first_row)
+        for (_st, off, size, first_row) in page_entries
+    ])
     return ci, oi
 
 

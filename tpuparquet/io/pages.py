@@ -19,13 +19,18 @@ Decoding returns either a materialized column or dictionary *indices*
 from __future__ import annotations
 
 import os
+import time
 import zlib
 
 import numpy as np
 
 from ..compress import compress_block, decompress_block
-from ..errors import CorruptPageError
+from ..errors import CorruptPageError, TransientIOError
+from ..faults import fault_point
+from ..obs import recorder as _flightrec
 from ..cpu import (
+    as_uint32,
+    bit_width,
     decode_byte_stream_split,
     decode_delta_binary_packed,
     decode_delta_byte_array,
@@ -72,6 +77,7 @@ __all__ = [
     "SUPPORTED_DATA_ENCODINGS",
     "page_crc_default",
     "crc_verify_default",
+    "write_native_default",
     "page_crc32",
     "verify_page_crc",
 ]
@@ -384,92 +390,339 @@ def _page_header_bytes(ph: PageHeader) -> bytes:
     return w.getvalue()
 
 
+def write_native_default() -> bool:
+    """Write-side gate: assemble data pages through the native one-pass
+    pipeline (``native/page.c``) when codec and shapes allow?  Output
+    is byte-identical to the pure path either way; ``TPQ_WRITE_NATIVE=0``
+    forces pure (the ci.sh stage-11 parity leg)."""
+    return os.environ.get("TPQ_WRITE_NATIVE", "1") != "0"
+
+
+def _native_page_ctx(codec: CompressionCodec):
+    """``(page_native, snappy_native_or_None, min_match)`` when the
+    native page pipeline can produce byte-identical output for this
+    codec, else None (unsupported codec, a user-registered compressor
+    on the codec id, natives unbuildable, or ``TPQ_WRITE_NATIVE=0``).
+    Invariant per chunk — ``write_chunk`` resolves it once and threads
+    it through ``native_ctx=`` so a multi-page column does not pay the
+    env read + registry lock per page."""
+    if not write_native_default():
+        return None
+    from ..native import page_native
+
+    pg = page_native()
+    if pg is None:
+        return None
+    if codec == CompressionCodec.UNCOMPRESSED:
+        from ..compress import builtin_uncompressed_registered
+
+        if not builtin_uncompressed_registered():
+            return None
+        return pg, None, 0
+    if codec == CompressionCodec.SNAPPY:
+        from ..compress import snappy_native_settings
+
+        s = snappy_native_settings()
+        if s is None:
+            return None
+        return pg, s[0], s[1]
+    return None
+
+
+def _hybrid_worst_case(count: int, width: int) -> int:
+    """Output capacity bound for one hybrid RLE/BP stream — the
+    bindings' own formula (one copy; a desync here would quietly turn
+    every native page into a cap-shortfall fallback)."""
+    from ..native import hybrid_encode_cap
+
+    return hybrid_encode_cap(count, width)
+
+
+def _native_values_view(node, column, encoding):
+    """u8 view of a page's value segment for the native assembler:
+    zero-copy for PLAIN fixed-width numpy columns (the bytes
+    ``encode_plain`` would produce, without producing them), else the
+    encoded bytes wrapped read-only."""
+    ptype = Type(node.element.type)
+    if encoding == Encoding.PLAIN and isinstance(column, np.ndarray):
+        dt = PHYSICAL_DTYPES.get(ptype)
+        if (ptype not in (Type.BOOLEAN, Type.FIXED_LEN_BYTE_ARRAY)
+                and dt is not None and column.dtype == np.dtype(dt)
+                and column.ndim == 1):
+            return np.ascontiguousarray(column).view(np.uint8)
+        if (ptype == Type.FIXED_LEN_BYTE_ARRAY
+                and column.dtype == np.uint8 and column.ndim == 2):
+            return np.ascontiguousarray(column).reshape(-1)
+    b = encode_values(ptype, encoding, column, node.element.type_length)
+    return np.frombuffer(b, dtype=np.uint8)
+
+
+def _write_page_native(out, node, column, rep, dl, codec, encoding, ctx,
+                       *, v2: bool, num_rows=None, null_count=None,
+                       dictionary_size=None, statistics=None,
+                       page_crc=True, arena=None):
+    """One data page through the native pipeline: encode the whole body
+    into a single arena-backed buffer (levels + dict-index/values, one
+    C pass), block-compress it in place, CRC it, then write header +
+    body with no intermediate Python ``bytes``.  Returns the pure
+    path's ``(compressed, uncompressed)`` sizes, or None when this page
+    must take the pure path (capacity shortfall, injected fault, or a
+    value the native encoder refuses) — falling back is always safe
+    because nothing has been written yet."""
+    pg, snat, min_match = ctx
+    from ..stats import current_stats
+
+    st = current_stats()
+    n = len(dl)
+    try:
+        fault_point("io.pages.page_write",
+                    column=".".join(node.path), values=n)
+        t0 = time.perf_counter() if st is not None else 0.0
+        if dictionary_size is not None:
+            idx = as_uint32(np.asarray(column))
+            if idx.ndim != 1:
+                return None
+            idx_width = max(int(dictionary_size - 1).bit_length(), 1) \
+                if dictionary_size > 1 else 1
+            values = None
+            enc_kind = Encoding.RLE_DICTIONARY
+        else:
+            idx = None
+            idx_width = 0
+            values = _native_values_view(node, column, encoding)
+            enc_kind = encoding
+        rep_w = bit_width(node.max_rep_level)
+        def_w = bit_width(node.max_def_level)
+        rep_arr = as_uint32(rep) if node.max_rep_level else None
+        dl_arr = as_uint32(dl) if node.max_def_level else None
+        cap = 16
+        if rep_arr is not None:
+            cap += 4 + _hybrid_worst_case(n, rep_w)
+        if dl_arr is not None:
+            cap += 4 + _hybrid_worst_case(n, def_w)
+        cap += (1 + _hybrid_worst_case(idx.size, idx_width)
+                if idx is not None else values.size)
+        scratch = arena.borrow(cap) if arena is not None \
+            else np.empty(cap, dtype=np.uint8)
+        enc = pg.encode(rep_arr, dl_arr, n, rep_w, def_w, v2, idx,
+                        idx_width, values, scratch)
+        if enc is None:
+            return None
+        rep_len, dl_len, val_len = enc
+        uncomp = rep_len + dl_len + val_len
+        if st is not None:
+            t1 = time.perf_counter()
+            st.write_encode_s += t1 - t0
+        else:
+            t1 = 0.0
+        # compress stage: V1 compresses the whole body, V2 only the
+        # values segment (levels stay raw on file)
+        lev = rep_len + dl_len
+        if snat is None:  # UNCOMPRESSED
+            segs = [scratch[:uncomp]]
+        elif v2:
+            vals_seg = scratch[lev:uncomp]
+            outbuf = _comp_buffer(arena, val_len)
+            comp_vals = snat.compress_into(vals_seg, outbuf, min_match)
+            segs = [scratch[:lev], outbuf[:comp_vals]]
+        else:
+            outbuf = _comp_buffer(arena, uncomp)
+            comp = snat.compress_into(scratch[:uncomp], outbuf,
+                                      min_match)
+            segs = [outbuf[:comp]]
+        crc = None
+        if page_crc:
+            c = 0
+            for s in segs:
+                c = pg.crc32(s, c)
+            crc = c - (1 << 32) if c >= (1 << 31) else c
+        comp_total = sum(s.size for s in segs)
+        if st is not None:
+            t2 = time.perf_counter()
+            st.write_compress_s += t2 - t1
+        else:
+            t2 = 0.0
+        if v2:
+            ph = PageHeader(
+                type=PageType.DATA_PAGE_V2,
+                uncompressed_page_size=uncomp,
+                compressed_page_size=comp_total,
+                crc=crc,
+                data_page_header_v2=DataPageHeaderV2(
+                    num_values=n,
+                    num_nulls=null_count,
+                    num_rows=num_rows,
+                    encoding=enc_kind,
+                    definition_levels_byte_length=dl_len,
+                    repetition_levels_byte_length=rep_len,
+                    is_compressed=codec != CompressionCodec.UNCOMPRESSED,
+                    statistics=statistics,
+                ),
+            )
+        else:
+            ph = PageHeader(
+                type=PageType.DATA_PAGE,
+                uncompressed_page_size=uncomp,
+                compressed_page_size=comp_total,
+                crc=crc,
+                data_page_header=DataPageHeader(
+                    num_values=n,
+                    encoding=enc_kind,
+                    definition_level_encoding=Encoding.RLE,
+                    repetition_level_encoding=Encoding.RLE,
+                    statistics=statistics,
+                ),
+            )
+        hdr = _page_header_bytes(ph)
+    except (TransientIOError, ValueError):
+        # injected fault / native refusal before anything was written:
+        # the pure path renders this page instead (identical bytes)
+        return None
+    out.write(hdr)
+    for s in segs:
+        out.write(memoryview(s))
+    if st is not None:
+        st.pages_assembled_native += 1
+        st.write_assemble_s += time.perf_counter() - t2
+    return len(hdr) + comp_total, len(hdr) + uncomp
+
+
+def _comp_buffer(arena, uncomp_len: int) -> np.ndarray:
+    """Compression output buffer sized to the codec's worst case."""
+    cap = 32 + uncomp_len + uncomp_len // 6
+    return arena.borrow(cap) if arena is not None \
+        else np.empty(cap, dtype=np.uint8)
+
+
 def write_data_page_v1(out, node, column, rep, dl, codec, encoding,
                        dictionary_size=None, statistics=None,
-                       page_crc=True) -> tuple[int, int]:
+                       page_crc=True, arena=None,
+                       native_ctx="auto") -> tuple[int, int]:
     """Append a V1 data page; returns (compressed_size, uncompressed_size)
     including the header bytes (ColumnMetaData counts headers —
-    ``chunk_writer.go:209-251``)."""
+    ``chunk_writer.go:209-251``).  ``native_ctx`` is the chunk-resolved
+    :func:`_native_page_ctx` (None = pure path); the default resolves
+    it here for direct callers."""
     n = len(dl)
-    body = bytearray()
-    if node.max_rep_level:
-        body += encode_levels_v1(rep, node.max_rep_level)
-    if node.max_def_level:
-        body += encode_levels_v1(dl, node.max_def_level)
-    if dictionary_size is not None:
-        body += encode_dict_indices(column, dictionary_size)
-        enc = Encoding.RLE_DICTIONARY
-    else:
-        body += encode_values(
-            Type(node.element.type), encoding, column,
-            node.element.type_length,
+    res = None
+    ctx = _native_page_ctx(codec) if native_ctx == "auto" else native_ctx
+    if ctx is not None:
+        res = _write_page_native(
+            out, node, column, rep, dl, codec, encoding, ctx, v2=False,
+            dictionary_size=dictionary_size, statistics=statistics,
+            page_crc=page_crc, arena=arena)
+    if res is None:
+        body = bytearray()
+        if node.max_rep_level:
+            body += encode_levels_v1(rep, node.max_rep_level)
+        if node.max_def_level:
+            body += encode_levels_v1(dl, node.max_def_level)
+        if dictionary_size is not None:
+            body += encode_dict_indices(column, dictionary_size)
+            enc = Encoding.RLE_DICTIONARY
+        else:
+            body += encode_values(
+                Type(node.element.type), encoding, column,
+                node.element.type_length,
+            )
+            enc = encoding
+        comp = compress_block(codec, bytes(body))
+        ph = PageHeader(
+            type=PageType.DATA_PAGE,
+            uncompressed_page_size=len(body),
+            compressed_page_size=len(comp),
+            crc=page_crc32(comp) if page_crc else None,
+            data_page_header=DataPageHeader(
+                num_values=n,
+                encoding=enc,
+                definition_level_encoding=Encoding.RLE,
+                repetition_level_encoding=Encoding.RLE,
+                statistics=statistics,
+            ),
         )
-        enc = encoding
-    comp = compress_block(codec, bytes(body))
-    ph = PageHeader(
-        type=PageType.DATA_PAGE,
-        uncompressed_page_size=len(body),
-        compressed_page_size=len(comp),
-        crc=page_crc32(comp) if page_crc else None,
-        data_page_header=DataPageHeader(
-            num_values=n,
-            encoding=enc,
-            definition_level_encoding=Encoding.RLE,
-            repetition_level_encoding=Encoding.RLE,
-            statistics=statistics,
-        ),
-    )
-    hdr = _page_header_bytes(ph)
-    out.write(hdr)
-    out.write(comp)
-    return len(hdr) + len(comp), len(hdr) + len(body)
+        hdr = _page_header_bytes(ph)
+        out.write(hdr)
+        out.write(comp)
+        res = len(hdr) + len(comp), len(hdr) + len(body)
+    _record_page_written(node, n)
+    return res
 
 
 def write_data_page_v2(out, node, column, rep, dl, codec, encoding,
                        num_rows, null_count, dictionary_size=None,
-                       statistics=None, page_crc=True) -> tuple[int, int]:
+                       statistics=None, page_crc=True, arena=None,
+                       native_ctx="auto") -> tuple[int, int]:
     n = len(dl)
-    rep_b = encode_levels_v2(rep, node.max_rep_level) if node.max_rep_level \
-        else b""
-    dl_b = encode_levels_v2(dl, node.max_def_level) if node.max_def_level \
-        else b""
-    if dictionary_size is not None:
-        values_b = encode_dict_indices(column, dictionary_size)
-        enc = Encoding.RLE_DICTIONARY
-    else:
-        values_b = encode_values(
-            Type(node.element.type), encoding, column,
-            node.element.type_length,
+    res = None
+    ctx = _native_page_ctx(codec) if native_ctx == "auto" else native_ctx
+    if ctx is not None:
+        res = _write_page_native(
+            out, node, column, rep, dl, codec, encoding, ctx, v2=True,
+            num_rows=num_rows, null_count=null_count,
+            dictionary_size=dictionary_size, statistics=statistics,
+            page_crc=page_crc, arena=arena)
+    if res is None:
+        rep_b = encode_levels_v2(rep, node.max_rep_level) \
+            if node.max_rep_level else b""
+        dl_b = encode_levels_v2(dl, node.max_def_level) \
+            if node.max_def_level else b""
+        if dictionary_size is not None:
+            values_b = encode_dict_indices(column, dictionary_size)
+            enc = Encoding.RLE_DICTIONARY
+        else:
+            values_b = encode_values(
+                Type(node.element.type), encoding, column,
+                node.element.type_length,
+            )
+            enc = encoding
+        comp_values = compress_block(codec, values_b)
+        ph = PageHeader(
+            type=PageType.DATA_PAGE_V2,
+            uncompressed_page_size=len(rep_b) + len(dl_b) + len(values_b),
+            compressed_page_size=len(rep_b) + len(dl_b) + len(comp_values),
+            # V2 CRC spans the on-file body: uncompressed level streams +
+            # compressed values (parquet.thrift "as it appears in the
+            # file")
+            crc=page_crc32(rep_b, dl_b, comp_values) if page_crc
+            else None,
+            data_page_header_v2=DataPageHeaderV2(
+                num_values=n,
+                num_nulls=null_count,
+                num_rows=num_rows,
+                encoding=enc,
+                definition_levels_byte_length=len(dl_b),
+                repetition_levels_byte_length=len(rep_b),
+                is_compressed=codec != CompressionCodec.UNCOMPRESSED,
+                statistics=statistics,
+            ),
         )
-        enc = encoding
-    comp_values = compress_block(codec, values_b)
-    ph = PageHeader(
-        type=PageType.DATA_PAGE_V2,
-        uncompressed_page_size=len(rep_b) + len(dl_b) + len(values_b),
-        compressed_page_size=len(rep_b) + len(dl_b) + len(comp_values),
-        # V2 CRC spans the on-file body: uncompressed level streams +
-        # compressed values (parquet.thrift "as it appears in the file")
-        crc=page_crc32(rep_b, dl_b, comp_values) if page_crc else None,
-        data_page_header_v2=DataPageHeaderV2(
-            num_values=n,
-            num_nulls=null_count,
-            num_rows=num_rows,
-            encoding=enc,
-            definition_levels_byte_length=len(dl_b),
-            repetition_levels_byte_length=len(rep_b),
-            is_compressed=codec != CompressionCodec.UNCOMPRESSED,
-            statistics=statistics,
-        ),
-    )
-    hdr = _page_header_bytes(ph)
-    out.write(hdr)
-    out.write(rep_b)
-    out.write(dl_b)
-    out.write(comp_values)
-    return (
-        len(hdr) + len(rep_b) + len(dl_b) + len(comp_values),
-        len(hdr) + ph.uncompressed_page_size,
-    )
+        hdr = _page_header_bytes(ph)
+        out.write(hdr)
+        out.write(rep_b)
+        out.write(dl_b)
+        out.write(comp_values)
+        res = (
+            len(hdr) + len(rep_b) + len(dl_b) + len(comp_values),
+            len(hdr) + ph.uncompressed_page_size,
+        )
+    _record_page_written(node, n)
+    return res
+
+
+def _record_page_written(node, n_values: int) -> None:
+    """Per-written-page accounting shared by every page writer: the
+    ``pages_written`` counter (every page, native or pure — the
+    conservation check ``pages_assembled_native <= pages_written``) and
+    the flight-recorder breadcrumb (guarded so the disabled path skips
+    the kwargs build; this runs once per page on the write hot loop)."""
+    from ..stats import current_stats
+
+    st = current_stats()
+    if st is not None:
+        st.pages_written += 1
+    if _flightrec._active is not None:
+        _flightrec.flight("page_write", site="io.pages",
+                          column=".".join(node.path), values=n_values)
 
 
 def write_dictionary_page(out, node, dictionary, codec,
@@ -494,4 +747,5 @@ def write_dictionary_page(out, node, dictionary, codec,
     hdr = _page_header_bytes(ph)
     out.write(hdr)
     out.write(comp)
+    _record_page_written(node, count)
     return len(hdr) + len(comp), len(hdr) + len(body)

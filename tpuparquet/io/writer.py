@@ -104,6 +104,7 @@ class FileWriter:
         salvage_hint: bool | None = None,
         page_index: bool | None = None,
         bloom_columns=None,
+        page_rows: int | None = None,
     ):
         self._f = f
         self._pos = 0
@@ -150,6 +151,15 @@ class FileWriter:
             bloom_columns = [c for c in bloom_columns.split(",")
                              if c.strip()]
         self.bloom_columns = {c.strip() for c in bloom_columns}
+        # data-page split size in level positions for flat columns
+        # (0 = the historical single data page per chunk).  Kwarg, else
+        # TPQ_PAGE_ROWS; repeated columns always stay single-page.
+        if page_rows is None:
+            try:
+                page_rows = int(os.environ.get("TPQ_PAGE_ROWS", "0"))
+            except ValueError:
+                page_rows = 0
+        self.page_rows = max(int(page_rows), 0)
 
         if schema is None:
             self.schema = Schema.empty()
@@ -320,7 +330,7 @@ class FileWriter:
                     leaf_vals = columns[key]
                     em = (element_masks or {}).get(key)
                     gm = None
-                vals, rep, dl, rows = self._prepare_repeated(
+                vals, rep, dl, rows, nc = self._prepare_repeated(
                     leaf, leaf_vals, np.asarray(offsets[key]),
                     (masks or {}).get(key), em, group_null=gm,
                 )
@@ -331,20 +341,20 @@ class FileWriter:
                 # masks on the group prefixes ("a", "a.b", ...)
                 if leaf.flat_name not in columns:
                     raise ValueError(f"missing column {leaf.flat_name!r}")
-                vals, dl, rows = self._prepare_struct(
+                vals, dl, rows, nc = self._prepare_struct(
                     leaf, columns[leaf.flat_name], masks or {}
                 )
             else:
                 if leaf.name not in columns:
                     raise ValueError(f"missing column {leaf.name!r}")
-                vals, dl, rows = self._prepare_flat(
+                vals, dl, rows, nc = self._prepare_flat(
                     leaf, columns[leaf.name], (masks or {}).get(leaf.name)
                 )
             if n_rows is None:
                 n_rows = rows
             elif n_rows != rows:
                 raise ValueError("column row counts differ")
-            prepared.append((leaf, vals, dl))
+            prepared.append((leaf, vals, dl, nc))
         self._flush_prepared(
             prepared, n_rows or 0, kv_metadata or {}, kv_per_column or {},
             reps=reps or None,
@@ -376,16 +386,17 @@ class FileWriter:
                     f"vs {nn} valid mask entries"
                 )
             dl = mask.astype(np.int32) * leaf.max_def_level
+            return vals, dl, rows, rows - nn
+        rows = _column_len(vals)
+        if leaf.max_def_level:
+            dl = np.full(rows, leaf.max_def_level, dtype=np.int32)
         else:
-            rows = _column_len(vals)
-            if leaf.max_def_level:
-                dl = np.full(rows, leaf.max_def_level, dtype=np.int32)
-            else:
-                dl = np.zeros(rows, dtype=np.int32)
-        return vals, dl, rows
+            dl = np.zeros(rows, dtype=np.int32)
+        return vals, dl, rows, 0
 
     def _prepare_struct(self, leaf, vals, masks):
-        """Nested non-repeated leaf -> (values, def levels, n_rows).
+        """Nested non-repeated leaf -> (values, def levels, n_rows,
+        null_count).
 
         Def levels are derived outermost-ancestor-first: a row absent at
         group ``a`` stays at ``a``'s parent definition level, exactly as
@@ -439,11 +450,13 @@ class FileWriter:
             raise ValueError(
                 f"column {leaf.flat_name!r}: {_column_len(vals)} values "
                 f"vs {nn} present rows (pass only non-null values)")
-        return vals, dl, n_rows
+        return vals, dl, n_rows, n_rows - nn
 
     def _prepare_repeated(self, leaf, vals, offs, row_mask, elem_mask,
                           group_null=None):
-        """Offsets-based LIST column -> (values, rep, def, n_rows).
+        """Offsets-based LIST column -> (values, rep, def, n_rows,
+        null_count) — null_count in the Parquet sense: level slots not
+        carrying a value (empty/null rows, null elements).
 
         ``group_null`` (full-slot bool, True = the element GROUP is
         null at that slot) serves lists of structs whose element group
@@ -549,7 +562,7 @@ class FileWriter:
                 f"column {leaf.path[0]!r}: {_column_len(vals)} values vs "
                 f"{n_vals} non-null elements"
             )
-        return vals, rep, dl, n_rows
+        return vals, rep, dl, n_rows, total - n_vals
 
     # -- flush -------------------------------------------------------------
 
@@ -595,6 +608,10 @@ class FileWriter:
         jobs = []
         for entry in prepared:
             leaf, column, dl = entry[0], entry[1], entry[2]
+            # null_count computed once by the columnar prepare step
+            # (O(1) from the masks); the row path passes None and the
+            # chunk layer derives it from the def levels
+            nc = entry[3] if len(entry) > 3 else None
             rep = (reps or {}).get(
                 leaf.flat_name, np.zeros(len(dl), dtype=np.int32)
             )
@@ -603,9 +620,9 @@ class FileWriter:
             enc = self.column_encodings.get(
                 leaf.flat_name, Encoding.PLAIN
             )
-            jobs.append((leaf, column, rep, dl, kv, enc))
+            jobs.append((leaf, column, rep, dl, kv, enc, nc))
 
-        def render(leaf, column, rep, dl, kv, enc):
+        def render(leaf, column, rep, dl, kv, enc, nc):
             # each chunk renders into its own buffer at position 0;
             # offsets in the returned metadata are made absolute when
             # the buffer is appended below — bytes are identical to
@@ -628,6 +645,8 @@ class FileWriter:
                     page_crc=self.page_crc,
                     page_index=self.page_index,
                     bloom=leaf.flat_name in self.bloom_columns,
+                    null_count=nc,
+                    page_rows=self.page_rows,
                 )
             return buf.getvalue(), cc, ws
 
@@ -690,8 +709,12 @@ class FileWriter:
                     submit(i + ahead)
         else:
             # serial path writes straight into the file: no per-chunk
-            # buffer or blob copy (identical to the pre-pool behavior)
-            for leaf, column, rep, dl, kv, enc in jobs:
+            # buffer or blob copy (identical to the pre-pool behavior).
+            # The whole TPQ_WRITE_THREADS budget goes to the intra-
+            # column page pipeline here (combined-budget rule: columns
+            # and pages share one knob; the parallel path above keeps
+            # pages serial because its workers already fill the budget)
+            for leaf, column, rep, dl, kv, enc, nc in jobs:
                 cc = write_chunk(
                     self, leaf, column, rep, dl,
                     codec=self.codec,
@@ -704,6 +727,9 @@ class FileWriter:
                     page_crc=self.page_crc,
                     page_index=self.page_index,
                     bloom=leaf.flat_name in self.bloom_columns,
+                    null_count=nc,
+                    page_rows=self.page_rows,
+                    pipeline_workers=n_workers,
                 )
                 total_bytes += cc.meta_data.total_uncompressed_size
                 total_comp += cc.meta_data.total_compressed_size

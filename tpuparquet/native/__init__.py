@@ -20,12 +20,13 @@ import numpy as np
 
 __all__ = ["snappy_native", "NativeSnappy", "hybrid_native", "NativeHybrid",
            "plane_native", "NativePlane", "delta_native", "NativeDelta",
-           "pack_native", "NativePack"]
+           "pack_native", "NativePack", "page_native", "NativePage"]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRCS = [os.path.join(_DIR, "snappy.c"), os.path.join(_DIR, "hybrid.c"),
          os.path.join(_DIR, "plane.c"), os.path.join(_DIR, "delta.c"),
-         os.path.join(_DIR, "pack.c"), os.path.join(_DIR, "intern.c")]
+         os.path.join(_DIR, "pack.c"), os.path.join(_DIR, "intern.c"),
+         os.path.join(_DIR, "page.c")]
 _SO = os.path.join(_DIR, "_tpq_native.so")
 
 _lock = threading.Lock()
@@ -37,6 +38,18 @@ def _as_u8(block) -> np.ndarray:
     if isinstance(block, np.ndarray):
         return np.ascontiguousarray(block.reshape(-1).view(np.uint8))
     return np.frombuffer(block, dtype=np.uint8)
+
+
+def hybrid_encode_cap(count: int, width: int) -> int:
+    """Output-capacity bound for one hybrid RLE/BP encode of ``count``
+    ``width``-bit values: packed groups + per-group headers + slack.
+    The ONE copy of this formula — the encoder bindings size their
+    buffers with it and the write-side page assembler
+    (``io/pages.py``) budgets its body buffer from it; a silent
+    desync would turn every native page into a cap-shortfall
+    fallback."""
+    groups = (count + 7) // 8
+    return groups * width + 5 * (groups + 2) + 32
 
 
 def _build() -> bool:
@@ -200,6 +213,30 @@ class NativeSnappy:
 
     def decompress(self, block: bytes, expected_size: int | None = None):
         return self.decompress_np(block, expected_size).tobytes()
+
+    def compress_into(self, src, out: np.ndarray,
+                      min_match: int = 8) -> int:
+        """Compress ``src`` into the caller's u8 buffer (arena-backed on
+        the write path); returns the produced length.  No intermediate
+        zeroed ctypes buffer and no copy-out — the two hidden whole-
+        body passes ``compress`` pays per page."""
+        buf = _as_u8(src)
+        cap = self._lib.tpq_snappy_max_compressed_length(buf.size)
+        if out.size < cap:
+            raise ValueError("snappy: output buffer too small")
+        produced = ctypes.c_size_t()
+        opt = self._compress_opt_fn
+        src_p = buf.ctypes.data_as(ctypes.c_char_p)
+        out_p = out.ctypes.data_as(ctypes.c_char_p)
+        if opt is not None:
+            rc = opt(src_p, buf.size, out_p, out.size,
+                     ctypes.byref(produced), min_match)
+        else:  # stale .so without the tunable: fixed min_match = 8
+            rc = self._lib.tpq_snappy_compress(
+                src_p, buf.size, out_p, out.size, ctypes.byref(produced))
+        if rc != 0:
+            raise ValueError(f"snappy: compress failed (rc={rc})")
+        return int(produced.value)
 
     def compress(self, data: bytes, min_match: int = 8) -> bytes:
         cap = self._lib.tpq_snappy_max_compressed_length(len(data))
@@ -656,6 +693,14 @@ class NativePack:
                 ctypes.c_void_p, ctypes.c_longlong,
                 ctypes.POINTER(ctypes.c_longlong),
             ]
+        self._hybrid_encode32 = getattr(lib, "tpq_hybrid_encode32", None)
+        if self._hybrid_encode32 is not None:
+            self._hybrid_encode32.restype = ctypes.c_longlong
+            self._hybrid_encode32.argtypes = [
+                ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int,
+                ctypes.c_void_p, ctypes.c_longlong,
+                ctypes.POINTER(ctypes.c_longlong),
+            ]
         self._expand.restype = ctypes.c_longlong
         self._expand.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
@@ -699,13 +744,34 @@ class NativePack:
         if self._hybrid_encode is None:
             return None
         v = np.ascontiguousarray(values, dtype=np.uint64)
-        groups = (v.size + 7) // 8
-        cap = groups * width + 5 * (groups + 2) + 32
+        cap = hybrid_encode_cap(v.size, width)
         out = np.empty(cap, dtype=np.uint8)
         out_len = ctypes.c_longlong()
         rc = self._hybrid_encode(v.ctypes.data, v.size, width,
                                  out.ctypes.data, cap,
                                  ctypes.byref(out_len))
+        if rc == -1:
+            raise ValueError(
+                f"value {int(v.max())} does not fit in {width} bits")
+        if rc != 0:
+            return None  # cap shortfall / bad width: fallback decides
+        return out[: out_len.value]
+
+    def hybrid_encode32(self, values: np.ndarray, width: int):
+        """Hybrid RLE/BP encode straight from a u32 array — the same
+        bytes as :meth:`hybrid_encode` without the u64-widening copy
+        the write path paid per dict-index/level stream.  None when
+        the symbol is missing (stale .so) or the capacity estimate
+        fell short; raises on a value that does not fit the width."""
+        if self._hybrid_encode32 is None or width > 32:
+            return None
+        v = np.ascontiguousarray(values, dtype=np.uint32)
+        cap = hybrid_encode_cap(v.size, width)
+        out = np.empty(cap, dtype=np.uint8)
+        out_len = ctypes.c_longlong()
+        rc = self._hybrid_encode32(v.ctypes.data, v.size, width,
+                                   out.ctypes.data, cap,
+                                   ctypes.byref(out_len))
         if rc == -1:
             raise ValueError(
                 f"value {int(v.max())} does not fit in {width} bits")
@@ -799,6 +865,75 @@ class NativePack:
         return out[:n]
 
 
+class NativePage:
+    """ctypes bindings over the write-side page assembly (page.c):
+    one-pass body encode into a caller buffer + the zlib-polynomial
+    CRC32 the PageHeader carries."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._encode = getattr(lib, "tpq_page_encode", None)
+        self._crc = getattr(lib, "tpq_crc32", None)
+        if None in (self._encode, self._crc):
+            raise RuntimeError("native library too old; rebuild")
+        self._encode.restype = ctypes.c_longlong
+        self._encode.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_longlong,
+            ctypes.c_void_p, ctypes.c_longlong,
+            ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_longlong),
+        ]
+        self._crc.restype = ctypes.c_uint32
+        self._crc.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
+                              ctypes.c_uint32]
+
+    def crc32(self, buf, crc: int = 0) -> int:
+        """zlib-compatible CRC32 (slice-by-8, GIL released)."""
+        b = _as_u8(buf)
+        return int(self._crc(b.ctypes.data, b.size, crc & 0xFFFFFFFF))
+
+    def encode(self, rep, dl, n: int, rep_width: int, def_width: int,
+               v2: bool, idx, idx_width: int, values,
+               out: np.ndarray):
+        """Lay one data page's uncompressed body into ``out``:
+        ``[rep stream][def stream][values]``, V1 length-prefixed or V2
+        raw level framing.  ``rep``/``dl`` are u32 level arrays or
+        None; the values segment is either ``idx`` (u32 dictionary
+        indices, hybrid-encoded behind the width byte) or ``values``
+        (pre-encoded u8 bytes, copied verbatim).  Returns
+        ``(rep_len, dl_len, val_len)`` — framing included — or None
+        when the buffer capacity fell short (caller falls back);
+        raises on a level/index exceeding its width."""
+        def _c(a):
+            # contiguity is load-bearing: C walks n consecutive words
+            # from the base pointer (no-op for the write path's own
+            # arrays; a caller-provided strided view copies here)
+            return None if a is None else np.ascontiguousarray(a)
+
+        def _p(a):
+            return None if a is None else a.ctypes.data
+
+        rep, dl, idx, values = _c(rep), _c(dl), _c(idx), _c(values)
+        rep_len = ctypes.c_longlong()
+        dl_len = ctypes.c_longlong()
+        val_len = ctypes.c_longlong()
+        rc = self._encode(
+            _p(rep), _p(dl), n, rep_width, def_width, 1 if v2 else 0,
+            _p(idx), 0 if idx is None else idx.size, idx_width,
+            _p(values), 0 if values is None else values.size,
+            out.ctypes.data, out.size,
+            ctypes.byref(rep_len), ctypes.byref(dl_len),
+            ctypes.byref(val_len))
+        if rc == -1:
+            raise ValueError("level/index value does not fit its width")
+        if rc != 0:
+            return None  # cap shortfall / bad width: fallback decides
+        return int(rep_len.value), int(dl_len.value), int(val_len.value)
+
+
 # sentinel: the interner hit its distinct-value cap (callers compare
 # with ``is``; a string literal here invited silent typo mismatches)
 TOO_MANY_DISTINCT = object()
@@ -819,6 +954,41 @@ class NativeIntern:
             ctypes.c_void_p, ctypes.c_longlong,
             ctypes.c_void_p,
         ]
+        # optional symbols (absent in a stale .so): bound once here
+        self._range32 = getattr(lib, "tpq_intern_range32", None)
+        self._range64 = getattr(lib, "tpq_intern_range64", None)
+        for fn, lo_t in ((self._range32, ctypes.c_uint32),
+                         (self._range64, ctypes.c_uint64)):
+            if fn is not None:
+                fn.restype = ctypes.c_longlong
+                fn.argtypes = [
+                    ctypes.c_void_p, ctypes.c_longlong, lo_t,
+                    ctypes.c_longlong,
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ]
+
+    def intern_range(self, arr: np.ndarray, lo: int, rng: int):
+        """First-occurrence intern of a small-range integer column in
+        one C pass: ``(uniq_positions int64[D], indices int32[n])``, or
+        None when the symbol is missing (stale .so).  ``lo``/``rng``
+        come from the column's true min/max (offsets are computed with
+        wraparound subtraction, exact for signed and unsigned alike);
+        raises on a value outside ``[lo, lo + rng)``."""
+        fn = self._range64 if arr.itemsize == 8 else self._range32
+        if fn is None or arr.itemsize not in (4, 8):
+            return None
+        u = np.ascontiguousarray(arr).view(
+            np.uint64 if arr.itemsize == 8 else np.uint32)
+        mask = (1 << (8 * arr.itemsize)) - 1
+        rank = np.full(rng, -1, dtype=np.int32)
+        uniq_pos = np.empty(rng, dtype=np.int64)
+        indices = np.empty(max(u.size, 1), dtype=np.int32)[: u.size]
+        d = fn(u.ctypes.data, u.size, lo & mask, rng,
+               rank.ctypes.data, uniq_pos.ctypes.data,
+               indices.ctypes.data)
+        if d < 0:
+            raise ValueError(f"value outside interning range (rc={d})")
+        return uniq_pos[:d].copy(), indices
 
     def intern_var(self, data, offsets, max_d: int):
         """First-occurrence intern of n variable byte values.
@@ -875,6 +1045,8 @@ _PACK_UNAVAILABLE = object()
 _pack_inst = None
 _INTERN_UNAVAILABLE = object()
 _intern_inst = None
+_PAGE_UNAVAILABLE = object()
+_page_inst = None
 
 
 def snappy_native() -> NativeSnappy | None:
@@ -961,6 +1133,27 @@ def intern_native() -> NativeIntern | None:
             st.native_fallbacks += 1
         return None
     return _intern_inst
+
+
+def page_native() -> NativePage | None:
+    """The process-wide page assembler, or None if unbuildable."""
+    global _page_inst
+    if _page_inst is not None:
+        return None if _page_inst is _PAGE_UNAVAILABLE else _page_inst
+    lib = _lib()
+    if lib is None:
+        return None
+    try:
+        _page_inst = NativePage(lib)
+    except RuntimeError:  # stale .so predating page.c: cache the miss
+        _page_inst = _PAGE_UNAVAILABLE
+        from ..stats import current_stats
+
+        st = current_stats()
+        if st is not None:
+            st.native_fallbacks += 1
+        return None
+    return _page_inst
 
 
 def plane_native() -> NativePlane | None:

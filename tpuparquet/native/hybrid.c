@@ -100,6 +100,126 @@ int tpq_hybrid_scan(const uint8_t *buf, size_t buflen, size_t pos,
   return TPQ_OK;
 }
 
+/* ------------------------------------------------------------------ */
+/* Hybrid RLE/BP ENCODER (u32 input) — the write-side mirror of the
+ * scanner above.  Byte-identical to cpu/hybrid.encode_hybrid and to
+ * pack.c's u64 tpq_hybrid_encode, but takes the uint32 arrays the
+ * write path actually holds (dictionary indices, levels), so the
+ * encode no longer pays a full u64-widening copy per page.           */
+/* ------------------------------------------------------------------ */
+
+static long long emit_uvarint32(uint8_t *out, long long o, uint64_t v) {
+  while (v >= 0x80) {
+    out[o++] = (uint8_t)(v | 0x80);
+    v >>= 7;
+  }
+  out[o++] = (uint8_t)v;
+  return o;
+}
+
+/* Pack count width-bit u32 values LSB-first at out (8 bytes slack past
+ * the exact payload); returns the exact payload length.  Same word-
+ * accumulator scheme as pack.c's pack_words. */
+static long long pack_words32(const uint32_t *v, long long count,
+                              int width, uint8_t *out) {
+  uint64_t acc = 0;
+  int nbits = 0;
+  long long o = 0;
+  for (long long i = 0; i < count; i++) {
+    acc |= nbits < 64 ? (uint64_t)v[i] << nbits : 0;
+    nbits += width;
+    if (nbits >= 64) {
+      memcpy(out + o, &acc, 8);
+      o += 8;
+      nbits -= 64;
+      acc = nbits ? (uint64_t)v[i] >> (width - nbits) : 0;
+    }
+  }
+  if (nbits > 0)
+    memcpy(out + o, &acc, 8); /* slack covers the tail */
+  return (count * (long long)width + 7) / 8;
+}
+
+/* One bit-packed region (header + 8-value groups, zero-padded tail),
+ * shared by the mid-stream and final flushes.  Returns the new offset,
+ * or -1 when cap would overflow. */
+static long long emit_bp_region32(const uint32_t *v, long long bp_n,
+                                  int width, uint8_t *out, long long cap,
+                                  long long o) {
+  if (bp_n <= 0)
+    return o;
+  long long groups = (bp_n + 7) / 8;
+  if (o + 10 + groups * width + 8 > cap)
+    return -1;
+  o = emit_uvarint32(out, o, ((uint64_t)groups << 1) | 1);
+  long long full = bp_n / 8 * 8;
+  if (full)
+    o += pack_words32(v, full, width, out + o);
+  if (bp_n > full) { /* zero-padded tail group */
+    uint32_t tmp[8] = {0};
+    for (long long k = 0; k < bp_n - full; k++)
+      tmp[k] = v[full + k];
+    o += pack_words32(tmp, 8, width, out + o);
+  }
+  return o;
+}
+
+/* Hybrid RLE/BP encode from u32 values: RLE for constant stretches
+ * >= 8, bit-packing (8-value groups, zero-padded tail) for the rest —
+ * byte-identical to the Python encoder and pack.c's u64 variant.  out
+ * needs 8 bytes of slack past the worst case.  Returns 0 with
+ * *out_len, -1 if a value exceeds width bits, -2 on bad width, -3 on
+ * cap overflow. */
+long long tpq_hybrid_encode32(const uint32_t *v, long long n, int width,
+                              uint8_t *out, long long cap,
+                              long long *out_len) {
+  if (width <= 0 || width > 32)
+    return -2;
+  const uint32_t lim_mask =
+      width >= 32 ? 0 : ~((uint32_t)0) << width; /* high bits set */
+  for (long long i = 0; i < n; i++)
+    if (v[i] & lim_mask)
+      return -1;
+  const int vbytes = (width + 7) / 8;
+  long long o = 0;
+  long long pending = 0; /* start of the un-emitted bit-packed region */
+  long long i = 0;
+  while (i < n) {
+    /* find the constant run starting at i */
+    long long e = i + 1;
+    while (e < n && v[e] == v[i])
+      e++;
+    if (e - i >= 8) { /* long run: flush pending BP, then RLE */
+      long long flush_end = i;
+      if ((flush_end - pending) % 8) {
+        long long r = pending + ((i - pending + 7) / 8) * 8;
+        flush_end = r < e ? r : e;
+      }
+      o = emit_bp_region32(v + pending, flush_end - pending, width, out,
+                           cap, o);
+      if (o < 0)
+        return -3;
+      if (e - flush_end >= 1) {
+        if (o + 10 + vbytes > cap)
+          return -3;
+        o = emit_uvarint32(out, o, (uint64_t)(e - flush_end) << 1);
+        uint32_t x = v[i];
+        for (int b = 0; b < vbytes; b++) {
+          out[o++] = (uint8_t)x;
+          x >>= 8;
+        }
+      }
+      pending = e;
+    }
+    i = e;
+  }
+  o = emit_bp_region32(v + pending, n - pending, width, out, cap, o);
+  if (o < 0)
+    return -3;
+  *out_len = o;
+  return 0;
+}
+
 /* Unpack value i (LSB-first within bytes) from a width-bit stream.
  * Caller guarantees the value's bits lie within bp_len bytes. */
 static inline uint32_t bp_get(const uint8_t *bp, size_t bp_len, int64_t i,

@@ -22,6 +22,60 @@
 #include <stdint.h>
 #include <string.h>
 
+/* Small-range integer intern (the dictionary-friendly case: category
+ * codes, quantized measures): one sequential pass assigning each value
+ * its first-occurrence rank via a dense rank table over the value
+ * range — replaces the multi-pass numpy formulation (widen, reversed
+ * scatter, presence scan, argsort, gather) whose temporaries were a
+ * first-order slice of the config-2 write wall.
+ *
+ * Values are taken as raw 32/64-bit words; `lo` is the column minimum
+ * in the same width, and offsets are computed with wraparound
+ * subtraction, which is exact for BOTH signed and unsigned columns as
+ * long as every (v - lo) lies in [0, rng) — the caller guarantees that
+ * by computing lo/rng from the true min/max.  rank must hold rng
+ * int32 entries pre-filled with -1.  uniq_pos receives the first-
+ * occurrence value index per id (ids are first-occurrence ranks by
+ * construction, so no re-ranking pass exists).  Returns the distinct
+ * count D, or -3 when a value falls outside [lo, lo+rng). */
+long long tpq_intern_range32(const uint32_t *v, long long n, uint32_t lo,
+                             long long rng, int32_t *rank,
+                             int64_t *uniq_pos, int32_t *indices) {
+    long long d = 0;
+    for (long long i = 0; i < n; i++) {
+        uint32_t off = v[i] - lo;
+        if ((uint64_t)off >= (uint64_t)rng)
+            return -3;
+        int32_t r = rank[off];
+        if (r < 0) {
+            r = (int32_t)d;
+            rank[off] = r;
+            uniq_pos[d++] = i;
+        }
+        indices[i] = r;
+    }
+    return d;
+}
+
+long long tpq_intern_range64(const uint64_t *v, long long n, uint64_t lo,
+                             long long rng, int32_t *rank,
+                             int64_t *uniq_pos, int32_t *indices) {
+    long long d = 0;
+    for (long long i = 0; i < n; i++) {
+        uint64_t off = v[i] - lo;
+        if (off >= (uint64_t)rng)
+            return -3;
+        int32_t r = rank[off];
+        if (r < 0) {
+            r = (int32_t)d;
+            rank[off] = r;
+            uniq_pos[d++] = i;
+        }
+        indices[i] = r;
+    }
+    return d;
+}
+
 long long tpq_intern_var(const uint8_t *data, long long data_len,
                          const int64_t *offs, long long n,
                          int32_t *slots, long long t_mask, int tbits,

@@ -132,6 +132,22 @@ class DecodeStats:
     # durable cursor checkpoints written (shard.scan.save_cursor_file
     # via the auto-checkpoint path or an explicit cursor_save)
     checkpoints_written: int = 0
+    # -- write pipeline (io/pages.py, io/chunk.py) --
+    # every page this scope wrote (dictionary + data, native or pure
+    # path) and the subset whose body was assembled by the native
+    # one-pass pipeline (native/page.c): the conservation invariant is
+    # pages_assembled_native <= pages_written, with equality on data
+    # pages when TPQ_WRITE_NATIVE is on and the codec qualifies
+    pages_written: int = 0
+    pages_assembled_native: int = 0
+    # where the native write wall went, accumulated per page: body
+    # encode (levels + dict-index/value streams into the arena
+    # buffer), block compress + page CRC, and header build + buffer
+    # writes.  All zero on the pure path (its stages interleave through
+    # Python bytes and can't be attributed exactly).
+    write_encode_s: float = 0.0
+    write_compress_s: float = 0.0
+    write_assemble_s: float = 0.0
     # -- predicate pushdown / pruning (tpuparquet/filter.py) --
     # row groups skipped entirely by a filter verdict (chunk Statistics,
     # bloom filters, or the page index proving no row can match) — the
@@ -216,6 +232,8 @@ class DecodeStats:
         "metadata_rejects",
         "deadline_exceeded", "hedges_issued", "hedges_won",
         "checkpoints_written",
+        "pages_written", "pages_assembled_native",
+        "write_encode_s", "write_compress_s", "write_assemble_s",
         "row_groups_pruned", "pages_pruned", "rows_pruned",
         "bloom_hits", "filter_rows_in", "filter_rows_out",
         "gather_bytes_moved", "gather_bytes_replicated",
@@ -286,6 +304,11 @@ class DecodeStats:
             "hedges_issued": self.hedges_issued,
             "hedges_won": self.hedges_won,
             "checkpoints_written": self.checkpoints_written,
+            "pages_written": self.pages_written,
+            "pages_assembled_native": self.pages_assembled_native,
+            "write_encode_s": round(self.write_encode_s, 6),
+            "write_compress_s": round(self.write_compress_s, 6),
+            "write_assemble_s": round(self.write_assemble_s, 6),
             "row_groups_pruned": self.row_groups_pruned,
             "pages_pruned": self.pages_pruned,
             "rows_pruned": self.rows_pruned,
@@ -342,6 +365,12 @@ class DecodeStats:
                f"{d['checkpoints_written']} checkpoints"
                if (d["deadline_exceeded"] or d["hedges_issued"]
                    or d["checkpoints_written"]) else "")
+            + (f"; WRITE: {d['pages_written']} pages "
+               f"({d['pages_assembled_native']} native), "
+               f"encode {d['write_encode_s']:.3f}s / compress "
+               f"{d['write_compress_s']:.3f}s / assemble "
+               f"{d['write_assemble_s']:.3f}s"
+               if d["pages_written"] else "")
             + (f"; PRUNE: {d['row_groups_pruned']} row groups / "
                f"{d['pages_pruned']} pages / {d['rows_pruned']} rows "
                f"pruned, {d['bloom_hits']} bloom hits"
