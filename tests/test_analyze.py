@@ -354,6 +354,82 @@ class TestRecorderGuardPass:
         """})
         assert recorderguard.run(t) == []
 
+    # -- the causal-trace vocabulary (obs/trace.py) rides the same
+    #    pass: emit_span/open_span hot sites must guard the call ------
+
+    def test_guarded_trace_emit_accepted(self):
+        t = _tree({"tpuparquet/io/x.py": """
+            from .obs import trace as _trace
+
+            def read(chunks):
+                for c in chunks:
+                    if _trace._active is not None:
+                        _trace.emit_span("read", c.t0, c.dt,
+                                         column=c.path)
+        """})
+        assert recorderguard.run(t) == []
+
+    def test_unguarded_trace_emit_flagged(self):
+        t = _tree({"tpuparquet/io/x.py": """
+            from .obs import trace as _trace
+
+            def read(chunks):
+                for c in chunks:
+                    _trace.emit_span("read", c.t0, c.dt,
+                                     column=c.path)
+        """})
+        assert _keys(recorderguard.run(t), "unguarded-hot-flight") \
+            == ["read:read"]
+
+    def test_unguarded_open_span_flagged(self):
+        t = _tree({"tpuparquet/io/x.py": """
+            from .obs import trace as _trace
+
+            def plan(col):
+                tsp = _trace.open_span("plan", column=col)
+                return tsp
+        """})
+        assert _keys(recorderguard.run(t), "unguarded-hot-flight") \
+            == ["plan:plan"]
+
+    def test_ternary_guard_accepted(self):
+        t = _tree({"tpuparquet/io/x.py": """
+            from .obs import trace as _trace
+
+            def plan(col):
+                tsp = _trace.open_span("plan", column=col) \\
+                    if _trace._active is not None else None
+                return tsp
+        """})
+        assert recorderguard.run(t) == []
+
+    def test_bare_trace_emit_in_except_accepted(self):
+        t = _tree({"tpuparquet/io/x.py": """
+            from .obs.trace import emit_span
+
+            def scan(units):
+                for u in units:
+                    try:
+                        u.decode()
+                    except ValueError:
+                        emit_span("quarantined", 0.0, 0.0, unit=u)
+        """})
+        assert recorderguard.run(t) == []
+
+    def test_close_span_needs_no_guard(self):
+        # close_span takes a handle (None when off) and builds no
+        # kwargs-per-call cost worth guarding — exempt by design
+        t = _tree({"tpuparquet/io/x.py": """
+            from .obs import trace as _trace
+
+            def plan(cols):
+                for c in cols:
+                    h = _trace.open_span("plan", column=c) \\
+                        if _trace._active is not None else None
+                    _trace.close_span(h)
+        """})
+        assert recorderguard.run(t) == []
+
 
 # ----------------------------------------------------------------------
 # thread-safety

@@ -301,6 +301,50 @@ class TestRecorder:
         assert live.registry().snapshot() == before
         assert current_stats() is None
 
+    def test_trace_off_structurally_zero_cost(self, corpus,
+                                              monkeypatch):
+        """Overhead guard for the causal tracer (round 16), structural
+        half: with ``TPQ_TRACE`` off (the default), no scan/gather/
+        write path may reach the tracer at all — every hot site's
+        ``_trace._active is not None`` guard short-circuits first.
+        Proven by making every Tracer method explode: a single
+        unguarded touch fails the scan."""
+        from tpuparquet.obs import trace
+
+        trace.set_tracing(False)
+        assert trace.tracer() is None
+
+        def boom(*a, **k):
+            raise AssertionError("tracer touched with TPQ_TRACE off")
+
+        monkeypatch.setattr(trace.Tracer, "record", boom)
+        monkeypatch.setattr(trace.Tracer, "snapshot", boom)
+        try:
+            scan = ShardedScan(corpus)
+            results = [o for _k, o in scan.run_iter()]
+            scan.gather_column(results, "a")
+            assert len(results) == len(scan.units)
+            assert trace.snapshot_spans() == []
+        finally:
+            trace._init_from_env()
+
+    def test_trace_on_records_then_off_again(self, corpus):
+        """The same sites DO record once tracing is armed (the guard
+        is a gate, not a lobotomy), and disabling returns the scan to
+        span-free operation."""
+        from tpuparquet.obs import trace
+
+        trace.set_tracing(True)
+        try:
+            ShardedScan(corpus).run()
+            spans = trace.snapshot_spans()
+            assert any(s["name"] == "unit" for s in spans)
+            trace.set_tracing(False)
+            ShardedScan(corpus).run()
+            assert trace.snapshot_spans() == []
+        finally:
+            trace._init_from_env()
+
     def test_scan_unit_records_survive_the_hot_guard(self, corpus):
         """Regression pin for the round-13 recorder-guard fixes: the
         scan-loop flight sites (`unit_done`, per-unit coordinates)

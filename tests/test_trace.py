@@ -1,0 +1,745 @@
+"""Causal scan tracing + per-scan attribution + the scan doctor.
+
+Covers the round's acceptance criteria at test scale:
+
+* every scan yields ONE connected span tree — no orphan spans — whose
+  per-unit stage buckets sum to the unit wall exactly (the
+  exclusive-time decomposition invariant);
+* spans survive, and parent correctly, across the adversity matrix:
+  transient-I/O retry, hedged replica reads (losers become cancelled
+  child spans), device→CPU degradation, quarantine, salvage and
+  cursor resume, plus the MultiHostScan merge
+  (``allgather_traces``);
+* attribution ledgers satisfy exact conservation — sum over scans of
+  every counter equals the process MetricsRegistry totals — and merge
+  exactly across hosts;
+* ``parquet-tool doctor`` reproduces a KNOWN critical path on a
+  synthetic trace (golden), names the bounding stage on a real scan's
+  export, and flags plan-pool oversubscription (the PLAN_SCALE_r06
+  diagnosis);
+* scan results are byte-identical with tracing on vs off, and the
+  trace-off hot path is structurally zero-cost.
+"""
+
+import io
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpuparquet import FileWriter, collect_stats
+from tpuparquet.faults import inject_faults
+from tpuparquet.obs import attribution, live, trace
+from tpuparquet.obs.export import (
+    load_trace_file,
+    spans_chrome_trace,
+    spans_otlp,
+    write_trace_file,
+)
+from tpuparquet.shard.distributed import (
+    MultiHostScan,
+    allgather_ledgers,
+    allgather_traces,
+)
+from tpuparquet.shard.scan import ShardedScan
+
+SCHEMA = ("message t { required int64 a; required double b; "
+          "optional binary s (STRING); }")
+
+
+def write_file(path, rows=400, rg_rows=100, seed=0):
+    with open(path, "wb") as f:
+        w = FileWriter(f, SCHEMA, max_row_group_size=rg_rows * 24)
+        for j in range(rows):
+            w.add_data({"a": j + seed, "b": (j + seed) * 0.5,
+                        "s": f"r{j}" if j % 3 else None})
+        w.close()
+    return str(path)
+
+
+@pytest.fixture
+def corpus(tmp_path):
+    return [write_file(tmp_path / f"f{i}.parquet", seed=i * 1000)
+            for i in range(2)]
+
+
+@pytest.fixture(autouse=True)
+def fresh_tracing():
+    """Every test runs with tracing armed on a fresh tracer, a fresh
+    registry and fresh ledgers (all restored to env defaults
+    after)."""
+    live.reset_registry()
+    attribution.reset_ledgers()
+    trace.set_tracing(True)
+    trace._ctx.set(None)   # no ambient context bleeding across tests
+    yield
+    trace.set_tracing(False)
+    trace._init_from_env()
+    trace._ctx.set(None)
+    attribution.reset_ledgers()
+    live.reset_registry()
+
+
+def assert_connected(spans):
+    """No orphans: every parent id resolves within the snapshot, and
+    every span belongs to a trace whose root is present."""
+    ids = {s["span"] for s in spans}
+    roots = {s["trace"] for s in spans if s["parent"] is None}
+    for s in spans:
+        if s["parent"] is not None:
+            assert s["parent"] in ids, f"orphan span {s}"
+        assert s["trace"] in roots, f"span outside any rooted trace {s}"
+
+
+def scan_spans(corpus, **kw):
+    scan = ShardedScan(corpus, **kw)
+    results = list(scan.run_iter())
+    return scan, results, trace.snapshot_spans()
+
+
+# ----------------------------------------------------------------------
+# Tracer mechanics
+# ----------------------------------------------------------------------
+
+class TestTracer:
+    def test_off_by_default(self, monkeypatch):
+        monkeypatch.delenv("TPQ_TRACE", raising=False)
+        assert trace.trace_default() == 0
+        trace.set_tracing(False)
+        # emit/open/start are all no-ops with no tracer
+        trace.emit_span("read", 0.0, 1.0)
+        assert trace.start_trace("x") is None
+        assert trace.open_span("unit") is None
+        assert trace.snapshot_spans() == []
+
+    def test_trace_env_ring(self, monkeypatch):
+        monkeypatch.setenv("TPQ_TRACE", "1")
+        assert trace.trace_default() == trace._DEFAULT_RING
+        monkeypatch.setenv("TPQ_TRACE", "512")
+        assert trace.trace_default() == 512
+        monkeypatch.setenv("TPQ_TRACE", "junk")
+        assert trace.trace_default() == 0
+
+    def test_spans_outside_a_trace_are_dropped(self):
+        trace.emit_span("read", 0.0, 1.0)   # no ambient root
+        assert trace.snapshot_spans() == []
+
+    def test_nesting_and_parents(self):
+        with trace.trace_scope("t") as root:
+            u = trace.open_span("unit", unit=0)
+            trace.emit_span("read", time.perf_counter(), 0.01,
+                            column="a")
+            trace.close_span(u)
+        spans = trace.snapshot_spans()
+        by_name = {s["name"]: s for s in spans}
+        assert by_name["scan"]["parent"] is None
+        assert by_name["unit"]["parent"] == by_name["scan"]["span"]
+        assert by_name["read"]["parent"] == by_name["unit"]["span"]
+        assert root is not None
+        assert_connected(spans)
+
+    def test_cross_thread_adoption(self):
+        got = {}
+
+        with trace.trace_scope("t"):
+            ctx = trace.current_ctx()
+
+            def worker():
+                with trace.adopt(ctx):
+                    trace.emit_span("read", time.perf_counter(), 0.0)
+                # outside the adopt: dropped
+                trace.emit_span("plan", time.perf_counter(), 0.0)
+                got["done"] = True
+
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        assert got["done"]
+        spans = trace.snapshot_spans()
+        names = sorted(s["name"] for s in spans)
+        assert names == ["read", "scan"]
+        read = next(s for s in spans if s["name"] == "read")
+        assert read["parent"] == ctx[1]
+
+    def test_abandoned_root_close_keeps_newer_trace_context(self):
+        # an abandoned scan's root, finalized LATE (GC of the
+        # generator) on a thread that has since started another
+        # trace, must not clobber the newer trace's ambient context
+        a = trace.start_trace("A")
+        b = trace.start_trace("B")
+        trace.end_trace(a)      # late close of the abandoned root
+        trace.emit_span("read", time.perf_counter(), 0.0)
+        trace.end_trace(b)
+        spans = trace.snapshot_spans()
+        b_root = next(s for s in spans
+                      if s["name"] == "scan" and s["label"] == "B")
+        read = next(s for s in spans if s["name"] == "read")
+        assert read["trace"] == b_root["trace"]
+        assert read["parent"] == b_root["span"]
+
+    def test_whole_trace_sampling(self):
+        trace.set_tracing(True, sample=0.5)
+        for _ in range(4):
+            with trace.trace_scope("t"):
+                trace.emit_span("read", time.perf_counter(), 0.0)
+        spans = trace.snapshot_spans()
+        traces = {s["trace"] for s in spans}
+        assert len(traces) == 2          # deterministic: every 2nd
+        # sampled traces are COMPLETE (root + child), unsampled absent
+        for t_id in traces:
+            names = sorted(s["name"] for s in spans
+                           if s["trace"] == t_id)
+            assert names == ["read", "scan"]
+
+    def test_sample_zero_records_nothing(self, corpus):
+        trace.set_tracing(True, sample=0.0)
+        scan, results, spans = scan_spans(corpus)
+        assert len(results) == len(scan.units)
+        assert spans == []
+
+    def test_ring_bounded(self, corpus):
+        trace.set_tracing(True, ring=16)
+        scan_spans(corpus)
+        # per-thread rings: snapshot stays bounded by ring x threads
+        per_tid = {}
+        for s in trace.snapshot_spans():
+            per_tid[s["tid"]] = per_tid.get(s["tid"], 0) + 1
+        assert per_tid
+        assert all(n <= 16 for n in per_tid.values())
+
+
+# ----------------------------------------------------------------------
+# Scan span trees
+# ----------------------------------------------------------------------
+
+class TestScanTraces:
+    def test_connected_tree_with_all_stages(self, corpus):
+        scan, results, spans = scan_spans(corpus)
+        n = len(scan.units)
+        assert len(results) == n
+        assert_connected(spans)
+        names = {s["name"] for s in spans}
+        assert {"scan", "unit", "read", "plan", "transfer",
+                "dispatch"} <= names
+        units = [s for s in spans if s["name"] == "unit"]
+        assert len(units) == n
+        # every unit has a plan child per column and transfer+dispatch
+        kids = {}
+        for s in spans:
+            kids.setdefault(s["parent"], []).append(s["name"])
+        for u in units:
+            ks = kids[u["span"]]
+            assert ks.count("plan") == 3
+            assert "transfer" in ks and "dispatch" in ks
+
+    def test_unit_stage_buckets_sum_to_wall(self, corpus):
+        scan, _results, spans = scan_spans(corpus)
+        rows = attribution.unit_reports(spans)
+        assert len(rows) == len(scan.units)
+        for r in rows:
+            total = sum(r["stages_s"].values())
+            # exclusive-time decomposition is exact by construction
+            # (1e-5 absorbs the per-bucket 6-decimal display rounding)
+            assert total == pytest.approx(r["dur_s"], abs=1e-5)
+
+    def test_results_identical_trace_on_off(self, corpus):
+        def checksum(results):
+            out = []
+            for _k, cols in results:
+                for p in sorted(cols):
+                    out.append(cols[p].to_numpy())
+            return out
+
+        trace.set_tracing(False)
+        base = checksum(list(ShardedScan(corpus).run_iter()))
+        trace.set_tracing(True)
+        traced = checksum(list(ShardedScan(corpus).run_iter()))
+        assert len(base) == len(traced)
+        for a, b in zip(base, traced):
+            if isinstance(a, tuple):   # byte column triplets
+                for x, y in zip(a, b):
+                    np.testing.assert_array_equal(x, y)
+            else:
+                np.testing.assert_array_equal(a, b)
+        assert trace.snapshot_spans()  # and the traced run traced
+
+    def test_retry_keeps_tree_connected(self, corpus):
+        with inject_faults() as inj:
+            inj.inject("io.reader.chunk_read", "transient", times=2)
+            scan, results, spans = scan_spans(
+                corpus, on_error="quarantine")
+        n = len(scan.units)
+        assert len(results) == n       # retried, nothing lost
+        assert_connected(spans)
+        assert sum(1 for s in spans if s["name"] == "unit") == n
+
+    def test_quarantined_unit_span_is_error(self, corpus):
+        with inject_faults() as inj:
+            inj.inject("kernels.device.page_payload", "corrupt",
+                       times=1000, match={"column": "a"})
+            scan = ShardedScan(corpus, on_error="quarantine",
+                               retries=0)
+            results = list(scan.run_iter())
+        spans = trace.snapshot_spans()
+        n = len(scan.units)
+        assert results == []
+        assert len(scan.quarantine) == n
+        assert_connected(spans)
+        units = [s for s in spans if s["name"] == "unit"]
+        assert len(units) == n
+        assert all(u["status"] == "error" and u.get("quarantined")
+                   for u in units)
+
+    def test_cpu_fallback_spans(self, corpus):
+        with inject_faults() as inj:
+            inj.inject("kernels.device.unit_dispatch", "dispatch",
+                       times=1000)
+            scan = ShardedScan(corpus, on_error="quarantine",
+                               retries=1)
+            results = list(scan.run_iter())
+        spans = trace.snapshot_spans()
+        assert len(results) == len(scan.units)  # degraded, not lost
+        assert_connected(spans)
+        names = [s["name"] for s in spans]
+        assert "dispatch_retry" in names
+        assert "degraded_to_host" in names
+        # the degradation markers parent under their unit spans
+        unit_ids = {s["span"] for s in spans if s["name"] == "unit"}
+        for s in spans:
+            if s["name"] in ("dispatch_retry", "degraded_to_host"):
+                assert s["parent"] in unit_ids
+
+    def test_hedge_losers_become_cancelled_children(self):
+        from tpuparquet.deadline import hedged_call
+
+        def slow():
+            time.sleep(0.25)
+            return "slow"
+
+        def fast():
+            return "fast"
+
+        with trace.trace_scope("t") as root:
+            out = hedged_call([slow, fast], delay=0.01,
+                              site="io.reader.chunk_read", file="f",
+                              column="a")
+        assert out == "fast"
+        spans = trace.snapshot_spans()
+        assert_connected(spans)
+        branches = {s["replica"]: s for s in spans
+                    if s["name"] == "read_replica"}
+        assert branches[1]["status"] == "ok"
+        assert branches[0]["status"] == "cancelled"
+        assert root is not None
+        root_id = next(s["span"] for s in spans
+                       if s["name"] == "scan")
+        assert all(b["parent"] == root_id
+                   for b in branches.values())
+
+    def test_deadline_expiry_span(self, corpus):
+        with inject_faults() as inj:
+            inj.inject("io.chunk.hang", "hang", times=1,
+                       seconds=30.0)
+            scan = ShardedScan(corpus, on_error="quarantine",
+                               unit_deadline=0.3, retries=0)
+            results = list(scan.run_iter())
+        spans = trace.snapshot_spans()
+        assert len(results) == len(scan.units) - 1
+        assert_connected(spans)
+        exp = [s for s in spans if s["name"] == "deadline_exceeded"]
+        assert exp and exp[0]["status"] == "error"
+
+    def test_salvage_scan_traced(self, corpus, tmp_path):
+        torn = tmp_path / "torn.parquet"
+        data = open(corpus[0], "rb").read()
+        torn.write_bytes(data[: len(data) - 7])   # tear the footer
+        scan = ShardedScan([str(torn), corpus[1]],
+                           on_error="quarantine", salvage=True)
+        results = list(scan.run_iter())
+        spans = trace.snapshot_spans()
+        assert len(results) >= 5       # salvaged prefix + healthy file
+        assert_connected(spans)
+
+    def test_cursor_resume_yields_two_connected_traces(self, corpus):
+        scan = ShardedScan(corpus)
+        it = scan.run_iter()
+        for _ in range(3):
+            next(it)
+        it.close()
+        resumed = ShardedScan(corpus, resume=scan.state())
+        rest = list(resumed.run_iter())
+        assert len(rest) == len(resumed.units) - 3
+        spans = trace.snapshot_spans()
+        assert_connected(spans)
+        roots = [s for s in spans if s["name"] == "scan"]
+        assert len(roots) == 2
+        assert {r["status"] for r in roots} == {"cancelled", "ok"}
+        resumed_root = next(r for r in roots if r["status"] == "ok")
+        assert resumed_root["resumed_at"] == 3
+
+    def test_multihost_scan_merge(self, corpus):
+        scan = MultiHostScan(corpus)
+        results = list(scan.run_iter())
+        assert results
+        merged = allgather_traces()
+        assert merged
+        assert all(s["proc"] == 0 for s in merged)
+        assert_connected(merged)
+        assert any(s["name"] == "scan" for s in merged)
+
+
+# ----------------------------------------------------------------------
+# Attribution ledgers
+# ----------------------------------------------------------------------
+
+class TestAttribution:
+    def test_conservation_vs_registry(self, corpus, tmp_path):
+        # two scans under distinct labels, ambient-metered
+        ShardedScan(corpus, progress_label="tenant-a").run()
+        extra = [write_file(tmp_path / "g.parquet", seed=7)]
+        ShardedScan(extra, progress_label="tenant-b").run()
+        leds = attribution.ledgers_snapshot()
+        assert set(leds) == {"tenant-a", "tenant-b"}
+        total: dict = {}
+        for led in leds.values():
+            for k, v in led["counters"].items():
+                total[k] = total.get(k, 0) + v
+        reg = live.registry().snapshot()["counters"]
+        for k in set(total) | set(reg):
+            assert total.get(k, 0) == pytest.approx(
+                reg.get(k, 0)), f"counter {k} not conserved"
+        assert leds["tenant-a"]["pages"] > 0
+        assert leds["tenant-a"]["bytes"]["read"] > 0
+
+    def test_user_collector_still_attributed(self, corpus):
+        with collect_stats() as st:
+            ShardedScan(corpus, progress_label="u").run()
+        led = attribution.ledgers_snapshot()["u"]
+        assert led["counters"]["pages"] == st.pages
+        assert led["counters"]["values"] == st.values
+        # the cpu_s view is disjoint: read rides inside the plan
+        # timing window, so the plan bucket is plan_s - read_s
+        assert led["cpu_s"]["plan"] == pytest.approx(
+            max(st.plan_s - st.read_s, 0.0), abs=1e-5)
+        assert led["cpu_s"]["read"] == pytest.approx(st.read_s,
+                                                     abs=1e-5)
+
+    def test_peak_arena_tracked(self, corpus):
+        ShardedScan(corpus, progress_label="arena").run()
+        led = attribution.ledgers_snapshot()["arena"]
+        assert led["peak_arena_bytes"] > 0
+
+    def test_ledger_state_merge_exact(self):
+        a = attribution.ScanLedger("x")
+        a.fold_delta({"pages": 3, "plan_s": 0.5})
+        a.note_peak(100)
+        a.scans = 1
+        b = attribution.ScanLedger("x")
+        b.fold_delta({"pages": 4, "read_s": 0.25})
+        b.note_peak(70)
+        b.scans = 2
+        merged = attribution.merge_ledger_states(
+            [{"x": a.to_state()}, {"x": b.to_state()}])["x"]
+        assert merged.counters == {"pages": 7, "plan_s": 0.5,
+                                   "read_s": 0.25}
+        assert merged.peak_arena_bytes == 100   # max, not sum
+        assert merged.scans == 3
+
+    def test_allgather_ledgers_single_process(self, corpus):
+        ShardedScan(corpus, progress_label="fleet").run()
+        local = attribution.ledgers_snapshot()["fleet"]
+        fleet = allgather_ledgers()["fleet"]
+        assert fleet.counters == local["counters"]
+
+    def test_gather_metered_into_ledger(self, corpus):
+        scan = ShardedScan(corpus, progress_label="g")
+        results = [o for _k, o in scan.run_iter()]
+        scan.gather_column(results, "a")
+        led = attribution.ledgers_snapshot()["g"]
+        assert led["counters"]["gather_bytes_moved"] > 0
+        assert led["cpu_s"]["gather"] > 0
+
+    def test_progress_frame_carries_attribution(self, corpus,
+                                                tmp_path):
+        status = tmp_path / "st.json"
+        scan = ShardedScan(corpus, progress_export=str(status))
+        scan.run()
+        frame = json.loads(status.read_text())
+        attr = frame["attribution"]
+        assert attr["cpu_s"]["plan"] > 0
+        assert attr["bytes"]["read"] > 0
+
+
+# ----------------------------------------------------------------------
+# The doctor: golden critical path + CLI
+# ----------------------------------------------------------------------
+
+def synthetic_trace():
+    """A hand-built trace with a KNOWN critical path: 5 units; plan
+    dominates units 0-3, unit 4 is a read-bound straggler (3.0s vs
+    ~1.0s siblings); one trailing gather.  Wall 10s."""
+    spans = [{"trace": "t-1", "span": 1, "parent": None,
+              "name": "scan", "t0": 0.0, "dur": 10.0, "tid": 1,
+              "status": "ok", "label": "golden", "usable_cpus": 1}]
+    sid = 2
+    t = 0.5
+    for u in range(4):
+        unit = {"trace": "t-1", "span": sid, "parent": 1,
+                "name": "unit", "t0": t, "dur": 1.0, "tid": 1,
+                "status": "ok", "unit": u, "file": 0, "row_group": u}
+        spans.append(unit)
+        # read 0.1, plan 0.7 (contains the read? no — sequential),
+        # transfer 0.1, dispatch 0.1
+        spans.append({"trace": "t-1", "span": sid + 1, "parent": sid,
+                      "name": "read", "t0": t, "dur": 0.1, "tid": 1,
+                      "status": "ok", "column": "a"})
+        spans.append({"trace": "t-1", "span": sid + 2, "parent": sid,
+                      "name": "plan", "t0": t + 0.1, "dur": 0.7,
+                      "tid": 1, "status": "ok", "column": "a"})
+        spans.append({"trace": "t-1", "span": sid + 3, "parent": sid,
+                      "name": "transfer", "t0": t + 0.8, "dur": 0.1,
+                      "tid": 1, "status": "ok"})
+        spans.append({"trace": "t-1", "span": sid + 4, "parent": sid,
+                      "name": "dispatch", "t0": t + 0.9, "dur": 0.1,
+                      "tid": 1, "status": "ok"})
+        sid += 5
+        t += 1.0
+    # straggler unit: 3.0s, 2.8 of it one slow read
+    spans.append({"trace": "t-1", "span": sid, "parent": 1,
+                  "name": "unit", "t0": t, "dur": 3.0, "tid": 1,
+                  "status": "ok", "unit": 4, "file": 0,
+                  "row_group": 4})
+    spans.append({"trace": "t-1", "span": sid + 1, "parent": sid,
+                  "name": "read", "t0": t, "dur": 2.8, "tid": 1,
+                  "status": "ok", "column": "b"})
+    spans.append({"trace": "t-1", "span": sid + 2, "parent": sid,
+                  "name": "plan", "t0": t + 2.8, "dur": 0.2,
+                  "tid": 1, "status": "ok", "column": "b"})
+    sid += 3
+    spans.append({"trace": "t-1", "span": sid, "parent": 1,
+                  "name": "gather", "t0": 8.2, "dur": 0.8, "tid": 1,
+                  "status": "ok"})
+    return spans
+
+
+class TestDoctor:
+    def test_golden_critical_path(self):
+        d = attribution.diagnose(synthetic_trace())
+        assert d["wall_s"] == pytest.approx(10.0)
+        assert d["units"] == 5
+        # exact exclusive-time stage totals
+        assert d["stages_s"]["plan"] == pytest.approx(3.0)
+        assert d["stages_s"]["read"] == pytest.approx(3.2)
+        assert d["stages_s"]["transfer"] == pytest.approx(0.4)
+        assert d["stages_s"]["dispatch"] == pytest.approx(0.4)
+        assert d["stages_s"]["gather"] == pytest.approx(0.8)
+        # read (3.2s) beats plan (3.0s): the straggler flipped the
+        # verdict — exactly what a critical-path walk must surface
+        assert d["verdict"] == "read-bound"
+        assert d["bound_stage"] == "read"
+        # per-unit bounds: 4 plan-bound, 1 read-bound
+        bounds = [u["bound"] for u in d["unit_rows"]]
+        assert bounds.count("plan") == 4
+        assert bounds.count("read") == 1
+        # the straggler is ranked with its offending coordinates
+        assert d["stragglers"]
+        s = d["stragglers"][0]
+        assert s["unit"] == 4
+        assert s["bound"] == "read"
+        assert s["top_child"]["name"] == "read"
+        assert s["top_child"]["column"] == "b"
+
+    def test_golden_unit_decomposition_exact(self):
+        rows = attribution.unit_reports(synthetic_trace())
+        for r in rows:
+            assert sum(r["stages_s"].values()) \
+                == pytest.approx(r["dur_s"])
+        # unit 0: driver gap = 1.0 - (0.1+0.7+0.1+0.1) = 0
+        assert rows[0]["stages_s"].get("driver", 0.0) \
+            == pytest.approx(0.0)
+
+    def test_cancelled_spans_do_not_tilt_verdict(self):
+        # a hedge loser's long cancelled branch is abandoned duplicate
+        # work: it must land in the "cancelled" bucket, never crown a
+        # read-bound verdict over the stage that actually ran
+        spans = [
+            {"trace": "t-3", "span": 1, "parent": None,
+             "name": "scan", "t0": 0.0, "dur": 2.0, "tid": 1,
+             "status": "ok"},
+            {"trace": "t-3", "span": 2, "parent": 1, "name": "unit",
+             "t0": 0.0, "dur": 2.0, "tid": 1, "status": "ok",
+             "unit": 0},
+            {"trace": "t-3", "span": 3, "parent": 2, "name": "plan",
+             "t0": 0.0, "dur": 0.5, "tid": 1, "status": "ok"},
+            {"trace": "t-3", "span": 4, "parent": 2,
+             "name": "read_replica", "t0": 0.5, "dur": 1.5,
+             "tid": 2, "status": "cancelled", "replica": 0},
+        ]
+        d = attribution.diagnose(spans)
+        assert d["verdict"] == "plan-bound"
+        assert d["stages_s"]["cancelled"] == pytest.approx(1.5)
+        assert "cancelled" not in d["stage_share"]
+
+    def test_stage_share_normalized_over_timed_work(self):
+        # parallel stage seconds sum past the wall; shares normalize
+        # over the timed total so they stay <= 1 and sum to 1
+        d = attribution.diagnose(synthetic_trace())
+        assert sum(d["stage_share"].values()) == pytest.approx(
+            1.0, abs=0.01)
+        assert all(0.0 <= v <= 1.0 for v in d["stage_share"].values())
+
+    def test_oversubscription_note(self):
+        # 4 plan spans on 4 threads over a 1s window, 1 usable core:
+        # concurrency 4 >> 1 — the PLAN_SCALE_r06 signature
+        spans = [{"trace": "t-2", "span": 1, "parent": None,
+                  "name": "scan", "t0": 0.0, "dur": 1.0, "tid": 1,
+                  "status": "ok", "usable_cpus": 1}]
+        for i in range(4):
+            spans.append({"trace": "t-2", "span": 2 + i, "parent": 1,
+                          "name": "plan", "t0": 0.0, "dur": 1.0,
+                          "tid": 10 + i, "status": "ok"})
+        d = attribution.diagnose(spans)
+        pp = d["plan_pool"]
+        assert pp["threads"] == 4
+        assert pp["concurrency"] == pytest.approx(4.0)
+        assert pp["oversubscribed"] is True
+        assert d["verdict"] == "plan-bound"
+        txt = attribution.format_diagnosis(d)
+        assert "OVERSUBSCRIBED" in txt
+        assert "TPQ_PLAN_THREADS" in txt
+
+    def test_doctor_cli_on_synthetic(self, tmp_path, capsys):
+        from tpuparquet.cli.parquet_tool import main
+
+        path = tmp_path / "trace.json"
+        write_trace_file(synthetic_trace(), str(path))
+        assert main(["doctor", str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "read-bound" in out
+        assert "STRAGGLER unit 4" in out
+
+    def test_doctor_cli_json(self, tmp_path, capsys):
+        from tpuparquet.cli.parquet_tool import main
+
+        path = tmp_path / "trace.json"
+        write_trace_file(synthetic_trace(), str(path),
+                         ledgers={"golden": {"cpu_s": {}}})
+        assert main(["doctor", "--json", str(path)]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["reports"][0]["verdict"] == "read-bound"
+        assert "golden" in doc["ledgers"]
+
+    def test_doctor_on_real_scan_export(self, corpus, tmp_path,
+                                        monkeypatch, capsys):
+        from tpuparquet.cli.parquet_tool import main
+
+        path = tmp_path / "scan.trace.json"
+        monkeypatch.setenv("TPQ_TRACE_EXPORT", str(path))
+        ShardedScan(corpus).run()
+        assert path.exists()
+        assert main(["doctor", str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "verdict:" in out
+        assert "-bound" in out
+        assert "ledger[scan]" in out
+
+    def test_doctor_missing_spans(self, tmp_path, capsys):
+        from tpuparquet.cli.parquet_tool import main
+
+        path = tmp_path / "empty.json"
+        write_trace_file([], str(path))
+        assert main(["doctor", str(path)]) == 1
+
+
+# ----------------------------------------------------------------------
+# Exports
+# ----------------------------------------------------------------------
+
+class TestExports:
+    def test_chrome_trace_shape_and_roundtrip(self, tmp_path):
+        spans = synthetic_trace()
+        obj = spans_chrome_trace(spans)
+        assert len(obj["traceEvents"]) == len(spans)
+        assert all(e["ph"] == "X" for e in obj["traceEvents"])
+        path = tmp_path / "t.perfetto.json"
+        write_trace_file(spans, str(path))
+        loaded, _ = load_trace_file(str(path))
+        d = attribution.diagnose(loaded)
+        assert d["verdict"] == "read-bound"
+
+    def test_otlp_shape(self):
+        spans = synthetic_trace()
+        obj = spans_otlp(spans, anchor={"wall": 1000.0, "perf": 0.0})
+        recs = obj["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        assert len(recs) == len(spans)
+        root = next(r for r in recs if "parentSpanId" not in r)
+        assert root["name"] == "scan"
+        assert len(root["traceId"]) == 32
+        assert len(root["spanId"]) == 16
+        assert int(root["startTimeUnixNano"]) == int(1000.0 * 1e9)
+        child = next(r for r in recs if r.get("parentSpanId"))
+        assert len(child["parentSpanId"]) == 16
+
+    def test_tpq_trace_envelope_roundtrip(self, tmp_path):
+        spans = synthetic_trace()
+        path = tmp_path / "t.json"
+        assert write_trace_file(
+            spans, str(path), ledgers={"l": {"pages": 1}},
+            anchor={"wall": 1.0, "perf": 0.0})
+        loaded, ledgers = load_trace_file(str(path))
+        assert loaded == sorted(spans, key=lambda s: json.dumps(
+            s, sort_keys=True)) or len(loaded) == len(spans)
+        assert ledgers == {"l": {"pages": 1}}
+
+    def test_load_rejects_junk(self, tmp_path):
+        p = tmp_path / "junk.json"
+        p.write_text("{\"nope\": 1}")
+        with pytest.raises(ValueError):
+            load_trace_file(str(p))
+        p2 = tmp_path / "torn.json"
+        p2.write_text("{not json")
+        with pytest.raises(ValueError):
+            load_trace_file(str(p2))
+
+    def test_export_per_label_suffix(self, corpus, tmp_path,
+                                     monkeypatch):
+        base = tmp_path / "tr.json"
+        monkeypatch.setenv("TPQ_TRACE_EXPORT", str(base))
+        ShardedScan(corpus, progress_label="tenant-a").run()
+        assert (tmp_path / "tr.json.tenant_a").exists()
+
+
+# ----------------------------------------------------------------------
+# Profile surface agreement
+# ----------------------------------------------------------------------
+
+class TestProfileAgreement:
+    def test_profile_json_has_attribution_and_trace(self, corpus,
+                                                    capsys):
+        from tpuparquet.cli.parquet_tool import main
+
+        assert main(["profile", "--json", corpus[0]]) == 0
+        rep = json.loads(capsys.readouterr().out)
+        attr = rep["attribution"]
+        # the same numbers as the counters, via obs.stage_seconds
+        # (disjoint buckets: plan excludes the read time inside it)
+        assert attr["cpu_s"]["plan"] == pytest.approx(
+            max(rep["counters"]["plan_s"]
+                - rep["counters"]["read_s"], 0.0), abs=1e-5)
+        assert attr["cpu_s"]["read"] == pytest.approx(
+            rep["counters"]["read_s"], abs=1e-5)
+        assert attr["bytes"]["read"] == rep["counters"]["bytes_read"]
+        assert rep["trace"]["verdict"].endswith("-bound")
+        assert rep["trace"]["units"] >= 1
+
+    def test_top_renders_attribution(self, corpus, tmp_path, capsys):
+        from tpuparquet.cli.parquet_tool import main
+
+        status = tmp_path / "st.json"
+        ShardedScan(corpus, progress_export=str(status)).run()
+        assert main(["top", "--once", str(status)]) == 0
+        out = capsys.readouterr().out
+        assert "cpu:" in out
+        assert "plan" in out

@@ -1,25 +1,34 @@
-"""Recorder-guard pass: hot flight-recorder sites skip kwargs when off.
+"""Recorder-guard pass: hot telemetry sites skip kwargs when off.
 
-``obs.recorder.flight`` is internally a no-op when the recorder is
-disabled — but the *call site* still evaluates and boxes its keyword
+``obs.recorder.flight`` — and its causal-tracing sibling
+``obs.trace.emit_span`` — are internally no-ops when their layer is
+disabled, but the *call site* still evaluates and boxes its keyword
 arguments first.  On per-page/per-chunk paths that cost is real, so
-the repo's discipline (``obs/recorder.py`` docstring) is to guard the
-call itself::
+the repo's discipline (``obs/recorder.py`` and ``obs/trace.py``
+docstrings) is to guard the call itself::
 
     if _flightrec._active is not None:
         _flightrec.flight("page", site=..., file=..., page=...)
 
-This pass enforces the pattern structurally:
+    if _trace._active is not None:
+        _trace.emit_span("read", t0, dt, file=..., column=...)
 
-* every *module-qualified* call (``<alias>.flight(...)`` — the form
+This pass enforces the pattern structurally, for BOTH vocabularies:
+
+* every *module-qualified* call (``<alias>.flight(...)`` /
+  ``<alias>.emit_span(...)`` / ``<alias>.open_span(...)`` — the form
   hot sites use precisely so they can reach ``_active``) must sit
   under an ``if`` whose test checks ``_active is not None`` (or
-  ``recorder() is not None``);
-* every *bare* ``flight(...)`` call that lives inside a ``for``/
-  ``while`` loop is treated as hot and held to the same rule — unless
-  it is on an exceptional path (inside an ``except`` handler), which
-  is the cold-site idiom (faults, quarantines, retries fire rarely
-  and keep the plain call).
+  ``recorder()``/``tracer()`` is not None);
+* every *bare* ``flight(...)``/``emit_span(...)`` call that lives
+  inside a ``for``/``while`` loop is treated as hot and held to the
+  same rule — unless it is on an exceptional path (inside an
+  ``except`` handler), which is the cold-site idiom (faults,
+  quarantines, retries fire rarely and keep the plain call).
+
+``close_span``/``adopt``/``ctx_of`` are exempt: they take an
+already-built handle (None when off) and build no kwargs — guarding
+them would only duplicate the open-site guard.
 """
 
 from __future__ import annotations
@@ -30,7 +39,11 @@ from .astutil import Finding, RepoTree, ancestors, enclosing_function
 
 PASS = "recorder-guard"
 
-EXCLUDE = ("tpuparquet/obs/recorder.py",)
+EXCLUDE = ("tpuparquet/obs/recorder.py", "tpuparquet/obs/trace.py")
+
+#: call names held to the guarded-hot-site rule (the kwargs-building
+#: emit surfaces of the flight recorder and the causal tracer)
+HOT_NAMES = ("flight", "emit_span", "open_span")
 
 
 def _is_guard_test(test: ast.AST) -> bool:
@@ -44,7 +57,7 @@ def _is_guard_test(test: ast.AST) -> bool:
             f = node.func
             name = f.attr if isinstance(f, ast.Attribute) \
                 else f.id if isinstance(f, ast.Name) else None
-            if name == "recorder":
+            if name in ("recorder", "tracer"):
                 return True
     return False
 
@@ -58,6 +71,12 @@ def _context(node, fn):
         if a is fn:
             break
         if isinstance(a, ast.If) and prev in a.body \
+                and _is_guard_test(a.test):
+            guarded = True
+        # the expression form of the same idiom:
+        #   h = _trace.open_span(...) if _trace._active is not None \
+        #       else None
+        if isinstance(a, ast.IfExp) and prev is a.body \
                 and _is_guard_test(a.test):
             guarded = True
         if isinstance(a, (ast.For, ast.While)):
@@ -78,8 +97,8 @@ def run(tree: RepoTree) -> list[Finding]:
                 continue
             f = node.func
             qualified = isinstance(f, ast.Attribute) and \
-                f.attr == "flight"
-            bare = isinstance(f, ast.Name) and f.id == "flight"
+                f.attr in HOT_NAMES
+            bare = isinstance(f, ast.Name) and f.id in HOT_NAMES
             if not (qualified or bare):
                 continue
             fn = enclosing_function(node)
@@ -87,6 +106,7 @@ def run(tree: RepoTree) -> list[Finding]:
             if guarded:
                 continue
             fname = fn.name if fn is not None else "<module>"
+            called = f.attr if qualified else f.id
             kind = ""
             if node.args and isinstance(node.args[0], ast.Constant):
                 kind = str(node.args[0].value)
@@ -95,17 +115,17 @@ def run(tree: RepoTree) -> list[Finding]:
                 findings.append(Finding(
                     PASS, path, node.lineno, "unguarded-hot-flight",
                     key,
-                    f"module-qualified flight() call in {fname}() "
+                    f"module-qualified {called}() call in {fname}() "
                     f"without the `_active is not None` guard — the "
                     f"qualified form exists exactly so hot sites can "
-                    f"skip kwargs construction when the recorder is "
-                    f"off"))
+                    f"skip kwargs construction when the "
+                    f"recorder/tracer is off"))
             elif in_loop and not in_except:
                 findings.append(Finding(
                     PASS, path, node.lineno, "unguarded-hot-flight",
                     key,
-                    f"flight() call inside a loop in {fname}() "
-                    f"constructs kwargs even with the recorder "
+                    f"{called}() call inside a loop in {fname}() "
+                    f"constructs kwargs even with the recorder/tracer "
                     f"disabled — guard with `_active is not None` "
                     f"(hot) or move to an exceptional path (cold)"))
     return findings

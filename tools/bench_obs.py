@@ -9,7 +9,14 @@ Decodes the 50M-value taxi shape (``bench.build_config2``) through a
                    would run).
 * ``always_on``  — the DEFAULT shipping configuration: flight
                    recorder armed, live metrics folding at unit
-                   boundaries, still no user collector.
+                   boundaries, causal tracing compiled in but OFF
+                   (``TPQ_TRACE`` unset), still no user collector.
+                   Its delta vs ``off`` staying at the r07-recorded
+                   noise level is the proof that the round-16 trace
+                   hot-site guards cost nothing when disabled.
+* ``trace_on``   — ``always_on`` plus the causal tracer ARMED
+                   (``TPQ_TRACE=1``, sample 1.0): what a diagnosis
+                   session pays.
 * ``collected``  — a full ``collect_stats(events=True)`` scope on top
                    (the post-hoc regime's known cost, for scale).
 
@@ -50,11 +57,13 @@ def _decode_once(buf):
 
 
 def _run_leg(buf, name: str, reps: int) -> dict:
-    from tpuparquet.obs import live, recorder
+    from tpuparquet.obs import live, recorder, trace
+
     from tpuparquet.stats import collect_stats
 
     walls = []
     for _ in range(reps):
+        trace.set_tracing(False)
         if name == "off":
             recorder.set_ring(0)
             os.environ["TPQ_LIVE_METRICS"] = "0"
@@ -62,6 +71,14 @@ def _run_leg(buf, name: str, reps: int) -> dict:
         elif name == "always_on":
             recorder.set_ring(recorder.ring_default() or 256)
             os.environ["TPQ_LIVE_METRICS"] = "1"
+            ctx = None
+        elif name == "trace_on":
+            # the round-16 causal tracer ARMED on top of the shipping
+            # default: spans per unit/stage/chunk, whole-trace
+            # sampling at 1.0 — the worst case the TPQ_TRACE knob buys
+            recorder.set_ring(recorder.ring_default() or 256)
+            os.environ["TPQ_LIVE_METRICS"] = "1"
+            trace.set_tracing(True)
             ctx = None
         else:  # collected
             recorder.set_ring(recorder.ring_default() or 256)
@@ -114,12 +131,12 @@ def main(argv=None) -> int:
     _decode_once(buf)
 
     legs = [_run_leg(buf, name, args.reps)
-            for name in ("off", "always_on", "collected")]
+            for name in ("off", "always_on", "trace_on", "collected")]
     by = {leg["leg"]: leg for leg in legs}
     base = by["off"]["wall_s_min"]
     overhead = {
         name: round((by[name]["wall_s_min"] / base - 1.0) * 100, 2)
-        for name in ("always_on", "collected")
+        for name in ("always_on", "trace_on", "collected")
     }
     report = {
         "bench": "obs_overhead",

@@ -1,0 +1,301 @@
+#!/usr/bin/env python
+"""Perf regression sentinel: noise-aware micro-runs vs a recorded
+baseline, so a perf regression fails CI instead of surfacing three
+rounds later in a BENCH_* re-run.
+
+The repo's perf story is recorded in the committed
+``BENCH_*``/``WRITE_*``/``PRUNE_*``/``SCAN_SCALE_*``/``PLAN_SCALE_*``
+JSONs — but those are expensive 50M-row runs nobody re-executes per
+commit.  This sentinel keeps four MICRO legs (seconds each, in-memory
+corpora) that cover the same walls:
+
+* ``scan``  — e2e ``ShardedScan`` over a taxi-shaped corpus
+              (the BENCH_/SCAN_SCALE_ wall);
+* ``plan``  — the serial plan phase, ``TPQ_PLAN_THREADS=1``
+              (the PLAN_SCALE_ wall);
+* ``write`` — ``FileWriter`` int64+double flush
+              (the WRITE_ wall, native pipeline on);
+* ``prune`` — filtered-scan speedup at ~1% selectivity
+              (the PRUNE_ ratio; higher is better).
+
+``--record`` measures each leg ``--reps`` times and commits
+median + MAD (median absolute deviation — the noise floor) to
+``SENTINEL_BASELINE.json``.  ``--check`` re-measures and fails a leg
+only when the fresh median is outside BOTH a relative tolerance and a
+``k × (baseline MAD + fresh MAD)`` noise envelope — a slow rep or a
+noisy box doesn't fail the gate, a real regression does.  The check
+also cross-pins shape invariants against the recorded full-scale
+baselines (today: ``PRUNE_r01.json`` showed ≥ 5x at 1% selectivity,
+so the micro prune leg must stay ≥ its floor) — those are
+box-independent ratios, valid even where absolute walls are not.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/bench_sentinel.py --record
+    JAX_PLATFORMS=cpu python tools/bench_sentinel.py --check
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+BASELINE_FILE = os.path.join(os.path.dirname(__file__), "..",
+                             "SENTINEL_BASELINE.json")
+
+#: relative tolerance per leg (micro benches on shared CI boxes are
+#: noisy; the MAD envelope handles the rest)
+DEFAULT_TOL = 0.35
+#: noise multiplier: fresh must exceed base by > K*(mad_b + mad_f)
+DEFAULT_K = 6.0
+#: box-independent floors derived from the recorded full-scale runs
+PRUNE_MICRO_FLOOR = 2.0
+
+N_ROWS = 200_000
+RG_ROWS = 25_000
+
+
+def _corpus_buf():
+    """A taxi-shaped two-column corpus in memory (sorted int64 key +
+    float64 value — the config-2 shape the BENCH ladder records)."""
+    import numpy as np
+
+    from tpuparquet import FileWriter
+
+    buf = io.BytesIO()
+    w = FileWriter(
+        buf,
+        "message t { required int64 ts; required double fare; }")
+    ts = np.arange(N_ROWS, dtype=np.int64) * 7
+    fare = (ts % 977).astype("float64") * 0.25
+    for a in range(0, N_ROWS, RG_ROWS):
+        w.write_columns({"ts": ts[a:a + RG_ROWS],
+                         "fare": fare[a:a + RG_ROWS]})
+    w.close()
+    return buf
+
+
+def leg_scan(buf) -> float:
+    from tpuparquet.shard.scan import ShardedScan
+
+    buf.seek(0)
+    t0 = time.perf_counter()
+    for _k, cols in ShardedScan([buf]).run_iter():
+        for c in cols.values():
+            c.block_until_ready()
+    return time.perf_counter() - t0
+
+
+def leg_plan(buf) -> float:
+    from tpuparquet.stats import collect_stats
+    from tpuparquet.shard.scan import ShardedScan
+
+    os.environ["TPQ_PLAN_THREADS"] = "1"
+    try:
+        buf.seek(0)
+        with collect_stats() as st:
+            for _k, cols in ShardedScan([buf]).run_iter():
+                for c in cols.values():
+                    c.block_until_ready()
+        return st.plan_s
+    finally:
+        os.environ.pop("TPQ_PLAN_THREADS", None)
+
+
+def leg_write(_buf) -> float:
+    import numpy as np
+
+    from tpuparquet import FileWriter
+
+    ts = np.arange(N_ROWS, dtype=np.int64) * 7
+    fare = (ts % 977).astype("float64") * 0.25
+    out = io.BytesIO()
+    t0 = time.perf_counter()
+    w = FileWriter(
+        out,
+        "message t { required int64 ts; required double fare; }")
+    for a in range(0, N_ROWS, RG_ROWS):
+        w.write_columns({"ts": ts[a:a + RG_ROWS],
+                         "fare": fare[a:a + RG_ROWS]})
+    w.close()
+    return time.perf_counter() - t0
+
+
+def leg_prune(buf) -> float:
+    """Filtered/unfiltered e2e ratio at ~1% selectivity (HIGHER is
+    better — stored as a speedup so the comparator can share the
+    lower-is-worse logic by inverting)."""
+    from tpuparquet.filter import col
+    from tpuparquet.shard.scan import ShardedScan
+
+    hi = int(N_ROWS * 7 * 0.01)
+
+    def run(filt):
+        buf.seek(0)
+        t0 = time.perf_counter()
+        for _k, cols in ShardedScan([buf], filter=filt).run_iter():
+            for c in cols.values():
+                c.block_until_ready()
+        return time.perf_counter() - t0
+
+    full = run(None)
+    filtered = run(col("ts") < hi)
+    return full / max(filtered, 1e-9)
+
+
+LEGS = {
+    "scan": (leg_scan, "lower"),
+    "plan": (leg_plan, "lower"),
+    "write": (leg_write, "lower"),
+    "prune": (leg_prune, "higher"),
+}
+
+
+def measure(reps: int, legs=None) -> dict:
+    buf = _corpus_buf()
+    # warmup: jit compilation must not land in any rep
+    leg_scan(buf)
+    out = {}
+    for name, (fn, direction) in LEGS.items():
+        if legs and name not in legs:
+            continue
+        samples = [fn(buf) for _ in range(reps)]
+        med = statistics.median(samples)
+        mad = statistics.median([abs(s - med) for s in samples])
+        out[name] = {
+            "median": round(med, 5),
+            "mad": round(mad, 5),
+            "direction": direction,
+            "samples": [round(s, 5) for s in samples],
+        }
+    return out
+
+
+def _usable_cpus() -> int:
+    try:
+        return len(os.sched_getaffinity(0)) or 1
+    except (AttributeError, OSError):
+        return os.cpu_count() or 1
+
+
+def record(path: str, reps: int) -> int:
+    doc = {
+        "format": "tpq-sentinel-baseline",
+        "version": 1,
+        "rows": N_ROWS,
+        "reps": reps,
+        "usable_cpus": _usable_cpus(),
+        "python": sys.version.split()[0],
+        "legs": measure(reps),
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"recorded baseline -> {path}")
+    print(json.dumps(doc["legs"], indent=1, sort_keys=True))
+    return 0
+
+
+def check(path: str, reps: int, tol: float, k: float) -> int:
+    if not os.path.exists(path):
+        print(f"bench_sentinel: no baseline at {path} — run "
+              f"--record first (skipping check, not failing: a "
+              f"missing baseline is a setup gap, not a regression)",
+              file=sys.stderr)
+        return 0
+    with open(path) as f:
+        base = json.load(f)
+    if base.get("format") != "tpq-sentinel-baseline":
+        print(f"bench_sentinel: {path} is not a sentinel baseline",
+              file=sys.stderr)
+        return 2
+    if base.get("usable_cpus") != _usable_cpus():
+        # absolute walls do not transfer across core counts; the
+        # box-independent ratio pins below still apply
+        print(f"bench_sentinel: baseline recorded on "
+              f"{base.get('usable_cpus')} usable cpu(s), this box has "
+              f"{_usable_cpus()} — absolute-wall legs skipped, ratio "
+              f"pins still enforced", file=sys.stderr)
+        fresh = measure(reps, legs=["prune"])
+    else:
+        fresh = measure(reps)
+
+    failures = []
+    report = {}
+    for name, f_leg in fresh.items():
+        b_leg = base["legs"].get(name)
+        if b_leg is None:
+            continue
+        b_med, f_med = b_leg["median"], f_leg["median"]
+        noise = k * (b_leg["mad"] + f_leg["mad"])
+        if f_leg["direction"] == "lower":
+            # worse = slower: outside BOTH the relative tolerance and
+            # the noise envelope
+            limit = b_med + max(tol * b_med, noise)
+            regressed = f_med > limit
+        else:
+            limit = b_med - max(tol * b_med, noise)
+            regressed = f_med < limit
+        report[name] = {"baseline": b_med, "fresh": f_med,
+                        "limit": round(limit, 5),
+                        "noise_envelope": round(noise, 5),
+                        "regressed": regressed}
+        if regressed:
+            failures.append(
+                f"{name}: fresh median {f_med} vs baseline {b_med} "
+                f"(limit {round(limit, 5)}, direction "
+                f"{f_leg['direction']})")
+    # box-independent ratio pin from the recorded full-scale runs
+    if "prune" in fresh:
+        spd = fresh["prune"]["median"]
+        report["prune_floor"] = {"floor": PRUNE_MICRO_FLOOR,
+                                 "fresh": spd,
+                                 "regressed": spd < PRUNE_MICRO_FLOOR}
+        if spd < PRUNE_MICRO_FLOOR:
+            failures.append(
+                f"prune: 1%-selectivity speedup {spd:.2f}x fell "
+                f"below the {PRUNE_MICRO_FLOOR}x floor (PRUNE_r01 "
+                f"recorded >=5x at full scale — pruning has stopped "
+                f"firing)")
+    print(json.dumps({"bench": "sentinel_check", "report": report},
+                     indent=1, sort_keys=True))
+    if failures:
+        print("bench_sentinel: PERF REGRESSION\n  "
+              + "\n  ".join(failures), file=sys.stderr)
+        return 1
+    print("bench_sentinel: within noise of baseline")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    mode = ap.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--record", action="store_true",
+                      help="measure and write the baseline file")
+    mode.add_argument("--check", action="store_true",
+                      help="measure and compare against the baseline")
+    ap.add_argument("--baseline", default=BASELINE_FILE)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--tol", type=float, default=DEFAULT_TOL,
+                    help="relative regression tolerance per leg")
+    ap.add_argument("--noise-k", type=float, default=DEFAULT_K,
+                    help="MAD multiplier for the noise envelope")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # host-side walls only
+    if args.record:
+        return record(args.baseline, args.reps)
+    return check(args.baseline, args.reps, args.tol, args.noise_k)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

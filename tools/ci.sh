@@ -1,7 +1,7 @@
 #!/bin/bash
 # In-repo CI gate (counterpart of the reference's .circleci/config.yml,
 # which pins go versions and runs `go test ./...` + the compatibility
-# corpus per commit).  Eleven stages, pinned env:
+# corpus per commit).  Twelve stages, pinned env:
 #
 #   1. tier-1 suite   — the ROADMAP.md verify command, gated on a PASS
 #                       FLOOR rather than rc: optional deps (zstandard,
@@ -71,6 +71,13 @@
 #                       same suite re-run under TPQ_WRITE_NATIVE=0 so
 #                       the pure path (and its parity pins) can never
 #                       silently rot
+#  12. tracing + sentinel — strict (rc=0): the causal-tracing /
+#                       attribution suite (span-tree connectivity,
+#                       adversity propagation, ledger conservation,
+#                       doctor goldens), the scan suites re-run with
+#                       TPQ_TRACE=1 (armed tracing must not change a
+#                       byte), and the bench sentinel in check mode
+#                       against the committed noise-aware baseline
 #
 # Usage: bash tools/ci.sh            (exit 0 = gate passed)
 # The tier-1 stage mirrors ROADMAP.md exactly — if you change one,
@@ -93,7 +100,7 @@ CI_PASS_FLOOR=${CI_PASS_FLOOR:-1000}
 
 fail() { echo "ci.sh: FAILED at stage $1" >&2; exit 1; }
 
-echo "=== stage 1/11: tier-1 suite (pass floor $CI_PASS_FLOOR) ==="
+echo "=== stage 1/12: tier-1 suite (pass floor $CI_PASS_FLOOR) ==="
 rm -f /tmp/_t1.log
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
@@ -107,25 +114,25 @@ echo "DOTS_PASSED=$passed"
 [ "$passed" -ge "$CI_PASS_FLOOR" ] \
   || fail "tier-1 ($passed passed < floor $CI_PASS_FLOOR)"
 
-echo "=== stage 2/11: smoke bench (CPU backend, tiny target) ==="
+echo "=== stage 2/12: smoke bench (CPU backend, tiny target) ==="
 TPQ_BENCH_TARGET=60000 TPQ_BENCH_CPU=1 timeout -k 10 600 \
   python bench.py > /tmp/_ci_bench.json || fail "smoke bench"
 tail -1 /tmp/_ci_bench.json
 
-echo "=== stage 3/11: crash corpus + fault-injection matrix (strict) ==="
+echo "=== stage 3/12: crash corpus + fault-injection matrix (strict) ==="
 timeout -k 10 600 python -m pytest \
   "tests/test_corpus.py::TestCrashRegressions" tests/test_faults.py \
   -q -p no:cacheprovider || fail "corpus/faults"
 
-echo "=== stage 4/11: salvage + strict metadata (strict) ==="
+echo "=== stage 4/12: salvage + strict metadata (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_salvage.py \
   -q -p no:cacheprovider || fail "salvage"
 
-echo "=== stage 5/11: deadlines/hedging + kill-resume checkpoints (strict) ==="
+echo "=== stage 5/12: deadlines/hedging + kill-resume checkpoints (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_deadline.py \
   tests/test_checkpoint.py -q -p no:cacheprovider || fail "time/crash"
 
-echo "=== stage 6/11: plan matrix: serial vs parallel, cache on (strict) ==="
+echo "=== stage 6/12: plan matrix: serial vs parallel, cache on (strict) ==="
 # leg A: pinned-serial planning (the TPQ_PLAN_THREADS=1 reference path)
 TPQ_PLAN_THREADS=1 timeout -k 10 600 python -m pytest \
   tests/test_plan_parallel.py tests/test_plan_cache.py \
@@ -136,7 +143,7 @@ TPQ_PLAN_CACHE_MB=64 timeout -k 10 600 python -m pytest \
   tests/test_plan_parallel.py tests/test_fallback_matrix.py \
   -q -p no:cacheprovider || fail "plan matrix (cache-on leg)"
 
-echo "=== stage 7/11: live obs gate + overhead guard (strict) ==="
+echo "=== stage 7/12: live obs gate + overhead guard (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_live_obs.py \
   tests/test_env_docs.py -q -p no:cacheprovider || fail "live obs"
 # overhead guard: the always-on default must stay within a generous
@@ -147,7 +154,7 @@ timeout -k 10 600 python tools/bench_obs.py --values 2000000 \
   || fail "obs overhead guard"
 tail -5 /tmp/_ci_obs.json
 
-echo "=== stage 8/11: pruning parity gate (strict) ==="
+echo "=== stage 8/12: pruning parity gate (strict) ==="
 # leg A: the whole pushdown suite (write/read page index + bloom,
 # verdicts, late materialization, counter exactness, corrupt-index
 # degrade, pyarrow interop) on the default pool width
@@ -160,13 +167,13 @@ TPQ_PLAN_THREADS=1 TPQ_PRUNE=0 timeout -k 10 600 python -m pytest \
   "tests/test_prune.py::TestParity" \
   -q -p no:cacheprovider || fail "pruning parity (prune-off leg)"
 
-echo "=== stage 9/11: tpq-analyze invariant passes + sanitizer leg (strict) ==="
+echo "=== stage 9/12: tpq-analyze invariant passes + sanitizer leg (strict) ==="
 timeout -k 10 300 python -m tools.analyze || fail "tpq-analyze"
 timeout -k 10 600 python -m pytest tests/test_analyze.py \
   -q -p no:cacheprovider || fail "analyzer self-test"
 timeout -k 10 900 bash tools/analyze/native.sh || fail "native sanitizers"
 
-echo "=== stage 10/11: gather placement parity gate (strict) ==="
+echo "=== stage 10/12: gather placement parity gate (strict) ==="
 # leg A: the placement suite — byte parity placed vs replicated across
 # filter/quarantine/salvage/resume/multi-host, placement + counter pins,
 # mesh-mismatch errors
@@ -179,7 +186,7 @@ TPQ_GATHER_TO=0 timeout -k 10 600 python -m pytest \
   tests/test_gather_placement.py \
   -q -p no:cacheprovider || fail "gather placement (env leg)"
 
-echo "=== stage 11/11: write-pipeline parity gate (strict) ==="
+echo "=== stage 11/12: write-pipeline parity gate (strict) ==="
 # leg A: the whole native-write suite on the default knobs
 timeout -k 10 600 python -m pytest tests/test_write_native.py \
   -q -p no:cacheprovider || fail "write parity"
@@ -189,5 +196,25 @@ timeout -k 10 600 python -m pytest tests/test_write_native.py \
 TPQ_WRITE_NATIVE=0 timeout -k 10 600 python -m pytest \
   tests/test_write_native.py -q -p no:cacheprovider \
   || fail "write parity (native-off leg)"
+
+echo "=== stage 12/12: causal tracing + attribution + bench sentinel (strict) ==="
+# leg A: the trace/attribution suite on the default (trace-off) env —
+# span-tree connectivity, adversity-matrix propagation, ledger
+# conservation, doctor goldens
+timeout -k 10 600 python -m pytest tests/test_trace.py \
+  -q -p no:cacheprovider || fail "trace suite"
+# leg B: trace-ENABLED scan paths — the scan/gather/write suites run
+# with TPQ_TRACE=1 so armed tracing can never change results (the
+# byte-parity pins inside these suites now also hold under tracing),
+# and the attribution/ledger exactness tests re-verify with spans on
+TPQ_TRACE=1 timeout -k 10 900 python -m pytest \
+  tests/test_trace.py tests/test_shard.py tests/test_live_obs.py \
+  tests/test_gather_placement.py \
+  -q -p no:cacheprovider || fail "trace-enabled leg"
+# leg C: perf regression sentinel — fresh micro-runs vs the committed
+# noise-aware baseline (SENTINEL_BASELINE.json); box-independent
+# ratio pins (prune >= floor) enforced even on a different box
+timeout -k 10 600 python tools/bench_sentinel.py --check \
+  || fail "bench sentinel"
 
 echo "ci.sh: gate PASSED"

@@ -308,6 +308,15 @@ def profile_report(events, stats=None) -> dict:
         rep["phases"] = {k: d[k] for k in
                          ("plan_s", "transfer_s", "dispatch_s",
                           "wall_s")}
+        # attribution view: per-stage cpu-seconds derived by the SAME
+        # function the scan ledgers/doctor use (obs.stage_seconds), so
+        # profile, top and doctor agree on numbers by construction
+        rep["attribution"] = {
+            "cpu_s": obs.stage_seconds(d),
+            "bytes": {"read": d.get("bytes_read", 0),
+                      "staged": d.get("bytes_staged", 0),
+                      "moved": d.get("gather_bytes_moved", 0)},
+        }
     else:
         phases: dict = {}
         for s in events.spans:
@@ -335,7 +344,10 @@ def cmd_profile(args, out=None) -> int:
     from .. import obs
     from ..stats import collect_stats
 
+    from ..obs import trace as _trace
+
     saved = getattr(args, "from_events", None)
+    troot = None
     if saved:
         if args.file:
             raise ValueError(
@@ -354,7 +366,10 @@ def cmd_profile(args, out=None) -> int:
 
             filt = parse_filter(args.filter)
         with FileReader(args.file, mirrors=mirrors) as r:
-            with collect_stats(events=True) as st:
+            # with TPQ_TRACE on, the profiled decode runs as its own
+            # trace so the TRACE section below can walk its span tree
+            with _trace.trace_scope("profile") as troot, \
+                    collect_stats(events=True) as st:
                 if filt is not None:
                     # predicate-pushdown profile: the pruning section
                     # below shows what the filter statically skipped
@@ -378,18 +393,31 @@ def cmd_profile(args, out=None) -> int:
                         for c in cols.values():
                             c.block_until_ready()
         log = st.events
+    # causal-trace section (TPQ_TRACE=1): the doctor's critical-path
+    # walk over the profiled decode — per-stage share + bound verdict
+    trace_diag = None
+    if troot is not None and _trace._active is not None:
+        from ..obs.attribution import diagnose
+
+        trace_diag = diagnose(
+            _trace._active.snapshot(troot["trace"]))
     if getattr(args, "json", False):
         import json as _json
 
         rep = profile_report(log, st)
         rep["file"] = args.file or saved
+        if trace_diag is not None:
+            rep["trace"] = {k: trace_diag[k] for k in
+                            ("verdict", "bound_stage", "verdict_share",
+                             "stage_share", "stages_s", "coverage",
+                             "wall_s", "units")}
         _json.dump(rep, out, sort_keys=True, default=str)
         print(file=out)
         # stdout is now a JSON document consumers parse whole: the
         # dump status lines must not corrupt it
         status = sys.stderr
     else:
-        _print_profile(log, st, out)
+        _print_profile(log, st, out, trace_diag)
         status = out
     if getattr(args, "events", None):
         log.write_jsonl(args.events)
@@ -400,7 +428,7 @@ def cmd_profile(args, out=None) -> int:
     return 0
 
 
-def _print_profile(log, st, out) -> None:
+def _print_profile(log, st, out, trace_diag=None) -> None:
     """The human rendering of a profile (live collector or saved
     events)."""
     from .. import obs
@@ -413,6 +441,21 @@ def _print_profile(log, st, out) -> None:
               f"dispatch {d['dispatch_s']:.3f}s  "
               f"wall {d['wall_s']:.3f}s",
               file=out)
+        # attribution section: the stage cpu_s view shared with the
+        # scan ledgers / doctor (obs.stage_seconds)
+        cpu = obs.stage_seconds(d)
+        if any(cpu.values()):
+            print("attribution: "
+                  + "  ".join(f"{k} {v:.3f}s"
+                              for k, v in cpu.items() if v)
+                  + f"  read {d['bytes_read']:,}B", file=out)
+        if trace_diag is not None and trace_diag.get("bound_stage"):
+            print(f"trace: {trace_diag['verdict']} — "
+                  f"{trace_diag['bound_stage']} is "
+                  f"{100 * trace_diag['verdict_share']:.1f}% of the "
+                  f"traced wall "
+                  f"(coverage {100 * trace_diag['coverage']:.1f}%)",
+                  file=out)
         # footer-keyed plan cache effectiveness (TPQ_PLAN_CACHE_MB):
         # per-span verdicts localize WHICH column plans hit
         cache_spans = obs.plan_cache_span_counts(log)
@@ -501,6 +544,16 @@ def render_top_frame(frames: list[dict], width: int = 40) -> str:
                if f.get("units_quarantined") else "")
             + (f"  staged {f['bytes_staged']:,}B"
                if f.get("bytes_staged") else ""))
+        attr = f.get("attribution")
+        if attr and attr.get("cpu_s"):
+            cpu = "  ".join(f"{k} {v:.2f}s"
+                            for k, v in attr["cpu_s"].items() if v)
+            by = attr.get("bytes") or {}
+            lines.append(
+                "  cpu: " + (cpu or "-")
+                + (f"  read {by['read']:,}B" if by.get("read") else "")
+                + (f"  peak_arena {attr['peak_arena_bytes']:,}B"
+                   if attr.get("peak_arena_bytes") else ""))
         if f.get("_stale_s") is not None:
             lines.append(
                 f"  STALE: no update for {f['_stale_s']:.0f}s "
@@ -562,6 +615,61 @@ def cmd_top(args, out=None) -> int:
             return 0
         _time.sleep(interval)
         print(file=out)
+
+
+def cmd_doctor(args, out=None) -> int:
+    """Walk a causal scan trace and say what bounds the wall.
+
+    Input: a trace export — the file a scan wrote via
+    ``TPQ_TRACE_EXPORT`` (the native ``tpq-trace`` envelope, read
+    live mid-scan or after), a bare span-list JSON, or a
+    ``*.perfetto.json`` round trip.  For each trace in the file:
+    the per-unit stage decomposition (exclusive-time critical-path
+    walk — stage buckets sum to the unit wall exactly), the
+    scan-level bound verdict (read-bound / plan-bound /
+    decompress-bound / decode-bound / gather-bound) with its share,
+    straggler units ranked against the rolling p95 of unit walls
+    (``deadline.LatencyTracker``, the same detector ``top`` uses
+    live), and the plan-pool concurrency note that turns the
+    PLAN_SCALE thread-degradation mystery into one line.  Attribution
+    ledgers embedded in the export print alongside.  ``--json`` emits
+    the full machine-readable reports.  No reference analogue — this
+    is the diagnosis face of the causal tracing layer."""
+    import json as _json
+
+    out = out or sys.stdout
+    from ..obs.attribution import diagnose, format_diagnosis
+    from ..obs.export import load_trace_file
+
+    spans, ledgers = load_trace_file(args.trace)
+    by_trace: dict = {}
+    for s in spans:
+        by_trace.setdefault(s.get("trace"), []).append(s)
+    sel = getattr(args, "trace_id", None)
+    if sel is not None:
+        if sel not in by_trace:
+            raise ValueError(
+                f"trace id {sel!r} not in {args.trace!r}; present: "
+                f"{sorted(k for k in by_trace if k is not None)}")
+        by_trace = {sel: by_trace[sel]}
+    if not by_trace:
+        print("(no spans — was TPQ_TRACE=1 set on the scan?)",
+              file=out)
+        return 1
+    reports = [diagnose(ss) for _tid, ss in
+               sorted(by_trace.items(),
+                      key=lambda kv: min(s["t0"] for s in kv[1]))]
+    if getattr(args, "json", False):
+        _json.dump({"reports": reports, "ledgers": ledgers}, out,
+                   sort_keys=True, default=str)
+        print(file=out)
+        return 0
+    for i, d in enumerate(reports):
+        if i:
+            print(file=out)
+        print(format_diagnosis(d, ledgers if i == 0 else None),
+              file=out)
+    return 0
 
 
 def cmd_rescue(args, out=None) -> int:
@@ -870,6 +978,22 @@ def build_parser() -> argparse.ArgumentParser:
                     help="progress status file(s) a scan exports via "
                          "progress_export= / TPQ_PROGRESS_EXPORT")
     tp.set_defaults(fn=cmd_top)
+
+    dr = sub.add_parser(
+        "doctor",
+        help="walk a causal scan trace (TPQ_TRACE_EXPORT file) and "
+             "name the bounding stage, stragglers and attribution")
+    dr.add_argument("--json", action="store_true",
+                    help="emit the full diagnosis reports as "
+                         "machine-readable JSON")
+    dr.add_argument("--trace-id", default=None, dest="trace_id",
+                    help="analyze only this trace id (default: every "
+                         "trace in the file)")
+    dr.add_argument("trace",
+                    help="trace export: the tpq-trace envelope a scan "
+                         "writes via TPQ_TRACE_EXPORT, a bare span "
+                         "list, or a *.perfetto.json round trip")
+    dr.set_defaults(fn=cmd_doctor)
 
     rc = sub.add_parser("rowcount", help="print the total row count")
     rc.add_argument("file")

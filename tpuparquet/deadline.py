@@ -270,11 +270,17 @@ def record_expiry(st, site: str, elapsed: float, budget: float,
     watchdog paths here and the scan-level budget in
     ``shard.scan.DurableScanMixin``)."""
     from .obs.recorder import flight
+    from .obs.trace import emit_span
 
     # the flight recorder sees every expiry, collector or not — this
     # is exactly the record a post-mortem wants on its timeline
     flight("deadline_exceeded", site=site,
            elapsed_s=round(elapsed, 3), budget_s=budget, **coords)
+    # the causal trace sees it too: a zero-duration error span at the
+    # expiry instant, parented under whatever stage was waiting
+    emit_span("deadline_exceeded", time.perf_counter(), 0.0,
+              status="error", site=site, elapsed_s=round(elapsed, 3),
+              budget_s=budget, **coords)
     if st is None:
         return
     st.deadline_exceeded += 1
@@ -302,18 +308,23 @@ def call_with_deadline(fn, budget: float | None, *, site: str,
     increments and a fault event is recorded."""
     if budget is None or budget <= 0:
         return fn()
+    from .obs import trace as _trace
     from .stats import current_stats
 
     st = current_stats()
     op = _Op(site, budget, coords)
     box: dict = {}
     wd = watchdog()
+    # the disposable worker re-enters the caller's trace context so
+    # spans emitted by the bounded work parent causally under the
+    # caller's open span (unit, plan, ...) despite the thread hop
+    tctx = _trace.current_ctx()
 
     def run():
         from .stats import worker_stats
 
         try:
-            with worker_stats(like=st) as ws:
+            with _trace.adopt(tctx), worker_stats(like=st) as ws:
                 try:
                     box["result"] = fn()
                 except BaseException as e:  # noqa: BLE001 — repropagated
@@ -375,18 +386,31 @@ def hedged_call(fns, *, delay: float, site: str,
     fns = list(fns)
     if len(fns) == 1 and (budget is None or budget <= 0):
         return fns[0]()
+    from .obs import trace as _trace
     from .stats import current_stats, worker_stats
 
     st = current_stats()
     q: queue.SimpleQueue = queue.SimpleQueue()
     starts: dict[int, float] = {}
+    # per-branch trace spans: each launched replica gets an open span
+    # under the caller's context; the branch worker adopts ITS span's
+    # context, so the branch's own reads nest under it.  Resolution
+    # closes the winner "ok" and every abandoned sibling "cancelled" —
+    # hedge losers are visible, attributable child spans, not ghosts.
+    branch_spans: dict[int, object] = {}
 
     def launch(i: int) -> None:
         starts[i] = time.monotonic()
+        bsp = None
+        if _trace._active is not None:
+            bsp = _trace.open_span("read_replica", push=False,
+                                   replica=i, site=site, **coords)
+        branch_spans[i] = bsp
+        bctx = _trace.ctx_of(bsp)
 
         def run():
             try:
-                with worker_stats(like=st) as ws:
+                with _trace.adopt(bctx), worker_stats(like=st) as ws:
                     try:
                         out = (True, fns[i]())
                     except BaseException as e:  # noqa: BLE001
@@ -396,6 +420,11 @@ def hedged_call(fns, *, delay: float, site: str,
                 pass
 
         _spawn_worker(run, f"tpq-hedge:{site}:{i}")
+
+    def _close_branch(i: int, status: str) -> None:
+        bsp = branch_spans.pop(i, None)
+        if bsp is not None:
+            _trace.close_span(bsp, status=status)
 
     def hedge_next() -> None:
         from .obs.recorder import flight
@@ -417,6 +446,8 @@ def hedged_call(fns, *, delay: float, site: str,
         now = time.monotonic()
         if budget is not None and budget > 0 and now - t0 >= budget:
             elapsed = now - t0
+            for i in list(branch_spans):
+                _close_branch(i, "cancelled")
             record_expiry(st, site, elapsed, budget, coords)
             raise DeadlineExceededError(
                 f"{site} exceeded its {budget:g}s deadline with "
@@ -443,6 +474,9 @@ def hedged_call(fns, *, delay: float, site: str,
             _merge_worker(st, ws, failed=False)
             if tracker is not None:
                 tracker.record(time.monotonic() - starts[i])
+            _close_branch(i, "ok")
+            for j in list(branch_spans):
+                _close_branch(j, "cancelled")  # abandoned losers
             if i > 0:
                 from .obs import recorder as _flightrec
 
@@ -458,6 +492,7 @@ def hedged_call(fns, *, delay: float, site: str,
                 on_win(i)
             return val
         _merge_worker(st, ws, failed=True)
+        _close_branch(i, "error")
         errors[i] = val
         done += 1
         if done == len(starts):

@@ -24,6 +24,7 @@ from ..cpu.plain import ByteArrayColumn
 from ..errors import CorruptChunkError, CorruptPageError, ScanError
 from ..faults import filter_bytes
 from ..obs import recorder as _flightrec
+from ..obs import trace as _trace
 from ..format.compact import CompactReader
 from ..format.metadata import (
     ColumnChunk,
@@ -652,13 +653,17 @@ def _write_split_pages(out, node, handler, page_column, dl, codec,
             dictionary if dict_size is not None else None)
         return vals, dl_pg, pg_null, pg_stats
 
+    # the encode-ahead worker re-enters the submitting thread's trace
+    # context so its page_write spans parent under the writer's trace
+    _tctx = _trace.current_ctx()
+
     def render(a, b, like):
         # render one page's bytes into a private buffer (pipelined
         # mode): offsets rebase at append time, stats merge at join
         from ..stats import worker_stats
 
         buf = _CountingBuf()
-        with worker_stats(like) as ws:
+        with _trace.adopt(_tctx), worker_stats(like) as ws:
             c, u, pg_stats = write_page(buf, a, b)
         return buf.parts, c, u, pg_stats, ws
 

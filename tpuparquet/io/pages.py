@@ -28,6 +28,7 @@ from ..compress import compress_block, decompress_block
 from ..errors import CorruptPageError, TransientIOError
 from ..faults import fault_point
 from ..obs import recorder as _flightrec
+from ..obs import trace as _trace
 from ..cpu import (
     as_uint32,
     bit_width,
@@ -723,6 +724,12 @@ def _record_page_written(node, n_values: int) -> None:
     if _flightrec._active is not None:
         _flightrec.flight("page_write", site="io.pages",
                           column=".".join(node.path), values=n_values)
+    # causal trace: write-side point span — the encode-ahead pipeline
+    # workers adopt the submitting chunk's context, so these parent
+    # under the writer's trace when one is open
+    if _trace._active is not None:
+        _trace.emit_span("page_write", time.perf_counter(), 0.0,
+                         column=".".join(node.path), values=n_values)
 
 
 def write_dictionary_page(out, node, dictionary, codec,

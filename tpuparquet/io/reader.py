@@ -30,6 +30,7 @@ from ..errors import CorruptChunkError, CorruptPageError, \
     ScanError
 from ..faults import fault_point, filter_bytes, retry_transient
 from ..obs import recorder as _flightrec
+from ..obs import trace as _trace
 from ..obs.recorder import flight
 from ..format.footer import read_file_metadata
 from ..format.metadata import ColumnMetaData, FileMetaData
@@ -793,9 +794,12 @@ class FileReader:
         Zero-copy view for in-memory sources; the full time-domain read
         policy (retry/hedge/deadline) otherwise.  Thread-safe — the
         column-parallel planner calls this from pool workers."""
+        from ..stats import current_stats
+
         start = cm.data_page_offset
         if cm.dictionary_page_offset is not None:
             start = min(start, cm.dictionary_page_offset)
+        t0 = time.perf_counter()
         if self._buf is not None:
             # explicit bounds: negative offsets would WRAP on a
             # memoryview slice (the old seek() raised instead)
@@ -816,6 +820,13 @@ class FileReader:
                     f"{cm.total_compressed_size} bytes",
                     column=path, file=self.name)
         blob = filter_bytes("io.reader.chunk_read", blob, column=path)
+        dt = time.perf_counter() - t0
+        st = current_stats()
+        if st is not None:
+            # the read-side attribution pair: wall spent fetching
+            # (retry/hedge/deadline wait included) and bytes fetched
+            st.read_s += dt
+            st.bytes_read += len(blob)
         # flight recorder: one record per chunk read (file/column
         # coordinates are exactly what a post-mortem wants trailing;
         # guarded so the disabled path skips the kwargs build)
@@ -823,6 +834,11 @@ class FileReader:
             _flightrec.flight("chunk_read", site="io.reader",
                               file=self.name, column=path,
                               bytes=cm.total_compressed_size)
+        # causal trace: the read span of this chunk's unit/plan chain
+        if _trace._active is not None:
+            _trace.emit_span("read", t0, dt, file=self.name,
+                             column=path,
+                             bytes=cm.total_compressed_size)
         return blob, start
 
     def iter_selected_chunks(self, rg):

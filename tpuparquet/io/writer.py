@@ -622,6 +622,13 @@ class FileWriter:
             )
             jobs.append((leaf, column, rep, dl, kv, enc, nc))
 
+        # parallel-flush workers re-enter the flushing thread's trace
+        # context so the per-page write spans parent causally under
+        # the writer's trace (when one is open) despite the pool hop
+        from ..obs import trace as _trace
+
+        _tctx = _trace.current_ctx()
+
         def render(leaf, column, rep, dl, kv, enc, nc):
             # each chunk renders into its own buffer at position 0;
             # offsets in the returned metadata are made absolute when
@@ -632,7 +639,7 @@ class FileWriter:
             from ..stats import worker_stats
 
             buf = io.BytesIO()
-            with worker_stats() as ws:
+            with _trace.adopt(_tctx), worker_stats() as ws:
                 cc = write_chunk(
                     buf, leaf, column, rep, dl,
                     codec=self.codec,

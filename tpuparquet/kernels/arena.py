@@ -20,17 +20,75 @@ import threading
 import numpy as np
 
 __all__ = ["HostArena", "ArenaPool", "lease_arena", "return_arena",
-           "trim_arena_pool", "thread_arena", "discard_thread_arena"]
+           "trim_arena_pool", "thread_arena", "discard_thread_arena",
+           "arena_occupancy", "take_arena_peak"]
+
+
+# ----------------------------------------------------------------------
+# Process-wide occupancy watermark (attribution telemetry)
+# ----------------------------------------------------------------------
+# Outstanding borrowed slab bytes across every arena, plus the
+# high-water mark since the last take — the "peak arena bytes" a
+# per-scan resource ledger (obs/attribution.py) reports.  Borrow-time
+# adds happen under a lock (a couple of integer ops per slab borrow —
+# slab, not page, granularity); readers take the peak at unit
+# boundaries.  Best-effort on abandoned arenas: an arena dropped
+# without release (the in-flight-transfer escape hatch) subtracts its
+# outstanding bytes at finalization.
+
+_occ_lock = threading.Lock()
+_occ_bytes = 0
+_occ_peak = 0
+
+
+def _occ_add(n: int) -> None:
+    global _occ_bytes, _occ_peak
+    with _occ_lock:
+        _occ_bytes += n
+        if _occ_bytes > _occ_peak:
+            _occ_peak = _occ_bytes
+
+
+def _occ_sub(n: int) -> None:
+    global _occ_bytes
+    with _occ_lock:
+        _occ_bytes = max(_occ_bytes - n, 0)
+
+
+def arena_occupancy() -> int:
+    """Outstanding borrowed arena bytes right now (process-wide)."""
+    with _occ_lock:
+        return _occ_bytes
+
+
+def take_arena_peak() -> int:
+    """The occupancy high-water mark since the previous take; resets
+    the mark to the CURRENT occupancy (so successive takes window the
+    peak without ever under-reporting a still-outstanding borrow).
+
+    PROCESS-WIDE by construction: arenas are a shared pool, so the
+    watermark cannot say which scan's borrows produced a given peak —
+    with concurrent scans, whichever scan takes a window first
+    absorbs that window's (shared) peak.  Per-scan ledgers therefore
+    report this as "peak arena occupancy observed during my units",
+    an upper bound on the scan's own footprint, not an exact
+    per-tenant attribution."""
+    global _occ_peak
+    with _occ_lock:
+        p = _occ_peak
+        _occ_peak = _occ_bytes
+        return p
 
 
 class HostArena:
     """Best-fit free list of reusable u8 slabs."""
 
-    __slots__ = ("_free", "_used", "max_slabs")
+    __slots__ = ("_free", "_used", "_used_bytes", "max_slabs")
 
     def __init__(self, max_slabs: int = 64):
         self._free: list[np.ndarray] = []
         self._used: list[np.ndarray] = []
+        self._used_bytes = 0
         self.max_slabs = max_slabs
 
     def borrow(self, nbytes: int) -> np.ndarray:
@@ -50,6 +108,8 @@ class HostArena:
             cap = 1 << (cap - 1).bit_length()
             slab = np.empty(cap, dtype=np.uint8)
         self._used.append(slab)
+        self._used_bytes += slab.size
+        _occ_add(slab.size)
         return slab[:nbytes]
 
     def release_all(self) -> None:
@@ -58,10 +118,22 @@ class HostArena:
         forever while small pages churn."""
         free = self._free + self._used
         self._used = []
+        _occ_sub(self._used_bytes)
+        self._used_bytes = 0
         if len(free) > self.max_slabs:
             free.sort(key=lambda s: s.size)
             free = free[-self.max_slabs:]
         self._free = free
+
+    def __del__(self):
+        # abandoned arenas (error paths drop leases without release so
+        # in-flight transfers stay safe) must not pin the occupancy
+        # gauge forever; interpreter-shutdown partial teardown tolerated
+        try:
+            if self._used_bytes:
+                _occ_sub(self._used_bytes)
+        except Exception:
+            pass
 
 
 class ArenaPool:

@@ -46,7 +46,9 @@ from ..errors import CorruptChunkError, CorruptPageError, \
 from ..faults import backoff_delays, fault_point, filter_bytes
 from ..native import plane_native
 from ..obs import recorder as _flightrec
+from ..obs import trace as _trace
 from ..obs.recorder import flight
+from ..obs.trace import emit_span
 from .arena import HostArena, discard_thread_arena, lease_arena, \
     return_arena, thread_arena, trim_arena_pool
 from ..cpu.plain import ByteArrayColumn
@@ -2595,14 +2597,23 @@ def read_row_group_device_resilient(reader, rg_index: int,
                     "dispatch_retry",
                     site="kernels.device.unit_dispatch",
                     row_group=rg_index, error=type(last).__name__)
+            if _trace._active is not None:
+                _trace.emit_span(
+                    "dispatch_retry", time.perf_counter(), 0.0,
+                    status="error", row_group=rg_index,
+                    error=type(last).__name__)
             st = current_stats()
             if st is not None:
                 st.dispatch_retries += 1
             sleep(delays[attempt])
     # retries exhausted: degrade this unit to the CPU oracle decode
+    # (cold site — the bare emit_span, like the bare flight above)
     flight("degraded-to-host", site="kernels.device.unit_dispatch",
            row_group=rg_index, error=type(last).__name__,
            message=str(last))
+    emit_span("degraded_to_host", time.perf_counter(), 0.0,
+              status="error", row_group=rg_index,
+              error=type(last).__name__)
     st = current_stats()
     if st is not None:
         st.units_degraded += 1
@@ -2631,6 +2642,10 @@ def _plan_one_column(reader, rg_index: int, path, node, cm,
     deg_ctx = (cpu_fallback_values() if degraded
                else contextlib.nullcontext())
     t0 = time.perf_counter()
+    # causal trace: the plan span is OPENED (not emitted whole) so the
+    # chunk read it triggers nests under it as a child span
+    tsp = _trace.open_span("plan", column=path) \
+        if _trace._active is not None else None
     stager = _Stager()
     # fingerprint only when the cache is on: computing it lazily costs
     # a footer re-read on file-backed sources, which cache-off scans
@@ -2654,6 +2669,7 @@ def _plan_one_column(reader, rg_index: int, path, node, cm,
             from .plancache import invalidate_fingerprint
 
             invalidate_fingerprint(fingerprint)
+        _trace.close_span(tsp, status="error")
         raise e.annotate(column=path, file=getattr(reader, "name", None))
     except ValueError as e:
         # codec-layer domain errors become taxonomy errors with
@@ -2662,9 +2678,15 @@ def _plan_one_column(reader, rg_index: int, path, node, cm,
         from .plancache import invalidate_fingerprint
 
         invalidate_fingerprint(fingerprint)
+        _trace.close_span(tsp, status="error")
         raise CorruptChunkError(
             str(e), column=path,
             file=getattr(reader, "name", None)) from e
+    except BaseException:
+        _trace.close_span(tsp, status="error")
+        raise
+    _trace.close_span(tsp, cache=(cache_state[0] if cache_state
+                                  else "off"))
     t1 = time.perf_counter()
     if _flightrec._active is not None:
         _flightrec.flight(
@@ -2683,14 +2705,23 @@ def _plan_one_column(reader, rg_index: int, path, node, cm,
 
 
 def _plan_column_task(reader, rg_index: int, path, node, cm,
-                      arena: HostArena, like, degraded: bool):
+                      arena: HostArena, like, degraded: bool,
+                      tctx=None, usp=None):
     """Pool-worker wrapper around :func:`_plan_one_column`: fresh
     per-thread collector (``worker_stats(like=)`` — the coordinator
     merges after joining, the exactness discipline ``stats.py``
-    documents) and the submitting thread's degradation state."""
+    documents) and the submitting thread's degradation state.
+    ``tctx`` re-enters the submitting site's trace context (the
+    unit's span) so this column's plan/read spans parent causally
+    under their unit regardless of which pool thread ran them;
+    ``usp`` is that unit's OPEN span handle — the first task to run
+    stamps its execution start (``setdefault`` is GIL-atomic), so the
+    unit span measures work, not submission-queue wait."""
     from ..stats import worker_stats
 
-    with worker_stats(like=like) as ws:
+    if usp is not None:
+        usp.setdefault("t0_exec", time.perf_counter())
+    with _trace.adopt(tctx), worker_stats(like=like) as ws:
         entry = _plan_one_column(reader, rg_index, path, node, cm,
                                  arena, degraded=degraded)
     return entry, ws
@@ -2772,6 +2803,9 @@ def _finish_row_group(planned):
             "span:stage", site="kernels.device", columns=len(out),
             transfer_s=round(t1 - t0, 6),
             dispatch_s=round(t2 - t1, 6))
+    if _trace._active is not None:
+        _trace.emit_span("transfer", t0, t1 - t0, columns=len(out))
+        _trace.emit_span("dispatch", t1, t2 - t1, columns=len(out))
     _cs = current_stats()
     if _cs is not None:
         _cs.transfer_s += t1 - t0
@@ -2850,19 +2884,29 @@ def filtered_pipelined_reads(readers, units, device_for=None,
     n_workers = _plan_threads()
     degraded = _host_values_only()
 
-    def task(ri, rgi):
+    def task(ri, rgi, tctx=None, usp=None):
         deg_ctx = (cpu_fallback_values() if degraded
                    else contextlib.nullcontext())
         t0 = time.perf_counter()
-        with worker_stats(like=_cs) as ws, deg_ctx:
+        if usp is not None:
+            usp.setdefault("t0_exec", t0)
+        with _trace.adopt(tctx), worker_stats(like=_cs) as ws, deg_ctx:
+            tsp = _trace.open_span("plan", filtered=True) \
+                if _trace._active is not None else None
             v = None if verdicts is None else verdicts.get((ri, rgi))
-            chunks, _rows = read_row_group_filtered(
-                readers[ri], rgi, filter, v)
+            try:
+                chunks, _rows = read_row_group_filtered(
+                    readers[ri], rgi, filter, v)
+            except BaseException:
+                _trace.close_span(tsp, status="error")
+                raise
+            _trace.close_span(tsp)
             ws.plan_s += time.perf_counter() - t0
         return chunks, ws
 
     ex = ThreadPoolExecutor(max_workers=n_workers)
     inflight = {}
+    unit_spans = {}
     state = {"next_j": 0}
 
     def fill(window: int):
@@ -2870,12 +2914,26 @@ def filtered_pipelined_reads(readers, units, device_for=None,
             k = order[state["next_j"]]
             state["next_j"] += 1
             ri, rgi = units[k]
-            inflight[k] = ex.submit(task, ri, rgi)
+            usp = None
+            if _trace._active is not None:
+                usp = _trace.open_span("unit", push=False, unit=k,
+                                       file=ri, row_group=rgi)
+            unit_spans[k] = usp
+            inflight[k] = ex.submit(task, ri, rgi, _trace.ctx_of(usp),
+                                    usp)
 
     try:
         fill(n_workers + 1)
         for k in order:
-            chunks, ws = inflight.pop(k).result()
+            usp = unit_spans.pop(k, None)
+            try:
+                chunks, ws = inflight.pop(k).result()
+            except BaseException as e:
+                _trace.close_span(usp, status="error",
+                                  error=type(e).__name__)
+                raise
+            if usp is not None and "t0_exec" in usp:
+                usp["t0"] = usp["t0_exec"]
             if _cs is not None:
                 _cs.merge_from(ws)
                 _cs.row_groups += 1
@@ -2890,12 +2948,21 @@ def filtered_pipelined_reads(readers, units, device_for=None,
                        for path, cd in chunks.items()}
                 jax.block_until_ready(
                     [x for c in out.values() for x in c._buffers()])
+            t1 = time.perf_counter()
             if _cs is not None:
-                _cs.transfer_s += time.perf_counter() - t0
+                _cs.transfer_s += t1 - t0
+            if _trace._active is not None:
+                _trace.emit_span("transfer", t0, t1 - t0,
+                                 parent=_trace.ctx_of(usp),
+                                 columns=len(out))
+            _trace.close_span(usp)
             fill(n_workers + 1)
             yield k, out
     finally:
         ex.shutdown(wait=True)
+        for usp in unit_spans.values():
+            _trace.close_span(usp, status="cancelled")
+        unit_spans.clear()
 
 
 def pipelined_reads(readers, units, device_for=None, start: int = 0):
@@ -2933,6 +3000,7 @@ def pipelined_reads(readers, units, device_for=None, start: int = 0):
     ex = ThreadPoolExecutor(max_workers=n_workers)
     inflight = {}    # unit k -> [future per column, in column order]
     arenas_of = {}   # unit k -> [leased arenas]
+    unit_spans = {}  # unit k -> open trace span handle (or None)
     state = {"next_j": 0, "tasks": 0}
 
     def submit_unit():
@@ -2941,6 +3009,16 @@ def pipelined_reads(readers, units, device_for=None, start: int = 0):
         ri, rgi = units[k]
         reader = readers[ri]
         cols = reader.selected_chunks(reader.meta.row_groups[rgi])
+        # unit span: opened WITHOUT pushing the ambient context (its
+        # open/close straddles generator yields) — the plan tasks and
+        # the finish step re-enter it explicitly, so a unit's spans
+        # connect under it even though planning overlaps other units
+        usp = None
+        if _trace._active is not None:
+            usp = _trace.open_span("unit", push=False, unit=k,
+                                   file=ri, row_group=rgi)
+        unit_spans[k] = usp
+        tctx = _trace.ctx_of(usp)
         futs, ars = [], []
         # single-worker pools run a unit's column tasks sequentially,
         # so one shared arena per unit keeps the old cross-column slab
@@ -2954,7 +3032,8 @@ def pipelined_reads(readers, units, device_for=None, start: int = 0):
                 a = lease_arena()
                 ars.append(a)
             futs.append(ex.submit(_plan_column_task, reader, rgi, path,
-                                  node, cm, a, _cs, degraded))
+                                  node, cm, a, _cs, degraded, tctx,
+                                  usp))
         inflight[k] = futs
         arenas_of[k] = ars
         state["tasks"] += len(futs)
@@ -2970,6 +3049,7 @@ def pipelined_reads(readers, units, device_for=None, start: int = 0):
         for k in order:
             futs = inflight.pop(k)
             state["tasks"] -= len(futs)
+            usp = unit_spans.pop(k, None)
             planned = []
             err = None
             for f in futs:
@@ -2981,13 +3061,29 @@ def pipelined_reads(readers, units, device_for=None, start: int = 0):
                 if _cs is not None:
                     _cs.merge_from(ws)
                 planned.append(entry)
+            if usp is not None and "t0_exec" in usp:
+                # the unit span starts when its first plan task RAN
+                # (stamped by the worker; all futures joined above),
+                # not when the window submitted it — queue wait
+                # belongs to the scan's driver time, not the unit
+                usp["t0"] = usp["t0_exec"]
             if err is not None:
+                _trace.close_span(usp, status="error",
+                                  error=type(err).__name__)
                 raise err
-            if device_for is not None:
-                with jax.default_device(device_for(k)):
-                    out = _finish_row_group(planned)
-            else:
-                out = _finish_row_group(planned)  # drains; arenas free
+            try:
+                with _trace.adopt(_trace.ctx_of(usp)):
+                    if device_for is not None:
+                        with jax.default_device(device_for(k)):
+                            out = _finish_row_group(planned)
+                    else:
+                        # drains; arenas free
+                        out = _finish_row_group(planned)
+            except BaseException as e:
+                _trace.close_span(usp, status="error",
+                                  error=type(e).__name__)
+                raise
+            _trace.close_span(usp)
             for a in arenas_of.pop(k):
                 return_arena(a)
             fill_window(1)
@@ -3002,6 +3098,12 @@ def pipelined_reads(readers, units, device_for=None, start: int = 0):
         # back to the allocator (keep=2: the resilient per-unit path
         # still reuses a couple of warm arenas between scans).
         ex.shutdown(wait=True)
+        # pre-submitted units the consumer never drained: their plan
+        # spans were already emitted (the workers ran), so emit the
+        # unit spans as cancelled rather than orphaning the children
+        for usp in unit_spans.values():
+            _trace.close_span(usp, status="cancelled")
+        unit_spans.clear()
         trim_arena_pool(keep=2)
 
 

@@ -63,11 +63,24 @@ from .events import (  # noqa: F401
     load_jsonl,
     plan_cache_span_counts,
 )
+from .attribution import (  # noqa: F401
+    ScanLedger,
+    diagnose,
+    format_diagnosis,
+    ledger,
+    ledgers_snapshot,
+    reset_ledgers,
+    stage_seconds,
+)
 from .export import (  # noqa: F401
     chrome_trace,
     column_table,
     format_column_table,
+    load_trace_file,
+    spans_chrome_trace,
+    spans_otlp,
     write_chrome_trace,
+    write_trace_file,
 )
 from .histogram import Histogram, N_BUCKETS  # noqa: F401
 from .live import (  # noqa: F401
@@ -87,16 +100,30 @@ from .progress import ScanProgress, read_progress_file  # noqa: F401
 # attribute `obs.recorder` stays the MODULE, not the function
 from .recorder import FlightRecorder, flight, set_ring  # noqa: F401
 from .recorder import recorder as flight_recorder  # noqa: F401
+from .trace import (  # noqa: F401
+    Tracer,
+    emit_span,
+    set_tracing,
+    snapshot_spans,
+    trace_scope,
+)
+from .trace import tracer as trace_tracer  # noqa: F401
 
 __all__ = [
     "EventLog", "PageEvent", "TRANSPORT_COUNTER", "counter_counts",
     "event_summary", "fault_counts_by_column", "load_jsonl",
     "plan_cache_span_counts", "chrome_trace",
     "column_table", "format_column_table", "write_chrome_trace",
+    "spans_chrome_trace", "spans_otlp", "write_trace_file",
+    "load_trace_file",
     "Histogram", "N_BUCKETS",
     "MetricsRegistry", "registry", "fold_stats", "live_enabled",
     "export_now",
     "FlightRecorder", "flight", "flight_recorder", "set_ring",
+    "Tracer", "emit_span", "set_tracing", "snapshot_spans",
+    "trace_scope", "trace_tracer",
+    "ScanLedger", "ledger", "ledgers_snapshot", "reset_ledgers",
+    "stage_seconds", "diagnose", "format_diagnosis",
     "ScanProgress", "read_progress_file",
     "record_incident", "postmortem_path_for", "load_postmortem",
 ]

@@ -8,16 +8,26 @@
   cover the planner/stager wall the profiler can't see).
 * :func:`column_table` — the per-column transport/timing aggregate the
   ``parquet-tool profile`` subcommand prints.
+* :func:`spans_chrome_trace` / :func:`spans_otlp` — the CAUSAL span
+  graph (:mod:`~tpuparquet.obs.trace`) as Chrome trace-event JSON
+  (Perfetto renders the parent/child nesting per thread track) or
+  OTLP-shaped ``resourceSpans`` JSON (what an OpenTelemetry collector
+  ingests); :func:`write_trace_file` / :func:`load_trace_file` are the
+  scan drivers' ``TPQ_TRACE_EXPORT`` writer and ``parquet-tool
+  doctor``'s reader (format picked by filename suffix, atomic
+  publish).
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 
 from .events import EventLog
 
 __all__ = ["chrome_trace", "write_chrome_trace", "column_table",
-           "format_column_table"]
+           "format_column_table", "spans_chrome_trace", "spans_otlp",
+           "write_trace_file", "load_trace_file"]
 
 
 def chrome_trace(log: EventLog) -> dict:
@@ -49,6 +59,160 @@ def write_chrome_trace(log: EventLog, path_or_file) -> None:
     else:
         with open(path_or_file, "w") as f:
             json.dump(obj, f)
+
+
+def spans_chrome_trace(spans: list[dict]) -> dict:
+    """Causal spans as Chrome trace-event JSON: complete ("X") events
+    on per-thread tracks (Perfetto nests children under parents by
+    interval containment), cancelled/error spans color-coded via
+    ``cname``, coordinates and ids in ``args``.  Cross-host merges
+    (spans carrying a ``proc`` field) land on per-process tracks."""
+    events = []
+    t_base = min((s["t0"] for s in spans), default=0.0)
+    for s in spans:
+        args = {k: v for k, v in s.items()
+                if k not in ("t0", "dur", "tid", "name")}
+        ev = {
+            "name": s.get("name", "?"),
+            "cat": s.get("status", "ok"),
+            "ph": "X",
+            "ts": round((s["t0"] - t_base) * 1e6, 1),
+            "dur": round(s.get("dur", 0.0) * 1e6, 1),
+            "pid": s.get("proc", 0),
+            "tid": s.get("tid", 0),
+            "args": args,
+        }
+        status = s.get("status")
+        if status == "cancelled":
+            ev["cname"] = "grey"
+        elif status == "error":
+            ev["cname"] = "terrible"
+        events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def _otlp_attr(k, v) -> dict:
+    if isinstance(v, bool):
+        val = {"boolValue": v}
+    elif isinstance(v, int):
+        val = {"intValue": str(v)}
+    elif isinstance(v, float):
+        val = {"doubleValue": v}
+    else:
+        val = {"stringValue": str(v)}
+    return {"key": k, "value": val}
+
+
+def spans_otlp(spans: list[dict], anchor: dict | None = None,
+               service: str = "tpuparquet") -> dict:
+    """Causal spans as OTLP-shaped JSON (``resourceSpans`` →
+    ``scopeSpans`` → ``spans`` with hex ``traceId``/``spanId``/
+    ``parentSpanId`` and Unix-nano timestamps) — the shape an
+    OpenTelemetry collector's JSON receiver ingests.  ``anchor`` is
+    the tracer's ``{"wall", "perf"}`` pair mapping the monotonic span
+    starts to epoch time (without it, spans are anchored at their raw
+    monotonic seconds)."""
+    wall = (anchor or {}).get("wall", 0.0)
+    perf = (anchor or {}).get("perf", 0.0)
+
+    def nanos(t: float) -> str:
+        return str(int((wall + (t - perf)) * 1e9))
+
+    otlp_spans = []
+    for s in spans:
+        trace_hex = hashlib.md5(
+            str(s.get("trace", "")).encode()).hexdigest()
+        attrs = [_otlp_attr(k, v) for k, v in sorted(s.items())
+                 if k not in ("t0", "dur", "tid", "name", "trace",
+                              "span", "parent", "status")]
+        status = s.get("status", "ok")
+        rec = {
+            "traceId": trace_hex,
+            "spanId": f"{int(s['span']) & (2**64 - 1):016x}",
+            "name": s.get("name", "?"),
+            "kind": 1,  # SPAN_KIND_INTERNAL
+            "startTimeUnixNano": nanos(s["t0"]),
+            "endTimeUnixNano": nanos(s["t0"] + s.get("dur", 0.0)),
+            "attributes": attrs,
+            "status": {"code": 2 if status == "error" else 1},
+        }
+        if s.get("parent") is not None:
+            rec["parentSpanId"] = \
+                f"{int(s['parent']) & (2**64 - 1):016x}"
+        otlp_spans.append(rec)
+    return {"resourceSpans": [{
+        "resource": {"attributes": [
+            _otlp_attr("service.name", service)]},
+        "scopeSpans": [{
+            "scope": {"name": "tpuparquet.obs.trace"},
+            "spans": otlp_spans,
+        }],
+    }]}
+
+
+TRACE_FILE_FORMAT = "tpq-trace"
+
+
+def write_trace_file(spans: list[dict], path: str, *,
+                     ledgers: dict | None = None,
+                     anchor: dict | None = None) -> bool:
+    """Publish a span list atomically (tmp + ``os.replace`` via
+    :func:`~tpuparquet.obs.live.atomic_write_text` — telemetry must
+    never fail the scan it describes).  Format by suffix:
+    ``*.perfetto.json``/``*.chrome.json`` → Chrome trace events,
+    ``*.otlp.json`` → OTLP, else the native ``tpq-trace`` envelope
+    (spans + optional per-label attribution ledgers + the wall/perf
+    anchor) that ``parquet-tool doctor`` reads."""
+    from .live import atomic_write_text
+
+    if path.endswith((".perfetto.json", ".chrome.json")):
+        obj = spans_chrome_trace(spans)
+    elif path.endswith(".otlp.json"):
+        obj = spans_otlp(spans, anchor)
+    else:
+        obj = {"format": TRACE_FILE_FORMAT, "version": 1,
+               "spans": spans}
+        if anchor is not None:
+            obj["anchor"] = anchor
+        if ledgers is not None:
+            obj["ledgers"] = ledgers
+    return atomic_write_text(path, json.dumps(obj, sort_keys=True))
+
+
+def load_trace_file(path: str) -> tuple[list[dict], dict]:
+    """Read back a trace for analysis: the native ``tpq-trace``
+    envelope, a bare span list, or a Chrome trace whose args carry
+    the span ids (a ``*.perfetto.json`` export round-trips).  Returns
+    ``(spans, ledgers)``; raises ``ValueError`` for anything else."""
+    with open(path) as f:
+        try:
+            doc = json.load(f)
+        except json.JSONDecodeError as e:
+            raise ValueError(
+                f"trace file {path!r} is not valid JSON: {e}") from e
+    if isinstance(doc, list):
+        return doc, {}
+    if isinstance(doc, dict) and doc.get("format") == TRACE_FILE_FORMAT:
+        return list(doc.get("spans") or []), dict(doc.get("ledgers")
+                                                  or {})
+    if isinstance(doc, dict) and "traceEvents" in doc:
+        spans = []
+        for ev in doc["traceEvents"]:
+            if ev.get("ph") != "X":
+                continue
+            s = dict(ev.get("args") or {})
+            s.setdefault("name", ev.get("name"))
+            s["t0"] = ev.get("ts", 0.0) / 1e6
+            s["dur"] = ev.get("dur", 0.0) / 1e6
+            s["tid"] = ev.get("tid", 0)
+            if "span" not in s:
+                raise ValueError(
+                    f"{path!r}: Chrome trace without tpq span ids in "
+                    "args — re-export the native tpq-trace form for "
+                    "doctor analysis")
+            spans.append(s)
+        return spans, {}
+    raise ValueError(f"{path!r} is not a tpq trace export")
 
 
 def column_table(log: EventLog) -> list[dict]:

@@ -350,9 +350,31 @@ class LiveFold:
         self._base: dict = {}
         self._hist_base: dict[str, list[int]] = {}
 
-    def fold(self, st, reg: MetricsRegistry | None = None) -> None:
+    def delta_only(self, st) -> dict:
+        """Counter deltas since the last call, advancing the
+        baselines WITHOUT applying anything to a registry — the
+        attribution ledger's tracker for a USER collector, whose own
+        registry fold happens at its scope exit (folding it here too
+        would double-count)."""
+        delta: dict = {}
+        for f in st._MERGE_FIELDS:
+            v = getattr(st, f)
+            d = v - self._base.get(f, 0)
+            if d:
+                delta[f] = d
+                self._base[f] = v
+        return delta
+
+    def fold(self, st, reg: MetricsRegistry | None = None) -> dict:
+        """Fold the delta since the last fold; returns the counter
+        deltas applied (empty when disabled/flat) so a second exact
+        sink — the per-scan attribution ledger
+        (:mod:`~tpuparquet.obs.attribution`) — can account the SAME
+        numbers the registry received (conservation by
+        construction)."""
+        delta: dict = {}
         if not live_enabled():
-            return
+            return delta
         if reg is None:
             reg = registry()
         s = reg._shard()
@@ -363,6 +385,7 @@ class LiveFold:
             if d:
                 c[f] = c.get(f, 0) + d
                 self._base[f] = v
+                delta[f] = d
         for name, h in st.hists.items():
             base = self._hist_base.get(name)
             if base is None:
@@ -382,6 +405,7 @@ class LiveFold:
             if dt:
                 tot.total += dt
                 self._base[("hist_total", name)] = h.total
+        return delta
 
 
 # ----------------------------------------------------------------------

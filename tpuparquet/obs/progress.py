@@ -84,6 +84,7 @@ class ScanProgress:
         self.units_quarantined = 0
         self.rows_done = 0
         self.bytes_staged = 0
+        self.attribution: dict | None = None
         self.state = "pending"     # -> running -> done | error | stopped
 
     # -- ticks (called by the scan driver) -------------------------------
@@ -147,6 +148,15 @@ class ScanProgress:
         self._export()
         self._gauges()
 
+    def set_attribution(self, d: dict | None) -> None:
+        """Attach the scan's resource-attribution view (per-stage
+        cpu-seconds, bytes, peak arena — obs/attribution.py) to the
+        exported frames, so ``parquet-tool top`` shows the same
+        numbers the ledger accounts.  Updated at unit boundaries by
+        the scan drivers."""
+        with self._lock:
+            self.attribution = d
+
     def finish(self, state: str = "done") -> None:
         with self._lock:
             self.state = state
@@ -192,6 +202,7 @@ class ScanProgress:
             quarantined = self.units_quarantined
             bytes_staged = self.bytes_staged
             inflight = len(self._inflight)
+            attribution = self.attribution
         remaining = max(total - done, 0)
         eta = (remaining * ewma
                if (ewma is not None and state == "running") else None)
@@ -214,6 +225,7 @@ class ScanProgress:
             "ewma_unit_s": (None if ewma is None else round(ewma, 4)),
             "eta_s": (None if eta is None else round(eta, 3)),
             "stragglers": self.stragglers(),
+            "attribution": attribution,
         }
 
     # -- export (cross-process channel) -----------------------------------

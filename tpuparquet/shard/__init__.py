@@ -29,5 +29,7 @@ from .scan import (  # noqa: F401
 from .distributed import (  # noqa: F401
     MultiHostScan,
     allgather_host,
+    allgather_ledgers,
+    allgather_traces,
     process_units,
 )

@@ -164,6 +164,48 @@ def allgather_metrics(reg=None) -> "MetricsRegistry":
     return total
 
 
+def allgather_traces(spans=None) -> list[dict]:
+    """Fold every host's causal-trace spans
+    (:mod:`tpuparquet.obs.trace`) into one fleet-wide span list,
+    identical on every process — the tracing sibling of
+    :func:`allgather_metrics` (same wire: JSON over
+    :func:`allgather_bytes`).  Each span gains a ``proc`` field naming
+    its origin process (trace ids already embed the origin pid, so
+    merged trees never collide); parent/child links are host-local by
+    construction and survive the merge untouched.  ``spans`` defaults
+    to this process's tracer snapshot ([] when tracing is off —
+    the merge then returns only the hosts that traced)."""
+    import json as _json
+
+    from ..obs.trace import snapshot_spans
+
+    if spans is None:
+        spans = snapshot_spans()
+    payloads = allgather_bytes(_json.dumps(spans).encode())
+    merged: list[dict] = []
+    for i, p in enumerate(payloads):
+        for s in _json.loads(p):
+            s["proc"] = i
+            merged.append(s)
+    merged.sort(key=lambda s: (s.get("proc", 0), s.get("t0", 0.0)))
+    return merged
+
+
+def allgather_ledgers() -> dict:
+    """Fold every host's per-scan attribution ledgers
+    (:mod:`tpuparquet.obs.attribution`) into one fleet-wide
+    ``{label: ScanLedger}``, identical on every process: counters sum
+    label-wise (exact — the merged ledger equals the single-host
+    ledger of the union corpus), peaks fold as max (per-host arena
+    occupancy is concurrent, not additive)."""
+    import json as _json
+
+    from ..obs.attribution import ledgers_state, merge_ledger_states
+
+    payloads = allgather_bytes(_json.dumps(ledgers_state()).encode())
+    return merge_ledger_states([_json.loads(p) for p in payloads])
+
+
 class MultiHostScan(_DurableScanMixin):
     """Decode many files across processes *and* local devices.
 

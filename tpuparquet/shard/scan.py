@@ -29,6 +29,7 @@ from ..errors import (
 from ..faults import QuarantineReport
 from ..io.reader import FileReader
 from ..obs import recorder as _flightrec
+from ..obs import trace as _trace
 from ..obs.postmortem import postmortem_path_for, record_incident
 from ..obs.recorder import flight
 from ..kernels.decode import scatter_to_dense
@@ -462,6 +463,13 @@ def resilient_unit_scan(readers, units, device_for, *, start: int = 0,
 
     for k in range(start, len(units)):
         fi, rgi = units[k]
+        # causal trace: the resilient path decodes one unit at a time
+        # on the driving thread, so the unit span pushes the ambient
+        # context — retry/degrade/deadline children (including the
+        # deadline worker, which adopts this context) nest under it
+        usp = _trace.open_span("unit", unit=k, file=fi,
+                               row_group=rgi) \
+            if _trace._active is not None else None
 
         def _decode(k=k, fi=fi, rgi=rgi):
             # default_device is thread-local; the deadline wrapper may
@@ -481,6 +489,8 @@ def resilient_unit_scan(readers, units, device_for, *, start: int = 0,
                 out = _decode()
         except QUARANTINE_ERRORS as e:
             if never_quarantine(e):
+                _trace.close_span(usp, status="error",
+                                  error=type(e).__name__)
                 raise
             entry = quarantine.add(unit=k, file=fi, row_group=rgi,
                                    error=e)
@@ -512,8 +522,16 @@ def resilient_unit_scan(readers, units, device_for, *, start: int = 0,
                 if st.events is not None:
                     st.events.fault(site="shard.scan.unit",
                                     kind="quarantined", **entry)
+            _trace.close_span(usp, status="error", quarantined=True,
+                              error=type(e).__name__)
             yield k, None
             continue
+        except BaseException:
+            # raw crash types propagate — but never with a leaked
+            # ambient trace context
+            _trace.close_span(usp, status="error")
+            raise
+        _trace.close_span(usp)
         yield k, out
 
 
@@ -609,6 +627,24 @@ class DurableScanMixin:
                                      export=path or None)
         self._live_stats = DecodeStats() if live_enabled() else None
         self._live_fold = LiveFold()
+        # per-scan-label attribution ledger (obs/attribution.py): fed
+        # the SAME counter deltas the registry fold applies, so
+        # sum-over-ledgers equals the registry totals exactly; gated
+        # by the same live-metrics switch for that conservation
+        from ..obs import attribution as _attribution
+
+        self._ledger = (_attribution.ledger(label)
+                        if live_enabled() else None)
+        self._attr_fold = LiveFold()
+        self._attr_src = None
+        # scan-end trace export (TPQ_TRACE_EXPORT): per-label suffix
+        # exactly like the progress file, so concurrent scans and the
+        # multi-host drivers never clobber one shared export
+        tpath = _trace.trace_export_default()
+        if tpath and label != "scan":
+            tpath = f"{tpath}.{label_slug(label)}"
+        self._trace_export = tpath or None
+        self._trace_ctx = None
 
     def _adopted(self):
         """Context installing the scan's ambient collector for one
@@ -624,9 +660,62 @@ class DurableScanMixin:
     def _fold_live(self) -> None:
         """Incrementally fold the ambient collector's delta into the
         process registry (unit-boundary cadence: a Prometheus scrape
-        mid-scan sees the units decoded so far)."""
+        mid-scan sees the units decoded so far) AND the same delta
+        into this scan's attribution ledger — one delta, two exact
+        sinks, so per-scan ledgers sum to the registry totals."""
+        from ..stats import current_stats
+
+        delta = None
         if self._live_stats is not None:
-            self._live_fold.fold(self._live_stats)
+            delta = self._live_fold.fold(self._live_stats)
+        led = self._ledger
+        if led is None:
+            return
+        st = current_stats() or self._live_stats
+        if st is not None:
+            if st is self._live_stats:
+                attr_delta = delta or {}
+            else:
+                # a user collector shadows the ambient one: track its
+                # deltas with a dedicated baseline fold (registry gets
+                # the user scope's own fold at scope exit)
+                if st is not self._attr_src:
+                    from ..obs.live import LiveFold
+
+                    self._attr_src = st
+                    self._attr_fold = LiveFold()
+                attr_delta = self._attr_fold.delta_only(st)
+            if attr_delta:
+                led.fold_delta(attr_delta)
+        from ..kernels.arena import take_arena_peak
+
+        led.note_peak(take_arena_peak())
+        # the live surfaces see the same numbers: the progress frame
+        # (parquet-tool top) carries the ledger's cpu_s/bytes view
+        view = led.as_dict()
+        self.progress.set_attribution({
+            "cpu_s": view["cpu_s"],
+            "bytes": view["bytes"],
+            "peak_arena_bytes": led.peak_arena_bytes,
+        })
+
+    def _export_trace(self, troot) -> None:
+        """Publish this trace at scan end (``TPQ_TRACE_EXPORT``, the
+        per-label path resolved at init): the traced spans plus the
+        process attribution ledgers, atomically — the file
+        ``parquet-tool doctor`` walks.  Best-effort by contract."""
+        if troot is None or self._trace_export is None:
+            return
+        tr = _trace._active
+        if tr is None:
+            return
+        from ..obs.attribution import ledgers_snapshot
+        from ..obs.export import write_trace_file
+
+        write_trace_file(tr.snapshot(troot["trace"]),
+                         self._trace_export,
+                         ledgers=ledgers_snapshot(),
+                         anchor=tr.anchor())
 
     def _init_filter(self, filter, readers) -> None:
         """Shared filter plumbing: bind once against the (homogeneous)
@@ -686,7 +775,7 @@ class DurableScanMixin:
         from ..stats import current_stats
 
         prog = self.progress
-        nxt0, _ = self._progress()
+        nxt0, n_total = self._progress()
         if prog.units_done != nxt0 or prog.state != "pending":
             # a fresh drive of an already-used progress: run() after a
             # partial run_iter (cursor reset to 0), a cursor resume
@@ -695,6 +784,19 @@ class DurableScanMixin:
             # elapsed/rows_per_s describe this run, not the idle gap
             prog.restart(done=nxt0)
         prog.begin()
+        # causal trace root: one trace per drive; the sampling verdict
+        # is whole-trace, and every unit/stage span below parents into
+        # this root's context (None = tracing off or unsampled)
+        troot = None
+        if _trace._active is not None:
+            from ..kernels.device import _usable_cpus
+
+            troot = _trace.start_trace(
+                prog.label, units=n_total, resumed_at=nxt0,
+                usable_cpus=_usable_cpus())
+        self._trace_ctx = _trace.ctx_of(troot)
+        if self._ledger is not None:
+            self._ledger.scans += 1
         try:
             with self._adopted():
                 self._check_scan_deadline()
@@ -736,15 +838,21 @@ class DurableScanMixin:
         except GeneratorExit:
             prog.finish("stopped")
             self._fold_live()
+            _trace.end_trace(troot, status="cancelled")
+            self._export_trace(troot)
             raise
         except BaseException:
             prog.finish("error")
             self._fold_live()
+            _trace.end_trace(troot, status="error")
+            self._export_trace(troot)
             raise
         with self._adopted():
             self._flush_checkpoint()
         self._fold_live()
         prog.finish("done")
+        _trace.end_trace(troot)
+        self._export_trace(troot)
 
     # -- consumer-aligned gathers (scan-level placement default) ---------
 
@@ -766,22 +874,32 @@ class DurableScanMixin:
         """:func:`gather_column` over this scan's mesh, defaulting to
         the placement the scan was constructed with
         (``out_sharding="replicated"`` forces the seed replicated
-        gather past an armed default)."""
-        return gather_column(
-            self.mesh, results, path,
-            out_sharding=self._gather_placement(out_sharding,
-                                                gather_to))
+        gather past an armed default).  Runs under the scan's ambient
+        collector and trace context, so gather counters land in this
+        scan's attribution ledger and the gather span attaches to the
+        scan's trace."""
+        with self._adopted(), _trace.adopt(self._trace_ctx):
+            out = gather_column(
+                self.mesh, results, path,
+                out_sharding=self._gather_placement(out_sharding,
+                                                    gather_to))
+        self._fold_live()
+        return out
 
     def gather_byte_column(self, results, path: str, *,
                            out_sharding=None, gather_to=None):
         """:func:`gather_byte_column` over this scan's mesh,
         defaulting to the placement the scan was constructed with
         (``out_sharding="replicated"`` forces the seed replicated
-        gather past an armed default)."""
-        return gather_byte_column(
-            self.mesh, results, path,
-            out_sharding=self._gather_placement(out_sharding,
-                                                gather_to))
+        gather past an armed default).  Metered like
+        :meth:`gather_column`."""
+        with self._adopted(), _trace.adopt(self._trace_ctx):
+            out = gather_byte_column(
+                self.mesh, results, path,
+                out_sharding=self._gather_placement(out_sharding,
+                                                    gather_to))
+        self._fold_live()
+        return out
 
     def cursor_save(self, path: str | None = None) -> None:
         """Durably checkpoint :meth:`state` (atomic tmp + fsync +
@@ -1275,8 +1393,12 @@ def _assemble_and_gather(mesh, streams, placement=None,
             out = _assemble_direct(placement, streams, n_true, t_parts,
                                    out_row_shapes)
             jax.block_until_ready(out)
+            t1 = time.perf_counter()
             if st is not None:
-                st.gather_reshard_s += time.perf_counter() - t0
+                st.gather_reshard_s += t1 - t0
+            if _trace._active is not None:
+                _trace.emit_span("gather", t0, t1 - t0,
+                                 streams=len(out), direct=True)
             _count_gather(out, placement)
             return list(out), np.arange(n_true, dtype=np.int64)
     rows_per_block = U // n_rg
@@ -1331,8 +1453,13 @@ def _assemble_and_gather(mesh, streams, placement=None,
         out = _place_streams(mesh, stacked_all, placement, perm, n_true,
                              t_parts, out_row_shapes)
     jax.block_until_ready(out)
+    t1 = time.perf_counter()
     if st is not None:
-        st.gather_reshard_s += time.perf_counter() - t0
+        st.gather_reshard_s += t1 - t0
+    if _trace._active is not None:
+        _trace.emit_span("gather", t0, t1 - t0, streams=len(out),
+                         placement=("replicated" if placement is None
+                                    else "placed"))
     _count_gather(out, placement)
     return list(out), perm
 

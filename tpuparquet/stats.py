@@ -73,6 +73,13 @@ class DecodeStats:
     values: int = 0
     bytes_compressed: int = 0
     bytes_uncompressed: int = 0
+    # chunk bytes fetched from the source (FileReader.chunk_blob —
+    # in-memory views and file reads alike) and the wall spent
+    # fetching them (retry/hedge/deadline wait included): the
+    # read-side pair of plan_s/transfer_s, and the bytes_read half of
+    # the per-scan attribution ledger (obs/attribution.py)
+    bytes_read: int = 0
+    read_s: float = 0.0
     # bytes shipped host->device THROUGH THE BATCHED STAGER (counted at
     # transfer time, split/padding included) — the transfer-wall
     # observable: compressed-wire shipping shows up as bytes_staged <
@@ -225,6 +232,7 @@ class DecodeStats:
         "pages_device_planes", "pages_device_delta_lanes",
         "pages_device_encoded", "pages_host_values", "values",
         "bytes_compressed", "bytes_uncompressed", "bytes_staged",
+        "bytes_read", "read_s",
         "native_fallbacks", "pages_crc_verified", "crc_mismatches",
         "faults_injected", "io_retries", "dispatch_retries",
         "pages_degraded", "units_degraded", "units_quarantined",
@@ -287,6 +295,8 @@ class DecodeStats:
             "bytes_compressed": self.bytes_compressed,
             "bytes_uncompressed": self.bytes_uncompressed,
             "bytes_staged": self.bytes_staged,
+            "bytes_read": self.bytes_read,
+            "read_s": round(self.read_s, 6),
             "native_fallbacks": self.native_fallbacks,
             "pages_crc_verified": self.pages_crc_verified,
             "crc_mismatches": self.crc_mismatches,
