@@ -430,6 +430,79 @@ class TestRecorderGuardPass:
         """})
         assert recorderguard.run(t) == []
 
+    # -- the longitudinal vocabulary (obs/digest.py + obs/alerts.py)
+    #    rides the same pass: observe/emit_alert hot sites guard ------
+
+    def test_guarded_digest_observe_accepted(self):
+        t = _tree({"tpuparquet/shard/x.py": """
+            from ..obs import digest as _digest
+
+            def drive(units):
+                for u in units:
+                    if _digest._active is not None:
+                        _digest.observe("lab", "unit", u.wall,
+                                        unit=u.k)
+        """})
+        assert recorderguard.run(t) == []
+
+    def test_unguarded_digest_observe_flagged(self):
+        t = _tree({"tpuparquet/shard/x.py": """
+            from ..obs import digest as _digest
+
+            def drive(units):
+                for u in units:
+                    _digest.observe("lab", "unit", u.wall, unit=u.k)
+        """})
+        assert _keys(recorderguard.run(t), "unguarded-hot-flight") \
+            == ["drive:lab"]
+
+    def test_unguarded_emit_alert_flagged(self):
+        t = _tree({"tpuparquet/shard/x.py": """
+            from ..obs import alerts as _alerts
+
+            def drive(units):
+                for u in units:
+                    _alerts.emit_alert("straggler", unit=u.k)
+        """})
+        assert _keys(recorderguard.run(t), "unguarded-hot-flight") \
+            == ["drive:straggler"]
+
+    def test_digests_accessor_guard_accepted(self):
+        t = _tree({"tpuparquet/shard/x.py": """
+            from ..obs import digest as _digest
+
+            def drive(units):
+                for u in units:
+                    if _digest.digests() is not None:
+                        _digest.observe("lab", "unit", u.wall)
+        """})
+        assert recorderguard.run(t) == []
+
+    def test_bare_emit_alert_in_except_accepted(self):
+        t = _tree({"tpuparquet/shard/x.py": """
+            from ..obs.alerts import emit_alert
+
+            def drive(units):
+                for u in units:
+                    try:
+                        u.decode()
+                    except ValueError:
+                        emit_alert("quarantined", unit=u.k)
+        """})
+        assert recorderguard.run(t) == []
+
+    def test_digest_and_alert_modules_exempt(self):
+        # the emit surfaces' own internals call observe/emit_alert
+        # unguarded by construction — excluded like recorder/trace
+        t = _tree({"tpuparquet/obs/digest.py": """
+            def observe(label, stage, value, **coords):
+                reg = _active
+                if reg is None:
+                    return
+                reg.observe(label, stage, value, **coords)
+        """})
+        assert recorderguard.run(t) == []
+
 
 # ----------------------------------------------------------------------
 # thread-safety

@@ -815,3 +815,125 @@ class TestReviewFixes:
         assert snap["state"] == "done"
         assert snap["units_done"] == len(scan.units)
         assert snap["elapsed_s"] < 0.3  # fresh clock, no idle gap
+
+
+# ----------------------------------------------------------------------
+# Longitudinal feed: digests + ring against REAL scans
+# ----------------------------------------------------------------------
+
+class TestLongitudinalFeed:
+    """Round-17 pins: the time-series/digest feed must not perturb
+    the conservation contracts it reports on, and its cross-host
+    merges must be exact against real scan latencies."""
+
+    @pytest.fixture(autouse=True)
+    def disarm_longitudinal(self):
+        from tpuparquet.obs import attribution
+        from tpuparquet.obs import digest as _digest
+        from tpuparquet.obs import timeseries as _timeseries
+
+        attribution.reset_ledgers()
+        _digest.set_digests(False)
+        _timeseries.set_ring_dir(None)
+        yield
+        attribution.reset_ledgers()
+        _digest.set_digests(_digest.digest_enabled_default())
+        _timeseries.maybe_start_ring()
+
+    def _scan_host(self, paths, label):
+        """One simulated host: scan under its own digest registry."""
+        from tpuparquet.obs import digest as _digest
+
+        _digest.set_digests(True)
+        scan = ShardedScan(paths, progress_label=label)
+        scan.run()
+        state = _digest.digests().to_state()
+        _digest.set_digests(False)
+        return scan, state
+
+    def test_cross_host_digest_merge_exact(self, tmp_path):
+        """Per-host digest states merged (the allgather_digests fold)
+        equal a single registry fed every host's observations —
+        bucket-for-bucket, n-for-n, total-for-total."""
+        from tpuparquet.obs.digest import DigestRegistry
+
+        paths = [write_file(tmp_path / f"h{i}.parquet", seed=i * 11)
+                 for i in range(4)]
+        scan_a, sa = self._scan_host(paths[:2], "ha")
+        scan_b, sb = self._scan_host(paths[2:], "hb")
+        fleet = DigestRegistry()
+        fleet.merge_state(sa)
+        fleet.merge_state(sb)
+        # the union registry, fed the same per-host states one more
+        # time through a different merge order, must agree exactly
+        other = DigestRegistry()
+        other.merge_state(sb)
+        other.merge_state(sa)
+        fs, os_ = fleet.snapshot(), other.snapshot()
+        assert set(fs) == set(os_) >= {("ha", "unit"), ("hb", "unit"),
+                                       ("ha", "scan"), ("hb", "scan")}
+        for key in fs:
+            assert fs[key].counts == os_[key].counts, key
+            assert fs[key].n == os_[key].n
+            assert fs[key].total == os_[key].total
+        # and each label's digest carries exactly its host's units
+        assert fs[("ha", "unit")].n == len(scan_a.units)
+        assert fs[("hb", "unit")].n == len(scan_b.units)
+        assert fs[("ha", "scan")].n == 1
+
+    def test_ledger_conservation_with_ring_feed(self, tmp_path):
+        """The round-16 conservation pin re-verified with the full
+        longitudinal feed armed: sum-over-ledgers == registry totals,
+        and the ring's last frame reports the same numbers."""
+        from tpuparquet.obs import attribution
+        from tpuparquet.obs import digest as _digest
+        from tpuparquet.obs import timeseries as _timeseries
+        from tpuparquet.obs.timeseries import load_ring
+
+        _digest.set_digests(True)
+        ring_dir = str(tmp_path / "ring")
+        _timeseries.set_ring_dir(ring_dir)
+        paths = [write_file(tmp_path / f"l{i}.parquet", seed=i)
+                 for i in range(2)]
+        ShardedScan([paths[0]], progress_label="ta").run()
+        ShardedScan([paths[1]], progress_label="tb").run()
+        counters = live.registry().snapshot()["counters"]
+        sums: dict = {}
+        for state in attribution.ledgers_state().values():
+            for k, v in (state.get("counters") or {}).items():
+                sums[k] = sums.get(k, 0) + v
+        for key in ("row_groups", "pages", "values"):
+            assert sums.get(key, 0) == counters.get(key, 0), key
+        last = load_ring(ring_dir)[-1]
+        assert last["kind"] == "scan_end"
+        assert last["counters"]["row_groups"] == \
+            counters["row_groups"]
+        ring_sums = {}
+        for state in last["ledgers"].values():
+            for k, v in (state.get("counters") or {}).items():
+                ring_sums[k] = ring_sums.get(k, 0) + v
+        assert ring_sums.get("row_groups", 0) == \
+            counters["row_groups"]
+
+    def test_top_flags_dead_writer_by_mtime(self, tmp_path, capsys):
+        """Satellite pin: a running-state status file whose MTIME is
+        older than 2x its write interval means the writer is dead —
+        `top --once` must exit nonzero with a clear message.  (The
+        ts-backdate case with a FRESH mtime — a restored backup —
+        stays rc 0 with the STALE banner: see
+        test_top_flags_stale_running_frame.)"""
+        import time as _t
+
+        from tpuparquet.cli.parquet_tool import main as pt_main
+
+        p = progress.ScanProgress(4, export=str(tmp_path / "s.json"),
+                                  min_export_interval=0.0)
+        p.begin()
+        p.unit_started(0)
+        p.unit_done(0)
+        old = _t.time() - 3600
+        os.utime(tmp_path / "s.json", (old, old))
+        assert pt_main(["top", "--once",
+                        str(tmp_path / "s.json")]) == 1
+        err = capsys.readouterr().err
+        assert "stale" in err and "dead" in err

@@ -1,0 +1,585 @@
+"""Longitudinal telemetry: time-series ring, latency digests,
+SLO/burn-rate evaluation, alert rules, `parquet-tool watch`/`slo`.
+
+Covers the round's acceptance criteria:
+
+* ring frames carry exact per-frame deltas (summable to the
+  cumulative counters), rotation bounds disk, torn trailing lines and
+  process restarts are tolerated;
+* digest merges are EXACT (bucket-wise integer adds): per-thread and
+  per-host merges equal the single-shard digest of the union, and
+  quantiles stay within the fixed relative-error bound;
+* SLO windowing subtracts cumulative baselines only within one
+  process epoch; error budgets and burn rates follow;
+* threshold/absence/burn-rate rules fire exactly when their
+  condition holds, delivery is edge-triggered, and the alert record
+  is capped and atomic;
+* everything is off by default and armable at runtime without env.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from tpuparquet import FileWriter
+from tpuparquet.obs import alerts as _alerts
+from tpuparquet.obs import attribution, live
+from tpuparquet.obs import digest as _digest
+from tpuparquet.obs import slo as _slo
+from tpuparquet.obs import timeseries as _timeseries
+from tpuparquet.obs.digest import (
+    DigestRegistry,
+    QuantileDigest,
+    bucket_hi,
+    bucket_index,
+    bucket_lo,
+)
+from tpuparquet.obs.timeseries import MetricRing, load_ring
+
+SCHEMA = "message t { required int64 a; required double b; }"
+
+
+def write_file(path, rows=80, rg_rows=20, seed=0):
+    with open(path, "wb") as f:
+        w = FileWriter(f, SCHEMA, max_row_group_size=rg_rows * 20)
+        for j in range(rows):
+            w.add_data({"a": j + seed, "b": (j + seed) * 0.5})
+        w.close()
+    return str(path)
+
+
+@pytest.fixture(autouse=True)
+def fresh_longitudinal():
+    """Each test sees a fresh registry/ledgers and a DISARMED
+    ring/digest/engine (restored to env defaults after)."""
+    live.reset_registry()
+    attribution.reset_ledgers()
+    _digest.set_digests(False)
+    _timeseries.set_ring_dir(None)
+    _alerts.set_engine(None)
+    yield
+    live.reset_registry()
+    attribution.reset_ledgers()
+    _digest.set_digests(_digest.digest_enabled_default())
+    _timeseries.maybe_start_ring()
+    _alerts.set_engine(None)
+
+
+def frame(ts, pid=1, seq=0, kind="tick", counters=None, delta=None,
+          ledgers=None, digests=None):
+    """A hand-built ring frame (the loader envelope)."""
+    f = {"format": "tpq-timeseries", "version": 1, "ts": ts,
+         "pid": pid, "seq": seq, "kind": kind,
+         "counters": counters or {}, "delta": delta or {},
+         "gauges": {}}
+    if ledgers is not None:
+        f["ledgers"] = ledgers
+    if digests is not None:
+        f["digests"] = digests
+    return f
+
+
+def led(label, **counters):
+    return {"label": label, "scans": 1, "counters": counters,
+            "peak_arena_bytes": 0}
+
+
+# ----------------------------------------------------------------------
+# Digest math
+# ----------------------------------------------------------------------
+
+class TestDigestMath:
+    def test_bucket_containment(self):
+        vals = list(range(0, 4096)) + \
+            [10**k + r for k in range(4, 13) for r in (0, 1, 7, 999)]
+        for v in vals:
+            i = bucket_index(v)
+            assert bucket_lo(i) <= v < bucket_hi(i), v
+
+    def test_occupied_buckets_disjoint_and_ordered(self):
+        occupied = sorted({bucket_index(v) for v in range(0, 70000)})
+        prev_hi = None
+        for i in occupied:
+            lo, hi = bucket_lo(i), bucket_hi(i)
+            assert lo < hi
+            if prev_hi is not None:
+                assert lo >= prev_hi
+            prev_hi = hi
+
+    def test_merge_exact_and_order_independent(self):
+        import random
+        rng = random.Random(7)
+        xs = [rng.randrange(1, 10**7) for _ in range(500)]
+        a, b, whole = (QuantileDigest() for _ in range(3))
+        for i, v in enumerate(xs):
+            (a if i % 2 else b).observe(v)
+            whole.observe(v)
+        ab, ba = QuantileDigest(), QuantileDigest()
+        ab.merge_from(a), ab.merge_from(b)
+        ba.merge_from(b), ba.merge_from(a)
+        assert ab.counts == ba.counts == whole.counts
+        assert ab.n == whole.n == len(xs)
+        assert ab.total == whole.total == sum(xs)
+
+    def test_quantile_relative_error_bound(self):
+        d = QuantileDigest()
+        for v in range(1, 20001):
+            d.observe(v)
+        for q, exact in ((0.5, 10000), (0.9, 18000), (0.99, 19800)):
+            est = d.quantile(q)
+            # the estimate is the containing bucket's hi: never below
+            # the exact value, and within one sub-octave above
+            assert exact <= est <= exact * 1.15, (q, est)
+        # monotone in q
+        qs = [d.quantile(q / 10) for q in range(1, 10)]
+        assert qs == sorted(qs)
+
+    def test_dict_roundtrip(self):
+        d = QuantileDigest()
+        for v in (3, 99, 4096, 10**9):
+            d.observe(v, trace="t1", unit=4)
+        r = QuantileDigest.from_dict(
+            json.loads(json.dumps(d.as_dict())))
+        assert r.counts == d.counts and r.n == d.n \
+            and r.total == d.total
+        assert r.exemplars == d.exemplars
+
+    def test_exemplar_first_wins_and_merge_adopts(self):
+        a = QuantileDigest()
+        a.observe(100, trace="first", unit=1)
+        a.observe(101, trace="second", unit=2)  # same bucket: kept out
+        [ex] = a.exemplars.values()
+        assert ex["trace"] == "first" and ex["unit"] == 1
+        b = QuantileDigest()
+        b.observe(10**6, trace="far")
+        a.merge_from(b)
+        assert any(e.get("trace") == "far"
+                   for e in a.exemplars.values())
+
+
+# ----------------------------------------------------------------------
+# DigestRegistry: thread and host merges
+# ----------------------------------------------------------------------
+
+class TestDigestRegistry:
+    def test_thread_shards_fold_exactly(self):
+        reg = DigestRegistry()
+
+        def work(base):
+            for i in range(200):
+                reg.observe("lab", "unit", base + i)
+
+        ts = [threading.Thread(target=work, args=(k * 1000,))
+              for k in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        g = reg.snapshot()[("lab", "unit")]
+        assert g.n == 800
+        assert sum(g.counts.values()) == 800
+
+    def test_cross_host_merge_equals_single_host(self):
+        """allgather exactness: per-host states merged == the
+        single-host registry of the union, bucket-for-bucket."""
+        import random
+        rng = random.Random(13)
+        obs = [("t%d" % (i % 3), "unit", rng.randrange(1, 10**6))
+               for i in range(600)]
+        hosts = [DigestRegistry() for _ in range(3)]
+        single = DigestRegistry()
+        for i, (lb, st, v) in enumerate(obs):
+            hosts[i % 3].observe(lb, st, v)
+            single.observe(lb, st, v)
+        fleet = DigestRegistry()
+        for h in hosts:
+            fleet.merge_state(h.to_state())
+        fs, ss = fleet.snapshot(), single.snapshot()
+        assert set(fs) == set(ss)
+        for key in ss:
+            assert fs[key].counts == ss[key].counts, key
+            assert fs[key].n == ss[key].n
+            assert fs[key].total == ss[key].total
+
+    def test_allgather_digests_single_process(self):
+        from tpuparquet.shard.distributed import allgather_digests
+
+        reg = _digest.set_digests(True)
+        for v in (10, 20, 30):
+            _digest.observe("lab", "unit", v)
+        fleet = allgather_digests()
+        assert fleet.snapshot()[("lab", "unit")].n == 3
+        assert fleet.snapshot()[("lab", "unit")].counts == \
+            reg.snapshot()[("lab", "unit")].counts
+
+    def test_off_by_default_and_gate(self):
+        assert _digest.digests() is None
+        _digest.observe("lab", "unit", 5)  # no-op, no error
+        reg = _digest.set_digests(True)
+        _digest.observe("lab", "unit", 5)
+        assert reg.snapshot()[("lab", "unit")].n == 1
+        assert _digest.set_digests(False) is None
+        assert _digest.digests() is None
+
+
+# ----------------------------------------------------------------------
+# MetricRing on disk
+# ----------------------------------------------------------------------
+
+class TestMetricRing:
+    def test_deltas_sum_to_cumulative(self, tmp_path):
+        ring = MetricRing(str(tmp_path))
+        reg = live.registry()
+        for n in (3, 5, 7):
+            reg.counter("pages", n)
+            assert ring.append()
+        frames = load_ring(str(tmp_path))
+        assert [f["kind"] for f in frames] == ["tick"] * 3
+        assert [f["seq"] for f in frames] == [0, 1, 2]
+        assert [f["delta"].get("pages") for f in frames] == [3, 5, 7]
+        assert frames[-1]["counters"]["pages"] == 15
+        assert sum(f["delta"].get("pages", 0) for f in frames) == \
+            frames[-1]["counters"]["pages"]
+
+    def test_rotation_bounds_disk(self, tmp_path):
+        ring = MetricRing(str(tmp_path), segment_frames=4, segments=2)
+        for _ in range(40):
+            ring.append()
+        segs = _timeseries._list_segments(str(tmp_path))
+        assert len(segs) <= 2
+        frames = load_ring(str(tmp_path))
+        # bounded: at most segments * segment_frames survive, and the
+        # survivors are the NEWEST frames
+        assert len(frames) <= 8
+        assert frames[-1]["seq"] == 39
+
+    def test_torn_trailing_line_skipped(self, tmp_path):
+        ring = MetricRing(str(tmp_path))
+        ring.append()
+        ring.append()
+        [(_, seg)] = _timeseries._list_segments(str(tmp_path))
+        with open(seg, "ab") as f:
+            f.write(b'{"format": "tpq-timeseries", "ts": 1.0, "tru')
+        with open(seg, "ab") as f:
+            f.write(b"\nnot json either\n")
+        frames = load_ring(str(tmp_path))
+        assert len(frames) == 2  # torn + garbage skipped, not fatal
+
+    def test_restart_resumes_segments(self, tmp_path):
+        a = MetricRing(str(tmp_path), segment_frames=2, segments=4)
+        for _ in range(3):
+            a.append()
+        # "restart": a new appender on the same dir must not rewrite
+        # history — it opens a FRESH segment after what's on disk
+        b = MetricRing(str(tmp_path), segment_frames=2, segments=4)
+        b.append()
+        frames = load_ring(str(tmp_path))
+        assert len(frames) == 4
+        # the restart frame restarts seq (new epoch, same pid here)
+        assert [f["seq"] for f in frames] == [0, 1, 2, 0]
+
+    def test_env_arming_and_stand_down(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPQ_TIMESERIES_DIR", str(tmp_path))
+        r = _timeseries.maybe_start_ring()
+        assert r is not None and r.env_armed
+        monkeypatch.delenv("TPQ_TIMESERIES_DIR")
+        assert _timeseries.maybe_start_ring() is None
+
+    def test_runtime_ring_survives_env_recheck(self, tmp_path,
+                                               monkeypatch):
+        """set_ring_dir() is a runtime decision: scan-init's
+        maybe_start_ring() must not stand it down just because the
+        env knob is unset."""
+        monkeypatch.delenv("TPQ_TIMESERIES_DIR", raising=False)
+        r = _timeseries.set_ring_dir(str(tmp_path))
+        assert _timeseries.maybe_start_ring() is r
+        _timeseries.tick("tick")
+        assert len(load_ring(str(tmp_path))) == 1
+
+    def test_scan_end_frame_with_ledgers_and_digests(self, tmp_path):
+        from tpuparquet.shard.scan import ShardedScan
+
+        _digest.set_digests(True)
+        _timeseries.set_ring_dir(str(tmp_path / "ring"))
+        paths = [write_file(tmp_path / "f.parquet")]
+        scan = ShardedScan(paths, progress_label="lab")
+        scan.run()
+        frames = load_ring(str(tmp_path / "ring"))
+        ends = [f for f in frames if f["kind"] == "scan_end"]
+        assert ends, "scan end must flush a frame"
+        last = ends[-1]
+        assert "lab" in last["ledgers"]
+        dig = QuantileDigest.from_dict(last["digests"]["lab"]["unit"])
+        assert dig.n == len(scan.units)
+        # the ring's digest state IS the in-process state
+        live_dig = _digest.digests().snapshot()[("lab", "unit")]
+        assert dig.counts == live_dig.counts
+
+
+# ----------------------------------------------------------------------
+# SLO windowing + evaluation
+# ----------------------------------------------------------------------
+
+class TestSLO:
+    def test_load_objectives_defaults_and_validation(self, tmp_path):
+        p = tmp_path / "slo.json"
+        p.write_text(json.dumps([{"label": "lab",
+                                  "latency_target_ms": 50}]))
+        [o] = _slo.load_objectives(str(p))
+        assert o["label"] == "lab" and o["latency_p"] == 0.99
+        assert o["latency_stage"] == "unit"
+        assert o["error_rate_target"] is None
+        p.write_text(json.dumps([{"no_label": 1}]))
+        with pytest.raises(ValueError):
+            _slo.load_objectives(str(p))
+        p.write_text("{not json")
+        with pytest.raises(ValueError):
+            _slo.load_objectives(str(p))
+        assert _slo.load_objectives("") == []
+
+    def test_window_ledger_subtracts_baseline(self):
+        now = 10_000.0
+        frames = [
+            frame(now - 500, seq=0,
+                  ledgers={"lab": led("lab", row_groups=10,
+                                      units_quarantined=4)}),
+            frame(now - 10, seq=1,
+                  ledgers={"lab": led("lab", row_groups=30,
+                                      units_quarantined=5)}),
+        ]
+        # window covers only the second frame: baseline subtracted
+        w = _slo.window_ledger(frames, "lab", 100.0, now)
+        assert w == {"row_groups": 20, "units_quarantined": 1}
+        # window covers everything: raw cumulative
+        w = _slo.window_ledger(frames, "lab", 10_000.0, now)
+        assert w == {"row_groups": 30, "units_quarantined": 5}
+
+    def test_window_epoch_guard_on_restart(self):
+        """A pid change between baseline and last frame means the
+        counters reset — subtraction would go negative, so the
+        window falls back to the raw last cumulative."""
+        now = 10_000.0
+        frames = [
+            frame(now - 500, pid=1, seq=7,
+                  ledgers={"lab": led("lab", row_groups=90)}),
+            frame(now - 10, pid=2, seq=0,
+                  ledgers={"lab": led("lab", row_groups=3)}),
+        ]
+        w = _slo.window_ledger(frames, "lab", 100.0, now)
+        assert w == {"row_groups": 3}
+
+    def test_evaluate_budget_and_burn(self):
+        now = 10_000.0
+        d = QuantileDigest()
+        for v in (1000, 2000, 3000):  # µs
+            d.observe(v)
+        frames = [frame(
+            now - 10,
+            ledgers={"lab": led("lab", row_groups=95,
+                                units_quarantined=5)},
+            digests={"lab": {"unit": d.as_dict()}})]
+        objectives = _slo_objs()
+        rep = _slo.evaluate(frames, objectives, now=now)
+        [row] = rep["objectives"]
+        lat, err = row["latency"], row["errors"]
+        assert lat["ok"] is True and lat["n"] == 3
+        assert lat["value_ms"] <= 50.0
+        # 5 errors over 100 attempts = 5%; target 10% -> OK
+        assert err["rate"] == pytest.approx(0.05)
+        assert err["ok"] is True
+        assert row["budget"]["allowed"] == pytest.approx(10.0)
+        assert row["budget"]["remaining_fraction"] == \
+            pytest.approx(0.5)
+        assert row["burn"]["fast"] == pytest.approx(0.5)
+        # render path
+        text = _slo.format_report(rep)
+        assert "lab" in text and "budget" in text and "burn" in text
+
+    def test_evaluate_no_data_is_no_verdict(self):
+        rep = _slo.evaluate([], _slo_objs(), now=1000.0)
+        [row] = rep["objectives"]
+        assert row["latency"]["ok"] is None
+        assert row["errors"]["ok"] is None
+
+
+def _slo_objs():
+    return [{"label": "lab", "latency_stage": "unit",
+             "latency_p": 0.99, "latency_target_ms": 50.0,
+             "error_rate_target": 0.10, "window_s": 3600.0}]
+
+
+# ----------------------------------------------------------------------
+# Alert rules + engine
+# ----------------------------------------------------------------------
+
+class TestAlerts:
+    def _frames(self, now, quarantined=0):
+        return [frame(now - 5, ledgers={"lab": led(
+            "lab", row_groups=20, units_quarantined=quarantined)})]
+
+    def test_threshold_rule_per_label(self):
+        now = 10_000.0
+        rule = _alerts.AlertRule("q", "threshold", label="lab",
+                                 counter="units_quarantined",
+                                 value=1, window_s=600.0)
+        assert rule.check(self._frames(now, 0), now) is None
+        a = rule.check(self._frames(now, 3), now)
+        assert a is not None and a["name"] == "q"
+        assert a["label"] == "lab"
+
+    def test_threshold_rule_global_delta(self):
+        now = 10_000.0
+        frames = [frame(now - 5, delta={"units_quarantined": 2})]
+        rule = _alerts.AlertRule("q", "threshold",
+                                 counter="units_quarantined",
+                                 value=2, window_s=600.0)
+        assert rule.check(frames, now) is not None
+        assert rule.check(frames, now + 10_000) is None  # aged out
+
+    def test_absence_rule(self):
+        now = 10_000.0
+        rule = _alerts.AlertRule("dead", "absence", window_s=60.0)
+        assert rule.check([], now) is not None
+        assert rule.check([frame(now - 5)], now) is None
+        assert rule.check([frame(now - 500)], now) is not None
+
+    def test_burn_rate_rule(self):
+        now = 10_000.0
+        rule = _alerts.AlertRule("burn", "burn_rate", label="lab",
+                                 error_rate_target=0.01,
+                                 threshold=2.0)
+        # 3/23 ~ 13% >> 2 * 1%: both windows burn
+        a = rule.check(self._frames(now, 3), now)
+        assert a is not None and a["fast_burn"] > 2.0
+        assert rule.check(self._frames(now, 0), now) is None
+
+    def test_engine_edge_triggered_delivery(self, tmp_path):
+        now = 10_000.0
+        seen = []
+        eng = _alerts.AlertEngine(
+            [_alerts.AlertRule("q", "threshold", label="lab",
+                               counter="units_quarantined", value=1,
+                               window_s=600.0)],
+            sinks=[seen.append], record_path="")
+        bad = self._frames(now, 2)
+        assert [a["name"] for a in eng.evaluate(bad, now=now)] == ["q"]
+        eng.evaluate(bad, now=now + 1)      # still firing: level view
+        assert len(seen) == 1               # ...but delivered ONCE
+        eng.evaluate(self._frames(now + 2, 0), now=now + 2)  # clears
+        eng.evaluate(self._frames(now + 3, 9), now=now + 3)  # refires
+        assert len(seen) == 2
+        # `since` pins the episode start, not the evaluation time
+        assert seen[0]["since"] == now
+
+    def test_sink_exception_never_breaks_evaluation(self):
+        def bad_sink(alert):
+            raise RuntimeError("sink down")
+
+        eng = _alerts.AlertEngine(
+            [_alerts.AlertRule("dead", "absence", window_s=60.0)],
+            sinks=[bad_sink], record_path="")
+        assert eng.evaluate([], now=1000.0)  # no raise
+
+    def test_record_cap_and_atomicity(self, tmp_path):
+        path = str(tmp_path / "alerts.json")
+        for i in range(_alerts.ALERT_CAP + 10):
+            _alerts.record_alert(path, {"name": f"a{i}", "ts": i})
+        doc = _alerts.load_alerts(path)
+        assert doc["format"] == "tpq-alerts"
+        assert len(doc["alerts"]) == _alerts.ALERT_CAP
+        # capped from the FRONT: the newest survive
+        assert doc["alerts"][-1]["name"] == \
+            f"a{_alerts.ALERT_CAP + 9}"
+
+    def test_emit_alert_gate(self, tmp_path):
+        _alerts.emit_alert("noop")  # engine off: no-op, no error
+        path = str(tmp_path / "rec.json")
+        _alerts.set_engine(_alerts.AlertEngine([], record_path=path))
+        _alerts.emit_alert("manual", severity="ticket", detail="x")
+        [a] = _alerts.load_alerts(path)["alerts"]
+        assert a["name"] == "manual" and a["severity"] == "ticket"
+
+    def test_default_rules_cover_objectives(self):
+        rules = _alerts.default_rules(_slo_objs())
+        kinds = {(r.name, r.kind) for r in rules}
+        assert ("telemetry_absent", "absence") in kinds
+        assert ("burn_lab", "burn_rate") in kinds
+
+
+# ----------------------------------------------------------------------
+# Exporter grid + final flush (the snapshot-writer feed)
+# ----------------------------------------------------------------------
+
+class TestExporterFeed:
+    def test_grid_delay_aligns_to_interval(self):
+        gd = live._grid_delay
+        assert gd(1003.2, 10.0) == pytest.approx(6.8)
+        assert gd(1000.0, 10.0) == pytest.approx(10.0)
+        # too close to the tick: skip to the NEXT grid point so two
+        # wakeups never land on one tick
+        assert gd(1009.95, 10.0) == pytest.approx(10.05)
+        for now in (0.0, 3.3, 9.99, 1234.5678):
+            assert 1.0 <= gd(now, 10.0) <= 11.0
+
+    def test_final_flush_appends_final_frame(self, tmp_path):
+        _timeseries.set_ring_dir(str(tmp_path))
+        live.registry().counter("pages", 2)
+        live._final_flush()
+        frames = load_ring(str(tmp_path))
+        assert frames and frames[-1]["kind"] == "final"
+        assert frames[-1]["counters"]["pages"] == 2
+
+    def test_final_flush_disarmed_is_noop(self, tmp_path):
+        live._final_flush()  # ring off: must not raise or write
+        assert load_ring(str(tmp_path)) == []
+
+
+# ----------------------------------------------------------------------
+# parquet-tool watch / slo report
+# ----------------------------------------------------------------------
+
+class TestWatchCLI:
+    def _record_ring(self, tmp_path):
+        from tpuparquet.shard.scan import ShardedScan
+
+        _digest.set_digests(True)
+        ring_dir = str(tmp_path / "ring")
+        _timeseries.set_ring_dir(ring_dir)
+        ShardedScan([write_file(tmp_path / "w.parquet")],
+                    progress_label="lab").run()
+        return ring_dir
+
+    def test_watch_once_renders_red_view(self, tmp_path, capsys):
+        from tpuparquet.cli.parquet_tool import main as pt_main
+
+        ring_dir = self._record_ring(tmp_path)
+        assert pt_main(["watch", "--once", ring_dir]) == 0
+        out = capsys.readouterr().out
+        assert "lab" in out
+
+    def test_watch_once_empty_ring_fails(self, tmp_path):
+        from tpuparquet.cli.parquet_tool import main as pt_main
+
+        assert pt_main(["watch", "--once",
+                        str(tmp_path / "nothing")]) == 1
+
+    def test_slo_report_verdict_exit_codes(self, tmp_path, capsys):
+        from tpuparquet.cli.parquet_tool import main as pt_main
+
+        ring_dir = self._record_ring(tmp_path)
+        ok_slo = tmp_path / "ok.json"
+        ok_slo.write_text(json.dumps([{
+            "label": "lab", "latency_target_ms": 10 ** 6,
+            "error_rate_target": 1.0}]))
+        assert pt_main(["slo", "report", "--slo", str(ok_slo),
+                        ring_dir]) == 0
+        assert "OK" in capsys.readouterr().out
+        bad_slo = tmp_path / "bad.json"
+        bad_slo.write_text(json.dumps([{
+            "label": "lab", "latency_target_ms": 0.000001}]))
+        assert pt_main(["slo", "report", "--slo", str(bad_slo),
+                        ring_dir]) == 2
+        assert "VIOLATED" in capsys.readouterr().out

@@ -13,13 +13,22 @@ docstrings) is to guard the call itself::
     if _trace._active is not None:
         _trace.emit_span("read", t0, dt, file=..., column=...)
 
-This pass enforces the pattern structurally, for BOTH vocabularies:
+The longitudinal layer keeps the same discipline for its own emit
+surfaces — ``obs.digest.observe`` (per-unit/per-scan latency
+observations) and ``obs.alerts.emit_alert``::
+
+    if _digest._active is not None:
+        _digest.observe(label, "unit", us, trace=..., unit=...)
+
+This pass enforces the pattern structurally, for ALL vocabularies:
 
 * every *module-qualified* call (``<alias>.flight(...)`` /
-  ``<alias>.emit_span(...)`` / ``<alias>.open_span(...)`` — the form
+  ``<alias>.emit_span(...)`` / ``<alias>.open_span(...)`` /
+  ``<alias>.observe(...)`` / ``<alias>.emit_alert(...)`` — the form
   hot sites use precisely so they can reach ``_active``) must sit
   under an ``if`` whose test checks ``_active is not None`` (or
-  ``recorder()``/``tracer()`` is not None);
+  ``recorder()``/``tracer()``/``digests()``/``engine()`` is not
+  None);
 * every *bare* ``flight(...)``/``emit_span(...)`` call that lives
   inside a ``for``/``while`` loop is treated as hot and held to the
   same rule — unless it is on an exceptional path (inside an
@@ -39,11 +48,14 @@ from .astutil import Finding, RepoTree, ancestors, enclosing_function
 
 PASS = "recorder-guard"
 
-EXCLUDE = ("tpuparquet/obs/recorder.py", "tpuparquet/obs/trace.py")
+EXCLUDE = ("tpuparquet/obs/recorder.py", "tpuparquet/obs/trace.py",
+           "tpuparquet/obs/digest.py", "tpuparquet/obs/alerts.py")
 
 #: call names held to the guarded-hot-site rule (the kwargs-building
-#: emit surfaces of the flight recorder and the causal tracer)
-HOT_NAMES = ("flight", "emit_span", "open_span")
+#: emit surfaces of the flight recorder, the causal tracer, the
+#: latency digests, and the alert engine)
+HOT_NAMES = ("flight", "emit_span", "open_span", "observe",
+             "emit_alert")
 
 
 def _is_guard_test(test: ast.AST) -> bool:
@@ -57,7 +69,7 @@ def _is_guard_test(test: ast.AST) -> bool:
             f = node.func
             name = f.attr if isinstance(f, ast.Attribute) \
                 else f.id if isinstance(f, ast.Name) else None
-            if name in ("recorder", "tracer"):
+            if name in ("recorder", "tracer", "digests", "engine"):
                 return True
     return False
 

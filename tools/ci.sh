@@ -1,7 +1,7 @@
 #!/bin/bash
 # In-repo CI gate (counterpart of the reference's .circleci/config.yml,
 # which pins go versions and runs `go test ./...` + the compatibility
-# corpus per commit).  Twelve stages, pinned env:
+# corpus per commit).  Thirteen stages, pinned env:
 #
 #   1. tier-1 suite   — the ROADMAP.md verify command, gated on a PASS
 #                       FLOOR rather than rc: optional deps (zstandard,
@@ -78,6 +78,16 @@
 #                       TPQ_TRACE=1 (armed tracing must not change a
 #                       byte), and the bench sentinel in check mode
 #                       against the committed noise-aware baseline
+#  13. soak smoke       — strict (rc=0): tools/soak.py at the small
+#                       default (4 concurrent labeled scans, corrupt-
+#                       page + hang/deadline fault plans): every
+#                       injected fault class must fire its matching
+#                       alert rule with zero false-negatives (and the
+#                       clean tenants'/absence rules zero false-
+#                       positives), per-label digests and ledgers
+#                       must sum exactly to process totals, and the
+#                       decoded output must be byte-identical to a
+#                       telemetry-off leg
 #
 # Usage: bash tools/ci.sh            (exit 0 = gate passed)
 # The tier-1 stage mirrors ROADMAP.md exactly — if you change one,
@@ -100,7 +110,7 @@ CI_PASS_FLOOR=${CI_PASS_FLOOR:-1000}
 
 fail() { echo "ci.sh: FAILED at stage $1" >&2; exit 1; }
 
-echo "=== stage 1/12: tier-1 suite (pass floor $CI_PASS_FLOOR) ==="
+echo "=== stage 1/13: tier-1 suite (pass floor $CI_PASS_FLOOR) ==="
 rm -f /tmp/_t1.log
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
@@ -114,25 +124,25 @@ echo "DOTS_PASSED=$passed"
 [ "$passed" -ge "$CI_PASS_FLOOR" ] \
   || fail "tier-1 ($passed passed < floor $CI_PASS_FLOOR)"
 
-echo "=== stage 2/12: smoke bench (CPU backend, tiny target) ==="
+echo "=== stage 2/13: smoke bench (CPU backend, tiny target) ==="
 TPQ_BENCH_TARGET=60000 TPQ_BENCH_CPU=1 timeout -k 10 600 \
   python bench.py > /tmp/_ci_bench.json || fail "smoke bench"
 tail -1 /tmp/_ci_bench.json
 
-echo "=== stage 3/12: crash corpus + fault-injection matrix (strict) ==="
+echo "=== stage 3/13: crash corpus + fault-injection matrix (strict) ==="
 timeout -k 10 600 python -m pytest \
   "tests/test_corpus.py::TestCrashRegressions" tests/test_faults.py \
   -q -p no:cacheprovider || fail "corpus/faults"
 
-echo "=== stage 4/12: salvage + strict metadata (strict) ==="
+echo "=== stage 4/13: salvage + strict metadata (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_salvage.py \
   -q -p no:cacheprovider || fail "salvage"
 
-echo "=== stage 5/12: deadlines/hedging + kill-resume checkpoints (strict) ==="
+echo "=== stage 5/13: deadlines/hedging + kill-resume checkpoints (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_deadline.py \
   tests/test_checkpoint.py -q -p no:cacheprovider || fail "time/crash"
 
-echo "=== stage 6/12: plan matrix: serial vs parallel, cache on (strict) ==="
+echo "=== stage 6/13: plan matrix: serial vs parallel, cache on (strict) ==="
 # leg A: pinned-serial planning (the TPQ_PLAN_THREADS=1 reference path)
 TPQ_PLAN_THREADS=1 timeout -k 10 600 python -m pytest \
   tests/test_plan_parallel.py tests/test_plan_cache.py \
@@ -143,7 +153,7 @@ TPQ_PLAN_CACHE_MB=64 timeout -k 10 600 python -m pytest \
   tests/test_plan_parallel.py tests/test_fallback_matrix.py \
   -q -p no:cacheprovider || fail "plan matrix (cache-on leg)"
 
-echo "=== stage 7/12: live obs gate + overhead guard (strict) ==="
+echo "=== stage 7/13: live obs gate + overhead guard (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_live_obs.py \
   tests/test_env_docs.py -q -p no:cacheprovider || fail "live obs"
 # overhead guard: the always-on default must stay within a generous
@@ -154,7 +164,7 @@ timeout -k 10 600 python tools/bench_obs.py --values 2000000 \
   || fail "obs overhead guard"
 tail -5 /tmp/_ci_obs.json
 
-echo "=== stage 8/12: pruning parity gate (strict) ==="
+echo "=== stage 8/13: pruning parity gate (strict) ==="
 # leg A: the whole pushdown suite (write/read page index + bloom,
 # verdicts, late materialization, counter exactness, corrupt-index
 # degrade, pyarrow interop) on the default pool width
@@ -167,13 +177,13 @@ TPQ_PLAN_THREADS=1 TPQ_PRUNE=0 timeout -k 10 600 python -m pytest \
   "tests/test_prune.py::TestParity" \
   -q -p no:cacheprovider || fail "pruning parity (prune-off leg)"
 
-echo "=== stage 9/12: tpq-analyze invariant passes + sanitizer leg (strict) ==="
+echo "=== stage 9/13: tpq-analyze invariant passes + sanitizer leg (strict) ==="
 timeout -k 10 300 python -m tools.analyze || fail "tpq-analyze"
 timeout -k 10 600 python -m pytest tests/test_analyze.py \
   -q -p no:cacheprovider || fail "analyzer self-test"
 timeout -k 10 900 bash tools/analyze/native.sh || fail "native sanitizers"
 
-echo "=== stage 10/12: gather placement parity gate (strict) ==="
+echo "=== stage 10/13: gather placement parity gate (strict) ==="
 # leg A: the placement suite — byte parity placed vs replicated across
 # filter/quarantine/salvage/resume/multi-host, placement + counter pins,
 # mesh-mismatch errors
@@ -186,7 +196,7 @@ TPQ_GATHER_TO=0 timeout -k 10 600 python -m pytest \
   tests/test_gather_placement.py \
   -q -p no:cacheprovider || fail "gather placement (env leg)"
 
-echo "=== stage 11/12: write-pipeline parity gate (strict) ==="
+echo "=== stage 11/13: write-pipeline parity gate (strict) ==="
 # leg A: the whole native-write suite on the default knobs
 timeout -k 10 600 python -m pytest tests/test_write_native.py \
   -q -p no:cacheprovider || fail "write parity"
@@ -197,7 +207,7 @@ TPQ_WRITE_NATIVE=0 timeout -k 10 600 python -m pytest \
   tests/test_write_native.py -q -p no:cacheprovider \
   || fail "write parity (native-off leg)"
 
-echo "=== stage 12/12: causal tracing + attribution + bench sentinel (strict) ==="
+echo "=== stage 12/13: causal tracing + attribution + bench sentinel (strict) ==="
 # leg A: the trace/attribution suite on the default (trace-off) env —
 # span-tree connectivity, adversity-matrix propagation, ledger
 # conservation, doctor goldens
@@ -216,5 +226,14 @@ TPQ_TRACE=1 timeout -k 10 900 python -m pytest \
 # ratio pins (prune >= floor) enforced even on a different box
 timeout -k 10 600 python tools/bench_sentinel.py --check \
   || fail "bench sentinel"
+
+echo "=== stage 13/13: soak smoke: faults -> alerts, exact sums, byte identity (strict) ==="
+# N=4 concurrent labeled scans with the deterministic fault plan
+# (CorruptPage on one tenant's unique column, hang + unit deadline on
+# another tenant's file).  Asserts the whole longitudinal contract:
+# alert coverage without false negatives OR false positives, digest/
+# ledger conservation to process totals, telemetry-off byte identity.
+timeout -k 10 600 python -m tools.soak --scans 4 \
+  || fail "soak smoke"
 
 echo "ci.sh: gate PASSED"

@@ -1,0 +1,361 @@
+#!/usr/bin/env python
+"""Soak harness: N concurrent labeled scans under fault injection.
+
+ROADMAP item 1's done-criterion made executable: drive ``--scans``
+concurrent labeled ``ShardedScan`` tenants over a generated corpus
+with DETERMINISTIC fault injection (``faults.py`` sites), record a
+time-series ring, and assert the whole longitudinal observability
+contract end to end:
+
+* **alert coverage, zero false-negatives** — every injected fault
+  class surfaces as its matching alert rule (CorruptPage → the
+  corrupt tenant's ``units_quarantined`` threshold rule; the hang +
+  unit-deadline combination → the deadline tenant's
+  ``deadline_exceeded`` threshold rule; plus a burn-rate rule on the
+  corrupt tenant's shredded error budget), and zero
+  false-POSITIVES — the clean tenants' rules and the absence rule
+  must stay silent;
+* **digest conservation** — per-label unit-latency digests carry
+  exactly one observation per driven unit and sum (exact
+  bucket-wise merge) to the process totals;
+* **ledger conservation** — per-label attribution ledgers sum
+  counter-for-counter to the live registry totals (the round-16 pin,
+  now under concurrent multi-tenant load with the ring feed on);
+* **telemetry neutrality** — decoded output is byte-identical to a
+  leg run with every telemetry surface off (live metrics, digests,
+  ring).
+
+Determinism under concurrency: fault rules target a tenant through
+structure, not timing — the corrupt rule matches the column name
+only tenant ``corrupt``'s schema has, the hang rule matches tenant
+``deadline``'s file path, and both fire on EVERY matching call
+(``times`` unbounded), so thread interleaving cannot reassign a
+fault between legs.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python -m tools.soak \
+        [--scans 4] [--rows 120] [--units 4] [--json] [--keep DIR]
+
+Exit 0 = every assertion held; nonzero prints what broke.  The CI
+soak-smoke gate (``tools/ci.sh`` stage 13) runs exactly this at the
+defaults.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+#: tenant roles by index: 1 eats corrupt pages, 2 eats hangs bounded
+#: by a unit deadline, every other tenant must stay clean
+CORRUPT_TENANT = 1
+DEADLINE_TENANT = 2
+UNIT_DEADLINE_S = 0.2
+HANG_S = 5.0
+
+
+def tenant_label(i: int) -> str:
+    return f"tenant_{i}"
+
+
+def _tenant_schema(i: int) -> str:
+    # the corrupt tenant's int column gets a UNIQUE name so the fault
+    # rule can target it by structure (see module docstring)
+    return (f"message soak {{ required int64 k{i}; "
+            f"required double b; }}")
+
+
+def build_corpus(root: str, scans: int, rows: int,
+                 units: int) -> dict[str, list[str]]:
+    """One file per tenant, ``units`` row groups each (each row group
+    is one scan unit)."""
+    from tpuparquet import FileWriter
+
+    rg_rows = max(rows // units, 1)
+    corpus: dict[str, list[str]] = {}
+    for i in range(scans):
+        path = os.path.join(root, f"tenant{i}.parquet")
+        with open(path, "wb") as f:
+            w = FileWriter(f, _tenant_schema(i),
+                           max_row_group_size=rg_rows * 20)
+            for j in range(rows):
+                w.add_data({f"k{i}": i * 10_000 + j, "b": j * 0.5})
+            w.close()
+        corpus[tenant_label(i)] = [path]
+    return corpus
+
+
+def _output_digest(results) -> str:
+    """Stable byte digest of a scan's decoded output: every unit's
+    every column's numpy buffers, in order."""
+    import numpy as np
+
+    h = hashlib.sha256()
+    for out in results:
+        for name in sorted(out):
+            for arr in out[name].to_numpy():
+                if arr is not None:
+                    h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
+
+
+def _arm_rules(inj, corpus: dict[str, list[str]]) -> None:
+    """The deterministic fault plan (every matching call fires)."""
+    inj.inject("kernels.device.page_payload", "corrupt",
+               match={"column": f"k{CORRUPT_TENANT}"}, times=10**9)
+    inj.inject("io.chunk.hang", "hang", seconds=HANG_S,
+               match={"file": corpus[tenant_label(DEADLINE_TENANT)][0]},
+               times=10**9)
+
+
+def run_leg(corpus: dict[str, list[str]], *, telemetry: bool,
+            ring_dir: str | None) -> dict:
+    """One soak leg: every tenant scans concurrently under the fault
+    plan.  Returns per-label output digests, quarantine counts, and
+    the scans' own progress tallies."""
+    from tpuparquet.faults import inject_faults
+    from tpuparquet.obs import attribution, live
+    from tpuparquet.obs import digest as _digest
+    from tpuparquet.obs import timeseries as _timeseries
+    from tpuparquet.shard.scan import ShardedScan
+
+    live.reset_registry()
+    attribution.reset_ledgers()
+    _digest.set_digests(telemetry)
+    _timeseries.set_ring_dir(ring_dir if telemetry else None)
+    prev_live = os.environ.get("TPQ_LIVE_METRICS")
+    if not telemetry:
+        os.environ["TPQ_LIVE_METRICS"] = "0"
+    results: dict[str, dict] = {}
+    errors: list[BaseException] = []
+
+    def drive(label: str, paths: list[str]) -> None:
+        try:
+            idx = int(label.rsplit("_", 1)[1])
+            scan = ShardedScan(
+                paths, on_error="quarantine", retries=0,
+                progress_label=label,
+                unit_deadline=(UNIT_DEADLINE_S
+                               if idx == DEADLINE_TENANT else None))
+            out = scan.run()
+            results[label] = {
+                "digest": _output_digest(out),
+                "units_done": scan.progress.units_done,
+                "units_quarantined": scan.progress.units_quarantined,
+                "quarantine": len(scan.quarantine),
+            }
+        except BaseException as e:  # surfaced by the main thread
+            errors.append(e)
+
+    try:
+        with inject_faults() as inj:
+            _arm_rules(inj, corpus)
+            threads = [threading.Thread(target=drive, args=(lb, ps),
+                                        name=f"soak-{lb}")
+                       for lb, ps in sorted(corpus.items())]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+    finally:
+        if not telemetry:
+            if prev_live is None:
+                os.environ.pop("TPQ_LIVE_METRICS", None)
+            else:
+                os.environ["TPQ_LIVE_METRICS"] = prev_live
+    if errors:
+        raise errors[0]
+    return results
+
+
+def check_soak(corpus: dict[str, list[str]], on: dict, off: dict,
+               ring_dir: str, alerts_path: str) -> list[str]:
+    """Every assertion of the soak contract; returns failure strings
+    (empty = pass)."""
+    from tpuparquet.obs import attribution, live
+    from tpuparquet.obs import digest as _digest
+    from tpuparquet.obs.alerts import AlertEngine, AlertRule
+    from tpuparquet.obs.digest import QuantileDigest
+    from tpuparquet.obs.timeseries import load_ring
+
+    bad: list[str] = []
+    labels = sorted(corpus)
+    t_corrupt = tenant_label(CORRUPT_TENANT)
+    t_deadline = tenant_label(DEADLINE_TENANT)
+
+    # -- telemetry neutrality: byte-identical outputs ------------------
+    for lb in labels:
+        if on[lb]["digest"] != off[lb]["digest"]:
+            bad.append(f"output of {lb} differs between telemetry-on "
+                       f"and telemetry-off legs")
+        if on[lb]["units_quarantined"] != off[lb]["units_quarantined"]:
+            bad.append(f"quarantine count of {lb} differs between "
+                       f"legs (fault plan not deterministic)")
+
+    # -- the faults actually landed ------------------------------------
+    if not on[t_corrupt]["units_quarantined"]:
+        bad.append("corrupt tenant saw no quarantined units — the "
+                   "fault plan did not fire")
+    if not on[t_deadline]["units_quarantined"]:
+        bad.append("deadline tenant saw no quarantined units — the "
+                   "hang/deadline plan did not fire")
+
+    # -- alert coverage: one rule per fault class + clean/absence ------
+    frames = load_ring(ring_dir)
+    if not frames:
+        bad.append(f"time-series ring {ring_dir} is empty")
+        return bad
+    week = 7 * 24 * 3600.0
+    rules = [
+        AlertRule("corrupt_pages", "threshold", label=t_corrupt,
+                  counter="units_quarantined", value=1, window_s=week),
+        AlertRule("deadline_expiries", "threshold", label=t_deadline,
+                  counter="deadline_exceeded", value=1, window_s=week),
+        AlertRule("budget_burn", "burn_rate", label=t_corrupt,
+                  error_rate_target=0.001, threshold=1.0),
+        AlertRule("telemetry_absent", "absence", window_s=week),
+    ]
+    for lb in labels:
+        if lb not in (t_corrupt, t_deadline):
+            rules.append(AlertRule(
+                f"clean_{lb}", "threshold", label=lb,
+                counter="units_quarantined", value=1, window_s=week))
+    engine = AlertEngine(rules, record_path=alerts_path)
+    firing = {a["name"] for a in engine.evaluate(frames)}
+    for required in ("corrupt_pages", "deadline_expiries",
+                     "budget_burn"):
+        if required not in firing:
+            bad.append(f"fault class behind rule {required!r} did "
+                       f"not fire its alert (false negative)")
+    for lb in labels:
+        if lb not in (t_corrupt, t_deadline) \
+                and f"clean_{lb}" in firing:
+            bad.append(f"clean tenant {lb} fired a quarantine alert "
+                       f"(false positive)")
+    if "telemetry_absent" in firing:
+        bad.append("absence rule fired against a live ring "
+                   "(false positive)")
+
+    # -- digest conservation: one observation per unit, exact sums -----
+    reg = _digest.digests()
+    snap = {} if reg is None else reg.snapshot()
+    total = QuantileDigest()
+    n_units = 0
+    for lb in labels:
+        g = snap.get((lb, "unit"))
+        done = on[lb]["units_done"]
+        n_units += done
+        if g is None:
+            bad.append(f"no unit digest for {lb}")
+            continue
+        if g.n != done:
+            bad.append(f"unit digest of {lb} has n={g.n}, scan drove "
+                       f"{done} units")
+        total.merge_from(g)
+    if total.n != n_units:
+        bad.append(f"merged per-label digests n={total.n} != process "
+                   f"total {n_units}")
+    if sum(total.counts.values()) != total.n:
+        bad.append("merged digest bucket counts do not sum to n")
+    # the last ring frame's digest state equals the in-process state
+    last_digests = frames[-1].get("digests") or {}
+    for lb in labels:
+        g = snap.get((lb, "unit"))
+        ring_d = (last_digests.get(lb) or {}).get("unit")
+        if g is not None and ring_d is not None:
+            rd = QuantileDigest.from_dict(ring_d)
+            if rd.counts != g.counts or rd.n != g.n \
+                    or rd.total != g.total:
+                bad.append(f"ring-frame digest of {lb} differs from "
+                           f"the in-process digest bucket-for-bucket")
+
+    # -- ledger conservation under the ring feed -----------------------
+    counters = live.registry().snapshot()["counters"]
+    led_sums: dict = {}
+    for state in attribution.ledgers_state().values():
+        for k, v in (state.get("counters") or {}).items():
+            led_sums[k] = led_sums.get(k, 0) + v
+    for key in ("row_groups", "pages", "values", "units_quarantined",
+                "deadline_exceeded"):
+        if led_sums.get(key, 0) != counters.get(key, 0):
+            bad.append(f"ledger sum of {key} ({led_sums.get(key, 0)}) "
+                       f"!= registry total ({counters.get(key, 0)})")
+    return bad
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scans", type=int, default=4,
+                    help="concurrent labeled scans (tenants); >= 4 "
+                         "so clean tenants exist beside the faulted "
+                         "two")
+    ap.add_argument("--rows", type=int, default=120,
+                    help="rows per tenant file")
+    ap.add_argument("--units", type=int, default=4,
+                    help="row groups (scan units) per tenant file")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable result")
+    ap.add_argument("--keep", metavar="DIR", default="",
+                    help="run inside DIR and leave the corpus, ring "
+                         "and alert records behind for inspection")
+    args = ap.parse_args(argv)
+    if args.scans < 4:
+        print("soak: --scans must be >= 4 (two faulted tenants + "
+              "clean controls)", file=sys.stderr)
+        return 2
+
+    root = args.keep or tempfile.mkdtemp(prefix="tpq-soak-")
+    os.makedirs(root, exist_ok=True)
+    ring_dir = os.path.join(root, "ring")
+    alerts_path = os.path.join(root, "alerts.json")
+    t0 = time.time()
+    try:
+        corpus = build_corpus(root, args.scans, args.rows, args.units)
+        # telemetry-off leg FIRST: it must not see the ring/digest
+        # state the on leg arms
+        off = run_leg(corpus, telemetry=False, ring_dir=None)
+        on = run_leg(corpus, telemetry=True, ring_dir=ring_dir)
+        failures = check_soak(corpus, on, off, ring_dir, alerts_path)
+        result = {
+            "scans": args.scans,
+            "units_per_scan": args.units,
+            "wall_s": round(time.time() - t0, 3),
+            "tenants": {lb: {k: v for k, v in on[lb].items()
+                             if k != "digest"} for lb in sorted(on)},
+            "failures": failures,
+            "ok": not failures,
+        }
+        if args.json:
+            print(json.dumps(result, sort_keys=True))
+        else:
+            for lb in sorted(on):
+                r = on[lb]
+                print(f"{lb}: {r['units_done']} units, "
+                      f"{r['units_quarantined']} quarantined")
+            for f in failures:
+                print(f"FAIL: {f}", file=sys.stderr)
+            print(f"soak {'PASS' if not failures else 'FAIL'} "
+                  f"({args.scans} scans, {result['wall_s']}s)")
+        return 0 if not failures else 1
+    finally:
+        from tpuparquet.obs import digest as _digest
+        from tpuparquet.obs import timeseries as _timeseries
+
+        _digest.set_digests(_digest.digest_enabled_default())
+        _timeseries.maybe_start_ring()
+        if not args.keep:
+            shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

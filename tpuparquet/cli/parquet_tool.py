@@ -7,9 +7,12 @@ Subcommand parity with the reference's cobra tool
 validation), ``profile`` (per-column transport/gate/timing telemetry
 with JSON-lines/Perfetto/``--json`` exports and ``--from-events``
 replay of a saved log), ``top`` (live view of a running scan's
-exported progress), ``meta --strict`` (metadata validator findings
-with nonzero exit) and ``rescue`` (rewrite a torn file's recoverable
-row groups into a clean file) — TPU-build additions.
+exported progress), ``watch`` (RED view + budgets + alerts over a
+time-series ring), ``slo report`` (error-budget/burn-rate evaluation
+with nonzero exit on violation), ``meta --strict`` (metadata
+validator findings with nonzero exit) and ``rescue`` (rewrite a torn
+file's recoverable row groups into a clean file) — TPU-build
+additions.
 
 Run as ``python -m tpuparquet.cli.parquet_tool <cmd> <file>``.
 """
@@ -585,6 +588,7 @@ def cmd_top(args, out=None) -> int:
     while True:
         frames = []
         missing = []
+        dead_files = []
         for path in args.status:
             try:
                 f = read_progress_file(path)
@@ -603,18 +607,161 @@ def cmd_top(args, out=None) -> int:
                               10.0 * (f.get("ewma_unit_s") or 0.0))
             if f.get("state") == "running" and age > stale_after:
                 f["_stale_s"] = age
+            # the harder verdict keys on the FILE's mtime, not the
+            # frame's ts (a restored backup carries an old ts with a
+            # fresh mtime; only the mtime says whether any writer is
+            # alive): a running frame whose file hasn't been touched
+            # for 2x its write interval means the writer is gone, and
+            # --once must not hand a script old numbers with rc 0
+            if f.get("state") == "running":
+                try:
+                    m_age = _time.time() - os.path.getmtime(path)
+                except OSError:
+                    m_age = None
+                write_iv = max(f.get("ewma_unit_s") or 0.0,
+                               5.0, interval)
+                if m_age is not None and m_age > 2.0 * write_iv:
+                    dead_files.append((path, m_age))
+                    f["_stale_s"] = max(f.get("_stale_s") or 0.0,
+                                        m_age)
             frames.append(f)
         if frames:
             print(render_top_frame(frames), file=out)
         for path in missing:
             print(f"(waiting for {path})", file=out)
         if once:
+            if dead_files:
+                for path, m_age in dead_files:
+                    print(f"parquet-tool top: {path} is stale "
+                          f"(not written for {m_age:.0f}s, > 2x its "
+                          f"write interval) — the scan is likely "
+                          f"dead; numbers above are old",
+                          file=sys.stderr)
+                return 1
             return 0 if frames else 1
         if frames and not missing and \
                 all(f["state"] != "running" for f in frames):
             return 0
         _time.sleep(interval)
         print(file=out)
+
+
+def render_watch(frames: list[dict], objectives: list[dict],
+                 alerts: list[dict], now: float) -> str:
+    """One ``watch`` screen: the RED view (rate / errors / duration)
+    per scan label over the fast window, error-budget state per
+    objective, and whatever is firing."""
+    from ..obs.slo import (
+        DEFAULT_FAST_WINDOW_S,
+        evaluate,
+        window_digest,
+        window_ledger,
+    )
+
+    lines = []
+    if not frames:
+        return "(no frames in ring)"
+    last = frames[-1]
+    labels = sorted(set(last.get("ledgers") or {})
+                    | set(last.get("digests") or {}))
+    w = DEFAULT_FAST_WINDOW_S
+    lines.append(f"RED over last {w:g}s "
+                 f"({len(frames)} frames in ring)")
+    for label in labels:
+        if label == "deadline":
+            continue  # expiry-site digests, not a scan label
+        led = window_ledger(frames, label, w, now)
+        attempts = led.get("row_groups", 0) \
+            + led.get("units_quarantined", 0)
+        errors = led.get("units_quarantined", 0) \
+            + led.get("deadline_exceeded", 0)
+        dig = window_digest(frames, label, "unit", w, now)
+        dur = ("-" if not dig.n
+               else f"p50 {dig.quantile(0.5) / 1000.0:.0f}ms / "
+                    f"p99 {dig.quantile(0.99) / 1000.0:.0f}ms")
+        lines.append(
+            f"  {label}: rate {attempts / w:.2f} units/s  "
+            f"errors {errors}"
+            + (f" ({errors / attempts * 100.0:.2f}%)" if attempts
+               else "")
+            + f"  duration {dur}")
+    if objectives:
+        report = evaluate(frames, objectives, now)
+        for row in report["objectives"]:
+            b = row.get("budget")
+            if b is None:
+                continue
+            burn = row.get("burn") or {}
+            f_burn = burn.get("fast")
+            lines.append(
+                f"  budget {row['label']}: "
+                f"{b['remaining_fraction'] * 100.0:.1f}% remaining"
+                + (f"  burn {f_burn:.1f}x" if f_burn is not None
+                   else ""))
+    for a in alerts:
+        label = f" label={a['label']}" if a.get("label") else ""
+        lines.append(f"  FIRING [{a.get('severity', 'page')}] "
+                     f"{a['name']}{label}: {a.get('msg', '')}")
+    return "\n".join(lines)
+
+
+def cmd_watch(args, out=None) -> int:
+    """Live RED view over a time-series ring (``TPQ_TIMESERIES_DIR``):
+    per-label rate/errors/duration, error-budget remaining per SLO
+    objective, and firing alerts — the one screen an operator tails
+    during an incident.  ``--once`` renders a single screen and exits
+    (nonzero when the ring is empty).  No reference analogue — the
+    serve-regime face of the longitudinal telemetry layer."""
+    import time as _time
+
+    from ..obs.alerts import AlertEngine, default_rules
+    from ..obs.slo import load_objectives
+    from ..obs.timeseries import load_ring
+
+    out = out or sys.stdout
+    interval = max(getattr(args, "interval", 2.0), 0.05)
+    objectives = load_objectives(args.slo or None)
+    engine = AlertEngine(default_rules(objectives), record_path="")
+    while True:
+        frames = load_ring(args.ring)
+        now = _time.time()
+        alerts = engine.evaluate(frames, now) if frames else []
+        print(render_watch(frames, objectives, alerts, now), file=out)
+        if getattr(args, "once", False):
+            return 0 if frames else 1
+        _time.sleep(interval)
+        print(file=out)
+
+
+def cmd_slo(args, out=None) -> int:
+    """Evaluate SLO objectives over a saved time-series ring and
+    print the report (error budgets, burn rates, latency verdicts).
+    ``report`` is the only action today.  Exits nonzero when any
+    objective is in violation — scriptable as a release gate."""
+    import json as _json
+
+    from ..obs.slo import evaluate, format_report, load_objectives
+    from ..obs.timeseries import load_ring
+
+    out = out or sys.stdout
+    if args.action != "report":
+        raise ValueError(f"unknown slo action {args.action!r} "
+                         f"(expected 'report')")
+    objectives = load_objectives(args.slo or None)
+    if not objectives:
+        raise ValueError("no SLO objectives: pass --slo FILE or set "
+                         "TPQ_SLO_FILE")
+    frames = load_ring(args.ring)
+    report = evaluate(frames, objectives)
+    if getattr(args, "json", False):
+        print(_json.dumps(report, sort_keys=True), file=out)
+    else:
+        print(format_report(report), file=out)
+    violated = any(
+        (row.get("latency") or {}).get("ok") is False
+        or (row.get("errors") or {}).get("ok") is False
+        for row in report["objectives"])
+    return 2 if violated else 0
 
 
 def cmd_doctor(args, out=None) -> int:
@@ -978,6 +1125,35 @@ def build_parser() -> argparse.ArgumentParser:
                     help="progress status file(s) a scan exports via "
                          "progress_export= / TPQ_PROGRESS_EXPORT")
     tp.set_defaults(fn=cmd_top)
+
+    w = sub.add_parser(
+        "watch",
+        help="live RED view (rate/errors/duration, budgets, alerts) "
+             "over a time-series ring directory")
+    w.add_argument("--once", action="store_true",
+                   help="render one screen and exit")
+    w.add_argument("--interval", type=float, default=2.0,
+                   help="refresh interval in seconds")
+    w.add_argument("--slo", default="",
+                   help="SLO objectives JSON (default: TPQ_SLO_FILE)")
+    w.add_argument("ring",
+                   help="time-series ring directory a process records "
+                        "via TPQ_TIMESERIES_DIR")
+    w.set_defaults(fn=cmd_watch)
+
+    so = sub.add_parser(
+        "slo",
+        help="evaluate SLO objectives over a saved time-series ring "
+             "(error budgets, burn rates); nonzero exit on violation")
+    so.add_argument("action", choices=["report"],
+                    help="what to do (report: print the evaluation)")
+    so.add_argument("--slo", default="",
+                    help="SLO objectives JSON (default: TPQ_SLO_FILE)")
+    so.add_argument("--json", action="store_true",
+                    help="emit the machine-readable report")
+    so.add_argument("ring",
+                    help="time-series ring directory to evaluate")
+    so.set_defaults(fn=cmd_slo)
 
     dr = sub.add_parser(
         "doctor",

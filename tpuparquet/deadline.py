@@ -269,6 +269,7 @@ def record_expiry(st, site: str, elapsed: float, budget: float,
     the single owner of the expiry-recording contract (used by the
     watchdog paths here and the scan-level budget in
     ``shard.scan.DurableScanMixin``)."""
+    from .obs import digest as _digest
     from .obs.recorder import flight
     from .obs.trace import emit_span
 
@@ -281,6 +282,12 @@ def record_expiry(st, site: str, elapsed: float, budget: float,
     emit_span("deadline_exceeded", time.perf_counter(), 0.0,
               status="error", site=site, elapsed_s=round(elapsed, 3),
               budget_s=budget, **coords)
+    # and the latency digest: the expired wall lands in the site's
+    # distribution (it IS the tail the SLO is about), keyed under the
+    # deadline stage so it never pollutes the unit/scan series
+    if _digest._active is not None:
+        _digest.observe("deadline", site, int(elapsed * 1e6),
+                        budget_s=budget, **_scan_coords(coords))
     if st is None:
         return
     st.deadline_exceeded += 1

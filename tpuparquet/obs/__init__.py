@@ -33,6 +33,23 @@ Two regimes share this package:
   snapshot) beside the durable cursor when quarantine/salvage/
   deadline events fire.
 
+**Longitudinal** (the time dimension — SLOs, budgets, paging):
+
+* :mod:`~tpuparquet.obs.timeseries` — the bounded on-disk ring of
+  delta-aware metric snapshots (``TPQ_TIMESERIES_DIR``), fed by the
+  snapshot writer's ticks and by scan-end flushes.
+* :mod:`~tpuparquet.obs.digest` — mergeable latency quantile digests
+  (``TPQ_LATENCY_DIGEST``): per-label/per-stage unit and scan walls
+  in fixed sub-octave buckets (~6% relative), exact merges across
+  threads and hosts, exemplars linking hot buckets to trace ids.
+* :mod:`~tpuparquet.obs.slo` — declarative objectives
+  (``TPQ_SLO_FILE``) evaluated over the ring into error budgets and
+  multi-window burn rates.
+* :mod:`~tpuparquet.obs.alerts` — threshold/absence/burn-rate rules
+  with stdout/file/callback sinks and atomic capped alert records
+  (``TPQ_ALERTS_EXPORT``); ``parquet-tool watch`` renders all of it
+  live.
+
 Entry points::
 
     with tpuparquet.collect_stats(events=True) as st:
@@ -82,6 +99,16 @@ from .export import (  # noqa: F401
     write_chrome_trace,
     write_trace_file,
 )
+from .alerts import (  # noqa: F401
+    AlertEngine,
+    AlertRule,
+    emit_alert,
+    load_alerts,
+    record_alert,
+)
+from .alerts import engine as alert_engine  # noqa: F401
+from .digest import DigestRegistry, QuantileDigest, observe  # noqa: F401
+from .digest import digests as latency_digests  # noqa: F401
 from .histogram import Histogram, N_BUCKETS  # noqa: F401
 from .live import (  # noqa: F401
     MetricsRegistry,
@@ -90,6 +117,17 @@ from .live import (  # noqa: F401
     live_enabled,
     registry,
 )
+from .slo import (  # noqa: F401
+    evaluate as evaluate_slo,
+    format_report as format_slo_report,
+    load_objectives,
+)
+from .timeseries import (  # noqa: F401
+    MetricRing,
+    load_ring,
+    tick,
+)
+from .timeseries import ring as metric_ring  # noqa: F401
 from .postmortem import (  # noqa: F401
     load_postmortem,
     postmortem_path_for,
@@ -126,4 +164,9 @@ __all__ = [
     "stage_seconds", "diagnose", "format_diagnosis",
     "ScanProgress", "read_progress_file",
     "record_incident", "postmortem_path_for", "load_postmortem",
+    "QuantileDigest", "DigestRegistry", "observe", "latency_digests",
+    "MetricRing", "load_ring", "tick", "metric_ring",
+    "AlertEngine", "AlertRule", "emit_alert", "alert_engine",
+    "record_alert", "load_alerts",
+    "evaluate_slo", "format_slo_report", "load_objectives",
 ]

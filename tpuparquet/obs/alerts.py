@@ -1,0 +1,372 @@
+"""Rule-based alerting over the ring: the thing that pages.
+
+A small, dependency-free rule engine in the Prometheus-alerting
+shape, evaluated over time-series ring frames
+(``obs/timeseries.py``).  Three rule kinds cover the serve regime's
+paging needs:
+
+* **threshold** — a windowed counter delta crossed a bound
+  (``units_quarantined >= 1 in 300s``).  Per-label rules read the
+  ledger cumulatives; global rules sum the frames' exact ``delta``
+  maps.
+* **absence** — the signal went away: no frame landed inside the
+  window (writer silent — the exporter died with the process), or a
+  counter that should be moving didn't.
+* **burn_rate** — the SRE-workbook multi-window page: the error
+  budget is burning faster than ``threshold``× in BOTH the fast and
+  slow windows (fast catches it now, slow confirms it's not a blip).
+
+Delivery is **sinks** — plain callables taking the alert dict.
+:func:`stdout_sink` prints one line; :func:`file_sink` appends to
+the postmortem-style atomic alert record (capped JSON document,
+tmp + ``os.replace``, oldest dropped) that ``TPQ_ALERTS_EXPORT``
+also arms process-wide; any callback does anything else.  The
+engine is edge-triggered per sink (an alert firing across ten
+evaluations delivers once, with ``since`` carrying the first firing
+time) while :meth:`AlertEngine.evaluate` always returns the full
+currently-firing list (``parquet-tool watch`` renders state, not
+edges).
+
+Push path: library code emits ad-hoc alerts through
+:func:`emit_alert` — off by default behind the one-is-None gate
+(armed by ``TPQ_ALERTS_EXPORT``), call-guarded at hot sites with
+``_alerts._active is not None`` per the recorder-guard discipline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["AlertRule", "AlertEngine", "emit_alert", "engine",
+           "set_engine", "alerts_export_default", "default_rules",
+           "record_alert", "load_alerts", "stdout_sink", "file_sink",
+           "ALERT_CAP"]
+
+ALERT_CAP = 64
+
+_OPS = {
+    ">=": lambda a, b: a >= b,
+    ">": lambda a, b: a > b,
+    "<=": lambda a, b: a <= b,
+    "<": lambda a, b: a < b,
+    "==": lambda a, b: a == b,
+}
+
+
+def alerts_export_default() -> str | None:
+    """Alert-record path from ``TPQ_ALERTS_EXPORT`` (None = off)."""
+    return os.environ.get("TPQ_ALERTS_EXPORT") or None
+
+
+# ----------------------------------------------------------------------
+# Durable alert records (postmortem discipline: atomic, capped)
+# ----------------------------------------------------------------------
+
+# serializes the load-append-write: concurrent scans share one record
+# file, and an unlocked read-modify-write would drop the loser's alert
+_record_lock = threading.Lock()
+
+
+def record_alert(path: str | None, alert: dict) -> str | None:
+    """Append one alert to the record file at ``path`` (no-op → None
+    when ``path`` is None).  Read-modify-write under the atomic
+    tmp + ``os.replace`` discipline, capped at :data:`ALERT_CAP`
+    (oldest dropped); ``OSError`` swallowed — best-effort telemetry."""
+    if not path:
+        return None
+    from .live import atomic_write_text
+
+    with _record_lock:
+        try:
+            doc = load_alerts(path)
+        except (OSError, ValueError):
+            doc = {"format": "tpq-alerts", "version": 1, "alerts": []}
+        doc["alerts"].append(alert)
+        if len(doc["alerts"]) > ALERT_CAP:
+            doc["alerts"] = doc["alerts"][-ALERT_CAP:]
+        if not atomic_write_text(path, json.dumps(doc, sort_keys=True)):
+            return None
+    return path
+
+
+def load_alerts(path: str) -> dict:
+    """Read an alert record file back, validating the envelope."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or doc.get("format") != "tpq-alerts":
+        raise ValueError(f"{path!r} is not a tpq alert record")
+    doc.setdefault("alerts", [])
+    return doc
+
+
+# ----------------------------------------------------------------------
+# Sinks
+# ----------------------------------------------------------------------
+
+def stdout_sink(alert: dict) -> None:
+    """One line per newly-firing alert, greppable."""
+    label = f" label={alert['label']}" if alert.get("label") else ""
+    print(f"ALERT [{alert.get('severity', 'page')}] "
+          f"{alert['name']}{label}: {alert.get('msg', '')}", flush=True)
+
+
+def file_sink(path: str):
+    """A sink appending to the atomic alert record at ``path``."""
+    def sink(alert: dict) -> None:
+        record_alert(path, alert)
+    return sink
+
+
+# ----------------------------------------------------------------------
+# Rules
+# ----------------------------------------------------------------------
+
+def _global_delta(frames: list[dict], counter: str,
+                  window_s: float, now: float) -> float:
+    """Windowed delta of a global registry counter: the frames' exact
+    per-frame ``delta`` maps are summable by construction."""
+    lo = now - window_s
+    return sum(f.get("delta", {}).get(counter, 0)
+               for f in frames if f.get("ts", 0.0) > lo)
+
+
+class AlertRule:
+    """One declarative rule; see the module docstring for kinds.
+
+    Normalized fields: ``name``, ``kind``, ``severity``; threshold
+    rules add ``counter``/``op``/``value``/``window_s`` and optional
+    ``label``; absence rules add ``window_s`` and optional
+    ``counter``; burn-rate rules add ``label``/
+    ``error_rate_target``/``threshold``."""
+
+    def __init__(self, name: str, kind: str, *, severity: str = "page",
+                 label: str | None = None, counter: str | None = None,
+                 op: str = ">=", value: float = 1.0,
+                 window_s: float = 300.0,
+                 error_rate_target: float = 0.001,
+                 threshold: float = 1.0):
+        if kind not in ("threshold", "absence", "burn_rate"):
+            raise ValueError(f"unknown alert rule kind {kind!r}")
+        if kind == "threshold" and counter is None:
+            raise ValueError(f"threshold rule {name!r} needs a counter")
+        if op not in _OPS:
+            raise ValueError(f"unknown threshold op {op!r}")
+        self.name = name
+        self.kind = kind
+        self.severity = severity
+        self.label = label
+        self.counter = counter
+        self.op = op
+        self.value = value
+        self.window_s = window_s
+        self.error_rate_target = error_rate_target
+        self.threshold = threshold
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "AlertRule":
+        d = dict(d)
+        return cls(d.pop("name"), d.pop("kind"), **d)
+
+    def check(self, frames: list[dict], now: float) -> dict | None:
+        """Evaluate against the ring; a firing rule returns the alert
+        dict (without ``since`` — the engine owns firing state)."""
+        if self.kind == "threshold":
+            return self._check_threshold(frames, now)
+        if self.kind == "absence":
+            return self._check_absence(frames, now)
+        return self._check_burn(frames, now)
+
+    def _alert(self, msg: str, **fields) -> dict:
+        a = {"name": self.name, "kind": self.kind,
+             "severity": self.severity, "msg": msg}
+        if self.label:
+            a["label"] = self.label
+        a.update(fields)
+        return a
+
+    def _check_threshold(self, frames: list[dict],
+                         now: float) -> dict | None:
+        from .slo import window_ledger
+
+        if self.label:
+            v = window_ledger(frames, self.label, self.window_s,
+                              now).get(self.counter, 0)
+        else:
+            v = _global_delta(frames, self.counter, self.window_s, now)
+        if _OPS[self.op](v, self.value):
+            return self._alert(
+                f"{self.counter} {self.op} {self.value:g} over "
+                f"{self.window_s:g}s (observed {v:g})",
+                counter=self.counter, observed=v)
+        return None
+
+    def _check_absence(self, frames: list[dict],
+                       now: float) -> dict | None:
+        lo = now - self.window_s
+        recent = [f for f in frames if f.get("ts", 0.0) > lo]
+        if not recent:
+            return self._alert(
+                f"no telemetry frame in {self.window_s:g}s "
+                f"(writer silent)", observed=0)
+        if self.counter is not None:
+            v = _global_delta(frames, self.counter, self.window_s, now)
+            if not v:
+                return self._alert(
+                    f"{self.counter} flat over {self.window_s:g}s",
+                    counter=self.counter, observed=0)
+        return None
+
+    def _check_burn(self, frames: list[dict],
+                    now: float) -> dict | None:
+        from .slo import (DEFAULT_FAST_WINDOW_S, DEFAULT_SLOW_WINDOW_S,
+                          _error_rate, window_ledger)
+
+        target = self.error_rate_target
+        if target <= 0 or not self.label:
+            return None
+        burns = []
+        for ws in (DEFAULT_FAST_WINDOW_S, DEFAULT_SLOW_WINDOW_S):
+            rate, _, _ = _error_rate(
+                window_ledger(frames, self.label, ws, now))
+            burns.append(None if rate is None else rate / target)
+        fast, slow = burns
+        if fast is not None and slow is not None \
+                and fast >= self.threshold and slow >= self.threshold:
+            return self._alert(
+                f"error budget burning {fast:.1f}x (fast) / "
+                f"{slow:.1f}x (slow), threshold {self.threshold:g}x",
+                fast_burn=fast, slow_burn=slow)
+        return None
+
+
+def default_rules(objectives: list[dict]) -> list[AlertRule]:
+    """The standing rule set ``parquet-tool watch`` arms: one
+    burn-rate rule per objective with an error target, plus one
+    absence rule on the writer itself."""
+    rules = [AlertRule("telemetry_absent", "absence", window_s=60.0,
+                       severity="ticket")]
+    for o in objectives:
+        if o.get("error_rate_target"):
+            rules.append(AlertRule(
+                f"burn_{o['label']}", "burn_rate", label=o["label"],
+                error_rate_target=o["error_rate_target"]))
+    return rules
+
+
+# ----------------------------------------------------------------------
+# Engine
+# ----------------------------------------------------------------------
+
+class AlertEngine:
+    """Holds the rules, the firing state, and the sinks.
+
+    :meth:`evaluate` is level-style (returns everything currently
+    firing); sink delivery is edge-style (each alert delivered once
+    per firing episode).  Thread-safe — watch loops and the soak
+    harness evaluate from wherever."""
+
+    def __init__(self, rules: list[AlertRule] | None = None,
+                 sinks: list | None = None,
+                 record_path: str | None = None):
+        self._lock = threading.Lock()
+        self.rules: list[AlertRule] = list(rules or [])
+        self.sinks = list(sinks or [])
+        self.record_path = (record_path if record_path is not None
+                            else alerts_export_default())
+        self._firing: dict[tuple, float] = {}   # key -> since ts
+
+    def evaluate(self, frames: list[dict],
+                 now: float | None = None) -> list[dict]:
+        """Run every rule; return the currently-firing alerts (each
+        carrying ``ts`` and ``since``); deliver newly-firing ones to
+        the sinks and the durable record."""
+        if now is None:
+            now = time.time()
+        firing: list[dict] = []
+        fresh: list[dict] = []
+        with self._lock:
+            seen = set()
+            for rule in self.rules:
+                a = rule.check(frames, now)
+                if a is None:
+                    continue
+                key = (a["name"], a.get("label"))
+                seen.add(key)
+                new = key not in self._firing
+                if new:
+                    self._firing[key] = now
+                a["ts"] = now
+                a["since"] = self._firing[key]
+                firing.append(a)
+                if new:
+                    fresh.append(a)
+            self._firing = {k: t for k, t in self._firing.items()
+                            if k in seen}
+        for a in fresh:
+            self._deliver(a)
+        return firing
+
+    def emit(self, alert: dict) -> None:
+        """Push path: deliver an ad-hoc alert (``emit_alert`` hook)
+        straight to the sinks + record, no rule involved."""
+        alert.setdefault("ts", time.time())
+        alert.setdefault("severity", "page")
+        self._deliver(alert)
+
+    def _deliver(self, alert: dict) -> None:
+        record_alert(self.record_path, alert)
+        for sink in self.sinks:
+            try:
+                sink(alert)
+            except Exception:
+                pass  # a broken sink must not break the others
+
+
+# ----------------------------------------------------------------------
+# Module gate — the one-is-None idiom (recorder/trace/faults shape)
+# ----------------------------------------------------------------------
+
+_lock = threading.Lock()
+
+#: The active engine, or None when alerting is off — the single gate
+#: the push-path hook checks.  Armed from ``TPQ_ALERTS_EXPORT`` at
+#: import; reconfigure with :func:`set_engine`.
+_active: AlertEngine | None = None
+
+
+def _init_from_env() -> None:
+    global _active
+    path = alerts_export_default()
+    with _lock:
+        _active = AlertEngine(record_path=path) if path else None
+
+
+_init_from_env()
+
+
+def engine() -> AlertEngine | None:
+    """The active engine (None when alerting is off)."""
+    return _active
+
+
+def set_engine(e: AlertEngine | None) -> AlertEngine | None:
+    """Runtime reconfigure (tests / the soak harness / watch)."""
+    global _active
+    with _lock:
+        _active = e
+        return _active
+
+
+def emit_alert(name: str, severity: str = "page", **fields) -> None:
+    """Instrumentation hook: push one ad-hoc alert.  No-op (one
+    global ``is None`` check) when alerting is off.  Hot sites guard
+    the CALL itself (``_alerts._active is not None``) per the
+    recorder-guard discipline."""
+    eng = _active
+    if eng is not None:
+        a = {"name": name, "severity": severity, "kind": "emit"}
+        a.update(fields)
+        eng.emit(a)

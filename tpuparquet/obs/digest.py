@@ -1,0 +1,306 @@
+"""Mergeable latency quantile digests with exact cross-host merges.
+
+The log2 :class:`~tpuparquet.obs.histogram.Histogram` answers "p99
+page is 1-2 MB" — factor-of-two resolution, fine for sizes, too
+coarse for latency SLOs ("p99 under 250 ms" and "p99 under 400 ms"
+land in the same bucket).  The digest here keeps the property that
+makes the histogram fleet-safe — fixed global bucket boundaries, so
+merging is elementwise integer addition, no re-binning, no float
+error, identical totals regardless of merge order — but subdivides
+every octave into 8 sub-buckets keyed by the top four significant
+bits: for ``v > 0`` with ``e = v.bit_length() - 1``,
+
+    sub = ((v >> (e - 3)) if e >= 3 else (v << (3 - e))) - 8
+    idx = e * 8 + sub + 1          # idx 0 holds exactly 0
+
+Bucket width is ``lo/8``, i.e. every reported quantile bound is
+within ~6% relative error of the true value — t-digest-grade
+accuracy for tail latencies, with none of t-digest's merge-order
+dependence (two t-digests merged A+B and B+A disagree; these never
+do, which is what lets the soak harness assert per-label digests sum
+to process totals *exactly*).
+
+Values are non-negative integers by convention, microseconds for the
+latency digests the scan drivers feed (``unit``/``scan`` stages per
+scan label).  Each bucket optionally keeps one **exemplar** — the
+first ``(trace, value, coords)`` observed in it — linking a hot
+latency bucket straight to a round-16 causal trace id
+(``parquet-tool trace``).  Exemplars are debugging breadcrumbs, not
+counters: merges keep the existing exemplar and adopt missing ones,
+so they ride along without being part of the exact-merge contract.
+
+Collection discipline matches every other obs structure: per-thread
+shards in a :class:`~tpuparquet.obs.recorder.ThreadSlots` (no locks
+on the observe path), snapshot folds are exact, cross-host
+aggregation goes through ``to_state``/``merge_state`` over the same
+``allgather_bytes`` wire as metrics and ledgers
+(``shard.distributed.allgather_digests``).  The module gate is the
+one-is-None idiom: ``TPQ_LATENCY_DIGEST=1`` arms :data:`_active`;
+hot sites guard the call itself (``_digest._active is not None``) so
+the disabled path is one global load + ``is None``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = ["QuantileDigest", "DigestRegistry", "observe", "digests",
+           "set_digests", "digest_enabled_default",
+           "bucket_index", "bucket_lo", "bucket_hi"]
+
+_SUBS = 8  # sub-buckets per octave (top-4-significant-bits binning)
+
+
+def bucket_index(value) -> int:
+    """Global fixed bucket index of a non-negative integer value
+    (negatives clamp to 0).  Index 0 holds exactly 0; octave ``e``
+    (values with ``bit_length() == e+1``) spans indices
+    ``e*8+1 .. e*8+8``."""
+    v = int(value)
+    if v <= 0:
+        return 0
+    e = v.bit_length() - 1
+    sub = ((v >> (e - 3)) if e >= 3 else (v << (3 - e))) - _SUBS
+    return e * _SUBS + sub + 1
+
+
+def bucket_lo(idx: int) -> int:
+    """Inclusive lower bound of bucket ``idx``."""
+    if idx <= 0:
+        return 0
+    j = idx - 1
+    e, sub = divmod(j, _SUBS)
+    m = _SUBS + sub
+    return (m << (e - 3)) if e >= 3 else (m >> (3 - e))
+
+
+def bucket_hi(idx: int) -> int:
+    """Exclusive upper bound of bucket ``idx``."""
+    if idx <= 0:
+        return 1
+    j = idx - 1
+    e, sub = divmod(j, _SUBS)
+    m = _SUBS + sub + 1
+    if e >= 3:
+        return m << (e - 3)
+    # low octaves have fewer than 8 distinct integers: several
+    # sub-buckets share a floor-divided bound; each occupied bucket
+    # still holds exactly one integer
+    return max(bucket_lo(idx) + 1, m >> (3 - e))
+
+
+class QuantileDigest:
+    """Sparse counts over the fixed sub-octave buckets, plus the exact
+    value sum and sample count, plus one exemplar per bucket.
+
+    ``counts`` is a plain dict keyed by bucket index — latency
+    distributions touch a few dozen of the conceptual buckets, so the
+    sparse form is both the memory layout and the wire form."""
+
+    __slots__ = ("counts", "n", "total", "exemplars")
+
+    def __init__(self):
+        self.counts: dict[int, int] = {}
+        self.n = 0
+        self.total = 0
+        # idx -> {"value": v, "trace": tid?, **coords} (first wins)
+        self.exemplars: dict[int, dict] = {}
+
+    def observe(self, value, trace=None, **coords) -> None:
+        v = int(value)
+        if v < 0:
+            v = 0
+        i = bucket_index(v)
+        c = self.counts
+        c[i] = c.get(i, 0) + 1
+        self.n += 1
+        self.total += v
+        if i not in self.exemplars:
+            ex = {"value": v}
+            if trace is not None:
+                ex["trace"] = trace
+            if coords:
+                ex.update(coords)
+            self.exemplars[i] = ex
+
+    def merge_from(self, other: "QuantileDigest") -> None:
+        """Exact fold: elementwise integer adds on counts/n/total.
+        Exemplars keep ours, adopt theirs for buckets we lack."""
+        c = self.counts
+        for i, k in other.counts.items():
+            c[i] = c.get(i, 0) + k
+        self.n += other.n
+        self.total += other.total
+        for i, ex in other.exemplars.items():
+            if i not in self.exemplars:
+                self.exemplars[i] = dict(ex)
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.n if self.n else 0.0
+
+    def quantile(self, q: float) -> int:
+        """Exclusive upper bound of the bucket containing the
+        q-quantile — within ~6% relative of the true value."""
+        if self.n == 0:
+            return 0
+        target = q * self.n
+        seen = 0
+        last = 0
+        for i in sorted(self.counts):
+            seen += self.counts[i]
+            last = i
+            if seen >= target:
+                return bucket_hi(i)
+        return bucket_hi(last)
+
+    def as_dict(self) -> dict:
+        return {
+            "n": self.n,
+            "total": self.total,
+            "counts": {str(i): c for i, c in sorted(self.counts.items())},
+            "exemplars": {str(i): ex for i, ex in
+                          sorted(self.exemplars.items())},
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "QuantileDigest":
+        g = cls()
+        g.n = int(d.get("n", 0))
+        g.total = int(d.get("total", 0))
+        g.counts = {int(i): int(c)
+                    for i, c in (d.get("counts") or {}).items()}
+        g.exemplars = {int(i): dict(ex)
+                       for i, ex in (d.get("exemplars") or {}).items()}
+        return g
+
+    def __repr__(self):
+        return (f"QuantileDigest(n={self.n}, total={self.total}, "
+                f"p50<{self.quantile(0.5)}, p99<{self.quantile(0.99)})")
+
+
+def _fold_shard(dst: dict, src: dict) -> None:
+    """Exact fold of one thread shard (dead-owner retirement)."""
+    for key, g in src.items():
+        tot = dst.get(key)
+        if tot is None:
+            tot = dst[key] = QuantileDigest()
+        tot.merge_from(g)
+
+
+class DigestRegistry:
+    """Process-wide digests keyed ``(label, stage)`` with the same
+    per-thread-shard exactness discipline as the metrics registry:
+    observes land on the calling thread's private dict, snapshots
+    fold with exact merges, dead threads retire into a base shard."""
+
+    def __init__(self):
+        from .recorder import ThreadSlots
+
+        self._slots = ThreadSlots(make=dict, fold=_fold_shard)
+
+    def observe(self, label: str, stage: str, value,
+                trace=None, **coords) -> None:
+        shard = self._slots.get()
+        g = shard.get((label, stage))
+        if g is None:
+            g = shard[(label, stage)] = QuantileDigest()
+        g.observe(value, trace=trace, **coords)
+
+    def snapshot(self) -> dict:
+        """Exact fold of every thread shard:
+        ``{(label, stage): QuantileDigest}`` (merged copies)."""
+        out: dict = {}
+        for shard in self._slots.all():
+            for key, g in list(shard.items()):
+                tot = out.get(key)
+                if tot is None:
+                    tot = out[key] = QuantileDigest()
+                tot.merge_from(g)
+        return out
+
+    # -- exact wire form (cross-host aggregation) ------------------------
+
+    def to_state(self) -> dict:
+        """JSON-serializable exact state, nested
+        ``{label: {stage: digest_dict}}``."""
+        state: dict = {}
+        for (label, stage), g in sorted(self.snapshot().items()):
+            state.setdefault(label, {})[stage] = g.as_dict()
+        return state
+
+    @classmethod
+    def from_state(cls, d: dict) -> "DigestRegistry":
+        reg = cls()
+        shard = reg._slots.get()
+        for label, stages in (d or {}).items():
+            for stage, gd in stages.items():
+                shard[(label, stage)] = QuantileDigest.from_dict(gd)
+        return reg
+
+    def merge_state(self, d: dict) -> None:
+        """Exact fold of another registry's ``to_state`` into this
+        one (bucket-for-bucket adds)."""
+        shard = self._slots.get()
+        for label, stages in (d or {}).items():
+            for stage, gd in stages.items():
+                tot = shard.get((label, stage))
+                if tot is None:
+                    tot = shard[(label, stage)] = QuantileDigest()
+                tot.merge_from(QuantileDigest.from_dict(gd))
+
+
+# ----------------------------------------------------------------------
+# Module gate — the one-is-None idiom (recorder/trace/faults shape)
+# ----------------------------------------------------------------------
+
+_lock = threading.Lock()
+
+#: The active digest registry, or None when disabled — the single
+#: gate every hot-path hook checks.  Armed from the environment at
+#: import; reconfigure at runtime with :func:`set_digests`.
+_active: DigestRegistry | None = None
+
+
+def digest_enabled_default() -> bool:
+    """Digest master switch (``TPQ_LATENCY_DIGEST``, default off —
+    the always-on layer stays within noise of round-16)."""
+    return os.environ.get("TPQ_LATENCY_DIGEST", "0") != "0"
+
+
+def _init_from_env() -> None:
+    global _active
+    with _lock:
+        _active = DigestRegistry() if digest_enabled_default() else None
+
+
+_init_from_env()
+
+
+def digests() -> DigestRegistry | None:
+    """The active digest registry (None when disabled)."""
+    return _active
+
+
+def set_digests(on: bool) -> DigestRegistry | None:
+    """Runtime reconfigure: ``True`` installs a FRESH registry,
+    ``False`` disables.  Returns the new registry (tests and the soak
+    harness flip this without re-importing)."""
+    global _active
+    with _lock:
+        _active = DigestRegistry() if on else None
+        return _active
+
+
+def observe(label: str, stage: str, value, trace=None, **coords) -> None:
+    """Instrumentation hook: record one latency observation.  No-op
+    (one global ``is None`` check) when digests are off.
+
+    Hot per-unit sites guard the CALL itself with
+    ``_digest._active is not None`` so the disabled path skips even
+    argument evaluation — the flight/emit_span discipline, enforced
+    structurally by the ``recorder-guard`` analyze pass."""
+    reg = _active
+    if reg is not None:
+        reg.observe(label, stage, value, trace=trace, **coords)

@@ -409,11 +409,12 @@ class LiveFold:
 
 
 # ----------------------------------------------------------------------
-# Background snapshot writer (TPQ_METRICS_EXPORT)
+# Background snapshot writer (TPQ_METRICS_EXPORT / TPQ_TIMESERIES_DIR)
 # ----------------------------------------------------------------------
 
 _exporter_lock = threading.Lock()
 _exporter: threading.Thread | None = None
+_atexit_registered = False
 
 
 def _metrics_interval() -> float:
@@ -422,6 +423,19 @@ def _metrics_interval() -> float:
     except ValueError:
         return 10.0
     return max(v, 0.05)
+
+
+def _grid_delay(now: float, interval: float) -> float:
+    """Seconds until the next tick on the interval grid
+    (``ceil(now / interval) * interval``), floored at a tenth of the
+    interval so a tick landing just past a grid point doesn't fire a
+    second, nearly-empty tick immediately.  Grid-aligned sleeps keep
+    ring timestamps from drifting: N ticks land near N grid points,
+    not N * (interval + write_cost)."""
+    d = interval - (now % interval)
+    if d < 0.1 * interval:
+        d += interval
+    return d
 
 
 def export_now(path: str | None = None) -> str | None:
@@ -437,13 +451,30 @@ def export_now(path: str | None = None) -> str | None:
     return path if atomic_write_text(path, body) else None
 
 
+def _final_flush() -> None:
+    """One last snapshot at interpreter exit (atexit): the frame that
+    carries a short-lived process's totals — without it a batch job
+    shorter than the interval leaves no ring frame and an empty
+    metrics file.  Callable directly (tests, explicit shutdown)."""
+    from . import timeseries as _timeseries
+
+    export_now()
+    if _timeseries._active is not None:
+        _timeseries.tick("final")
+
+
 def maybe_start_exporter() -> None:
-    """Arm the background snapshot-writer daemon if
-    ``TPQ_METRICS_EXPORT`` is set and it isn't running (restart-safe
-    across fork — threads do not survive one)."""
-    if not os.environ.get("TPQ_METRICS_EXPORT"):
+    """Arm the background snapshot-writer daemon if either export
+    surface (``TPQ_METRICS_EXPORT`` file, ``TPQ_TIMESERIES_DIR``
+    ring) is configured and it isn't running (restart-safe across
+    fork — threads do not survive one).  Arming also registers the
+    atexit final flush."""
+    from . import timeseries as _timeseries
+
+    if not (os.environ.get("TPQ_METRICS_EXPORT")
+            or _timeseries.timeseries_dir_default()):
         return
-    global _exporter
+    global _exporter, _atexit_registered
     t = _exporter
     if t is not None and t.is_alive():
         return
@@ -451,13 +482,21 @@ def maybe_start_exporter() -> None:
         t = _exporter
         if t is not None and t.is_alive():
             return
+        if not _atexit_registered:
+            import atexit
+
+            atexit.register(_final_flush)
+            _atexit_registered = True
 
         def run():
             while True:
-                time.sleep(_metrics_interval())
-                if not os.environ.get("TPQ_METRICS_EXPORT"):
+                time.sleep(_grid_delay(time.time(), _metrics_interval()))
+                if not (os.environ.get("TPQ_METRICS_EXPORT")
+                        or _timeseries.timeseries_dir_default()):
                     return  # unset: stand down (tests flip this)
                 export_now()
+                if _timeseries.maybe_start_ring() is not None:
+                    _timeseries.tick("tick")
 
         t = threading.Thread(target=run, daemon=True,
                              name="tpq-metrics-export")

@@ -1,0 +1,297 @@
+"""SLO objectives, error budgets, and burn rates over the ring.
+
+The serve regime's operator question is not "what is the p99 right
+now" but "am I *keeping my promise*, and how fast am I spending the
+slack" — Service Level Objectives evaluated into error budgets and
+multi-window burn rates (the SRE-workbook alerting discipline: page
+on a fast-window burn confirmed by the slow window, so a blip
+doesn't page and a slow leak still does).
+
+Objectives are declarative JSON (``TPQ_SLO_FILE``), one per scan
+label::
+
+    [{"label": "scan",
+      "latency_stage": "unit",          // digest stage to test
+      "latency_p": 0.99,                // which percentile
+      "latency_target_ms": 250,         // promise: p99 unit < 250ms
+      "error_rate_target": 0.001,       // promise: <0.1% units fail
+      "window_s": 3600}]                // budget window
+
+Evaluation (:func:`evaluate`) runs over the frames of a time-series
+ring (``obs/timeseries.py``).  Everything in a frame is cumulative,
+so a window's worth of anything is *last frame minus the frame just
+before the window* — and because digests and ledgers merge by
+elementwise integer math on fixed buckets, that subtraction is exact
+bucket-for-bucket, the same property the cross-host merges lean on.
+A process restart (pid change) resets cumulatives; deltas clamp at
+the raw last value so a restart under-counts briefly instead of
+going negative.
+
+Vocabulary: per label, **errors** are ``units_quarantined +
+deadline_exceeded`` out of **attempts** (``row_groups`` decoded +
+quarantined units) — the same conservation counters the ledgers pin.
+The **error budget** is ``error_rate_target × attempts``; **burn
+rate** is ``actual_rate / target`` over a window (burn 1.0 = spending
+exactly at budget; 14.4 = the classic page-now threshold).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from .digest import QuantileDigest
+
+__all__ = ["load_objectives", "evaluate", "format_report",
+           "slo_file_default", "window_digest", "window_ledger",
+           "DEFAULT_FAST_WINDOW_S", "DEFAULT_SLOW_WINDOW_S"]
+
+DEFAULT_FAST_WINDOW_S = 300.0
+DEFAULT_SLOW_WINDOW_S = 3600.0
+
+_ERROR_COUNTERS = ("units_quarantined", "deadline_exceeded")
+
+
+def slo_file_default() -> str | None:
+    """Objectives path from ``TPQ_SLO_FILE`` (None = no objectives)."""
+    return os.environ.get("TPQ_SLO_FILE") or None
+
+
+def load_objectives(path: str | None = None) -> list[dict]:
+    """Load + normalize objectives (defaults filled, types coerced).
+    ``path`` defaults to ``TPQ_SLO_FILE``; no path → ``[]``.  Raises
+    ``ValueError`` on a file that is not an objective list."""
+    if path is None:
+        path = slo_file_default()
+    if not path:
+        return []
+    with open(path) as f:
+        try:
+            doc = json.load(f)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"SLO file {path!r} is not valid JSON: {e}") \
+                from e
+    if isinstance(doc, dict):
+        doc = doc.get("objectives")
+    if not isinstance(doc, list):
+        raise ValueError(f"SLO file {path!r}: expected a list of "
+                         f"objectives (or {{'objectives': [...]}})")
+    out = []
+    for i, o in enumerate(doc):
+        if not isinstance(o, dict) or not o.get("label"):
+            raise ValueError(f"SLO file {path!r}: objective #{i} "
+                             f"needs a 'label'")
+        out.append({
+            "label": str(o["label"]),
+            "latency_stage": str(o.get("latency_stage", "unit")),
+            "latency_p": float(o.get("latency_p", 0.99)),
+            "latency_target_ms": (
+                None if o.get("latency_target_ms") is None
+                else float(o["latency_target_ms"])),
+            "error_rate_target": (
+                None if o.get("error_rate_target") is None
+                else float(o["error_rate_target"])),
+            "window_s": float(o.get("window_s", DEFAULT_SLOW_WINDOW_S)),
+        })
+    return out
+
+
+# ----------------------------------------------------------------------
+# Windowed deltas over ring frames (exact on the fixed buckets)
+# ----------------------------------------------------------------------
+
+def _baseline_frame(frames: list[dict], start_ts: float) -> dict | None:
+    """The newest frame at-or-before the window start — the cumulative
+    baseline the window subtracts.  None = window spans the whole
+    ring (baseline zero)."""
+    base = None
+    for f in frames:
+        if f.get("ts", 0.0) <= start_ts:
+            base = f
+        else:
+            break
+    return base
+
+
+def _same_epoch(a: dict | None, b: dict) -> bool:
+    """Cumulative subtraction only makes sense within one process
+    epoch (counters reset at restart)."""
+    return a is not None and a.get("pid") == b.get("pid")
+
+
+def window_digest(frames: list[dict], label: str, stage: str,
+                  window_s: float, now: float) -> QuantileDigest:
+    """The digest of observations that landed inside the window:
+    last frame's cumulative digest minus the baseline frame's,
+    bucket-for-bucket (exact — fixed global buckets)."""
+    out = QuantileDigest()
+    if not frames:
+        return out
+    last = frames[-1]
+    ld = ((last.get("digests") or {}).get(label) or {}).get(stage)
+    if not ld:
+        return out
+    out = QuantileDigest.from_dict(ld)
+    base = _baseline_frame(frames, now - window_s)
+    if _same_epoch(base, last):
+        bd = ((base.get("digests") or {}).get(label) or {}).get(stage)
+        if bd:
+            bg = QuantileDigest.from_dict(bd)
+            for i, c in bg.counts.items():
+                left = out.counts.get(i, 0) - c
+                if left > 0:
+                    out.counts[i] = left
+                else:
+                    out.counts.pop(i, None)
+            out.n = max(out.n - bg.n, 0)
+            out.total = max(out.total - bg.total, 0)
+    return out
+
+
+def window_ledger(frames: list[dict], label: str,
+                  window_s: float, now: float) -> dict:
+    """Per-label counter deltas inside the window (ledger cumulative
+    last-minus-baseline, clamped at the raw last value across
+    process restarts)."""
+    if not frames:
+        return {}
+    last = frames[-1]
+    lc = ((last.get("ledgers") or {}).get(label) or {}).get("counters")
+    if not lc:
+        return {}
+    out = dict(lc)
+    base = _baseline_frame(frames, now - window_s)
+    if _same_epoch(base, last):
+        bc = ((base.get("ledgers") or {}).get(label) or {}) \
+            .get("counters") or {}
+        for k, v in bc.items():
+            out[k] = max(out.get(k, 0) - v, 0)
+    return {k: v for k, v in out.items() if v}
+
+
+def _error_rate(counters: dict) -> tuple[float | None, int, int]:
+    """(rate, errors, attempts) from a windowed ledger-counter dict;
+    rate None when nothing ran in the window."""
+    errors = sum(int(counters.get(k, 0)) for k in _ERROR_COUNTERS)
+    attempts = int(counters.get("row_groups", 0)) \
+        + int(counters.get("units_quarantined", 0))
+    if attempts <= 0:
+        return None, errors, 0
+    return errors / attempts, errors, attempts
+
+
+# ----------------------------------------------------------------------
+# Evaluation
+# ----------------------------------------------------------------------
+
+def evaluate(frames: list[dict], objectives: list[dict],
+             now: float | None = None) -> dict:
+    """Evaluate every objective over the ring frames into one report:
+    windowed latency percentile vs target, windowed error rate vs
+    target, error-budget consumption, and fast/slow burn rates.
+    ``ok`` flags are None (no verdict) when the window saw no work."""
+    if now is None:
+        now = time.time()
+    rows = []
+    for o in objectives:
+        label = o["label"]
+        row: dict = {"label": label, "window_s": o["window_s"]}
+
+        # latency leg
+        if o["latency_target_ms"] is not None:
+            dig = window_digest(frames, label, o["latency_stage"],
+                                o["window_s"], now)
+            val_ms = (dig.quantile(o["latency_p"]) / 1000.0
+                      if dig.n else None)
+            row["latency"] = {
+                "stage": o["latency_stage"],
+                "p": o["latency_p"],
+                "target_ms": o["latency_target_ms"],
+                "value_ms": val_ms,
+                "n": dig.n,
+                "ok": (None if val_ms is None
+                       else val_ms <= o["latency_target_ms"]),
+            }
+
+        # error-rate leg + budget + burn
+        if o["error_rate_target"] is not None:
+            target = o["error_rate_target"]
+            rate, errors, attempts = _error_rate(
+                window_ledger(frames, label, o["window_s"], now))
+            budget_allowed = target * attempts
+            consumed = (min(errors / budget_allowed, 1e9)
+                        if budget_allowed > 0 else (1.0 if errors else 0.0))
+            burns = {}
+            for wname, ws in (("fast", DEFAULT_FAST_WINDOW_S),
+                              ("slow", DEFAULT_SLOW_WINDOW_S)):
+                r, _, att = _error_rate(
+                    window_ledger(frames, label, ws, now))
+                burns[wname] = (None if r is None or target <= 0
+                                else r / target)
+                burns[f"{wname}_window_s"] = ws
+            row["errors"] = {
+                "target": target,
+                "rate": rate,
+                "errors": errors,
+                "attempts": attempts,
+                "ok": None if rate is None else rate <= target,
+            }
+            row["budget"] = {
+                "allowed": budget_allowed,
+                "consumed_fraction": consumed,
+                "remaining_fraction": max(1.0 - consumed, 0.0),
+            }
+            row["burn"] = burns
+        rows.append(row)
+    return {
+        "format": "tpq-slo-report",
+        "version": 1,
+        "ts": now,
+        "frames": len(frames),
+        "objectives": rows,
+    }
+
+
+def _fmt_pct(x: float | None) -> str:
+    return "-" if x is None else f"{100.0 * x:.2f}%"
+
+
+def _fmt_burn(x: float | None) -> str:
+    return "-" if x is None else f"{x:.1f}x"
+
+
+def format_report(report: dict) -> str:
+    """Human-readable report (one block per objective) for
+    ``parquet-tool slo report``."""
+    lines = [f"SLO report over {report['frames']} frames"]
+    for row in report["objectives"]:
+        lines.append(f"  {row['label']}  (window {row['window_s']:g}s)")
+        lat = row.get("latency")
+        if lat:
+            v = ("-" if lat["value_ms"] is None
+                 else f"{lat['value_ms']:.1f}ms")
+            verdict = {True: "OK", False: "VIOLATED", None: "no data"}[
+                lat["ok"]]
+            lines.append(
+                f"    latency  p{int(lat['p'] * 100)} {lat['stage']} "
+                f"= {v}  target {lat['target_ms']:g}ms  [{verdict}] "
+                f"(n={lat['n']})")
+        err = row.get("errors")
+        if err:
+            verdict = {True: "OK", False: "VIOLATED", None: "no data"}[
+                err["ok"]]
+            lines.append(
+                f"    errors   {err['errors']}/{err['attempts']} "
+                f"= {_fmt_pct(err['rate'])}  target "
+                f"{_fmt_pct(err['target'])}  [{verdict}]")
+            b = row["budget"]
+            lines.append(
+                f"    budget   {_fmt_pct(b['remaining_fraction'])} "
+                f"remaining (consumed {_fmt_pct(b['consumed_fraction'])})")
+            burn = row["burn"]
+            lines.append(
+                f"    burn     fast {_fmt_burn(burn['fast'])} "
+                f"({burn['fast_window_s']:g}s)  slow "
+                f"{_fmt_burn(burn['slow'])} ({burn['slow_window_s']:g}s)")
+    return "\n".join(lines)

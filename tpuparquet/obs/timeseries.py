@@ -1,0 +1,287 @@
+"""Bounded on-disk ring of metric snapshots — the time dimension.
+
+The metrics registry (``obs/live.py``) answers "what are the totals
+*now*"; the ring here answers "what were they over the last hour" —
+the substrate the SLO engine (``obs/slo.py``) computes error budgets
+and burn rates over, and what ``parquet-tool watch`` / ``slo
+report`` render.  Frames are **delta-aware**: each carries both the
+cumulative counters and the exact delta since the previous frame of
+this ring (per-counter baselines, the ``LiveFold`` discipline), so a
+reader computes rates without differencing across process restarts.
+
+Layout: a directory of append-only JSONL segments
+(``segment-<n>.jsonl``), one frame per line.  A segment rotates at
+``TPQ_TIMESERIES_SEGMENT_FRAMES`` frames (default 256) and the ring
+keeps at most ``TPQ_TIMESERIES_SEGMENTS`` segments (default 8),
+unlinking the oldest — bounded disk, no compaction.  Appends are a
+single ``write()`` of one ``\\n``-terminated line in binary append
+mode, so a crash can tear at most the final line; the loader
+tolerates exactly that (a torn trailing line is damage the format
+expects, unlike the atomically-published progress/metrics files).
+Restart-safe: a new process resumes numbering after the segments
+already on disk.
+
+Frame shape::
+
+    {"format": "tpq-timeseries", "version": 1, "ts": ..., "pid": ...,
+     "seq": ..., "kind": "tick" | "scan_end" | "final",
+     "counters": {...cumulative...}, "delta": {...since prev frame...},
+     "gauges": {...}, "ledgers": {label: ledger_state},
+     "digests": {label: {stage: digest_dict}}}
+
+Feeds: the background snapshot writer (``obs/live.py``) appends a
+``tick`` frame on every interval, the scan drivers append a
+``scan_end`` frame as each scan finishes (so short scans are visible
+between ticks), and the atexit flush appends a ``final`` frame.  All
+of it is off by default behind the one-is-None gate: set
+``TPQ_TIMESERIES_DIR`` to arm :data:`_active`; hot sites guard the
+call itself.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["MetricRing", "load_ring", "tick", "ring",
+           "maybe_start_ring", "set_ring_dir",
+           "timeseries_dir_default", "segment_frames_default",
+           "segments_default", "FRAME_FORMAT"]
+
+FRAME_FORMAT = "tpq-timeseries"
+_SEG_PREFIX = "segment-"
+_SEG_SUFFIX = ".jsonl"
+
+
+def timeseries_dir_default() -> str | None:
+    """Ring directory from ``TPQ_TIMESERIES_DIR`` (None = off)."""
+    return os.environ.get("TPQ_TIMESERIES_DIR") or None
+
+
+def segment_frames_default() -> int:
+    """Frames per segment from ``TPQ_TIMESERIES_SEGMENT_FRAMES``
+    (default 256, floor 1)."""
+    try:
+        v = int(os.environ.get("TPQ_TIMESERIES_SEGMENT_FRAMES", "256"))
+    except ValueError:
+        return 256
+    return max(v, 1)
+
+
+def segments_default() -> int:
+    """Segment-count cap from ``TPQ_TIMESERIES_SEGMENTS`` (default 8,
+    floor 2 — one filling, one of history)."""
+    try:
+        v = int(os.environ.get("TPQ_TIMESERIES_SEGMENTS", "8"))
+    except ValueError:
+        return 8
+    return max(v, 2)
+
+
+def _segment_no(name: str) -> int | None:
+    if not (name.startswith(_SEG_PREFIX) and name.endswith(_SEG_SUFFIX)):
+        return None
+    try:
+        return int(name[len(_SEG_PREFIX):-len(_SEG_SUFFIX)])
+    except ValueError:
+        return None
+
+
+def _list_segments(dirpath: str) -> list[tuple[int, str]]:
+    """(number, path) of every segment on disk, ascending."""
+    try:
+        names = os.listdir(dirpath)
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        n = _segment_no(name)
+        if n is not None:
+            out.append((n, os.path.join(dirpath, name)))
+    out.sort()
+    return out
+
+
+class MetricRing:
+    """Appender side of the ring: builds frames from the live
+    registry (+ armed digests), applies the delta baselines, writes
+    and rotates.  Thread-safe; every filesystem failure is swallowed
+    (telemetry must never fail the work it describes — the
+    atomic_write_text contract)."""
+
+    def __init__(self, dirpath: str, *, segment_frames: int | None = None,
+                 segments: int | None = None):
+        self.dir = dirpath
+        self.env_armed = False      # True when maybe_start_ring installed it
+        self.segment_frames = segment_frames or segment_frames_default()
+        self.segments = segments or segments_default()
+        self._lock = threading.Lock()
+        self._base: dict = {}       # counter -> cumulative at last frame
+        self._seq = 0
+        segs = _list_segments(dirpath)
+        # resume after what's on disk: never rewrite history
+        self._seg_no = (segs[-1][0] + 1) if segs else 0
+        self._frames_in_seg = 0
+
+    # -- frame construction ----------------------------------------------
+
+    def build_frame(self, kind: str) -> dict:
+        """One JSON-serializable frame from the process telemetry
+        (cumulative counters + exact delta since the previous frame
+        of THIS ring + gauges + armed digests)."""
+        from . import digest as _digest
+        from .attribution import ledgers_state
+        from .live import registry
+
+        snap = registry().snapshot()
+        counters = snap["counters"]
+        delta = {}
+        with self._lock:
+            for k, v in counters.items():
+                d = v - self._base.get(k, 0)
+                if d:
+                    delta[k] = d
+                    self._base[k] = v
+            seq = self._seq
+            self._seq += 1
+        frame = {
+            "format": FRAME_FORMAT,
+            "version": 1,
+            "ts": time.time(),
+            "pid": os.getpid(),
+            "seq": seq,
+            "kind": kind,
+            "counters": counters,
+            "delta": delta,
+            "gauges": snap["gauges"],
+        }
+        leds = ledgers_state()
+        if leds:
+            frame["ledgers"] = leds
+        if _digest._active is not None:
+            frame["digests"] = _digest._active.to_state()
+        return frame
+
+    # -- append + rotation -----------------------------------------------
+
+    def append(self, kind: str = "tick") -> bool:
+        """Build and append one frame; rotate/trim as needed.
+        Returns False (best-effort) on any filesystem error."""
+        frame = self.build_frame(kind)
+        line = (json.dumps(frame, sort_keys=True) + "\n").encode("utf-8")
+        with self._lock:
+            seg = os.path.join(
+                self.dir, f"{_SEG_PREFIX}{self._seg_no}{_SEG_SUFFIX}")
+            try:
+                os.makedirs(self.dir, exist_ok=True)
+                # one write() of one terminated line in O_APPEND mode:
+                # a crash tears at most the trailing line, which the
+                # loader tolerates by design
+                with open(seg, "ab") as f:
+                    f.write(line)
+            except OSError:
+                return False
+            self._frames_in_seg += 1
+            if self._frames_in_seg >= self.segment_frames:
+                self._seg_no += 1
+                self._frames_in_seg = 0
+                # keep the newest `segments` numbers (including the
+                # one the next append will create); unlink the rest
+                floor = self._seg_no - self.segments
+                for n, path in _list_segments(self.dir):
+                    if n <= floor:
+                        try:
+                            os.unlink(path)
+                        except OSError:
+                            pass
+        return True
+
+
+def load_ring(dirpath: str) -> list[dict]:
+    """Read every frame in the ring, oldest first (segment order,
+    then line order).  A torn or garbage line — the expected crash
+    artifact at a segment tail — is skipped, not fatal; a frame
+    without the ``tpq-timeseries`` envelope is skipped too."""
+    frames: list[dict] = []
+    for _, path in _list_segments(dirpath):
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            continue
+        for line in raw.split(b"\n"):
+            if not line.strip():
+                continue
+            try:
+                doc = json.loads(line)
+            except (ValueError, UnicodeDecodeError):
+                continue
+            if isinstance(doc, dict) and doc.get("format") == FRAME_FORMAT:
+                frames.append(doc)
+    return frames
+
+
+# ----------------------------------------------------------------------
+# Module gate — the one-is-None idiom (recorder/trace/faults shape)
+# ----------------------------------------------------------------------
+
+_lock = threading.Lock()
+
+#: The active ring appender, or None when disabled — the single gate
+#: every feed site checks.  Armed from ``TPQ_TIMESERIES_DIR`` at
+#: import / first registry access; reconfigure with :func:`set_ring_dir`.
+_active: MetricRing | None = None
+
+
+def _init_from_env() -> None:
+    global _active
+    d = timeseries_dir_default()
+    with _lock:
+        _active = MetricRing(d) if d else None
+
+
+_init_from_env()
+
+
+def ring() -> MetricRing | None:
+    """The active ring appender (None when disabled)."""
+    return _active
+
+
+def maybe_start_ring() -> MetricRing | None:
+    """Arm the ring if ``TPQ_TIMESERIES_DIR`` is set and the active
+    appender doesn't match it (restart-safe; tests flip the env).
+    Unsetting the env stands down only an env-armed ring — one
+    installed programmatically via :func:`set_ring_dir` stays up."""
+    global _active
+    d = timeseries_dir_default()
+    with _lock:
+        r = _active
+        if d is None:
+            if r is not None and r.env_armed:
+                _active = None
+        elif r is None or r.dir != d:
+            _active = MetricRing(d)
+            _active.env_armed = True
+        return _active
+
+
+def set_ring_dir(dirpath: str | None) -> MetricRing | None:
+    """Runtime reconfigure: a path installs a FRESH appender on that
+    directory, None disables.  Returns the new appender."""
+    global _active
+    with _lock:
+        _active = MetricRing(dirpath) if dirpath else None
+        return _active
+
+
+def tick(kind: str = "tick") -> None:
+    """Feed hook: append one frame to the armed ring.  No-op (one
+    global ``is None`` check) when the ring is off.  Feed sites on
+    scan paths guard the CALL itself (``_timeseries._active is not
+    None``) per the recorder-guard discipline."""
+    r = _active
+    if r is not None:
+        r.append(kind)

@@ -28,6 +28,7 @@ from .scan import (  # noqa: F401
 )
 from .distributed import (  # noqa: F401
     MultiHostScan,
+    allgather_digests,
     allgather_host,
     allgather_ledgers,
     allgather_traces,

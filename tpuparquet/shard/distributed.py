@@ -25,6 +25,7 @@ __all__ = [
     "allgather_bytes",
     "allgather_stats",
     "allgather_metrics",
+    "allgather_digests",
 ]
 
 from .scan import DurableScanMixin as _DurableScanMixin  # noqa: E402
@@ -161,6 +162,31 @@ def allgather_metrics(reg=None) -> "MetricsRegistry":
         total.merge_from(MetricsRegistry.from_state(state))
         for k, v in gauges.items():
             total.gauge(f"p{i}_{k}", v)
+    return total
+
+
+def allgather_digests(reg=None) -> "DigestRegistry":
+    """Fold every host's latency quantile digests
+    (:mod:`tpuparquet.obs.digest`) into one fleet-wide registry,
+    identical on every process — same wire as
+    :func:`allgather_metrics` (exact JSON state over
+    :func:`allgather_bytes`), same exactness: the digests' fixed
+    sub-octave buckets sum elementwise, so the merged digest equals
+    the single-host digest of the union corpus bucket-for-bucket
+    (what the soak harness pins).  ``reg`` defaults to this process's
+    active digest registry; an unarmed process contributes an empty
+    state."""
+    import json as _json
+
+    from ..obs.digest import DigestRegistry, digests
+
+    if reg is None:
+        reg = digests()
+    state = {} if reg is None else reg.to_state()
+    payloads = allgather_bytes(_json.dumps(state).encode())
+    total = DigestRegistry()
+    for p in payloads:
+        total.merge_state(_json.loads(p))
     return total
 
 

@@ -28,7 +28,9 @@ from ..errors import (
 )
 from ..faults import QuarantineReport
 from ..io.reader import FileReader
+from ..obs import digest as _digest
 from ..obs import recorder as _flightrec
+from ..obs import timeseries as _timeseries
 from ..obs import trace as _trace
 from ..obs.postmortem import postmortem_path_for, record_incident
 from ..obs.recorder import flight
@@ -645,6 +647,26 @@ class DurableScanMixin:
             tpath = f"{tpath}.{label_slug(label)}"
         self._trace_export = tpath or None
         self._trace_ctx = None
+        # arm the time-series ring now if TPQ_TIMESERIES_DIR appeared
+        # after import, so the scan-end flush below has somewhere to
+        # land even for scans shorter than the exporter interval
+        _timeseries.maybe_start_ring()
+
+    def _finish_telemetry(self, t_scan: float, troot,
+                          status: str) -> None:
+        """Scan-end longitudinal feeds: the whole-scan latency into
+        the quantile digest (with the trace id as exemplar) and one
+        ``scan_end`` frame onto the time-series ring — so a scan
+        shorter than the exporter interval still leaves history.
+        Both off-by-default, one ``is None`` check each."""
+        if _digest._active is not None:
+            _digest.observe(
+                self.progress.label, "scan",
+                int((time.monotonic() - t_scan) * 1e6),
+                trace=(troot["trace"] if troot is not None else None),
+                status=status)
+        if _timeseries._active is not None:
+            _timeseries.tick("scan_end")
 
     def _adopted(self):
         """Context installing the scan's ambient collector for one
@@ -797,12 +819,14 @@ class DurableScanMixin:
         self._trace_ctx = _trace.ctx_of(troot)
         if self._ledger is not None:
             self._ledger.scans += 1
+        t_scan = time.monotonic()
         try:
             with self._adopted():
                 self._check_scan_deadline()
             while True:
                 nxt, _ = self._progress()
                 prog.unit_started(nxt)
+                t_unit = time.monotonic()
                 try:
                     with self._adopted():
                         k, out = next(gen)
@@ -829,6 +853,13 @@ class DurableScanMixin:
                         else "unit_quarantined",
                         site="shard.scan", unit=k, file=fi,
                         row_group=rgi, rows=rows)
+                if _digest._active is not None:
+                    _digest.observe(
+                        prog.label, "unit",
+                        int((time.monotonic() - t_unit) * 1e6),
+                        trace=(troot["trace"] if troot is not None
+                               else None),
+                        unit=k, file=fi, row_group=rgi)
                 self._fold_live()
                 if out is not None:
                     yield k, out
@@ -838,12 +869,14 @@ class DurableScanMixin:
         except GeneratorExit:
             prog.finish("stopped")
             self._fold_live()
+            self._finish_telemetry(t_scan, troot, "stopped")
             _trace.end_trace(troot, status="cancelled")
             self._export_trace(troot)
             raise
         except BaseException:
             prog.finish("error")
             self._fold_live()
+            self._finish_telemetry(t_scan, troot, "error")
             _trace.end_trace(troot, status="error")
             self._export_trace(troot)
             raise
@@ -851,6 +884,7 @@ class DurableScanMixin:
             self._flush_checkpoint()
         self._fold_live()
         prog.finish("done")
+        self._finish_telemetry(t_scan, troot, "done")
         _trace.end_trace(troot)
         self._export_trace(troot)
 
