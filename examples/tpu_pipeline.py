@@ -79,7 +79,9 @@ lanes = fare.data.reshape(-1, 2)  # f64 as (lo, hi) u32 pairs
 
 import jax
 
-with jax.enable_x64(True):
+from tpuparquet.kernels.encode import enable_x64  # version-portable shim
+
+with enable_x64(True):
     f64 = jax.lax.bitcast_convert_type(lanes, jnp.float64)
     tipped = f64 * 1.15
     out_lanes = jax.lax.bitcast_convert_type(tipped, jnp.uint32)

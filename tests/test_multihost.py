@@ -45,6 +45,15 @@ def test_two_process_scan(tmp_path):
         for p in procs:
             stdout, _ = p.communicate(timeout=300)
             logs.append(stdout)
+            if (p.returncode != 0
+                    and "aren't implemented on the CPU backend" in stdout):
+                # some jaxlib builds cannot run multiprocess collectives
+                # on the CPU backend at all (no Gloo) — an environment
+                # capability gap, not a scan regression
+                import pytest
+
+                pytest.skip("jax CPU backend lacks multiprocess "
+                            "collectives in this image")
             assert p.returncode == 0, f"child failed:\n{stdout[-3000:]}"
     finally:
         # a failed/timed-out child leaves its peer blocked in a Gloo

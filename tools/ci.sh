@@ -1,7 +1,7 @@
 #!/bin/bash
 # In-repo CI gate (counterpart of the reference's .circleci/config.yml,
 # which pins go versions and runs `go test ./...` + the compatibility
-# corpus per commit).  Thirteen stages, pinned env:
+# corpus per commit).  Fourteen stages, pinned env:
 #
 #   1. tier-1 suite   — the ROADMAP.md verify command, gated on a PASS
 #                       FLOOR rather than rc: optional deps (zstandard,
@@ -88,6 +88,20 @@
 #                       must sum exactly to process totals, and the
 #                       decoded output must be byte-identical to a
 #                       telemetry-off leg
+#  14. remote emulator  — strict (rc=0): the remote byte-range path.
+#                       The dedicated suite (tests/test_remote.py:
+#                       coalescer properties, tiered-cache
+#                       conservation, poisoning, torn-cache restart,
+#                       emu parity legs cache-on AND cache-off), then
+#                       the scan/prune/checkpoint suites re-run
+#                       UNMODIFIED with TPQ_SOURCE=emu rerouting every
+#                       bare-path open through the emulated object
+#                       store — with a mild deterministic fault plan
+#                       (every 23rd request throttled, every 41st
+#                       reset) and the disk cache armed — so the whole
+#                       scan stack (filter pushdown, cursor resume,
+#                       quarantine, gather) proves byte-identical over
+#                       an unreliable remote store
 #
 # Usage: bash tools/ci.sh            (exit 0 = gate passed)
 # The tier-1 stage mirrors ROADMAP.md exactly — if you change one,
@@ -110,7 +124,7 @@ CI_PASS_FLOOR=${CI_PASS_FLOOR:-1000}
 
 fail() { echo "ci.sh: FAILED at stage $1" >&2; exit 1; }
 
-echo "=== stage 1/13: tier-1 suite (pass floor $CI_PASS_FLOOR) ==="
+echo "=== stage 1/14: tier-1 suite (pass floor $CI_PASS_FLOOR) ==="
 rm -f /tmp/_t1.log
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
@@ -124,25 +138,25 @@ echo "DOTS_PASSED=$passed"
 [ "$passed" -ge "$CI_PASS_FLOOR" ] \
   || fail "tier-1 ($passed passed < floor $CI_PASS_FLOOR)"
 
-echo "=== stage 2/13: smoke bench (CPU backend, tiny target) ==="
+echo "=== stage 2/14: smoke bench (CPU backend, tiny target) ==="
 TPQ_BENCH_TARGET=60000 TPQ_BENCH_CPU=1 timeout -k 10 600 \
   python bench.py > /tmp/_ci_bench.json || fail "smoke bench"
 tail -1 /tmp/_ci_bench.json
 
-echo "=== stage 3/13: crash corpus + fault-injection matrix (strict) ==="
+echo "=== stage 3/14: crash corpus + fault-injection matrix (strict) ==="
 timeout -k 10 600 python -m pytest \
   "tests/test_corpus.py::TestCrashRegressions" tests/test_faults.py \
   -q -p no:cacheprovider || fail "corpus/faults"
 
-echo "=== stage 4/13: salvage + strict metadata (strict) ==="
+echo "=== stage 4/14: salvage + strict metadata (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_salvage.py \
   -q -p no:cacheprovider || fail "salvage"
 
-echo "=== stage 5/13: deadlines/hedging + kill-resume checkpoints (strict) ==="
+echo "=== stage 5/14: deadlines/hedging + kill-resume checkpoints (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_deadline.py \
   tests/test_checkpoint.py -q -p no:cacheprovider || fail "time/crash"
 
-echo "=== stage 6/13: plan matrix: serial vs parallel, cache on (strict) ==="
+echo "=== stage 6/14: plan matrix: serial vs parallel, cache on (strict) ==="
 # leg A: pinned-serial planning (the TPQ_PLAN_THREADS=1 reference path)
 TPQ_PLAN_THREADS=1 timeout -k 10 600 python -m pytest \
   tests/test_plan_parallel.py tests/test_plan_cache.py \
@@ -153,7 +167,7 @@ TPQ_PLAN_CACHE_MB=64 timeout -k 10 600 python -m pytest \
   tests/test_plan_parallel.py tests/test_fallback_matrix.py \
   -q -p no:cacheprovider || fail "plan matrix (cache-on leg)"
 
-echo "=== stage 7/13: live obs gate + overhead guard (strict) ==="
+echo "=== stage 7/14: live obs gate + overhead guard (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_live_obs.py \
   tests/test_env_docs.py -q -p no:cacheprovider || fail "live obs"
 # overhead guard: the always-on default must stay within a generous
@@ -164,7 +178,7 @@ timeout -k 10 600 python tools/bench_obs.py --values 2000000 \
   || fail "obs overhead guard"
 tail -5 /tmp/_ci_obs.json
 
-echo "=== stage 8/13: pruning parity gate (strict) ==="
+echo "=== stage 8/14: pruning parity gate (strict) ==="
 # leg A: the whole pushdown suite (write/read page index + bloom,
 # verdicts, late materialization, counter exactness, corrupt-index
 # degrade, pyarrow interop) on the default pool width
@@ -177,13 +191,13 @@ TPQ_PLAN_THREADS=1 TPQ_PRUNE=0 timeout -k 10 600 python -m pytest \
   "tests/test_prune.py::TestParity" \
   -q -p no:cacheprovider || fail "pruning parity (prune-off leg)"
 
-echo "=== stage 9/13: tpq-analyze invariant passes + sanitizer leg (strict) ==="
+echo "=== stage 9/14: tpq-analyze invariant passes + sanitizer leg (strict) ==="
 timeout -k 10 300 python -m tools.analyze || fail "tpq-analyze"
 timeout -k 10 600 python -m pytest tests/test_analyze.py \
   -q -p no:cacheprovider || fail "analyzer self-test"
 timeout -k 10 900 bash tools/analyze/native.sh || fail "native sanitizers"
 
-echo "=== stage 10/13: gather placement parity gate (strict) ==="
+echo "=== stage 10/14: gather placement parity gate (strict) ==="
 # leg A: the placement suite — byte parity placed vs replicated across
 # filter/quarantine/salvage/resume/multi-host, placement + counter pins,
 # mesh-mismatch errors
@@ -196,7 +210,7 @@ TPQ_GATHER_TO=0 timeout -k 10 600 python -m pytest \
   tests/test_gather_placement.py \
   -q -p no:cacheprovider || fail "gather placement (env leg)"
 
-echo "=== stage 11/13: write-pipeline parity gate (strict) ==="
+echo "=== stage 11/14: write-pipeline parity gate (strict) ==="
 # leg A: the whole native-write suite on the default knobs
 timeout -k 10 600 python -m pytest tests/test_write_native.py \
   -q -p no:cacheprovider || fail "write parity"
@@ -207,7 +221,7 @@ TPQ_WRITE_NATIVE=0 timeout -k 10 600 python -m pytest \
   tests/test_write_native.py -q -p no:cacheprovider \
   || fail "write parity (native-off leg)"
 
-echo "=== stage 12/13: causal tracing + attribution + bench sentinel (strict) ==="
+echo "=== stage 12/14: causal tracing + attribution + bench sentinel (strict) ==="
 # leg A: the trace/attribution suite on the default (trace-off) env —
 # span-tree connectivity, adversity-matrix propagation, ledger
 # conservation, doctor goldens
@@ -227,7 +241,7 @@ TPQ_TRACE=1 timeout -k 10 900 python -m pytest \
 timeout -k 10 600 python tools/bench_sentinel.py --check \
   || fail "bench sentinel"
 
-echo "=== stage 13/13: soak smoke: faults -> alerts, exact sums, byte identity (strict) ==="
+echo "=== stage 13/14: soak smoke: faults -> alerts, exact sums, byte identity (strict) ==="
 # N=4 concurrent labeled scans with the deterministic fault plan
 # (CorruptPage on one tenant's unique column, hang + unit deadline on
 # another tenant's file).  Asserts the whole longitudinal contract:
@@ -235,5 +249,30 @@ echo "=== stage 13/13: soak smoke: faults -> alerts, exact sums, byte identity (
 # ledger conservation to process totals, telemetry-off byte identity.
 timeout -k 10 600 python -m tools.soak --scans 4 \
   || fail "soak smoke"
+
+echo "=== stage 14/14: remote emulator: parity over an unreliable store (strict) ==="
+# leg A: the dedicated remote suite — URI routing, coalescer property
+# sweep, tiered-cache conservation + poisoning + torn-file restart,
+# emu parity with the cache on AND off, hedged slow replicas
+timeout -k 10 600 python -m pytest tests/test_remote.py \
+  -q -p no:cacheprovider || fail "remote suite"
+# leg B: the scan/prune/checkpoint suites rerouted through the
+# emulated store (TPQ_SOURCE=emu: bare paths keep their names, so the
+# suites run unmodified), under a mild deterministic fault plan and
+# with the disk tier armed — the full scan stack must be byte-exact
+# over a throttling, resetting remote
+_CI_EMU_CACHE=$(mktemp -d)
+TPQ_SOURCE=emu TPQ_EMU_THROTTLE_EVERY=23 TPQ_EMU_RESET_EVERY=41 \
+  TPQ_CACHE_DISK_DIR="$_CI_EMU_CACHE" timeout -k 10 900 \
+  python -m pytest tests/test_shard.py tests/test_prune.py \
+  tests/test_checkpoint.py -q -p no:cacheprovider \
+  || fail "remote emulator (cache-on leg)"
+rm -rf "$_CI_EMU_CACHE"
+# leg C: cache-off parity — the same reroute with both cache tiers
+# disabled; results may not depend on the cache's existence
+TPQ_SOURCE=emu TPQ_CACHE_DISK_MB=0 TPQ_CACHE_MEM_MB=0 \
+  timeout -k 10 900 python -m pytest tests/test_shard.py \
+  tests/test_checkpoint.py -q -p no:cacheprovider \
+  || fail "remote emulator (cache-off leg)"
 
 echo "ci.sh: gate PASSED"

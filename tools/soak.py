@@ -23,7 +23,13 @@ contract end to end:
   now under concurrent multi-tenant load with the ring feed on);
 * **telemetry neutrality** — decoded output is byte-identical to a
   leg run with every telemetry surface off (live metrics, digests,
-  ring).
+  ring);
+* **remote equivalence** — one tenant reads through the ``emu://``
+  object-store emulator under periodic 429 throttles
+  (``TPQ_EMU_THROTTLE_EVERY``): the retry ladder must absorb every
+  throttle (``remote_retry`` > 0, zero quarantines) and the decoded
+  output must be byte-identical to a fault-free local control read
+  of the same file.
 
 Determinism under concurrency: fault rules target a tenant through
 structure, not timing — the corrupt rule matches the column name
@@ -57,9 +63,14 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 #: tenant roles by index: 1 eats corrupt pages, 2 eats hangs bounded
-#: by a unit deadline, every other tenant must stay clean
+#: by a unit deadline, 3 reads through the ``emu://`` object-store
+#: emulator under periodic 429 throttles (absorbed by the retry
+#: ladder, so it must stay clean AND byte-identical to a local
+#: control read), every other tenant must stay clean
 CORRUPT_TENANT = 1
 DEADLINE_TENANT = 2
+REMOTE_TENANT = 3
+REMOTE_THROTTLE_EVERY = "5"
 UNIT_DEADLINE_S = 0.2
 HANG_S = 5.0
 
@@ -109,6 +120,16 @@ def _output_digest(results) -> str:
     return h.hexdigest()
 
 
+def _control_digest(paths: list[str]) -> str:
+    """Fault-free LOCAL read of the remote tenant's file: the digest
+    its ``emu://`` leg must reproduce byte-for-byte.  Runs before the
+    legs; both legs reset every telemetry surface, so the control's
+    counters never leak into the conservation checks."""
+    from tpuparquet.shard.scan import ShardedScan
+
+    return _output_digest(ShardedScan(paths).run())
+
+
 def _arm_rules(inj, corpus: dict[str, list[str]]) -> None:
     """The deterministic fault plan (every matching call fires)."""
     inj.inject("kernels.device.page_payload", "corrupt",
@@ -128,6 +149,7 @@ def run_leg(corpus: dict[str, list[str]], *, telemetry: bool,
     from tpuparquet.obs import digest as _digest
     from tpuparquet.obs import timeseries as _timeseries
     from tpuparquet.shard.scan import ShardedScan
+    from tpuparquet.stats import collect_stats
 
     live.reset_registry()
     attribution.reset_ledgers()
@@ -142,17 +164,24 @@ def run_leg(corpus: dict[str, list[str]], *, telemetry: bool,
     def drive(label: str, paths: list[str]) -> None:
         try:
             idx = int(label.rsplit("_", 1)[1])
+            if idx == REMOTE_TENANT:
+                # reroute through the object-store emulator; retries
+                # (not quarantine) must absorb its throttles
+                paths = ["emu://" + p for p in paths]
             scan = ShardedScan(
                 paths, on_error="quarantine", retries=0,
                 progress_label=label,
                 unit_deadline=(UNIT_DEADLINE_S
                                if idx == DEADLINE_TENANT else None))
-            out = scan.run()
+            with collect_stats() as st:
+                out = scan.run()
             results[label] = {
                 "digest": _output_digest(out),
                 "units_done": scan.progress.units_done,
                 "units_quarantined": scan.progress.units_quarantined,
                 "quarantine": len(scan.quarantine),
+                "remote_ranges_fetched": st.remote_ranges_fetched,
+                "remote_retry": st.remote_retry,
             }
         except BaseException as e:  # surfaced by the main thread
             errors.append(e)
@@ -179,7 +208,8 @@ def run_leg(corpus: dict[str, list[str]], *, telemetry: bool,
 
 
 def check_soak(corpus: dict[str, list[str]], on: dict, off: dict,
-               ring_dir: str, alerts_path: str) -> list[str]:
+               ring_dir: str, alerts_path: str,
+               remote_control: str) -> list[str]:
     """Every assertion of the soak contract; returns failure strings
     (empty = pass)."""
     from tpuparquet.obs import attribution, live
@@ -192,6 +222,7 @@ def check_soak(corpus: dict[str, list[str]], on: dict, off: dict,
     labels = sorted(corpus)
     t_corrupt = tenant_label(CORRUPT_TENANT)
     t_deadline = tenant_label(DEADLINE_TENANT)
+    t_remote = tenant_label(REMOTE_TENANT)
 
     # -- telemetry neutrality: byte-identical outputs ------------------
     for lb in labels:
@@ -209,6 +240,22 @@ def check_soak(corpus: dict[str, list[str]], on: dict, off: dict,
     if not on[t_deadline]["units_quarantined"]:
         bad.append("deadline tenant saw no quarantined units — the "
                    "hang/deadline plan did not fire")
+
+    # -- remote tenant: emu:// engaged, throttles absorbed, bytes
+    #    identical to the local control read --------------------------
+    if not on[t_remote]["remote_ranges_fetched"]:
+        bad.append("remote tenant issued no remote range fetches — "
+                   "the emu:// reroute did not engage")
+    if not on[t_remote]["remote_retry"]:
+        bad.append("remote tenant saw no throttle retries — the "
+                   "emulated-429 plan did not fire")
+    if on[t_remote]["units_quarantined"]:
+        bad.append("remote tenant quarantined units — throttles must "
+                   "be absorbed by the retry ladder, not surfaced")
+    if on[t_remote]["digest"] != remote_control:
+        bad.append("remote tenant output differs from the local "
+                   "control read of the same file (emu:// is not "
+                   "byte-identical)")
 
     # -- alert coverage: one rule per fault class + clean/absence ------
     frames = load_ring(ring_dir)
@@ -297,8 +344,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--scans", type=int, default=4,
                     help="concurrent labeled scans (tenants); >= 4 "
-                         "so clean tenants exist beside the faulted "
-                         "two")
+                         "so the corrupt, deadline and remote "
+                         "tenants exist beside a clean control")
     ap.add_argument("--rows", type=int, default=120,
                     help="rows per tenant file")
     ap.add_argument("--units", type=int, default=4,
@@ -310,8 +357,8 @@ def main(argv=None) -> int:
                          "and alert records behind for inspection")
     args = ap.parse_args(argv)
     if args.scans < 4:
-        print("soak: --scans must be >= 4 (two faulted tenants + "
-              "clean controls)", file=sys.stderr)
+        print("soak: --scans must be >= 4 (corrupt + deadline + "
+              "remote tenants + a clean control)", file=sys.stderr)
         return 2
 
     root = args.keep or tempfile.mkdtemp(prefix="tpq-soak-")
@@ -319,13 +366,18 @@ def main(argv=None) -> int:
     ring_dir = os.path.join(root, "ring")
     alerts_path = os.path.join(root, "alerts.json")
     t0 = time.time()
+    prev_throttle = os.environ.get("TPQ_EMU_THROTTLE_EVERY")
+    os.environ["TPQ_EMU_THROTTLE_EVERY"] = REMOTE_THROTTLE_EVERY
     try:
         corpus = build_corpus(root, args.scans, args.rows, args.units)
+        remote_control = _control_digest(
+            corpus[tenant_label(REMOTE_TENANT)])
         # telemetry-off leg FIRST: it must not see the ring/digest
         # state the on leg arms
         off = run_leg(corpus, telemetry=False, ring_dir=None)
         on = run_leg(corpus, telemetry=True, ring_dir=ring_dir)
-        failures = check_soak(corpus, on, off, ring_dir, alerts_path)
+        failures = check_soak(corpus, on, off, ring_dir, alerts_path,
+                              remote_control)
         result = {
             "scans": args.scans,
             "units_per_scan": args.units,
@@ -351,6 +403,10 @@ def main(argv=None) -> int:
         from tpuparquet.obs import digest as _digest
         from tpuparquet.obs import timeseries as _timeseries
 
+        if prev_throttle is None:
+            os.environ.pop("TPQ_EMU_THROTTLE_EVERY", None)
+        else:
+            os.environ["TPQ_EMU_THROTTLE_EVERY"] = prev_throttle
         _digest.set_digests(_digest.digest_enabled_default())
         _timeseries.maybe_start_ring()
         if not args.keep:

@@ -482,6 +482,22 @@ def _print_profile(log, st, out, trace_diag=None) -> None:
                   f"encode {d['write_encode_s']:.3f}s  "
                   f"compress {d['write_compress_s']:.3f}s  "
                   f"assemble {d['write_assemble_s']:.3f}s", file=out)
+        # remote-source section (io/source.py byte-range backends):
+        # round trips actually issued vs saved by coalescing, and the
+        # tiered range cache's hit economics (io/rangecache.py)
+        if (d["remote_ranges_fetched"] or d["cache_hits_mem"]
+                or d["cache_hits_disk"] or d["cache_misses_mem"]
+                or d["cache_misses_disk"]):
+            print(f"remote: {d['remote_ranges_fetched']} ranges fetched "
+                  f"({d['ranges_coalesced']} coalesced away)  "
+                  f"{d['remote_bytes']:,}B  "
+                  f"{d['remote_retry']} retries", file=out)
+            print(f"range cache: mem {d['cache_hits_mem']}h/"
+                  f"{d['cache_misses_mem']}m/"
+                  f"{d['cache_evictions_mem']}e  "
+                  f"disk {d['cache_hits_disk']}h/"
+                  f"{d['cache_misses_disk']}m/"
+                  f"{d['cache_evictions_disk']}e", file=out)
         # predicate-pushdown section: what the filter statically skipped
         # and what the exact pass kept (tpuparquet/filter.py)
         if (d["row_groups_pruned"] or d["pages_pruned"]

@@ -57,6 +57,18 @@ site                                  instrumented where / supported kinds
                                       reads (``io/reader.py``) —
                                       ``oserror``, ``transient``,
                                       ``corrupt``, ``truncate``
+``io.remote.open``                    byte-range source open
+                                      (``io/source.py``) — ``oserror``,
+                                      ``transient``
+``io.remote.throttle``                per range request, before the
+                                      read (the HTTP-429 slot;
+                                      ``io/source.py``) — ``transient``
+``io.remote.range``                   range request payload
+                                      (``io/source.py``; short/truncated
+                                      responses are detected and raised
+                                      as transient, never returned) —
+                                      ``oserror``, ``transient``,
+                                      ``corrupt``, ``truncate``
 ====================================  =====================================
 
 Kinds: ``oserror`` raises ``OSError(EIO)``; ``transient`` raises
@@ -121,6 +133,10 @@ SITES: dict[str, tuple] = {
     "format.footer.blob": ("corrupt", "truncate"),
     "format.pageindex": ("oserror", "transient",
                          "corrupt", "truncate"),
+    "io.remote.open": ("oserror", "transient"),
+    "io.remote.throttle": ("transient",),
+    "io.remote.range": ("oserror", "transient",
+                        "corrupt", "truncate"),
 }
 
 _active: "FaultInjector | None" = None

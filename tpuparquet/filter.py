@@ -828,9 +828,18 @@ def read_row_group_filtered(reader, rg_index: int, f: Filter,
         return node, cd, kept
 
     # stage 2: filter columns decode first, predicate runs exactly on
-    # the candidate rows (kept is a page-granular superset of cand)
+    # the candidate rows (kept is a page-granular superset of cand).
+    # Remote sources batch-prefetch exactly the chunks each stage is
+    # about to read — the filter columns here, the undecoded survivor
+    # columns below — so late materialization doesn't turn into one
+    # round trip per column.
+    pf = getattr(reader, "prefetch_ranges", None)
+    fcols = sorted(f.columns())
+    if pf is not None:
+        pf([(reader._chunk_start(cms[p]), cms[p].total_compressed_size,
+             p) for p in fcols if p in cms])
     decoded = {}
-    for path in sorted(f.columns()):
+    for path in fcols:
         if path not in cms:
             raise ValueError(
                 f"filter references column {path!r} absent from row "
@@ -859,7 +868,11 @@ def read_row_group_filtered(reader, rg_index: int, f: Filter,
         keep2 = np.zeros(num_rows, dtype=bool)
         keep2[surviving] = True
     out = {}
-    for path, node, _cm in reader.selected_chunks(rg):
+    sel = reader.selected_chunks(rg)
+    if pf is not None:
+        pf([(reader._chunk_start(cm), cm.total_compressed_size, p)
+            for p, _n, cm in sel if p not in decoded])
+    for path, node, _cm in sel:
         if path in decoded:
             node, cd, kept = decoded[path]
         else:

@@ -1,7 +1,19 @@
 """File I/O layer: reader/writer, pages, chunks, Dremel store."""
 
 from .chunk import ChunkData, read_chunk, write_chunk  # noqa: F401
+from .rangecache import (  # noqa: F401
+    invalidate_source_caches,
+    reset_range_caches,
+)
 from .reader import FileReader  # noqa: F401
+from .source import (  # noqa: F401
+    ByteRangeSource,
+    EmulatedStoreSource,
+    LocalByteRangeSource,
+    coalesce_ranges,
+    open_byte_source,
+    parse_source_uri,
+)
 from .store import (  # noqa: F401
     ColumnStore,
     assemble_record,

@@ -1,0 +1,419 @@
+"""Tiered range cache for remote byte-range sources.
+
+Two tiers, two access costs:
+
+* **Memory** (:class:`MemRangeCache`, ``TPQ_CACHE_MEM_MB``) — hot small
+  ranges: footer framing bytes, metadata blobs, page-index/bloom
+  sections.  These are re-read on every reopen (fingerprint hashing,
+  handle un-poisoning, mirror opens), and on an object store each
+  re-read is a full round trip.  Same byte-budgeted LRU discipline as
+  ``kernels/plancache.py``, keyed by the source's *etag* — ``(path,
+  size, mtime_ns)`` — plus the range, so a rewritten object can never
+  be served stale bytes.
+
+* **Disk** (:class:`DiskRangeCache`, ``TPQ_CACHE_DISK_DIR`` +
+  ``TPQ_CACHE_DISK_MB``) — recently fetched column-chunk ranges.  One
+  file per entry, written atomically (tmp + ``os.replace``) and
+  CRC-verified on every read.  A torn file (process killed mid-write)
+  or a bit-rotted payload can therefore never reach a decoder: torn
+  framing self-heals silently (unlink + miss), while a CRC mismatch on
+  well-formed framing is treated as *poisoning* — the entry is
+  evicted, a ``cache_poison`` flight record and post-mortem incident
+  are emitted, and the key is marked so the direct refetch is NOT
+  immediately re-cached (degrade to uncached: if the payload keeps
+  arriving corrupt, the cache must not amplify it).
+
+Both tiers bump the exactly-merging ``cache_{hits,misses,evictions}_
+{mem,disk}`` counters on the calling thread's collector, so
+``cache_hits + cache_misses == lookups`` holds per tier by
+construction.  :func:`invalidate_source_caches` drops both tiers for a
+path — wired to the corruption/quarantine/salvage hooks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import zlib
+from collections import OrderedDict
+
+from ..obs.recorder import flight
+from .source import parse_source_uri
+
+__all__ = [
+    "MemRangeCache",
+    "DiskRangeCache",
+    "mem_cache",
+    "disk_cache",
+    "invalidate_source_caches",
+    "reset_range_caches",
+]
+
+_MAGIC = b"TPQC1"
+_SUFFIX = ".tpqc"
+# magic + crc32(u32) + payload_len(u64) + key_len(u16), big-endian
+_HDR = len(_MAGIC) + 4 + 8 + 2
+
+
+def _bump(field: str, n: int = 1) -> None:
+    from ..stats import current_stats
+
+    st = current_stats()
+    if st is not None:
+        setattr(st, field, getattr(st, field) + n)
+
+
+def _norm_path(src: str) -> str:
+    """Cache keys store the backing *path*; accept either a path or a
+    ``scheme://path`` URI at the invalidation hooks."""
+    parsed = parse_source_uri(src)
+    return parsed[1] if parsed is not None else src
+
+
+def mem_cache_budget() -> int:
+    """``TPQ_CACHE_MEM_MB`` in bytes (default 16 MiB; ``0`` disables).
+    Read per call so tests and operators can flip it live."""
+    v = os.environ.get("TPQ_CACHE_MEM_MB")
+    if v is None or v == "":
+        return 16 * (1 << 20)
+    return max(0, int(float(v) * (1 << 20)))
+
+
+def disk_cache_dir() -> str | None:
+    return os.environ.get("TPQ_CACHE_DISK_DIR") or None
+
+
+def disk_cache_budget() -> int:
+    """``TPQ_CACHE_DISK_MB`` in bytes (default 256 MiB; ``0`` disables
+    the disk tier even when a directory is configured)."""
+    v = os.environ.get("TPQ_CACHE_DISK_MB")
+    if v is None or v == "":
+        return 256 * (1 << 20)
+    return max(0, int(float(v) * (1 << 20)))
+
+
+class MemRangeCache:
+    """Byte-budgeted LRU of ``key -> bytes`` (self-synchronized)."""
+
+    def __init__(self, budget: int):
+        self._budget = budget
+        self._lock = threading.Lock()
+        self._entries: OrderedDict = OrderedDict()
+        self._bytes = 0
+
+    def get(self, key):
+        with self._lock:
+            data = self._entries.get(key)
+            if data is None:
+                _bump("cache_misses_mem")
+                return None
+            self._entries.move_to_end(key)
+        _bump("cache_hits_mem")
+        return data
+
+    def put(self, key, data: bytes) -> None:
+        n = len(data)
+        if n > self._budget:
+            return
+        evicted = 0
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= len(old)
+            self._entries[key] = data
+            self._bytes += n
+            while self._bytes > self._budget and len(self._entries) > 1:
+                _, dropped = self._entries.popitem(last=False)
+                self._bytes -= len(dropped)
+                evicted += 1
+        if evicted:
+            _bump("cache_evictions_mem", evicted)
+
+    def invalidate_path(self, path: str) -> int:
+        with self._lock:
+            doomed = [k for k in self._entries if k[0] == path]
+            for k in doomed:
+                self._bytes -= len(self._entries.pop(k))
+        if doomed:
+            _bump("cache_evictions_mem", len(doomed))
+        return len(doomed)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self._bytes,
+                    "budget": self._budget}
+
+
+class DiskRangeCache:
+    """One CRC-framed file per cached range, LRU by entry mtime.
+
+    Entry layout: ``TPQC1 | crc32(payload) u32 | payload_len u64 |
+    key_len u16 | key json | payload`` (big-endian).  Writes go to a
+    ``.tmp`` sibling and ``os.replace`` in, so a crash leaves either
+    the old entry, the new entry, or a ``.tmp`` straggler that the next
+    startup sweep removes — never a half-entry under the real name.
+    """
+
+    def __init__(self, directory: str, budget: int):
+        self._dir = directory
+        self._budget = budget
+        self._lock = threading.Lock()
+        # key -> [fname, total_file_bytes]; insertion order = LRU order
+        self._index: OrderedDict = OrderedDict()
+        self._bytes = 0
+        self._no_recache: set = set()  # poisoned keys: skip next put
+        os.makedirs(directory, exist_ok=True)
+        self._sweep()
+
+    # -- startup recovery -------------------------------------------------
+    def _sweep(self) -> None:
+        """Rebuild the index from disk: drop ``.tmp`` stragglers and
+        entries whose framing no longer parses (torn by a crash)."""
+        found = []
+        for fn in os.listdir(self._dir):
+            fp = os.path.join(self._dir, fn)
+            if fn.endswith(".tmp"):
+                _unlink_quiet(fp)
+                continue
+            if not fn.endswith(_SUFFIX):
+                continue
+            key = self._parse_header(fp)
+            if key is None:
+                _unlink_quiet(fp)  # torn entry: self-heal
+                continue
+            try:
+                st = os.stat(fp)
+            except OSError:
+                continue
+            found.append((st.st_mtime_ns, key, fn, st.st_size))
+        for _, key, fn, nbytes in sorted(found):
+            self._index[key] = [fn, nbytes]
+            self._bytes += nbytes
+
+    @staticmethod
+    def _parse_header(fp: str):
+        """Key tuple from an entry's header, or None if malformed.
+        Validates framing only — payload CRC is checked at ``get``."""
+        try:
+            with open(fp, "rb") as f:
+                hdr = f.read(_HDR)
+                if len(hdr) < _HDR or hdr[:len(_MAGIC)] != _MAGIC:
+                    return None
+                o = len(_MAGIC) + 4
+                plen = int.from_bytes(hdr[o:o + 8], "big")
+                klen = int.from_bytes(hdr[o + 8:o + 10], "big")
+                kraw = f.read(klen)
+                if len(kraw) < klen:
+                    return None
+                if os.fstat(f.fileno()).st_size != _HDR + klen + plen:
+                    return None
+                return tuple(json.loads(kraw.decode()))
+        except (OSError, ValueError):
+            return None
+
+    # -- entry naming -----------------------------------------------------
+    @staticmethod
+    def _fname(key) -> str:
+        import hashlib
+
+        raw = json.dumps(list(key)).encode()
+        return hashlib.sha256(raw).hexdigest()[:40] + _SUFFIX
+
+    # -- contract ---------------------------------------------------------
+    def get(self, key):
+        with self._lock:
+            ent = self._index.get(key)
+            if ent is not None:
+                self._index.move_to_end(key)
+        if ent is None:
+            _bump("cache_misses_disk")
+            return None
+        fp = os.path.join(self._dir, ent[0])
+        data, poisoned = self._read_entry(fp, key)
+        if data is not None:
+            _bump("cache_hits_disk")
+            try:
+                os.utime(fp)  # LRU persists across restarts
+            except OSError:
+                pass
+            return data
+        # unreadable entry: evict; on CRC poison also pin the key so
+        # the direct refetch ships uncached (see module docstring)
+        with self._lock:
+            dropped = self._index.pop(key, None)
+            if dropped is not None:
+                self._bytes -= dropped[1]
+            if poisoned:
+                self._no_recache.add(key)
+        _unlink_quiet(fp)
+        _bump("cache_misses_disk")
+        _bump("cache_evictions_disk")
+        if poisoned:
+            flight("cache_poison", site="io.remote.range", file=key[0],
+                   start=key[3], size=key[4])
+            from ..obs.postmortem import postmortem_path_for, \
+                record_incident
+
+            record_incident(postmortem_path_for(None), {
+                "kind": "cache_poison", "file": key[0],
+                "start": key[3], "size": key[4], "entry": fp,
+            })
+        return None
+
+    def contains(self, key) -> bool:
+        """Counter-free index peek for the prefetch planner.  No
+        hit/miss bump: conservation (hits + misses == lookups) is
+        pinned on ``get`` alone, and prefetch consults this before
+        deciding what to fetch — it is not a lookup."""
+        with self._lock:
+            return key in self._index
+
+    def _read_entry(self, fp: str, key):
+        """(payload, poisoned): payload None when unreadable; poisoned
+        True only for a CRC mismatch inside intact framing."""
+        try:
+            with open(fp, "rb") as f:
+                blob = f.read()
+        except OSError:
+            return None, False
+        if len(blob) < _HDR or blob[:len(_MAGIC)] != _MAGIC:
+            return None, False
+        o = len(_MAGIC)
+        crc = int.from_bytes(blob[o:o + 4], "big")
+        plen = int.from_bytes(blob[o + 4:o + 12], "big")
+        klen = int.from_bytes(blob[o + 12:o + 14], "big")
+        if len(blob) != _HDR + klen + plen:
+            return None, False
+        try:
+            stored = tuple(json.loads(blob[_HDR:_HDR + klen].decode()))
+        except ValueError:
+            return None, False
+        if stored != tuple(key):
+            return None, False
+        payload = blob[_HDR + klen:]
+        if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+            return None, True  # bit rot: the poisoning case
+        return payload, False
+
+    def put(self, key, data: bytes) -> None:
+        with self._lock:
+            if key in self._no_recache:
+                self._no_recache.discard(key)
+                return
+        kraw = json.dumps(list(key)).encode()
+        total = _HDR + len(kraw) + len(data)
+        if total > self._budget:
+            return
+        fn = self._fname(key)
+        fp = os.path.join(self._dir, fn)
+        tmp = f"{fp}.{os.getpid()}.{threading.get_ident()}.tmp"
+        hdr = (_MAGIC
+               + (zlib.crc32(data) & 0xFFFFFFFF).to_bytes(4, "big")
+               + len(data).to_bytes(8, "big")
+               + len(kraw).to_bytes(2, "big"))
+        try:
+            with open(tmp, "wb") as f:
+                f.write(hdr)
+                f.write(kraw)
+                f.write(data)
+            os.replace(tmp, fp)
+        except OSError:
+            _unlink_quiet(tmp)
+            return
+        evict = []
+        with self._lock:
+            old = self._index.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._index[key] = [fn, total]
+            self._bytes += total
+            while self._bytes > self._budget and len(self._index) > 1:
+                k, (efn, ebytes) = self._index.popitem(last=False)
+                self._bytes -= ebytes
+                evict.append(efn)
+        for efn in evict:
+            _unlink_quiet(os.path.join(self._dir, efn))
+        if evict:
+            _bump("cache_evictions_disk", len(evict))
+
+    def invalidate_path(self, path: str) -> int:
+        with self._lock:
+            doomed = [(k, ent) for k, ent in self._index.items()
+                      if k[0] == path]
+            for k, ent in doomed:
+                self._index.pop(k, None)
+                self._bytes -= ent[1]
+        for _, ent in doomed:
+            _unlink_quiet(os.path.join(self._dir, ent[0]))
+        if doomed:
+            _bump("cache_evictions_disk", len(doomed))
+        return len(doomed)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._index), "bytes": self._bytes,
+                    "budget": self._budget, "dir": self._dir}
+
+
+def _unlink_quiet(fp: str) -> None:
+    try:
+        os.unlink(fp)
+    except OSError:
+        pass
+
+
+# -- process-wide tier singletons (env-keyed, rebuilt when config
+# changes; mutated only under the module lock) --------------------------
+_LOCK = threading.Lock()
+_MEM: tuple | None = None   # (budget, MemRangeCache)
+_DISK: tuple | None = None  # ((dir, budget), DiskRangeCache)
+
+
+def mem_cache() -> MemRangeCache | None:
+    global _MEM
+    budget = mem_cache_budget()
+    if budget <= 0:
+        return None
+    with _LOCK:
+        if _MEM is None or _MEM[0] != budget:
+            _MEM = (budget, MemRangeCache(budget))
+        return _MEM[1]
+
+
+def disk_cache() -> DiskRangeCache | None:
+    global _DISK
+    d = disk_cache_dir()
+    if d is None:
+        return None
+    budget = disk_cache_budget()
+    if budget <= 0:
+        return None
+    with _LOCK:
+        if _DISK is None or _DISK[0] != (d, budget):
+            _DISK = ((d, budget), DiskRangeCache(d, budget))
+        return _DISK[1]
+
+
+def invalidate_source_caches(src: str) -> int:
+    """Drop every cached range for a source from BOTH tiers — the
+    corruption/quarantine/salvage invalidation hook.  Accepts a bare
+    path or a ``scheme://`` URI; returns entries dropped."""
+    path = _norm_path(src)
+    n = 0
+    m = mem_cache()
+    if m is not None:
+        n += m.invalidate_path(path)
+    d = disk_cache()
+    if d is not None:
+        n += d.invalidate_path(path)
+    return n
+
+
+def reset_range_caches() -> None:
+    """Test hook: forget both tier singletons (the next lookup rebuilds
+    from the current env)."""
+    global _MEM, _DISK
+    with _LOCK:
+        _MEM = None
+        _DISK = None

@@ -36,6 +36,7 @@ from ..format.footer import read_file_metadata
 from ..format.metadata import ColumnMetaData, FileMetaData
 from ..format.schema import Schema
 from .chunk import ChunkData, read_chunk
+from .source import ByteRangeSource, RangeSourceFile, open_byte_source
 from .store import assemble_record, attach_stores
 
 __all__ = ["FileReader"]
@@ -65,6 +66,67 @@ class _IoHandle:
         self.owns = owns
         self.name = name
         self.inflight = 0   # guarded by the reader's _count_lock
+
+
+class _MetaRangeFile:
+    """Footer-resolution view of a byte-range source: every read is an
+    absolute range served through the MEMORY cache tier (keyed by the
+    source's etag), so reopens of the same object — fingerprint
+    hashing, handle un-poisoning, replica opens — skip the remote
+    round trips entirely.  Misses fetch with per-request retry
+    (``remote_retry``) and count toward ``remote_ranges_fetched`` /
+    ``remote_bytes``.  Position state is local to this view; safe to
+    construct per use."""
+
+    def __init__(self, source):
+        self.source = source
+        self.name = source.uri
+        self._pos = 0
+
+    def read(self, size: int = -1) -> bytes:
+        from ..faults import retry_transient
+        from ..stats import current_stats
+        from .rangecache import mem_cache
+
+        end = self.source.size()
+        if size is None or size < 0:
+            size = max(0, end - self._pos)
+        else:
+            size = min(size, max(0, end - self._pos))
+        if size == 0:
+            return b""
+        start = self._pos
+        key = self.source.etag() + (start, size)
+        mc = mem_cache()
+        data = None if mc is None else mc.get(key)
+        if data is None:
+            data = retry_transient(
+                lambda: self.source.get_range(start, size),
+                counter="remote_retry")
+            st = current_stats()
+            if st is not None:
+                st.remote_ranges_fetched += 1
+                st.remote_bytes += size
+            if mc is not None:
+                mc.put(key, data)
+        self._pos += size
+        return data
+
+    def seek(self, offset: int, whence: int = 0) -> int:
+        import os as _os
+
+        if whence == _os.SEEK_SET:
+            self._pos = offset
+        elif whence == _os.SEEK_CUR:
+            self._pos += offset
+        elif whence == _os.SEEK_END:
+            self._pos = self.source.size() + offset
+        else:
+            raise ValueError(f"bad whence {whence}")
+        return self._pos
+
+    def tell(self) -> int:
+        return self._pos
 
 
 class FileReader:
@@ -118,7 +180,22 @@ class FileReader:
                  read_deadline: float | None = None):
         import threading
 
-        if isinstance(source, (str, bytes)) and not hasattr(source, "read"):
+        # byte-range sources (io/source.py): explicit scheme://
+        # URIs, TPQ_SOURCE-rerouted bare paths, or a ByteRangeSource
+        # instance.  The source rides behind a RangeSourceFile facade
+        # so the whole handle/hedge/deadline machinery below works
+        # unchanged; _source non-None switches on the remote-tuned
+        # read path (tiered cache, coalesced prefetch, remote_retry
+        # accounting).
+        self._source = (source if isinstance(source, ByteRangeSource)
+                        else open_byte_source(source)
+                        if isinstance(source, str) else None)
+        if self._source is not None:
+            self._f = RangeSourceFile(self._source)
+            self._owns = True
+            self.name = self._source.uri
+        elif isinstance(source, (str, bytes)) \
+                and not hasattr(source, "read"):
             self._f = open(source, "rb")
             self._owns = True
             self.name = source if isinstance(source, str) else None
@@ -207,8 +284,14 @@ class FileReader:
 
         if strict_metadata is None:
             strict_metadata = strict_metadata_default()
+        # remote sources resolve the footer through the memory cache
+        # tier (hot footers: a reopen costs zero round trips); the
+        # salvage forward-scan below stays on the plain facade — bulk
+        # page reads must not churn the small-range tier
+        mf = (self._f if self._source is None
+              else _MetaRangeFile(self._source))
         try:
-            meta = read_file_metadata(self._f)
+            meta = read_file_metadata(mf)
         except CorruptFooterError as e:
             if not salvage:
                 raise e.annotate(file=self.name)
@@ -224,7 +307,7 @@ class FileReader:
             return meta
         if not (strict_metadata or salvage):
             return meta
-        size = _source_size(self._f)
+        size = _source_size(mf)
         findings = validate_metadata(meta, size)
         self.metadata_findings = findings
         if not any(f.is_error for f in findings):
@@ -318,6 +401,24 @@ class FileReader:
                 if flen <= 0 or size - 8 - flen < 4:
                     return None
                 crc = zlib.crc32(self._buf[size - 8 - flen : size - 8])
+            elif self._source is not None:
+                # memory-tier view: the footer ranges were cached at
+                # open, so the lazy fingerprint costs no round trips
+                # (and needs no handle lock — the view is independent
+                # of the chunk-read handles)
+                mf = _MetaRangeFile(self._source)
+                size = mf.seek(0, _os.SEEK_END)
+                if size < 12:
+                    return None
+                mf.seek(size - 8)
+                # full 8-byte tail: the same range the footer read
+                # cached, so this is a guaranteed memory hit
+                tail = mf.read(8)
+                (flen,) = _struct.unpack("<I", tail[:4])
+                if flen <= 0 or size - 8 - flen < 4:
+                    return None
+                mf.seek(size - 8 - flen)
+                crc = zlib.crc32(mf.read(flen))
             else:
                 with self._count_lock:
                     h = self._io
@@ -415,6 +516,26 @@ class FileReader:
             if start + size > len(self._buf):
                 raise ValueError("byte range overruns the file")
             return bytes(self._buf[start : start + size])
+        if self._source is not None:
+            # plan hints (page index / bloom blobs) live in the memory
+            # tier: small, hot, re-read per (rg, column) across reopens
+            from ..stats import current_stats
+            from .rangecache import mem_cache
+
+            if start + size > self._source.size():
+                raise ValueError("byte range overruns the file")
+            key = self._source.etag() + (start, size)
+            mc = mem_cache()
+            data = None if mc is None else mc.get(key)
+            if data is None:
+                data = self._source.get_range(start, size)
+                st = current_stats()
+                if st is not None:
+                    st.remote_ranges_fetched += 1
+                    st.remote_bytes += size
+                if mc is not None:
+                    mc.put(key, data)
+            return data
         with self._count_lock:
             h = self._io
             h.inflight += 1
@@ -495,10 +616,12 @@ class FileReader:
                     "format.pageindex",
                     retry_transient(lambda: self._read_range(
                         cc.column_index_offset,
-                        cc.column_index_length)),
+                        cc.column_index_length),
+                        counter=self._retry_counter),
                     column=path)
                 oi_blob = retry_transient(lambda: self._read_range(
-                    cc.offset_index_offset, cc.offset_index_length))
+                    cc.offset_index_offset, cc.offset_index_length),
+                    counter=self._retry_counter)
                 ci = ColumnIndex.from_bytes(ci_blob)
                 oi = OffsetIndex.from_bytes(oi_blob)
                 findings = validate_page_index(
@@ -616,7 +739,9 @@ class FileReader:
                                             r.pos + nb)
 
                 blob = filter_bytes("format.pageindex",
-                                    retry_transient(_read),
+                                    retry_transient(
+                                        _read,
+                                        counter=self._retry_counter),
                                     column=column)
                 got = SplitBlockBloom.from_bytes(blob)
             except (ScanError, OSError, ValueError, ThriftError,
@@ -764,6 +889,13 @@ class FileReader:
                 # cache entries under it; never compute one here
                 if self._plan_fp is not _FP_UNSET:
                     invalidate_fingerprint(self._plan_fp)
+                if self._source is not None:
+                    # the bad bytes may have been SERVED from the range
+                    # cache: evict both tiers so a retry of this unit
+                    # refetches from the store, not the poison
+                    from .rangecache import invalidate_source_caches
+
+                    invalidate_source_caches(self._source.uri)
             raise e.annotate(row_group=rg_index, file=self.name)
         if ev is not None:
             import threading
@@ -812,13 +944,36 @@ class FileReader:
             fault_point("io.chunk.hang", file=self.name, column=path)
             blob = self._buf[start : start + cm.total_compressed_size]
         else:
-            blob = self._read_chunk_bytes(
-                start, cm.total_compressed_size, path)
-            if len(blob) < cm.total_compressed_size:
-                raise CorruptChunkError(
-                    f"column chunk short read: {len(blob)}/"
-                    f"{cm.total_compressed_size} bytes",
-                    column=path, file=self.name)
+            # remote path: column-chunk ranges live in the DISK cache
+            # tier (CRC-framed files, rangecache.py); a hit skips the
+            # fetch entirely, a miss fetches through the full
+            # retry/hedge/deadline ladder and back-fills the tier
+            dcache = None
+            ckey = None
+            blob = None
+            if self._source is not None:
+                from .rangecache import disk_cache
+
+                dcache = disk_cache()
+                if dcache is not None:
+                    ckey = self._source.etag() + (
+                        start, cm.total_compressed_size)
+                    blob = dcache.get(ckey)
+            if blob is None:
+                blob = self._read_chunk_bytes(
+                    start, cm.total_compressed_size, path)
+                if len(blob) < cm.total_compressed_size:
+                    raise CorruptChunkError(
+                        f"column chunk short read: {len(blob)}/"
+                        f"{cm.total_compressed_size} bytes",
+                        column=path, file=self.name)
+                if self._source is not None:
+                    st = current_stats()
+                    if st is not None:
+                        st.remote_ranges_fetched += 1
+                        st.remote_bytes += len(blob)
+                    if dcache is not None:
+                        dcache.put(ckey, blob)
         blob = filter_bytes("io.reader.chunk_read", blob, column=path)
         dt = time.perf_counter() - t0
         st = current_stats()
@@ -844,10 +999,131 @@ class FileReader:
     def iter_selected_chunks(self, rg):
         """Yield (path, node, cm, chunk_bytes, start_offset) for each
         selected chunk of a row group — the shared slurp used by both the
-        CPU and device decode paths."""
-        for path, node, cm in self.selected_chunks(rg):
+        CPU and device decode paths.  Remote sources batch-prefetch the
+        row group's chunk ranges first (coalesced, parallel) so the
+        per-chunk loop below is all cache hits."""
+        chunks = self.selected_chunks(rg)
+        if self._source is not None:
+            self.prefetch_ranges([
+                (self._chunk_start(cm), cm.total_compressed_size, path)
+                for path, node, cm in chunks])
+        for path, node, cm in chunks:
             blob, start = self.chunk_blob(cm, path)
             yield path, node, cm, blob, start
+
+    @staticmethod
+    def _chunk_start(cm) -> int:
+        start = cm.data_page_offset
+        if cm.dictionary_page_offset is not None:
+            start = min(start, cm.dictionary_page_offset)
+        return start
+
+    def prefetch_chunks(self, rg) -> None:
+        """Batch-prefetch the selected chunk ranges of one row group
+        into the disk tier (no-op for local/in-memory sources)."""
+        if self._source is None or self._buf is not None:
+            return
+        self.prefetch_ranges([
+            (self._chunk_start(cm), cm.total_compressed_size, path)
+            for path, node, cm in self.selected_chunks(rg)])
+
+    def prefetch_ranges(self, entries) -> None:
+        """The remote-tuned fetch planner: coalesce ``(start, size,
+        path)`` requests under ``TPQ_RANGE_COALESCE_GAP`` — the inverse
+        of the seek-happy local path, where every request is a round
+        trip — and fetch the merged spans in parallel under the shared
+        ``TPQ_PLAN_THREADS`` budget, populating the disk tier.
+
+        Only ranges not already cached are fetched.  Accounting is
+        exact: ``remote_ranges_fetched`` counts merged spans issued,
+        ``ranges_coalesced`` counts requests saved by merging, and
+        ``remote_bytes`` sums span payloads (gap bytes included —
+        that's the trade).  Spans retry/deadline individually; a span
+        that exhausts its retries is simply not cached, and the
+        per-chunk read path surfaces the error with full coordinates.
+        """
+        from ..stats import current_stats
+        from .rangecache import disk_cache
+        from .source import coalesce_gap_default, coalesce_ranges
+
+        if self._source is None or not entries:
+            return
+        dcache = disk_cache()
+        if dcache is None:
+            return
+        etag = self._source.etag()
+        missing = [(s, n) for s, n, _p in entries
+                   if not dcache.contains(etag + (s, n))]
+        if not missing:
+            return
+        spans = coalesce_ranges(missing, coalesce_gap_default())
+
+        def _fetch_span(start, size):
+            def _one():
+                if self._read_deadline:
+                    return call_with_deadline(
+                        lambda: self._source.get_range(start, size),
+                        self._read_deadline, site="io.remote.range",
+                        file=self.name)
+                return self._source.get_range(start, size)
+            try:
+                return retry_transient(_one, counter="remote_retry")
+            except (ScanError, OSError):
+                return None  # per-chunk path re-reads and surfaces it
+
+        n_workers = min(self._prefetch_threads(), len(spans))
+        if n_workers <= 1:
+            fetched = [_fetch_span(s, n) for s, n, _m in spans]
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            from ..stats import merge_worker_stats, worker_stats
+
+            like = current_stats()
+
+            def _task(start, size):
+                # per-thread collector, merged after join — the
+                # exactness discipline stats.py documents
+                with worker_stats(like=like) as ws:
+                    out = _fetch_span(start, size)
+                return out, ws
+
+            with ThreadPoolExecutor(max_workers=n_workers) as ex:
+                futs = [ex.submit(_task, s, n) for s, n, _m in spans]
+                fetched = []
+                for fu in futs:
+                    out, ws = fu.result()
+                    merge_worker_stats(like, ws, failed=out is None)
+                    fetched.append(out)
+        st = current_stats()
+        for (start, size, members), data in zip(spans, fetched):
+            if data is None:
+                continue
+            if st is not None:
+                st.remote_ranges_fetched += 1
+                st.remote_bytes += size
+                st.ranges_coalesced += len(members) - 1
+            for mi in members:
+                ms, mn = missing[mi]
+                dcache.put(etag + (ms, mn),
+                           bytes(data[ms - start : ms - start + mn]))
+
+    def _prefetch_threads(self) -> int:
+        """Shared thread budget: ``TPQ_PLAN_THREADS`` when set, else
+        usable cores (mirrors ``kernels/device._plan_threads`` without
+        importing the device stack on the pure-CPU path)."""
+        import os as _os
+
+        v = _os.environ.get("TPQ_PLAN_THREADS")
+        if v is not None:
+            try:
+                return max(int(v), 1)
+            except ValueError:
+                pass
+        try:
+            return len(_os.sched_getaffinity(0)) or 1
+        except (AttributeError, OSError):
+            return _os.cpu_count() or 1
 
     # -- timed / hedged / deadline-bounded chunk reads ---------------------
 
@@ -913,7 +1189,8 @@ class FileReader:
                     self._reopen_after_expiry()
                     raise
 
-            return retry_transient(_hedged)
+            return retry_transient(_hedged,
+                                   counter=self._retry_counter)
         if self._read_deadline:
             def _bounded():
                 try:
@@ -936,7 +1213,8 @@ class FileReader:
             read_latency.record(_time.monotonic() - t0)
             return out
 
-        return retry_transient(_timed)
+        return retry_transient(_timed,
+                               counter=self._retry_counter)
 
     def _note_hedge_win(self, i: int) -> None:
         """Hedge outcome feedback: a mirror win means the primary lost
@@ -981,7 +1259,12 @@ class FileReader:
         if not (self._owns and self.name):
             return  # caller-owned file object: nothing we can reopen
         try:
-            f = open(self.name, "rb")
+            if self._source is not None:
+                ns = self._source.reopen()
+                f = RangeSourceFile(ns)
+                self._source = ns
+            else:
+                f = open(self.name, "rb")
         except OSError:
             return  # keep the old handle; the retry ladder decides
         nh = _IoHandle(f, True, self.name)
@@ -1008,8 +1291,14 @@ class FileReader:
         if hasattr(src, "read"):
             nh = _IoHandle(src, False, getattr(src, "name", None))
         else:
-            nh = _IoHandle(open(src, "rb"), True,
-                           src if isinstance(src, str) else None)
+            bs = (src if isinstance(src, ByteRangeSource)
+                  else open_byte_source(src) if isinstance(src, str)
+                  else None)
+            if bs is not None:
+                nh = _IoHandle(RangeSourceFile(bs), True, bs.uri)
+            else:
+                nh = _IoHandle(open(src, "rb"), True,
+                               src if isinstance(src, str) else None)
         with self._mirror_lock:
             cur = self._mirror_handles[mi]
             if cur is None:
@@ -1042,6 +1331,13 @@ class FileReader:
         finally:
             with self._count_lock:
                 h.inflight -= 1
+
+    @property
+    def _retry_counter(self) -> str:
+        """Which DecodeStats counter the retry ladder bumps: remote
+        sources account separately (``remote_retry``) so fleet
+        dashboards can tell a flaky store from a flaky local disk."""
+        return "remote_retry" if self._source is not None else "io_retries"
 
     def _resolve_hedge_delay(self) -> float:
         if self._hedge_delay is not None:

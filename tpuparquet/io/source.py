@@ -1,0 +1,388 @@
+"""Byte-range sources: the pluggable object-store read abstraction.
+
+Production scan fleets read S3/GCS-style object stores, not local
+filesystems.  This module gives the reader a narrow, swappable contract
+for that regime — :class:`ByteRangeSource` with ``get_range``/
+``get_ranges``/``size`` — plus two concrete backends:
+
+* :class:`LocalByteRangeSource` (``file://``) — a plain local file
+  served through the range contract, so the remote-tuned read path
+  (coalescing, tiered caching, per-request retry) can be exercised and
+  parity-tested against the classic ``open()`` path byte-for-byte.
+
+* :class:`EmulatedStoreSource` (``emu://``) — a local-dir *emulator*
+  that models object-store failure behavior deterministically:
+  per-request latency, HTTP-429-style throttling, connection resets,
+  slow replicas, and truncated/short range responses, each driven by a
+  per-instance request counter (no wall-clock or RNG), so a fault plan
+  replays identically run to run.
+
+Every range read also traverses the registered fault sites
+``io.remote.open`` / ``io.remote.throttle`` / ``io.remote.range``, so
+the :mod:`tpuparquet.faults` harness can inject the same failure
+taxonomy into *any* backend, not just the emulator.
+
+Short responses are never returned to callers: a range that comes back
+with fewer bytes than requested raises :class:`TransientIOError` (the
+client-detects-and-refetches model), so truncation can never silently
+corrupt a decode.
+
+:func:`open_byte_source` resolves source strings: explicit URIs
+(``file://``, ``emu://``) always resolve; bare paths resolve only when
+``TPQ_SOURCE`` names a scheme — and keep their plain path as the
+display name, so cursors, quarantine records, and fault-plan ``file=``
+matches stay stable when a whole suite is rerouted through the
+emulator.
+
+:func:`coalesce_ranges` is the remote-tuned planner primitive: merge
+adjacent chunk reads under a gap threshold (``TPQ_RANGE_COALESCE_GAP``)
+— the inverse of the seek-happy local path, where every extra request
+is a round trip.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ..errors import TransientIOError
+from ..faults import fault_point, filter_bytes
+from ..obs.recorder import flight
+
+__all__ = [
+    "ByteRangeSource",
+    "LocalByteRangeSource",
+    "EmulatedStoreSource",
+    "RangeSourceFile",
+    "coalesce_ranges",
+    "coalesce_gap_default",
+    "open_byte_source",
+    "parse_source_uri",
+]
+
+_SCHEMES = ("file", "emu")
+
+
+def parse_source_uri(src):
+    """``"emu:///data/f.parquet"`` -> ``("emu", "/data/f.parquet")``;
+    ``None`` for a bare path; :class:`ValueError` for a scheme this
+    build does not know (a typo'd scheme must fail loudly at open, not
+    fall through to ``open()`` and produce ENOENT noise)."""
+    if not isinstance(src, str):
+        return None
+    head, sep, rest = src.partition("://")
+    if not sep:
+        return None
+    if head not in _SCHEMES:
+        raise ValueError(f"unsupported source scheme {head!r} in {src!r} "
+                         f"(known: {', '.join(_SCHEMES)})")
+    return head, rest
+
+
+def open_byte_source(src):
+    """Resolve a source string to a :class:`ByteRangeSource`, or
+    ``None`` when the classic local-``open()`` path should be used.
+
+    Explicit ``scheme://`` URIs always resolve.  Bare paths resolve
+    only when ``TPQ_SOURCE`` names a scheme (``file`` or ``emu``) —
+    the reroute keeps the bare path as the source's display name so
+    every path-keyed artifact (cursors, quarantine entries, fault-plan
+    matches) is byte-identical to a local run.
+    """
+    parsed = parse_source_uri(src)
+    if parsed is not None:
+        scheme, path = parsed
+        uri = src
+    else:
+        if not isinstance(src, str):
+            return None
+        scheme = os.environ.get("TPQ_SOURCE", "").strip().lower()
+        if not scheme:
+            return None
+        if scheme not in _SCHEMES:
+            raise ValueError(
+                f"TPQ_SOURCE={scheme!r} is not a known scheme "
+                f"(known: {', '.join(_SCHEMES)})")
+        path = src
+        uri = src  # bare path stays the display name (see docstring)
+    if scheme == "emu":
+        return EmulatedStoreSource(path, uri=uri)
+    return LocalByteRangeSource(path, uri=uri)
+
+
+def coalesce_gap_default() -> int:
+    """``TPQ_RANGE_COALESCE_GAP`` — merge two requested ranges into one
+    fetch when the hole between them is at most this many bytes
+    (default 256 KiB: on an object store a round trip costs far more
+    than shipping a quarter-megabyte of dead bytes)."""
+    v = os.environ.get("TPQ_RANGE_COALESCE_GAP")
+    if not v:
+        return 256 * 1024
+    return max(0, int(v))
+
+
+def coalesce_ranges(ranges, gap: int = 0):
+    """Merge ``[(start, size), ...]`` into fetch spans under a gap
+    threshold.
+
+    Returns ``[(start, size, members), ...]`` where ``members`` lists
+    the indices of the requested ranges served by that span.  Spans are
+    disjoint and sorted, every requested byte is covered by exactly one
+    span (overlapping requests are never double-fetched), and a
+    requested range is always a contiguous slice of its span —
+    ``data[rs - start : rs - start + rn]`` recovers it.
+    """
+    if gap < 0:
+        raise ValueError(f"gap must be >= 0, got {gap}")
+    order = sorted(range(len(ranges)),
+                   key=lambda i: (ranges[i][0], ranges[i][1]))
+    merged = []  # [start, end, [member indices]]
+    for i in order:
+        s, n = ranges[i]
+        if s < 0 or n < 0:
+            raise ValueError(f"bad range {(s, n)!r}")
+        if merged and s <= merged[-1][1] + gap:
+            m = merged[-1]
+            m[1] = max(m[1], s + n)
+            m[2].append(i)
+        else:
+            merged.append([s, s + n, [i]])
+    return [(s, e - s, mem) for s, e, mem in merged]
+
+
+class ByteRangeSource:
+    """The object-store read contract: exact byte ranges by offset.
+
+    Subclasses implement ``_read_raw(start, size)`` and set ``path``,
+    ``uri``, ``_size`` and ``_etag`` in ``__init__``.  ``get_range``
+    wraps every read with the registered remote fault sites and the
+    short-response check; ``get_ranges`` is the multi-range batch hook
+    (base implementation: sequential — a real S3/GCS backend would
+    issue them concurrently; the reader's prefetch layer already
+    parallelizes above this call).
+    """
+
+    scheme = "?"
+
+    # -- subclass surface -------------------------------------------------
+    def _read_raw(self, start: int, size: int) -> bytes:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    def reopen(self) -> "ByteRangeSource":
+        """A fresh, independent source for the same object — used by
+        handle un-poisoning and mirror opens."""
+        raise NotImplementedError
+
+    # -- contract ---------------------------------------------------------
+    def size(self) -> int:
+        return self._size
+
+    def etag(self):
+        """Cache identity: ``(path, size, mtime_ns)``.  Any rewrite of
+        the object changes it, so stale cache entries can never serve a
+        new file's reads."""
+        return self._etag
+
+    def get_range(self, start: int, size: int) -> bytes:
+        """Exactly ``size`` bytes at ``start``.  A short response —
+        injected, emulated, or real (EOF race with a concurrent
+        truncate) — raises :class:`TransientIOError` so the retry
+        ladder refetches; callers never see silently truncated data."""
+        fault_point("io.remote.throttle", file=self.uri)
+        fault_point("io.remote.range", file=self.uri,
+                    start=start, size=size)
+        data = self._read_raw(start, size)
+        data = filter_bytes("io.remote.range", data, file=self.uri,
+                            start=start, size=size)
+        if len(data) != size:
+            raise TransientIOError(
+                f"short range response from {self.uri}: "
+                f"{len(data)}/{size} bytes at offset {start}")
+        return data
+
+    def get_ranges(self, ranges):
+        """Batch fetch: ``[(start, size), ...] -> [bytes, ...]``."""
+        return [self.get_range(s, n) for s, n in ranges]
+
+
+class LocalByteRangeSource(ByteRangeSource):
+    """``file://`` — a local file behind the range contract."""
+
+    scheme = "file"
+
+    def __init__(self, path: str, uri: str | None = None):
+        self.path = path
+        self.uri = uri if uri is not None else f"file://{path}"
+        fault_point("io.remote.open", file=self.uri)
+        self._f = open(path, "rb")
+        self._lock = threading.Lock()  # serializes seek+read pairs
+        self._closed = False
+        st = os.fstat(self._f.fileno())
+        self._size = st.st_size
+        self._etag = (path, st.st_size, st.st_mtime_ns)
+
+    def _read_raw(self, start: int, size: int) -> bytes:
+        with self._lock:
+            self._f.seek(start)
+            return self._f.read(size)
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._closed:
+                self._closed = True
+                self._f.close()
+
+    def reopen(self) -> "LocalByteRangeSource":
+        return type(self)(self.path, uri=self.uri)
+
+
+class EmulatedStoreSource(LocalByteRangeSource):
+    """``emu://`` — object-store behavior modeled over a local file.
+
+    Deterministic by construction: every fault fires on a per-instance
+    request counter (throttle/reset/short on every Nth request), never
+    on wall-clock or RNG, so a failing run replays exactly.  Knobs come
+    from the constructor or their ``TPQ_EMU_*`` env defaults:
+
+    * ``latency_ms`` / ``TPQ_EMU_LATENCY_MS`` — fixed per-request pause
+      (the round-trip cost the coalescer exists to amortize).
+    * ``throttle_every`` / ``TPQ_EMU_THROTTLE_EVERY`` — every Nth
+      request fails like an HTTP 429 (:class:`TransientIOError`).
+    * ``reset_every`` / ``TPQ_EMU_RESET_EVERY`` — every Nth request
+      dies mid-flight (:class:`ConnectionResetError`).
+    * ``short_every`` / ``TPQ_EMU_SHORT_EVERY`` — every Nth response
+      returns half the requested bytes (detected upstream and retried).
+    * ``slow_match`` + ``slow_ms`` / ``TPQ_EMU_SLOW_MATCH`` +
+      ``TPQ_EMU_SLOW_MS`` — replicas whose path contains the substring
+      pay an extra pause per request: the tail-latency replica the
+      hedging machinery exists to route around.
+
+    ``0`` / empty disables a knob.  Every injected fault is announced
+    on the flight recorder (``emu_fault``) before it fires — no silent
+    failures, per the no-silent-retries observability contract.
+    """
+
+    scheme = "emu"
+
+    def __init__(self, path: str, uri: str | None = None, *,
+                 latency_ms: float | None = None,
+                 throttle_every: int | None = None,
+                 reset_every: int | None = None,
+                 short_every: int | None = None,
+                 slow_match: str | None = None,
+                 slow_ms: float | None = None):
+        def _f(v, env, dflt):
+            return float(os.environ.get(env) or dflt) if v is None else v
+
+        def _i(v, env):
+            return int(os.environ.get(env) or 0) if v is None else v
+
+        self._latency_s = _f(latency_ms, "TPQ_EMU_LATENCY_MS", 0.0) / 1e3
+        self._throttle_every = _i(throttle_every, "TPQ_EMU_THROTTLE_EVERY")
+        self._reset_every = _i(reset_every, "TPQ_EMU_RESET_EVERY")
+        self._short_every = _i(short_every, "TPQ_EMU_SHORT_EVERY")
+        self._slow_match = (os.environ.get("TPQ_EMU_SLOW_MATCH", "")
+                            if slow_match is None else slow_match)
+        self._slow_s = _f(slow_ms, "TPQ_EMU_SLOW_MS", 50.0) / 1e3
+        self._requests = 0  # guarded by _req_lock
+        self._req_lock = threading.Lock()
+        super().__init__(path, uri=uri if uri is not None
+                         else f"emu://{path}")
+
+    def _knobs(self) -> dict:
+        return {
+            "latency_ms": self._latency_s * 1e3,
+            "throttle_every": self._throttle_every,
+            "reset_every": self._reset_every,
+            "short_every": self._short_every,
+            "slow_match": self._slow_match,
+            "slow_ms": self._slow_s * 1e3,
+        }
+
+    def reopen(self) -> "EmulatedStoreSource":
+        return type(self)(self.path, uri=self.uri, **self._knobs())
+
+    def _read_raw(self, start: int, size: int) -> bytes:
+        with self._req_lock:
+            self._requests += 1
+            n = self._requests
+        if self._latency_s > 0:
+            time.sleep(self._latency_s)
+        if self._slow_match and self._slow_match in self.path:
+            time.sleep(self._slow_s)
+        if self._throttle_every and n % self._throttle_every == 0:
+            flight("emu_fault", site="io.remote.throttle", fault="throttle",
+                   file=self.uri, request=n)
+            raise TransientIOError(
+                f"429 throttled (emulated, request {n}) on {self.uri}")
+        if self._reset_every and n % self._reset_every == 0:
+            flight("emu_fault", site="io.remote.range", fault="reset",
+                   file=self.uri, request=n)
+            raise ConnectionResetError(
+                f"connection reset (emulated, request {n}) on {self.uri}")
+        data = super()._read_raw(start, size)
+        if self._short_every and n % self._short_every == 0 and len(data) > 1:
+            flight("emu_fault", site="io.remote.range", fault="short",
+                   file=self.uri, request=n)
+            return data[:len(data) // 2]
+        return data
+
+
+class RangeSourceFile:
+    """Seekable file-object facade over a :class:`ByteRangeSource`.
+
+    Lets the entire existing reader stack — footer framing, fingerprint
+    hashing, salvage scans, hedged/deadline-bounded chunk reads via
+    ``_IoHandle`` — run unchanged against a remote source: every
+    ``seek``+``read`` pair becomes one exact range request.  Position
+    state is per-facade; concurrency control stays where it already
+    lives (the reader's handle lock).
+    """
+
+    def __init__(self, source: ByteRangeSource):
+        self.source = source
+        self.name = source.uri
+        self._pos = 0
+
+    def read(self, size: int = -1) -> bytes:
+        end = self.source.size()
+        if size is None or size < 0:
+            size = max(0, end - self._pos)
+        else:
+            size = min(size, max(0, end - self._pos))
+        if size == 0:
+            return b""
+        data = self.source.get_range(self._pos, size)
+        self._pos += size
+        return data
+
+    def seek(self, offset: int, whence: int = os.SEEK_SET) -> int:
+        if whence == os.SEEK_SET:
+            self._pos = offset
+        elif whence == os.SEEK_CUR:
+            self._pos += offset
+        elif whence == os.SEEK_END:
+            self._pos = self.source.size() + offset
+        else:
+            raise ValueError(f"bad whence {whence}")
+        return self._pos
+
+    def tell(self) -> int:
+        return self._pos
+
+    def readable(self) -> bool:
+        return True
+
+    def seekable(self) -> bool:
+        return True
+
+    def close(self) -> None:
+        self.source.close()
+
+    @property
+    def closed(self) -> bool:
+        return getattr(self.source, "_closed", False)

@@ -2451,6 +2451,13 @@ def read_row_group_device(reader, rg_index: int, filter=None,
     arenas = []
     try:
         cols = reader.selected_chunks(rg)
+        # remote sources: batch-prefetch the row group's chunk ranges
+        # (coalesced, parallel) so the column planners below hit the
+        # disk tier instead of issuing one round trip each.  No-op for
+        # local/in-memory sources.
+        pf = getattr(reader, "prefetch_chunks", None)
+        if pf is not None:
+            pf(rg)
         n_workers = min(_plan_threads(), max(len(cols), 1))
         if n_workers <= 1:
             # serial path: plan on the calling thread under the caller's
@@ -2625,6 +2632,19 @@ def read_row_group_device_resilient(reader, rg_index: int,
     return attempt_once(degraded=True)
 
 
+def _drop_range_caches(reader) -> None:
+    """Corruption hook for remote sources: the bad bytes may have been
+    SERVED from the range cache, so evict both tiers for this source —
+    the resilient retry then refetches from the store, not the poison.
+    No-op for local readers."""
+    src = getattr(reader, "_source", None)
+    if src is None:
+        return
+    from ..io.rangecache import invalidate_source_caches
+
+    invalidate_source_caches(src.uri)
+
+
 def _plan_one_column(reader, rg_index: int, path, node, cm,
                      arena: HostArena, degraded: bool = False):
     """Plan ONE column chunk into its own stager — the unit of work the
@@ -2669,6 +2689,7 @@ def _plan_one_column(reader, rg_index: int, path, node, cm,
             from .plancache import invalidate_fingerprint
 
             invalidate_fingerprint(fingerprint)
+            _drop_range_caches(reader)
         _trace.close_span(tsp, status="error")
         raise e.annotate(column=path, file=getattr(reader, "name", None))
     except ValueError as e:
@@ -2678,6 +2699,7 @@ def _plan_one_column(reader, rg_index: int, path, node, cm,
         from .plancache import invalidate_fingerprint
 
         invalidate_fingerprint(fingerprint)
+        _drop_range_caches(reader)
         _trace.close_span(tsp, status="error")
         raise CorruptChunkError(
             str(e), column=path,

@@ -231,6 +231,14 @@ def open_sources(sources, columns, *, on_error: str,
     for i, err in sorted(failures.items()):
         primary = _replicas(sources[i])[0]
         path = primary if isinstance(primary, str) else None
+        if path is not None:
+            # a failing open may have been fed by (or may have seeded)
+            # stale cached ranges: drop both tiers for this source so
+            # the salvage retry below — and the next scan — reads the
+            # store's truth, not the cache's memory of a bad file
+            from ..io.rangecache import invalidate_source_caches
+
+            invalidate_source_caches(path)
         if salvage:
             try:
                 with _counters_only_if_recorded(i):

@@ -205,6 +205,28 @@ class DecodeStats:
     plan_cache_hits: int = 0
     plan_cache_misses: int = 0
     plan_cache_evictions: int = 0
+    # -- remote byte-range sources (io/source.py, io/rangecache.py) --
+    # range requests actually issued to a remote source by the chunk
+    # fetch path (after coalescing; cache hits never issue one) and the
+    # requests *saved* by merging: a prefetch of R chunk ranges that
+    # collapses to M fetches adds M to remote_ranges_fetched and R - M
+    # to ranges_coalesced.  remote_bytes is the exact payload total of
+    # issued fetches (gap bytes included — that's the trade the
+    # coalescer makes); remote_retry counts retry-ladder re-issues
+    # against remote sources (the remote twin of io_retries)
+    remote_ranges_fetched: int = 0
+    ranges_coalesced: int = 0
+    remote_bytes: int = 0
+    remote_retry: int = 0
+    # tiered range cache: per-tier lookups split exactly into hits +
+    # misses (conservation: hits + misses == lookups), evictions are
+    # LRU drops, budget rejections and poison/invalidation removals
+    cache_hits_mem: int = 0
+    cache_misses_mem: int = 0
+    cache_evictions_mem: int = 0
+    cache_hits_disk: int = 0
+    cache_misses_disk: int = 0
+    cache_evictions_disk: int = 0
     # where the device-path wall went, accumulated per unit: host plan
     # phase (page walk, decompression, run-table scans — overlapped with
     # transfer by the pipelined reader, so plan_s can exceed the e2e
@@ -247,6 +269,10 @@ class DecodeStats:
         "gather_bytes_moved", "gather_bytes_replicated",
         "gather_reshard_s",
         "plan_cache_hits", "plan_cache_misses", "plan_cache_evictions",
+        "remote_ranges_fetched", "ranges_coalesced", "remote_bytes",
+        "remote_retry",
+        "cache_hits_mem", "cache_misses_mem", "cache_evictions_mem",
+        "cache_hits_disk", "cache_misses_disk", "cache_evictions_disk",
         "plan_s", "transfer_s", "dispatch_s",
     )
 
@@ -334,6 +360,16 @@ class DecodeStats:
             "plan_cache_hits": self.plan_cache_hits,
             "plan_cache_misses": self.plan_cache_misses,
             "plan_cache_evictions": self.plan_cache_evictions,
+            "remote_ranges_fetched": self.remote_ranges_fetched,
+            "ranges_coalesced": self.ranges_coalesced,
+            "remote_bytes": self.remote_bytes,
+            "remote_retry": self.remote_retry,
+            "cache_hits_mem": self.cache_hits_mem,
+            "cache_misses_mem": self.cache_misses_mem,
+            "cache_evictions_mem": self.cache_evictions_mem,
+            "cache_hits_disk": self.cache_hits_disk,
+            "cache_misses_disk": self.cache_misses_disk,
+            "cache_evictions_disk": self.cache_evictions_disk,
             "plan_s": round(self.plan_s, 6),
             "transfer_s": round(self.transfer_s, 6),
             "dispatch_s": round(self.dispatch_s, 6),
@@ -400,6 +436,18 @@ class DecodeStats:
                f"{d['plan_cache_evictions']} evictions"
                if (d["plan_cache_hits"] or d["plan_cache_misses"]
                    or d["plan_cache_evictions"]) else "")
+            + (f"; REMOTE: {d['remote_ranges_fetched']} ranges "
+               f"({d['ranges_coalesced']} coalesced away), "
+               f"{d['remote_bytes']:,}B fetched, "
+               f"{d['remote_retry']} retries; cache mem "
+               f"{d['cache_hits_mem']}/{d['cache_misses_mem']}"
+               f"/{d['cache_evictions_mem']} disk "
+               f"{d['cache_hits_disk']}/{d['cache_misses_disk']}"
+               f"/{d['cache_evictions_disk']} (hit/miss/evict)"
+               if (d["remote_ranges_fetched"] or d["remote_retry"]
+                   or d["cache_hits_mem"] or d["cache_misses_mem"]
+                   or d["cache_hits_disk"] or d["cache_misses_disk"])
+               else "")
             + (f"; SALVAGE: {d['files_salvaged']} files salvaged "
                f"({d['row_groups_recovered']} row groups recovered), "
                f"{d['files_quarantined']} files quarantined, "
@@ -534,7 +582,8 @@ def worker_stats(like: "DecodeStats | None" = None):
 # These must cover every counter the fault EVENTS (which DO merge on
 # failure) can record, or counters and events diverge.
 _FAULT_OBSERVABILITY_FIELDS = ("faults_injected", "crc_mismatches",
-                               "io_retries", "dispatch_retries",
+                               "io_retries", "remote_retry",
+                               "dispatch_retries",
                                "deadline_exceeded", "hedges_issued",
                                "hedges_won")
 
