@@ -25,10 +25,17 @@ from tools.analyze import (  # noqa: E402
     counters,
     envknobs,
     faultsites,
+    lifecycle,
+    raises,
     recorderguard,
     run_analysis,
     threads,
 )
+
+_ALL_PASSES = [
+    "atomic-write", "counters", "env-knobs", "exception-taxonomy",
+    "fault-sites", "recorder-guard", "resource-lifecycle",
+    "thread-safety"]
 
 
 def _tree(files, readme=None):
@@ -351,6 +358,47 @@ class TestRecorderGuardPass:
                         u.decode()
                     except ValueError:
                         flight("quarantined", unit=u)
+        """})
+        assert recorderguard.run(t) == []
+
+    # -- round-18 hot kinds: emu_fault/cache_poison/prefetch_span sit
+    #    on the remote-read path, so the guard is required regardless
+    #    of loop or exception context --------------------------------
+
+    def test_hot_kind_unguarded_flagged_outside_loop(self):
+        t = _tree({"tpuparquet/io/x.py": """
+            from .obs.recorder import flight
+
+            def fetch(uri):
+                flight("emu_fault", file=uri)
+        """})
+        assert _keys(recorderguard.run(t), "unguarded-hot-kind") \
+            == ["fetch:emu_fault"]
+
+    def test_hot_kind_unguarded_in_except_still_flagged(self):
+        # cold-path leniency does NOT apply to the hot kinds
+        t = _tree({"tpuparquet/io/x.py": """
+            from .obs import recorder as _flightrec
+
+            def get(key):
+                try:
+                    return _load(key)
+                except ValueError:
+                    _flightrec.flight("cache_poison", key=key)
+                    raise
+        """})
+        assert _keys(recorderguard.run(t), "unguarded-hot-kind") \
+            == ["get:cache_poison"]
+
+    def test_hot_kind_guarded_accepted(self):
+        t = _tree({"tpuparquet/io/x.py": """
+            from .obs import recorder as _flightrec
+
+            def prefetch(spans):
+                for s in spans:
+                    if _flightrec._active is not None:
+                        _flightrec.flight("prefetch_span", start=s.a,
+                                          size=s.n)
         """})
         assert recorderguard.run(t) == []
 
@@ -699,6 +747,284 @@ class TestThreadSafetyPass:
 
 
 # ----------------------------------------------------------------------
+# resource-lifecycle
+# ----------------------------------------------------------------------
+
+class TestLifecyclePass:
+    def test_with_managed_accepted(self):
+        t = _tree({"tpuparquet/io/x.py": """
+            def read(path):
+                with open(path, "rb") as f:
+                    return f.read()
+        """})
+        assert lifecycle.run(t) == []
+
+    def test_unreleased_acquire_flagged(self):
+        t = _tree({"tpuparquet/io/x.py": """
+            def peek(path):
+                f = open(path, "rb")
+                magic = f.read(4)
+                return magic == b"PAR1"
+        """})
+        found = lifecycle.run(t)
+        assert _keys(found, "unreleased-acquire") == ["peek:f"]
+
+    def test_finally_release_accepted(self):
+        t = _tree({"tpuparquet/io/x.py": """
+            def peek(path):
+                f = open(path, "rb")
+                try:
+                    return f.read(4)
+                finally:
+                    f.close()
+        """})
+        assert lifecycle.run(t) == []
+
+    def test_leak_on_error_flagged(self):
+        # released, but a raise-able call sits between acquire and
+        # release with no finally: the error path leaks the fd
+        t = _tree({"tpuparquet/io/x.py": """
+            def head(path, n):
+                f = open(path, "rb")
+                data = decode(f.read(n))
+                f.close()
+                return data
+        """})
+        assert _keys(lifecycle.run(t), "leak-on-error") == ["head:f"]
+
+    def test_ownership_transfer_accepted(self):
+        t = _tree({"tpuparquet/io/x.py": """
+            def open_part(path):
+                f = open(path, "wb")
+                return Writer(f)
+        """})
+        assert lifecycle.run(t) == []
+
+    def test_ctor_leak_on_error_flagged(self):
+        t = _tree({"tpuparquet/io/x.py": """
+            class Source:
+                def __init__(self, path):
+                    self._f = open(path, "rb")
+                    self._size = probe_size(path)
+        """})
+        found = lifecycle.run(t)
+        assert _keys(found, "ctor-leak-on-error") \
+            == ["Source.__init__:_f"]
+
+    def test_ctor_guarded_accepted(self):
+        t = _tree({"tpuparquet/io/x.py": """
+            class Source:
+                def __init__(self, path):
+                    self._f = open(path, "rb")
+                    try:
+                        self._size = probe_size(path)
+                    except BaseException:
+                        self._f.close()
+                        raise
+        """})
+        assert lifecycle.run(t) == []
+
+
+# ----------------------------------------------------------------------
+# exception-taxonomy
+# ----------------------------------------------------------------------
+
+_ERRORS_FIXTURE = """
+    class ScanError(Exception):
+        def __init__(self, message="", *, file=None, row_group=None,
+                     column=None, page=None):
+            super().__init__(message)
+
+    class CorruptPageError(ScanError):
+        pass
+
+    class BadKnobError(ValueError):
+        pass
+
+    FormatError = CorruptPageError
+"""
+
+
+class TestRaisesPass:
+    def _tree(self, body):
+        return _tree({"tpuparquet/errors.py": _ERRORS_FIXTURE,
+                      "tpuparquet/io/x.py": body})
+
+    def test_family_raise_with_coords_accepted(self):
+        t = self._tree("""
+            from ..errors import CorruptPageError
+
+            def decode(path, pg):
+                raise CorruptPageError("bad crc", file=path, page=pg)
+        """)
+        assert raises.run(t) == []
+
+    def test_family_raise_without_coords_flagged(self):
+        t = self._tree("""
+            from ..errors import CorruptPageError
+
+            def decode(path, pg):
+                raise CorruptPageError("bad crc")
+        """)
+        assert _keys(raises.run(t), "taxonomy-no-coords") \
+            == ["decode:CorruptPageError"]
+
+    def test_non_taxonomy_raise_flagged(self):
+        t = self._tree("""
+            def decode(path):
+                raise RuntimeError("bad crc in " + path)
+        """)
+        assert _keys(raises.run(t), "non-taxonomy-raise") \
+            == ["decode:RuntimeError"]
+
+    def test_repo_valueerror_subclass_is_plain_vocabulary(self):
+        # a repo class whose base closure reaches an allowed builtin
+        # is classifiable — no coords required, not flagged
+        t = self._tree("""
+            from ..errors import BadKnobError
+
+            def parse(v):
+                raise BadKnobError(f"bad knob {v!r}")
+        """)
+        assert raises.run(t) == []
+
+    def test_module_alias_resolves_to_family(self):
+        # FormatError = CorruptPageError: the alias inherits the
+        # family's coordinate obligation, keyed by the RESOLVED class
+        # so a rename of the alias can't dodge an allowlist entry
+        t = self._tree("""
+            from ..errors import FormatError
+
+            def decode(path):
+                raise FormatError("bad magic")
+        """)
+        assert _keys(raises.run(t), "taxonomy-no-coords") \
+            == ["decode:CorruptPageError"]
+
+    def test_factory_reraise_skipped(self):
+        t = self._tree("""
+            def fail(err):
+                raise err
+        """)
+        assert raises.run(t) == []
+
+
+# ----------------------------------------------------------------------
+# whole-program lock graph + runtime cross-validation
+# ----------------------------------------------------------------------
+
+class TestLockGraph:
+    def test_virtual_dispatch_reaches_override_locks(self):
+        # a base-typed call (template method) must fan out to the
+        # subclass overrides that actually take locks — this is the
+        # _read_raw pattern the runtime recorder caught
+        t = _tree({"tpuparquet/io/src.py": """
+            import threading
+
+            class Base:
+                def get(self, n):
+                    return self._raw(n)
+
+                def _raw(self, n):
+                    raise NotImplementedError
+
+            class Local(Base):
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def _raw(self, n):
+                    with self._lock:
+                        return n
+
+            class Facade:
+                def __init__(self, source: Base):
+                    self.source = source
+
+                def read(self, n):
+                    return self.source.get(n)
+        """, "tpuparquet/io/rd.py": """
+            import threading
+
+            from .src import Facade
+
+            class Handle:
+                def __init__(self, f: "Facade | object"):
+                    self.f = f
+                    self.lock = threading.Lock()
+
+            class Reader:
+                def __init__(self, h):
+                    self._io = Handle(open("x", "rb"))
+
+                def read_at(self, n):
+                    h = self._io
+                    with h.lock:
+                        return h.f.read(n)
+        """})
+        g = threads.static_graph(t)
+        edges = set(map(tuple, g["edges"]))
+        assert ("tpuparquet/io/rd.py:9",
+                "tpuparquet/io/src.py:13") in edges, g["edges"]
+
+    def test_runtime_subgraph_verified(self):
+        t = _tree({"tpuparquet/a.py": """
+            import threading
+
+            _la = threading.Lock()
+            _lb = threading.Lock()
+
+            def f():
+                with _la:
+                    with _lb:
+                        pass
+        """})
+        ok = {"locks": ["tpuparquet/a.py:4"],
+              "edges": [["tpuparquet/a.py:4", "tpuparquet/a.py:5", 3]],
+              "violations": []}
+        assert threads.verify_runtime_graph(t, ok) == []
+
+    def test_runtime_edge_missing_from_static_fails(self):
+        t = _tree({"tpuparquet/a.py": """
+            import threading
+
+            _la = threading.Lock()
+            _lb = threading.Lock()
+        """})
+        bad = {"locks": [], "edges": [
+            ["tpuparquet/a.py:5", "tpuparquet/a.py:4", 1]],
+            "violations": []}
+        problems = threads.verify_runtime_graph(t, bad)
+        assert problems and "absent from the static lock graph" \
+            in problems[0]
+
+    def test_runtime_violation_always_fails(self):
+        t = _tree({})
+        problems = threads.verify_runtime_graph(
+            t, {"locks": [], "edges": [], "violations": [
+                {"kind": "lock-cycle", "cycle": ["a", "b", "a"]}]})
+        assert problems and "runtime violation" in problems[0]
+
+    def test_foreign_edges_ignored(self):
+        t = _tree({})
+        dump = {"locks": [], "edges": [
+            ["/usr/lib/python3.11/logging/__init__.py:226",
+             "tpuparquet/a.py:4", 9]], "violations": []}
+        assert threads.verify_runtime_graph(t, dump) == []
+
+    def test_real_tree_models_iohandle_source_path(self):
+        # regression for the recorder-caught gap: holding the
+        # _IoHandle serialization lock, a RangeSourceFile read
+        # reaches the fault-injector and byte-source locks
+        g = threads.static_graph(RepoTree.from_disk(_REPO))
+        edges = set(map(tuple, g["edges"]))
+        srcs = {b for (a, b) in edges
+                if a.startswith("tpuparquet/io/reader.py")}
+        assert any(s.startswith("tpuparquet/faults.py") for s in srcs)
+        assert any(s.startswith("tpuparquet/io/source.py")
+                   for s in srcs), sorted(edges)
+
+
+# ----------------------------------------------------------------------
 # allowlist + gate
 # ----------------------------------------------------------------------
 
@@ -731,6 +1057,29 @@ class TestAllowlist:
             run_analysis(tree=_tree({}), passes=["nope"],
                          allowlist=Allowlist([]))
 
+    def test_audit_fails_on_missing_target_file(self):
+        t = _tree({"tpuparquet/io/x.py": "pass\n"})
+        al = Allowlist([
+            {"pass": "atomic-write", "file": "tpuparquet/io/x.py",
+             "key": "live", "reason": "fixture",
+             "added": "2026-08-01"},
+            {"pass": "atomic-write", "file": "tpuparquet/io/gone.py",
+             "key": "dead", "reason": "file was deleted"},
+        ])
+        rep = al.audit(t)
+        assert not rep["ok"]
+        assert [e["key"] for e in rep["missing_target"]] == ["dead"]
+        # entries sort oldest-first; undated rows sort before dated
+        assert [e["added"] for e in rep["entries"]] \
+            == ["(pre-audit)", "2026-08-01"]
+
+    def test_shipped_allowlist_audit_clean(self):
+        from tools.analyze import DEFAULT_ALLOWLIST
+
+        al = Allowlist.load(DEFAULT_ALLOWLIST)
+        rep = al.audit(RepoTree.from_disk(_REPO))
+        assert rep["ok"], rep["missing_target"]
+
 
 class TestSelfRun:
     def test_repo_tree_is_gate_clean(self):
@@ -743,9 +1092,12 @@ class TestSelfRun:
 
     def test_every_pass_ran(self):
         res = run_analysis(root=_REPO)
-        assert sorted(res["counts"]) == [
-            "atomic-write", "counters", "env-knobs", "fault-sites",
-            "recorder-guard", "thread-safety"]
+        assert sorted(res["counts"]) == _ALL_PASSES
+
+    def test_per_pass_timings_reported(self):
+        res = run_analysis(root=_REPO)
+        assert sorted(res["timings_s"]) == _ALL_PASSES
+        assert all(t >= 0 for t in res["timings_s"].values())
 
     def test_allowlist_entries_all_used(self):
         # the shipped allowlist holds only LIVE justified exceptions
@@ -758,6 +1110,5 @@ class TestSelfRun:
         rc = main(["--json", "--root", _REPO])
         out = json.loads(capsys.readouterr().out)
         assert rc == 0 and out["ok"]
-        assert set(out["counts"]) == set(
-            ["atomic-write", "counters", "env-knobs", "fault-sites",
-             "recorder-guard", "thread-safety"])
+        assert set(out["counts"]) == set(_ALL_PASSES)
+        assert set(out["timings_s"]) == set(_ALL_PASSES)

@@ -1,0 +1,144 @@
+"""Schedule-chaos validator: seeded interleavings must not change any
+observable output.
+
+``TestChaosScope`` covers the schedule mechanics; ``TestSuites`` runs
+the tools/chaos cross-seed sweep (the tentpole acceptance criterion:
+>= 3 seeds, byte-identical output, exact counter conservation);
+``TestDiskCacheConcurrentWriters`` is the round-18 regression riding
+along — two threads caching the same key under chaos must leave one
+intact TPQC1 frame and no phantom eviction counts.
+"""
+
+import hashlib
+import os
+import sys
+import threading
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tpuparquet.faults import ChaosSchedule, chaos_scope  # noqa: E402
+
+
+class TestChaosScope:
+    def test_draws_are_seed_deterministic(self):
+        a = ChaosSchedule(101)
+        b = ChaosSchedule(101)
+        c = ChaosSchedule(202)
+        seq_a = [a._draw("io.remote.range", n) for n in range(32)]
+        seq_b = [b._draw("io.remote.range", n) for n in range(32)]
+        seq_c = [c._draw("io.remote.range", n) for n in range(32)]
+        assert seq_a == seq_b
+        assert seq_a != seq_c
+        assert a.switch_interval == b.switch_interval
+
+    def test_scope_pins_and_restores_switch_interval(self):
+        prev = sys.getswitchinterval()
+        with chaos_scope(101) as sched:
+            # the interpreter rounds to its internal resolution —
+            # compare loosely
+            assert sys.getswitchinterval() == pytest.approx(
+                sched.switch_interval, rel=0.25)
+            assert sched.switch_interval < 1e-3  # aggressive
+        assert sys.getswitchinterval() == pytest.approx(prev, rel=0.25)
+
+    def test_scopes_do_not_nest(self):
+        with chaos_scope(1):
+            with pytest.raises(RuntimeError, match="nest"):
+                with chaos_scope(2):
+                    pass
+
+    def test_fault_sites_perturb_inside_scope(self):
+        from tpuparquet.faults import fault_point
+
+        with chaos_scope(7) as sched:
+            for _ in range(64):
+                fault_point("io.remote.range", file="x")
+        assert sched.perturbations > 0
+        # and nothing fires outside the scope
+        before = sched.perturbations
+        fault_point("io.remote.range", file="x")
+        assert sched.perturbations == before
+
+
+class TestSuites:
+    def test_cross_seed_sweep_is_invariant(self, tmp_path):
+        # the full acceptance sweep: every suite, >= 3 seeds, each
+        # chaos leg byte-identical to its unperturbed baseline with
+        # exact counter conservation (run_chaos diffs the dicts
+        # exactly and fails on any drift or a vacuous zero-perturb
+        # leg)
+        from tools.chaos import DEFAULT_SEEDS, SUITES, run_chaos
+
+        assert len(DEFAULT_SEEDS) >= 3
+        res = run_chaos(str(tmp_path), list(SUITES),
+                        list(DEFAULT_SEEDS))
+        assert res["ok"], "\n".join(res["failures"])
+        assert sorted(res["suites"]) == sorted(SUITES)
+
+
+class TestDiskCacheConcurrentWriters:
+    def _cache(self, tmp_path, budget=1 << 20):
+        from tpuparquet.io.rangecache import DiskRangeCache
+
+        return DiskRangeCache(str(tmp_path / "dcache"), budget)
+
+    def test_same_key_two_writers_one_intact_frame(self, tmp_path):
+        from tpuparquet.stats import collect_stats
+
+        cache = self._cache(tmp_path)
+        key = ("file:///t.parquet", 4096, 512, "etag1")
+        payload = hashlib.sha256(b"range-bytes").digest() * 16
+        start = threading.Barrier(3)
+        errors = []
+
+        def writer():
+            try:
+                start.wait(timeout=10)
+                for _ in range(32):
+                    cache.put(key, payload)
+            except Exception as e:  # pragma: no cover - reported
+                errors.append(e)
+
+        with collect_stats() as st:
+            with chaos_scope(101):
+                ts = [threading.Thread(target=writer)
+                      for _ in range(2)]
+                for t in ts:
+                    t.start()
+                start.wait(timeout=10)
+                for t in ts:
+                    t.join(timeout=30)
+        assert errors == []
+        # exactly one live entry, its TPQC1 frame fully intact
+        assert cache.get(key) == payload
+        assert cache.stats()["entries"] == 1
+        # same-key overwrites are not evictions: the counter must not
+        # have been bumped by the race
+        assert st.cache_evictions_disk == 0
+        # no torn .tmp stragglers left behind
+        leftovers = [fn for fn in os.listdir(cache._dir)
+                     if fn.endswith(".tmp")]
+        assert leftovers == []
+        # index accounting survived the interleaving: byte total
+        # equals the one live entry's file size
+        fn, total = cache._index[key]
+        assert os.path.getsize(os.path.join(cache._dir, fn)) == total
+        assert cache._bytes == total
+
+    def test_distinct_keys_still_evict_exactly(self, tmp_path):
+        # sanity twin: real evictions still count when the budget is
+        # tight, chaos or not
+        from tpuparquet.stats import collect_stats
+
+        entry = 600
+        cache = self._cache(tmp_path, budget=2 * entry)
+        with collect_stats() as st:
+            with chaos_scope(202):
+                for i in range(4):
+                    cache.put(("f", i, 0, "e"), bytes(400))
+        assert st.cache_evictions_disk == 2
+        assert cache.stats()["entries"] == 2

@@ -1,0 +1,188 @@
+"""Runtime lock-order recorder (``TPQ_LOCKCHECK``) and its
+cross-validation against the static lock graph.
+
+The unit tests drive the wrapper/registry machinery in-process with
+``install()``/``uninstall()`` around hand-built lock choreography; the
+subprocess test runs a real multi-threaded scan workload under
+``TPQ_LOCKCHECK=1`` + ``TPQ_LOCKCHECK_OUT`` and requires the dump to
+be (a) cycle-free and (b) a subgraph of the static analysis — the
+tentpole acceptance criterion that each half validates the other.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tpuparquet import lockcheck  # noqa: E402
+
+
+@pytest.fixture
+def recorder():
+    """Install the wrappers for one test, restore + wipe after."""
+    lockcheck.reset()
+    lockcheck.install(strict=False)
+    try:
+        yield lockcheck
+    finally:
+        lockcheck.uninstall()
+        lockcheck.reset()
+
+
+class TestRecorder:
+    def test_nested_acquire_records_edge(self, recorder):
+        la = threading.Lock()
+        lb = threading.Lock()
+        with la:
+            with lb:
+                pass
+        e = recorder.edges()
+        assert len(e) == 1 and e[0][2] == 1
+        a, b, _ = e[0]
+        assert a != b
+        assert a.startswith("tests/test_lockcheck.py:")
+        assert b.startswith("tests/test_lockcheck.py:")
+        assert recorder.check_dag() == []
+
+    def test_cycle_detected(self, recorder):
+        la = threading.Lock()
+        lb = threading.Lock()
+        with la:
+            with lb:
+                pass
+        with lb:
+            with la:
+                pass
+        v = recorder.violations()
+        assert v and v[0]["kind"] == "lock-cycle"
+
+    def test_strict_raises_at_closing_acquisition(self, recorder):
+        recorder.install(strict=True)
+        la = threading.Lock()
+        lb = threading.Lock()
+        with la:
+            with lb:
+                pass
+        with pytest.raises(lockcheck.LockOrderError):
+            with lb:
+                with la:
+                    pass
+
+    def test_rlock_reentry_is_not_an_edge(self, recorder):
+        rl = threading.RLock()
+        with rl:
+            with rl:
+                pass
+        assert recorder.edges() == []
+        assert recorder.violations() == []
+
+    def test_condition_wait_releases_held_entry(self, recorder):
+        # Condition drives _release_save/_acquire_restore on the
+        # wrapped RLock; a wait must not leave the site marked held
+        cv = threading.Condition(threading.RLock())
+        other = threading.Lock()
+
+        def waker():
+            with cv:
+                cv.notify()
+
+        with cv:
+            t = threading.Thread(target=waker)
+            t.start()
+            cv.wait(timeout=5)
+        t.join()
+        with other:
+            pass
+        # no cv-site -> other-site edge: wait() dropped the hold
+        sites = [a for a, b, n in recorder.edges()]
+        assert all("test_lockcheck" not in a or "cv" not in a
+                   for a in sites)
+        assert recorder.check_dag() == []
+
+    def test_repo_site_predicate(self):
+        assert lockcheck.repo_site("tpuparquet/io/reader.py:66")
+        assert lockcheck.repo_site("tools/soak.py:10")
+        assert not lockcheck.repo_site(
+            "/usr/lib/python3.11/logging/__init__.py:226")
+        assert not lockcheck.repo_site("<unknown>:0")
+
+    def test_foreign_cycle_not_a_violation(self, recorder):
+        # a cycle whose edges touch a non-repo site must not trip the
+        # verdict — foreign lock ordering is not this repo's contract
+        lockcheck._record_acquire("/usr/lib/x.py:1", False)
+        lockcheck._record_acquire("tpuparquet/a.py:2", False)
+        lockcheck._record_release("tpuparquet/a.py:2")
+        lockcheck._record_release("/usr/lib/x.py:1")
+        lockcheck._record_acquire("tpuparquet/a.py:2", False)
+        lockcheck._record_acquire("/usr/lib/x.py:1", False)
+        lockcheck._record_release("/usr/lib/x.py:1")
+        lockcheck._record_release("tpuparquet/a.py:2")
+        assert recorder.violations() == []
+        assert recorder.check_dag() == []
+
+    def test_dump_roundtrip(self, recorder, tmp_path):
+        la = threading.Lock()
+        lb = threading.Lock()
+        with la:
+            with lb:
+                pass
+        out = tmp_path / "locks.json"
+        recorder.dump(str(out))
+        doc = json.loads(out.read_text())
+        assert doc["edges"] and doc["violations"] == []
+        assert set(doc) == {"locks", "edges", "violations"}
+
+
+_WORKLOAD = textwrap.dedent("""
+    import json, os, sys, tempfile
+    sys.path.insert(0, {repo!r})
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from tpuparquet import FileWriter
+    from tpuparquet.shard.scan import ShardedScan
+
+    root = tempfile.mkdtemp(prefix="tpq-lockcheck-")
+    path = os.path.join(root, "t.parquet")
+    with open(path, "wb") as f:
+        w = FileWriter(f, "message m {{ required int64 k; "
+                          "required double v; }}",
+                       max_row_group_size=600)
+        for j in range(160):
+            w.add_data({{"k": j, "v": j * 0.5}})
+        w.close()
+    # plan-parallel local scan + an emulated remote scan: exercises
+    # the _IoHandle serialization lock over a RangeSourceFile, the
+    # fault-injector lock, and the byte-source locks
+    os.environ["TPQ_PLAN_THREADS"] = "4"
+    ShardedScan([path]).run()
+    ShardedScan(["emu://" + path]).run()
+""")
+
+
+class TestSubprocessCrossValidation:
+    def test_workload_dump_is_subgraph_of_static(self, tmp_path):
+        out = tmp_path / "dump.json"
+        env = dict(os.environ)
+        env.update({"TPQ_LOCKCHECK": "1",
+                    "TPQ_LOCKCHECK_OUT": str(out),
+                    "JAX_PLATFORMS": "cpu"})
+        proc = subprocess.run(
+            [sys.executable, "-c", _WORKLOAD.format(repo=_REPO)],
+            env=env, capture_output=True, text=True, timeout=240)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        doc = json.loads(out.read_text())
+        assert doc["violations"] == []
+        assert any(s.startswith("tpuparquet/") for s in doc["locks"])
+
+        from tools.analyze import RepoTree, threads
+        problems = threads.verify_runtime_graph(
+            RepoTree.from_disk(_REPO), doc)
+        assert problems == [], "\n".join(problems)

@@ -1,11 +1,12 @@
 """tpq-analyze: the repo's conventions as machine-checked contracts.
 
-Six AST invariant passes over the library (plus the native sanitizer
-leg in ``tools/analyze/native.sh``) turn documented disciplines —
-exact counter merges, registered fault sites, the env-knob catalog,
-atomic durable writes, guarded flight-recorder hot sites, lock-guarded
-module state with an acyclic lock graph — into a zero-findings CI
-gate.  Run::
+Eight AST invariant passes over the library (plus the native
+sanitizer leg in ``tools/analyze/native.sh``) turn documented
+disciplines — exact counter merges, registered fault sites, the
+env-knob catalog, atomic durable writes, guarded flight-recorder hot
+sites, lock-guarded module state with an acyclic whole-program lock
+graph, released-on-all-paths resource lifecycles, and taxonomy-typed
+raises — into a zero-findings CI gate.  Run::
 
     python -m tools.analyze [--json] [--pass NAME]
 
@@ -14,16 +15,25 @@ exceptions live in ``tools/analyze/allowlist.json`` with a reason
 each, matched by ``(pass, file, key)`` where ``key`` is a stable
 symbol/site/knob name (never a line number).  A stale allowlist entry
 — one that matches nothing anymore — is itself a finding, so the
-exception list can only shrink truthfully.
+exception list can only shrink truthfully; ``--allowlist-audit``
+additionally lists every entry by age and fails on entries whose
+target file no longer exists.
+
+The static thread-safety pass has a runtime twin: with
+``TPQ_LOCKCHECK=1`` the library records its real lock-acquisition
+graph (``tpuparquet/lockcheck.py``), and ``--verify-lockcheck DUMP``
+checks that recording is cycle-free and a subgraph of the static
+graph — each side validating the other.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import time
 
 from . import (atomicwrite, counters, envknobs, faultsites,
-               recorderguard, threads)
+               lifecycle, raises, recorderguard, threads)
 from .astutil import Finding, RepoTree
 
 __all__ = ["PASSES", "RepoTree", "Finding", "Allowlist",
@@ -37,6 +47,8 @@ PASSES = {
     atomicwrite.PASS: atomicwrite.run,
     recorderguard.PASS: recorderguard.run,
     threads.PASS: threads.run,
+    lifecycle.PASS: lifecycle.run,
+    raises.PASS: raises.run,
 }
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
@@ -49,7 +61,9 @@ def repo_root() -> str:
 
 
 class Allowlist:
-    """Justified exceptions: entries ``{pass, file, key, reason}``.
+    """Justified exceptions: entries ``{pass, file, key, reason}``
+    plus an optional ``added`` date (YYYY-MM-DD) the hygiene audit
+    sorts by.
 
     Matching is exact on ``(pass, file, key)``; a ``reason`` is
     mandatory — an allowlist row without one is rejected at load so
@@ -88,6 +102,30 @@ class Allowlist:
         return [e for i, e in enumerate(self.entries)
                 if i not in self._used]
 
+    def audit(self, tree: "RepoTree") -> dict:
+        """Hygiene report: every entry by age/pass, plus the entries
+        whose target FILE no longer exists in the tree (a stronger
+        staleness than key-match — the justified code is gone
+        entirely, so the exception must go with it)."""
+        rows = []
+        missing = []
+        for e in self.entries:
+            row = {
+                "pass": e["pass"],
+                "file": e["file"],
+                "key": e["key"],
+                "added": e.get("added") or "(pre-audit)",
+                "reason": e["reason"],
+                "target_exists": e["file"] in tree.files,
+            }
+            rows.append(row)
+            if not row["target_exists"]:
+                missing.append(row)
+        rows.sort(key=lambda r: (r["added"], r["pass"], r["file"],
+                                 r["key"]))
+        return {"entries": rows, "missing_target": missing,
+                "ok": not missing}
+
 
 def run_analysis(root: str | None = None,
                  passes: list[str] | None = None,
@@ -96,8 +134,12 @@ def run_analysis(root: str | None = None,
     """Run the selected passes and fold in the allowlist.
 
     Returns ``{"findings": [...], "suppressed": [...], "stale":
-    [...], "counts": {...}, "ok": bool}`` — ``ok`` is the gate:
-    no live findings, no parse errors, no stale allowlist entries."""
+    [...], "counts": {...}, "timings_s": {...}, "ok": bool}`` —
+    ``ok`` is the gate: no live findings, no parse errors, no stale
+    allowlist entries.  ``timings_s`` carries per-pass wall time (the
+    parsed-AST cache in :class:`RepoTree` is shared across passes, so
+    the first pass pays the parse and the rest measure pure
+    analysis)."""
     if tree is None:
         tree = RepoTree.from_disk(root or repo_root())
     if isinstance(allowlist, str) or allowlist is None:
@@ -110,8 +152,11 @@ def run_analysis(root: str | None = None,
     live: list[Finding] = []
     suppressed: list[Finding] = []
     counts: dict[str, int] = {}
+    timings: dict[str, float] = {}
     for name in selected:
+        t0 = time.monotonic()
         found = PASSES[name](tree)
+        timings[name] = round(time.monotonic() - t0, 4)
         counts[name] = len(found)
         for f in found:
             (suppressed if allowlist.suppresses(f) else live).append(f)
@@ -127,5 +172,6 @@ def run_analysis(root: str | None = None,
         "suppressed": [f.as_dict() for f in suppressed],
         "stale_allowlist": stale,
         "counts": counts,
+        "timings_s": timings,
         "ok": not live and not stale,
     }

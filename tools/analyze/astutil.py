@@ -72,6 +72,11 @@ class RepoTree:
         self.readme = readme
         self._asts: dict[str, ast.AST | None] = {}
         self.parse_errors: list[tuple[str, str]] = []
+        #: cross-pass scratch cache keyed by pass-chosen names (the
+        #: thread-safety pass parks its whole-program lock graph here
+        #: so ``static_graph``/``--lock-graph`` don't recompute it);
+        #: scoped to THIS tree, so fixtures never see stale facts
+        self.memo: dict = {}
 
     @classmethod
     def from_disk(cls, root: str) -> "RepoTree":

@@ -30,6 +30,22 @@ SRC_DIR=tpuparquet/native
 SRCS=("$SRC_DIR"/delta.c "$SRC_DIR"/hybrid.c "$SRC_DIR"/intern.c \
       "$SRC_DIR"/pack.c "$SRC_DIR"/page.c "$SRC_DIR"/plane.c \
       "$SRC_DIR"/snappy.c)
+
+# coverage check: the pinned SRCS list must name every native/*.c on
+# disk — a codec added without updating this script would otherwise
+# ship with zero sanitizer/static-analysis coverage, silently
+for src in "$SRC_DIR"/*.c; do
+  covered=0
+  for s in "${SRCS[@]}"; do
+    [ "$s" = "$src" ] && { covered=1; break; }
+  done
+  if [ "$covered" = 0 ]; then
+    echo "native.sh: FAILED — $src exists on disk but is missing" >&2
+    echo "native.sh: from SRCS; add it so the sanitizer + analyzer" >&2
+    echo "native.sh: legs cover it" >&2
+    exit 1
+  fi
+done
 BUILD_DIR=${TMPDIR:-/tmp}/tpq-native-san.$$
 SAN_SO="$BUILD_DIR/_tpq_native_san.so"
 trap 'rm -rf "$BUILD_DIR"' EXIT

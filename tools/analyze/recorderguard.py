@@ -57,6 +57,15 @@ EXCLUDE = ("tpuparquet/obs/recorder.py", "tpuparquet/obs/trace.py",
 HOT_NAMES = ("flight", "emit_span", "open_span", "observe",
              "emit_alert")
 
+#: event KINDS (the first positional arg) that ride per-request /
+#: per-range hot paths no matter where the call sits — the round-18
+#: remote-store emulation fires on a modulo of EVERY request, the
+#: disk-cache poison check runs per cache hit, and prefetch spans are
+#: emitted once per prefetched range.  These must be guarded even
+#: outside loops and even on exceptional paths (the kwargs build
+#: happens before the raise).
+HOT_KINDS = ("emu_fault", "cache_poison", "prefetch_span")
+
 
 def _is_guard_test(test: ast.AST) -> bool:
     """Does this if-test (or any part of it) check the recorder gate?"""
@@ -123,6 +132,16 @@ def run(tree: RepoTree) -> list[Finding]:
             if node.args and isinstance(node.args[0], ast.Constant):
                 kind = str(node.args[0].value)
             key = f"{fname}:{kind}" if kind else fname
+            if kind in HOT_KINDS:
+                findings.append(Finding(
+                    PASS, path, node.lineno, "unguarded-hot-kind",
+                    key,
+                    f"{called}({kind!r}, ...) in {fname}() without "
+                    f"the `_active is not None` guard — {kind} events "
+                    f"fire on per-request/per-range paths, so the "
+                    f"kwargs build must be skipped when the recorder "
+                    f"is off, wherever the call sits"))
+                continue
             if qualified:
                 findings.append(Finding(
                     PASS, path, node.lineno, "unguarded-hot-flight",

@@ -1,0 +1,283 @@
+#!/usr/bin/env python
+"""Schedule-chaos validator: seeded interleaving, invariant output.
+
+The concurrency suites assert determinism under ONE interleaving per
+run — whichever the OS happens to produce.  This harness re-runs them
+under :func:`tpuparquet.faults.chaos_scope`: a seed-derived aggressive
+``sys.setswitchinterval`` plus deterministic perturbations (GIL
+yields, microsecond sleeps) at every registered fault site, which
+double as named yield points on the hot paths.  Each suite runs once
+WITHOUT chaos (the baseline) and once per ``--seeds`` entry, and every
+chaos leg must reproduce the baseline exactly:
+
+* **plan-parallel** — multi-threaded row-group planning
+  (``TPQ_PLAN_THREADS``): byte-identical decoded output, exact
+  ``row_groups``/``pages``/``values`` counters;
+* **encode-ahead** — the writer's pipelined encode/compress pool
+  (``TPQ_WRITE_THREADS``, multi-page columns): byte-identical FILE
+  bytes — page order and framing must not depend on encode timing;
+* **prefetch** — the remote fetch planner (coalesced parallel spans
+  through ``emu://`` into a fresh disk cache): byte-identical decoded
+  output, exact fetch/coalesce accounting;
+* **soak-parity** — the multi-tenant soak leg (corrupt + deadline +
+  remote + clean tenants under deterministic fault rules): per-tenant
+  byte-identical output and exact quarantine counts.
+
+A chaos leg that records zero perturbations is itself a failure — the
+seed must actually have exercised the schedule, or the invariance it
+"proves" is vacuous.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python -m tools.chaos \
+        [--seeds 101,202,303] [--suite NAME ...] [--json] [--keep DIR]
+
+Exit 0 = every chaos leg reproduced its baseline; nonzero prints what
+drifted.  ci.sh stage 15 runs the plan-parallel and soak-parity
+suites at one seed; the full cross-seed sweep is
+``tests/test_chaos.py``'s job.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import hashlib
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+DEFAULT_SEEDS = (101, 202, 303)
+ROWS = 240
+UNITS = 4
+
+
+@contextlib.contextmanager
+def _env(**overrides):
+    """Set env knobs for one leg, restoring the previous values."""
+    prev = {k: os.environ.get(k) for k in overrides}
+    for k, v in overrides.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = str(v)
+    try:
+        yield
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _output_digest(results) -> str:
+    import numpy as np
+
+    h = hashlib.sha256()
+    for out in results:
+        for name in sorted(out):
+            for arr in out[name].to_numpy():
+                if arr is not None:
+                    h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
+
+
+def _write_corpus_file(path: str, rows: int = ROWS,
+                       units: int = UNITS) -> str:
+    from tpuparquet import FileWriter
+
+    rg_rows = max(rows // units, 1)
+    with open(path, "wb") as f:
+        w = FileWriter(f, "message chaos { required int64 k; "
+                          "required double b; }",
+                       max_row_group_size=rg_rows * 20)
+        for j in range(rows):
+            w.add_data({"k": j * 3 + 1, "b": j * 0.25})
+        w.close()
+    return path
+
+
+# ----------------------------------------------------------------------
+# Suites: each returns a dict that must be EXACTLY equal across legs
+# ----------------------------------------------------------------------
+
+def suite_plan_parallel(corpus: str, work: str) -> dict:
+    from tpuparquet.shard.scan import ShardedScan
+    from tpuparquet.stats import collect_stats
+
+    with _env(TPQ_PLAN_THREADS="4"):
+        with collect_stats() as st:
+            out = ShardedScan([corpus]).run()
+    return {
+        "digest": _output_digest(out),
+        "counters": {k: getattr(st, k)
+                     for k in ("row_groups", "pages", "values")},
+    }
+
+
+def suite_encode_ahead(corpus: str, work: str) -> dict:
+    from tpuparquet import FileWriter
+
+    path = os.path.join(work, "encoded.parquet")
+    with _env(TPQ_WRITE_THREADS="4", TPQ_PAGE_ROWS="16"):
+        with open(path, "wb") as f:
+            w = FileWriter(f, "message chaos { required int64 k; "
+                              "required double b; }",
+                           max_row_group_size=1200)
+            for j in range(ROWS):
+                w.add_data({"k": j * 3 + 1, "b": j * 0.25})
+            w.close()
+    with open(path, "rb") as f:
+        return {"digest": hashlib.sha256(f.read()).hexdigest()}
+
+
+def suite_prefetch(corpus: str, work: str) -> dict:
+    from tpuparquet.shard.scan import ShardedScan
+    from tpuparquet.stats import collect_stats
+
+    dcache = os.path.join(work, "dcache")
+    os.makedirs(dcache, exist_ok=True)
+    # mem tier off: it is a process-global singleton that would carry
+    # baseline-leg hits into the chaos legs (fewer remote fetches in
+    # later legs — state drift, not schedule drift); the per-leg disk
+    # dir keeps the disk tier cold each time
+    with _env(TPQ_PLAN_THREADS="4", TPQ_CACHE_DISK_DIR=dcache,
+              TPQ_CACHE_DISK_MB="64", TPQ_CACHE_MEM_MB="0",
+              TPQ_RANGE_COALESCE_GAP="4096"):
+        with collect_stats() as st:
+            out = ShardedScan(["emu://" + corpus]).run()
+    return {
+        "digest": _output_digest(out),
+        "counters": {k: getattr(st, k)
+                     for k in ("row_groups", "pages", "values",
+                               "remote_ranges_fetched",
+                               "ranges_coalesced", "remote_bytes")},
+    }
+
+
+def suite_soak_parity(corpus: str, work: str) -> dict:
+    from tools import soak
+
+    soak_corpus = json.loads(corpus)  # {label: [paths]} built once
+    with _env(TPQ_EMU_THROTTLE_EVERY=soak.REMOTE_THROTTLE_EVERY):
+        legs = soak.run_leg(soak_corpus, telemetry=False,
+                            ring_dir=None)
+    return {lb: {"digest": r["digest"],
+                 "units_done": r["units_done"],
+                 "units_quarantined": r["units_quarantined"],
+                 "quarantine": r["quarantine"]}
+            for lb, r in sorted(legs.items())}
+
+
+SUITES = {
+    "plan-parallel": suite_plan_parallel,
+    "encode-ahead": suite_encode_ahead,
+    "prefetch": suite_prefetch,
+    "soak-parity": suite_soak_parity,
+}
+
+
+def run_chaos(root: str, suites: list[str],
+              seeds: list[int]) -> dict:
+    """Run each suite at baseline + every seed; compare exactly."""
+    from tools import soak as _soak
+    from tpuparquet.faults import chaos_scope
+
+    corpus = _write_corpus_file(os.path.join(root, "chaos.parquet"))
+    suite_input = {name: corpus for name in SUITES}
+    if "soak-parity" in suites:
+        sroot = os.path.join(root, "soak")
+        os.makedirs(sroot, exist_ok=True)
+        suite_input["soak-parity"] = json.dumps(
+            _soak.build_corpus(sroot, 4, 120, UNITS))
+
+    failures: list[str] = []
+    report: dict = {}
+    for name in suites:
+        fn = SUITES[name]
+        legs: dict = {}
+        base_dir = os.path.join(root, f"{name}-baseline")
+        os.makedirs(base_dir, exist_ok=True)
+        baseline = fn(suite_input[name], base_dir)
+        legs["baseline"] = baseline
+        for seed in seeds:
+            work = os.path.join(root, f"{name}-seed{seed}")
+            os.makedirs(work, exist_ok=True)
+            with chaos_scope(seed) as sched:
+                got = fn(suite_input[name], work)
+            legs[f"seed{seed}"] = got
+            if sched.perturbations == 0:
+                failures.append(
+                    f"{name} seed {seed}: zero perturbations — the "
+                    f"chaos schedule never fired, invariance is "
+                    f"vacuous")
+            if got != baseline:
+                diffs = _diff(baseline, got)
+                failures.append(
+                    f"{name} seed {seed} drifted from baseline: "
+                    f"{'; '.join(diffs) or 'structural difference'}")
+        report[name] = {
+            "seeds": seeds,
+            "perturbed": True,
+            "digest": str(baseline)[:120],
+        }
+    return {"failures": failures, "suites": report,
+            "ok": not failures}
+
+
+def _diff(a, b, prefix="") -> list[str]:
+    out: list[str] = []
+    if isinstance(a, dict) and isinstance(b, dict):
+        for k in sorted(set(a) | set(b)):
+            out.extend(_diff(a.get(k), b.get(k), f"{prefix}{k}."))
+    elif a != b:
+        out.append(f"{prefix.rstrip('.')}: {a!r} != {b!r}")
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seeds", default=",".join(
+        str(s) for s in DEFAULT_SEEDS),
+        help="comma-separated chaos seeds (default: "
+             f"{','.join(str(s) for s in DEFAULT_SEEDS)})")
+    ap.add_argument("--suite", dest="suites", action="append",
+                    choices=sorted(SUITES), metavar="NAME",
+                    help="run only this suite (repeatable; "
+                         "default all)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable result")
+    ap.add_argument("--keep", metavar="DIR", default="",
+                    help="run inside DIR and leave artifacts behind")
+    args = ap.parse_args(argv)
+    seeds = [int(s) for s in args.seeds.split(",") if s.strip()]
+    suites = args.suites or list(SUITES)
+
+    root = args.keep or tempfile.mkdtemp(prefix="tpq-chaos-")
+    os.makedirs(root, exist_ok=True)
+    t0 = time.time()
+    try:
+        res = run_chaos(root, suites, seeds)
+        res["wall_s"] = round(time.time() - t0, 3)
+        if args.json:
+            print(json.dumps(res, sort_keys=True))
+        else:
+            for f in res["failures"]:
+                print(f"FAIL: {f}", file=sys.stderr)
+            print(f"chaos {'PASS' if res['ok'] else 'FAIL'} "
+                  f"({len(suites)} suite(s) x {len(seeds)} seed(s) + "
+                  f"baseline, {res['wall_s']}s)")
+        return 0 if res["ok"] else 1
+    finally:
+        if not args.keep:
+            shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

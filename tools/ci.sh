@@ -1,7 +1,7 @@
 #!/bin/bash
 # In-repo CI gate (counterpart of the reference's .circleci/config.yml,
 # which pins go versions and runs `go test ./...` + the compatibility
-# corpus per commit).  Fourteen stages, pinned env:
+# corpus per commit).  Fifteen stages, pinned env:
 #
 #   1. tier-1 suite   — the ROADMAP.md verify command, gated on a PASS
 #                       FLOOR rather than rc: optional deps (zstandard,
@@ -43,15 +43,16 @@
 #                       corpora, serial AND parallel plans, with fault
 #                       injection and salvage=True, plus the
 #                       corrupt-index degrade-to-no-pruning pin
-#   9. static analysis — strict (rc=0): the tpq-analyze invariant
+#   9. static analysis — strict (rc=0): the tpq-analyze v2 invariant
 #                       passes (counters / fault sites / env knobs /
-#                       atomic writes / recorder guards / thread
-#                       safety + lock graph) must report ZERO
-#                       unsuppressed findings, the analyzer's own
-#                       seeded-bug suite must pass, and the native
-#                       ASan+UBSan + C-static-analysis leg runs
-#                       (skipping loudly when no sanitizer-capable
-#                       compiler is on the box)
+#                       atomic writes / recorder guards / whole-
+#                       program thread-safety + lock graph / resource
+#                       lifecycle / exception taxonomy) must report
+#                       ZERO unsuppressed findings, the analyzer's
+#                       own seeded-bug suite must pass, and the
+#                       native ASan+UBSan + C-static-analysis leg
+#                       runs (skipping loudly when no sanitizer-
+#                       capable compiler is on the box)
 #  10. gather parity    — strict (rc=0): consumer-aligned output
 #                       placement must stay byte-identical to the
 #                       replicated gather across the hard scan paths
@@ -102,6 +103,19 @@
 #                       scan stack (filter pushdown, cursor resume,
 #                       quarantine, gather) proves byte-identical over
 #                       an unreliable remote store
+#  15. concurrency validator — strict (rc=0): the runtime half of the
+#                       tpq-analyze v2 concurrency contract.  One
+#                       chaos-seed leg of the plan-parallel and
+#                       soak-parity suites (tools/chaos.py: seeded
+#                       schedule perturbation must reproduce the
+#                       unperturbed baseline byte-for-byte with exact
+#                       counter conservation), then a soak leg under
+#                       TPQ_LOCKCHECK=1 — the recorded lock-order
+#                       graph must be cycle-free and a subgraph of
+#                       the static lock graph (the full cross-seed
+#                       sweep and the recorder unit suite run in
+#                       tier-1 via tests/test_chaos.py and
+#                       tests/test_lockcheck.py)
 #
 # Usage: bash tools/ci.sh            (exit 0 = gate passed)
 # The tier-1 stage mirrors ROADMAP.md exactly — if you change one,
@@ -124,7 +138,7 @@ CI_PASS_FLOOR=${CI_PASS_FLOOR:-1000}
 
 fail() { echo "ci.sh: FAILED at stage $1" >&2; exit 1; }
 
-echo "=== stage 1/14: tier-1 suite (pass floor $CI_PASS_FLOOR) ==="
+echo "=== stage 1/15: tier-1 suite (pass floor $CI_PASS_FLOOR) ==="
 rm -f /tmp/_t1.log
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
@@ -138,25 +152,25 @@ echo "DOTS_PASSED=$passed"
 [ "$passed" -ge "$CI_PASS_FLOOR" ] \
   || fail "tier-1 ($passed passed < floor $CI_PASS_FLOOR)"
 
-echo "=== stage 2/14: smoke bench (CPU backend, tiny target) ==="
+echo "=== stage 2/15: smoke bench (CPU backend, tiny target) ==="
 TPQ_BENCH_TARGET=60000 TPQ_BENCH_CPU=1 timeout -k 10 600 \
   python bench.py > /tmp/_ci_bench.json || fail "smoke bench"
 tail -1 /tmp/_ci_bench.json
 
-echo "=== stage 3/14: crash corpus + fault-injection matrix (strict) ==="
+echo "=== stage 3/15: crash corpus + fault-injection matrix (strict) ==="
 timeout -k 10 600 python -m pytest \
   "tests/test_corpus.py::TestCrashRegressions" tests/test_faults.py \
   -q -p no:cacheprovider || fail "corpus/faults"
 
-echo "=== stage 4/14: salvage + strict metadata (strict) ==="
+echo "=== stage 4/15: salvage + strict metadata (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_salvage.py \
   -q -p no:cacheprovider || fail "salvage"
 
-echo "=== stage 5/14: deadlines/hedging + kill-resume checkpoints (strict) ==="
+echo "=== stage 5/15: deadlines/hedging + kill-resume checkpoints (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_deadline.py \
   tests/test_checkpoint.py -q -p no:cacheprovider || fail "time/crash"
 
-echo "=== stage 6/14: plan matrix: serial vs parallel, cache on (strict) ==="
+echo "=== stage 6/15: plan matrix: serial vs parallel, cache on (strict) ==="
 # leg A: pinned-serial planning (the TPQ_PLAN_THREADS=1 reference path)
 TPQ_PLAN_THREADS=1 timeout -k 10 600 python -m pytest \
   tests/test_plan_parallel.py tests/test_plan_cache.py \
@@ -167,7 +181,7 @@ TPQ_PLAN_CACHE_MB=64 timeout -k 10 600 python -m pytest \
   tests/test_plan_parallel.py tests/test_fallback_matrix.py \
   -q -p no:cacheprovider || fail "plan matrix (cache-on leg)"
 
-echo "=== stage 7/14: live obs gate + overhead guard (strict) ==="
+echo "=== stage 7/15: live obs gate + overhead guard (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_live_obs.py \
   tests/test_env_docs.py -q -p no:cacheprovider || fail "live obs"
 # overhead guard: the always-on default must stay within a generous
@@ -178,7 +192,7 @@ timeout -k 10 600 python tools/bench_obs.py --values 2000000 \
   || fail "obs overhead guard"
 tail -5 /tmp/_ci_obs.json
 
-echo "=== stage 8/14: pruning parity gate (strict) ==="
+echo "=== stage 8/15: pruning parity gate (strict) ==="
 # leg A: the whole pushdown suite (write/read page index + bloom,
 # verdicts, late materialization, counter exactness, corrupt-index
 # degrade, pyarrow interop) on the default pool width
@@ -191,13 +205,13 @@ TPQ_PLAN_THREADS=1 TPQ_PRUNE=0 timeout -k 10 600 python -m pytest \
   "tests/test_prune.py::TestParity" \
   -q -p no:cacheprovider || fail "pruning parity (prune-off leg)"
 
-echo "=== stage 9/14: tpq-analyze invariant passes + sanitizer leg (strict) ==="
+echo "=== stage 9/15: tpq-analyze invariant passes + sanitizer leg (strict) ==="
 timeout -k 10 300 python -m tools.analyze || fail "tpq-analyze"
 timeout -k 10 600 python -m pytest tests/test_analyze.py \
   -q -p no:cacheprovider || fail "analyzer self-test"
 timeout -k 10 900 bash tools/analyze/native.sh || fail "native sanitizers"
 
-echo "=== stage 10/14: gather placement parity gate (strict) ==="
+echo "=== stage 10/15: gather placement parity gate (strict) ==="
 # leg A: the placement suite — byte parity placed vs replicated across
 # filter/quarantine/salvage/resume/multi-host, placement + counter pins,
 # mesh-mismatch errors
@@ -210,7 +224,7 @@ TPQ_GATHER_TO=0 timeout -k 10 600 python -m pytest \
   tests/test_gather_placement.py \
   -q -p no:cacheprovider || fail "gather placement (env leg)"
 
-echo "=== stage 11/14: write-pipeline parity gate (strict) ==="
+echo "=== stage 11/15: write-pipeline parity gate (strict) ==="
 # leg A: the whole native-write suite on the default knobs
 timeout -k 10 600 python -m pytest tests/test_write_native.py \
   -q -p no:cacheprovider || fail "write parity"
@@ -221,7 +235,7 @@ TPQ_WRITE_NATIVE=0 timeout -k 10 600 python -m pytest \
   tests/test_write_native.py -q -p no:cacheprovider \
   || fail "write parity (native-off leg)"
 
-echo "=== stage 12/14: causal tracing + attribution + bench sentinel (strict) ==="
+echo "=== stage 12/15: causal tracing + attribution + bench sentinel (strict) ==="
 # leg A: the trace/attribution suite on the default (trace-off) env —
 # span-tree connectivity, adversity-matrix propagation, ledger
 # conservation, doctor goldens
@@ -241,7 +255,7 @@ TPQ_TRACE=1 timeout -k 10 900 python -m pytest \
 timeout -k 10 600 python tools/bench_sentinel.py --check \
   || fail "bench sentinel"
 
-echo "=== stage 13/14: soak smoke: faults -> alerts, exact sums, byte identity (strict) ==="
+echo "=== stage 13/15: soak smoke: faults -> alerts, exact sums, byte identity (strict) ==="
 # N=4 concurrent labeled scans with the deterministic fault plan
 # (CorruptPage on one tenant's unique column, hang + unit deadline on
 # another tenant's file).  Asserts the whole longitudinal contract:
@@ -250,7 +264,7 @@ echo "=== stage 13/14: soak smoke: faults -> alerts, exact sums, byte identity (
 timeout -k 10 600 python -m tools.soak --scans 4 \
   || fail "soak smoke"
 
-echo "=== stage 14/14: remote emulator: parity over an unreliable store (strict) ==="
+echo "=== stage 14/15: remote emulator: parity over an unreliable store (strict) ==="
 # leg A: the dedicated remote suite — URI routing, coalescer property
 # sweep, tiered-cache conservation + poisoning + torn-file restart,
 # emu parity with the cache on AND off, hedged slow replicas
@@ -274,5 +288,18 @@ TPQ_SOURCE=emu TPQ_CACHE_DISK_MB=0 TPQ_CACHE_MEM_MB=0 \
   timeout -k 10 900 python -m pytest tests/test_shard.py \
   tests/test_checkpoint.py -q -p no:cacheprovider \
   || fail "remote emulator (cache-off leg)"
+
+echo "=== stage 15/15: schedule chaos + runtime lock-order validation (strict) ==="
+# leg A: one chaos seed over the plan-parallel and soak-parity suites
+# — the seeded schedule perturbation must reproduce the unperturbed
+# baseline exactly (tests/test_chaos.py runs the full 3-seed sweep in
+# tier-1; this leg keeps the harness itself on the strict path)
+timeout -k 10 600 python -m tools.chaos --seeds 101 \
+  --suite plan-parallel --suite soak-parity || fail "chaos leg"
+# leg B: the soak workload under the runtime lock-order recorder with
+# a chaos seed — any lock-cycle, or any recorded edge the static
+# analysis failed to model, fails the soak's own gate
+TPQ_LOCKCHECK=1 timeout -k 10 600 python -m tools.soak --scans 4 \
+  --chaos-seed 101 || fail "lockcheck soak leg"
 
 echo "ci.sh: gate PASSED"

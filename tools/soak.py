@@ -340,6 +340,24 @@ def check_soak(corpus: dict[str, list[str]], on: dict, off: dict,
     return bad
 
 
+def _lockcheck_failures() -> list[str]:
+    """When the runtime lock-order recorder is armed (TPQ_LOCKCHECK),
+    the soak's concurrent legs are exactly the load it exists for:
+    assert the recorded acquisition DAG is cycle-free and a subgraph
+    of the static lock graph before declaring the soak green."""
+    from tpuparquet import lockcheck
+
+    if not lockcheck.installed():
+        return []
+    from tools.analyze import RepoTree, repo_root
+    from tools.analyze import threads as _threads
+
+    snap = lockcheck.snapshot()
+    tree = RepoTree.from_disk(repo_root())
+    return [f"lockcheck: {p}"
+            for p in _threads.verify_runtime_graph(tree, snap)]
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--scans", type=int, default=4,
@@ -355,6 +373,12 @@ def main(argv=None) -> int:
     ap.add_argument("--keep", metavar="DIR", default="",
                     help="run inside DIR and leave the corpus, ring "
                          "and alert records behind for inspection")
+    ap.add_argument("--chaos-seed", type=int, default=None,
+                    metavar="N",
+                    help="run every leg under faults.chaos_scope(N): "
+                         "seeded interleaving perturbation at each "
+                         "fault site + an aggressive switch interval "
+                         "(the assertions must hold unchanged)")
     args = ap.parse_args(argv)
     if args.scans < 4:
         print("soak: --scans must be >= 4 (corrupt + deadline + "
@@ -369,15 +393,24 @@ def main(argv=None) -> int:
     prev_throttle = os.environ.get("TPQ_EMU_THROTTLE_EVERY")
     os.environ["TPQ_EMU_THROTTLE_EVERY"] = REMOTE_THROTTLE_EVERY
     try:
+        import contextlib
+
+        from tpuparquet.faults import chaos_scope
+
+        scope = (chaos_scope(args.chaos_seed)
+                 if args.chaos_seed is not None
+                 else contextlib.nullcontext())
         corpus = build_corpus(root, args.scans, args.rows, args.units)
-        remote_control = _control_digest(
-            corpus[tenant_label(REMOTE_TENANT)])
-        # telemetry-off leg FIRST: it must not see the ring/digest
-        # state the on leg arms
-        off = run_leg(corpus, telemetry=False, ring_dir=None)
-        on = run_leg(corpus, telemetry=True, ring_dir=ring_dir)
+        with scope:
+            remote_control = _control_digest(
+                corpus[tenant_label(REMOTE_TENANT)])
+            # telemetry-off leg FIRST: it must not see the ring/digest
+            # state the on leg arms
+            off = run_leg(corpus, telemetry=False, ring_dir=None)
+            on = run_leg(corpus, telemetry=True, ring_dir=ring_dir)
         failures = check_soak(corpus, on, off, ring_dir, alerts_path,
                               remote_control)
+        failures += _lockcheck_failures()
         result = {
             "scans": args.scans,
             "units_per_scan": args.units,

@@ -9,6 +9,15 @@ HBM and decodes them in parallel, sharding row groups across a device mesh.
 
 __version__ = "0.1.0"
 
+import os as _os
+
+# Lock-order recorder: must patch threading.Lock/RLock BEFORE any
+# submodule import so module-level locks are created wrapped.
+if _os.environ.get("TPQ_LOCKCHECK", "") not in ("", "0"):
+    from . import lockcheck as _lockcheck
+
+    _lockcheck.install()
+
 from .compress import (  # noqa: F401
     BlockCompressor,
     register_block_compressor,

@@ -982,7 +982,28 @@ def cmd_analyze(args, out=None) -> int:
             f"runs from a source checkout (pass --root)")
     if root not in sys.path:
         sys.path.insert(0, root)
-    from tools.analyze import run_analysis
+    from tools.analyze import (Allowlist, DEFAULT_ALLOWLIST, RepoTree,
+                               run_analysis)
+
+    if getattr(args, "allowlist_audit", False):
+        tree = RepoTree.from_disk(root)
+        report = Allowlist.load(DEFAULT_ALLOWLIST).audit(tree)
+        if getattr(args, "json", False):
+            report["root"] = root
+            _json.dump(report, out, sort_keys=True)
+            print(file=out)
+        else:
+            for e in report["entries"]:
+                mark = (" MISSING-TARGET"
+                        if not e["target_exists"] else "")
+                print(f"{e['added']}  {e['pass']:20s} {e['file']}::"
+                      f"{e['key']}{mark}", file=out)
+            print(f"allowlist-audit: {len(report['entries'])} "
+                  f"entr(y/ies), {len(report['missing_target'])} "
+                  f"with missing target file — "
+                  + ("PASSED" if report["ok"] else "FAILED"),
+                  file=out)
+        return 0 if report["ok"] else 1
 
     res = run_analysis(root=root, passes=args.passes or None)
     if getattr(args, "json", False):
@@ -1024,9 +1045,14 @@ def cmd_split(args, out=None) -> int:
             nonlocal part, w, f, current
             current = os.path.join(folder, f"{base}_{part:03d}.parquet")
             f = open(current, "wb")
-            w = FileWriter(f, schema_def, codec=codec,
-                           max_row_group_size=rg_size or None,
-                           created_by="parquet-tool split")
+            try:
+                w = FileWriter(f, schema_def, codec=codec,
+                               max_row_group_size=rg_size or None,
+                               created_by="parquet-tool split")
+            except BaseException:
+                f.close()
+                f = None
+                raise
             print(f"writing {current}", file=out)
             part += 1
 
@@ -1215,6 +1241,11 @@ def build_parser() -> argparse.ArgumentParser:
     an.add_argument("--root", default="",
                     help="repo root (default: the checkout this "
                          "module ships in)")
+    an.add_argument("--allowlist-audit", action="store_true",
+                    dest="allowlist_audit",
+                    help="audit the allowlist instead of running the "
+                         "passes: list entries by age/pass, fail on "
+                         "entries whose target file no longer exists")
     an.set_defaults(fn=cmd_analyze)
 
     sp = sub.add_parser("split", help="split into multiple parquet files")

@@ -93,8 +93,10 @@ from __future__ import annotations
 import contextlib
 import errno as _errno
 import os
+import sys
 import threading
 import time
+import zlib
 
 from .errors import DeviceDispatchError, TransientIOError
 
@@ -108,6 +110,8 @@ __all__ = [
     "is_transient",
     "QuarantineReport",
     "SITES",
+    "ChaosSchedule",
+    "chaos_scope",
 ]
 
 #: The fault-site registry: every instrumented site name and the
@@ -279,6 +283,9 @@ def inject_faults():
 def fault_point(site: str, **ctx) -> None:
     """Instrumentation hook: may raise an injected fault.  No-op (one
     global ``is None`` check) when no injector is active."""
+    ch = _chaos
+    if ch is not None:
+        ch.perturb(site)
     inj = _active
     if inj is not None:
         inj.fire_raise(site, ctx)
@@ -288,10 +295,95 @@ def filter_bytes(site: str, data, **ctx):
     """Instrumentation hook for byte streams: returns ``data`` (the
     common case, zero-copy) or an injected corruption/truncation of
     it; may also raise for read-failure kinds."""
+    ch = _chaos
+    if ch is not None:
+        ch.perturb(site)
     inj = _active
     if inj is not None:
         return inj.fire_bytes(site, data, ctx)
     return data
+
+
+# ----------------------------------------------------------------------
+# Schedule chaos: deterministic interleaving perturbation
+# ----------------------------------------------------------------------
+#
+# The fault sites double as NAMED YIELD POINTS: under a
+# :func:`chaos_scope`, every ``fault_point``/``filter_bytes`` call may
+# sleep a few microseconds or force a GIL release, and the interpreter
+# switch interval is pinned to a seed-derived aggressive value.  The
+# perturbation PLAN is a pure function of (seed, site, occurrence
+# ordinal) — no global ``random`` state, no wall-clock input — so a
+# seed names one chaos schedule.  What chaos runs assert is OUTPUT
+# invariance (byte-identical scan/write results, exact counter
+# conservation) across seeds, not schedule identity: the OS may still
+# interleave threads differently, and that is the point.
+
+_chaos: "ChaosSchedule | None" = None
+
+
+class ChaosSchedule:
+    """A seeded interleaving-perturbation plan over the fault-site
+    registry (zero-cost when inactive: one module-global ``is None``
+    check per site, same discipline as the injector)."""
+
+    #: per-site occurrence draw: (do nothing, yield GIL, short sleep)
+    _SLEEP_MAX_S = 200e-6
+
+    def __init__(self, seed: int):
+        self.seed = int(seed)
+        # benign-race counters: a lost increment only shifts which
+        # perturbation a thread draws, never the data path — keeping
+        # this lock-free means chaos adds no lock-order edges
+        self._counts: dict[str, int] = {}
+        self.perturbations = 0
+        import random
+
+        rng = random.Random(self.seed)
+        #: seed-derived interpreter switch interval, aggressive enough
+        #: to force switches inside critical regions (default is 5ms)
+        self.switch_interval = 10 ** rng.uniform(-6.0, -4.0)
+
+    def _draw(self, site: str, n: int) -> float:
+        key = f"{self.seed}:{site}:{n}".encode()
+        return (zlib.crc32(key) & 0xFFFFFFFF) / 0x100000000
+
+    def perturb(self, site: str) -> None:
+        n = self._counts.get(site, 0)
+        self._counts[site] = n + 1
+        u = self._draw(site, n)
+        if u < 0.4:
+            return
+        self.perturbations += 1
+        if u < 0.7:
+            time.sleep(0)          # force a GIL release / reschedule
+        else:
+            # a short sleep moves this thread to the back of the line
+            time.sleep((u - 0.7) / 0.3 * self._SLEEP_MAX_S)
+
+
+@contextlib.contextmanager
+def chaos_scope(seed: int | None = None):
+    """Scope with an active :class:`ChaosSchedule` (yields it):
+    perturbs thread interleavings at every registered fault site and
+    pins a seed-derived ``sys.setswitchinterval``.  ``seed`` falls
+    back to ``TPQ_CHAOS_SEED`` (default 0).  Process-global and not
+    reentrant, like :func:`inject_faults` (the two compose: chaos
+    perturbs first, then the injector fires)."""
+    global _chaos
+    if _chaos is not None:
+        raise RuntimeError("chaos_scope scopes do not nest")
+    if seed is None:
+        seed = _env_int("TPQ_CHAOS_SEED", 0)
+    sched = ChaosSchedule(seed)
+    prev = sys.getswitchinterval()
+    sys.setswitchinterval(sched.switch_interval)
+    _chaos = sched
+    try:
+        yield sched
+    finally:
+        _chaos = None
+        sys.setswitchinterval(prev)
 
 
 # ----------------------------------------------------------------------

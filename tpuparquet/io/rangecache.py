@@ -38,7 +38,7 @@ import threading
 import zlib
 from collections import OrderedDict
 
-from ..obs.recorder import flight
+from ..obs import recorder as _flightrec
 from .source import parse_source_uri
 
 __all__ = [
@@ -250,8 +250,10 @@ class DiskRangeCache:
         _bump("cache_misses_disk")
         _bump("cache_evictions_disk")
         if poisoned:
-            flight("cache_poison", site="io.remote.range", file=key[0],
-                   start=key[3], size=key[4])
+            if _flightrec._active is not None:
+                _flightrec.flight(
+                    "cache_poison", site="io.remote.range",
+                    file=key[0], start=key[3], size=key[4])
             from ..obs.postmortem import postmortem_path_for, \
                 record_incident
 

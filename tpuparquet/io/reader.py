@@ -58,7 +58,8 @@ class _IoHandle:
 
     __slots__ = ("f", "lock", "owns", "name", "inflight")
 
-    def __init__(self, f, owns: bool, name=None):
+    def __init__(self, f: "RangeSourceFile | io.BufferedIOBase",
+                 owns: bool, name=None):
         import threading
 
         self.f = f
@@ -1099,6 +1100,13 @@ class FileReader:
         for (start, size, members), data in zip(spans, fetched):
             if data is None:
                 continue
+            # flight recorder: one record per fetched span so a ring
+            # dump shows what the planner coalesced and actually
+            # pulled (guarded — this fires per prefetched range)
+            if _flightrec._active is not None:
+                _flightrec.flight(
+                    "prefetch_span", site="io.reader", file=self.name,
+                    start=start, size=size, members=len(members))
             if st is not None:
                 st.remote_ranges_fetched += 1
                 st.remote_bytes += size

@@ -48,7 +48,7 @@ import time
 
 from ..errors import TransientIOError
 from ..faults import fault_point, filter_bytes
-from ..obs.recorder import flight
+from ..obs import recorder as _flightrec
 
 __all__ = [
     "ByteRangeSource",
@@ -201,7 +201,8 @@ class ByteRangeSource:
         if len(data) != size:
             raise TransientIOError(
                 f"short range response from {self.uri}: "
-                f"{len(data)}/{size} bytes at offset {start}")
+                f"{len(data)}/{size} bytes at offset {start}",
+                file=self.uri)
         return data
 
     def get_ranges(self, ranges):
@@ -219,11 +220,17 @@ class LocalByteRangeSource(ByteRangeSource):
         self.uri = uri if uri is not None else f"file://{path}"
         fault_point("io.remote.open", file=self.uri)
         self._f = open(path, "rb")
-        self._lock = threading.Lock()  # serializes seek+read pairs
-        self._closed = False
-        st = os.fstat(self._f.fileno())
-        self._size = st.st_size
-        self._etag = (path, st.st_size, st.st_mtime_ns)
+        try:
+            self._lock = threading.Lock()  # serializes seek+read pairs
+            self._closed = False
+            st = os.fstat(self._f.fileno())
+            self._size = st.st_size
+            self._etag = (path, st.st_size, st.st_mtime_ns)
+        except BaseException:
+            # a failed __init__ returns no instance for anyone to
+            # close: release the fd before the raise escapes
+            self._f.close()
+            raise
 
     def _read_raw(self, start: int, size: int) -> bytes:
         with self._lock:
@@ -315,19 +322,26 @@ class EmulatedStoreSource(LocalByteRangeSource):
         if self._slow_match and self._slow_match in self.path:
             time.sleep(self._slow_s)
         if self._throttle_every and n % self._throttle_every == 0:
-            flight("emu_fault", site="io.remote.throttle", fault="throttle",
-                   file=self.uri, request=n)
+            if _flightrec._active is not None:
+                _flightrec.flight(
+                    "emu_fault", site="io.remote.throttle",
+                    fault="throttle", file=self.uri, request=n)
             raise TransientIOError(
-                f"429 throttled (emulated, request {n}) on {self.uri}")
+                f"429 throttled (emulated, request {n}) on {self.uri}",
+                file=self.uri)
         if self._reset_every and n % self._reset_every == 0:
-            flight("emu_fault", site="io.remote.range", fault="reset",
-                   file=self.uri, request=n)
+            if _flightrec._active is not None:
+                _flightrec.flight(
+                    "emu_fault", site="io.remote.range", fault="reset",
+                    file=self.uri, request=n)
             raise ConnectionResetError(
                 f"connection reset (emulated, request {n}) on {self.uri}")
         data = super()._read_raw(start, size)
         if self._short_every and n % self._short_every == 0 and len(data) > 1:
-            flight("emu_fault", site="io.remote.range", fault="short",
-                   file=self.uri, request=n)
+            if _flightrec._active is not None:
+                _flightrec.flight(
+                    "emu_fault", site="io.remote.range", fault="short",
+                    file=self.uri, request=n)
             return data[:len(data) // 2]
         return data
 
