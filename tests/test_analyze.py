@@ -274,6 +274,24 @@ class TestEnvKnobsPass:
         ks = envknobs.source_knobs(t)
         assert ks["TPQ_DELTA"]["evidence"] == "indirect"
 
+    def test_profiler_knob_family_parity(self):
+        # the round-20 profiler knobs ride the same catalog contract:
+        # a TPQ_PROFILE_* read without its README row is flagged, and
+        # documenting it clears the finding (both directions — a stale
+        # row with no read would flag too, via stale-doc-knob)
+        src = _ENV_OK + (
+            "\n    def profile_hz():\n"
+            "        import os\n"
+            "        return os.environ.get('TPQ_PROFILE_HZ', '50')\n")
+        t = _tree({"tpuparquet/mod.py": src}, readme=_README)
+        assert _keys(envknobs.run(t), "undocumented-knob") \
+            == ["TPQ_PROFILE_HZ"]
+        documented = _README.replace(
+            "| `TPQ_BETA` | x | y |",
+            "| `TPQ_BETA` | x | y |\n| `TPQ_PROFILE_HZ` | x | y |")
+        t = _tree({"tpuparquet/mod.py": src}, readme=documented)
+        assert envknobs.run(t) == []
+
 
 # ----------------------------------------------------------------------
 # atomic-write
@@ -548,6 +566,81 @@ class TestRecorderGuardPass:
                 if reg is None:
                     return
                 reg.observe(label, stage, value, **coords)
+        """})
+        assert recorderguard.run(t) == []
+
+    # -- the round-20 profiler vocabulary: stage_begin/wait_begin are
+    #    hot emit surfaces; their token-taking *_end twins are exempt
+    #    like close_span --------------------------------------------
+
+    def test_unguarded_stage_begin_flagged(self):
+        t = _tree({"tpuparquet/io/x.py": """
+            from ..obs import profiler as _profiler
+
+            def write_chunk(cols):
+                tok = _profiler.stage_begin("write")
+                try:
+                    return cols
+                finally:
+                    _profiler.stage_end(tok)
+        """})
+        assert _keys(recorderguard.run(t), "unguarded-hot-flight") \
+            == ["write_chunk:write"]
+
+    def test_ternary_guarded_stage_begin_accepted(self):
+        t = _tree({"tpuparquet/io/x.py": """
+            from ..obs import profiler as _profiler
+
+            def write_chunk(cols):
+                tok = _profiler.stage_begin("write") \\
+                    if _profiler._active is not None else None
+                try:
+                    return cols
+                finally:
+                    if tok is not None:
+                        _profiler.stage_end(tok)
+        """})
+        assert recorderguard.run(t) == []
+
+    def test_unguarded_wait_begin_in_loop_flagged(self):
+        t = _tree({"tpuparquet/io/x.py": """
+            from ..obs.profiler import wait_begin, wait_end
+
+            def fetch(ranges):
+                for r in ranges:
+                    tok = wait_begin("io", "io.reader.chunk_read")
+                    try:
+                        r.read()
+                    finally:
+                        wait_end(tok)
+        """})
+        assert _keys(recorderguard.run(t), "unguarded-hot-flight") \
+            == ["fetch:io"]
+
+    def test_profiler_accessor_guard_accepted(self):
+        t = _tree({"tpuparquet/io/x.py": """
+            from ..obs import profiler as _profiler
+
+            def fetch(ranges):
+                for r in ranges:
+                    tok = _profiler.wait_begin("io", "site") \\
+                        if _profiler.profiler() is not None else None
+                    try:
+                        r.read()
+                    finally:
+                        _profiler.wait_end(tok)
+        """})
+        assert recorderguard.run(t) == []
+
+    def test_profiler_module_exempt(self):
+        # the sampler's own internals call the markers unguarded by
+        # construction — excluded like recorder/trace/digest/alerts
+        t = _tree({"tpuparquet/obs/profiler.py": """
+            def stage_begin(stage):
+                p = _active
+                if p is None:
+                    return None
+                return p.push_stage(stage)
         """})
         assert recorderguard.run(t) == []
 

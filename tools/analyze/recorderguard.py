@@ -20,6 +20,17 @@ observations) and ``obs.alerts.emit_alert``::
     if _digest._active is not None:
         _digest.observe(label, "unit", us, trace=..., unit=...)
 
+The round-20 sampling profiler adds two more: ``stage_begin`` (the
+per-window stage hints at the write/transfer/dispatch/gather sites)
+and ``wait_begin`` (the off-CPU IO markers in the chunk reader)::
+
+    ptok = _profiler.stage_begin("write") \
+        if _profiler._active is not None else None
+
+Their ``stage_end``/``wait_end`` twins are exempt like
+``close_span``: they take the instance-carrying token ``stage_begin``
+returned (None when off) and build nothing.
+
 This pass enforces the pattern structurally, for ALL vocabularies:
 
 * every *module-qualified* call (``<alias>.flight(...)`` /
@@ -49,13 +60,15 @@ from .astutil import Finding, RepoTree, ancestors, enclosing_function
 PASS = "recorder-guard"
 
 EXCLUDE = ("tpuparquet/obs/recorder.py", "tpuparquet/obs/trace.py",
-           "tpuparquet/obs/digest.py", "tpuparquet/obs/alerts.py")
+           "tpuparquet/obs/digest.py", "tpuparquet/obs/alerts.py",
+           "tpuparquet/obs/profiler.py")
 
 #: call names held to the guarded-hot-site rule (the kwargs-building
 #: emit surfaces of the flight recorder, the causal tracer, the
-#: latency digests, and the alert engine)
+#: latency digests, the alert engine, and the sampling profiler's
+#: stage/wait markers)
 HOT_NAMES = ("flight", "emit_span", "open_span", "observe",
-             "emit_alert")
+             "emit_alert", "stage_begin", "wait_begin")
 
 #: event KINDS (the first positional arg) that ride per-request /
 #: per-range hot paths no matter where the call sits — the round-18
@@ -78,7 +91,8 @@ def _is_guard_test(test: ast.AST) -> bool:
             f = node.func
             name = f.attr if isinstance(f, ast.Attribute) \
                 else f.id if isinstance(f, ast.Name) else None
-            if name in ("recorder", "tracer", "digests", "engine"):
+            if name in ("recorder", "tracer", "digests", "engine",
+                        "profiler"):
                 return True
     return False
 

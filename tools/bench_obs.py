@@ -17,6 +17,9 @@ Decodes the 50M-value taxi shape (``bench.build_config2``) through a
 * ``trace_on``   — ``always_on`` plus the causal tracer ARMED
                    (``TPQ_TRACE=1``, sample 1.0): what a diagnosis
                    session pays.
+* ``profile_on`` — ``always_on`` plus the round-20 sampling profiler
+                   ARMED at its default rate (``TPQ_PROFILE=1``):
+                   what a live flamegraph costs while it runs.
 * ``collected``  — a full ``collect_stats(events=True)`` scope on top
                    (the post-hoc regime's known cost, for scale).
 
@@ -57,13 +60,14 @@ def _decode_once(buf):
 
 
 def _run_leg(buf, name: str, reps: int) -> dict:
-    from tpuparquet.obs import live, recorder, trace
+    from tpuparquet.obs import live, profiler, recorder, trace
 
     from tpuparquet.stats import collect_stats
 
     walls = []
     for _ in range(reps):
         trace.set_tracing(False)
+        profiler.set_profiling(False)
         if name == "off":
             recorder.set_ring(0)
             os.environ["TPQ_LIVE_METRICS"] = "0"
@@ -80,6 +84,14 @@ def _run_leg(buf, name: str, reps: int) -> dict:
             os.environ["TPQ_LIVE_METRICS"] = "1"
             trace.set_tracing(True)
             ctx = None
+        elif name == "profile_on":
+            # the round-20 sampling profiler ARMED at the default
+            # rate: sys._current_frames() walks on a jittered grid,
+            # stage/wait tagging live at every hot site
+            recorder.set_ring(recorder.ring_default() or 256)
+            os.environ["TPQ_LIVE_METRICS"] = "1"
+            profiler.set_profiling(True)
+            ctx = None
         else:  # collected
             recorder.set_ring(recorder.ring_default() or 256)
             os.environ["TPQ_LIVE_METRICS"] = "1"
@@ -92,6 +104,7 @@ def _run_leg(buf, name: str, reps: int) -> dict:
             with ctx:
                 units = _decode_once(buf)
         walls.append(time.perf_counter() - t0)
+    profiler.set_profiling(False)
     return {"leg": name, "units": units, "reps": reps,
             "wall_s_min": round(min(walls), 4),
             "wall_s_median": round(statistics.median(walls), 4),
@@ -131,12 +144,14 @@ def main(argv=None) -> int:
     _decode_once(buf)
 
     legs = [_run_leg(buf, name, args.reps)
-            for name in ("off", "always_on", "trace_on", "collected")]
+            for name in ("off", "always_on", "trace_on", "profile_on",
+                         "collected")]
     by = {leg["leg"]: leg for leg in legs}
     base = by["off"]["wall_s_min"]
     overhead = {
         name: round((by[name]["wall_s_min"] / base - 1.0) * 100, 2)
-        for name in ("always_on", "trace_on", "collected")
+        for name in ("always_on", "trace_on", "profile_on",
+                     "collected")
     }
     report = {
         "bench": "obs_overhead",

@@ -29,6 +29,16 @@ baselines (today: ``PRUNE_r01.json`` showed ≥ 5x at 1% selectivity,
 so the micro prune leg must stay ≥ its floor) — those are
 box-independent ratios, valid even where absolute walls are not.
 
+``--record`` also captures one PROFILED rep per leg (the round-20
+sampling profiler, armed at ``PROFILE_HZ`` in a dedicated rep AFTER
+the timing reps so the sampler never perturbs the walls) and commits
+the trimmed top stacks under a ``profiles`` key.  When ``--check``
+fails a leg, it re-profiles that leg and prints the top DIVERGING
+frames (``diff_states`` weighted stack diff) — the gate doesn't just
+say "scan got 40% slower", it says which frames grew.  Baselines
+recorded before round 20 have no ``profiles`` key; the diff is
+skipped, the gate itself is unchanged.
+
 Usage::
 
     JAX_PLATFORMS=cpu python tools/bench_sentinel.py --record
@@ -57,6 +67,11 @@ DEFAULT_TOL = 0.35
 DEFAULT_K = 6.0
 #: box-independent floors derived from the recorded full-scale runs
 PRUNE_MICRO_FLOOR = 2.0
+#: sampling rate for the per-leg profile capture (high: micro legs
+#: are short, and the profiled rep is not timed)
+PROFILE_HZ = 200.0
+#: heaviest-stacks cap per leg so the committed baseline stays small
+PROFILE_KEEP = 60
 
 N_ROWS = 200_000
 RG_ROWS = 25_000
@@ -178,6 +193,86 @@ def measure(reps: int, legs=None) -> dict:
     return out
 
 
+def _trim_state(state: dict, keep: int = PROFILE_KEEP) -> dict:
+    """Keep only the ``keep`` heaviest stacks across the state's
+    buckets — the committed baseline wants the shape of the hot path,
+    not every one-sample tail frame.  Counters stay exact (they are
+    the conservation record); only the stack tries are trimmed, which
+    inflates retained shares by the same truncated tail on both sides
+    of a later diff."""
+    ranked = []
+    for label, stages in (state.get("buckets") or {}).items():
+        for stage, b in stages.items():
+            for stk, cnt in (b.get("stacks") or {}).items():
+                ranked.append((cnt, label, stage, stk))
+    ranked.sort(reverse=True)
+    kept = {(lb, st, stk) for _c, lb, st, stk in ranked[:keep]}
+    buckets: dict = {}
+    for label, stages in (state.get("buckets") or {}).items():
+        for stage, b in stages.items():
+            stacks = {k: c for k, c in (b.get("stacks") or {}).items()
+                      if (label, stage, k) in kept}
+            if stacks:
+                buckets.setdefault(label, {})[stage] = {
+                    "samples": b["samples"],
+                    "offcpu": b["offcpu"],
+                    "stacks": stacks,
+                }
+    out = dict(state)
+    out["buckets"] = buckets
+    return out
+
+
+def profile_legs(legs=None) -> dict:
+    """One profiled (untimed) run per leg: arm the sampling profiler,
+    run the leg once, keep the trimmed state.  Separate from
+    ``measure`` on purpose — the sampler must never run during a
+    timing rep.  The leg runs in a dedicated thread so the sampled
+    stack ROOT is identical between ``--record`` and ``--check``
+    (profiling on the main thread would bake ``record``/``check``
+    caller frames into the stacks and they would dominate any diff)."""
+    import threading
+
+    from tpuparquet.obs import profiler as prof
+
+    buf = _corpus_buf()
+    out = {}
+    for name, (fn, _direction) in LEGS.items():
+        if legs and name not in legs:
+            continue
+        exc: list = []
+
+        def body():
+            try:
+                fn(buf)
+            except BaseException as e:  # re-raised on the caller
+                exc.append(e)
+
+        prof.set_profiling(True, hz=PROFILE_HZ)
+        try:
+            t = threading.Thread(target=body, name="sentinel-leg")
+            t.start()
+            t.join()
+        finally:
+            p = prof.profiler()
+            state = p.to_state() if p is not None else None
+            prof.set_profiling(False)
+        if exc:
+            raise exc[0]
+        if state and state["counters"]["profile_samples"]:
+            # the main thread is sampled too, parked in t.join() with
+            # record/check caller frames in its stack — drop those
+            # stacks (the profile_legs frame never appears on the leg
+            # thread) so they can't dominate a later diff
+            for stages in state["buckets"].values():
+                for b in stages.values():
+                    b["stacks"] = {
+                        k: c for k, c in b["stacks"].items()
+                        if "bench_sentinel.py:profile_legs" not in k}
+            out[name] = _trim_state(state)
+    return out
+
+
 def _usable_cpus() -> int:
     try:
         return len(os.sched_getaffinity(0)) or 1
@@ -194,6 +289,7 @@ def record(path: str, reps: int) -> int:
         "usable_cpus": _usable_cpus(),
         "python": sys.version.split()[0],
         "legs": measure(reps),
+        "profiles": profile_legs(),
     }
     with open(path, "w") as f:
         json.dump(doc, f, indent=1, sort_keys=True)
@@ -201,6 +297,29 @@ def record(path: str, reps: int) -> int:
     print(f"recorded baseline -> {path}")
     print(json.dumps(doc["legs"], indent=1, sort_keys=True))
     return 0
+
+
+def _print_diverging_frames(bad_legs, base_profiles: dict) -> None:
+    """A leg regressed: re-profile it and localize the delta.  Quietly
+    a no-op for pre-round-20 baselines (no ``profiles`` key) or legs
+    that yielded no samples."""
+    bad = sorted(n for n in bad_legs if n in base_profiles)
+    if not bad:
+        return
+    from tpuparquet.obs.profiler import diff_states
+
+    fresh = profile_legs(legs=bad)
+    for name in bad:
+        state = fresh.get(name)
+        if not state:
+            continue
+        print(f"bench_sentinel: top diverging frames ({name}, "
+              f"baseline -> fresh):", file=sys.stderr)
+        for row in diff_states(base_profiles[name], state, n=8):
+            print(f"  {row['delta'] * 100:+7.2f}pp  "
+                  f"{row['share_a'] * 100:6.2f}% -> "
+                  f"{row['share_b'] * 100:6.2f}%  {row['frame']}",
+                  file=sys.stderr)
 
 
 def check(path: str, reps: int, tol: float, k: float) -> int:
@@ -269,6 +388,9 @@ def check(path: str, reps: int, tol: float, k: float) -> int:
     if failures:
         print("bench_sentinel: PERF REGRESSION\n  "
               + "\n  ".join(failures), file=sys.stderr)
+        _print_diverging_frames(
+            {f.split(":", 1)[0] for f in failures},
+            base.get("profiles") or {})
         return 1
     print("bench_sentinel: within noise of baseline")
     return 0

@@ -1,7 +1,7 @@
 #!/bin/bash
 # In-repo CI gate (counterpart of the reference's .circleci/config.yml,
 # which pins go versions and runs `go test ./...` + the compatibility
-# corpus per commit).  Fifteen stages, pinned env:
+# corpus per commit).  Sixteen stages, pinned env:
 #
 #   1. tier-1 suite   — the ROADMAP.md verify command, gated on a PASS
 #                       FLOOR rather than rc: optional deps (zstandard,
@@ -116,6 +116,16 @@
 #                       sweep and the recorder unit suite run in
 #                       tier-1 via tests/test_chaos.py and
 #                       tests/test_lockcheck.py)
+#  16. sampling profiler — strict (rc=0): the round-20 profiler gate.
+#                       The profiler suite + scan suite re-run under
+#                       TPQ_PROFILE=1 (armed sampling must not change
+#                       a byte of scan output), then a CLI smoke over
+#                       freshly captured profiles: flame renders a
+#                       native-write capture, flame --diff localizes
+#                       the native-on vs TPQ_WRITE_NATIVE=0 delta,
+#                       and doctor --profile joins a profiled+traced
+#                       scan's samples to its span-derived stage walls
+#                       with zero consistency warnings
 #
 # Usage: bash tools/ci.sh            (exit 0 = gate passed)
 # The tier-1 stage mirrors ROADMAP.md exactly — if you change one,
@@ -138,7 +148,7 @@ CI_PASS_FLOOR=${CI_PASS_FLOOR:-1000}
 
 fail() { echo "ci.sh: FAILED at stage $1" >&2; exit 1; }
 
-echo "=== stage 1/15: tier-1 suite (pass floor $CI_PASS_FLOOR) ==="
+echo "=== stage 1/16: tier-1 suite (pass floor $CI_PASS_FLOOR) ==="
 rm -f /tmp/_t1.log
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
@@ -152,25 +162,25 @@ echo "DOTS_PASSED=$passed"
 [ "$passed" -ge "$CI_PASS_FLOOR" ] \
   || fail "tier-1 ($passed passed < floor $CI_PASS_FLOOR)"
 
-echo "=== stage 2/15: smoke bench (CPU backend, tiny target) ==="
+echo "=== stage 2/16: smoke bench (CPU backend, tiny target) ==="
 TPQ_BENCH_TARGET=60000 TPQ_BENCH_CPU=1 timeout -k 10 600 \
   python bench.py > /tmp/_ci_bench.json || fail "smoke bench"
 tail -1 /tmp/_ci_bench.json
 
-echo "=== stage 3/15: crash corpus + fault-injection matrix (strict) ==="
+echo "=== stage 3/16: crash corpus + fault-injection matrix (strict) ==="
 timeout -k 10 600 python -m pytest \
   "tests/test_corpus.py::TestCrashRegressions" tests/test_faults.py \
   -q -p no:cacheprovider || fail "corpus/faults"
 
-echo "=== stage 4/15: salvage + strict metadata (strict) ==="
+echo "=== stage 4/16: salvage + strict metadata (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_salvage.py \
   -q -p no:cacheprovider || fail "salvage"
 
-echo "=== stage 5/15: deadlines/hedging + kill-resume checkpoints (strict) ==="
+echo "=== stage 5/16: deadlines/hedging + kill-resume checkpoints (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_deadline.py \
   tests/test_checkpoint.py -q -p no:cacheprovider || fail "time/crash"
 
-echo "=== stage 6/15: plan matrix: serial vs parallel, cache on (strict) ==="
+echo "=== stage 6/16: plan matrix: serial vs parallel, cache on (strict) ==="
 # leg A: pinned-serial planning (the TPQ_PLAN_THREADS=1 reference path)
 TPQ_PLAN_THREADS=1 timeout -k 10 600 python -m pytest \
   tests/test_plan_parallel.py tests/test_plan_cache.py \
@@ -181,7 +191,7 @@ TPQ_PLAN_CACHE_MB=64 timeout -k 10 600 python -m pytest \
   tests/test_plan_parallel.py tests/test_fallback_matrix.py \
   -q -p no:cacheprovider || fail "plan matrix (cache-on leg)"
 
-echo "=== stage 7/15: live obs gate + overhead guard (strict) ==="
+echo "=== stage 7/16: live obs gate + overhead guard (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_live_obs.py \
   tests/test_env_docs.py -q -p no:cacheprovider || fail "live obs"
 # overhead guard: the always-on default must stay within a generous
@@ -192,7 +202,7 @@ timeout -k 10 600 python tools/bench_obs.py --values 2000000 \
   || fail "obs overhead guard"
 tail -5 /tmp/_ci_obs.json
 
-echo "=== stage 8/15: pruning parity gate (strict) ==="
+echo "=== stage 8/16: pruning parity gate (strict) ==="
 # leg A: the whole pushdown suite (write/read page index + bloom,
 # verdicts, late materialization, counter exactness, corrupt-index
 # degrade, pyarrow interop) on the default pool width
@@ -205,13 +215,13 @@ TPQ_PLAN_THREADS=1 TPQ_PRUNE=0 timeout -k 10 600 python -m pytest \
   "tests/test_prune.py::TestParity" \
   -q -p no:cacheprovider || fail "pruning parity (prune-off leg)"
 
-echo "=== stage 9/15: tpq-analyze invariant passes + sanitizer leg (strict) ==="
+echo "=== stage 9/16: tpq-analyze invariant passes + sanitizer leg (strict) ==="
 timeout -k 10 300 python -m tools.analyze || fail "tpq-analyze"
 timeout -k 10 600 python -m pytest tests/test_analyze.py \
   -q -p no:cacheprovider || fail "analyzer self-test"
 timeout -k 10 900 bash tools/analyze/native.sh || fail "native sanitizers"
 
-echo "=== stage 10/15: gather placement parity gate (strict) ==="
+echo "=== stage 10/16: gather placement parity gate (strict) ==="
 # leg A: the placement suite — byte parity placed vs replicated across
 # filter/quarantine/salvage/resume/multi-host, placement + counter pins,
 # mesh-mismatch errors
@@ -224,7 +234,7 @@ TPQ_GATHER_TO=0 timeout -k 10 600 python -m pytest \
   tests/test_gather_placement.py \
   -q -p no:cacheprovider || fail "gather placement (env leg)"
 
-echo "=== stage 11/15: write-pipeline parity gate (strict) ==="
+echo "=== stage 11/16: write-pipeline parity gate (strict) ==="
 # leg A: the whole native-write suite on the default knobs
 timeout -k 10 600 python -m pytest tests/test_write_native.py \
   -q -p no:cacheprovider || fail "write parity"
@@ -235,7 +245,7 @@ TPQ_WRITE_NATIVE=0 timeout -k 10 600 python -m pytest \
   tests/test_write_native.py -q -p no:cacheprovider \
   || fail "write parity (native-off leg)"
 
-echo "=== stage 12/15: causal tracing + attribution + bench sentinel (strict) ==="
+echo "=== stage 12/16: causal tracing + attribution + bench sentinel (strict) ==="
 # leg A: the trace/attribution suite on the default (trace-off) env —
 # span-tree connectivity, adversity-matrix propagation, ledger
 # conservation, doctor goldens
@@ -255,7 +265,7 @@ TPQ_TRACE=1 timeout -k 10 900 python -m pytest \
 timeout -k 10 600 python tools/bench_sentinel.py --check \
   || fail "bench sentinel"
 
-echo "=== stage 13/15: soak smoke: faults -> alerts, exact sums, byte identity (strict) ==="
+echo "=== stage 13/16: soak smoke: faults -> alerts, exact sums, byte identity (strict) ==="
 # N=4 concurrent labeled scans with the deterministic fault plan
 # (CorruptPage on one tenant's unique column, hang + unit deadline on
 # another tenant's file).  Asserts the whole longitudinal contract:
@@ -264,7 +274,7 @@ echo "=== stage 13/15: soak smoke: faults -> alerts, exact sums, byte identity (
 timeout -k 10 600 python -m tools.soak --scans 4 \
   || fail "soak smoke"
 
-echo "=== stage 14/15: remote emulator: parity over an unreliable store (strict) ==="
+echo "=== stage 14/16: remote emulator: parity over an unreliable store (strict) ==="
 # leg A: the dedicated remote suite — URI routing, coalescer property
 # sweep, tiered-cache conservation + poisoning + torn-file restart,
 # emu parity with the cache on AND off, hedged slow replicas
@@ -289,7 +299,7 @@ TPQ_SOURCE=emu TPQ_CACHE_DISK_MB=0 TPQ_CACHE_MEM_MB=0 \
   tests/test_checkpoint.py -q -p no:cacheprovider \
   || fail "remote emulator (cache-off leg)"
 
-echo "=== stage 15/15: schedule chaos + runtime lock-order validation (strict) ==="
+echo "=== stage 15/16: schedule chaos + runtime lock-order validation (strict) ==="
 # leg A: one chaos seed over the plan-parallel and soak-parity suites
 # — the seeded schedule perturbation must reproduce the unperturbed
 # baseline exactly (tests/test_chaos.py runs the full 3-seed sweep in
@@ -301,5 +311,99 @@ timeout -k 10 600 python -m tools.chaos --seeds 101 \
 # analysis failed to model, fails the soak's own gate
 TPQ_LOCKCHECK=1 timeout -k 10 600 python -m tools.soak --scans 4 \
   --chaos-seed 101 || fail "lockcheck soak leg"
+
+echo "=== stage 16/16: sampling profiler: armed parity + flame/doctor smoke (strict) ==="
+# leg A: profiler-ENABLED scan paths — the real sampler thread walks
+# sys._current_frames() through the whole scan suite and must not
+# change a byte of output (the byte-parity pins inside these suites
+# now also hold under armed sampling)
+TPQ_PROFILE=1 timeout -k 10 900 python -m pytest \
+  tests/test_profiler.py tests/test_shard.py \
+  -q -p no:cacheprovider || fail "profile-enabled leg"
+# leg B: CLI smoke over freshly captured profiles — capture the
+# native and pure write pipelines plus one traced+profiled scan, then
+# flame / flame --diff / doctor --profile must all render (and the
+# doctor's samples-vs-stage-wall consistency check must stay quiet)
+_CI_PROF=$(mktemp -d)
+timeout -k 10 600 python - "$_CI_PROF" <<'PYEOF' || fail "profile capture"
+import os
+import sys
+
+root = sys.argv[1]
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+from tpuparquet import FileWriter
+from tpuparquet.obs import profiler as prof
+from tpuparquet.obs import trace
+from tpuparquet.obs.profiler import write_profile_file
+from tpuparquet.shard.scan import ShardedScan
+
+N, STEP = 400_000, 50_000
+ts = np.arange(N, dtype=np.int64) * 3
+fare = (ts % 977).astype("float64") * 0.5
+SCHEMA = "message t { required int64 ts; required double fare; }"
+
+
+def write_once(path):
+    with open(path, "wb") as f:
+        w = FileWriter(f, SCHEMA)
+        for a in range(0, N, STEP):
+            w.write_columns({"ts": ts[a:a + STEP],
+                             "fare": fare[a:a + STEP]})
+        w.close()
+
+
+def capture(fn):
+    p = prof.set_profiling(True, hz=500)
+    try:
+        fn()
+    finally:
+        state = p.to_state()
+        prof.set_profiling(False)
+    return state
+
+sa = capture(lambda: write_once(os.path.join(root, "native.parquet")))
+os.environ["TPQ_WRITE_NATIVE"] = "0"
+sb = capture(lambda: write_once(os.path.join(root, "pure.parquet")))
+del os.environ["TPQ_WRITE_NATIVE"]
+assert sa["counters"]["profile_samples"], "no samples (native write)"
+assert sb["counters"]["profile_samples"], "no samples (pure write)"
+write_profile_file(sa, os.path.join(root, "native.prof"))
+write_profile_file(sb, os.path.join(root, "pure.prof"))
+
+# one traced + profiled scan: the scan driver exports both files
+os.environ["TPQ_PROFILE_EXPORT"] = os.path.join(root, "scan.prof")
+os.environ["TPQ_TRACE_EXPORT"] = os.path.join(root, "scan.trace")
+trace.set_tracing(True)
+prof.set_profiling(True, hz=500)
+try:
+    for _k, cols in ShardedScan(
+            [os.path.join(root, "native.parquet")]).run_iter():
+        for c in cols.values():
+            c.block_until_ready()
+finally:
+    prof.set_profiling(False)
+    trace.set_tracing(False)
+    for k in ("TPQ_PROFILE_EXPORT", "TPQ_TRACE_EXPORT"):
+        del os.environ[k]
+assert os.path.exists(os.path.join(root, "scan.prof")), "no scan export"
+assert os.path.exists(os.path.join(root, "scan.trace")), "no trace export"
+PYEOF
+timeout -k 10 120 python -m tpuparquet.cli.parquet_tool flame \
+  "$_CI_PROF/native.prof" > /dev/null || fail "flame smoke"
+timeout -k 10 120 python -m tpuparquet.cli.parquet_tool flame \
+  --diff "$_CI_PROF/native.prof" "$_CI_PROF/pure.prof" > /dev/null \
+  || fail "flame --diff smoke"
+_CI_DOC=$(timeout -k 10 120 python -m tpuparquet.cli.parquet_tool \
+  doctor --profile "$_CI_PROF/scan.prof" "$_CI_PROF/scan.trace") \
+  || fail "doctor --profile smoke"
+echo "$_CI_DOC" | grep -q "profile: top frames" \
+  || fail "doctor --profile (no profile section)"
+echo "$_CI_DOC" | grep -q "WARNING" \
+  && fail "doctor --profile (consistency warning)"
+rm -rf "$_CI_PROF"
 
 echo "ci.sh: gate PASSED"

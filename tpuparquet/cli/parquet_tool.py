@@ -573,6 +573,14 @@ def render_top_frame(frames: list[dict], width: int = 40) -> str:
                 + (f"  read {by['read']:,}B" if by.get("read") else "")
                 + (f"  peak_arena {attr['peak_arena_bytes']:,}B"
                    if attr.get("peak_arena_bytes") else ""))
+        prof = f.get("profile")
+        if prof and prof.get("samples"):
+            lines.append(
+                "  PROFILE "
+                f"{prof['samples']} samples "
+                f"@ {prof.get('rate_hz') or 0:.0f}/s  "
+                f"off-cpu {(prof.get('offcpu_share') or 0) * 100:.0f}%"
+                f"  top {prof.get('top_frame') or '-'}")
         if f.get("_stale_s") is not None:
             lines.append(
                 f"  STALE: no update for {f['_stale_s']:.0f}s "
@@ -701,6 +709,23 @@ def render_watch(frames: list[dict], objectives: list[dict],
             + (f" ({errors / attempts * 100.0:.2f}%)" if attempts
                else "")
             + f"  duration {dur}")
+    # one-line PROFILE section when a sampler is armed: the sampler
+    # mirrors its counters/gauges into the registry, so they ride the
+    # same ring frames the RED view reads — stable under --once
+    if (last.get("counters") or {}).get("profile_samples"):
+        c = last["counters"]
+        g = last.get("gauges") or {}
+        share = g.get("profile_offcpu_share")
+        if share is None and c["profile_samples"]:
+            share = (c.get("profile_samples_offcpu", 0)
+                     / c["profile_samples"])
+        lines.append(
+            f"  PROFILE {c['profile_samples']} samples "
+            f"@ {g.get('profile_rate_hz') or 0:.0f}/s  "
+            f"off-cpu {(share or 0) * 100:.0f}%  "
+            f"top {g.get('profile_top_frame') or '-'}"
+            + (f"  drops {c['profile_drops']}"
+               if c.get("profile_drops") else ""))
     if objectives:
         report = evaluate(frames, objectives, now)
         for row in report["objectives"]:
@@ -780,6 +805,101 @@ def cmd_slo(args, out=None) -> int:
     return 2 if violated else 0
 
 
+def cmd_flame(args, out=None) -> int:
+    """Render a sampling-profile export (the native ``tpq-profile``
+    envelope a scan wrote via ``TPQ_PROFILE_EXPORT``): top-N frames
+    by self samples with total/share columns, filterable by
+    ``--label``/``--stage``.  ``--diff A B`` prints the weighted
+    per-frame share delta between two profiles — each normalizes to
+    its own sample total, so runs of different length compare and the
+    biggest movers localize a regression.  ``--collapsed`` dumps
+    collapsed-stack lines for flamegraph.pl / speedscope; ``--json``
+    emits machine-readable rows."""
+    import json as _json
+
+    from ..obs.profiler import (
+        collapsed_lines,
+        diff_states,
+        load_profile_file,
+        top_frames,
+    )
+
+    out = out or sys.stdout
+    n = getattr(args, "n", 15)
+    if getattr(args, "diff", None):
+        a = load_profile_file(args.diff[0])
+        b = load_profile_file(args.diff[1])
+        rows = diff_states(a, b, n=n)
+        if getattr(args, "json", False):
+            print(_json.dumps(rows, sort_keys=True), file=out)
+            return 0
+        print(f"share delta {args.diff[0]} -> {args.diff[1]} "
+              f"(+ grew in B)", file=out)
+        for r in rows:
+            print(f"  {r['delta'] * 100:+7.2f}%  "
+                  f"{r['share_a'] * 100:6.2f}% -> "
+                  f"{r['share_b'] * 100:6.2f}%  {r['frame']}",
+                  file=out)
+        return 0
+    if not getattr(args, "profile_file", None):
+        raise ValueError("flame: pass a PROFILE file or --diff A B")
+    state = load_profile_file(args.profile_file)
+    if getattr(args, "collapsed", False):
+        for line in collapsed_lines(state):
+            print(line, file=out)
+        return 0
+    label = getattr(args, "label", None)
+    stage = getattr(args, "stage", None)
+    rows = top_frames(state, label=label, stage=stage, n=n)
+    if getattr(args, "json", False):
+        print(_json.dumps(
+            {"counters": state.get("counters") or {},
+             "period_s": state.get("period_s"),
+             "top": rows}, sort_keys=True), file=out)
+        return 0
+    c = state.get("counters") or {}
+    total = c.get("profile_samples", 0)
+    off = c.get("profile_samples_offcpu", 0)
+    sel = "".join(
+        [f" label={label}" if label else "",
+         f" stage={stage}" if stage else ""])
+    print(f"{total} samples ({off} off-cpu, "
+          f"{c.get('profile_drops', 0)} drops) "
+          f"@ {state.get('hz') or 0:g} Hz{sel}", file=out)
+    if not rows:
+        print("  (no samples match)", file=out)
+        return 1
+    print(f"  {'self':>7} {'total':>7} {'share':>7}  frame", file=out)
+    for r in rows:
+        print(f"  {r['self_s']:7.3f} {r['total_s']:7.3f} "
+              f"{r['share'] * 100:6.2f}%  {r['frame']}", file=out)
+    return 0
+
+
+def _render_doctor_profile(state: dict, d: dict) -> str:
+    """The ``doctor --profile`` tail: name the top frames inside the
+    diagnosis's dominant stage and cross-check sampled seconds
+    against the span-derived stage walls."""
+    from ..obs.profiler import profile_consistency, top_frames
+
+    bound = d.get("bound_stage")
+    rows = top_frames(state, label=d.get("label"), stage=bound, n=5)
+    if not rows:
+        # multi-label exports may not key this trace's label; the
+        # stage-filtered whole-profile view still answers "what ran"
+        rows = top_frames(state, stage=bound, n=5)
+    lines = [f"  profile: top frames in {bound} "
+             f"({state.get('hz') or 0:g} Hz sampler)"]
+    if not rows:
+        lines.append("    (no samples in this stage)")
+    for r in rows:
+        lines.append(f"    {r['self_s']:8.3f}s self  "
+                     f"{r['share'] * 100:5.1f}%  {r['frame']}")
+    for w in profile_consistency(state, d.get("stages_s") or {}):
+        lines.append(f"  WARNING {w}")
+    return "\n".join(lines)
+
+
 def cmd_doctor(args, out=None) -> int:
     """Walk a causal scan trace and say what bounds the wall.
 
@@ -822,9 +942,25 @@ def cmd_doctor(args, out=None) -> int:
     reports = [diagnose(ss) for _tid, ss in
                sorted(by_trace.items(),
                       key=lambda kv: min(s["t0"] for s in kv[1]))]
+    pstate = None
+    if getattr(args, "profile", None):
+        from ..obs.profiler import load_profile_file
+
+        pstate = load_profile_file(args.profile)
     if getattr(args, "json", False):
-        _json.dump({"reports": reports, "ledgers": ledgers}, out,
-                   sort_keys=True, default=str)
+        doc = {"reports": reports, "ledgers": ledgers}
+        if pstate is not None:
+            from ..obs.profiler import profile_consistency, top_frames
+
+            doc["profile"] = [
+                {"trace": d.get("trace"),
+                 "bound_stage": d.get("bound_stage"),
+                 "top_frames": top_frames(
+                     pstate, stage=d.get("bound_stage"), n=5),
+                 "warnings": profile_consistency(
+                     pstate, d.get("stages_s") or {})}
+                for d in reports]
+        _json.dump(doc, out, sort_keys=True, default=str)
         print(file=out)
         return 0
     for i, d in enumerate(reports):
@@ -832,6 +968,8 @@ def cmd_doctor(args, out=None) -> int:
             print(file=out)
         print(format_diagnosis(d, ledgers if i == 0 else None),
               file=out)
+        if pstate is not None:
+            print(_render_doctor_profile(pstate, d), file=out)
     return 0
 
 
@@ -1207,11 +1345,43 @@ def build_parser() -> argparse.ArgumentParser:
     dr.add_argument("--trace-id", default=None, dest="trace_id",
                     help="analyze only this trace id (default: every "
                          "trace in the file)")
+    dr.add_argument("--profile", default=None,
+                    help="sampling-profile export (TPQ_PROFILE_EXPORT "
+                         "native envelope): name the top frames inside "
+                         "the dominant stage and cross-check sampled "
+                         "seconds against the span stage walls")
     dr.add_argument("trace",
                     help="trace export: the tpq-trace envelope a scan "
                          "writes via TPQ_TRACE_EXPORT, a bare span "
                          "list, or a *.perfetto.json round trip")
     dr.set_defaults(fn=cmd_doctor)
+
+    fl = sub.add_parser(
+        "flame",
+        help="render a sampling-profile export (TPQ_PROFILE_EXPORT): "
+             "top frames by self time, or --diff two profiles")
+    fl.add_argument("--diff", nargs=2, metavar=("A", "B"),
+                    default=None,
+                    help="weighted per-frame share delta between two "
+                         "profile exports (regression localization)")
+    fl.add_argument("--label", default=None,
+                    help="only samples of this scan label")
+    fl.add_argument("--stage", default=None,
+                    help="only samples tagged with this stage "
+                         "(read/plan/decompress/transfer/dispatch/"
+                         "gather/write/other)")
+    fl.add_argument("-n", type=int, default=15,
+                    help="rows to print (default 15)")
+    fl.add_argument("--collapsed", action="store_true",
+                    help="dump collapsed-stack lines "
+                         "(flamegraph.pl / speedscope input)")
+    fl.add_argument("--json", action="store_true",
+                    help="emit machine-readable rows")
+    fl.add_argument("profile_file", nargs="?", default=None,
+                    metavar="profile",
+                    help="a native tpq-profile export (not needed "
+                         "with --diff)")
+    fl.set_defaults(fn=cmd_flame)
 
     rc = sub.add_parser("rowcount", help="print the total row count")
     rc.add_argument("file")

@@ -23,6 +23,7 @@ from ..cpu.dictionary import build_dictionary
 from ..cpu.plain import ByteArrayColumn
 from ..errors import CorruptChunkError, CorruptPageError, ScanError
 from ..faults import filter_bytes
+from ..obs import profiler as _profiler
 from ..obs import recorder as _flightrec
 from ..obs import trace as _trace
 from ..format.compact import CompactReader
@@ -514,6 +515,11 @@ def write_chunk(out, node: SchemaNode, column, rep, dl, *,
     from ..kernels.arena import lease_arena, return_arena
 
     arena = lease_arena()
+    # stage hint: the span substrate only learns about page writes
+    # after the fact (emit_span), so the sampler needs an explicit
+    # marker to bucket in-flight stacks under "write"
+    ptok = _profiler.stage_begin("write") \
+        if _profiler._active is not None else None
     try:
         if dictionary is not None:
             dict_page_offset = pos0
@@ -586,6 +592,8 @@ def write_chunk(out, node: SchemaNode, column, rep, dl, *,
             total_comp += sum(e[2] for e in page_entries)
             total_uncomp += c
     finally:
+        if ptok is not None:
+            _profiler.stage_end(ptok)
         # page bodies have been copied into the output stream; slabs
         # recycle for the next chunk on this thread
         arena.release_all()

@@ -29,6 +29,7 @@ from ..deadline import (
 from ..errors import CorruptChunkError, CorruptPageError, \
     ScanError
 from ..faults import fault_point, filter_bytes, retry_transient
+from ..obs import profiler as _profiler
 from ..obs import recorder as _flightrec
 from ..obs import trace as _trace
 from ..obs.recorder import flight
@@ -933,48 +934,60 @@ class FileReader:
         if cm.dictionary_page_offset is not None:
             start = min(start, cm.dictionary_page_offset)
         t0 = time.perf_counter()
-        if self._buf is not None:
-            # explicit bounds: negative offsets would WRAP on a
-            # memoryview slice (the old seek() raised instead)
-            if (start < 0 or cm.total_compressed_size < 0
-                    or start + cm.total_compressed_size
-                    > len(self._buf)):
-                raise CorruptChunkError("column chunk overruns file",
-                                        column=path, file=self.name)
-            fault_point("io.reader.chunk_read", column=path)
-            fault_point("io.chunk.hang", file=self.name, column=path)
-            blob = self._buf[start : start + cm.total_compressed_size]
-        else:
-            # remote path: column-chunk ranges live in the DISK cache
-            # tier (CRC-framed files, rangecache.py); a hit skips the
-            # fetch entirely, a miss fetches through the full
-            # retry/hedge/deadline ladder and back-fills the tier
-            dcache = None
-            ckey = None
-            blob = None
-            if self._source is not None:
-                from .rangecache import disk_cache
-
-                dcache = disk_cache()
-                if dcache is not None:
-                    ckey = self._source.etag() + (
-                        start, cm.total_compressed_size)
-                    blob = dcache.get(ckey)
-            if blob is None:
-                blob = self._read_chunk_bytes(
-                    start, cm.total_compressed_size, path)
-                if len(blob) < cm.total_compressed_size:
+        # off-CPU marker: a thread sampled inside the fetch (fault
+        # hangs, remote stalls, retry/hedge/deadline waits) is
+        # wait-on-IO, not on-CPU work in this frame
+        ptok = _profiler.wait_begin("io", "io.reader.chunk_read") \
+            if _profiler._active is not None else None
+        try:
+            if self._buf is not None:
+                # explicit bounds: negative offsets would WRAP on a
+                # memoryview slice (the old seek() raised instead)
+                if (start < 0 or cm.total_compressed_size < 0
+                        or start + cm.total_compressed_size
+                        > len(self._buf)):
                     raise CorruptChunkError(
-                        f"column chunk short read: {len(blob)}/"
-                        f"{cm.total_compressed_size} bytes",
+                        "column chunk overruns file",
                         column=path, file=self.name)
+                fault_point("io.reader.chunk_read", column=path)
+                fault_point("io.chunk.hang", file=self.name,
+                            column=path)
+                blob = self._buf[start : start + cm.total_compressed_size]
+            else:
+                # remote path: column-chunk ranges live in the DISK
+                # cache tier (CRC-framed files, rangecache.py); a hit
+                # skips the fetch entirely, a miss fetches through the
+                # full retry/hedge/deadline ladder and back-fills the
+                # tier
+                dcache = None
+                ckey = None
+                blob = None
                 if self._source is not None:
-                    st = current_stats()
-                    if st is not None:
-                        st.remote_ranges_fetched += 1
-                        st.remote_bytes += len(blob)
+                    from .rangecache import disk_cache
+
+                    dcache = disk_cache()
                     if dcache is not None:
-                        dcache.put(ckey, blob)
+                        ckey = self._source.etag() + (
+                            start, cm.total_compressed_size)
+                        blob = dcache.get(ckey)
+                if blob is None:
+                    blob = self._read_chunk_bytes(
+                        start, cm.total_compressed_size, path)
+                    if len(blob) < cm.total_compressed_size:
+                        raise CorruptChunkError(
+                            f"column chunk short read: {len(blob)}/"
+                            f"{cm.total_compressed_size} bytes",
+                            column=path, file=self.name)
+                    if self._source is not None:
+                        st = current_stats()
+                        if st is not None:
+                            st.remote_ranges_fetched += 1
+                            st.remote_bytes += len(blob)
+                        if dcache is not None:
+                            dcache.put(ckey, blob)
+        finally:
+            if ptok is not None:
+                _profiler.wait_end(ptok)
         blob = filter_bytes("io.reader.chunk_read", blob, column=path)
         dt = time.perf_counter() - t0
         st = current_stats()

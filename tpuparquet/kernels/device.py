@@ -45,6 +45,7 @@ from ..errors import CorruptChunkError, CorruptPageError, \
     DeviceDispatchError, ScanError
 from ..faults import backoff_delays, fault_point, filter_bytes
 from ..native import plane_native
+from ..obs import profiler as _profiler
 from ..obs import recorder as _flightrec
 from ..obs import trace as _trace
 from ..obs.recorder import flight
@@ -2804,21 +2805,38 @@ def _finish_row_group(planned):
         fault_point("kernels.device.unit_dispatch")
         fault_point("kernels.device.hang")
     t0 = time.perf_counter()
-    staged_lists = _put_all([stager for _, _, stager in planned])
+    # stage hints: transfer and dispatch only emit_span AFTER
+    # measuring, so the sampler needs in-flight markers scoped to the
+    # same windows the spans time (doctor cross-checks the two)
+    ptok = _profiler.stage_begin("transfer") \
+        if _profiler._active is not None else None
+    try:
+        staged_lists = _put_all([stager for _, _, stager in planned])
+    finally:
+        if ptok is not None:
+            _profiler.stage_end(ptok)
     t1 = time.perf_counter()
-    out = {path: finish(staged)
-           for (path, finish, _), staged in zip(planned, staged_lists)}
-    # Drain the dispatched kernels before returning: on the
-    # remote-attached TPU, letting async work pile up degrades every
-    # subsequent transfer ~2x (measured 1.16s vs 0.53s over 8 row
-    # groups at 50M values) — the tunnel serializes badly under a deep
-    # queue.  Compute itself is sub-ms; this costs one sync, and it
-    # also fences the finish()-time transfers sourced from arena slabs.
-    # One batched block_until_ready: per-buffer syncs are a round trip
-    # EACH over the tunnel (~240 of them across 8 row groups x 5 columns
-    # x 6 buffers cost ~0.6s — the entire e2e-vs-internals gap).
-    jax.block_until_ready(
-        [x for c in out.values() for x in c._buffers()])
+    ptok = _profiler.stage_begin("dispatch") \
+        if _profiler._active is not None else None
+    try:
+        out = {path: finish(staged)
+               for (path, finish, _), staged in
+               zip(planned, staged_lists)}
+        # Drain the dispatched kernels before returning: on the
+        # remote-attached TPU, letting async work pile up degrades
+        # every subsequent transfer ~2x (measured 1.16s vs 0.53s over
+        # 8 row groups at 50M values) — the tunnel serializes badly
+        # under a deep queue.  Compute itself is sub-ms; this costs
+        # one sync, and it also fences the finish()-time transfers
+        # sourced from arena slabs.  One batched block_until_ready:
+        # per-buffer syncs are a round trip EACH over the tunnel
+        # (~240 of them across 8 row groups x 5 columns x 6 buffers
+        # cost ~0.6s — the entire e2e-vs-internals gap).
+        jax.block_until_ready(
+            [x for c in out.values() for x in c._buffers()])
+    finally:
+        if ptok is not None:
+            _profiler.stage_end(ptok)
     t2 = time.perf_counter()
     if _flightrec._active is not None:
         _flightrec.flight(
@@ -2965,11 +2983,19 @@ def filtered_pipelined_reads(readers, units, device_for=None,
             dev_ctx = (jax.default_device(device_for(k))
                        if device_for is not None
                        else contextlib.nullcontext())
-            with dev_ctx:
-                out = {path: stage_chunkdata(cd, reader.schema.leaf(path))
-                       for path, cd in chunks.items()}
-                jax.block_until_ready(
-                    [x for c in out.values() for x in c._buffers()])
+            ptok = _profiler.stage_begin("transfer") \
+                if _profiler._active is not None else None
+            try:
+                with dev_ctx:
+                    out = {path: stage_chunkdata(
+                               cd, reader.schema.leaf(path))
+                           for path, cd in chunks.items()}
+                    jax.block_until_ready(
+                        [x for c in out.values()
+                         for x in c._buffers()])
+            finally:
+                if ptok is not None:
+                    _profiler.stage_end(ptok)
             t1 = time.perf_counter()
             if _cs is not None:
                 _cs.transfer_s += t1 - t0

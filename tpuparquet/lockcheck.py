@@ -52,6 +52,7 @@ __all__ = [
     "install",
     "uninstall",
     "installed",
+    "set_wait_hooks",
     "edges",
     "locks_seen",
     "violations",
@@ -78,6 +79,16 @@ _sites: set = set()                 # every creation site seen
 _violations: list[dict] = []
 
 _tls = threading.local()            # .held: list of [site, depth]
+
+#: Wait-edge hooks ``(begin, end)`` the sampling profiler installs
+#: when it arms (:func:`set_wait_hooks`): a CONTENDED acquire — the
+#: non-blocking first attempt failed — is bracketed so off-CPU samples
+#: taken while the thread blocks attribute to this lock's creation
+#: site (the same ``relpath:lineno`` identity the order graph keys
+#: on).  One tuple, swapped atomically, so a reader never sees a
+#: begin without its end.  None when no profiler is armed — the
+#: acquire fast path is then one global load + ``is None``.
+_wait_hooks: tuple | None = None
 
 
 class LockOrderError(RuntimeError):
@@ -199,7 +210,20 @@ class _CheckedLock:
         self._site = site
 
     def acquire(self, blocking: bool = True, timeout: float = -1):
-        got = self._inner.acquire(blocking, timeout)
+        hooks = _wait_hooks
+        if hooks is None or not blocking:
+            got = self._inner.acquire(blocking, timeout)
+        else:
+            # profiler armed: try without blocking first — only a
+            # CONTENDED acquire gets the wait bracket, so uncontended
+            # locks never produce false off-CPU samples
+            got = self._inner.acquire(False)
+            if not got:
+                tok = hooks[0]("lock", self._site)
+                try:
+                    got = self._inner.acquire(True, timeout)
+                finally:
+                    hooks[1](tok)
         if got:
             try:
                 _record_acquire(self._site, self._reentrant)
@@ -250,7 +274,17 @@ class _CheckedRLock(_CheckedLock):
         return state
 
     def _acquire_restore(self, state) -> None:
-        self._inner._acquire_restore(state)
+        hooks = _wait_hooks
+        if hooks is None:
+            self._inner._acquire_restore(state)
+        else:
+            # Condition.wait re-acquire: almost always contended (the
+            # notifier holds the lock), so bracket it unconditionally
+            tok = hooks[0]("lock", self._site)
+            try:
+                self._inner._acquire_restore(state)
+            finally:
+                hooks[1](tok)
         _record_acquire(self._site, True)
 
     def _is_owned(self) -> bool:
@@ -295,6 +329,19 @@ def uninstall() -> None:
 
 def installed() -> bool:
     return _installed
+
+
+def set_wait_hooks(begin, end) -> None:
+    """Install (or clear, with ``None, None``) the profiler's wait
+    bracket around contended acquires.  The profiler calls this when
+    it arms/disarms (``obs.profiler.set_profiling``); lockcheck keeps
+    no dependency on obs — the hooks are opaque callables.  The swap
+    is a single reference assignment (readers grab one snapshot), but
+    it runs under ``_reg_lock`` anyway so two racing arm/disarm calls
+    serialize."""
+    global _wait_hooks
+    with _reg_lock:
+        _wait_hooks = (begin, end) if begin is not None else None
 
 
 def enabled_from_env() -> bool:

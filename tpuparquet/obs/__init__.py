@@ -28,6 +28,13 @@ Two regimes share this package:
 * :mod:`~tpuparquet.obs.progress` — live scan progress
   (units/rows/s/EWMA ETA/stragglers), exported as a JSON status file
   (``TPQ_PROGRESS_EXPORT``) the ``parquet-tool top`` view tails.
+* :mod:`~tpuparquet.obs.profiler` — the background sampling profiler
+  (``TPQ_PROFILE`` / ``TPQ_PROFILE_HZ``): grid-jittered
+  ``sys._current_frames()`` walks tagged with the ambient trace/span,
+  scan label, and stage, off-CPU classification (lock sites, IO
+  waits), mergeable per-(label, stage) stack tries, collapsed-stack /
+  Chrome-trace export (``TPQ_PROFILE_EXPORT``), and the
+  ``parquet-tool flame`` / ``doctor --profile`` consumers.
 * :mod:`~tpuparquet.obs.postmortem` — automatic ``.postmortem.json``
   dumps (trigger coordinates + flight-recorder tail + metrics
   snapshot) beside the durable cursor when quarantine/salvage/
@@ -133,6 +140,18 @@ from .postmortem import (  # noqa: F401
     postmortem_path_for,
     record_incident,
 )
+from .profiler import (  # noqa: F401
+    Profiler,
+    collapsed_lines,
+    diff_states,
+    load_profile_file,
+    merge_profile_states,
+    profile_consistency,
+    set_profiling,
+    top_frames,
+    write_profile_file,
+)
+from .profiler import profiler as sampling_profiler  # noqa: F401
 from .progress import ScanProgress, read_progress_file  # noqa: F401
 # the accessor is re-exported as `flight_recorder` so the package
 # attribute `obs.recorder` stays the MODULE, not the function
@@ -163,6 +182,10 @@ __all__ = [
     "ScanLedger", "ledger", "ledgers_snapshot", "reset_ledgers",
     "stage_seconds", "diagnose", "format_diagnosis",
     "ScanProgress", "read_progress_file",
+    "Profiler", "set_profiling", "sampling_profiler",
+    "merge_profile_states", "write_profile_file",
+    "load_profile_file", "collapsed_lines", "top_frames",
+    "diff_states", "profile_consistency",
     "record_incident", "postmortem_path_for", "load_postmortem",
     "QuantileDigest", "DigestRegistry", "observe", "latency_digests",
     "MetricRing", "load_ring", "tick", "metric_ring",

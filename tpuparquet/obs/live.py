@@ -416,6 +416,15 @@ _exporter_lock = threading.Lock()
 _exporter: threading.Thread | None = None
 _atexit_registered = False
 
+#: Serializes the interpreter-exit flushes of every always-on export
+#: surface: the metrics/timeseries final flush here and the profiler's
+#: atexit export (``obs.profiler.final_flush``) both take this lock,
+#: so one teardown writer can never interleave with — or observe a
+#: half-written frame from — the other.  atexit runs callbacks LIFO
+#: on one thread, but both flushes are also callable directly (tests,
+#: explicit shutdown) from arbitrary threads.
+_flush_lock = threading.Lock()
+
 
 def _metrics_interval() -> float:
     try:
@@ -458,9 +467,10 @@ def _final_flush() -> None:
     metrics file.  Callable directly (tests, explicit shutdown)."""
     from . import timeseries as _timeseries
 
-    export_now()
-    if _timeseries._active is not None:
-        _timeseries.tick("final")
+    with _flush_lock:
+        export_now()
+        if _timeseries._active is not None:
+            _timeseries.tick("final")
 
 
 def maybe_start_exporter() -> None:

@@ -85,6 +85,7 @@ class ScanProgress:
         self.rows_done = 0
         self.bytes_staged = 0
         self.attribution: dict | None = None
+        self.profile: dict | None = None
         self.state = "pending"     # -> running -> done | error | stopped
 
     # -- ticks (called by the scan driver) -------------------------------
@@ -157,6 +158,14 @@ class ScanProgress:
         with self._lock:
             self.attribution = d
 
+    def set_profile(self, d: dict | None) -> None:
+        """Attach the armed sampling profiler's brief (samples/s,
+        off-CPU share, top frame — obs/profiler.py) to the exported
+        frames for the ``top``/``watch`` PROFILE line.  Updated at
+        unit boundaries like :meth:`set_attribution`."""
+        with self._lock:
+            self.profile = d
+
     def finish(self, state: str = "done") -> None:
         with self._lock:
             self.state = state
@@ -203,6 +212,7 @@ class ScanProgress:
             bytes_staged = self.bytes_staged
             inflight = len(self._inflight)
             attribution = self.attribution
+            profile = self.profile
         remaining = max(total - done, 0)
         eta = (remaining * ewma
                if (ewma is not None and state == "running") else None)
@@ -226,6 +236,7 @@ class ScanProgress:
             "eta_s": (None if eta is None else round(eta, 3)),
             "stragglers": self.stragglers(),
             "attribution": attribution,
+            "profile": profile,
         }
 
     # -- export (cross-process channel) -----------------------------------

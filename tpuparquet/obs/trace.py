@@ -60,6 +60,7 @@ import threading
 import time
 from collections import deque
 
+from . import profiler as _profiler
 from .recorder import ThreadSlots
 
 __all__ = [
@@ -220,10 +221,16 @@ def adopt(ctx):
         yield
         return
     token = _ctx.set(ctx)
+    # the profiler mirrors every context transition (contextvars are
+    # unreadable cross-thread, so the sampler needs its own map)
+    if _profiler._active is not None:
+        _profiler.ctx_push(ctx[0], ctx[1], None)
     try:
         yield
     finally:
         _reset(token)
+        if _profiler._active is not None:
+            _profiler.ctx_pop(ctx[0], ctx[1])
 
 
 def _reset(token) -> None:
@@ -256,6 +263,8 @@ def start_trace(label: str, **fields):
     tid = f"{os.getpid():x}-{n}"
     sid = next(tr._span_ids)
     token = _ctx.set((tid, sid))
+    if _profiler._active is not None:
+        _profiler.ctx_push(tid, sid, "scan", label=label)
     return {"trace": tid, "span": sid, "parent": None, "name": "scan",
             "t0": time.perf_counter(), "token": token,
             "fields": {"label": label, **fields}}
@@ -288,6 +297,11 @@ def open_span(name: str, *, push: bool = True, parent=None, **fields):
         return None
     sid = next(tr._span_ids)
     token = _ctx.set((ctx[0], sid)) if push else None
+    if _profiler._active is not None:
+        if push:
+            _profiler.ctx_push(ctx[0], sid, name)
+        else:
+            _profiler.span_note(ctx[0], sid, name)
     return {"trace": ctx[0], "span": sid, "parent": ctx[1],
             "name": name, "t0": time.perf_counter(), "token": token,
             "fields": fields}
@@ -318,6 +332,8 @@ def close_span(handle, status: str = "ok", **fields) -> None:
         if cur is not None and cur[0] == handle["trace"] \
                 and cur[1] == handle["span"]:
             _reset(handle["token"])
+            if _profiler._active is not None:
+                _profiler.ctx_pop(handle["trace"], handle["span"])
     tr = _active
     if tr is None:
         return

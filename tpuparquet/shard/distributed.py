@@ -26,6 +26,7 @@ __all__ = [
     "allgather_stats",
     "allgather_metrics",
     "allgather_digests",
+    "allgather_profiles",
 ]
 
 from .scan import DurableScanMixin as _DurableScanMixin  # noqa: E402
@@ -215,6 +216,30 @@ def allgather_traces(spans=None) -> list[dict]:
             merged.append(s)
     merged.sort(key=lambda s: (s.get("proc", 0), s.get("t0", 0.0)))
     return merged
+
+
+def allgather_profiles(state=None) -> dict:
+    """Fold every host's sampling-profile state
+    (:mod:`tpuparquet.obs.profiler`) into one fleet-wide profile,
+    identical on every process — same wire as
+    :func:`allgather_digests` (exact JSON state over
+    :func:`allgather_bytes`), same exactness: sample counters and
+    per-(label, stage) stack tallies sum elementwise, so the merged
+    profile equals the single-host profile of the union sample set
+    bucket-for-bucket.  ``state`` defaults to this process's armed
+    profiler; an unarmed process contributes an empty state."""
+    import json as _json
+
+    from ..obs import profiler as _profiler
+    from ..obs.profiler import merge_profile_states
+
+    if state is None:
+        p = _profiler.profiler()
+        state = p.to_state() if p is not None else None
+    payloads = allgather_bytes(
+        _json.dumps(state or {}).encode())
+    return merge_profile_states(
+        [_json.loads(pl) for pl in payloads])
 
 
 def allgather_ledgers() -> dict:

@@ -29,6 +29,7 @@ from ..errors import (
 from ..faults import QuarantineReport
 from ..io.reader import FileReader
 from ..obs import digest as _digest
+from ..obs import profiler as _profiler
 from ..obs import recorder as _flightrec
 from ..obs import timeseries as _timeseries
 from ..obs import trace as _trace
@@ -655,6 +656,12 @@ class DurableScanMixin:
             tpath = f"{tpath}.{label_slug(label)}"
         self._trace_export = tpath or None
         self._trace_ctx = None
+        # scan-end profile export (TPQ_PROFILE_EXPORT): same per-label
+        # suffixing as the trace file, for the same two reasons
+        ppath = _profiler.profile_export_default()
+        if ppath and label != "scan":
+            ppath = f"{ppath}.{label_slug(label)}"
+        self._profile_export = ppath or None
         # arm the time-series ring now if TPQ_TIMESERIES_DIR appeared
         # after import, so the scan-end flush below has somewhere to
         # land even for scans shorter than the exporter interval
@@ -698,6 +705,10 @@ class DurableScanMixin:
         delta = None
         if self._live_stats is not None:
             delta = self._live_fold.fold(self._live_stats)
+        # the profile brief rides the progress frame independently of
+        # live metrics: `top` shows PROFILE whenever a sampler is armed
+        if _profiler._active is not None:
+            self.progress.set_profile(_profiler._active.brief())
         led = self._ledger
         if led is None:
             return
@@ -746,6 +757,21 @@ class DurableScanMixin:
                          self._trace_export,
                          ledgers=ledgers_snapshot(),
                          anchor=tr.anchor())
+
+    def _export_profile(self) -> None:
+        """Publish the sampling profile at scan end
+        (``TPQ_PROFILE_EXPORT``, the per-label path resolved at
+        init).  Independent of tracing: a profile without a trace is
+        still a flamegraph.  Best-effort by contract."""
+        p = _profiler._active
+        if p is None or self._profile_export is None:
+            return
+        from ..obs.profiler import write_profile_file
+
+        try:
+            write_profile_file(p.to_state(), self._profile_export)
+        except OSError:
+            pass
 
     def _init_filter(self, filter, readers) -> None:
         """Shared filter plumbing: bind once against the (homogeneous)
@@ -880,6 +906,7 @@ class DurableScanMixin:
             self._finish_telemetry(t_scan, troot, "stopped")
             _trace.end_trace(troot, status="cancelled")
             self._export_trace(troot)
+            self._export_profile()
             raise
         except BaseException:
             prog.finish("error")
@@ -887,6 +914,7 @@ class DurableScanMixin:
             self._finish_telemetry(t_scan, troot, "error")
             _trace.end_trace(troot, status="error")
             self._export_trace(troot)
+            self._export_profile()
             raise
         with self._adopted():
             self._flush_checkpoint()
@@ -895,6 +923,7 @@ class DurableScanMixin:
         self._finish_telemetry(t_scan, troot, "done")
         _trace.end_trace(troot)
         self._export_trace(troot)
+        self._export_profile()
 
     # -- consumer-aligned gathers (scan-level placement default) ---------
 
@@ -1432,9 +1461,17 @@ def _assemble_and_gather(mesh, streams, placement=None,
 
             st = current_stats()
             t0 = time.perf_counter()
-            out = _assemble_direct(placement, streams, n_true, t_parts,
-                                   out_row_shapes)
-            jax.block_until_ready(out)
+            # stage hint: keep sampled gather time inside the same
+            # window the span times (doctor cross-checks the two)
+            ptok = _profiler.stage_begin("gather") \
+                if _profiler._active is not None else None
+            try:
+                out = _assemble_direct(placement, streams, n_true,
+                                       t_parts, out_row_shapes)
+                jax.block_until_ready(out)
+            finally:
+                if ptok is not None:
+                    _profiler.stage_end(ptok)
             t1 = time.perf_counter()
             if st is not None:
                 st.gather_reshard_s += t1 - t0
@@ -1486,15 +1523,22 @@ def _assemble_and_gather(mesh, streams, placement=None,
 
     st = current_stats()
     t0 = time.perf_counter()
-    if placement is None:
-        rep = NamedSharding(mesh, P())
-        out = jax.jit(
-            lambda *xs: xs, out_shardings=tuple(rep for _ in stacked_all)
-        )(*stacked_all)
-    else:
-        out = _place_streams(mesh, stacked_all, placement, perm, n_true,
-                             t_parts, out_row_shapes)
-    jax.block_until_ready(out)
+    ptok = _profiler.stage_begin("gather") \
+        if _profiler._active is not None else None
+    try:
+        if placement is None:
+            rep = NamedSharding(mesh, P())
+            out = jax.jit(
+                lambda *xs: xs,
+                out_shardings=tuple(rep for _ in stacked_all)
+            )(*stacked_all)
+        else:
+            out = _place_streams(mesh, stacked_all, placement, perm,
+                                 n_true, t_parts, out_row_shapes)
+        jax.block_until_ready(out)
+    finally:
+        if ptok is not None:
+            _profiler.stage_end(ptok)
     t1 = time.perf_counter()
     if st is not None:
         st.gather_reshard_s += t1 - t0
