@@ -1,0 +1,81 @@
+"""Subprocess body for the graceful-drain / SIGKILL sweep over the
+scan server (``tests/test_serve.py``).
+
+Hosts a :class:`tpuparquet.serve.ScanServer` with a durable state
+directory, one tenant per input file, and submits one job per tenant
+under a FIXED ``job_id`` so a successor process resumes the same
+cursors.  Each decoded unit is persisted the way a crash-safe
+consumer must: an append-only decode log, then an atomic per-unit
+output file keyed by unit index (tmp + rename) — the
+``tests/checkpoint_child.py`` discipline, per tenant.
+
+``SIGTERM`` triggers the server's graceful drain (admissions stop,
+in-flight scans checkpoint and finish ``drained``); the parent may
+also ``SIGKILL`` at arbitrary points.  Exit 0 when every job ended
+``done``, 3 when any ended ``drained`` (resumable), 1 on failure.
+
+Usage: python tests/serve_child.py <state_dir> <outdir> <file>...
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the interpreter puts tests/ on sys.path (the script's directory);
+# the library lives one level up
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from tpuparquet.serve import ScanServer  # noqa: E402
+
+
+def _sink(outdir: str):
+    """Keyed atomic per-unit writer + decode log for one tenant."""
+    log = os.path.join(outdir, "decode.log")
+
+    def sink(k, out):
+        vals, _rep, _dl = out["a"].to_numpy()
+        arr = np.asarray(vals).ravel()
+        # log the decode, then persist atomically under the unit key
+        # BEFORE the scan checkpoints past it (checkpoint_every=1
+        # checkpoints on the next iteration step)
+        with open(log, "a") as f:
+            f.write(f"{k}\n")
+            f.flush()
+            os.fsync(f.fileno())
+        tmp = os.path.join(outdir, f".unit{k}.tmp.npy")
+        np.save(tmp, arr)
+        os.replace(tmp, os.path.join(outdir, f"unit{k}.npy"))
+
+    return sink
+
+
+def main() -> int:
+    state_dir, outdir = sys.argv[1], sys.argv[2]
+    paths = sys.argv[3:]
+    server = ScanServer(state_dir=state_dir, rebalance_interval=0.1)
+    server.install_signal_handlers()
+    jobs = []
+    for i, path in enumerate(paths):
+        tenant = f"tenant_{i}"
+        tdir = os.path.join(outdir, tenant)
+        os.makedirs(tdir, exist_ok=True)
+        server.add_tenant(tenant)
+        jobs.append(server.submit(
+            tenant, [path], job_id="sweep", checkpoint_every=1,
+            sink=_sink(tdir)))
+    for job in jobs:
+        job.wait()
+    server.shutdown(drain=False)
+    states = {j.state for j in jobs}
+    if states == {"done"}:
+        return 0
+    if "failed" in states:
+        return 1
+    return 3  # drained somewhere: resumable on a successor
+
+
+if __name__ == "__main__":
+    sys.exit(main())

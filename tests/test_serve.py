@@ -1,0 +1,481 @@
+"""The multi-tenant scan server and its resource arbiter: share
+apportionment (anti-starvation floors, bounded adaptive boosts),
+admission control (queue/byte/deadline load-shedding), the
+thread-budget binding, the legacy-knob oversubscription guard, the
+in-process server path (byte-exact vs direct scans, draining
+rejections, greedy-tenant starvation regression), and the
+SIGTERM/SIGKILL graceful-drain sweep: kill a subprocess server at
+arbitrary points, resume on a successor, and the union of decoded
+units must be complete, duplicate-free, and bit-exact.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from tpuparquet import FileWriter
+from tpuparquet.serve import (
+    AdmissionRejected,
+    ResourceArbiter,
+    ScanServer,
+    plan_budget,
+    tenant_scope,
+)
+from tpuparquet.serve import arbiter as _arbiter
+from tpuparquet.shard import ShardedScan
+
+N_RG = 3
+N = 120
+
+
+def write_file(path, n_rg: int = N_RG, base: int = 0) -> None:
+    buf = io.BytesIO()
+    w = FileWriter(buf, "message m { required int64 a; }")
+    for rg in range(n_rg):
+        lo = base + rg * N
+        w.write_columns({"a": np.arange(lo, lo + N, dtype=np.int64)})
+    w.close()
+    with open(path, "wb") as f:
+        f.write(buf.getvalue())
+
+
+def unit_values(out) -> np.ndarray:
+    vals, _rep, _dl = out["a"].to_numpy()
+    return np.asarray(vals).ravel()
+
+
+# ----------------------------------------------------------------------
+# Share apportionment
+# ----------------------------------------------------------------------
+
+class TestShares:
+    def test_equal_weights_split_evenly(self):
+        arb = ResourceArbiter(total_workers=8)
+        for i in range(4):
+            arb.register(f"t{i}")
+        assert arb.shares() == {f"t{i}": 2 for i in range(4)}
+
+    def test_weighted_shares_sum_to_budget(self):
+        arb = ResourceArbiter(total_workers=10)
+        arb.register("heavy", weight=3.0)
+        arb.register("light", weight=1.0)
+        s = arb.shares()
+        assert sum(s.values()) == 10
+        assert s["heavy"] > s["light"] >= 1
+
+    def test_floor_when_workers_scarce(self):
+        # more tenants than workers: bounded oversubscription, one
+        # worker each — never zero
+        arb = ResourceArbiter(total_workers=2)
+        for i in range(5):
+            arb.register(f"t{i}")
+        assert arb.shares() == {f"t{i}": 1 for i in range(5)}
+
+    def test_greedy_tenant_cannot_starve_others(self):
+        # the starvation regression: one adversarial tenant with a
+        # huge weight is clamped to the budget minus the floors
+        arb = ResourceArbiter(total_workers=8)
+        arb.register("greedy", weight=10_000.0)
+        for i in range(3):
+            arb.register(f"meek{i}", weight=1.0)
+        s = arb.shares()
+        assert sum(s.values()) == 8
+        for i in range(3):
+            assert s[f"meek{i}"] >= 1
+        assert s["greedy"] == 8 - sum(s[f"meek{i}"] for i in range(3))
+
+    def test_unregister_recomputes(self):
+        arb = ResourceArbiter(total_workers=4)
+        arb.register("a")
+        arb.register("b")
+        arb.unregister("b")
+        assert arb.shares() == {"a": 4}
+
+    def test_adaptive_boosts_are_bounded(self):
+        # a pathological tenant (astronomical burn + p99 violation +
+        # plan-bound) still cannot push any other tenant below its
+        # floor, and the shares still sum to the budget exactly
+        arb = ResourceArbiter(total_workers=8)
+        arb.register("hot", latency_target_ms=1.0)
+        arb.register("cold")
+        with arb._lock:
+            t = arb._tenants["hot"]
+            t.last_burn = 1e12
+            t.last_bound = "plan-bound"
+            t.last_p99_ms = 1e9
+            arb._recompute_locked()
+        s = arb.shares()
+        assert sum(s.values()) == 8
+        assert s["cold"] >= 1
+        assert s["hot"] > s["cold"]
+
+
+# ----------------------------------------------------------------------
+# Admission control
+# ----------------------------------------------------------------------
+
+class TestAdmission:
+    def test_unknown_tenant_is_an_error(self):
+        arb = ResourceArbiter(total_workers=2)
+        with pytest.raises(KeyError):
+            arb.admit("ghost")
+
+    def test_queue_full_sheds_load(self):
+        arb = ResourceArbiter(total_workers=2)
+        arb.register("t")
+        with pytest.raises(AdmissionRejected) as ei:
+            arb.admit("t", queue_depth=3, queue_bound=3)
+        assert ei.value.reason == "queue_full"
+        assert ei.value.tenant == "t"
+        assert ei.value.retry_after_s > 0
+
+    def test_byte_budget_exhaustion(self):
+        arb = ResourceArbiter(total_workers=2)
+        arb.register("t", byte_budget=100)
+        arb.admit("t", est_bytes=60)
+        with pytest.raises(AdmissionRejected) as ei:
+            arb.admit("t", est_bytes=60)
+        assert ei.value.reason == "byte_budget"
+        # a retracted admission refunds the byte account
+        arb.retract("t", 60)
+        arb.admit("t", est_bytes=60)
+
+    def test_deadline_budget_sheds_doomed_jobs(self):
+        arb = ResourceArbiter(total_workers=2)
+        arb.register("t")
+        # no duration history yet: deadline admission cannot price the
+        # backlog, so it must admit
+        arb.admit("t", deadline_s=0.001)
+        arb.note_job_done("t", 10.0)
+        with pytest.raises(AdmissionRejected) as ei:
+            arb.admit("t", deadline_s=5.0, queue_depth=2,
+                      queue_bound=8)
+        assert ei.value.reason == "deadline_budget"
+        # a roomy deadline still admits against the same backlog
+        arb.admit("t", deadline_s=100.0, queue_depth=2, queue_bound=8)
+
+    def test_rejections_are_counted(self):
+        arb = ResourceArbiter(total_workers=2)
+        arb.register("t")
+        with pytest.raises(AdmissionRejected):
+            arb.admit("t", queue_depth=1, queue_bound=1)
+        assert arb.tenants_state()["t"]["rejected"] == 1
+
+
+# ----------------------------------------------------------------------
+# Activation + thread binding → thread budgets
+# ----------------------------------------------------------------------
+
+class TestBinding:
+    def test_plan_budget_reads_the_bound_tenants_share(self):
+        assert plan_budget() is None  # no arbiter active
+        arb = ResourceArbiter(total_workers=6)
+        arb.register("a", weight=2.0)
+        arb.register("b", weight=1.0)
+        _arbiter.activate(arb)
+        try:
+            assert plan_budget() is None  # active but unbound
+            with tenant_scope("a"):
+                assert plan_budget() == arb.share_of("a")
+                with tenant_scope("b"):  # re-entrant
+                    assert plan_budget() == arb.share_of("b")
+                assert plan_budget() == arb.share_of("a")
+            assert plan_budget() is None  # restored
+        finally:
+            _arbiter.deactivate(arb)
+        assert plan_budget() is None
+
+    def test_plan_threads_bounded_by_shares_not_cores(self, monkeypatch):
+        # the PLAN_SCALE_r06 fix, pinned at the mechanism: with N
+        # tenants under one arbiter, each tenant's plan pool sizes to
+        # its SHARE, so the total planner-thread budget across all
+        # tenants equals the arbiter budget — not N x cores the way
+        # raw per-scan TPQ_PLAN_THREADS sizing oversubscribed
+        from tpuparquet.io.writer import _write_threads
+        from tpuparquet.kernels.device import _plan_threads
+
+        monkeypatch.setenv("TPQ_PLAN_THREADS", "64")
+        monkeypatch.setenv("TPQ_WRITE_THREADS", "64")
+        arb = ResourceArbiter(total_workers=4)
+        labels = [f"t{i}" for i in range(4)]
+        for lb in labels:
+            arb.register(lb)
+        _arbiter.activate(arb)
+        try:
+            totals = 0
+            for lb in labels:
+                with tenant_scope(lb):
+                    got = _plan_threads()
+                    assert got == arb.share_of(lb)
+                    assert _write_threads() == arb.share_of(lb)
+                    totals += got
+            assert totals == 4  # == the budget, not 4 x 64
+            # unbound threads (direct scans) still obey the env knob
+            assert _plan_threads() == 64
+        finally:
+            _arbiter.deactivate(arb)
+
+    def test_second_arbiter_cannot_activate(self):
+        a, b = ResourceArbiter(total_workers=1), \
+            ResourceArbiter(total_workers=1)
+        _arbiter.activate(a)
+        try:
+            with pytest.raises(RuntimeError):
+                _arbiter.activate(b)
+            _arbiter.activate(a)  # idempotent for the same instance
+        finally:
+            _arbiter.deactivate(a)
+
+
+# ----------------------------------------------------------------------
+# Legacy-knob oversubscription guard
+# ----------------------------------------------------------------------
+
+class TestOversubscriptionGuard:
+    def test_warns_once_and_publishes_the_gauge(self, monkeypatch):
+        from tpuparquet.obs import live
+
+        cores = _arbiter._usable_cpus()
+        monkeypatch.setenv("TPQ_PLAN_THREADS", str(cores + 3))
+        monkeypatch.setenv("TPQ_WRITE_THREADS", str(cores))
+        _arbiter._reset_oversub_warning()
+        live.reset_registry()
+        try:
+            with pytest.warns(RuntimeWarning, match="exceeds"):
+                excess = _arbiter.warn_if_oversubscribed()
+            assert excess == cores + 3
+            gauges = live.registry().snapshot()["gauges"]
+            assert gauges["threads_oversubscribed"] == float(excess)
+            # one-shot: the second call stays silent (but still
+            # returns the excess and refreshes the gauge)
+            import warnings as _w
+            with _w.catch_warnings():
+                _w.simplefilter("error")
+                assert _arbiter.warn_if_oversubscribed() == excess
+        finally:
+            _arbiter._reset_oversub_warning()
+            live.reset_registry()
+
+    def test_silent_when_within_budget_or_unset(self, monkeypatch):
+        _arbiter._reset_oversub_warning()
+        monkeypatch.delenv("TPQ_PLAN_THREADS", raising=False)
+        monkeypatch.delenv("TPQ_WRITE_THREADS", raising=False)
+        assert _arbiter.warn_if_oversubscribed() == 0
+        monkeypatch.setenv("TPQ_PLAN_THREADS", "1")
+        assert _arbiter.warn_if_oversubscribed() == 0  # writer unset
+        monkeypatch.setenv("TPQ_WRITE_THREADS", "bogus")
+        assert _arbiter.warn_if_oversubscribed() == 0  # malformed
+
+
+# ----------------------------------------------------------------------
+# The in-process server path
+# ----------------------------------------------------------------------
+
+class TestScanServer:
+    def test_server_outputs_match_direct_scans(self, tmp_path):
+        paths = {}
+        for i in range(2):
+            p = str(tmp_path / f"t{i}.parquet")
+            write_file(p, base=i * 1_000_000)
+            paths[f"tenant_{i}"] = p
+        with ScanServer(arbiter=ResourceArbiter(total_workers=2)) as srv:
+            jobs = {}
+            for lb, p in paths.items():
+                srv.add_tenant(lb)
+                jobs[lb] = srv.submit(lb, [p])
+            for lb, job in jobs.items():
+                assert job.wait(120), f"{lb} never finished"
+                assert job.state == "done", job.as_dict()
+            for lb, p in paths.items():
+                expected = {k: unit_values(out)
+                            for k, out in ShardedScan([p]).run_iter()}
+                got = jobs[lb].outputs
+                assert sorted(got) == sorted(expected)
+                for k in expected:
+                    np.testing.assert_array_equal(
+                        unit_values(got[k]), expected[k])
+                assert jobs[lb].units_done == N_RG
+                assert jobs[lb].units_quarantined == 0
+
+    def test_draining_server_rejects_submissions(self, tmp_path):
+        p = str(tmp_path / "f.parquet")
+        write_file(p)
+        srv = ScanServer(arbiter=ResourceArbiter(total_workers=1))
+        try:
+            srv.add_tenant("t")
+            srv.request_drain()
+            with pytest.raises(AdmissionRejected) as ei:
+                srv.submit("t", [p])
+            assert ei.value.reason == "draining"
+            assert ei.value.retry_after_s > 0
+        finally:
+            srv.shutdown()
+
+    def test_greedy_tenant_cannot_starve_the_meek(self, tmp_path):
+        # the end-to-end starvation regression: a heavy tenant with a
+        # deep queue of jobs must not keep a light tenant's single
+        # job from completing, and the light tenant keeps its floor
+        gp = str(tmp_path / "g.parquet")
+        mp = str(tmp_path / "m.parquet")
+        write_file(gp)
+        write_file(mp, base=5_000_000)
+        with ScanServer(arbiter=ResourceArbiter(total_workers=4),
+                        queue_bound=8) as srv:
+            srv.add_tenant("greedy", weight=10_000.0)
+            srv.add_tenant("meek", weight=1.0)
+            greedy_jobs = [srv.submit("greedy", [gp],
+                                      job_id=f"g{i}")
+                           for i in range(4)]
+            meek = srv.submit("meek", [mp])
+            assert meek.wait(120) and meek.state == "done"
+            assert srv.status()["shares"]["meek"] >= 1
+            for j in greedy_jobs:
+                assert j.wait(120) and j.state == "done"
+        expected = {k: unit_values(out)
+                    for k, out in ShardedScan([mp]).run_iter()}
+        for k in expected:
+            np.testing.assert_array_equal(
+                unit_values(meek.outputs[k]), expected[k])
+
+    def test_queue_bound_sheds_load(self, tmp_path):
+        p = str(tmp_path / "f.parquet")
+        write_file(p)
+        srv = ScanServer(arbiter=ResourceArbiter(total_workers=1),
+                         queue_bound=1)
+        try:
+            srv.add_tenant("t")
+            jobs = []
+            rejected = None
+            # depth counts queued + running; a bound of 1 rejects by
+            # the third rapid submission at the latest
+            for i in range(3):
+                try:
+                    jobs.append(srv.submit("t", [p], job_id=f"j{i}"))
+                except AdmissionRejected as e:
+                    rejected = e
+            assert rejected is not None
+            assert rejected.reason == "queue_full"
+            for j in jobs:
+                assert j.wait(120) and j.state == "done"
+        finally:
+            srv.shutdown()
+
+
+# ----------------------------------------------------------------------
+# Graceful-drain / SIGKILL sweep (subprocess)
+# ----------------------------------------------------------------------
+
+CHILD = os.path.join(os.path.dirname(__file__), "serve_child.py")
+N_TENANTS = 2
+
+
+def _child_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("TPQ_RETRY_BASE_S", "0.001")
+    env.setdefault("TPQ_RETRY_MAX_S", "0.002")
+    return env
+
+
+def _spawn(state_dir, outdir, paths):
+    return subprocess.Popen(
+        [sys.executable, CHILD, str(state_dir), str(outdir)] + paths,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(CHILD))),
+        env=_child_env(), stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL)
+
+
+def _unit_files(outdir, tenant):
+    tdir = os.path.join(str(outdir), tenant)
+    if not os.path.isdir(tdir):
+        return []
+    return sorted((f for f in os.listdir(tdir)
+                   if f.startswith("unit") and f.endswith(".npy")),
+                  key=lambda s: int(s[4:-4]))
+
+
+def _total_units(outdir):
+    return sum(len(_unit_files(outdir, f"tenant_{i}"))
+               for i in range(N_TENANTS))
+
+
+class TestDrainResumeSweep:
+    """SIGTERM (graceful drain) then SIGKILL (hard crash) a subprocess
+    scan server mid-flight; each successor resumes every tenant's
+    durable cursor; the per-tenant union of keyed outputs must be
+    complete, duplicate-free, and bit-exact vs a direct-scan oracle."""
+
+    def test_drain_kill_resume_union_exact(self, tmp_path):
+        paths = []
+        for i in range(N_TENANTS):
+            p = str(tmp_path / f"f{i}.parquet")
+            write_file(p, base=i * 100_000)
+            paths.append(p)
+        outdir = tmp_path / "out"
+        outdir.mkdir()
+        state_dir = tmp_path / "state"
+        total = N_TENANTS * N_RG
+        kills = 0
+        deadline = time.monotonic() + 300
+
+        # round 1: SIGTERM once the first unit lands → graceful drain
+        # (cursors flushed, exit 3 = resumable)
+        proc = _spawn(state_dir, outdir, paths)
+        while (_total_units(outdir) < 1 and proc.poll() is None
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            kills += 1
+        rc = proc.wait(timeout=120)
+        assert rc in (0, 3), f"drain run exited {rc}"
+
+        # round 2: SIGKILL mid-flight on the successor → hard crash
+        if _total_units(outdir) < total:
+            before = _total_units(outdir)
+            proc = _spawn(state_dir, outdir, paths)
+            while (_total_units(outdir) < before + 1
+                   and proc.poll() is None
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGKILL)
+                kills += 1
+            proc.wait(timeout=120)
+
+        # final uninterrupted successor completes every tenant
+        proc = _spawn(state_dir, outdir, paths)
+        assert proc.wait(timeout=240) == 0
+
+        for i in range(N_TENANTS):
+            tenant = f"tenant_{i}"
+            # complete + duplicate-free: keyed files, every unit once
+            assert _unit_files(outdir, tenant) == \
+                [f"unit{k}.npy" for k in range(N_RG)]
+            # bit-exact vs the direct-scan oracle
+            expected = {k: unit_values(out) for k, out in
+                        ShardedScan([paths[i]]).run_iter()}
+            for k in range(N_RG):
+                got = np.load(os.path.join(
+                    str(outdir), tenant, f"unit{k}.npy"))
+                np.testing.assert_array_equal(
+                    got, expected[k], err_msg=f"{tenant} unit {k}")
+            # the at-least-once window: with checkpoint_every=1 each
+            # kill forces at most ONE re-decode per tenant (the unit
+            # consumed but not yet checkpointed); a graceful drain
+            # flushes the cursor and forces none
+            with open(os.path.join(str(outdir), tenant,
+                                   "decode.log")) as f:
+                decoded = [int(line) for line in f if line.strip()]
+            counts = {k: decoded.count(k) for k in set(decoded)}
+            assert sorted(counts) == list(range(N_RG))
+            re_decodes = sum(c - 1 for c in counts.values())
+            assert re_decodes <= kills, (tenant, decoded)

@@ -73,6 +73,9 @@ REMOTE_TENANT = 3
 REMOTE_THROTTLE_EVERY = "5"
 UNIT_DEADLINE_S = 0.2
 HANG_S = 5.0
+#: the serve leg's per-job deadline budget — "no tenant starved"
+#: means every tenant's job completes inside this
+SERVE_SCAN_DEADLINE_S = 300.0
 
 
 def tenant_label(i: int) -> str:
@@ -207,14 +210,237 @@ def run_leg(corpus: dict[str, list[str]], *, telemetry: bool,
     return results
 
 
+def run_serve_leg(corpus: dict[str, list[str]], *, ring_dir: str,
+                  state_dir: str) -> tuple[dict, dict]:
+    """The server-path leg: the SAME tenants, fault plan and
+    telemetry surfaces as the raw telemetry-on leg, but every scan is
+    submitted through a :class:`tpuparquet.serve.ScanServer` — shared
+    arbiter, admission control, per-tenant queues, durable cursors.
+    Returns ``(per-label results, server meta)``; the raw leg is the
+    control its outputs must match byte-for-byte."""
+    from tpuparquet.faults import inject_faults
+    from tpuparquet.obs import attribution, live
+    from tpuparquet.obs import digest as _digest
+    from tpuparquet.obs import timeseries as _timeseries
+    from tpuparquet.serve import ScanServer
+
+    live.reset_registry()
+    attribution.reset_ledgers()
+    _digest.set_digests(True)
+    _timeseries.set_ring_dir(ring_dir)
+    results: dict[str, dict] = {}
+    with inject_faults() as inj:
+        _arm_rules(inj, corpus)
+        server = ScanServer(state_dir=state_dir,
+                            rebalance_interval=0.2)
+        try:
+            for lb in sorted(corpus):
+                server.add_tenant(lb, error_rate_target=0.001,
+                                  latency_target_ms=1000.0)
+            jobs = {}
+            for lb, paths in sorted(corpus.items()):
+                idx = int(lb.rsplit("_", 1)[1])
+                if idx == REMOTE_TENANT:
+                    paths = ["emu://" + p for p in paths]
+                jobs[lb] = server.submit(
+                    lb, paths, job_id="soak",
+                    unit_deadline=(UNIT_DEADLINE_S
+                                   if idx == DEADLINE_TENANT
+                                   else None),
+                    scan_deadline=SERVE_SCAN_DEADLINE_S)
+            for lb, job in jobs.items():
+                if not job.wait(SERVE_SCAN_DEADLINE_S + 60):
+                    raise RuntimeError(
+                        f"serve leg: {lb} never reached a terminal "
+                        f"state")
+            meta = {"shares": server.status()["shares"],
+                    "total_workers": server.status()["total_workers"]}
+            for lb, job in jobs.items():
+                st = job.stats
+                out = [job.outputs[k] for k in sorted(job.outputs)]
+                results[lb] = {
+                    "digest": _output_digest(out),
+                    "state": job.state,
+                    "error": (repr(job.error)
+                              if job.error is not None else None),
+                    "units_done": job.units_done,
+                    "units_quarantined": job.units_quarantined,
+                    "quarantine": (len(job.quarantine)
+                                   if job.quarantine is not None
+                                   else 0),
+                    "remote_ranges_fetched": (
+                        st.remote_ranges_fetched if st else 0),
+                    "remote_retry": st.remote_retry if st else 0,
+                }
+        finally:
+            server.shutdown()
+    return results, meta
+
+
+def _soak_rules(labels: list[str]) -> list:
+    """The alert-coverage rule set both the raw and serve legs are
+    held to: one rule per injected fault class, a burn-rate rule on
+    the corrupt tenant, clean-tenant silence rules, and an absence
+    rule that must stay quiet against a live ring."""
+    from tpuparquet.obs.alerts import AlertRule
+
+    t_corrupt = tenant_label(CORRUPT_TENANT)
+    t_deadline = tenant_label(DEADLINE_TENANT)
+    week = 7 * 24 * 3600.0
+    rules = [
+        AlertRule("corrupt_pages", "threshold", label=t_corrupt,
+                  counter="units_quarantined", value=1, window_s=week),
+        AlertRule("deadline_expiries", "threshold", label=t_deadline,
+                  counter="deadline_exceeded", value=1, window_s=week),
+        AlertRule("budget_burn", "burn_rate", label=t_corrupt,
+                  error_rate_target=0.001, threshold=1.0),
+        AlertRule("telemetry_absent", "absence", window_s=week),
+    ]
+    for lb in labels:
+        if lb not in (t_corrupt, t_deadline):
+            rules.append(AlertRule(
+                f"clean_{lb}", "threshold", label=lb,
+                counter="units_quarantined", value=1, window_s=week))
+    return rules
+
+
+def _alert_failures(labels: list[str], ring_dir: str,
+                    alerts_path: str, leg: str) -> list[str]:
+    """Alert coverage over one leg's ring: every fault class fires
+    its rule, zero false alerts from the clean/absence rules."""
+    from tpuparquet.obs.alerts import AlertEngine
+    from tpuparquet.obs.timeseries import load_ring
+
+    bad: list[str] = []
+    frames = load_ring(ring_dir)
+    if not frames:
+        return [f"{leg}: time-series ring {ring_dir} is empty"]
+    t_corrupt = tenant_label(CORRUPT_TENANT)
+    t_deadline = tenant_label(DEADLINE_TENANT)
+    engine = AlertEngine(_soak_rules(labels), record_path=alerts_path)
+    firing = {a["name"] for a in engine.evaluate(frames)}
+    for required in ("corrupt_pages", "deadline_expiries",
+                     "budget_burn"):
+        if required not in firing:
+            bad.append(f"{leg}: fault class behind rule {required!r} "
+                       f"did not fire its alert (false negative)")
+    for lb in labels:
+        if lb not in (t_corrupt, t_deadline) \
+                and f"clean_{lb}" in firing:
+            bad.append(f"{leg}: clean tenant {lb} fired a quarantine "
+                       f"alert (false positive)")
+    if "telemetry_absent" in firing:
+        bad.append(f"{leg}: absence rule fired against a live ring "
+                   f"(false positive)")
+    return bad
+
+
+def _conservation_failures(labels: list[str], units_done: dict,
+                           leg: str) -> list[str]:
+    """Digest + ledger conservation over the CURRENT process
+    telemetry state: per-label unit digests carry exactly one
+    observation per driven unit and merge to the process total, and
+    per-label ledger counters sum to the registry totals exactly."""
+    from tpuparquet.obs import attribution, live
+    from tpuparquet.obs import digest as _digest
+    from tpuparquet.obs.digest import QuantileDigest
+
+    bad: list[str] = []
+    reg = _digest.digests()
+    snap = {} if reg is None else reg.snapshot()
+    total = QuantileDigest()
+    n_units = 0
+    for lb in labels:
+        g = snap.get((lb, "unit"))
+        done = units_done[lb]
+        n_units += done
+        if g is None:
+            bad.append(f"{leg}: no unit digest for {lb}")
+            continue
+        if g.n != done:
+            bad.append(f"{leg}: unit digest of {lb} has n={g.n}, "
+                       f"scan drove {done} units")
+        total.merge_from(g)
+    if total.n != n_units:
+        bad.append(f"{leg}: merged per-label digests n={total.n} != "
+                   f"process total {n_units}")
+    counters = live.registry().snapshot()["counters"]
+    led_sums: dict = {}
+    for state in attribution.ledgers_state().values():
+        for k, v in (state.get("counters") or {}).items():
+            led_sums[k] = led_sums.get(k, 0) + v
+    for key in ("row_groups", "pages", "values", "units_quarantined",
+                "deadline_exceeded"):
+        if led_sums.get(key, 0) != counters.get(key, 0):
+            bad.append(f"{leg}: ledger sum of {key} "
+                       f"({led_sums.get(key, 0)}) != registry total "
+                       f"({counters.get(key, 0)})")
+    return bad
+
+
+def check_serve(corpus: dict[str, list[str]], serve: dict, meta: dict,
+                on: dict, ring_dir: str, alerts_path: str,
+                remote_control: str) -> list[str]:
+    """The serve-leg contract: byte-identical to the raw control leg,
+    no tenant starved, exact accounting, zero false alerts, fair
+    shares."""
+    bad: list[str] = []
+    labels = sorted(corpus)
+    t_remote = tenant_label(REMOTE_TENANT)
+
+    # -- no tenant starved: every job completed within its deadline
+    #    budget (a starved tenant fails its scan_deadline or never
+    #    reaches "done") ------------------------------------------------
+    for lb in labels:
+        if serve[lb]["state"] != "done":
+            bad.append(f"serve: tenant {lb} ended "
+                       f"{serve[lb]['state']!r} "
+                       f"({serve[lb].get('error')}) — starved or "
+                       f"failed within its deadline budget")
+
+    # -- server path is byte-identical to the raw control leg ----------
+    for lb in labels:
+        if serve[lb]["digest"] != on[lb]["digest"]:
+            bad.append(f"serve: output of {lb} differs from the "
+                       f"direct ShardedScan control leg")
+        if serve[lb]["units_quarantined"] != on[lb]["units_quarantined"]:
+            bad.append(f"serve: quarantine count of {lb} differs "
+                       f"from the control leg (fault plan not "
+                       f"deterministic through the server)")
+
+    # -- remote tenant still equivalent through the server -------------
+    if not serve[t_remote]["remote_retry"]:
+        bad.append("serve: remote tenant saw no throttle retries — "
+                   "the emulated-429 plan did not fire")
+    if serve[t_remote]["digest"] != remote_control:
+        bad.append("serve: remote tenant output differs from the "
+                   "local control read")
+
+    # -- fair shares: anti-starvation floors held ----------------------
+    shares = meta.get("shares") or {}
+    for lb in labels:
+        if shares.get(lb, 0) < 1:
+            bad.append(f"serve: tenant {lb} share is "
+                       f"{shares.get(lb, 0)} — the anti-starvation "
+                       f"floor (>= 1 worker) was violated")
+    total = meta.get("total_workers") or 0
+    if total >= len(labels) and sum(shares.values()) > total:
+        bad.append(f"serve: shares {shares} oversubscribe the "
+                   f"{total}-worker budget")
+
+    bad += _alert_failures(labels, ring_dir, alerts_path, "serve")
+    bad += _conservation_failures(
+        labels, {lb: serve[lb]["units_done"] for lb in labels},
+        "serve")
+    return bad
+
+
 def check_soak(corpus: dict[str, list[str]], on: dict, off: dict,
                ring_dir: str, alerts_path: str,
                remote_control: str) -> list[str]:
     """Every assertion of the soak contract; returns failure strings
     (empty = pass)."""
-    from tpuparquet.obs import attribution, live
     from tpuparquet.obs import digest as _digest
-    from tpuparquet.obs.alerts import AlertEngine, AlertRule
     from tpuparquet.obs.digest import QuantileDigest
     from tpuparquet.obs.timeseries import load_ring
 
@@ -258,64 +484,18 @@ def check_soak(corpus: dict[str, list[str]], on: dict, off: dict,
                    "byte-identical)")
 
     # -- alert coverage: one rule per fault class + clean/absence ------
-    frames = load_ring(ring_dir)
-    if not frames:
-        bad.append(f"time-series ring {ring_dir} is empty")
-        return bad
-    week = 7 * 24 * 3600.0
-    rules = [
-        AlertRule("corrupt_pages", "threshold", label=t_corrupt,
-                  counter="units_quarantined", value=1, window_s=week),
-        AlertRule("deadline_expiries", "threshold", label=t_deadline,
-                  counter="deadline_exceeded", value=1, window_s=week),
-        AlertRule("budget_burn", "burn_rate", label=t_corrupt,
-                  error_rate_target=0.001, threshold=1.0),
-        AlertRule("telemetry_absent", "absence", window_s=week),
-    ]
-    for lb in labels:
-        if lb not in (t_corrupt, t_deadline):
-            rules.append(AlertRule(
-                f"clean_{lb}", "threshold", label=lb,
-                counter="units_quarantined", value=1, window_s=week))
-    engine = AlertEngine(rules, record_path=alerts_path)
-    firing = {a["name"] for a in engine.evaluate(frames)}
-    for required in ("corrupt_pages", "deadline_expiries",
-                     "budget_burn"):
-        if required not in firing:
-            bad.append(f"fault class behind rule {required!r} did "
-                       f"not fire its alert (false negative)")
-    for lb in labels:
-        if lb not in (t_corrupt, t_deadline) \
-                and f"clean_{lb}" in firing:
-            bad.append(f"clean tenant {lb} fired a quarantine alert "
-                       f"(false positive)")
-    if "telemetry_absent" in firing:
-        bad.append("absence rule fired against a live ring "
-                   "(false positive)")
+    bad += _alert_failures(labels, ring_dir, alerts_path, "soak")
 
-    # -- digest conservation: one observation per unit, exact sums -----
+    # -- digest + ledger conservation under the ring feed --------------
+    bad += _conservation_failures(
+        labels, {lb: on[lb]["units_done"] for lb in labels}, "soak")
+
+    # -- the last ring frame's digest state equals the in-process
+    #    state bucket-for-bucket ----------------------------------------
+    frames = load_ring(ring_dir)
     reg = _digest.digests()
     snap = {} if reg is None else reg.snapshot()
-    total = QuantileDigest()
-    n_units = 0
-    for lb in labels:
-        g = snap.get((lb, "unit"))
-        done = on[lb]["units_done"]
-        n_units += done
-        if g is None:
-            bad.append(f"no unit digest for {lb}")
-            continue
-        if g.n != done:
-            bad.append(f"unit digest of {lb} has n={g.n}, scan drove "
-                       f"{done} units")
-        total.merge_from(g)
-    if total.n != n_units:
-        bad.append(f"merged per-label digests n={total.n} != process "
-                   f"total {n_units}")
-    if sum(total.counts.values()) != total.n:
-        bad.append("merged digest bucket counts do not sum to n")
-    # the last ring frame's digest state equals the in-process state
-    last_digests = frames[-1].get("digests") or {}
+    last_digests = (frames[-1].get("digests") or {}) if frames else {}
     for lb in labels:
         g = snap.get((lb, "unit"))
         ring_d = (last_digests.get(lb) or {}).get("unit")
@@ -325,18 +505,6 @@ def check_soak(corpus: dict[str, list[str]], on: dict, off: dict,
                     or rd.total != g.total:
                 bad.append(f"ring-frame digest of {lb} differs from "
                            f"the in-process digest bucket-for-bucket")
-
-    # -- ledger conservation under the ring feed -----------------------
-    counters = live.registry().snapshot()["counters"]
-    led_sums: dict = {}
-    for state in attribution.ledgers_state().values():
-        for k, v in (state.get("counters") or {}).items():
-            led_sums[k] = led_sums.get(k, 0) + v
-    for key in ("row_groups", "pages", "values", "units_quarantined",
-                "deadline_exceeded"):
-        if led_sums.get(key, 0) != counters.get(key, 0):
-            bad.append(f"ledger sum of {key} ({led_sums.get(key, 0)}) "
-                       f"!= registry total ({counters.get(key, 0)})")
     return bad
 
 
@@ -379,6 +547,13 @@ def main(argv=None) -> int:
                          "seeded interleaving perturbation at each "
                          "fault site + an aggressive switch interval "
                          "(the assertions must hold unchanged)")
+    ap.add_argument("--serve", action="store_true",
+                    help="add a fourth leg that drives the same "
+                         "tenant corpus through tpuparquet.serve."
+                         "ScanServer and asserts the server path is "
+                         "byte-identical to the direct-scan control, "
+                         "no tenant starves, and the per-tenant "
+                         "accounting stays exact")
     args = ap.parse_args(argv)
     if args.scans < 4:
         print("soak: --scans must be >= 4 (corrupt + deadline + "
@@ -397,11 +572,13 @@ def main(argv=None) -> int:
 
         from tpuparquet.faults import chaos_scope
 
-        scope = (chaos_scope(args.chaos_seed)
-                 if args.chaos_seed is not None
-                 else contextlib.nullcontext())
+        def _scope():
+            return (chaos_scope(args.chaos_seed)
+                    if args.chaos_seed is not None
+                    else contextlib.nullcontext())
+
         corpus = build_corpus(root, args.scans, args.rows, args.units)
-        with scope:
+        with _scope():
             remote_control = _control_digest(
                 corpus[tenant_label(REMOTE_TENANT)])
             # telemetry-off leg FIRST: it must not see the ring/digest
@@ -411,6 +588,21 @@ def main(argv=None) -> int:
         failures = check_soak(corpus, on, off, ring_dir, alerts_path,
                               remote_control)
         failures += _lockcheck_failures()
+        serve = None
+        smeta: dict = {}
+        if args.serve:
+            serve_ring = os.path.join(root, "ring-serve")
+            serve_alerts = os.path.join(root, "alerts-serve.json")
+            serve_state = os.path.join(root, "serve-state")
+            # a fresh chaos scope: the serve leg must hold the same
+            # contract under its own seeded interleaving
+            with _scope():
+                serve, smeta = run_serve_leg(
+                    corpus, ring_dir=serve_ring, state_dir=serve_state)
+            failures += check_serve(corpus, serve, smeta, on,
+                                    serve_ring, serve_alerts,
+                                    remote_control)
+            failures += _lockcheck_failures()
         result = {
             "scans": args.scans,
             "units_per_scan": args.units,
@@ -420,6 +612,14 @@ def main(argv=None) -> int:
             "failures": failures,
             "ok": not failures,
         }
+        if serve is not None:
+            result["serve"] = {
+                "shares": smeta.get("shares"),
+                "total_workers": smeta.get("total_workers"),
+                "tenants": {lb: {k: v for k, v in serve[lb].items()
+                                 if k != "digest"}
+                            for lb in sorted(serve)},
+            }
         if args.json:
             print(json.dumps(result, sort_keys=True))
         else:
@@ -427,6 +627,12 @@ def main(argv=None) -> int:
                 r = on[lb]
                 print(f"{lb}: {r['units_done']} units, "
                       f"{r['units_quarantined']} quarantined")
+            if serve is not None:
+                for lb in sorted(serve):
+                    r = serve[lb]
+                    print(f"serve {lb}: {r['state']}, "
+                          f"{r['units_done']} units, share "
+                          f"{(smeta.get('shares') or {}).get(lb)}")
             for f in failures:
                 print(f"FAIL: {f}", file=sys.stderr)
             print(f"soak {'PASS' if not failures else 'FAIL'} "

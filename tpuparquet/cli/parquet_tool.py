@@ -805,6 +805,151 @@ def cmd_slo(args, out=None) -> int:
     return 2 if violated else 0
 
 
+def cmd_serve(args, out=None) -> int:
+    """Run a :class:`tpuparquet.serve.ScanServer` from a JSON spec:
+    register tenants, submit their jobs, serve until everything is
+    done or a SIGTERM drains (in-flight scans checkpoint durable
+    cursors; rerunning the same spec on a successor resumes them).
+
+    Spec shape::
+
+        {"state_dir": "...",            # optional (TPQ_SERVE_STATE_DIR)
+         "workers": 4,                  # optional global budget
+         "status_export": "st.json",    # optional, for `tenants`
+         "tenants": [{"label": "a", "weight": 2.0,
+                      "byte_budget": null, "latency_target_ms": 500,
+                      "error_rate_target": 0.01}],
+         "jobs": [{"tenant": "a", "job_id": "j0",
+                   "sources": ["a.parquet"], "columns": ["x", "y"],
+                   "unit_deadline": 0.2, "scan_deadline": null,
+                   "checkpoint_every": 1, "sink_dir": "out/a"}]}
+
+    A job with ``sink_dir`` persists each decoded unit as a keyed
+    atomic ``unit<k>.npz`` (tmp + rename — the crash-safe consumer
+    discipline), so drained-and-resumed runs converge to a
+    duplicate-free, bit-exact union.  Exit 0 = every job done; 3 =
+    drained with work remaining (resume on a successor); 1 = a job
+    failed."""
+    import json as _json
+
+    from ..serve import ScanServer
+
+    out = out or sys.stdout
+    with open(args.spec) as f:
+        spec = _json.load(f)
+    arbiter = None
+    if spec.get("workers"):
+        from ..serve import ResourceArbiter
+
+        arbiter = ResourceArbiter(total_workers=int(spec["workers"]))
+    server = ScanServer(arbiter=arbiter,
+                        state_dir=spec.get("state_dir"))
+    try:
+        for t in spec.get("tenants", []):
+            server.add_tenant(
+                t["label"], weight=float(t.get("weight", 1.0)),
+                byte_budget=t.get("byte_budget"),
+                latency_target_ms=t.get("latency_target_ms"),
+                error_rate_target=t.get("error_rate_target"))
+        jobs = []
+        for j in spec.get("jobs", []):
+            sink = (_npz_sink(j["sink_dir"])
+                    if j.get("sink_dir") else None)
+            jobs.append(server.submit(
+                j["tenant"], j["sources"], *j.get("columns", []),
+                job_id=j.get("job_id"),
+                unit_deadline=j.get("unit_deadline"),
+                scan_deadline=j.get("scan_deadline"),
+                checkpoint_every=j.get("checkpoint_every"),
+                sink=sink))
+        server.install_signal_handlers()
+        status_path = spec.get("status_export")
+        while not all(job.terminal for job in jobs):
+            if server.draining:
+                server.drain()
+                break
+            if status_path:
+                server.write_status(status_path)
+            import time as _time
+
+            _time.sleep(0.2)
+        if status_path:
+            server.write_status(status_path)
+        for job in jobs:
+            job.wait(5.0)
+            print(f"{job.tenant}/{job.job_id}: {job.state} "
+                  f"({job.units_done}/{job.units_total} units)",
+                  file=out)
+        if any(job.state == "failed" for job in jobs):
+            return 1
+        if any(job.state != "done" for job in jobs):
+            return 3  # drained: resume on a successor
+        return 0
+    finally:
+        server.shutdown(drain=False)
+
+
+def _npz_sink(sink_dir: str):
+    """Keyed atomic per-unit writer (``tests/checkpoint_child.py``
+    discipline): re-decoded units after a crash/drain overwrite with
+    identical bytes instead of duplicating."""
+    os.makedirs(sink_dir, exist_ok=True)
+
+    def sink(k: int, unit_out: dict) -> None:
+        import numpy as np
+
+        arrays = {}
+        for name in sorted(unit_out):
+            for i, arr in enumerate(unit_out[name].to_numpy()):
+                if arr is not None:
+                    arrays[f"{name}.{i}"] = np.asarray(arr)
+        tmp = os.path.join(sink_dir, f".unit{k}.npz.tmp")
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(sink_dir, f"unit{k}.npz"))
+
+    return sink
+
+
+def cmd_tenants(args, out=None) -> int:
+    """Render a running server's tenant table from its
+    ``status_export`` JSON (see ``serve``): per-tenant share of the
+    global worker budget, queue depth, accounting, and the adaptive
+    feedback (bound verdict, error-budget burn, unit p99) the
+    arbiter last rebalanced on."""
+    import json as _json
+
+    out = out or sys.stdout
+    with open(args.status) as f:
+        st = _json.load(f)
+    if getattr(args, "json", False):
+        print(_json.dumps(st, sort_keys=True), file=out)
+        return 0
+    drain = " DRAINING" if st.get("draining") else ""
+    print(f"workers={st.get('total_workers')}{drain} "
+          f"state_dir={st.get('state_dir') or '-'}", file=out)
+    hdr = (f"{'tenant':<16} {'share':>5} {'queued':>6} {'run':>3} "
+           f"{'done':>5} {'rej':>4} {'bound':<12} {'burn':>6} "
+           f"{'p99_ms':>8}")
+    print(hdr, file=out)
+    for label in sorted(st.get("tenants", {})):
+        row = st["tenants"][label]
+        burn = row.get("burn")
+        p99 = row.get("p99_ms")
+        burn_s = "-" if burn is None else f"{burn:.2f}"
+        p99_s = "-" if p99 is None else f"{p99:.1f}"
+        print(f"{label:<16} {row.get('share', 0):>5} "
+              f"{len(row.get('queued') or []):>6} "
+              f"{1 if row.get('running') else 0:>3} "
+              f"{row.get('jobs_done', 0):>5} "
+              f"{row.get('rejected', 0):>4} "
+              f"{row.get('bound') or '-':<12} "
+              f"{burn_s:>6} {p99_s:>8}", file=out)
+    return 0
+
+
 def cmd_flame(args, out=None) -> int:
     """Render a sampling-profile export (the native ``tpq-profile``
     envelope a scan wrote via ``TPQ_PROFILE_EXPORT``): top-N frames
@@ -1334,6 +1479,27 @@ def build_parser() -> argparse.ArgumentParser:
     so.add_argument("ring",
                     help="time-series ring directory to evaluate")
     so.set_defaults(fn=cmd_slo)
+
+    sv = sub.add_parser(
+        "serve",
+        help="run the multi-tenant scan server from a JSON spec "
+             "(tenants + jobs); SIGTERM drains with durable cursors "
+             "so rerunning the spec resumes")
+    sv.add_argument("spec",
+                    help="server spec JSON (tenants, jobs, state_dir, "
+                         "status_export — see the command docstring)")
+    sv.set_defaults(fn=cmd_serve)
+
+    tn = sub.add_parser(
+        "tenants",
+        help="render a running scan server's per-tenant status table "
+             "from its status_export JSON")
+    tn.add_argument("status",
+                    help="status JSON the server exports "
+                         "(spec key status_export)")
+    tn.add_argument("--json", action="store_true",
+                    help="emit the raw status document")
+    tn.set_defaults(fn=cmd_tenants)
 
     dr = sub.add_parser(
         "doctor",

@@ -316,6 +316,7 @@ def call_with_deadline(fn, budget: float | None, *, site: str,
     if budget is None or budget <= 0:
         return fn()
     from .obs import trace as _trace
+    from .serve import arbiter as _arbiter
     from .stats import current_stats
 
     st = current_stats()
@@ -324,14 +325,18 @@ def call_with_deadline(fn, budget: float | None, *, site: str,
     wd = watchdog()
     # the disposable worker re-enters the caller's trace context so
     # spans emitted by the bounded work parent causally under the
-    # caller's open span (unit, plan, ...) despite the thread hop
+    # caller's open span (unit, plan, ...) despite the thread hop —
+    # and the caller's serve-tenant binding, so the bounded work's
+    # planner pool sizes from the tenant's arbiter share
     tctx = _trace.current_ctx()
+    tenant = _arbiter.current_binding()
 
     def run():
         from .stats import worker_stats
 
         try:
-            with _trace.adopt(tctx), worker_stats(like=st) as ws:
+            with _trace.adopt(tctx), _arbiter.tenant_scope(tenant), \
+                    worker_stats(like=st) as ws:
                 try:
                     box["result"] = fn()
                 except BaseException as e:  # noqa: BLE001 — repropagated
@@ -394,9 +399,11 @@ def hedged_call(fns, *, delay: float, site: str,
     if len(fns) == 1 and (budget is None or budget <= 0):
         return fns[0]()
     from .obs import trace as _trace
+    from .serve import arbiter as _arbiter
     from .stats import current_stats, worker_stats
 
     st = current_stats()
+    tenant = _arbiter.current_binding()
     q: queue.SimpleQueue = queue.SimpleQueue()
     starts: dict[int, float] = {}
     # per-branch trace spans: each launched replica gets an open span
@@ -417,7 +424,8 @@ def hedged_call(fns, *, delay: float, site: str,
 
         def run():
             try:
-                with _trace.adopt(bctx), worker_stats(like=st) as ws:
+                with _trace.adopt(bctx), _arbiter.tenant_scope(tenant), \
+                        worker_stats(like=st) as ws:
                     try:
                         out = (True, fns[i]())
                     except BaseException as e:  # noqa: BLE001

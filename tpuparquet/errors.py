@@ -52,6 +52,8 @@ __all__ = [
     "DeviceDispatchError",
     "DeadlineExceededError",
     "DispatchDeadlineError",
+    "ServeStateError",
+    "AdmissionRejected",
     "QUARANTINE_ERRORS",
 ]
 
@@ -193,6 +195,31 @@ class DispatchDeadlineError(_DeadlineInfo, DeviceDispatchError):
 
     def coordinates(self) -> dict:
         return self._deadline_coords(super().coordinates())
+
+
+class ServeStateError(RuntimeError):
+    """Invalid scan-server lifecycle operation — e.g. activating a
+    second process-wide :class:`~tpuparquet.serve.ResourceArbiter`
+    while another is live.  A caller bug, not a scan failure: it
+    never enters the quarantine/retry routing."""
+
+
+class AdmissionRejected(ServeStateError):
+    """Load-shed rejection from the scan server's admission control
+    (:meth:`tpuparquet.serve.ResourceArbiter.admit`).
+
+    Always RETRYABLE: the request was never queued, so resubmitting
+    after ``retry_after_s`` is safe and duplicate-free.  Carries the
+    machine-readable fields a client backoff loop needs: ``tenant``,
+    ``reason`` (``"queue_full"`` / ``"byte_budget"`` /
+    ``"deadline_budget"`` / ``"draining"``) and ``retry_after_s``."""
+
+    def __init__(self, msg: str, *, tenant: str, reason: str,
+                 retry_after_s: float):
+        super().__init__(msg)
+        self.tenant = tenant
+        self.reason = reason
+        self.retry_after_s = retry_after_s
 
 
 # What a quarantining scan may absorb per unit: the library's clean

@@ -1130,11 +1130,18 @@ class FileReader:
                            bytes(data[ms - start : ms - start + mn]))
 
     def _prefetch_threads(self) -> int:
-        """Shared thread budget: ``TPQ_PLAN_THREADS`` when set, else
-        usable cores (mirrors ``kernels/device._plan_threads`` without
-        importing the device stack on the pure-CPU path)."""
+        """Shared thread budget: the serve-arbiter tenant share when
+        the calling thread is bound, else ``TPQ_PLAN_THREADS`` when
+        set, else usable cores (mirrors ``kernels/device.
+        _plan_threads`` without importing the device stack on the
+        pure-CPU path)."""
         import os as _os
 
+        from ..serve import arbiter as _arbiter
+
+        share = _arbiter.plan_budget()
+        if share is not None:
+            return share
         v = _os.environ.get("TPQ_PLAN_THREADS")
         if v is not None:
             try:

@@ -43,7 +43,16 @@ def _write_threads() -> int:
     """Per-column encode parallelism for row-group flushes.
     ``TPQ_WRITE_THREADS=1`` forces the serial path; default is the
     USABLE core count (affinity/cpuset-aware, capped by the column
-    count at use)."""
+    count at use).  A thread bound to a serve-arbiter tenant sizes
+    from its tenant share instead (one share bounds ALL of a tenant's
+    workers — the library never runs the plan and encode pools for
+    the same operation)."""
+    from ..serve import arbiter as _arbiter
+
+    share = _arbiter.write_budget()
+    if share is not None:
+        return share
+    _arbiter.warn_if_oversubscribed()
     v = os.environ.get("TPQ_WRITE_THREADS")
     if v is not None:
         try:

@@ -2880,7 +2880,19 @@ def _plan_threads() -> int:
     read-then-write workloads need no tuning.  Stats stay exact at any
     worker count: each column plan runs under a per-thread collector
     (``stats.worker_stats``) merged on the coordinating thread when its
-    future is consumed."""
+    future is consumed.
+
+    Under an active serve arbiter (``tpuparquet.serve``) a thread
+    bound to a tenant sizes from that tenant's share of the GLOBAL
+    worker budget instead — consulted per call, so adaptive
+    rebalances take effect at the next unit boundary; unbound threads
+    and arbiter-less processes keep the legacy behavior exactly."""
+    from ..serve import arbiter as _arbiter
+
+    share = _arbiter.plan_budget()
+    if share is not None:
+        return share
+    _arbiter.warn_if_oversubscribed()
     v = os.environ.get("TPQ_PLAN_THREADS")
     if v is not None:
         try:

@@ -39,7 +39,8 @@ import threading
 
 __all__ = [
     "ScanLedger", "ledger", "ledgers_snapshot", "reset_ledgers",
-    "merge_ledger_states", "stage_seconds", "STAGE_OF", "VERDICT_OF",
+    "merge_ledger_states", "stage_seconds", "stage_verdict",
+    "STAGE_OF", "VERDICT_OF",
     "span_tree", "exclusive_times", "unit_reports", "diagnose",
     "format_diagnosis",
 ]
@@ -90,6 +91,21 @@ def stage_seconds(counters: dict) -> dict:
            for stage, c in _STAGE_COUNTERS.items()}
     out["plan"] = round(max(out["plan"] - out["read"], 0.0), 6)
     return out
+
+
+def stage_verdict(counters: dict) -> str | None:
+    """Counter-only doctor verdict: the :data:`VERDICT_OF` name of
+    the dominant :func:`stage_seconds` bucket, or None when nothing
+    has accrued.  The trace-based :func:`diagnose` is strictly richer
+    (exclusive times, tails, oversubscription); this is the cheap
+    always-available form the serve arbiter's adaptive loop feeds on
+    — same buckets, same vocabulary, so ``parquet-tool doctor`` and
+    the rebalancer never disagree about what a tenant is bound by."""
+    stages = stage_seconds(counters)
+    stage = max(stages, key=lambda s: stages[s])
+    if stages[stage] <= 0:
+        return None
+    return VERDICT_OF.get(stage)
 
 
 class ScanLedger:

@@ -45,6 +45,7 @@ from .digest import QuantileDigest
 
 __all__ = ["load_objectives", "evaluate", "format_report",
            "slo_file_default", "window_digest", "window_ledger",
+           "error_rate",
            "DEFAULT_FAST_WINDOW_S", "DEFAULT_SLOW_WINDOW_S"]
 
 DEFAULT_FAST_WINDOW_S = 300.0
@@ -170,15 +171,21 @@ def window_ledger(frames: list[dict], label: str,
     return {k: v for k, v in out.items() if v}
 
 
-def _error_rate(counters: dict) -> tuple[float | None, int, int]:
+def error_rate(counters: dict) -> tuple[float | None, int, int]:
     """(rate, errors, attempts) from a windowed ledger-counter dict;
-    rate None when nothing ran in the window."""
+    rate None when nothing ran in the window.  Public because the
+    serve arbiter prices its adaptive burn feedback with EXACTLY this
+    derivation — the rebalancer and the SLO evaluator must agree on
+    what an error is by construction."""
     errors = sum(int(counters.get(k, 0)) for k in _ERROR_COUNTERS)
     attempts = int(counters.get("row_groups", 0)) \
         + int(counters.get("units_quarantined", 0))
     if attempts <= 0:
         return None, errors, 0
     return errors / attempts, errors, attempts
+
+
+_error_rate = error_rate  # internal alias (pre-serve call sites)
 
 
 # ----------------------------------------------------------------------

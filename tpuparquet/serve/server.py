@@ -1,0 +1,524 @@
+"""The multi-tenant scan server: bounded per-tenant queues over one
+shared arbiter, with graceful drain.
+
+One :class:`ScanServer` owns (or adopts) a
+:class:`~tpuparquet.serve.arbiter.ResourceArbiter`, activates it
+process-wide, and multiplexes concurrent tenant scans onto the
+library's shared substrate — the plan cache, the arena pool, the
+watchdog, the metrics registry and per-label ledgers/digests.  Each
+tenant gets a FIFO queue bounded by admission control; a round-robin
+scheduler starts at most ONE scan per tenant at a time (the
+*arbiter* shares cores between tenants; serializing a tenant's own
+jobs keeps its queue estimate honest), and every scan runs in
+quarantine mode under :func:`~tpuparquet.serve.arbiter.tenant_scope`
+with a durable cursor in the server's state directory.
+
+**Graceful drain** (``SIGTERM`` via
+:meth:`ScanServer.install_signal_handlers`, or :meth:`ScanServer.
+shutdown`): admissions start rejecting with a retryable
+``"draining"`` :class:`~tpuparquet.serve.arbiter.AdmissionRejected`;
+every in-flight scan is asked to stop cooperatively
+(:meth:`~tpuparquet.shard.scan.DurableScanMixin.request_stop` — it
+finishes its current unit, flushes the durable cursor, and marks its
+progress ``stopped``); queued-but-unstarted jobs are handed back as
+``drained``; telemetry is flushed.  A successor server that
+resubmits the same ``(tenant, job_id)`` jobs resumes every cursor —
+with a keyed sink (the ``tests/checkpoint_child.py`` discipline) the
+union of results is duplicate-free and bit-exact.
+
+Lock discipline: the server condition variable is a LEAF like the
+arbiter lock — queue bookkeeping only, never held across admission,
+scan driving, arbiter rebalance, or telemetry calls.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from collections import deque
+
+from . import arbiter as _arbiter
+from .arbiter import AdmissionRejected, ResourceArbiter
+
+__all__ = ["ScanJob", "ScanServer", "state_dir_default"]
+
+
+def state_dir_default() -> str | None:
+    """Durable-cursor directory from ``TPQ_SERVE_STATE_DIR`` (None =
+    no checkpointing: jobs are not resumable across a restart)."""
+    return os.environ.get("TPQ_SERVE_STATE_DIR") or None
+
+
+class ScanJob:
+    """One admitted scan request.
+
+    ``wait(timeout)`` blocks until the job reaches a terminal state:
+    ``done`` (all units decoded), ``drained`` (checkpointed mid-scan
+    by a drain — resubmit on the successor to continue), or
+    ``failed`` (:attr:`error` holds the exception).  Without a
+    ``sink``, decoded units land in :attr:`outputs` keyed by unit
+    index; with one, ``sink(unit_index, out)`` is called from the
+    driver thread as each unit decodes (keyed atomic writes there
+    make a crash-safe consumer — see ``tests/serve_child.py``)."""
+
+    def __init__(self, tenant: str, job_id: str, sources, columns,
+                 options: dict, sink):
+        self.tenant = tenant
+        self.job_id = job_id
+        self.sources = sources
+        self.columns = columns
+        self.options = options
+        self.sink = sink
+        self.outputs: dict = {} if sink is None else None
+        self.state = "queued"
+        self.error: BaseException | None = None
+        self.units_done = 0
+        self.units_total: int | None = None
+        self.units_quarantined = 0
+        self.quarantine = None     # QuarantineReport after the run
+        self.stats = None          # exact DecodeStats for this job
+        self.est_bytes = 0
+        self.scan = None           # live ShardedScan while running
+        self._event = threading.Event()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self._event.wait(timeout)
+
+    @property
+    def terminal(self) -> bool:
+        return self.state in ("done", "drained", "failed")
+
+    def _finish(self, state: str) -> None:
+        self.state = state
+        self._event.set()
+
+    def as_dict(self) -> dict:
+        return {
+            "tenant": self.tenant, "job_id": self.job_id,
+            "state": self.state,
+            "units_done": self.units_done,
+            "units_total": self.units_total,
+            "error": (f"{type(self.error).__name__}: {self.error}"
+                      if self.error is not None else None),
+        }
+
+
+class ScanServer:
+    """Long-lived multi-tenant scan host (see module docstring).
+
+    ``plan_cache_mb``: arm the shared plan cache at this budget for
+    the server's lifetime (concurrent tenants re-planning the same
+    files is the serve-shaped hit pattern); None leaves the
+    ``TPQ_PLAN_CACHE_MB`` env setting alone.  The arena-pool free-
+    list retention is raised to the worker budget while the server
+    runs and trimmed back on shutdown."""
+
+    def __init__(self, *, arbiter: ResourceArbiter | None = None,
+                 state_dir: str | None = None,
+                 queue_bound: int | None = None,
+                 rebalance_interval: float | None = None,
+                 plan_cache_mb: float | None = None):
+        self._arb = arbiter if arbiter is not None else ResourceArbiter()
+        _arbiter.activate(self._arb)
+        self.state_dir = (state_dir if state_dir is not None
+                          else state_dir_default())
+        if self.state_dir:
+            os.makedirs(self.state_dir, exist_ok=True)
+        self._queue_bound = (queue_bound if queue_bound is not None
+                             else _arbiter.queue_bound_default())
+        self._reb_interval = (
+            rebalance_interval if rebalance_interval is not None
+            else _arbiter.rebalance_interval_default())
+        self._cv = threading.Condition()
+        self._queues: dict[str, deque[ScanJob]] = {}
+        self._running: dict[str, ScanJob] = {}
+        self._rr: list[str] = []      # round-robin tenant order
+        self._rr_pos = 0
+        self._finished: list[ScanJob] = []
+        self._drivers: list[threading.Thread] = []
+        self._draining = False
+        self._closed = False
+        self._drain_event = threading.Event()
+        from ..kernels import arena as _arena
+        from ..kernels import plancache as _plancache
+
+        self._plancache_token = (
+            _plancache.set_plan_cache_budget(plan_cache_mb)
+            if plan_cache_mb is not None else None)
+        self._arena_keep_prev = _arena.set_arena_retention(
+            self._arb.total_workers)
+        self._scheduler = threading.Thread(
+            target=self._schedule_loop, name="tpq-serve-sched",
+            daemon=True)
+        self._scheduler.start()
+
+    # -- tenants ---------------------------------------------------------
+
+    def add_tenant(self, label: str, *, weight: float = 1.0,
+                   byte_budget: int | None = None,
+                   latency_target_ms: float | None = None,
+                   error_rate_target: float | None = None) -> None:
+        """Register a tenant with the arbiter and give it a queue."""
+        self._arb.register(
+            label, weight=weight, byte_budget=byte_budget,
+            latency_target_ms=latency_target_ms,
+            error_rate_target=error_rate_target)
+        with self._cv:
+            if label not in self._queues:
+                self._queues[label] = deque()
+                self._rr.append(label)
+
+    # -- submission ------------------------------------------------------
+
+    @staticmethod
+    def _estimate_bytes(sources) -> int:
+        """Admission-control sizing: local file sizes where knowable,
+        0 for remote/opened sources (their budget charge lands when a
+        cheap remote HEAD estimate exists; unknown must not reject)."""
+        total = 0
+        for s in sources if isinstance(sources, (list, tuple)) else [sources]:
+            if isinstance(s, (str, os.PathLike)):
+                try:
+                    total += os.path.getsize(s)
+                except OSError:
+                    pass
+        return total
+
+    def _cursor_path(self, job: ScanJob) -> str | None:
+        if not self.state_dir:
+            return None
+        from ..obs.progress import label_slug
+
+        name = f"{label_slug(job.tenant)}__{label_slug(job.job_id)}.cursor"
+        return os.path.join(self.state_dir, name)
+
+    def submit(self, tenant: str, sources, *columns: str,
+               job_id: str | None = None,
+               unit_deadline: float | None = None,
+               scan_deadline: float | None = None,
+               retries: int | None = 0,
+               checkpoint_every: int | None = None,
+               filter=None, sink=None) -> ScanJob:
+        """Admit and enqueue one scan for ``tenant``.
+
+        Raises :class:`AdmissionRejected` (retryable) when draining,
+        when the tenant's bounded queue is full, or when its byte /
+        deadline budget cannot take the job — the request never
+        hangs.  ``job_id`` keys the durable cursor: resubmitting the
+        same id on a successor server resumes the checkpoint."""
+        if self._draining or self._closed:
+            raise AdmissionRejected(
+                f"server is draining; resubmit tenant {tenant!r} "
+                f"work to the successor", tenant=tenant,
+                reason="draining", retry_after_s=5.0)
+        est = self._estimate_bytes(sources)
+        with self._cv:
+            q = self._queues.get(tenant)
+            depth = (len(q) if q is not None else 0) \
+                + (1 if tenant in self._running else 0)
+        # admission outside the server lock: the arbiter lock is its
+        # own leaf and the two must never nest
+        self._arb.admit(tenant, est_bytes=est, deadline_s=scan_deadline,
+                        queue_depth=depth, queue_bound=self._queue_bound)
+        if job_id is None:
+            job_id = f"job{int(time.monotonic() * 1e6):x}"
+        job = ScanJob(tenant, job_id, sources, columns, {
+            "unit_deadline": unit_deadline,
+            "scan_deadline": scan_deadline,
+            "retries": retries,
+            "checkpoint_every": checkpoint_every,
+            "filter": filter,
+        }, sink)
+        job.est_bytes = est
+        enqueued = False
+        with self._cv:
+            q = self._queues.get(tenant)
+            if q is not None and not self._draining \
+                    and len(q) < self._queue_bound:
+                q.append(job)
+                enqueued = True
+                self._cv.notify_all()
+        if not enqueued:
+            self._arb.retract(tenant, est)
+            raise AdmissionRejected(
+                f"tenant {tenant!r} queue filled while admitting; "
+                f"retry", tenant=tenant, reason="queue_full",
+                retry_after_s=1.0)
+        return job
+
+    # -- scheduling ------------------------------------------------------
+
+    def _pick_locked(self) -> ScanJob | None:
+        """Round-robin: next tenant with queued work and no running
+        job.  Called under the cv."""
+        n = len(self._rr)
+        for i in range(n):
+            label = self._rr[(self._rr_pos + i) % n]
+            if label in self._running:
+                continue
+            q = self._queues.get(label)
+            if q:
+                self._rr_pos = (self._rr_pos + i + 1) % n
+                job = q.popleft()
+                self._running[label] = job
+                return job
+        return None
+
+    def _schedule_loop(self) -> None:
+        last_reb = time.monotonic()
+        while True:
+            job = None
+            with self._cv:
+                if self._closed:
+                    return
+                job = self._pick_locked()
+                if job is None:
+                    self._cv.wait(timeout=self._reb_interval)
+            if self._closed:
+                return
+            if job is not None:
+                if self._draining:
+                    # admitted before the drain began but never
+                    # started: hand it back untouched for the
+                    # successor (its cursor, if any, is intact)
+                    with self._cv:
+                        self._running.pop(job.tenant, None)
+                        self._cv.notify_all()
+                    job._finish("drained")
+                    continue
+                t = threading.Thread(
+                    target=self._drive_job, args=(job,),
+                    name=f"tpq-serve:{job.tenant}", daemon=True)
+                with self._cv:
+                    self._drivers = [d for d in self._drivers
+                                     if d.is_alive()]
+                    self._drivers.append(t)
+                t.start()
+            now = time.monotonic()
+            if now - last_reb >= self._reb_interval:
+                # outside every server lock: rebalance reads the obs
+                # registries and takes the arbiter leaf lock
+                self._arb.rebalance()
+                last_reb = now
+
+    # -- the per-job driver ----------------------------------------------
+
+    def _drive_job(self, job: ScanJob) -> None:
+        from ..shard.scan import ShardedScan
+        from ..stats import collect_stats
+
+        label = job.tenant
+        t0 = time.monotonic()
+        scan = None
+        opts = job.options
+        try:
+            with _arbiter.tenant_scope(label):
+                scan = ShardedScan(
+                    job.sources, *job.columns, on_error="quarantine",
+                    retries=opts.get("retries"),
+                    unit_deadline=opts.get("unit_deadline"),
+                    scan_deadline=opts.get("scan_deadline"),
+                    filter=opts.get("filter"),
+                    resume_from=self._cursor_path(job),
+                    checkpoint_every=opts.get("checkpoint_every"),
+                    progress_label=label)
+                job.scan = scan
+                job.units_total = len(scan.units)
+                job.state = "running"
+                if self._draining:
+                    scan.request_stop()  # raced the drain broadcast
+                with collect_stats() as st:
+                    for k, out in scan.run_iter():
+                        if job.sink is not None:
+                            job.sink(k, out)
+                        else:
+                            job.outputs[k] = out
+                        job.units_done += 1
+                job.stats = st
+                job.quarantine = scan.quarantine
+                # the scan's own tally is authoritative: it counts
+                # quarantined units too, which never reach the sink
+                job.units_done = scan.progress.units_done
+                job.units_quarantined = scan.progress.units_quarantined
+                final = "drained" if scan.stopped else "done"
+        except BaseException as e:  # noqa: BLE001 — reported on the job
+            job.error = e
+            if scan is not None:
+                job.quarantine = scan.quarantine
+                job.units_done = scan.progress.units_done
+                job.units_quarantined = scan.progress.units_quarantined
+            final = "failed"
+        finally:
+            if scan is not None:
+                scan.close()
+            job.scan = None
+        self._arb.note_job_done(label, time.monotonic() - t0,
+                                ok=final == "done")
+        with self._cv:
+            self._running.pop(label, None)
+            self._finished.append(job)
+            self._cv.notify_all()
+        job._finish(final)
+
+    # -- waiting ---------------------------------------------------------
+
+    def wait_idle(self, timeout: float | None = None) -> bool:
+        """Block until no job is queued or running (or ``timeout``
+        elapses); True when idle."""
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        with self._cv:
+            while self._running or any(self._queues.values()):
+                rem = None
+                if deadline is not None:
+                    rem = deadline - time.monotonic()
+                    if rem <= 0:
+                        return False
+                self._cv.wait(timeout=rem if rem is not None else 1.0)
+            return True
+
+    # -- drain / shutdown ------------------------------------------------
+
+    def request_drain(self) -> None:
+        """Begin a graceful drain; safe to call from a signal handler
+        (sets flags and events only — no locks)."""
+        self._draining = True
+        for job in list(self._running.values()):
+            scan = job.scan
+            if scan is not None:
+                scan.request_stop()
+        self._drain_event.set()
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """Stop admissions, checkpoint every in-flight scan, hand
+        queued jobs back as ``drained``, flush telemetry.  True when
+        everything reached a terminal state in time."""
+        self.request_drain()
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        drained_q: list[ScanJob] = []
+        ok = True
+        with self._cv:
+            for q in self._queues.values():
+                while q:
+                    drained_q.append(q.popleft())
+            self._cv.notify_all()
+        for job in drained_q:
+            job._finish("drained")
+        with self._cv:
+            while self._running:
+                rem = None
+                if deadline is not None:
+                    rem = deadline - time.monotonic()
+                    if rem <= 0:
+                        ok = False
+                        break
+                self._cv.wait(timeout=rem if rem is not None else 1.0)
+        self._flush_telemetry()
+        return ok
+
+    def _flush_telemetry(self) -> None:
+        """Best-effort scan-end style flush: a final registry export
+        (when the exporter is armed) and a drain tick on the
+        time-series ring — post-mortems and progress files were
+        already written by the scans themselves."""
+        from ..obs import live as _live
+        from ..obs import timeseries as _timeseries
+
+        try:
+            _live.export_now()
+        except OSError:
+            pass
+        _timeseries.tick("serve_drain")
+
+    def shutdown(self, *, drain: bool = True,
+                 timeout: float | None = None) -> bool:
+        """Drain (optionally), stop the scheduler, release the shared
+        resources and deactivate the arbiter.  Idempotent."""
+        ok = True
+        if drain and not self._closed:
+            ok = self.drain(timeout=timeout)
+        self._draining = True
+        with self._cv:
+            self._closed = True
+            drivers = list(self._drivers)
+            self._cv.notify_all()
+        self._scheduler.join(timeout=5.0)
+        # jobs reach their terminal state moments BEFORE the driver
+        # thread finishes unwinding; exiting the process through that
+        # window tears down native state under a live thread — join
+        # the (daemon) drivers so a clean shutdown never races it
+        for d in drivers:
+            d.join(timeout=5.0)
+        from ..kernels import arena as _arena
+        from ..kernels import plancache as _plancache
+
+        _arena.set_arena_retention(self._arena_keep_prev)
+        _arena.trim_arena_pool(0)
+        if self._plancache_token is not None:
+            _plancache.set_plan_cache_budget(self._plancache_token)
+        _arbiter.deactivate(self._arb)
+        return ok
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.shutdown()
+
+    # -- signals / status ------------------------------------------------
+
+    def install_signal_handlers(self, signals=(signal.SIGTERM,)) -> None:
+        """Route ``SIGTERM`` (by default) to :meth:`request_drain`;
+        pair with :meth:`serve_forever`.  Main thread only (a CPython
+        restriction on ``signal.signal``)."""
+
+        def _handler(signum, frame):
+            self.request_drain()
+
+        for s in signals:
+            signal.signal(s, _handler)
+
+    def serve_forever(self, poll_s: float = 0.2) -> None:
+        """Block until a drain is requested (signal or another
+        thread), then finish the drain and return."""
+        while not self._drain_event.wait(timeout=poll_s):
+            pass
+        self.drain()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def status(self) -> dict:
+        """The ``parquet-tool tenants`` document: per-tenant arbiter
+        accounting + queue/running/finished state."""
+        with self._cv:
+            queued = {lb: [j.as_dict() for j in q]
+                      for lb, q in self._queues.items()}
+            running = {lb: j.as_dict()
+                       for lb, j in self._running.items()}
+            finished = [j.as_dict() for j in self._finished]
+        tenants = self._arb.tenants_state()
+        for lb, row in tenants.items():
+            row["queued"] = queued.get(lb, [])
+            row["running"] = running.get(lb)
+        return {
+            "total_workers": self._arb.total_workers,
+            "shares": self._arb.shares(),
+            "draining": self._draining,
+            "state_dir": self.state_dir,
+            "tenants": tenants,
+            "finished": finished,
+        }
+
+    def write_status(self, path: str) -> None:
+        """Atomic JSON status export for out-of-process viewers."""
+        import json
+
+        from ..obs.live import atomic_write_text
+
+        atomic_write_text(path, json.dumps(self.status(), indent=2,
+                                           sort_keys=True) + "\n")

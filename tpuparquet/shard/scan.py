@@ -13,6 +13,7 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import threading
 import time
 import zlib
 
@@ -599,6 +600,12 @@ class DurableScanMixin:
                                   else checkpoint_every_default())
         self._since_checkpoint = 0
         self._run_t0 = None
+        # cooperative drain: request_stop() (any thread) makes the
+        # drive loop exit cleanly at the next unit boundary with the
+        # durable cursor flushed — the serve layer's graceful-drain
+        # primitive, usable by any embedder
+        self._stop = threading.Event()
+        self.stopped = False
         self._postmortem_path = (
             postmortem if isinstance(postmortem, str)
             else None if postmortem is False
@@ -858,6 +865,18 @@ class DurableScanMixin:
             with self._adopted():
                 self._check_scan_deadline()
             while True:
+                if self._stop.is_set():
+                    gen.close()
+                    with self._adopted():
+                        self._flush_checkpoint()
+                    self._fold_live()
+                    self.stopped = True
+                    prog.finish("stopped")
+                    self._finish_telemetry(t_scan, troot, "stopped")
+                    _trace.end_trace(troot, status="cancelled")
+                    self._export_trace(troot)
+                    self._export_profile()
+                    return
                 nxt, _ = self._progress()
                 prog.unit_started(nxt)
                 t_unit = time.monotonic()
@@ -971,6 +990,16 @@ class DurableScanMixin:
                                                     gather_to))
         self._fold_live()
         return out
+
+    def request_stop(self) -> None:
+        """Ask a running :meth:`run_iter` to stop cooperatively: the
+        drive loop exits BEFORE starting another unit, flushes the
+        durable cursor (when checkpointing is configured), and marks
+        progress/trace ``stopped`` — then sets :attr:`stopped` so the
+        caller can distinguish a drain from completion.  Safe from
+        any thread and before the run starts (the loop checks first);
+        the serve layer's graceful-drain hook."""
+        self._stop.set()
 
     def cursor_save(self, path: str | None = None) -> None:
         """Durably checkpoint :meth:`state` (atomic tmp + fsync +
