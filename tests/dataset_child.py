@@ -1,0 +1,82 @@
+"""Subprocess body for the dataset commit-protocol kill/resume sweep
+(``tests/test_dataset.py``).
+
+Writes one deterministic batch of rows into a partitioned dataset and
+commits it.  ``kill_at >= 0`` SIGKILLs the process at the ``kill_at``-th
+commit-protocol step boundary (``DatasetWriter`` invokes its
+``step_hook`` immediately BEFORE each protocol action: staging a
+partial, writing the journal, each per-file promote, the manifest
+rename, the cleanup) — so every adjacent pair of protocol actions gets
+a crash between them.  ``kill_at == -1`` runs to completion, printing
+one step label per line to stdout (the parent counts them to size the
+sweep); since the writer is constructed with ``resume_from=``, the
+same invocation is also the resume leg after a kill.
+
+Usage: python tests/dataset_child.py <root> <kill_at>
+"""
+
+import os
+import signal
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the interpreter puts tests/ on sys.path (the script's directory);
+# the library lives one level up
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import contextlib  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+from tpuparquet.dataset import DatasetWriter  # noqa: E402
+from tpuparquet.faults import chaos_scope  # noqa: E402
+
+SCHEMA = """message rec {
+  required int64 id;
+  optional binary tag (STRING);
+  required binary region (STRING);
+}"""
+
+N = 60
+
+
+def batch():
+    """The deterministic commit-B payload: 60 rows over 2 partitions,
+    with a null hole every 7th tag."""
+    ids = np.arange(1000, 1000 + N, dtype=np.int64)
+    tags = [b"tag-%03d" % i for i in range(N)]
+    regions = [b"eu" if i % 3 == 0 else b"us" for i in range(N)]
+    mask = np.array([i % 7 != 0 for i in range(N)])
+    return ids, tags, regions, mask
+
+
+def main() -> int:
+    root, kill_at = sys.argv[1], int(sys.argv[2])
+    count = [0]
+
+    def hook(label):
+        if count[0] == kill_at:
+            os.kill(os.getpid(), signal.SIGKILL)
+        count[0] += 1
+        if kill_at < 0:
+            print(":".join(str(p) for p in label), flush=True)
+
+    # the chaos-seeds leg: perturb thread interleavings at every
+    # registered fault site (TPQ_LOCKCHECK=strict rides the normal
+    # env path and raises in-process on any lock-order cycle)
+    ctx = chaos_scope() if os.environ.get("TPQ_CHAOS_SEED") \
+        else contextlib.nullcontext()
+    with ctx:
+        w = DatasetWriter(root, SCHEMA, ["region"], step_hook=hook,
+                          resume_from=root)
+        ids, tags, regions, mask = batch()
+        w.write_columns({"id": ids, "tag": tags, "region": regions},
+                        masks={"tag": mask})
+        w.commit()
+        w._release()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

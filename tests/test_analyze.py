@@ -917,6 +917,56 @@ class TestLifecyclePass:
         """})
         assert lifecycle.run(t) == []
 
+    def test_container_leak_flagged(self):
+        # handles parked in a registry attr with no draining method
+        # anywhere on the class: every entry leaks with the instance
+        t = _tree({"tpuparquet/io/x.py": """
+            class PartPool:
+                def __init__(self):
+                    self._handles = {}
+
+                def open_part(self, key, path):
+                    self._handles[key] = open(path, "wb")
+                    return self._handles[key]
+        """})
+        found = lifecycle.run(t)
+        assert _keys(found, "container-leak") == ["PartPool:_handles"]
+
+    def test_container_drained_accepted(self):
+        # clean twin: directory-scoped ownership transfer — another
+        # method references the registry and releases its entries
+        t = _tree({"tpuparquet/io/x.py": """
+            class PartPool:
+                def __init__(self):
+                    self._handles = {}
+
+                def open_part(self, key, path):
+                    self._handles[key] = open(path, "wb")
+                    return self._handles[key]
+
+                def close(self):
+                    for fh in self._handles.values():
+                        fh.close()
+                    self._handles.clear()
+        """})
+        assert lifecycle.run(t) == []
+
+    def test_container_acquirer_own_release_not_enough(self):
+        # the acquiring method closing some OTHER handle must not
+        # count as draining the registry it fills
+        t = _tree({"tpuparquet/io/x.py": """
+            class PartPool:
+                def __init__(self):
+                    self._handles = {}
+
+                def open_part(self, key, path, old):
+                    old.close()
+                    self._handles[key] = open(path, "wb")
+                    return self._handles[key]
+        """})
+        found = lifecycle.run(t)
+        assert _keys(found, "container-leak") == ["PartPool:_handles"]
+
 
 # ----------------------------------------------------------------------
 # exception-taxonomy

@@ -1,0 +1,526 @@
+"""Partitioned datasets: atomic manifest-journal commits, kill/resume
+sweep at every commit-protocol step, partition pruning, orphan
+quarantine, manifest-corruption degrade, compaction, and pyarrow
+hive interop both ways.
+
+The acceptance invariant (the round's tentpole): SIGKILL the writer at
+EVERY commit-protocol step boundary — a fresh reader sees the previous
+snapshot (or nothing, for a first commit), never a torn dataset; a
+``DatasetWriter(resume_from=)`` re-run finishes the write bit-exact
+and duplicate-free against an uninterrupted oracle.  The chaos legs
+re-run the kill/resume under seeded scheduler perturbation with
+``TPQ_LOCKCHECK=strict`` and require zero lock-order findings plus
+exact counter conservation.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tpuparquet import FileWriter
+from tpuparquet.dataset import (
+    DatasetScan,
+    DatasetWriter,
+    compact_dataset,
+    partition_matches,
+    resolve_manifest,
+    split_partition_filter,
+    sweep_orphans,
+)
+from tpuparquet.dataset import manifest as mf
+from tpuparquet.errors import CorruptManifestError
+from tpuparquet.faults import QuarantineReport, inject_faults
+from tpuparquet.filter import col
+from tpuparquet.shard import ShardedScan
+from tpuparquet.stats import collect_stats
+
+SCHEMA = """message rec {
+  required int64 id;
+  optional binary tag (STRING);
+  required binary region (STRING);
+}"""
+
+CHILD = os.path.join(os.path.dirname(__file__), "dataset_child.py")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_snapshot_a(root) -> list[int]:
+    """The base snapshot the kill sweep must keep visible: 40 rows
+    over region=eu / region=us, committed as manifest v1."""
+    ids = np.arange(40, dtype=np.int64)
+    w = DatasetWriter(str(root), SCHEMA, ["region"])
+    w.write_columns({
+        "id": ids,
+        "tag": [b"a-%02d" % i for i in range(40)],
+        "region": [b"eu" if i % 2 else b"us" for i in range(40)],
+    }, masks={"tag": np.array([i % 5 != 0 for i in range(40)])})
+    assert w.commit() == 1
+    w._release()
+    return sorted(int(i) for i in ids)
+
+
+def _i64(vals, counts) -> list[int]:
+    out = []
+    for u in range(vals.shape[0]):
+        out.extend(vals[u, : counts[u]].astype(np.uint32)
+                   .view(np.uint8).view("<i8").ravel().tolist())
+    return out
+
+
+def _scan_ids(root) -> list[int]:
+    with DatasetScan(str(root), "id") as s:
+        res = s.run()
+        vals, counts = s.gather_column(res, "id")
+    return sorted(_i64(vals, counts))
+
+
+def _published_state(root) -> dict:
+    """Manifest-listed files with their physical content hashes —
+    the bit-exactness witness the sweep compares against the
+    oracle."""
+    body, _version, _ = resolve_manifest(str(root))
+    state = {}
+    for e in body["files"]:
+        with open(os.path.join(str(root), e["path"]), "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()
+        state[e["path"]] = (e["partition"], e["rows"], e["bytes"],
+                            e["sha1"], digest)
+    return state
+
+
+def _child_env(extra: dict | None = None) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("TPQ_RETRY_BASE_S", "0.001")
+    env.setdefault("TPQ_RETRY_MAX_S", "0.002")
+    env.pop("TPQ_CHAOS_SEED", None)
+    env.pop("TPQ_LOCKCHECK", None)
+    if extra:
+        env.update(extra)
+    return env
+
+
+def _spawn(root, kill_at: int, extra_env=None, capture=False):
+    return subprocess.Popen(
+        [sys.executable, CHILD, str(root), str(kill_at)],
+        cwd=_REPO, env=_child_env(extra_env),
+        stdout=subprocess.PIPE if capture else subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL)
+
+
+# ----------------------------------------------------------------------
+# Round trip + partition pruning
+# ----------------------------------------------------------------------
+
+class TestRoundTrip:
+    def test_write_scan_roundtrip(self, tmp_path):
+        root = tmp_path / "ds"
+        ids = _write_snapshot_a(root)
+        assert _scan_ids(root) == ids
+        with DatasetScan(str(root), "id") as s:
+            assert s.version == 1
+            assert {p["region"] for p in s.partitions.values()} \
+                == {"eu", "us"}
+
+    def test_partition_pruning_counts(self, tmp_path):
+        root = tmp_path / "ds"
+        _write_snapshot_a(root)
+        with DatasetScan(str(root), "id",
+                         filter="region == 'eu'") as s:
+            res, st = s.run_with_stats()
+            vals, counts = s.gather_column(res, "id")
+        assert st.dataset_files_pruned == 1
+        assert sorted(_i64(vals, counts)) \
+            == [i for i in range(40) if i % 2]
+
+    def test_mixed_partition_and_data_filter(self, tmp_path):
+        root = tmp_path / "ds"
+        _write_snapshot_a(root)
+        with DatasetScan(str(root), "id",
+                         filter=(col("region") == "us")
+                         & (col("id") < 10)) as s:
+            res = s.run()
+            vals, counts = s.gather_column(res, "id")
+        assert sorted(_i64(vals, counts)) == [0, 2, 4, 6, 8]
+
+    def test_partition_column_not_scannable(self, tmp_path):
+        root = tmp_path / "ds"
+        _write_snapshot_a(root)
+        with pytest.raises(ValueError, match="partition key"):
+            DatasetScan(str(root), "region")
+
+    def test_mixed_disjunct_rejected(self):
+        pred = (col("region") == "us") | (col("id") < 10)
+        with pytest.raises(ValueError, match="mixes partition keys"):
+            split_partition_filter(pred, ["region"])
+
+    def test_null_partition_roundtrip(self, tmp_path):
+        root = tmp_path / "ds"
+        w = DatasetWriter(str(root), SCHEMA, ["region"])
+        w.write_columns({
+            "id": np.array([1, 2], dtype=np.int64),
+            "tag": [b"x", b"y"],
+            "region": [b"eu", None],
+        })
+        w.commit()
+        w._release()
+        assert os.path.isdir(root / f"region={mf.HIVE_NULL}")
+        with DatasetScan(str(root), "id",
+                         filter=col("region").is_null()) as s:
+            res = s.run()
+            vals, counts = s.gather_column(res, "id")
+        assert _i64(vals, counts) == [2]
+
+    def test_partition_matches_null_semantics(self):
+        assert not partition_matches(col("k") == "v", {"k": None})
+        assert partition_matches(col("k").is_null(), {"k": None})
+        assert partition_matches(col("k").not_null(), {"k": "v"})
+
+
+# ----------------------------------------------------------------------
+# Parity vs a plain per-file ShardedScan
+# ----------------------------------------------------------------------
+
+class TestScanParity:
+    def test_bytes_and_counters_match_sharded_scan(self, tmp_path):
+        root = tmp_path / "ds"
+        _write_snapshot_a(root)
+        with DatasetScan(str(root), "id", "tag") as ds:
+            files = [src for src, _p, _r, _b in ds.files()]
+            with collect_stats() as st_ds:
+                res_ds = ds.run()
+            ids_ds = ds.gather_column(res_ds, "id")
+        with ShardedScan(files, "id", "tag") as fs:
+            with collect_stats() as st_fs:
+                res_fs = fs.run()
+            ids_fs = fs.gather_column(res_fs, "id")
+        np.testing.assert_array_equal(ids_ds[0], ids_fs[0])
+        np.testing.assert_array_equal(ids_ds[1], ids_fs[1])
+        d_ds, d_fs = st_ds.as_dict(), st_fs.as_dict()
+        for k in ("row_groups", "pages", "values", "bytes_read",
+                  "bytes_uncompressed", "units_quarantined"):
+            assert d_ds[k] == d_fs[k], k
+
+
+# ----------------------------------------------------------------------
+# Kill/resume sweep — the tentpole acceptance invariant
+# ----------------------------------------------------------------------
+
+def _run_to_completion(root, extra_env=None) -> list[str]:
+    proc = _spawn(root, -1, extra_env=extra_env, capture=True)
+    out, _ = proc.communicate(timeout=240)
+    assert proc.returncode == 0
+    return [ln for ln in out.decode().splitlines() if ln.strip()]
+
+
+class TestKillResumeSweep:
+    def test_kill_at_every_step_then_resume(self, tmp_path):
+        base = tmp_path / "base"
+        a_ids = _write_snapshot_a(base)
+
+        # uninterrupted oracle fixes the expected final state and the
+        # number of protocol steps to sweep
+        oracle = tmp_path / "oracle"
+        shutil.copytree(base, oracle)
+        steps = _run_to_completion(oracle)
+        # 2 partitions: stage x2, journal, promote x2, manifest, clean
+        assert [s.split(":")[0] for s in steps] == [
+            "stage", "stage", "journal", "promote", "promote",
+            "manifest", "clean"]
+        oracle_ids = _scan_ids(oracle)
+        oracle_state = _published_state(oracle)
+        assert len(oracle_ids) == len(a_ids) + 60
+
+        for kill_at in range(len(steps)):
+            root = tmp_path / f"k{kill_at}"
+            shutil.copytree(base, root)
+            proc = _spawn(root, kill_at)
+            assert proc.wait(timeout=240) == -signal.SIGKILL, \
+                f"step {kill_at}: child was expected to self-SIGKILL"
+
+            # invisible: a fresh reader sees exactly snapshot A
+            # (unless the kill landed after the manifest rename, the
+            # commit point — then it sees the complete commit B)
+            mid_ids = _scan_ids(root)
+            assert mid_ids in (a_ids, oracle_ids), \
+                f"step {kill_at}: torn dataset visible"
+
+            # resumable: a resume_from= re-run converges on the
+            # oracle, bit-exact and duplicate-free
+            _run_to_completion(root)
+            assert _scan_ids(root) == oracle_ids, f"step {kill_at}"
+            assert _published_state(root) == oracle_state, \
+                f"step {kill_at}"
+
+            # staging leftovers from the dead run are swept to
+            # quarantine, never silently deleted
+            q = QuarantineReport()
+            sweep_orphans(str(root), quarantine=q)
+            assert os.listdir(root / mf.TMP_DIR) == []
+            for rec in q.as_dicts():
+                moved = rec.get("swept_to")
+                assert moved and os.path.exists(os.path.join(
+                    str(root), moved)), rec
+
+    def test_first_commit_kill_shows_nothing(self, tmp_path):
+        root = tmp_path / "ds"
+        root.mkdir()
+        # kill at the first promote: files half-published, journal
+        # present, no manifest — the reader must see NOTHING, not a
+        # hive-discovered half dataset
+        proc = _spawn(root, 3)
+        assert proc.wait(timeout=240) == -signal.SIGKILL
+        with pytest.raises(FileNotFoundError, match="pending commit"):
+            DatasetScan(str(root), "id")
+        _run_to_completion(root)
+        assert len(_scan_ids(root)) == 60
+
+
+@pytest.mark.slow
+class TestKillResumeChaos:
+    """The ci.sh stage-18 leg: kill mid-promote, resume under seeded
+    schedule chaos with the strict lock-order recorder armed; zero
+    findings, exact counter conservation vs the unperturbed oracle."""
+
+    @pytest.mark.parametrize("seed", [101, 202, 303])
+    def test_resume_under_chaos_lockcheck(self, seed, tmp_path):
+        base = tmp_path / "base"
+        _write_snapshot_a(base)
+        oracle = tmp_path / "oracle"
+        shutil.copytree(base, oracle)
+        _run_to_completion(oracle)
+        oracle_ids = _scan_ids(oracle)
+        oracle_state = _published_state(oracle)
+
+        root = tmp_path / "ds"
+        shutil.copytree(base, root)
+        proc = _spawn(root, 4)  # mid-promote
+        assert proc.wait(timeout=240) == -signal.SIGKILL
+        dump = tmp_path / "locks.json"
+        _run_to_completion(root, extra_env={
+            "TPQ_CHAOS_SEED": str(seed),
+            "TPQ_LOCKCHECK": "strict",
+            "TPQ_LOCKCHECK_OUT": str(dump),
+        })
+        doc = json.loads(dump.read_text())
+        assert doc["violations"] == []
+        assert _published_state(root) == oracle_state
+        # exact counter conservation: scanning the chaos-resumed
+        # dataset decodes the same work as scanning the oracle
+        with DatasetScan(str(root), "id", "tag") as s:
+            _res, st = s.run_with_stats()
+        with DatasetScan(str(oracle), "id", "tag") as s2:
+            _res2, st2 = s2.run_with_stats()
+        d1, d2 = st.as_dict(), st2.as_dict()
+        for k in ("row_groups", "pages", "values",
+                  "bytes_uncompressed", "units_quarantined",
+                  "dataset_files_pruned"):
+            assert d1[k] == d2[k], k
+        assert _scan_ids(root) == oracle_ids
+
+
+# ----------------------------------------------------------------------
+# Orphan sweep + manifest corruption degrade
+# ----------------------------------------------------------------------
+
+class TestQuarantine:
+    def test_abort_leaves_orphans_sweep_quarantines(self, tmp_path):
+        root = tmp_path / "ds"
+        _write_snapshot_a(root)
+        w = DatasetWriter(str(root), SCHEMA, ["region"])
+        w.write_columns({
+            "id": np.array([100], dtype=np.int64),
+            "tag": [b"zz"],
+            "region": [b"eu"],
+        })
+        w._stage_part(("eu",))  # staged but never committed
+        w.abort()
+        staged = os.listdir(root / mf.TMP_DIR)
+        assert staged
+        q = QuarantineReport()
+        with collect_stats() as st:
+            swept = sweep_orphans(str(root), quarantine=q)
+        assert st.dataset_orphans_swept == len(staged)
+        assert os.listdir(root / mf.TMP_DIR) == []
+        # never silently deleted: every swept file still exists under
+        # _quarantine/, byte-complete
+        for rec in q.as_dicts():
+            assert os.path.exists(
+                os.path.join(str(root), rec["swept_to"]))
+        assert len(swept) == len(staged)
+        # the published snapshot is untouched
+        assert len(_scan_ids(root)) == 40
+
+    def test_corrupt_newest_manifest_degrades(self, tmp_path):
+        root = tmp_path / "ds"
+        ids = _write_snapshot_a(root)
+        w = DatasetWriter(str(root), SCHEMA, ["region"])
+        w.write_columns({
+            "id": np.array([99], dtype=np.int64),
+            "tag": [b"z"],
+            "region": [b"eu"],
+        })
+        assert w.commit() == 2
+        w._release()
+        m2 = root / mf.manifest_name(2)
+        raw = bytearray(m2.read_bytes())
+        raw[len(raw) // 2] ^= 0xFF
+        m2.write_bytes(bytes(raw))
+
+        with DatasetScan(str(root), "id") as s:
+            assert s.version == 1  # degraded to the older snapshot
+            rep = s.quarantine.as_dicts()
+        assert any(r.get("file", "").endswith(mf.manifest_name(2))
+                   for r in rep)
+        assert _scan_ids(root) == ids
+
+    def test_only_manifest_corrupt_raises(self, tmp_path):
+        root = tmp_path / "ds"
+        _write_snapshot_a(root)
+        m1 = root / mf.manifest_name(1)
+        m1.write_bytes(b'{"not": "an envelope"}')
+        with pytest.raises(CorruptManifestError):
+            DatasetScan(str(root), "id")
+
+    def test_manifest_load_fault_site(self, tmp_path):
+        root = tmp_path / "ds"
+        ids = _write_snapshot_a(root)
+        with inject_faults() as inj:
+            inj.inject("dataset.manifest.load", "corrupt",
+                       offset=40, xor=0x5A)
+            # the corrupted read is rejected by the CRC frame; v1 is
+            # the only snapshot, so the resolver has nothing to
+            # degrade to and the scan fails loudly
+            with pytest.raises(CorruptManifestError):
+                DatasetScan(str(root), "id")
+        # out of the fault scope the dataset is intact on disk
+        assert _scan_ids(root) == ids
+
+
+# ----------------------------------------------------------------------
+# Compaction
+# ----------------------------------------------------------------------
+
+class TestCompaction:
+    def test_compact_merges_small_files(self, tmp_path):
+        root = tmp_path / "ds"
+        all_ids = []
+        for batch in range(3):
+            w = DatasetWriter(str(root), SCHEMA, ["region"])
+            ids = np.arange(batch * 10, batch * 10 + 10,
+                            dtype=np.int64)
+            w.write_columns({
+                "id": ids,
+                "tag": [b"t%d" % i for i in ids],
+                "region": [b"eu" if i % 2 else b"us" for i in ids],
+            })
+            w.commit()
+            w._release()
+            all_ids.extend(int(i) for i in ids)
+        body, _v, _ = resolve_manifest(str(root))
+        assert len(body["files"]) == 6  # 3 commits x 2 partitions
+
+        rep = compact_dataset(str(root), sort_by="id",
+                              manifest_keep=1)
+        assert rep["files_before"] == 6
+        assert rep["files_after"] == 2
+        assert rep["rows"] == 30
+        assert sorted(rep["gc"])  # the merged-away originals are gone
+        assert _scan_ids(root) == sorted(all_ids)
+
+    def test_compact_through_cli(self, tmp_path):
+        from tpuparquet.cli.parquet_tool import main as tool_main
+
+        root = tmp_path / "ds"
+        for batch in range(2):
+            w = DatasetWriter(str(root), SCHEMA, ["region"])
+            ids = np.arange(batch * 5, batch * 5 + 5, dtype=np.int64)
+            w.write_columns({
+                "id": ids,
+                "tag": [b"t%d" % i for i in ids],
+                "region": [b"eu"] * 5,
+            })
+            w.commit()
+            w._release()
+        assert tool_main(["compact", "--sort-by", "id",
+                          "--keep", "1", str(root)]) == 0
+        body, _v, _ = resolve_manifest(str(root))
+        assert len(body["files"]) == 1
+        assert _scan_ids(root) == list(range(10))
+
+
+# ----------------------------------------------------------------------
+# Remote (emu://) dataset members under throttle faults
+# ----------------------------------------------------------------------
+
+class TestRemoteDataset:
+    def test_emu_root_scan_under_throttle(self, tmp_path,
+                                          monkeypatch):
+        monkeypatch.setenv("TPQ_RETRY_BASE_S", "0.001")
+        monkeypatch.setenv("TPQ_RETRY_MAX_S", "0.002")
+        root = tmp_path / "ds"
+        ids = _write_snapshot_a(root)
+        uri = "emu://" + str(root)
+        with inject_faults() as inj:
+            inj.inject("io.remote.throttle", "transient", times=3)
+            # the collector wraps construction too: the manifest read
+            # itself rides the remote byte-source + retry ladder
+            with collect_stats() as st:
+                with DatasetScan(uri, "id") as s:
+                    assert all(src.startswith("emu://")
+                               for src in s.sources)
+                    res = s.run()
+                    vals, counts = s.gather_column(res, "id")
+        assert sorted(_i64(vals, counts)) == ids
+        assert st.io_retries >= 1
+        assert st.units_quarantined == 0
+
+
+# ----------------------------------------------------------------------
+# pyarrow hive interop, both directions
+# ----------------------------------------------------------------------
+
+class TestPyarrowInterop:
+    pa = pytest.importorskip("pyarrow")
+
+    def test_pyarrow_reads_our_dataset(self, tmp_path):
+        import pyarrow.dataset as pads
+
+        root = tmp_path / "ds"
+        ids = _write_snapshot_a(root)
+        table = pads.dataset(str(root), format="parquet",
+                             partitioning="hive").to_table()
+        assert sorted(table.column("id").to_pylist()) == ids
+        regions = set(table.column("region").to_pylist())
+        assert regions == {"eu", "us"}
+
+    def test_we_read_pyarrow_dataset(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.dataset as pads
+
+        root = tmp_path / "pads"
+        table = pa.table({
+            "id": pa.array(range(20), type=pa.int64()),
+            "region": pa.array(["eu" if i % 2 else "us"
+                                for i in range(20)]),
+        })
+        pads.write_dataset(table, str(root), format="parquet",
+                           partitioning=pads.partitioning(
+                               pa.schema([("region", pa.string())]),
+                               flavor="hive"))
+        with DatasetScan(str(root), "id",
+                         filter="region == 'eu'") as s:
+            assert s.version == 0  # synthetic discovery manifest
+            res = s.run()
+            vals, counts = s.gather_column(res, "id")
+        assert sorted(_i64(vals, counts)) \
+            == [i for i in range(20) if i % 2]

@@ -21,6 +21,16 @@ that can raise OUTSIDE a try that closes it leaks the handle on a
 failed construction — ``__init__`` raising means nobody ever holds
 the instance to close it.
 
+Handle *registries* get a third rule (``container-leak``): an acquire
+stored into a container attribute — ``self._handles[key] = open(...)``,
+the ``DatasetWriter`` shape — transfers ownership to the OBJECT, not
+to the enclosing function, so the function-local rules above cannot
+see it.  The transfer is legitimate only when some *other* method of
+the owning class drains the registry (references the container attr
+and performs a release call — a ``_release()``/``close()`` that
+iterates the dict closing each handle).  A class that fills such a
+registry and never drains it leaks every entry.
+
 Acquire vocabulary: ``open``, ``os.open``, ``os.fdopen``,
 ``tempfile.mkstemp``, ``lease_arena``, ``.lease()``.  Release
 vocabulary: ``.close()``, ``.release()``, ``os.close``,
@@ -262,6 +272,43 @@ def _check_ctor(cls_name, init, path, stmt, attr, findings) -> None:
             return
 
 
+def _check_container(cls_node, cls_name, acq_fn, path, stmt, attr,
+                     findings) -> None:
+    """``self.attr[key] = open(...)``: directory-scoped ownership
+    transfer.  Legitimate only when another method of the class
+    drains the registry — references ``self.attr`` and performs a
+    release call in the same body."""
+    for m in cls_node.body:
+        if not isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                or m is acq_fn:
+            continue
+        refs_container = any(
+            isinstance(n, ast.Attribute) and n.attr == attr
+            and isinstance(n.value, ast.Name) and n.value.id == "self"
+            for n in ast.walk(m))
+        if not refs_container:
+            continue
+        releases = any(
+            isinstance(n, ast.Call)
+            and ((isinstance(n.func, ast.Attribute)
+                  and n.func.attr in _REL_METHODS)
+                 or (isinstance(n.func, ast.Name)
+                     and n.func.id in _REL_FUNCS)
+                 or (isinstance(n.func, ast.Attribute)
+                     and isinstance(n.func.value, ast.Name)
+                     and (n.func.value.id, n.func.attr) in _REL_ATTRS))
+            for n in ast.walk(m))
+        if releases:
+            return
+    findings.append(Finding(
+        PASS, path, stmt.lineno, "container-leak",
+        f"{cls_name}:{attr}",
+        f"handles stored into registry self.{attr} in "
+        f"{acq_fn.name}() are never drained — no other method of "
+        f"{cls_name} references the container and releases; every "
+        f"entry leaks when the instance is dropped"))
+
+
 def run(tree: RepoTree) -> list[Finding]:
     findings: list[Finding] = []
     for path, mod in tree.modules("tpuparquet/"):
@@ -293,4 +340,11 @@ def run(tree: RepoTree) -> list[Finding]:
                         t.value.id == "self" and \
                         fn.name == "__init__" and cls is not None:
                     _check_ctor(cls, fn, path, stmt, t.attr, findings)
+                elif isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Attribute) and \
+                        isinstance(t.value.value, ast.Name) and \
+                        t.value.value.id == "self" and \
+                        cls is not None:
+                    _check_container(parent, cls, fn, path, stmt,
+                                     t.value.attr, findings)
     return findings

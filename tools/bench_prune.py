@@ -121,7 +121,9 @@ def main(argv=None) -> int:
         "sel_1pct": (col("x") < int(total * 0.01), int(total * 0.01)),
     }
     report = {"rows": total, "files": len(paths),
-              "rows_per_rg": rows_per_rg, "write_s": round(write_s, 3),
+              "rows_per_rg": rows_per_rg,
+              "page_rows": _env_int("TPQ_PAGE_ROWS", 0),
+              "write_s": round(write_s, 3),
               "reps": args.reps, "legs": {}}
     ok = True
     notes = []

@@ -1,7 +1,7 @@
 #!/bin/bash
 # In-repo CI gate (counterpart of the reference's .circleci/config.yml,
 # which pins go versions and runs `go test ./...` + the compatibility
-# corpus per commit).  Seventeen stages, pinned env:
+# corpus per commit).  Eighteen stages, pinned env:
 #
 #   1. tier-1 suite   — the ROADMAP.md verify command, gated on a PASS
 #                       FLOOR rather than rc: optional deps (zstandard,
@@ -137,6 +137,20 @@
 #                       legacy-knob leg proving direct scans under
 #                       TPQ_PLAN_THREADS/TPQ_WRITE_THREADS are
 #                       untouched by the arbiter's existence
+#  18. datasets         — strict (rc=0): the partitioned-dataset gate.
+#                       The full dataset suite INCLUDING the slow
+#                       kill/resume chaos legs (SIGKILL at every
+#                       commit-protocol step: reader sees prior
+#                       snapshot or nothing, resume_from= converges
+#                       bit-exact/duplicate-free on the uninterrupted
+#                       oracle; resumed under chaos seeds 101/202/303
+#                       with TPQ_LOCKCHECK=strict and zero findings),
+#                       then the soak's --dataset leg: a writer
+#                       tenant commits through the atomic manifest
+#                       protocol while a scan tenant runs under the
+#                       same arbiter, and the dataset reads back
+#                       complete and duplicate-free through
+#                       submit_dataset admission
 #
 # Usage: bash tools/ci.sh            (exit 0 = gate passed)
 # The tier-1 stage mirrors ROADMAP.md exactly — if you change one,
@@ -159,7 +173,7 @@ CI_PASS_FLOOR=${CI_PASS_FLOOR:-1000}
 
 fail() { echo "ci.sh: FAILED at stage $1" >&2; exit 1; }
 
-echo "=== stage 1/17: tier-1 suite (pass floor $CI_PASS_FLOOR) ==="
+echo "=== stage 1/18: tier-1 suite (pass floor $CI_PASS_FLOOR) ==="
 rm -f /tmp/_t1.log
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
@@ -173,25 +187,25 @@ echo "DOTS_PASSED=$passed"
 [ "$passed" -ge "$CI_PASS_FLOOR" ] \
   || fail "tier-1 ($passed passed < floor $CI_PASS_FLOOR)"
 
-echo "=== stage 2/17: smoke bench (CPU backend, tiny target) ==="
+echo "=== stage 2/18: smoke bench (CPU backend, tiny target) ==="
 TPQ_BENCH_TARGET=60000 TPQ_BENCH_CPU=1 timeout -k 10 600 \
   python bench.py > /tmp/_ci_bench.json || fail "smoke bench"
 tail -1 /tmp/_ci_bench.json
 
-echo "=== stage 3/17: crash corpus + fault-injection matrix (strict) ==="
+echo "=== stage 3/18: crash corpus + fault-injection matrix (strict) ==="
 timeout -k 10 600 python -m pytest \
   "tests/test_corpus.py::TestCrashRegressions" tests/test_faults.py \
   -q -p no:cacheprovider || fail "corpus/faults"
 
-echo "=== stage 4/17: salvage + strict metadata (strict) ==="
+echo "=== stage 4/18: salvage + strict metadata (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_salvage.py \
   -q -p no:cacheprovider || fail "salvage"
 
-echo "=== stage 5/17: deadlines/hedging + kill-resume checkpoints (strict) ==="
+echo "=== stage 5/18: deadlines/hedging + kill-resume checkpoints (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_deadline.py \
   tests/test_checkpoint.py -q -p no:cacheprovider || fail "time/crash"
 
-echo "=== stage 6/17: plan matrix: serial vs parallel, cache on (strict) ==="
+echo "=== stage 6/18: plan matrix: serial vs parallel, cache on (strict) ==="
 # leg A: pinned-serial planning (the TPQ_PLAN_THREADS=1 reference path)
 TPQ_PLAN_THREADS=1 timeout -k 10 600 python -m pytest \
   tests/test_plan_parallel.py tests/test_plan_cache.py \
@@ -202,7 +216,7 @@ TPQ_PLAN_CACHE_MB=64 timeout -k 10 600 python -m pytest \
   tests/test_plan_parallel.py tests/test_fallback_matrix.py \
   -q -p no:cacheprovider || fail "plan matrix (cache-on leg)"
 
-echo "=== stage 7/17: live obs gate + overhead guard (strict) ==="
+echo "=== stage 7/18: live obs gate + overhead guard (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_live_obs.py \
   tests/test_env_docs.py -q -p no:cacheprovider || fail "live obs"
 # overhead guard: the always-on default must stay within a generous
@@ -213,7 +227,7 @@ timeout -k 10 600 python tools/bench_obs.py --values 2000000 \
   || fail "obs overhead guard"
 tail -5 /tmp/_ci_obs.json
 
-echo "=== stage 8/17: pruning parity gate (strict) ==="
+echo "=== stage 8/18: pruning parity gate (strict) ==="
 # leg A: the whole pushdown suite (write/read page index + bloom,
 # verdicts, late materialization, counter exactness, corrupt-index
 # degrade, pyarrow interop) on the default pool width
@@ -226,13 +240,13 @@ TPQ_PLAN_THREADS=1 TPQ_PRUNE=0 timeout -k 10 600 python -m pytest \
   "tests/test_prune.py::TestParity" \
   -q -p no:cacheprovider || fail "pruning parity (prune-off leg)"
 
-echo "=== stage 9/17: tpq-analyze invariant passes + sanitizer leg (strict) ==="
+echo "=== stage 9/18: tpq-analyze invariant passes + sanitizer leg (strict) ==="
 timeout -k 10 300 python -m tools.analyze || fail "tpq-analyze"
 timeout -k 10 600 python -m pytest tests/test_analyze.py \
   -q -p no:cacheprovider || fail "analyzer self-test"
 timeout -k 10 900 bash tools/analyze/native.sh || fail "native sanitizers"
 
-echo "=== stage 10/17: gather placement parity gate (strict) ==="
+echo "=== stage 10/18: gather placement parity gate (strict) ==="
 # leg A: the placement suite — byte parity placed vs replicated across
 # filter/quarantine/salvage/resume/multi-host, placement + counter pins,
 # mesh-mismatch errors
@@ -245,7 +259,7 @@ TPQ_GATHER_TO=0 timeout -k 10 600 python -m pytest \
   tests/test_gather_placement.py \
   -q -p no:cacheprovider || fail "gather placement (env leg)"
 
-echo "=== stage 11/17: write-pipeline parity gate (strict) ==="
+echo "=== stage 11/18: write-pipeline parity gate (strict) ==="
 # leg A: the whole native-write suite on the default knobs
 timeout -k 10 600 python -m pytest tests/test_write_native.py \
   -q -p no:cacheprovider || fail "write parity"
@@ -256,7 +270,7 @@ TPQ_WRITE_NATIVE=0 timeout -k 10 600 python -m pytest \
   tests/test_write_native.py -q -p no:cacheprovider \
   || fail "write parity (native-off leg)"
 
-echo "=== stage 12/17: causal tracing + attribution + bench sentinel (strict) ==="
+echo "=== stage 12/18: causal tracing + attribution + bench sentinel (strict) ==="
 # leg A: the trace/attribution suite on the default (trace-off) env —
 # span-tree connectivity, adversity-matrix propagation, ledger
 # conservation, doctor goldens
@@ -276,7 +290,7 @@ TPQ_TRACE=1 timeout -k 10 900 python -m pytest \
 timeout -k 10 600 python tools/bench_sentinel.py --check \
   || fail "bench sentinel"
 
-echo "=== stage 13/17: soak smoke: faults -> alerts, exact sums, byte identity (strict) ==="
+echo "=== stage 13/18: soak smoke: faults -> alerts, exact sums, byte identity (strict) ==="
 # N=4 concurrent labeled scans with the deterministic fault plan
 # (CorruptPage on one tenant's unique column, hang + unit deadline on
 # another tenant's file).  Asserts the whole longitudinal contract:
@@ -285,7 +299,7 @@ echo "=== stage 13/17: soak smoke: faults -> alerts, exact sums, byte identity (
 timeout -k 10 600 python -m tools.soak --scans 4 \
   || fail "soak smoke"
 
-echo "=== stage 14/17: remote emulator: parity over an unreliable store (strict) ==="
+echo "=== stage 14/18: remote emulator: parity over an unreliable store (strict) ==="
 # leg A: the dedicated remote suite — URI routing, coalescer property
 # sweep, tiered-cache conservation + poisoning + torn-file restart,
 # emu parity with the cache on AND off, hedged slow replicas
@@ -310,7 +324,7 @@ TPQ_SOURCE=emu TPQ_CACHE_DISK_MB=0 TPQ_CACHE_MEM_MB=0 \
   tests/test_checkpoint.py -q -p no:cacheprovider \
   || fail "remote emulator (cache-off leg)"
 
-echo "=== stage 15/17: schedule chaos + runtime lock-order validation (strict) ==="
+echo "=== stage 15/18: schedule chaos + runtime lock-order validation (strict) ==="
 # leg A: one chaos seed over the plan-parallel and soak-parity suites
 # — the seeded schedule perturbation must reproduce the unperturbed
 # baseline exactly (tests/test_chaos.py runs the full 3-seed sweep in
@@ -323,7 +337,7 @@ timeout -k 10 600 python -m tools.chaos --seeds 101 \
 TPQ_LOCKCHECK=1 timeout -k 10 600 python -m tools.soak --scans 4 \
   --chaos-seed 101 || fail "lockcheck soak leg"
 
-echo "=== stage 16/17: sampling profiler: armed parity + flame/doctor smoke (strict) ==="
+echo "=== stage 16/18: sampling profiler: armed parity + flame/doctor smoke (strict) ==="
 # leg A: profiler-ENABLED scan paths — the real sampler thread walks
 # sys._current_frames() through the whole scan suite and must not
 # change a byte of output (the byte-parity pins inside these suites
@@ -417,7 +431,7 @@ echo "$_CI_DOC" | grep -q "WARNING" \
   && fail "doctor --profile (consistency warning)"
 rm -rf "$_CI_PROF"
 
-echo "=== stage 17/17: scan server: arbiter + admission + drain (strict) ==="
+echo "=== stage 17/18: scan server: arbiter + admission + drain (strict) ==="
 # leg A: the serve suite — arbiter apportionment (anti-starvation
 # floors, bounded boosts), admission load-shedding, the in-process
 # server path, and the SIGTERM/SIGKILL drain-resume sweep
@@ -441,5 +455,24 @@ done
 TPQ_PLAN_THREADS=2 TPQ_WRITE_THREADS=2 timeout -k 10 600 \
   python -m pytest tests/test_shard.py tests/test_plan_parallel.py \
   -q -p no:cacheprovider || fail "legacy-knob leg"
+
+echo "=== stage 18/18: partitioned datasets: atomic commits + kill sweep (strict) ==="
+# leg A: the dataset suite with the slow marker INCLUDED — the
+# kill-at-every-step sweep, the first-commit snapshot-or-nothing pin,
+# pruning/quarantine/compaction/interop, and the chaos kill/resume
+# legs (seeds 101/202/303 baked into the parametrize) where the
+# resume runs under TPQ_LOCKCHECK=strict and must post zero lock
+# findings with exact counter conservation vs the unperturbed oracle
+timeout -k 10 600 python -m pytest tests/test_dataset.py \
+  -q -p no:cacheprovider || fail "dataset suite + kill sweep"
+# leg B: concurrent scan+write admission under one arbiter — the
+# soak's dataset leg across the same three chaos seeds: the writer
+# tenant's commit must survive seeded interleaving perturbation and
+# read back complete and duplicate-free through submit_dataset
+for _ci_seed in 101 202 303; do
+  TPQ_LOCKCHECK=strict timeout -k 10 600 python -m tools.soak \
+    --dataset --scans 4 --chaos-seed "$_ci_seed" \
+    || fail "dataset soak leg (seed $_ci_seed)"
+done
 
 echo "ci.sh: gate PASSED"

@@ -277,6 +277,101 @@ def run_serve_leg(corpus: dict[str, list[str]], *, ring_dir: str,
     return results, meta
 
 
+def run_dataset_leg(corpus: dict[str, list[str]], *, root: str,
+                    state_dir: str) -> tuple[dict, list[str]]:
+    """The dataset-writing tenant: one tenant COMMITS a hive-
+    partitioned dataset through the atomic manifest protocol while a
+    scan tenant runs through the same server — concurrent scan+write
+    admission under one arbiter (the writer's encode pool sizes from
+    its tenant share via ``arbiter.write_budget()``).  The freshly
+    committed dataset is then admitted back as a dataset job
+    (:meth:`ScanServer.submit_dataset`) and the decoded ids must be
+    complete and duplicate-free.  Returns ``(meta, failures)``."""
+    import numpy as np
+
+    from tpuparquet.dataset import DatasetWriter
+    from tpuparquet.serve import ScanServer
+    from tpuparquet.serve import arbiter as _arb
+
+    ds_root = os.path.join(root, "dataset")
+    n = 240
+    failures: list[str] = []
+    meta: dict = {}
+    write_err: list[str] = []
+    server = ScanServer(state_dir=state_dir, rebalance_interval=0.2)
+    try:
+        server.add_tenant("ds_scan")
+        server.add_tenant("ds_writer")
+
+        def write_ds():
+            try:
+                with _arb.tenant_scope("ds_writer"):
+                    w = DatasetWriter(
+                        ds_root,
+                        "message rec { required int64 id; "
+                        "required binary part (STRING); }",
+                        ["part"])
+                    step = n // 4
+                    for batch in range(4):
+                        seg = list(range(batch * step,
+                                         (batch + 1) * step))
+                        w.write_columns({
+                            "id": np.asarray(seg, dtype=np.int64),
+                            "part": [b"a" if i % 2 else b"b"
+                                     for i in seg],
+                        })
+                    w.commit()
+                    w._release()
+            except BaseException as e:  # noqa: BLE001 — reported
+                write_err.append(f"dataset: writer failed: {e!r}")
+
+        # scan load + dataset write race through the same arbiter
+        t = threading.Thread(target=write_ds, name="ds-writer")
+        t.start()
+        scan_job = server.submit(
+            "ds_scan", corpus[tenant_label(0)], job_id="ds-bg-scan",
+            scan_deadline=SERVE_SCAN_DEADLINE_S)
+        if not scan_job.wait(SERVE_SCAN_DEADLINE_S + 60):
+            failures.append("dataset: background scan never finished")
+        elif scan_job.state != "done":
+            failures.append(
+                f"dataset: background scan ended {scan_job.state!r}")
+        t.join(SERVE_SCAN_DEADLINE_S)
+        failures += write_err
+        if not failures:
+            ds_job = server.submit_dataset(
+                "ds_scan", ds_root, "id", job_id="ds-read",
+                scan_deadline=SERVE_SCAN_DEADLINE_S)
+            if not ds_job.wait(SERVE_SCAN_DEADLINE_S + 60):
+                failures.append("dataset: read-back job never "
+                                "finished")
+            elif ds_job.state != "done":
+                failures.append(
+                    f"dataset: read-back ended {ds_job.state!r} "
+                    f"({ds_job.error!r})")
+            else:
+                got: list[int] = []
+                for k in sorted(ds_job.outputs):
+                    vals, _rep, _dl = ds_job.outputs[k]["id"].to_numpy()
+                    got.extend(int(v) for v in
+                               np.asarray(vals).ravel())
+                if sorted(got) != list(range(n)):
+                    failures.append(
+                        f"dataset: read-back ids not complete/"
+                        f"duplicate-free ({len(got)} rows, "
+                        f"{len(set(got))} distinct, want {n})")
+                meta = {"est_bytes": ds_job.est_bytes,
+                        "units": ds_job.units_total,
+                        "rows": len(got)}
+                if not ds_job.est_bytes:
+                    failures.append(
+                        "dataset: admission did not charge the "
+                        "manifest byte estimate")
+    finally:
+        server.shutdown()
+    return meta, failures
+
+
 def _soak_rules(labels: list[str]) -> list:
     """The alert-coverage rule set both the raw and serve legs are
     held to: one rule per injected fault class, a burn-rate rule on
@@ -554,6 +649,14 @@ def main(argv=None) -> int:
                          "byte-identical to the direct-scan control, "
                          "no tenant starves, and the per-tenant "
                          "accounting stays exact")
+    ap.add_argument("--dataset", action="store_true",
+                    help="add a dataset leg: a writer tenant commits "
+                         "a hive-partitioned dataset through the "
+                         "atomic manifest protocol while a scan "
+                         "tenant runs through the same server, then "
+                         "the dataset is admitted back as a scan job "
+                         "and must read back complete and "
+                         "duplicate-free")
     args = ap.parse_args(argv)
     if args.scans < 4:
         print("soak: --scans must be >= 4 (corrupt + deadline + "
@@ -603,6 +706,14 @@ def main(argv=None) -> int:
                                     serve_ring, serve_alerts,
                                     remote_control)
             failures += _lockcheck_failures()
+        dsmeta: dict = {}
+        if args.dataset:
+            ds_state = os.path.join(root, "dataset-state")
+            with _scope():
+                dsmeta, ds_failures = run_dataset_leg(
+                    corpus, root=root, state_dir=ds_state)
+            failures += ds_failures
+            failures += _lockcheck_failures()
         result = {
             "scans": args.scans,
             "units_per_scan": args.units,
@@ -620,6 +731,8 @@ def main(argv=None) -> int:
                                  if k != "digest"}
                             for lb in sorted(serve)},
             }
+        if args.dataset:
+            result["dataset"] = dsmeta
         if args.json:
             print(json.dumps(result, sort_keys=True))
         else:

@@ -62,5 +62,6 @@ from .errors import (  # noqa: F401  (structured error taxonomy)
 )
 from .faults import QuarantineReport, inject_faults, retry_transient  # noqa: F401
 from .io import FileReader, FileWriter  # noqa: F401
+from .dataset import DatasetScan, DatasetWriter, compact_dataset  # noqa: F401
 from .filter import Filter, col, parse_filter  # noqa: F401
 from .stats import DecodeStats, collect_stats, trace  # noqa: F401

@@ -1,0 +1,38 @@
+"""Partitioned datasets: atomic multi-file writes, manifest-resolved
+scans, compaction.
+
+* :class:`DatasetWriter` — hive-partitioned writer whose commit is a
+  CRC-framed, atomically-renamed manifest journal: a SIGKILL at any
+  byte leaves the previous snapshot or a resumable journal, never a
+  torn dataset (``dataset/writer.py``).
+* :class:`DatasetScan` — reads only through the newest valid manifest,
+  with partition-value pruning composed in front of the per-file
+  stats/bloom/page-index layers (``dataset/scan.py``).
+* :func:`compact_dataset` — small-file merge, re-sorted by a filter
+  column, committed through the same protocol (``dataset/compact.py``).
+* :func:`sweep_orphans` — quarantines (never silently deletes)
+  staging leftovers from crashed writes (``dataset/manifest.py``).
+"""
+
+from .compact import compact_dataset, gc_unreferenced  # noqa: F401
+from .manifest import (  # noqa: F401
+    resolve_manifest,
+    sweep_orphans,
+)
+from .scan import (  # noqa: F401
+    DatasetScan,
+    partition_matches,
+    split_partition_filter,
+)
+from .writer import DatasetWriter  # noqa: F401
+
+__all__ = [
+    "DatasetWriter",
+    "DatasetScan",
+    "compact_dataset",
+    "gc_unreferenced",
+    "resolve_manifest",
+    "sweep_orphans",
+    "split_partition_filter",
+    "partition_matches",
+]

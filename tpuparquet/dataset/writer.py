@@ -1,0 +1,592 @@
+"""DatasetWriter: hive-partitioned multi-file writes with atomic
+dataset commit.
+
+Rows arrive as row-aligned column arrays (partition columns included);
+the writer routes them by partition value into per-partition streaming
+:class:`~tpuparquet.io.writer.FileWriter` files under ``_tmp/``, rolls
+a partition to a fresh file when it crosses the
+``TPQ_DATASET_TARGET_MB`` size target, and publishes everything in
+:meth:`commit` through the manifest-journal protocol
+(``dataset/manifest.py``):
+
+1. each open file is *staged*: footer + fsync, then renamed (within
+   ``_tmp/``) to its content-addressed name ``part-<sha1>.parquet`` —
+   a staged name asserts complete, durable content;
+2. the **journal** (``_commit.json``) is atomically written, recording
+   every staged file and its final partition path;
+3. each staged file is renamed into its ``key=value`` directory
+   (fault site ``dataset.file.promote``), idempotently — a file whose
+   final path already exists was promoted by a previous attempt;
+4. the new **manifest snapshot** is atomically written (previous
+   snapshot's files + the new ones) — this rename is the commit point;
+5. the journal is cleared and old snapshots pruned.
+
+SIGKILL before step 2 leaves the previous snapshot plus orphaned
+staging files (swept to quarantine, or reused bit-exact by a re-run —
+content addressing makes re-staging idempotent); SIGKILL after step 2
+leaves a journal from which ``DatasetWriter(root, ...,
+resume_from=root)`` finishes the commit duplicate-free without the
+caller re-supplying data.  Readers resolve only through manifests, so
+no intermediate state is ever visible.
+
+Concurrency: one :func:`~tpuparquet.io.writer._write_threads` budget
+is SPLIT across the partitions flushed by one ``write_columns`` call —
+``k`` partition files encode concurrently on an outer pool while each
+inner ``FileWriter`` gets ``encode_threads = max(1, W // k)`` — so a
+partitioned write never oversubscribes the box the way ``k``
+independent writers each sizing to ``W`` would.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as np
+
+from ..faults import fault_point
+from ..format.dsl import SchemaDefinition, parse_schema_definition
+from ..io.writer import FileWriter, _write_threads
+from . import manifest as mf
+
+__all__ = ["DatasetWriter", "target_bytes_default"]
+
+
+def target_bytes_default() -> int:
+    """``TPQ_DATASET_TARGET_MB`` — rolling file-size target per
+    partition file (default 64 MiB; a partition crossing it rolls to
+    a fresh content-addressed file at the next write boundary)."""
+    try:
+        v = float(os.environ.get("TPQ_DATASET_TARGET_MB", ""))
+    except ValueError:
+        return 64 * 1024 * 1024
+    return max(int(v * 1024 * 1024), 1)
+
+
+class _HashingTee:
+    """File-object facade that mirrors every write into an incremental
+    SHA-1 — the content address is known the moment the stream closes,
+    without re-reading the staged bytes."""
+
+    __slots__ = ("_fh", "sha1")
+
+    def __init__(self, fh):
+        self._fh = fh
+        self.sha1 = hashlib.sha1()
+
+    def write(self, data):
+        self.sha1.update(data)
+        return self._fh.write(data)
+
+    def flush(self):
+        self._fh.flush()
+
+    def fileno(self):
+        return self._fh.fileno()
+
+
+class _OpenPart:
+    """One in-flight partition file: its FileWriter, hashing tee, and
+    routing metadata.  The raw handle lives in the owning writer's
+    ``_handles`` registry (directory-scoped ownership: the writer's
+    close/abort release every member)."""
+
+    __slots__ = ("key", "partition", "partial", "tee", "writer", "rows")
+
+    def __init__(self, key, partition, partial, tee, writer):
+        self.key = key
+        self.partition = partition
+        self.partial = partial
+        self.tee = tee
+        self.writer = writer
+        self.rows = 0
+
+
+def _part_sort_key(key: tuple):
+    """Deterministic partition ordering for mixed-type keys (None
+    sorts first within a column)."""
+    return tuple((v is None, str(v)) for v in key)
+
+
+class DatasetWriter:
+    """Write a hive-partitioned dataset with atomic snapshot commits.
+
+    ``schema`` is the FULL row schema (a DSL string or
+    :class:`SchemaDefinition`) including the ``partition_by`` columns;
+    data files are written WITHOUT the partition columns (hive style —
+    their values live in the directory names and the manifest).
+    Partition columns must be top-level primitive leaves; v1 restricts
+    the data columns to flat (non-repeated) leaves.
+
+    ``resume_from`` (normally the dataset root itself) picks up a
+    crashed commit: a pending journal's files are folded into this
+    writer's commit, and re-supplied data dedups against already
+    staged/promoted content by content address — the resumed dataset
+    is bit-exact with an uninterrupted write.
+
+    Use as a context manager: a clean exit commits, an exception
+    aborts (partials removed, staged files left for the orphan sweep).
+    """
+
+    def __init__(self, root, schema, partition_by, *,
+                 target_mb=None, resume_from=None, manifest_keep=None,
+                 step_hook=None, **writer_options):
+        scheme, root_path = mf.split_root(root)
+        self.root = root
+        self.root_path = root_path
+        if isinstance(partition_by, str):
+            partition_by = (partition_by,)
+        self.partition_by = tuple(partition_by)
+        if isinstance(schema, str):
+            schema = parse_schema_definition(schema)
+        if not isinstance(schema, SchemaDefinition):
+            raise TypeError(
+                "schema must be a DSL string or SchemaDefinition, "
+                f"not {type(schema).__name__}")
+        self.schema = schema
+        self._data_schema = self._split_schema(schema)
+        self._target = int(target_mb * 1024 * 1024) \
+            if target_mb is not None else target_bytes_default()
+        self._keep = manifest_keep
+        self._step_hook = step_hook
+        self._writer_options = dict(writer_options)
+        self._parts: dict = {}
+        self._handles: dict = {}
+        self._staged: list = []
+        self._seq = 0
+        self._closed = False
+        os.makedirs(os.path.join(root_path, mf.TMP_DIR), exist_ok=True)
+        self._journal = None
+        if resume_from:
+            if isinstance(resume_from, str):
+                _, resume_path = mf.split_root(resume_from)
+                if os.path.abspath(resume_path) != \
+                        os.path.abspath(root_path):
+                    raise ValueError(
+                        f"resume_from={resume_from!r} does not name "
+                        f"this dataset root {root!r}")
+            self._journal = mf.load_journal(root_path)
+
+    # -- schema routing ---------------------------------------------------
+
+    def _split_schema(self, sd: SchemaDefinition) -> SchemaDefinition:
+        """The data-file schema: the full schema minus the partition
+        columns (which must be top-level primitive leaves)."""
+        import copy
+
+        names = {c.name for c in sd.root.children}
+        for k in self.partition_by:
+            if k not in names:
+                raise ValueError(
+                    f"partition column {k!r} is not a top-level "
+                    f"schema field")
+        keep = []
+        for c in sd.root.children:
+            if c.name in self.partition_by:
+                if c.children:
+                    raise ValueError(
+                        f"partition column {c.name!r} must be a "
+                        f"primitive leaf, not a group")
+                continue
+            keep.append(copy.deepcopy(c))
+        if not keep:
+            raise ValueError(
+                "schema has no data columns besides the partition "
+                "keys")
+        root = copy.deepcopy(sd.root)
+        root.children = keep
+        out = SchemaDefinition(root)
+        out.validate()
+        return out
+
+    # -- writing ----------------------------------------------------------
+
+    def write_columns(self, columns: dict, *, masks=None) -> None:
+        """Route one batch of rows to their partition files.
+
+        ``columns`` maps column name -> ROW-ALIGNED values (numpy
+        array, or list for binary/string columns; partition columns
+        included and required non-null unless a None value routes the
+        row to the hive null partition).  ``masks`` maps data-column
+        name -> row-aligned bool validity (values at null rows are
+        ignored).  Each call appends one row group per touched
+        partition file.
+        """
+        if self._closed:
+            raise ValueError("dataset writer is closed")
+        masks = masks or {}
+        for k in self.partition_by:
+            if k not in columns:
+                raise ValueError(f"missing partition column {k!r}")
+            if k in masks:
+                raise ValueError(
+                    f"partition column {k!r} cannot carry a mask; "
+                    f"use None values for the hive null partition")
+        data_names = [c.name for c in self._data_schema.root.children]
+        for name in columns:
+            if name not in data_names and \
+                    name not in self.partition_by:
+                raise ValueError(f"unknown column {name!r}")
+        n_rows = None
+        for name, vals in columns.items():
+            n = len(vals)
+            if n_rows is None:
+                n_rows = n
+            elif n != n_rows:
+                raise ValueError(
+                    f"column {name!r} has {n} rows, expected {n_rows}")
+        if not n_rows:
+            return
+        groups = self._group_rows(columns, n_rows)
+        self._flush_groups(groups, columns, masks)
+
+    def _group_rows(self, columns, n_rows):
+        """partition-value tuple -> row-index array, in deterministic
+        partition order."""
+        cols = []
+        for k in self.partition_by:
+            vals = columns[k]
+            cols.append([None if v is None else
+                         (v.item() if isinstance(v, np.generic) else
+                          (v.decode("utf-8") if isinstance(v, bytes)
+                           else v))
+                         for v in (vals.tolist()
+                                   if isinstance(vals, np.ndarray)
+                                   else list(vals))])
+        buckets: dict = {}
+        for i in range(n_rows):
+            key = tuple(c[i] for c in cols)
+            buckets.setdefault(key, []).append(i)
+        return [(key, np.asarray(buckets[key], dtype=np.int64))
+                for key in sorted(buckets, key=_part_sort_key)]
+
+    def _slice(self, vals, mask, idx):
+        """Row-aligned (vals, mask) -> FileWriter's (dense non-null
+        values, mask) for the selected rows."""
+        if isinstance(vals, np.ndarray):
+            sub = vals[idx]
+        else:
+            lst = list(vals)
+            sub = [lst[i] for i in idx]
+        if mask is None:
+            return sub, None
+        m = np.asarray(mask, dtype=bool)[idx]
+        if isinstance(sub, np.ndarray):
+            return sub[m], m
+        return [v for v, keep in zip(sub, m) if keep], m
+
+    def _open_part(self, key, partition) -> _OpenPart:
+        self._seq += 1
+        partial = os.path.join(
+            self.root_path, mf.TMP_DIR,
+            f".partial.{os.getpid()}.{self._seq}")
+        # the raw handle is owned by the writer-level registry: close()
+        # and abort() release every member, so a failed flush cannot
+        # strand fds on abandoned _OpenParts
+        self._handles[key] = open(partial, "wb")
+        tee = _HashingTee(self._handles[key])
+        fw = FileWriter(tee, self._data_schema, **self._writer_options)
+        part = _OpenPart(key, partition, partial, tee, fw)
+        self._parts[key] = part
+        return part
+
+    def _flush_groups(self, groups, columns, masks) -> None:
+        budget = _write_threads()
+        share = max(1, budget // max(len(groups), 1))
+        jobs = []
+        for key, idx in groups:
+            part = self._parts.get(key)
+            if part is None:
+                partition = dict(zip(self.partition_by, key))
+                part = self._open_part(key, partition)
+            part.writer.encode_threads = share
+            cols = {}
+            mks = {}
+            for c in self._data_schema.root.children:
+                name = c.name
+                if name not in columns:
+                    continue
+                vals, m = self._slice(columns[name],
+                                      masks.get(name), idx)
+                cols[name] = vals
+                if m is not None:
+                    mks[name] = m
+            jobs.append((part, cols, mks, len(idx)))
+
+        def flush(part, cols, mks, n):
+            part.writer.write_columns(cols, masks=mks or None)
+            part.rows += n
+
+        if len(jobs) > 1 and budget > 1:
+            # outer pool over partitions: workers adopt the caller's
+            # trace context and collect stats per-thread, merged into
+            # the ambient collector (same discipline as the per-column
+            # pool in io/writer.py)
+            from concurrent.futures import ThreadPoolExecutor
+
+            from ..obs import trace as _trace
+            from ..stats import current_stats, worker_stats
+
+            _tctx = _trace.current_ctx()
+            _sink = current_stats()
+
+            def run(job):
+                with _trace.adopt(_tctx), worker_stats() as ws:
+                    flush(*job)
+                return ws
+
+            with ThreadPoolExecutor(
+                    max_workers=min(len(jobs), budget)) as ex:
+                for ws in ex.map(run, jobs):
+                    if _sink is not None:
+                        _sink.merge_from(ws)
+        else:
+            for job in jobs:
+                flush(*job)
+        # roll AFTER the parallel flush (deterministic: depends only
+        # on the bytes written, never on thread timing)
+        for key, _ in groups:
+            part = self._parts.get(key)
+            if part is not None and \
+                    part.writer.current_file_size() >= self._target:
+                self._stage_part(key)
+
+    def write_partition(self, partition: dict, columns: dict, *,
+                        masks=None, source_bytes=None) -> None:
+        """Write row-aligned DATA columns (no partition columns)
+        straight into one partition — the compaction path.  Rows are
+        chunked so the rolling size target still applies, with the
+        per-row byte estimate taken from ``source_bytes`` (the size of
+        the files being rewritten) when given."""
+        if self._closed:
+            raise ValueError("dataset writer is closed")
+        if set(partition) != set(self.partition_by):
+            raise ValueError(
+                f"partition {sorted(partition)} does not match "
+                f"partition_by {sorted(self.partition_by)}")
+        masks = masks or {}
+        n_rows = None
+        for name, vals in columns.items():
+            if n_rows is None:
+                n_rows = len(vals)
+            elif len(vals) != n_rows:
+                raise ValueError(
+                    f"column {name!r} has {len(vals)} rows, "
+                    f"expected {n_rows}")
+        if not n_rows:
+            return
+        est_row = max(int(source_bytes / n_rows), 1) \
+            if source_bytes else 64
+        chunk = max(self._target // (4 * est_row), 1)
+        key = tuple(partition[k] for k in self.partition_by)
+        idx_all = np.arange(n_rows, dtype=np.int64)
+        for lo in range(0, n_rows, chunk):
+            idx = idx_all[lo:lo + chunk]
+            part = self._parts.get(key)
+            if part is None:
+                part = self._open_part(key, dict(partition))
+            part.writer.encode_threads = None
+            cols, mks = {}, {}
+            for name, vals in columns.items():
+                v, m = self._slice(vals, masks.get(name), idx)
+                cols[name] = v
+                if m is not None:
+                    mks[name] = m
+            part.writer.write_columns(cols, masks=mks or None)
+            part.rows += len(idx)
+            if part.writer.current_file_size() >= self._target:
+                self._stage_part(key)
+
+    # -- staging / commit protocol ----------------------------------------
+
+    def _step(self, *label) -> None:
+        """Commit-protocol step boundary: the kill-sweep harness hooks
+        here to SIGKILL the writer between any two protocol actions."""
+        if self._step_hook is not None:
+            self._step_hook(label)
+
+    def _stage_part(self, key) -> dict:
+        """Finalize one partition file into its content-addressed
+        staging name.  After this returns, ``_tmp/part-<sha1>.parquet``
+        is complete and durable (a ``.partial.*`` name never is)."""
+        part = self._parts.pop(key)
+        fh = self._handles[key]
+        self._step("stage", part.partial)
+        part.writer.close()  # footer
+        fh.flush()
+        os.fsync(fh.fileno())
+        size = fh.tell()
+        fh.close()
+        del self._handles[key]
+        digest = part.tee.sha1.hexdigest()[:16]
+        name = f"part-{digest}.parquet"
+        staged = os.path.join(self.root_path, mf.TMP_DIR, name)
+        if os.path.exists(staged):
+            # identical content already staged (a resumed re-run):
+            # reuse it, drop the duplicate partial
+            os.unlink(part.partial)
+        else:
+            os.replace(part.partial, staged)
+            self._fsync_dir(os.path.dirname(staged))
+        pdir = mf.partition_dir(self.partition_by, part.partition)
+        rel = f"{pdir}/{name}" if pdir else name
+        entry = {"tmp": name, "path": rel,
+                 "partition": part.partition,
+                 "rows": part.rows, "bytes": size, "sha1": digest}
+        self._staged.append(entry)
+        return entry
+
+    def _fsync_dir(self, d: str) -> None:
+        try:
+            dfd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass
+
+    def _promote(self, entry: dict, tmp_refs: dict) -> None:
+        """Move one staged file to its final partition path —
+        idempotent, so a resumed commit re-runs it safely."""
+        rel = entry["path"]
+        final = os.path.join(self.root_path, rel)
+        fault_point("dataset.file.promote", file=rel)
+        # count down even when skipping: a resumed commit must still
+        # consume the staged copy on its LAST reference, or the
+        # leftover would read as a spurious orphan
+        tmp_refs[entry["tmp"]] -= 1
+        tmp = os.path.join(self.root_path, mf.TMP_DIR, entry["tmp"])
+        if os.path.exists(final):
+            # promoted by a previous attempt; a resume that re-staged
+            # the same content leaves a duplicate of the PUBLISHED
+            # file (same content address) — consume it
+            if tmp_refs[entry["tmp"]] <= 0 and os.path.exists(tmp):
+                os.unlink(tmp)
+            return
+        os.makedirs(os.path.dirname(final) or self.root_path,
+                    exist_ok=True)
+        if tmp_refs[entry["tmp"]] > 0:
+            # identical content published under several partition
+            # paths: keep the staged copy for the remaining entries
+            os.link(tmp, final)
+        else:
+            os.replace(tmp, final)
+        self._fsync_dir(os.path.dirname(final))
+
+    def commit(self, *, remove_paths=()):
+        """Run the commit protocol; returns the new manifest version
+        (or the current one when there is nothing to publish).  Safe
+        to call on a resumed writer with no new data — it finishes
+        whatever the journal recorded.  ``remove_paths`` drops base
+        files from the new snapshot (compaction: the merged-away
+        originals stay on disk, still referenced by older snapshots,
+        until snapshot pruning + GC collects them)."""
+        if self._closed:
+            raise ValueError("dataset writer is closed")
+        for key in sorted(self._parts, key=_part_sort_key):
+            self._stage_part(key)
+        new_files = {e["path"]: e for e in self._staged}
+        base_body, base_ver, _ = mf.resolve_manifest(self.root)
+        base_ver = base_ver or 0
+        version = base_ver + 1
+        if self._journal is not None:
+            if base_ver >= self._journal["version"]:
+                # the crashed run already published its manifest; run
+                # its cleanup step, then fall through to commit any
+                # NEW data at the next version
+                self._step("clean")
+                mf.clear_journal(self.root_path)
+                mf.prune_manifests(self.root_path, self._keep)
+                self._journal = None
+                if not new_files:
+                    self._staged = []
+                    return base_ver
+            else:
+                for e in self._journal["files"]:
+                    new_files.setdefault(e["path"], dict(e))
+                version = self._journal["version"]
+                # a journaled compaction's drop-list must survive the
+                # crash, or a resume would republish the merged-away
+                # originals next to their replacements
+                remove_paths = set(remove_paths) | \
+                    set(self._journal.get("remove_paths") or [])
+        if not new_files:
+            return base_ver if base_ver else None
+        entries = [new_files[p] for p in sorted(new_files)]
+        self._step("journal")
+        mf.write_journal(self.root_path, {
+            "version": version, "base_version": base_ver,
+            "partition_keys": list(self.partition_by),
+            "files": entries,
+            "remove_paths": sorted(remove_paths)})
+        tmp_refs: dict = {}
+        for e in entries:
+            tmp_refs[e["tmp"]] = tmp_refs.get(e["tmp"], 0) + 1
+        for e in entries:
+            self._step("promote", e["path"])
+            self._promote(e, tmp_refs)
+        base_files = list(base_body["files"]) if base_body else []
+        removed = set(remove_paths)
+        published = {p: {k: v for k, v in e.items() if k != "tmp"}
+                     for p, e in new_files.items()}
+        for e in base_files:
+            if e["path"] not in removed:
+                published.setdefault(e["path"], dict(e))
+        self._step("manifest")
+        mf.write_manifest(self.root_path, {
+            "version": version,
+            "partition_keys": list(self.partition_by),
+            "schema": str(self.schema),
+            "files": [published[p] for p in sorted(published)]})
+        self._step("clean")
+        mf.clear_journal(self.root_path)
+        mf.prune_manifests(self.root_path, self._keep)
+        self._journal = None
+        self._staged = []
+        return version
+
+    # -- lifecycle --------------------------------------------------------
+
+    def close(self):
+        """Commit pending data, then release every partition handle."""
+        if self._closed:
+            return
+        try:
+            self.commit()
+        finally:
+            self._release()
+
+    def abort(self):
+        """Discard without committing: every open partial is removed;
+        already-staged content is LEFT under ``_tmp/`` for the orphan
+        sweep (never silently deleted — a deliberate abort may still
+        be the only copy of expensive data)."""
+        if self._closed:
+            return
+        partials = [p.partial for p in self._parts.values()]
+        self._release()
+        for p in partials:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+    def _release(self):
+        self._closed = True
+        for fh in self._handles.values():
+            try:
+                fh.close()
+            except OSError:
+                pass
+        self._handles.clear()
+        self._parts.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self.close()
+        else:
+            self.abort()

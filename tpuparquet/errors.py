@@ -48,6 +48,7 @@ __all__ = [
     "CorruptPageError",
     "CorruptChunkError",
     "CorruptFooterError",
+    "CorruptManifestError",
     "TransientIOError",
     "DeviceDispatchError",
     "DeadlineExceededError",
@@ -135,6 +136,16 @@ class CorruptFooterError(ScanError, ValueError):
         if self.offset is not None:
             c["offset"] = self.offset
         return c
+
+
+class CorruptManifestError(ScanError, ValueError):
+    """A partitioned dataset's manifest (or commit journal) failed its
+    framing checks: not the envelope format, unknown version, CRC
+    mismatch over the canonical body, or a body that fails structural
+    validation.  The dataset-level analogue of
+    :class:`CorruptFooterError` — ``file`` carries the manifest path,
+    and the resolver degrades to the newest *older* snapshot that
+    validates (quarantining this one) rather than failing the scan."""
 
 
 class TransientIOError(ScanError, OSError):

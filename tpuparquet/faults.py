@@ -69,6 +69,19 @@ site                                  instrumented where / supported kinds
                                       as transient, never returned) —
                                       ``oserror``, ``transient``,
                                       ``corrupt``, ``truncate``
+``dataset.manifest.write``            dataset manifest / commit-journal
+                                      publication (``dataset/
+                                      manifest.py``, before the tmp
+                                      write) — ``oserror``,
+                                      ``transient``
+``dataset.manifest.load``             manifest / journal blob read
+                                      (``dataset/manifest.py``) —
+                                      ``oserror``, ``transient``,
+                                      ``corrupt``, ``truncate``
+``dataset.file.promote``              staged data-file rename into its
+                                      partition directory
+                                      (``dataset/writer.py``) —
+                                      ``oserror``, ``transient``
 ====================================  =====================================
 
 Kinds: ``oserror`` raises ``OSError(EIO)``; ``transient`` raises
@@ -141,6 +154,10 @@ SITES: dict[str, tuple] = {
     "io.remote.throttle": ("transient",),
     "io.remote.range": ("oserror", "transient",
                         "corrupt", "truncate"),
+    "dataset.manifest.write": ("oserror", "transient"),
+    "dataset.manifest.load": ("oserror", "transient",
+                              "corrupt", "truncate"),
+    "dataset.file.promote": ("oserror", "transient"),
 }
 
 _active: "FaultInjector | None" = None

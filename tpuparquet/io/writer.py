@@ -114,6 +114,7 @@ class FileWriter:
         page_index: bool | None = None,
         bloom_columns=None,
         page_rows: int | None = None,
+        encode_threads: int | None = None,
     ):
         self._f = f
         self._pos = 0
@@ -169,6 +170,12 @@ class FileWriter:
             except ValueError:
                 page_rows = 0
         self.page_rows = max(int(page_rows), 0)
+        # encode parallelism override: a caller that runs SEVERAL
+        # writers concurrently (the partitioned dataset writer) splits
+        # the shared TPQ_WRITE_THREADS budget across them and pins each
+        # writer's share here; None = size from the budget at flush
+        self.encode_threads = (max(int(encode_threads), 1)
+                               if encode_threads is not None else None)
 
         if schema is None:
             self.schema = Schema.empty()
@@ -676,7 +683,8 @@ class FileWriter:
         # external anchor was unbeatable single-threaded).  Gate on the
         # VALUE count (len(dl) covers list columns whose few rows hold
         # millions of elements); small flushes skip the pool.
-        n_workers = _write_threads()
+        n_workers = self.encode_threads \
+            if self.encode_threads is not None else _write_threads()
         total_values = sum(len(j[3]) for j in jobs)
         if len(jobs) > 1 and n_workers > 1 and total_values > 65536:
             from concurrent.futures import ThreadPoolExecutor

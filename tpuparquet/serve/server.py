@@ -200,20 +200,24 @@ class ScanServer:
                scan_deadline: float | None = None,
                retries: int | None = 0,
                checkpoint_every: int | None = None,
-               filter=None, sink=None) -> ScanJob:
+               filter=None, sink=None,
+               est_bytes: int | None = None) -> ScanJob:
         """Admit and enqueue one scan for ``tenant``.
 
         Raises :class:`AdmissionRejected` (retryable) when draining,
         when the tenant's bounded queue is full, or when its byte /
         deadline budget cannot take the job — the request never
         hangs.  ``job_id`` keys the durable cursor: resubmitting the
-        same id on a successor server resumes the checkpoint."""
+        same id on a successor server resumes the checkpoint.
+        ``est_bytes`` overrides the local-stat sizing when the caller
+        already knows the read size (dataset manifests record it)."""
         if self._draining or self._closed:
             raise AdmissionRejected(
                 f"server is draining; resubmit tenant {tenant!r} "
                 f"work to the successor", tenant=tenant,
                 reason="draining", retry_after_s=5.0)
-        est = self._estimate_bytes(sources)
+        est = est_bytes if est_bytes is not None \
+            else self._estimate_bytes(sources)
         with self._cv:
             q = self._queues.get(tenant)
             depth = (len(q) if q is not None else 0) \
@@ -247,6 +251,38 @@ class ScanServer:
                 f"retry", tenant=tenant, reason="queue_full",
                 retry_after_s=1.0)
         return job
+
+    def submit_dataset(self, tenant: str, root, *columns: str,
+                       filter=None, **kw) -> ScanJob:
+        """Admit a partitioned-dataset scan (``tpuparquet/dataset/``).
+
+        The file list comes from the newest valid manifest;
+        partition-key conjuncts of ``filter`` prune files *before*
+        admission, and the byte-budget charge is the manifest's
+        recorded sizes for the surviving files (exact even for
+        remote ``emu://`` members, which local stat cannot size).
+        The residual predicate and every :meth:`submit` option pass
+        through; the job runs as an ordinary sharded scan over the
+        surviving members."""
+        from ..dataset import manifest as mf
+        from ..dataset.scan import (partition_matches,
+                                    split_partition_filter)
+
+        body, _version, findings = mf.resolve_manifest(root)
+        if body is None:
+            raise FileNotFoundError(
+                f"{root!r} has no valid manifest snapshot"
+                + (f" ({len(findings)} rejected)" if findings else ""))
+        part_pred, residual = split_partition_filter(
+            filter, body["partition_keys"])
+        sources, est = [], 0
+        for e in body["files"]:
+            if partition_matches(part_pred, e["partition"]):
+                sources.append(e.get("uri")
+                               or mf.file_uri(root, e["path"]))
+                est += int(e.get("bytes") or 0)
+        return self.submit(tenant, sources, *columns, filter=residual,
+                           est_bytes=est, **kw)
 
     # -- scheduling ------------------------------------------------------
 

@@ -171,6 +171,14 @@ class DecodeStats:
     # bloom-filter probes that answered "definitely absent" (each such
     # verdict licenses a prune; blooms have no false negatives)
     bloom_hits: int = 0
+    # -- partitioned datasets (tpuparquet/dataset/) --
+    # data files skipped entirely by partition-value pruning against
+    # the manifest (the scan never opens them — this composes BEFORE
+    # the per-file stats/bloom/page-index layers above)
+    dataset_files_pruned: int = 0
+    # orphaned staging files / stale journals moved to _quarantine/ by
+    # the dataset orphan sweep (never deleted silently)
+    dataset_orphans_swept: int = 0
     # exact-filter selectivity accounting: rows that entered exact
     # predicate evaluation vs rows that survived it (selectivity =
     # filter_rows_out / filter_rows_in); rows pruned statically never
@@ -266,6 +274,7 @@ class DecodeStats:
         "write_encode_s", "write_compress_s", "write_assemble_s",
         "row_groups_pruned", "pages_pruned", "rows_pruned",
         "bloom_hits", "filter_rows_in", "filter_rows_out",
+        "dataset_files_pruned", "dataset_orphans_swept",
         "gather_bytes_moved", "gather_bytes_replicated",
         "gather_reshard_s",
         "plan_cache_hits", "plan_cache_misses", "plan_cache_evictions",
@@ -349,6 +358,8 @@ class DecodeStats:
             "pages_pruned": self.pages_pruned,
             "rows_pruned": self.rows_pruned,
             "bloom_hits": self.bloom_hits,
+            "dataset_files_pruned": self.dataset_files_pruned,
+            "dataset_orphans_swept": self.dataset_orphans_swept,
             "filter_rows_in": self.filter_rows_in,
             "filter_rows_out": self.filter_rows_out,
             "selectivity": round(
