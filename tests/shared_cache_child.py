@@ -1,0 +1,142 @@
+"""Subprocess body for the cross-process shared-disk-cache sweeps
+(``tests/test_shared_cache.py``).
+
+Scans a corpus of source URIs through the process's cache tiers
+(``TPQ_CACHE_DISK_DIR`` + ``TPQ_CACHE_DISK_SHARED=1`` ride the normal
+env path) and writes a JSON result: a sha256 digest over every decoded
+array (byte-identity across processes and against the uncached
+oracle), the exact ``cache_*_disk`` / ``remote_*`` counters
+(conservation sums across processes), and any runtime-vs-static
+lock-graph divergences.
+
+Modes:
+
+* ``read``  — plain ``FileReader`` loop over every row group of every
+  source: one disk-cache lookup per column chunk, so the parent knows
+  the exact expected lookup count (files x groups x columns).
+* ``serve`` — a one-tenant :class:`ScanServer` job over the corpus
+  with the SLO-aware prefetch planner on: the fleet-origin-economy
+  leg, where N such processes over one shared cache dir must hit the
+  origin at most once each per coalesced span.
+
+Usage: python tests/shared_cache_child.py <mode> <corpus_json> <out_json>
+
+``corpus_json`` holds ``{"sources": [uri, ...]}``.  A chaos seed in
+``TPQ_CHAOS_SEED`` wraps the whole scan in ``chaos_scope()``;
+``TPQ_LOCKCHECK=strict`` raises in-process on any lock-order cycle.
+"""
+
+import hashlib
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import contextlib  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+from tpuparquet.faults import chaos_scope  # noqa: E402
+from tpuparquet.io import FileReader  # noqa: E402
+from tpuparquet.stats import collect_stats  # noqa: E402
+
+COUNTERS = ("cache_hits_disk", "cache_misses_disk",
+            "cache_evictions_disk", "cache_hits_mem",
+            "cache_misses_mem", "remote_ranges_fetched",
+            "remote_bytes", "remote_retry", "ranges_coalesced")
+
+
+def _fold(h, arr):
+    a = np.ascontiguousarray(np.asarray(arr))
+    h.update(str(a.dtype).encode())
+    h.update(str(a.shape).encode())
+    h.update(a.tobytes())
+
+
+def _scan_read(sources, h):
+    for uri in sources:
+        r = FileReader(uri)
+        try:
+            for g in range(len(r.meta.row_groups)):
+                arrays = r.read_row_group_arrays(g)
+                for path in sorted(arrays):
+                    col = arrays[path]
+                    h.update(path.encode())
+                    _fold(h, col.values)
+                    _fold(h, col.def_levels)
+                    _fold(h, col.rep_levels)
+        finally:
+            r.close()
+
+
+def _scan_serve(sources, h):
+    from tpuparquet.serve import ResourceArbiter, ScanServer
+
+    server = ScanServer(arbiter=ResourceArbiter(total_workers=2))
+    try:
+        server.add_tenant("fleet")
+        job = server.submit("fleet", sources)
+        assert job.wait(300.0), "serve job did not finish"
+        assert job.state == "done", f"job state {job.state}: {job.error}"
+        for k in sorted(job.outputs):
+            h.update(str(k).encode())
+            out = job.outputs[k]
+            for path in sorted(out):
+                h.update(path.encode())
+                for part in out[path].to_numpy():
+                    _fold(h, part)
+        return job.stats
+    finally:
+        server.shutdown(drain=False)
+
+
+def _lockcheck_failures():
+    """Runtime-vs-static lock-graph divergence, as the soak harness
+    checks it — empty means every runtime edge is statically known."""
+    if os.environ.get("TPQ_LOCKCHECK", "") != "strict":
+        return []
+    from tools.analyze import RepoTree, repo_root
+    from tools.analyze import threads as _threads
+    from tpuparquet import lockcheck
+
+    try:
+        tree = RepoTree.from_disk(repo_root())
+        return list(_threads.verify_runtime_graph(
+            tree, lockcheck.snapshot()))
+    except Exception as e:  # noqa: BLE001 — report, don't crash
+        return [f"lockcheck verify error: {e!r}"]
+
+
+def main() -> int:
+    mode, corpus_json, out_json = sys.argv[1], sys.argv[2], sys.argv[3]
+    with open(corpus_json) as f:
+        sources = json.load(f)["sources"]
+    h = hashlib.sha256()
+    ctx = chaos_scope() if os.environ.get("TPQ_CHAOS_SEED") \
+        else contextlib.nullcontext()
+    with ctx, collect_stats() as st:
+        if mode == "serve":
+            job_stats = _scan_serve(sources, h)
+            if job_stats is not None:
+                st = job_stats
+        else:
+            _scan_read(sources, h)
+    d = st.as_dict()
+    result = {
+        "pid": os.getpid(),
+        "digest": h.hexdigest(),
+        "counters": {k: d.get(k, 0) for k in COUNTERS},
+        "lockcheck": _lockcheck_failures(),
+    }
+    tmp = out_json + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f)
+    os.replace(tmp, out_json)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

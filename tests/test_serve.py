@@ -169,6 +169,30 @@ class TestAdmission:
             arb.admit("t", queue_depth=1, queue_bound=1)
         assert arb.tenants_state()["t"]["rejected"] == 1
 
+    def test_release_refunds_inflight_bytes(self):
+        # the byte budget caps IN-FLIGHT bytes: a finished job's
+        # charge is refunded, so a previously shed job clears the
+        # check on its retry
+        arb = ResourceArbiter(total_workers=2)
+        arb.register("t", byte_budget=100)
+        arb.admit("t", est_bytes=60)
+        with pytest.raises(AdmissionRejected) as ei:
+            arb.admit("t", est_bytes=60)
+        assert ei.value.reason == "byte_budget"
+        assert ei.value.retry_after_s > 0
+        arb.release("t", 60)  # the first job reached a terminal state
+        arb.admit("t", est_bytes=60)
+        st = arb.tenants_state()["t"]
+        # release is the job's normal end of life, not a rollback:
+        # the admitted/rejected tallies are untouched by it
+        assert st["admitted"] == 2
+        assert st["rejected"] == 1
+        assert st["bytes_admitted"] == 60
+        # over-release clamps at zero; unknown tenants are a no-op
+        arb.release("t", 10**9)
+        assert arb.tenants_state()["t"]["bytes_admitted"] == 0
+        arb.release("ghost", 5)
+
 
 # ----------------------------------------------------------------------
 # Activation + thread binding → thread budgets
@@ -367,6 +391,51 @@ class TestScanServer:
                 assert j.wait(120) and j.state == "done"
         finally:
             srv.shutdown()
+
+
+class TestServeRequeue:
+    """``parquet-tool serve`` treats admission shedding as backpressure,
+    not failure: a job rejected with a ``retry_after_s`` hint is held
+    back and resubmitted after the hinted delay."""
+
+    def test_byte_budget_shed_requeued_and_completes(self, tmp_path):
+        import argparse
+        import json
+
+        from tpuparquet.cli.parquet_tool import cmd_serve
+
+        p = str(tmp_path / "a.parquet")
+        write_file(p)
+        size = os.path.getsize(p)
+        # room for one job's bytes in flight, not two: the second
+        # submission is shed (byte_budget), then admitted once the
+        # first job's terminal state releases its charge
+        spec = {
+            "workers": 2,
+            "tenants": [{"label": "t",
+                         "byte_budget": int(size * 1.5)}],
+            "jobs": [
+                {"tenant": "t", "sources": [p], "columns": ["a"],
+                 "job_id": "j1"},
+                {"tenant": "t", "sources": [p], "columns": ["a"],
+                 "job_id": "j2"},
+            ],
+        }
+        sp = tmp_path / "spec.json"
+        sp.write_text(json.dumps(spec))
+        buf = io.StringIO()
+        old = signal.getsignal(signal.SIGTERM)
+        try:
+            rc = cmd_serve(argparse.Namespace(spec=str(sp)), out=buf)
+        finally:
+            signal.signal(signal.SIGTERM, old)
+        out = buf.getvalue()
+        assert rc == 0, out
+        assert "shed (byte_budget)" in out
+        assert "retrying in" in out
+        # both jobs — including the shed one — ran to completion
+        assert out.count(": done") == 2
+        assert "never admitted" not in out
 
 
 # ----------------------------------------------------------------------

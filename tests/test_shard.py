@@ -419,6 +419,104 @@ class TestResumableCursor:
             ShardedScan([buf], mesh=make_mesh(2, sp=1), resume=bad)
 
 
+class TestEpochShuffle:
+    """``shuffle_seed=`` + ``epoch=``: a deterministic per-epoch
+    permutation of the unit list — same data, reordered — with
+    checkpoint/resume pinned to the permutation's identity."""
+
+    N_FILES, N_GROUPS = 3, 4  # 12 units
+
+    @pytest.fixture
+    def corpus(self, tmp_path):
+        paths = []
+        for f in range(self.N_FILES):
+            p = str(tmp_path / f"f{f}.parquet")
+            buf, _ = _write_file(200, self.N_GROUPS, seed=70 + f)
+            with open(p, "wb") as fh:
+                fh.write(buf.getvalue())
+            paths.append(p)
+        return paths
+
+    def _run(self, corpus, **kw):
+        s = ShardedScan(corpus, "a", **kw)
+        units = list(s.units)
+        outs = [(units[k], repr(out["a"].to_numpy()))
+                for k, out in s.run_iter()]
+        s.close()
+        return units, outs
+
+    def test_no_seed_keeps_natural_order_epoch_ignored(self, corpus):
+        u0, o0 = self._run(corpus)
+        assert u0 == sorted(u0)
+        # epoch without a seed is inert: byte-identical to no-seed
+        u1, o1 = self._run(corpus, epoch=5)
+        assert u1 == u0
+        assert o1 == o0
+
+    def test_seeded_epochs_permute_deterministically(self, corpus):
+        u0, o0 = self._run(corpus)
+        u1, o1 = self._run(corpus, shuffle_seed=42, epoch=1)
+        u1b, o1b = self._run(corpus, shuffle_seed=42, epoch=1)
+        # same seed + epoch -> same permutation on every host
+        assert u1 == u1b and o1 == o1b
+        # a real permutation: reordered, nothing added or dropped,
+        # and every unit decodes to the same bytes as the natural run
+        assert u1 != u0
+        assert sorted(map(str, u1)) == sorted(map(str, u0))
+        assert sorted(o1) == sorted(o0)
+        # the next epoch reshuffles
+        u2, o2 = self._run(corpus, shuffle_seed=42, epoch=2)
+        assert u2 != u1
+        assert sorted(o2) == sorted(o0)
+        # and a different seed walks a different trajectory
+        u3, _ = self._run(corpus, shuffle_seed=7, epoch=1)
+        assert u3 != u1
+
+    def test_shuffled_resume_is_duplicate_free(self, corpus):
+        n = self.N_FILES * self.N_GROUPS
+        _, full = self._run(corpus, shuffle_seed=42, epoch=1)
+        s = ShardedScan(corpus, "a", shuffle_seed=42, epoch=1)
+        units = list(s.units)
+        it = s.run_iter()
+        got = []
+        for _ in range(4):  # decode 4 units, then "crash"
+            k, out = next(it)
+            got.append((units[k], repr(out["a"].to_numpy())))
+        it.close()
+        cur = s.state()
+        s.close()
+        assert cur["shuffle"] == [42, 1]
+        s2 = ShardedScan(corpus, "a", shuffle_seed=42, epoch=1,
+                         resume=cur)
+        units2 = list(s2.units)
+        assert units2 == units  # the permutation survived the cursor
+        got += [(units2[k], repr(out["a"].to_numpy()))
+                for k, out in s2.run_iter()]
+        s2.close()
+        # crash + resume == one uninterrupted shuffled epoch: same
+        # units, same order, same bytes, zero duplicates
+        assert got == full
+        assert len({str(u) for u, _ in got}) == n
+
+    def test_resume_refuses_mismatched_shuffle(self, corpus):
+        s = ShardedScan(corpus, "a", shuffle_seed=42, epoch=1)
+        it = s.run_iter()
+        next(it)
+        it.close()
+        cur = s.state()
+        s.close()
+        # a different seed or epoch permutes differently: resuming
+        # the cursor there would re-decode or skip units
+        with pytest.raises(ValueError):
+            ShardedScan(corpus, "a", shuffle_seed=7, epoch=1,
+                        resume=cur)
+        with pytest.raises(ValueError):
+            ShardedScan(corpus, "a", shuffle_seed=42, epoch=2,
+                        resume=cur)
+        with pytest.raises(ValueError):
+            ShardedScan(corpus, "a", resume=cur)  # seedless resume
+
+
 class TestMultiHostCursor:
     def test_state_resume_roundtrip(self, tmp_path):
         import json

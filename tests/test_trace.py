@@ -653,6 +653,128 @@ class TestDoctor:
         assert main(["doctor", str(path)]) == 1
 
 
+# Remote counter profile where the origin absorbed most of the range
+# demand: 9 hits vs 21 origin fetches -> hit ratio 0.3 (< 0.5), which
+# together with a read-bound trace verdict must crown ORIGIN-BOUND.
+ORIGIN_HEAVY = {
+    "remote_ranges_fetched": 21, "remote_bytes": 1_048_576,
+    "ranges_coalesced": 6, "cache_hits_mem": 4, "cache_hits_disk": 5,
+    "cache_misses_mem": 9, "cache_misses_disk": 12,
+    "cache_evictions_disk": 2, "remote_retry": 3,
+    "hedges_issued": 2, "hedges_won": 1,
+}
+
+# Same scan with the cache doing its job: 27 hits vs 3 fetches ->
+# ratio 0.9.  Read-bound or not, that is disk-bound, never
+# origin-bound.
+CACHE_HEAVY = dict(ORIGIN_HEAVY, remote_ranges_fetched=3,
+                   cache_hits_mem=13, cache_hits_disk=14,
+                   cache_misses_mem=2, cache_misses_disk=1,
+                   cache_evictions_disk=0)
+
+
+class TestDoctorRemote:
+    def test_report_none_without_remote_activity(self):
+        assert attribution.remote_report({}) is None
+        # pure-local scans accrue decode/plan counters but no remote
+        # or cache traffic: the REMOTE section must stay silent
+        assert attribution.remote_report(
+            {"decode_cpu_s": 4.2, "cache_hits_mem": 0,
+             "remote_ranges_fetched": 0}) is None
+
+    def test_report_exact_math(self):
+        rr = attribution.remote_report(ORIGIN_HEAVY,
+                                       verdict="read-bound")
+        assert rr["origin_fetches"] == 21
+        assert rr["origin_bytes"] == 1_048_576
+        assert rr["ranges_coalesced"] == 6
+        # hits (4 + 5) over demand (9 hits + 21 fetches)
+        assert rr["hit_ratio"] == pytest.approx(9 / 30)
+        assert rr["retries"] == 3
+        assert rr["hedges_issued"] == 2
+        assert rr["hedges_won"] == 1
+        assert rr["origin_bound"] is True
+
+    def test_origin_bound_needs_read_bound_verdict(self):
+        # a plan-bound scan with a cold cache is NOT origin-bound:
+        # the origin isn't on the critical path
+        rr = attribution.remote_report(ORIGIN_HEAVY,
+                                       verdict="plan-bound")
+        assert rr["origin_bound"] is False
+        assert attribution.remote_report(
+            ORIGIN_HEAVY, verdict=None)["origin_bound"] is False
+
+    def test_origin_bound_needs_origin_dominated_demand(self):
+        # read-bound but the cache absorbed 90% of demand: the cure
+        # is more local disk bandwidth, not prefetch depth
+        rr = attribution.remote_report(CACHE_HEAVY,
+                                       verdict="read-bound")
+        assert rr["hit_ratio"] == pytest.approx(0.9)
+        assert rr["origin_bound"] is False
+
+    def test_golden_remote_section_rendering(self):
+        # beside the existing verdicts: the synthetic trace is
+        # read-bound, the ledger is origin-heavy -> both the REMOTE
+        # line and the ORIGIN-BOUND note (with its cures) render
+        d = attribution.diagnose(synthetic_trace())
+        assert d["verdict"] == "read-bound"
+        txt = attribution.format_diagnosis(
+            d, ledgers={"golden": {"cpu_s": {},
+                                   "counters": ORIGIN_HEAVY}})
+        assert "REMOTE[golden]:" in txt
+        assert "origin 21 fetches / 1,048,576B (coalesced 6)" in txt
+        assert "hit ratio 30.0%" in txt
+        assert "retries=3" in txt
+        assert "hedges=1/2" in txt
+        assert "evictions=2" in txt
+        assert "ORIGIN-BOUND" in txt
+        assert "TPQ_PREFETCH_DEPTH" in txt
+        assert "TPQ_CACHE_DISK_MB" in txt
+
+    def test_remote_section_without_origin_bound(self):
+        d = attribution.diagnose(synthetic_trace())
+        txt = attribution.format_diagnosis(
+            d, ledgers={"golden": {"cpu_s": {},
+                                   "counters": CACHE_HEAVY}})
+        assert "REMOTE[golden]:" in txt
+        assert "hit ratio 90.0%" in txt
+        # evictions suffix is elided at zero
+        assert "evictions=" not in txt
+        assert "ORIGIN-BOUND" not in txt
+
+    def test_local_scan_has_no_remote_section(self):
+        d = attribution.diagnose(synthetic_trace())
+        txt = attribution.format_diagnosis(
+            d, ledgers={"golden": {"cpu_s": {}, "counters": {}}})
+        assert "REMOTE[" not in txt
+
+    def test_doctor_cli_json_remote_key(self, tmp_path, capsys):
+        from tpuparquet.cli.parquet_tool import main
+
+        path = tmp_path / "trace.json"
+        write_trace_file(synthetic_trace(), str(path),
+                         ledgers={"golden": {
+                             "cpu_s": {}, "counters": ORIGIN_HEAVY}})
+        assert main(["doctor", "--json", str(path)]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        rr = doc["remote"]["golden"]
+        assert rr["origin_fetches"] == 21
+        assert rr["hit_ratio"] == pytest.approx(0.3)
+        assert rr["origin_bound"] is True
+
+    def test_doctor_cli_renders_remote(self, tmp_path, capsys):
+        from tpuparquet.cli.parquet_tool import main
+
+        path = tmp_path / "trace.json"
+        write_trace_file(synthetic_trace(), str(path),
+                         ledgers={"golden": {
+                             "cpu_s": {}, "counters": ORIGIN_HEAVY}})
+        assert main(["doctor", str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "REMOTE[golden]:" in out
+        assert "ORIGIN-BOUND" in out
+
+
 # ----------------------------------------------------------------------
 # Exports
 # ----------------------------------------------------------------------

@@ -486,7 +486,11 @@ class _Program:
                             cf.path, f"{cf.name}.{t.attr}")
                         self._add_site(site, label, kind)
                         cf.lock_attrs[t.attr] = (site, kind)
-                    elif ctor and ctor[:1].isupper():
+                    elif ctor and ctor.lstrip("_")[:1].isupper():
+                        # CapWord possibly behind a privacy prefix:
+                        # ``self._pool = _HttpConnPool(...)`` must
+                        # type the attr or the pool's lock reach
+                        # (its Condition) vanishes from the graph
                         cf.attr_types[t.attr] = ctor
                     elif isinstance(node.value,
                                     (ast.Attribute, ast.Name)):

@@ -1,7 +1,7 @@
 #!/bin/bash
 # In-repo CI gate (counterpart of the reference's .circleci/config.yml,
 # which pins go versions and runs `go test ./...` + the compatibility
-# corpus per commit).  Eighteen stages, pinned env:
+# corpus per commit).  Nineteen stages, pinned env:
 #
 #   1. tier-1 suite   — the ROADMAP.md verify command, gated on a PASS
 #                       FLOOR rather than rc: optional deps (zstandard,
@@ -151,6 +151,24 @@
 #                       same arbiter, and the dataset reads back
 #                       complete and duplicate-free through
 #                       submit_dataset admission
+#  19. http(s) backend  — strict (rc=0): the HTTP range-backend gate.
+#                       The http-source suite (Range/ETag/If-Match
+#                       protocol, status taxonomy, retry ladder over
+#                       scripted 429/503/reset/short faults) and the
+#                       cross-process shared-disk-cache suite (two
+#                       concurrent scanners over one cache dir under
+#                       chaos seeds: byte identity, exact counter
+#                       conservation, kill/resume at arbitrary
+#                       offsets, fleet-visible poison eviction), then
+#                       a remote-equivalence leg: the scan/prune/
+#                       checkpoint suites re-run with TPQ_SOURCE=http
+#                       rerouted through a live tools/httpfault
+#                       server (root /, mild throttle+reset plan) and
+#                       must pass unmodified, then the soak's --http
+#                       leg (429/503/reset storm + mid-scan ETag
+#                       flip, zero quarantines, byte identity to the
+#                       local control) under TPQ_LOCKCHECK=strict
+#                       across three chaos seeds
 #
 # Usage: bash tools/ci.sh            (exit 0 = gate passed)
 # The tier-1 stage mirrors ROADMAP.md exactly — if you change one,
@@ -173,7 +191,7 @@ CI_PASS_FLOOR=${CI_PASS_FLOOR:-1000}
 
 fail() { echo "ci.sh: FAILED at stage $1" >&2; exit 1; }
 
-echo "=== stage 1/18: tier-1 suite (pass floor $CI_PASS_FLOOR) ==="
+echo "=== stage 1/19: tier-1 suite (pass floor $CI_PASS_FLOOR) ==="
 rm -f /tmp/_t1.log
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
@@ -187,25 +205,25 @@ echo "DOTS_PASSED=$passed"
 [ "$passed" -ge "$CI_PASS_FLOOR" ] \
   || fail "tier-1 ($passed passed < floor $CI_PASS_FLOOR)"
 
-echo "=== stage 2/18: smoke bench (CPU backend, tiny target) ==="
+echo "=== stage 2/19: smoke bench (CPU backend, tiny target) ==="
 TPQ_BENCH_TARGET=60000 TPQ_BENCH_CPU=1 timeout -k 10 600 \
   python bench.py > /tmp/_ci_bench.json || fail "smoke bench"
 tail -1 /tmp/_ci_bench.json
 
-echo "=== stage 3/18: crash corpus + fault-injection matrix (strict) ==="
+echo "=== stage 3/19: crash corpus + fault-injection matrix (strict) ==="
 timeout -k 10 600 python -m pytest \
   "tests/test_corpus.py::TestCrashRegressions" tests/test_faults.py \
   -q -p no:cacheprovider || fail "corpus/faults"
 
-echo "=== stage 4/18: salvage + strict metadata (strict) ==="
+echo "=== stage 4/19: salvage + strict metadata (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_salvage.py \
   -q -p no:cacheprovider || fail "salvage"
 
-echo "=== stage 5/18: deadlines/hedging + kill-resume checkpoints (strict) ==="
+echo "=== stage 5/19: deadlines/hedging + kill-resume checkpoints (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_deadline.py \
   tests/test_checkpoint.py -q -p no:cacheprovider || fail "time/crash"
 
-echo "=== stage 6/18: plan matrix: serial vs parallel, cache on (strict) ==="
+echo "=== stage 6/19: plan matrix: serial vs parallel, cache on (strict) ==="
 # leg A: pinned-serial planning (the TPQ_PLAN_THREADS=1 reference path)
 TPQ_PLAN_THREADS=1 timeout -k 10 600 python -m pytest \
   tests/test_plan_parallel.py tests/test_plan_cache.py \
@@ -216,7 +234,7 @@ TPQ_PLAN_CACHE_MB=64 timeout -k 10 600 python -m pytest \
   tests/test_plan_parallel.py tests/test_fallback_matrix.py \
   -q -p no:cacheprovider || fail "plan matrix (cache-on leg)"
 
-echo "=== stage 7/18: live obs gate + overhead guard (strict) ==="
+echo "=== stage 7/19: live obs gate + overhead guard (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_live_obs.py \
   tests/test_env_docs.py -q -p no:cacheprovider || fail "live obs"
 # overhead guard: the always-on default must stay within a generous
@@ -227,7 +245,7 @@ timeout -k 10 600 python tools/bench_obs.py --values 2000000 \
   || fail "obs overhead guard"
 tail -5 /tmp/_ci_obs.json
 
-echo "=== stage 8/18: pruning parity gate (strict) ==="
+echo "=== stage 8/19: pruning parity gate (strict) ==="
 # leg A: the whole pushdown suite (write/read page index + bloom,
 # verdicts, late materialization, counter exactness, corrupt-index
 # degrade, pyarrow interop) on the default pool width
@@ -240,13 +258,13 @@ TPQ_PLAN_THREADS=1 TPQ_PRUNE=0 timeout -k 10 600 python -m pytest \
   "tests/test_prune.py::TestParity" \
   -q -p no:cacheprovider || fail "pruning parity (prune-off leg)"
 
-echo "=== stage 9/18: tpq-analyze invariant passes + sanitizer leg (strict) ==="
+echo "=== stage 9/19: tpq-analyze invariant passes + sanitizer leg (strict) ==="
 timeout -k 10 300 python -m tools.analyze || fail "tpq-analyze"
 timeout -k 10 600 python -m pytest tests/test_analyze.py \
   -q -p no:cacheprovider || fail "analyzer self-test"
 timeout -k 10 900 bash tools/analyze/native.sh || fail "native sanitizers"
 
-echo "=== stage 10/18: gather placement parity gate (strict) ==="
+echo "=== stage 10/19: gather placement parity gate (strict) ==="
 # leg A: the placement suite — byte parity placed vs replicated across
 # filter/quarantine/salvage/resume/multi-host, placement + counter pins,
 # mesh-mismatch errors
@@ -259,7 +277,7 @@ TPQ_GATHER_TO=0 timeout -k 10 600 python -m pytest \
   tests/test_gather_placement.py \
   -q -p no:cacheprovider || fail "gather placement (env leg)"
 
-echo "=== stage 11/18: write-pipeline parity gate (strict) ==="
+echo "=== stage 11/19: write-pipeline parity gate (strict) ==="
 # leg A: the whole native-write suite on the default knobs
 timeout -k 10 600 python -m pytest tests/test_write_native.py \
   -q -p no:cacheprovider || fail "write parity"
@@ -270,7 +288,7 @@ TPQ_WRITE_NATIVE=0 timeout -k 10 600 python -m pytest \
   tests/test_write_native.py -q -p no:cacheprovider \
   || fail "write parity (native-off leg)"
 
-echo "=== stage 12/18: causal tracing + attribution + bench sentinel (strict) ==="
+echo "=== stage 12/19: causal tracing + attribution + bench sentinel (strict) ==="
 # leg A: the trace/attribution suite on the default (trace-off) env —
 # span-tree connectivity, adversity-matrix propagation, ledger
 # conservation, doctor goldens
@@ -290,7 +308,7 @@ TPQ_TRACE=1 timeout -k 10 900 python -m pytest \
 timeout -k 10 600 python tools/bench_sentinel.py --check \
   || fail "bench sentinel"
 
-echo "=== stage 13/18: soak smoke: faults -> alerts, exact sums, byte identity (strict) ==="
+echo "=== stage 13/19: soak smoke: faults -> alerts, exact sums, byte identity (strict) ==="
 # N=4 concurrent labeled scans with the deterministic fault plan
 # (CorruptPage on one tenant's unique column, hang + unit deadline on
 # another tenant's file).  Asserts the whole longitudinal contract:
@@ -299,7 +317,7 @@ echo "=== stage 13/18: soak smoke: faults -> alerts, exact sums, byte identity (
 timeout -k 10 600 python -m tools.soak --scans 4 \
   || fail "soak smoke"
 
-echo "=== stage 14/18: remote emulator: parity over an unreliable store (strict) ==="
+echo "=== stage 14/19: remote emulator: parity over an unreliable store (strict) ==="
 # leg A: the dedicated remote suite — URI routing, coalescer property
 # sweep, tiered-cache conservation + poisoning + torn-file restart,
 # emu parity with the cache on AND off, hedged slow replicas
@@ -324,7 +342,7 @@ TPQ_SOURCE=emu TPQ_CACHE_DISK_MB=0 TPQ_CACHE_MEM_MB=0 \
   tests/test_checkpoint.py -q -p no:cacheprovider \
   || fail "remote emulator (cache-off leg)"
 
-echo "=== stage 15/18: schedule chaos + runtime lock-order validation (strict) ==="
+echo "=== stage 15/19: schedule chaos + runtime lock-order validation (strict) ==="
 # leg A: one chaos seed over the plan-parallel and soak-parity suites
 # — the seeded schedule perturbation must reproduce the unperturbed
 # baseline exactly (tests/test_chaos.py runs the full 3-seed sweep in
@@ -337,7 +355,7 @@ timeout -k 10 600 python -m tools.chaos --seeds 101 \
 TPQ_LOCKCHECK=1 timeout -k 10 600 python -m tools.soak --scans 4 \
   --chaos-seed 101 || fail "lockcheck soak leg"
 
-echo "=== stage 16/18: sampling profiler: armed parity + flame/doctor smoke (strict) ==="
+echo "=== stage 16/19: sampling profiler: armed parity + flame/doctor smoke (strict) ==="
 # leg A: profiler-ENABLED scan paths — the real sampler thread walks
 # sys._current_frames() through the whole scan suite and must not
 # change a byte of output (the byte-parity pins inside these suites
@@ -431,7 +449,7 @@ echo "$_CI_DOC" | grep -q "WARNING" \
   && fail "doctor --profile (consistency warning)"
 rm -rf "$_CI_PROF"
 
-echo "=== stage 17/18: scan server: arbiter + admission + drain (strict) ==="
+echo "=== stage 17/19: scan server: arbiter + admission + drain (strict) ==="
 # leg A: the serve suite — arbiter apportionment (anti-starvation
 # floors, bounded boosts), admission load-shedding, the in-process
 # server path, and the SIGTERM/SIGKILL drain-resume sweep
@@ -456,7 +474,7 @@ TPQ_PLAN_THREADS=2 TPQ_WRITE_THREADS=2 timeout -k 10 600 \
   python -m pytest tests/test_shard.py tests/test_plan_parallel.py \
   -q -p no:cacheprovider || fail "legacy-knob leg"
 
-echo "=== stage 18/18: partitioned datasets: atomic commits + kill sweep (strict) ==="
+echo "=== stage 18/19: partitioned datasets: atomic commits + kill sweep (strict) ==="
 # leg A: the dataset suite with the slow marker INCLUDED — the
 # kill-at-every-step sweep, the first-commit snapshot-or-nothing pin,
 # pruning/quarantine/compaction/interop, and the chaos kill/resume
@@ -473,6 +491,51 @@ for _ci_seed in 101 202 303; do
   TPQ_LOCKCHECK=strict timeout -k 10 600 python -m tools.soak \
     --dataset --scans 4 --chaos-seed "$_ci_seed" \
     || fail "dataset soak leg (seed $_ci_seed)"
+done
+
+echo "=== stage 19/19: http(s) backend: fault server + shared cache (strict) ==="
+# leg A: the dedicated suites — the HTTP range source against the
+# deterministic fault server (status taxonomy, retry ladder, ETag
+# flips, bounded pool) and the cross-process shared disk cache (two
+# concurrent scanners, chaos seeds, kill/resume sweep, poison
+# eviction, fleet origin economy)
+timeout -k 10 900 python -m pytest tests/test_http_source.py \
+  tests/test_shared_cache.py -q -p no:cacheprovider \
+  || fail "http/shared-cache suites"
+# leg B: remote equivalence — the scan/prune/checkpoint suites re-run
+# with every bare-path open rerouted through a LIVE fault HTTP server
+# (TPQ_SOURCE=http + TPQ_HTTP_BASE; the server roots at / so rerouted
+# absolute paths resolve) under a mild deterministic fault plan; the
+# whole scan stack must be byte-exact over a throttling, resetting
+# HTTP origin, exactly like the emu:// leg of stage 14
+_CI_HTTP_DIR=$(mktemp -d)
+python -m tools.httpfault --root / --throttle-every 23 \
+  --reset-every 41 --url-file "$_CI_HTTP_DIR/url" \
+  > /dev/null 2>&1 &
+_CI_HTTP_PID=$!
+for _i in $(seq 1 50); do
+  [ -s "$_CI_HTTP_DIR/url" ] && break
+  sleep 0.1
+done
+[ -s "$_CI_HTTP_DIR/url" ] || { kill "$_CI_HTTP_PID" 2>/dev/null;
+  fail "httpfault server did not start"; }
+TPQ_SOURCE=http TPQ_HTTP_BASE=$(cat "$_CI_HTTP_DIR/url") \
+  timeout -k 10 900 python -m pytest tests/test_shard.py \
+  tests/test_prune.py tests/test_checkpoint.py -q \
+  -p no:cacheprovider
+_ci_http_rc=$?
+kill "$_CI_HTTP_PID" 2>/dev/null
+wait "$_CI_HTTP_PID" 2>/dev/null
+rm -rf "$_CI_HTTP_DIR"
+[ "$_ci_http_rc" -eq 0 ] || fail "http remote-equivalence leg"
+# leg C: the soak's http leg — scripted 429/503/reset storm, then a
+# mid-scan ETag flip, both byte-identical to the local control with
+# zero quarantined units — under the runtime lock-order recorder
+# across three chaos seeds
+for _ci_seed in 101 202 303; do
+  TPQ_LOCKCHECK=strict timeout -k 10 600 python -m tools.soak \
+    --http --scans 4 --chaos-seed "$_ci_seed" \
+    || fail "http soak leg (seed $_ci_seed)"
 done
 
 echo "ci.sh: gate PASSED"

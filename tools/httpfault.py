@@ -1,0 +1,324 @@
+#!/usr/bin/env python
+"""Deterministic fault-injecting HTTP range server (stdlib only).
+
+The ``http(s)://`` counterpart of the ``emu://`` object-store
+emulator: serves files under a root directory through real HTTP
+Range/ETag/If-Match semantics, with a scripted fault schedule keyed
+by a server-wide request counter — no wall-clock, no RNG — so a
+failing run replays identically and tier-1 never needs the network.
+
+Fault knobs (0/empty disables; ``match`` scopes faults to requests
+whose URL path contains the substring):
+
+* ``throttle_every`` — every Nth request answers 429 with a
+  ``Retry-After`` header (``retry_after_s``).
+* ``error_every``    — every Nth request answers 503.
+* ``reset_every``    — every Nth request drops the connection before
+  writing a status line (client sees a reset/remote-disconnect).
+* ``short_every``    — every Nth GET advertises the full
+  ``Content-Length`` but writes half the body and closes (client
+  sees a short/incomplete read).
+* ``slow_ms``        — fixed pause before every matching response
+  (the tail-latency replica hedging exists to route around).
+* ``etag_flip_at``   — from request N on, the served ETag changes
+  generation (as if the object were rewritten): conditional
+  ``If-Match`` GETs keyed on the old tag answer 412.
+
+Usage (library)::
+
+    from tools.httpfault import FaultPlan, serve
+    with serve(root_dir, FaultPlan(throttle_every=3)) as base:
+        src = HttpByteRangeSource(base + "/data/f.parquet")
+
+Usage (CLI)::
+
+    python -m tools.httpfault --root DIR [--port 0] \
+        [--throttle-every N] [--error-every N] [--reset-every N] \
+        [--short-every N] [--slow-ms MS] [--etag-flip-at N] \
+        [--url-file PATH]
+
+Prints the base URL on stdout (and to ``--url-file`` for shell
+orchestration), then serves until SIGTERM/SIGINT.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import email.utils
+import hashlib
+import os
+import sys
+import threading
+import time
+import urllib.parse
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+__all__ = ["FaultPlan", "FaultHTTPServer", "serve", "main"]
+
+
+@dataclass
+class FaultPlan:
+    """The scripted fault schedule (see module docstring)."""
+
+    throttle_every: int = 0
+    error_every: int = 0
+    reset_every: int = 0
+    short_every: int = 0
+    slow_ms: float = 0.0
+    etag_flip_at: int = 0
+    retry_after_s: float = 0.01
+    match: str = ""
+
+    def applies(self, path: str) -> bool:
+        return not self.match or self.match in path
+
+
+class FaultHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer carrying the fault plan and the request
+    counter every fault decision keys on."""
+
+    daemon_threads = True
+
+    def __init__(self, addr, root: str, plan: FaultPlan | None = None):
+        super().__init__(addr, _Handler)
+        self.root = os.path.abspath(root)
+        self.plan = plan if plan is not None else FaultPlan()
+        self._lock = threading.Lock()  # guards _requests
+        self._requests = 0
+
+    def next_request(self) -> int:
+        with self._lock:
+            self._requests += 1
+            return self._requests
+
+    @property
+    def base_url(self) -> str:
+        host, port = self.server_address[:2]
+        return f"http://{host}:{port}"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "httpfault/1"
+
+    def log_message(self, fmt, *args):  # quiet by default
+        if os.environ.get("TPQ_HTTPFAULT_LOG"):
+            super().log_message(fmt, *args)
+
+    # -- object resolution ------------------------------------------------
+    def _resolve(self):
+        """URL path -> (fs path, size, mtime_ns) or None (404'd)."""
+        raw = urllib.parse.unquote(
+            urllib.parse.urlsplit(self.path).path)
+        fs = os.path.abspath(os.path.join(
+            self.server.root, raw.lstrip("/")))
+        prefix = self.server.root.rstrip(os.sep) + os.sep
+        if fs != self.server.root and not fs.startswith(prefix):
+            self.send_error(404, "outside root")
+            return None
+        try:
+            st = os.stat(fs)
+        except OSError:
+            self.send_error(404, "no such object")
+            return None
+        if not os.path.isfile(fs):
+            self.send_error(404, "not a file")
+            return None
+        return fs, st.st_size, st.st_mtime_ns
+
+    def _etag(self, fs, size, mtime_ns, n: int) -> str:
+        gen = (2 if self.server.plan.etag_flip_at
+               and n >= self.server.plan.etag_flip_at else 1)
+        h = hashlib.sha1(
+            f"{fs}|{size}|{mtime_ns}|g{gen}".encode()).hexdigest()[:20]
+        return f'"{h}"'
+
+    # -- the scripted faults ----------------------------------------------
+    def _scripted_fault(self, n: int, *, get: bool) -> str | None:
+        """Apply any pre-body fault due at request ``n``.  Returns
+        ``"handled"`` when a response (or abort) was already issued,
+        ``"short"`` when the GET body must be truncated, else None."""
+        plan = self.server.plan
+        if not plan.applies(self.path):
+            return None
+        if plan.slow_ms > 0:
+            time.sleep(plan.slow_ms / 1e3)
+        if plan.reset_every and n % plan.reset_every == 0:
+            # die before the status line: the client observes a
+            # remote disconnect / connection reset
+            self.close_connection = True
+            with contextlib.suppress(OSError):
+                self.connection.shutdown(2)  # SHUT_RDWR
+            return "handled"
+        if plan.throttle_every and n % plan.throttle_every == 0:
+            self.send_response(429)
+            self.send_header("Retry-After",
+                             f"{plan.retry_after_s:g}")
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return "handled"
+        if plan.error_every and n % plan.error_every == 0:
+            self.send_response(503)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return "handled"
+        if get and plan.short_every and n % plan.short_every == 0:
+            return "short"
+        return None
+
+    # -- verbs ------------------------------------------------------------
+    def do_HEAD(self):
+        n = self.server.next_request()
+        obj = self._resolve()
+        if obj is None:
+            return
+        if self._scripted_fault(n, get=False) == "handled":
+            return
+        fs, size, mtime_ns = obj
+        self.send_response(200)
+        self.send_header("ETag", self._etag(fs, size, mtime_ns, n))
+        self.send_header("Accept-Ranges", "bytes")
+        self.send_header("Content-Length", str(size))
+        self.send_header("Last-Modified",
+                         email.utils.formatdate(mtime_ns / 1e9,
+                                                usegmt=True))
+        self.end_headers()
+
+    def do_GET(self):
+        n = self.server.next_request()
+        obj = self._resolve()
+        if obj is None:
+            return
+        fault = self._scripted_fault(n, get=True)
+        if fault == "handled":
+            return
+        fs, size, mtime_ns = obj
+        etag = self._etag(fs, size, mtime_ns, n)
+        cond = self.headers.get("If-Match")
+        if cond is not None and cond.strip() not in (etag, "*"):
+            self.send_response(412)
+            self.send_header("ETag", etag)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        rng = self._parse_range(size)
+        if rng == "bad":
+            self.send_response(416)
+            self.send_header("Content-Range", f"bytes */{size}")
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        if rng is None:
+            status, start, length = 200, 0, size
+        else:
+            start, length = rng
+            status = 206
+        self.send_response(status)
+        self.send_header("ETag", etag)
+        self.send_header("Accept-Ranges", "bytes")
+        self.send_header("Content-Length", str(length))
+        if status == 206:
+            self.send_header(
+                "Content-Range",
+                f"bytes {start}-{start + length - 1}/{size}")
+        self.end_headers()
+        with open(fs, "rb") as f:
+            f.seek(start)
+            body = f.read(length)
+        if fault == "short" and len(body) > 1:
+            # advertise full length, ship half, hang up: the client
+            # must detect the short read and retry
+            self.wfile.write(body[: len(body) // 2])
+            self.wfile.flush()
+            self.close_connection = True
+            with contextlib.suppress(OSError):
+                self.connection.shutdown(2)
+            return
+        self.wfile.write(body)
+
+    def _parse_range(self, size: int):
+        """``bytes=a-b`` -> (start, length); None = whole object;
+        ``"bad"`` = unsatisfiable (416)."""
+        hdr = self.headers.get("Range")
+        if not hdr or not hdr.startswith("bytes="):
+            return None
+        spec = hdr[len("bytes="):].split(",")[0].strip()
+        first, _, last = spec.partition("-")
+        try:
+            if first:
+                start = int(first)
+                end = int(last) if last else size - 1
+            else:  # suffix form: bytes=-N
+                start = max(0, size - int(last))
+                end = size - 1
+        except ValueError:
+            return "bad"
+        if start >= size or start < 0 or end < start:
+            return "bad"
+        end = min(end, size - 1)
+        return start, end - start + 1
+
+
+@contextlib.contextmanager
+def serve(root: str, plan: FaultPlan | None = None, port: int = 0):
+    """Start a fault server over ``root`` on localhost; yields the
+    base URL; shuts down on exit."""
+    srv = FaultHTTPServer(("127.0.0.1", port), root, plan)
+    t = threading.Thread(target=srv.serve_forever,
+                         name="httpfault", daemon=True)
+    t.start()
+    try:
+        yield srv.base_url
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        t.join(10.0)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", required=True,
+                    help="directory served as the object store")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--throttle-every", type=int, default=0)
+    ap.add_argument("--error-every", type=int, default=0)
+    ap.add_argument("--reset-every", type=int, default=0)
+    ap.add_argument("--short-every", type=int, default=0)
+    ap.add_argument("--slow-ms", type=float, default=0.0)
+    ap.add_argument("--etag-flip-at", type=int, default=0)
+    ap.add_argument("--retry-after-s", type=float, default=0.01)
+    ap.add_argument("--match", default="",
+                    help="apply faults only to URL paths containing "
+                         "this substring")
+    ap.add_argument("--url-file", default="",
+                    help="also write the base URL to this file")
+    args = ap.parse_args(argv)
+    plan = FaultPlan(
+        throttle_every=args.throttle_every,
+        error_every=args.error_every,
+        reset_every=args.reset_every,
+        short_every=args.short_every,
+        slow_ms=args.slow_ms,
+        etag_flip_at=args.etag_flip_at,
+        retry_after_s=args.retry_after_s,
+        match=args.match)
+    srv = FaultHTTPServer(("127.0.0.1", args.port), args.root, plan)
+    print(srv.base_url, flush=True)
+    if args.url_file:
+        with open(args.url_file, "w") as f:
+            f.write(srv.base_url)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -210,6 +210,97 @@ def run_leg(corpus: dict[str, list[str]], *, telemetry: bool,
     return results
 
 
+def run_http_leg(corpus: dict[str, list[str]]) -> dict:
+    """The ``http(s)://`` tenant: the remote tenant's file served
+    through the deterministic fault HTTP server (``tools/httpfault``)
+    in two phases — a scripted 429/503/connection-reset storm, then a
+    mid-scan ETag flip (the object "rewritten" under the reader) —
+    both of which the retry ladder and the identity refresh must
+    absorb without a single quarantined unit.  Returns per-phase
+    digests and remote counters; the local control read is the byte
+    oracle."""
+    import threading as _threading
+
+    from tools.httpfault import FaultHTTPServer, FaultPlan
+    from tpuparquet.io.rangecache import reset_range_caches
+    from tpuparquet.shard.scan import ShardedScan
+    from tpuparquet.stats import collect_stats
+
+    t_http = tenant_label(REMOTE_TENANT)
+    path = corpus[t_http][0]
+    srv = FaultHTTPServer(("127.0.0.1", 0), os.path.dirname(path))
+    t = _threading.Thread(target=srv.serve_forever,
+                          name="soak-httpfault", daemon=True)
+    t.start()
+    results: dict[str, dict] = {}
+    try:
+        url = srv.base_url + "/" + os.path.basename(path)
+
+        def phase(name: str, plan: FaultPlan,
+                  mid_scan_plan: FaultPlan | None = None) -> None:
+            reset_range_caches()  # cold per phase: faults must land
+            srv.plan = plan
+            scan = ShardedScan([url], on_error="quarantine",
+                               retries=0, progress_label=t_http)
+            if mid_scan_plan is not None:
+                # the scan's identity (HEAD + footer) was established
+                # under ``plan``; the switch lands mid-scan
+                srv.plan = mid_scan_plan
+            with collect_stats() as st:
+                out = scan.run()
+            results[name] = {
+                "digest": _output_digest(out),
+                "units_done": scan.progress.units_done,
+                "units_quarantined": scan.progress.units_quarantined,
+                "remote_ranges_fetched": st.remote_ranges_fetched,
+                "remote_retry": st.remote_retry,
+            }
+
+        phase("storm",
+              FaultPlan(throttle_every=5, error_every=7,
+                        reset_every=11, retry_after_s=0.005))
+        # the object is "rewritten" under the open reader: every
+        # request from here on serves the generation-2 ETag, so the
+        # reader's conditional GETs keyed on the old tag answer 412,
+        # the source refreshes its identity and refetches
+        phase("flip", FaultPlan(),
+              mid_scan_plan=FaultPlan(etag_flip_at=1))
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        t.join(10.0)
+        reset_range_caches()
+    return results
+
+
+def check_http(http: dict, on: dict,
+               remote_control: str) -> list[str]:
+    """The http-leg contract: byte-identical to the local control
+    through both fault phases, faults absorbed by retries (never
+    quarantine), exact unit accounting against the emu:// twin."""
+    bad: list[str] = []
+    t_http = tenant_label(REMOTE_TENANT)
+    units = on[t_http]["units_done"]  # same file, same unit count
+    for name, r in http.items():
+        if r["digest"] != remote_control:
+            bad.append(f"http[{name}]: output differs from the local "
+                       f"control read")
+        if r["units_quarantined"]:
+            bad.append(f"http[{name}]: {r['units_quarantined']} "
+                       f"units quarantined — scripted HTTP faults "
+                       f"must be absorbed by the retry ladder")
+        if r["units_done"] != units:
+            bad.append(f"http[{name}]: {r['units_done']} units done, "
+                       f"expected {units}")
+        if not r["remote_ranges_fetched"]:
+            bad.append(f"http[{name}]: no remote range fetches — the "
+                       f"http:// reroute did not engage")
+        if not r["remote_retry"]:
+            bad.append(f"http[{name}]: no remote retries — the "
+                       f"scripted fault plan did not fire")
+    return bad
+
+
 def run_serve_leg(corpus: dict[str, list[str]], *, ring_dir: str,
                   state_dir: str) -> tuple[dict, dict]:
     """The server-path leg: the SAME tenants, fault plan and
@@ -649,6 +740,13 @@ def main(argv=None) -> int:
                          "byte-identical to the direct-scan control, "
                          "no tenant starves, and the per-tenant "
                          "accounting stays exact")
+    ap.add_argument("--http", action="store_true",
+                    help="add an http(s):// leg: the remote tenant's "
+                         "file is re-read through the deterministic "
+                         "fault HTTP server under a scripted "
+                         "429/503/reset storm and then a mid-scan "
+                         "ETag flip; both must stay byte-identical "
+                         "to the local control with zero quarantines")
     ap.add_argument("--dataset", action="store_true",
                     help="add a dataset leg: a writer tenant commits "
                          "a hive-partitioned dataset through the "
@@ -706,6 +804,13 @@ def main(argv=None) -> int:
                                     serve_ring, serve_alerts,
                                     remote_control)
             failures += _lockcheck_failures()
+        http = None
+        if args.http:
+            # its own chaos scope, like every other optional leg
+            with _scope():
+                http = run_http_leg(corpus)
+            failures += check_http(http, on, remote_control)
+            failures += _lockcheck_failures()
         dsmeta: dict = {}
         if args.dataset:
             ds_state = os.path.join(root, "dataset-state")
@@ -731,6 +836,10 @@ def main(argv=None) -> int:
                                  if k != "digest"}
                             for lb in sorted(serve)},
             }
+        if http is not None:
+            result["http"] = {
+                name: {k: v for k, v in r.items() if k != "digest"}
+                for name, r in http.items()}
         if args.dataset:
             result["dataset"] = dsmeta
         if args.json:
@@ -746,6 +855,12 @@ def main(argv=None) -> int:
                     print(f"serve {lb}: {r['state']}, "
                           f"{r['units_done']} units, share "
                           f"{(smeta.get('shares') or {}).get(lb)}")
+            if http is not None:
+                for name in sorted(http):
+                    r = http[name]
+                    print(f"http {name}: {r['units_done']} units, "
+                          f"{r['units_quarantined']} quarantined, "
+                          f"{r['remote_retry']} retries")
             for f in failures:
                 print(f"FAIL: {f}", file=sys.stderr)
             print(f"soak {'PASS' if not failures else 'FAIL'} "

@@ -827,11 +827,20 @@ def cmd_serve(args, out=None) -> int:
     A job with ``sink_dir`` persists each decoded unit as a keyed
     atomic ``unit<k>.npz`` (tmp + rename — the crash-safe consumer
     discipline), so drained-and-resumed runs converge to a
-    duplicate-free, bit-exact union.  Exit 0 = every job done; 3 =
-    drained with work remaining (resume on a successor); 1 = a job
-    failed."""
-    import json as _json
+    duplicate-free, bit-exact union.
 
+    Admission shedding is not failure: a job rejected with a
+    retryable :class:`~tpuparquet.errors.AdmissionRejected` (queue
+    full, byte budget, drain race) is held back and resubmitted after
+    its ``retry_after_s`` hint — the rejection contract guarantees
+    the request was never queued, so the retry is duplicate-free.
+
+    Exit 0 = every job done; 3 = drained with work remaining (resume
+    on a successor); 1 = a job failed."""
+    import json as _json
+    import time as _time
+
+    from ..errors import AdmissionRejected
     from ..serve import ScanServer
 
     out = out or sys.stdout
@@ -844,6 +853,18 @@ def cmd_serve(args, out=None) -> int:
         arbiter = ResourceArbiter(total_workers=int(spec["workers"]))
     server = ScanServer(arbiter=arbiter,
                         state_dir=spec.get("state_dir"))
+
+    def _submit(j):
+        sink = (_npz_sink(j["sink_dir"])
+                if j.get("sink_dir") else None)
+        return server.submit(
+            j["tenant"], j["sources"], *j.get("columns", []),
+            job_id=j.get("job_id"),
+            unit_deadline=j.get("unit_deadline"),
+            scan_deadline=j.get("scan_deadline"),
+            checkpoint_every=j.get("checkpoint_every"),
+            sink=sink)
+
     try:
         for t in spec.get("tenants", []):
             server.add_tenant(
@@ -852,26 +873,34 @@ def cmd_serve(args, out=None) -> int:
                 latency_target_ms=t.get("latency_target_ms"),
                 error_rate_target=t.get("error_rate_target"))
         jobs = []
+        pending = []  # [due_monotonic, jobspec] — shed, to resubmit
         for j in spec.get("jobs", []):
-            sink = (_npz_sink(j["sink_dir"])
-                    if j.get("sink_dir") else None)
-            jobs.append(server.submit(
-                j["tenant"], j["sources"], *j.get("columns", []),
-                job_id=j.get("job_id"),
-                unit_deadline=j.get("unit_deadline"),
-                scan_deadline=j.get("scan_deadline"),
-                checkpoint_every=j.get("checkpoint_every"),
-                sink=sink))
+            try:
+                jobs.append(_submit(j))
+            except AdmissionRejected as e:
+                hint = e.retry_after_s or 0.5
+                print(f"{j['tenant']}/{j.get('job_id') or '?'}: shed "
+                      f"({e.reason}), retrying in {hint:g}s", file=out)
+                pending.append([_time.monotonic() + hint, j])
         server.install_signal_handlers()
         status_path = spec.get("status_export")
-        while not all(job.terminal for job in jobs):
+        while pending or not all(job.terminal for job in jobs):
             if server.draining:
                 server.drain()
                 break
+            now = _time.monotonic()
+            held = []
+            for due, j in pending:
+                if now < due:
+                    held.append([due, j])
+                    continue
+                try:
+                    jobs.append(_submit(j))
+                except AdmissionRejected as e:
+                    held.append([now + (e.retry_after_s or 0.5), j])
+            pending = held
             if status_path:
                 server.write_status(status_path)
-            import time as _time
-
             _time.sleep(0.2)
         if status_path:
             server.write_status(status_path)
@@ -880,9 +909,12 @@ def cmd_serve(args, out=None) -> int:
             print(f"{job.tenant}/{job.job_id}: {job.state} "
                   f"({job.units_done}/{job.units_total} units)",
                   file=out)
+        for _due, j in pending:
+            print(f"{j['tenant']}/{j.get('job_id') or '?'}: shed "
+                  f"(never admitted)", file=out)
         if any(job.state == "failed" for job in jobs):
             return 1
-        if any(job.state != "done" for job in jobs):
+        if pending or any(job.state != "done" for job in jobs):
             return 3  # drained: resume on a successor
         return 0
     finally:
@@ -1060,9 +1092,13 @@ def cmd_doctor(args, out=None) -> int:
     (``deadline.LatencyTracker``, the same detector ``top`` uses
     live), and the plan-pool concurrency note that turns the
     PLAN_SCALE thread-degradation mystery into one line.  Attribution
-    ledgers embedded in the export print alongside.  ``--json`` emits
-    the full machine-readable reports.  No reference analogue — this
-    is the diagnosis face of the causal tracing layer."""
+    ledgers embedded in the export print alongside; a ledger whose
+    counters show remote-source or range-cache traffic gets a REMOTE
+    line (origin fetches vs cache hits, retry/hedge tallies) and an
+    ORIGIN-BOUND callout when the read-bound verdict is dominated by
+    origin round trips rather than local disk.  ``--json`` emits the
+    full machine-readable reports.  No reference analogue — this is
+    the diagnosis face of the causal tracing layer."""
     import json as _json
 
     out = out or sys.stdout
@@ -1093,7 +1129,15 @@ def cmd_doctor(args, out=None) -> int:
 
         pstate = load_profile_file(args.profile)
     if getattr(args, "json", False):
-        doc = {"reports": reports, "ledgers": ledgers}
+        from ..obs.attribution import remote_report
+
+        verdict0 = reports[0].get("verdict") if reports else None
+        doc = {"reports": reports, "ledgers": ledgers,
+               "remote": {
+                   label: remote_report(
+                       (led or {}).get("counters") or {},
+                       verdict=verdict0)
+                   for label, led in sorted((ledgers or {}).items())}}
         if pstate is not None:
             from ..obs.profiler import profile_consistency, top_frames
 

@@ -498,9 +498,18 @@ def retry_transient(fn, *, retries: int | None = None,
     retry up to ``retries`` times with bounded exponential backoff.
     Permanent errors and the final exhausted attempt propagate
     unchanged.  Each retry increments ``DecodeStats.<counter>`` on the
-    active collector."""
+    active collector.
+
+    A transient error carrying a ``retry_after_s`` attribute (an
+    HTTP 429/503 with a ``Retry-After`` header, mapped by
+    :class:`~tpuparquet.io.source.HttpByteRangeSource`) stretches
+    that retry's sleep to the origin's hint — bounded by the backoff
+    cap, so a hostile header can never stall a scan — and never
+    shortens it below the scheduled delay."""
     from .stats import current_stats
 
+    if cap is None:
+        cap = _env_float("TPQ_RETRY_MAX_S", 0.5)
     delays = backoff_delays(retries, base, cap)
     for delay in delays:
         try:
@@ -508,6 +517,9 @@ def retry_transient(fn, *, retries: int | None = None,
         except Exception as e:
             if not is_transient(e):
                 raise
+            hint = getattr(e, "retry_after_s", None)
+            if hint is not None:
+                delay = max(delay, min(float(hint), cap))
             st = current_stats()
             if st is not None:
                 setattr(st, counter, getattr(st, counter) + 1)

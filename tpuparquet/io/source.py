@@ -17,6 +17,18 @@ for that regime — :class:`ByteRangeSource` with ``get_range``/
   per-instance request counter (no wall-clock or RNG), so a fault plan
   replays identically run to run.
 
+* :class:`HttpByteRangeSource` (``http://`` / ``https://``) — a real
+  HTTP range client on the stdlib: conditional Range GETs
+  (``If-Match`` keyed on the served ETag, so a concurrent object
+  rewrite surfaces as 412 → cache invalidation + retry, never stale
+  bytes), keep-alive connection reuse through a bounded per-source
+  pool (``TPQ_HTTP_CONNS``), per-request socket deadlines
+  (``TPQ_HTTP_TIMEOUT_S``), and classification of
+  416/412/429/5xx/short-body/reset into the existing error taxonomy —
+  so retry/backoff (``Retry-After``-aware), hedged mirrors, failover
+  and quarantine all compose unchanged.  ``tools/httpfault.py`` is
+  its deterministic in-repo test server.
+
 Every range read also traverses the registered fault sites
 ``io.remote.open`` / ``io.remote.throttle`` / ``io.remote.range``, so
 the :mod:`tpuparquet.faults` harness can inject the same failure
@@ -42,26 +54,31 @@ is a round trip.
 
 from __future__ import annotations
 
+import http.client
 import os
 import threading
 import time
+import urllib.parse
 
 from ..errors import TransientIOError
-from ..faults import fault_point, filter_bytes
+from ..faults import fault_point, filter_bytes, retry_transient
 from ..obs import recorder as _flightrec
 
 __all__ = [
     "ByteRangeSource",
     "LocalByteRangeSource",
     "EmulatedStoreSource",
+    "HttpByteRangeSource",
     "RangeSourceFile",
     "coalesce_ranges",
     "coalesce_gap_default",
+    "http_conns_default",
+    "http_timeout_default",
     "open_byte_source",
     "parse_source_uri",
 ]
 
-_SCHEMES = ("file", "emu")
+_SCHEMES = ("file", "emu", "http", "https")
 
 
 def parse_source_uri(src):
@@ -106,6 +123,16 @@ def open_byte_source(src):
                 f"(known: {', '.join(_SCHEMES)})")
         path = src
         uri = src  # bare path stays the display name (see docstring)
+    if scheme in ("http", "https"):
+        if parsed is not None:
+            return HttpByteRangeSource(src, uri=uri)
+        base = os.environ.get("TPQ_HTTP_BASE", "").strip()
+        if not base:
+            raise ValueError(
+                "TPQ_SOURCE=http(s) reroutes bare paths and needs "
+                "TPQ_HTTP_BASE (e.g. http://127.0.0.1:8080) to build "
+                "the request URL")
+        return HttpByteRangeSource(base.rstrip("/") + path, uri=uri)
     if scheme == "emu":
         return EmulatedStoreSource(path, uri=uri)
     return LocalByteRangeSource(path, uri=uri)
@@ -344,6 +371,298 @@ class EmulatedStoreSource(LocalByteRangeSource):
                     file=self.uri, request=n)
             return data[:len(data) // 2]
         return data
+
+
+def http_conns_default() -> int:
+    """``TPQ_HTTP_CONNS`` — bound on live keep-alive connections per
+    source (default 4: enough for the prefetch pool to overlap spans
+    without stampeding one origin host)."""
+    v = os.environ.get("TPQ_HTTP_CONNS")
+    return max(1, int(v)) if v else 4
+
+
+def http_timeout_default() -> float:
+    """``TPQ_HTTP_TIMEOUT_S`` — per-request socket deadline (connect
+    and each read) on HTTP sources, default 30s.  A hung origin
+    surfaces as a retryable :class:`TimeoutError`, never a stuck
+    scan."""
+    v = os.environ.get("TPQ_HTTP_TIMEOUT_S")
+    return float(v) if v else 30.0
+
+
+class _HttpConnPool:
+    """Bounded keep-alive connection pool for one origin host.
+
+    ``acquire`` hands out an idle connection or dials a new one while
+    under the bound; past the bound it waits (bounded by the request
+    timeout) for a release.  Network I/O always happens OUTSIDE the
+    pool lock.  A connection that saw a protocol error or an
+    unconsumed body is closed and discarded on release instead of
+    being reused."""
+
+    def __init__(self, host: str, port, tls: bool, timeout: float,
+                 bound: int):
+        self._host = host
+        self._port = port
+        self._tls = tls
+        self._timeout = timeout
+        self._bound = max(1, bound)
+        self._cv = threading.Condition(threading.Lock())
+        self._idle: list = []  # guarded by _cv
+        self._total = 0        # guarded by _cv
+        self._closed = False   # guarded by _cv
+
+    def _connect(self):
+        cls = (http.client.HTTPSConnection if self._tls
+               else http.client.HTTPConnection)
+        return cls(self._host, self._port, timeout=self._timeout)
+
+    def acquire(self):
+        deadline = time.monotonic() + self._timeout
+        with self._cv:
+            while True:
+                if self._closed:
+                    raise ValueError("connection pool is closed")
+                if self._idle:
+                    return self._idle.pop()
+                if self._total < self._bound:
+                    self._total += 1
+                    break
+                left = deadline - time.monotonic()
+                if left <= 0 or not self._cv.wait(left):
+                    raise TransientIOError(
+                        f"connection pool exhausted: {self._bound} "
+                        f"connections busy for {self._timeout:g}s",
+                        file=self._host)
+        try:
+            return self._connect()
+        except BaseException:
+            with self._cv:
+                self._total -= 1
+                self._cv.notify()
+            raise
+
+    def release(self, conn, *, reusable: bool) -> None:
+        with self._cv:
+            if reusable and not self._closed:
+                self._idle.append(conn)
+                self._cv.notify()
+                return
+            self._total -= 1
+            self._cv.notify()
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            drop, self._idle = self._idle, []
+            self._total -= len(drop)
+            self._cv.notify_all()
+        for conn in drop:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+class HttpByteRangeSource(ByteRangeSource):
+    """``http://`` / ``https://`` — a real HTTP range client.
+
+    Opens with a HEAD (size + served ``ETag``); every range read is a
+    conditional GET (``Range`` + ``If-Match``), so a concurrent
+    rewrite of the object surfaces as 412 — the handler refreshes the
+    identity, invalidates both cache tiers for this source, and
+    raises :class:`TransientIOError` for the retry ladder to refetch
+    under the NEW identity; stale bytes can never serve a read.
+
+    Status classification into the existing taxonomy (everything the
+    scan stack above already knows how to absorb):
+
+    * 206/200 — bytes (200 is sliced; a short slice trips the base
+      class's short-response check).
+    * 412/416 — identity/size stale → refresh + invalidate +
+      :class:`TransientIOError`.
+    * 429/503 — :class:`TransientIOError` carrying the parsed
+      ``Retry-After`` hint (``retry_after_s``), which
+      :func:`tpuparquet.faults.retry_transient` honors.
+    * other 5xx — :class:`TransientIOError`.
+    * 404 — :class:`FileNotFoundError`; 401/403 —
+      :class:`PermissionError`; other 4xx — :class:`OSError`
+      (permanent: quarantine, don't retry).
+    * resets / remote disconnects propagate as
+      :class:`ConnectionError` (transient); short/incomplete bodies
+      return their partial bytes and trip the short-response check.
+    """
+
+    scheme = "http"
+
+    def __init__(self, url: str, uri: str | None = None, *,
+                 timeout_s: float | None = None,
+                 conns: int | None = None):
+        split = urllib.parse.urlsplit(url)
+        if split.scheme not in ("http", "https") or not split.hostname:
+            raise ValueError(f"not an http(s) URL: {url!r}")
+        self._url = url
+        self.uri = uri if uri is not None else url
+        self.path = self.uri
+        self.scheme = split.scheme
+        self._target = split.path or "/"
+        if split.query:
+            self._target += "?" + split.query
+        self._timeout = (timeout_s if timeout_s is not None
+                         else http_timeout_default())
+        self._conns = conns if conns is not None else http_conns_default()
+        self._pool = _HttpConnPool(
+            split.hostname, split.port, split.scheme == "https",
+            self._timeout, self._conns)
+        self._id_lock = threading.Lock()  # guards the etag identity
+        self._closed = False
+        fault_point("io.remote.open", file=self.uri)
+        try:
+            size, tag = retry_transient(self._head)
+        except BaseException:
+            self._pool.close()
+            raise
+        self._size = size
+        self._etag_header = tag
+        self._etag = (self.path, size, tag)
+
+    # -- identity ---------------------------------------------------------
+    def _head(self):
+        """HEAD the object: (size, etag-header-or-empty)."""
+        conn = self._pool.acquire()
+        reusable = False
+        try:
+            conn.request("HEAD", self._target)
+            resp = conn.getresponse()
+            resp.read()
+            if resp.status == 200:
+                reusable = True
+                n = resp.getheader("Content-Length")
+                if n is None:
+                    # protocol violation from origin/proxy: let the
+                    # retry ladder take a few swings, then quarantine
+                    raise TransientIOError(
+                        f"HEAD {self.uri}: origin sent no "
+                        f"Content-Length", file=self.uri)
+                return int(n), (resp.getheader("ETag") or "").strip()
+            raise self._status_error(resp, verb="HEAD")
+        except (ConnectionError, TimeoutError):
+            raise
+        except http.client.HTTPException as e:
+            raise TransientIOError(
+                f"HEAD {self.uri}: {e!r}", file=self.uri) from e
+        finally:
+            self._pool.release(conn, reusable=reusable)
+
+    def _refresh_identity(self) -> None:
+        """Re-HEAD after a 412/416: adopt the new (size, etag) and
+        drop every cached range for this source — all before the
+        transient raise hands control to the retry ladder."""
+        size, tag = retry_transient(self._head)
+        with self._id_lock:
+            self._size = size
+            self._etag_header = tag
+            self._etag = (self.path, size, tag)
+        from .rangecache import invalidate_source_caches
+
+        invalidate_source_caches(self.uri)
+
+    def _status_error(self, resp, *, verb: str = "GET",
+                      start: int | None = None) -> BaseException:
+        """Map a non-2xx response to the error taxonomy (the caller
+        raises); transient errors carry a ``retry_after_s`` hint when
+        the origin sent one."""
+        status = resp.status
+        at = "" if start is None else f" at offset {start}"
+        msg = f"{verb} {self.uri}{at}: HTTP {status}"
+        if status in (429, 503) or status >= 500:
+            err = TransientIOError(msg, file=self.uri)
+            hint = _parse_retry_after(resp.getheader("Retry-After"))
+            if hint is not None:
+                err.retry_after_s = hint
+            return err
+        if status == 404:
+            return FileNotFoundError(msg)
+        if status in (401, 403):
+            return PermissionError(msg)
+        return OSError(msg)
+
+    # -- reads ------------------------------------------------------------
+    def _read_raw(self, start: int, size: int) -> bytes:
+        conn = self._pool.acquire()
+        reusable = False
+        try:
+            with self._id_lock:
+                tag = self._etag_header
+            headers = {"Range": f"bytes={start}-{start + size - 1}"}
+            if tag:
+                headers["If-Match"] = tag
+            conn.request("GET", self._target, headers=headers)
+            resp = conn.getresponse()
+            short = False
+            try:
+                body = resp.read()
+            except (http.client.IncompleteRead,) as e:
+                body, short = e.partial, True
+            if resp.status == 206:
+                reusable = not short
+                return body  # short bodies trip the base length check
+            if resp.status == 200:
+                reusable = not short
+                return body[start:start + size]
+            if resp.status in (412, 416):
+                self._refresh_identity()
+                what = ("object changed under us (etag mismatch)"
+                        if resp.status == 412 else
+                        "range not satisfiable (stale size)")
+                raise TransientIOError(
+                    f"GET {self.uri} at offset {start}: HTTP "
+                    f"{resp.status} — {what}; identity refreshed, "
+                    f"caches invalidated", file=self.uri)
+            raise self._status_error(resp, start=start)
+        except (ConnectionError, TimeoutError):
+            raise  # already transient in the taxonomy
+        except http.client.HTTPException as e:
+            raise TransientIOError(
+                f"GET {self.uri} at offset {start}: {e!r}",
+                file=self.uri) from e
+        finally:
+            self._pool.release(conn, reusable=reusable)
+
+    # -- lifecycle --------------------------------------------------------
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._pool.close()
+
+    def reopen(self) -> "HttpByteRangeSource":
+        return type(self)(self._url, uri=self.uri,
+                          timeout_s=self._timeout, conns=self._conns)
+
+
+def _parse_retry_after(value):
+    """``Retry-After`` header -> seconds (or None): delta-seconds or
+    an HTTP-date, clamped to >= 0."""
+    if not value:
+        return None
+    value = value.strip()
+    try:
+        return max(0.0, float(value))
+    except ValueError:
+        pass
+    try:
+        import email.utils
+
+        when = email.utils.parsedate_to_datetime(value)
+    except (TypeError, ValueError):
+        return None
+    if when is None:
+        return None
+    return max(0.0, when.timestamp() - time.time())
 
 
 class RangeSourceFile:

@@ -40,6 +40,7 @@ import threading
 __all__ = [
     "ScanLedger", "ledger", "ledgers_snapshot", "reset_ledgers",
     "merge_ledger_states", "stage_seconds", "stage_verdict",
+    "remote_report",
     "STAGE_OF", "VERDICT_OF",
     "span_tree", "exclusive_times", "unit_reports", "diagnose",
     "format_diagnosis",
@@ -91,6 +92,51 @@ def stage_seconds(counters: dict) -> dict:
            for stage, c in _STAGE_COUNTERS.items()}
     out["plan"] = round(max(out["plan"] - out["read"], 0.0), 6)
     return out
+
+
+def remote_report(counters: dict,
+                  verdict: str | None = None) -> dict | None:
+    """The doctor's REMOTE section over one counter dict (a ledger's
+    ``counters``, a ``DecodeStats.as_dict()``, or a registry
+    snapshot), or None when the scan never touched a remote source or
+    a range cache.
+
+    ``hit_ratio`` is cache hits (mem + disk) over total range demand
+    (hits + origin fetches) — the fraction of range reads the cache
+    absorbed.  ``origin_bound`` fires only when the trace already says
+    ``read-bound`` (pass :func:`diagnose`'s ``verdict``) AND the
+    origin absorbed at least half the demand: a read-bound scan whose
+    cache is doing its job is disk-bound, not origin-bound, and the
+    cures differ (more spindles vs deeper prefetch / bigger cache)."""
+    fetched = int(counters.get("remote_ranges_fetched", 0) or 0)
+    hits = (int(counters.get("cache_hits_mem", 0) or 0)
+            + int(counters.get("cache_hits_disk", 0) or 0))
+    misses = (int(counters.get("cache_misses_mem", 0) or 0)
+              + int(counters.get("cache_misses_disk", 0) or 0))
+    retries = int(counters.get("remote_retry", 0) or 0)
+    if not (fetched or hits or misses or retries):
+        return None
+    demand = hits + fetched
+    ratio = hits / demand if demand > 0 else 0.0
+    return {
+        "origin_fetches": fetched,
+        "origin_bytes": int(counters.get("remote_bytes", 0) or 0),
+        "ranges_coalesced": int(
+            counters.get("ranges_coalesced", 0) or 0),
+        "cache_hits_mem": int(counters.get("cache_hits_mem", 0) or 0),
+        "cache_hits_disk": int(
+            counters.get("cache_hits_disk", 0) or 0),
+        "cache_misses_disk": int(
+            counters.get("cache_misses_disk", 0) or 0),
+        "cache_evictions_disk": int(
+            counters.get("cache_evictions_disk", 0) or 0),
+        "hit_ratio": round(ratio, 4),
+        "retries": retries,
+        "hedges_issued": int(counters.get("hedges_issued", 0) or 0),
+        "hedges_won": int(counters.get("hedges_won", 0) or 0),
+        "origin_bound": bool(verdict == "read-bound"
+                             and fetched > 0 and ratio < 0.5),
+    }
 
 
 def stage_verdict(counters: dict) -> str | None:
@@ -558,4 +604,24 @@ def format_diagnosis(d: dict, ledgers: dict | None = None) -> str:
             + f"  pages={led.get('pages', 0)}"
             + (f"  peak_arena={led.get('peak_arena_bytes', 0):,}B"
                if led.get("peak_arena_bytes") else ""))
+        rr = remote_report(led.get("counters") or {},
+                           verdict=d.get("verdict"))
+        if rr:
+            lines.append(
+                f"  REMOTE[{label}]: origin {rr['origin_fetches']} "
+                f"fetches / {rr['origin_bytes']:,}B "
+                f"(coalesced {rr['ranges_coalesced']})  cache hits "
+                f"mem={rr['cache_hits_mem']} "
+                f"disk={rr['cache_hits_disk']}  hit ratio "
+                f"{100 * rr['hit_ratio']:.1f}%  retries={rr['retries']}"
+                f"  hedges={rr['hedges_won']}/{rr['hedges_issued']}"
+                + (f"  evictions={rr['cache_evictions_disk']}"
+                   if rr["cache_evictions_disk"] else ""))
+            if rr["origin_bound"]:
+                lines.append(
+                    "    ORIGIN-BOUND: read-bound and the origin "
+                    f"absorbed {100 * (1 - rr['hit_ratio']):.1f}% of "
+                    "range demand — deepen prefetch "
+                    "(TPQ_PREFETCH_DEPTH) or grow the shared disk "
+                    "cache (TPQ_CACHE_DISK_MB)")
     return "\n".join(lines)

@@ -191,8 +191,11 @@ class ResourceArbiter:
                  error_rate_target: float | None = None) -> None:
         """Add (or re-weight) a tenant and recompute shares.
 
-        ``byte_budget`` caps CUMULATIVE admitted bytes (admission
-        control, not a rate limit); ``latency_target_ms`` /
+        ``byte_budget`` caps IN-FLIGHT admitted bytes (admission
+        control, not a rate limit): :meth:`admit` charges the
+        account, :meth:`release` refunds it when the job reaches a
+        terminal state, so a shed job becomes admissible again once
+        the budget frees up.  ``latency_target_ms`` /
         ``error_rate_target`` are this tenant's SLO targets — the
         adaptive loop boosts tenants violating them."""
         with self._lock:
@@ -282,7 +285,7 @@ class ResourceArbiter:
         """Admit one job or raise :class:`AdmissionRejected`.
 
         Checks, in order: bounded queue (``queue_depth`` vs
-        ``queue_bound``), cumulative byte budget, and the deadline
+        ``queue_bound``), in-flight byte budget, and the deadline
         budget — a job whose ``deadline_s`` the current backlog
         cannot meet (estimated from the tenant's recent job-duration
         EWMA) is shed NOW rather than admitted to time out in line.
@@ -333,6 +336,19 @@ class ResourceArbiter:
             t.bytes_admitted = max(t.bytes_admitted - est_bytes, 0)
             t.admitted = max(t.admitted - 1, 0)
             t.rejected += 1
+
+    def release(self, label: str, est_bytes: int = 0) -> None:
+        """Refund one finished job's byte charge.
+
+        Unlike :meth:`retract` this is the NORMAL end of an admitted
+        job's life (done, failed, or drained — the bytes are no
+        longer in flight either way), so it does not touch the
+        admitted/rejected tallies."""
+        with self._lock:
+            t = self._tenants.get(label)
+            if t is None:
+                return
+            t.bytes_admitted = max(t.bytes_admitted - est_bytes, 0)
 
     def note_job_done(self, label: str, seconds: float, *,
                       ok: bool = True) -> None:

@@ -1,0 +1,271 @@
+"""SLO-aware prefetch planner: warm the shared cache ahead of the
+cursor, as deep as the latency promise requires.
+
+The serve regime's read problem is not "is the next range cached" but
+"will the next unit make its deadline" — on a remote source the
+answer is dominated by origin round trips, and the cure the reader
+already owns is :meth:`~tpuparquet.io.reader.FileReader.
+prefetch_chunks`: coalesced, parallel, populates the disk tier, and
+skips anything already cached (``contains`` — which, over a
+:class:`~tpuparquet.io.rangecache.SharedDiskRangeCache`, sees every
+OTHER server process's publishes too).  This module decides *when*
+and *how far ahead* to call it:
+
+* **Depth** comes from the SLO signals, clamped to
+  ``TPQ_PREFETCH_DEPTH`` (the max lookahead, 0 disables): when the
+  latency digest's p99 for ``(label, "unit")`` is comfortably inside
+  the job's ``unit_deadline`` (≤ 25% of it), one unit of lookahead is
+  plenty and the byte budget stays unspent; as the p99 climbs toward
+  the deadline the window deepens proportionally; and when the SLO
+  burn rate (``obs/slo.py`` over the time-series ring) says the error
+  budget is being spent at ≥ 1×, the planner goes to max depth —
+  origin latency must be fully hidden *before* units start missing
+  deadlines.  Without a deadline or digest data it stays at max depth
+  (prefetch is cheap insurance; ``contains`` dedup keeps it honest).
+* **Bytes** are bounded by ``TPQ_PREFETCH_BYTES_MB`` of
+  prefetched-but-unconsumed row-group bytes (meta
+  ``total_byte_size``), so a deep window over fat row groups cannot
+  blow the cache budget; the unit right after the cursor is always
+  allowed through, or fat units would never prefetch.
+* **Threads** are whatever the reader's own planner gets: the worker
+  thread binds :func:`~tpuparquet.serve.arbiter.tenant_scope`, so
+  ``prefetch_ranges`` sizes its pool from the tenant's arbiter share.
+
+Counter exactness: the worker thread runs every fetch under a
+``worker_stats`` collector; :meth:`PrefetchPlanner.close` (called on
+the job driver's thread, inside the job's ``collect_stats`` scope)
+merges them — so ``remote_ranges_fetched`` / ``remote_bytes`` /
+``cache_*_disk`` from prefetch land on the job's tenant exactly once,
+and fleet-wide sums stay conservation-exact.
+
+Lock discipline: the planner condition variable is a LEAF — window
+bookkeeping only; every fetch, digest read, and ring read happens
+outside it.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from . import arbiter as _arbiter
+
+__all__ = ["PrefetchPlanner", "prefetch_depth_default",
+           "prefetch_bytes_default"]
+
+#: units between SLO-signal refreshes (digest fold + ring read are
+#: not per-unit cheap; the signals move slower than this anyway)
+_REFRESH_UNITS = 16
+
+
+def prefetch_depth_default() -> int:
+    """``TPQ_PREFETCH_DEPTH`` — max units of lookahead the planner
+    may warm (default 2; ``0`` disables serve-side prefetch)."""
+    v = os.environ.get("TPQ_PREFETCH_DEPTH")
+    if v is None or v == "":
+        return 2
+    return max(0, int(v))
+
+
+def prefetch_bytes_default() -> int:
+    """``TPQ_PREFETCH_BYTES_MB`` in bytes — cap on
+    prefetched-but-unconsumed row-group bytes (default 64 MiB)."""
+    v = os.environ.get("TPQ_PREFETCH_BYTES_MB")
+    if v is None or v == "":
+        return 64 * (1 << 20)
+    return max(0, int(float(v) * (1 << 20)))
+
+
+def _unit_est_bytes(readers, unit) -> int:
+    """Window-budget sizing for one ``(file, row_group)`` unit from
+    footer meta — compressed row-group bytes, 0 when unknowable."""
+    fi, rgi = unit
+    r = readers[fi] if fi < len(readers) else None
+    if r is None:
+        return 0
+    try:
+        return max(0, int(r.meta.row_groups[rgi].total_byte_size))
+    except (AttributeError, IndexError, TypeError, ValueError):
+        return 0
+
+
+class PrefetchPlanner:
+    """One per running job: a worker thread that keeps the next
+    ``depth(t)`` units' chunk ranges warm in the (shared) disk tier.
+
+    Driver contract: :meth:`start` once, :meth:`note_progress(k)`
+    after each completed unit, :meth:`close` on the driver thread
+    inside the job's stats scope (merges the worker's counters and
+    joins the thread).  All methods are cheap; the fetching happens on
+    the planner's own thread."""
+
+    def __init__(self, readers, units, label: str, *,
+                 start: int = 0,
+                 unit_deadline: float | None = None,
+                 max_depth: int | None = None,
+                 byte_cap: int | None = None):
+        self._readers = readers
+        self._units = units
+        self._label = label
+        self._unit_deadline = unit_deadline
+        self._max_depth = (max_depth if max_depth is not None
+                           else prefetch_depth_default())
+        self._byte_cap = (byte_cap if byte_cap is not None
+                          else prefetch_bytes_default())
+        self._cv = threading.Condition()
+        self._cursor = start - 1   # last unit the driver consumed
+        self._next = start         # next unit index to prefetch
+        self._ahead: list = []     # (unit_idx, est_bytes) in window
+        self._stop = False
+        self._depth = 1            # deepened by the SLO signals
+        self._since_refresh = _REFRESH_UNITS  # refresh on first use
+        self._workers: list = []   # worker collectors, merged at close
+        self._thread: threading.Thread | None = None
+
+    # -- driver side ------------------------------------------------------
+
+    def start(self) -> "PrefetchPlanner":
+        if self._max_depth <= 0 or not self._units:
+            return self  # disabled: every method stays a no-op
+        self._thread = threading.Thread(
+            target=self._run, name=f"tpq-prefetch:{self._label}",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def note_progress(self, k: int) -> None:
+        """Unit ``k`` was consumed: slide the window."""
+        if self._thread is None:
+            return
+        with self._cv:
+            if k > self._cursor:
+                self._cursor = k
+                self._ahead = [(u, b) for u, b in self._ahead if u > k]
+            self._cv.notify_all()
+
+    def close(self) -> None:
+        """Stop + join, then fold the worker's counters into the
+        CALLING thread's collector — call on the driver thread, inside
+        the job's stats scope, after the scan loop ends."""
+        if self._thread is None:
+            return
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        self._thread.join(30.0)
+        self._thread = None
+        from ..stats import current_stats, merge_worker_stats
+
+        st = current_stats()
+        for ws in self._workers:
+            merge_worker_stats(st, ws, failed=False)
+        self._workers = []
+
+    # -- SLO signals ------------------------------------------------------
+
+    def _target_depth(self) -> int:
+        """Lookahead for the current window — see module docstring."""
+        depth = self._max_depth
+        p99_s = self._digest_p99_s()
+        if self._unit_deadline and p99_s is not None:
+            pressure = p99_s / self._unit_deadline
+            if pressure <= 0.25:
+                depth = 1
+            else:
+                depth = max(1, min(self._max_depth,
+                                   round(self._max_depth
+                                         * min(pressure, 1.0))))
+        burn = self._fast_burn()
+        if burn is not None and burn >= 1.0:
+            depth = self._max_depth
+        return depth
+
+    def _digest_p99_s(self) -> float | None:
+        from ..obs import digest as _digest
+
+        reg = _digest.digests()
+        if reg is None:
+            return None
+        g = reg.snapshot().get((self._label, "unit"))
+        if g is None or not g.n:
+            return None
+        return g.quantile(0.99) / 1e6  # digests observe microseconds
+
+    def _fast_burn(self) -> float | None:
+        """Fast-window burn rate for this label from the time-series
+        ring + SLO objectives; None when either is unarmed."""
+        from ..obs import slo as _slo
+        from ..obs import timeseries as _timeseries
+
+        ring = _timeseries.ring()
+        if ring is None:
+            return None
+        try:
+            objectives = [o for o in _slo.load_objectives()
+                          if o["label"] == self._label]
+            if not objectives:
+                return None
+            frames = _timeseries.load_ring(ring.dir)
+            if not frames:
+                return None
+            report = _slo.evaluate(frames, objectives)
+        except (OSError, ValueError, KeyError):
+            return None
+        for row in report["objectives"]:
+            burn = (row.get("burn") or {}).get("fast")
+            if burn is not None:
+                return burn
+        return None
+
+    # -- the worker -------------------------------------------------------
+
+    def _pick(self):
+        """Next unit to warm, or None to wait.  Called under the cv;
+        byte-cap and depth decisions use the last refreshed signals."""
+        if self._next >= len(self._units):
+            return None
+        if self._next > self._cursor + self._depth:
+            return None
+        ahead_bytes = sum(b for _u, b in self._ahead)
+        est = _unit_est_bytes(self._readers, self._units[self._next])
+        if ahead_bytes > 0 and ahead_bytes + est > self._byte_cap:
+            return None  # window full by bytes; first unit always goes
+        k = self._next
+        self._next += 1
+        self._ahead.append((k, est))
+        return k
+
+    def _run(self) -> None:
+        from ..stats import worker_stats
+
+        with worker_stats() as ws, _arbiter.tenant_scope(self._label):
+            # one collector for the thread's whole life; close()
+            # merges it after the join, so there is no concurrent
+            # access — the worker_stats exactness discipline
+            self._workers.append(ws)
+            while True:
+                with self._cv:
+                    k = self._pick()
+                    while k is None and not self._stop:
+                        self._cv.wait(0.05)
+                        k = self._pick()
+                    if self._stop:
+                        return
+                if self._since_refresh >= _REFRESH_UNITS:
+                    self._since_refresh = 0
+                    depth = self._target_depth()  # outside the cv
+                    with self._cv:
+                        self._depth = depth
+                self._since_refresh += 1
+                fi, rgi = self._units[k]
+                reader = (self._readers[fi]
+                          if fi < len(self._readers) else None)
+                if reader is None:
+                    continue
+                try:
+                    reader.prefetch_chunks(rgi)
+                except Exception:  # noqa: BLE001 — advisory path
+                    # prefetch must never fail the scan: the per-unit
+                    # decode re-reads with the full resilience policy
+                    # and surfaces real errors with coordinates
+                    pass

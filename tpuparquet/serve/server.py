@@ -341,6 +341,28 @@ class ScanServer:
 
     # -- the per-job driver ----------------------------------------------
 
+    @staticmethod
+    def _maybe_prefetcher(scan, label: str, opts: dict):
+        """Arm the SLO-aware prefetch planner for this job when it
+        can pay off: lookahead enabled, a disk tier to warm, and at
+        least one remote source to warm it from (a local mmap scan
+        gets nothing from prefetch).  Returns a started
+        :class:`~tpuparquet.serve.prefetch.PrefetchPlanner` or None."""
+        from ..io.rangecache import disk_cache
+        from .prefetch import PrefetchPlanner, prefetch_depth_default
+
+        if prefetch_depth_default() <= 0:
+            return None
+        if disk_cache() is None:
+            return None
+        if not any(r is not None and getattr(r, "_source", None)
+                   is not None for r in scan.readers):
+            return None
+        start, _total = scan._progress()
+        return PrefetchPlanner(
+            scan.readers, scan.units, label, start=start,
+            unit_deadline=opts.get("unit_deadline")).start()
+
     def _drive_job(self, job: ScanJob) -> None:
         from ..shard.scan import ShardedScan
         from ..stats import collect_stats
@@ -366,12 +388,19 @@ class ScanServer:
                 if self._draining:
                     scan.request_stop()  # raced the drain broadcast
                 with collect_stats() as st:
-                    for k, out in scan.run_iter():
-                        if job.sink is not None:
-                            job.sink(k, out)
-                        else:
-                            job.outputs[k] = out
-                        job.units_done += 1
+                    planner = self._maybe_prefetcher(scan, label, opts)
+                    try:
+                        for k, out in scan.run_iter():
+                            if job.sink is not None:
+                                job.sink(k, out)
+                            else:
+                                job.outputs[k] = out
+                            job.units_done += 1
+                            if planner is not None:
+                                planner.note_progress(k)
+                    finally:
+                        if planner is not None:
+                            planner.close()
                 job.stats = st
                 job.quarantine = scan.quarantine
                 # the scan's own tally is authoritative: it counts
@@ -392,6 +421,10 @@ class ScanServer:
             job.scan = None
         self._arb.note_job_done(label, time.monotonic() - t0,
                                 ok=final == "done")
+        # refund the in-flight byte charge: the admission-time bytes
+        # are no longer outstanding, so a previously shed job can now
+        # clear the byte-budget check on its retry
+        self._arb.release(label, job.est_bytes)
         with self._cv:
             self._running.pop(label, None)
             self._finished.append(job)

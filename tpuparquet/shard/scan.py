@@ -1077,6 +1077,14 @@ class ShardedScan(DurableScanMixin):
     the first undecoded unit in a fresh process.  The cursor is plain
     JSON-serializable data.
 
+    Epoch shuffling (training loaders): ``shuffle_seed=`` +
+    ``epoch=`` permute the unit list deterministically per epoch —
+    identical on every host, applied before the cursor exists, so
+    checkpoint/resume of a shuffled epoch stays duplicate-free (the
+    cursor records the shuffle identity and refuses a mismatched
+    resume).  With ``shuffle_seed=None`` (default) the natural order
+    is untouched and ``epoch`` is ignored.
+
     Fault tolerance (``on_error``):
 
     * ``"raise"`` (default) — first failure aborts the scan, exactly
@@ -1166,6 +1174,7 @@ class ShardedScan(DurableScanMixin):
                  progress_label: str = "scan",
                  postmortem=None,
                  filter=None,
+                 shuffle_seed: int | None = None, epoch: int = 0,
                  out_sharding=None, gather_to=None):
         from .mesh import make_mesh, resolve_out_sharding
 
@@ -1197,6 +1206,22 @@ class ShardedScan(DurableScanMixin):
         self.units = scan_units(self.readers, filter=self.filter,
                                 verdicts=self._verdicts,
                                 pruned=self._pruned)
+        # epoch shuffling for training loaders: a deterministic
+        # per-epoch permutation of the unit list, applied BEFORE any
+        # cursor/telemetry sees the units — the cursor stores (and
+        # resume validates) the permuted order, so a resumed epoch
+        # stays duplicate-free, and every host derives the identical
+        # permutation (string-seeded Random hashes with sha512, so
+        # PYTHONHASHSEED cannot skew it).  ``shuffle_seed=None`` (the
+        # default) leaves the natural file/row-group order untouched —
+        # byte-identical to a scan without the feature, epoch ignored.
+        self.shuffle_seed = shuffle_seed
+        self.epoch = int(epoch)
+        if shuffle_seed is not None:
+            import random
+
+            random.Random(
+                f"{int(shuffle_seed)}:{self.epoch}").shuffle(self.units)
         # progress_label keys this scan's registry gauges (see
         # obs/progress.py): concurrent scans in one serve process pass
         # distinct labels so their gauges don't clobber each other
@@ -1215,8 +1240,13 @@ class ShardedScan(DurableScanMixin):
             self._load_cursor(resume)
 
     def _load_cursor(self, cursor: dict) -> None:
+        expected = {}
+        if self.shuffle_seed is not None:
+            # shuffle identity is part of the cursor: resuming under a
+            # different seed/epoch would re-decode or skip units
+            expected["shuffle"] = [int(self.shuffle_seed), self.epoch]
         self._next_unit = cursor_load(cursor, self.units, "next_unit",
-                                      len(self.units))
+                                      len(self.units), **expected)
         self.quarantine = QuarantineReport.from_dicts(
             cursor.get("quarantine"))
         # the resumed scan re-opened its sources, so a file already
@@ -1231,8 +1261,12 @@ class ShardedScan(DurableScanMixin):
         :meth:`run_iter` steps; decoding restarts at the first unit not
         yet yielded.  Quarantined units ride along (coordinates +
         error class), so a resumed scan's report stays complete."""
+        extra = {}
+        if self.shuffle_seed is not None:
+            extra["shuffle"] = [int(self.shuffle_seed), self.epoch]
         return cursor_state(self.units, "next_unit", self._next_unit,
-                            quarantine=self.quarantine.as_dicts())
+                            quarantine=self.quarantine.as_dicts(),
+                            **extra)
 
     def device_for(self, unit_index: int):
         return self.devices[unit_index % len(self.devices)]
