@@ -1,4 +1,5 @@
-"""Compression registry + codec tests; pyarrow is the snappy byte oracle."""
+"""Compression registry + codec tests; pyarrow is the byte oracle for
+snappy and LZ4_RAW, and the decoded-equality oracle for GZIP/ZSTD."""
 
 import numpy as np
 import pyarrow as pa
@@ -10,6 +11,11 @@ from tpuparquet.compress import (
     compress_block,
     decompress_block,
     get_block_compressor,
+    lz4_compress,
+    lz4_decompress,
+    page_codec_settings,
+    page_compress_bound,
+    page_compress_into,
     register_block_compressor,
     registered_codecs,
     snappy_compress,
@@ -19,12 +25,29 @@ from tpuparquet.format.metadata import CompressionCodec
 
 rng = np.random.default_rng(3)
 
-# ZSTD is pluggable: the codec registers only when the optional
-# `zstandard` module is importable.  Images without it must SKIP the
-# zstd cases, not fail them (tier-1 reflects real regressions only).
-HAVE_ZSTD = CompressionCodec.ZSTD in registered_codecs()
+# ZSTD registers when EITHER backend exists: the system libzstd (found
+# via dlopen) or the optional `zstandard` wheel.  Boxes with neither
+# must SKIP the zstd cases, not fail them (tier-1 reflects real
+# regressions only).  TPQ_NATIVE_CODECS=0 pins the gate for the whole
+# run (the ci.sh fallback leg): without the wheel that leaves zstd
+# registered but backend-less, so the usability probe is env-aware.
+def _zstd_usable() -> bool:
+    if CompressionCodec.ZSTD not in registered_codecs():
+        return False
+    try:
+        import zstandard  # noqa: F401
+
+        return True
+    except ImportError:
+        from tpuparquet.compress import native_codecs_enabled
+
+        return native_codecs_enabled()
+
+
+HAVE_ZSTD = _zstd_usable()
 needs_zstd = pytest.mark.skipif(
-    not HAVE_ZSTD, reason="zstandard not installed in this image")
+    not HAVE_ZSTD,
+    reason="no usable zstd backend (system libzstd or zstandard wheel)")
 
 PAYLOADS = [
     b"",
@@ -81,6 +104,7 @@ class TestRegistry:
         CompressionCodec.UNCOMPRESSED,
         CompressionCodec.GZIP,
         CompressionCodec.SNAPPY,
+        CompressionCodec.LZ4_RAW,
         pytest.param(CompressionCodec.ZSTD, marks=needs_zstd),
     ],
 )
@@ -141,3 +165,318 @@ class TestSnappyMalformed:
         blob = bytes([1, 2 << 2, ord("a"), ord("b"), ord("c")])
         with pytest.raises(CompressionError):
             snappy_decompress(blob, None)
+
+
+class TestLz4CrossImpl:
+    """pyarrow's lz4_raw codec is the byte oracle for our LZ4 block
+    implementation — both directions, every payload shape."""
+
+    @pytest.mark.parametrize("payload", PAYLOADS, ids=range(len(PAYLOADS)))
+    def test_ours_to_pyarrow(self, payload):
+        ours = compress_block(CompressionCodec.LZ4_RAW, payload)
+        theirs = bytes(pa.decompress(
+            ours, decompressed_size=len(payload), codec="lz4_raw"))
+        assert theirs == payload
+
+    @pytest.mark.parametrize("payload", PAYLOADS, ids=range(len(PAYLOADS)))
+    def test_pyarrow_to_ours(self, payload):
+        theirs = bytes(pa.compress(payload, codec="lz4_raw"))
+        got = decompress_block(
+            CompressionCodec.LZ4_RAW, theirs, len(payload))
+        assert bytes(got) == payload
+
+    def test_compression_actually_happens(self):
+        data = b"hello world, " * 1000
+        assert len(lz4_compress(data)) < len(data) // 10
+
+
+class TestLz4PureNativeParity:
+    """The pure-Python encoder mirrors native/lz4raw.c step for step —
+    identical bytes, so files are bit-reproducible whichever side
+    wrote them (the parity anchor the ci.sh codec leg pins)."""
+
+    def test_byte_identical(self):
+        from tpuparquet.native import lz4_native
+
+        nat = lz4_native()
+        if nat is None:
+            pytest.skip("native lz4 unavailable (no compiler)")
+        r = np.random.default_rng(17)
+        cases = [
+            b"", b"a", b"abc", b"abcd" * 2000,
+            bytes(range(256)) * 300,
+            r.integers(0, 255, 70_000, dtype=np.uint8).tobytes(),
+            b"\x00" * 200_000,  # spans multiple 64K blocks
+            r.integers(0, 8, 150_000, dtype=np.uint8).tobytes(),
+            b"x" * 12, b"x" * 13,  # around the MFLIMIT end rule
+            np.arange(30_000, dtype=np.int64).tobytes(),
+        ]
+        for d in cases:
+            assert lz4_compress(d) == nat.compress(d), len(d)
+
+    def test_pure_decodes_native_and_back(self):
+        from tpuparquet.native import lz4_native
+
+        nat = lz4_native()
+        if nat is None:
+            pytest.skip("native lz4 unavailable (no compiler)")
+        d = np.arange(50_000, dtype=np.int32).tobytes()
+        assert lz4_decompress(nat.compress(d), len(d)) == d
+        assert nat.decompress(lz4_compress(d), len(d)) == d
+
+
+class TestLz4Malformed:
+    """Adversarial LZ4 streams raise CompressionError from both the
+    pure decoder and the C decoder — never crash, never overrun."""
+
+    CASES = [
+        b"\x10",                    # literal run of 1, no payload
+        b"\xf0",                    # 15-extension announced, truncated
+        b"\xff" * 20,               # runaway 255-chain
+        bytes([0x00, 0x00, 0x00]),  # bytes after final literal token
+        bytes([0x10, ord("a"), 0x00, 0x00]),  # offset 0
+        bytes([0x10, ord("a"), 0x05, 0x00]),  # offset 5 > output pos 1
+        bytes([0x1f, ord("a")]),    # match-length ext truncated
+        bytes([0x10, ord("a"), 0x01]),        # offset truncated
+    ]
+
+    @pytest.mark.parametrize("blob", CASES, ids=range(len(CASES)))
+    def test_pure(self, blob):
+        with pytest.raises(CompressionError):
+            lz4_decompress(blob, 64)
+
+    @pytest.mark.parametrize("blob", CASES, ids=range(len(CASES)))
+    def test_native(self, blob):
+        from tpuparquet.native import lz4_native
+
+        nat = lz4_native()
+        if nat is None:
+            pytest.skip("native lz4 unavailable (no compiler)")
+        with pytest.raises(ValueError):
+            nat.decompress(blob, 64)
+
+    def test_mutation_fuzz_never_crashes(self):
+        """Seeded random corruption of valid frames: every mutation
+        either raises CompressionError or decodes to SOME bytes of the
+        expected size — no raw IndexError/struct.error/segfault.  Runs
+        under ASan+UBSan in tools/analyze/native.sh where a C overrun
+        would abort."""
+        from tpuparquet.native import lz4_native
+
+        r = np.random.default_rng(23)
+        base = r.integers(0, 16, 30_000, dtype=np.uint8).tobytes()
+        nat = lz4_native()
+        for trial in range(200):
+            blob = bytearray(lz4_compress(base))
+            k = int(r.integers(1, 8))
+            for _ in range(k):
+                blob[int(r.integers(0, len(blob)))] = int(r.integers(0, 256))
+            if r.integers(0, 2):
+                blob = blob[:int(r.integers(0, len(blob)))]
+            for decode in filter(None, (
+                    lambda b: lz4_decompress(b, len(base)),
+                    (lambda b: nat.decompress(b, len(base)))
+                    if nat is not None else None)):
+                try:
+                    out = decode(bytes(blob))
+                    assert len(out) == len(base)
+                except (CompressionError, ValueError):
+                    pass
+
+    def test_truncated_payload_fuzz(self):
+        """Every truncation point of a valid stream fails cleanly."""
+        blob = lz4_compress(b"the quick brown fox " * 50)
+        for cut in range(len(blob)):
+            try:
+                lz4_decompress(blob[:cut], 1000)
+            except CompressionError:
+                pass
+
+
+class TestGzipZstdNativeBindings:
+    """The ctypes system-library bindings against the stdlib/wheel
+    fallbacks: same decoded bytes, multi-member/multi-frame capable
+    both ways (the shapes the block-parallel writer emits)."""
+
+    def test_gzip_native_matches_zlib_module(self):
+        from tpuparquet.native.syslibs import zlib_native
+
+        nat = zlib_native()
+        if nat is None:
+            pytest.skip("system libz not loadable")
+        import zlib
+
+        for d in PAYLOADS:
+            g = nat.compress(d)
+            assert zlib.decompress(g, 31) == d
+            assert nat.decompress(g, len(d)) == d
+
+    def test_gzip_multi_member(self):
+        d = b"alpha" * 4000 + b"beta" * 4000
+        parts = [compress_block(CompressionCodec.GZIP, d[:10_000]),
+                 compress_block(CompressionCodec.GZIP, d[10_000:])]
+        got = decompress_block(CompressionCodec.GZIP,
+                               b"".join(parts), len(d))
+        assert got == d
+
+    @needs_zstd
+    def test_zstd_multi_frame(self):
+        d = np.arange(30_000, dtype=np.int64).tobytes()
+        parts = [compress_block(CompressionCodec.ZSTD, d[:100_000]),
+                 compress_block(CompressionCodec.ZSTD, d[100_000:])]
+        got = decompress_block(CompressionCodec.ZSTD,
+                               b"".join(parts), len(d))
+        assert bytes(got) == d
+
+    @needs_zstd
+    def test_zstd_corrupt_raises(self):
+        with pytest.raises(CompressionError):
+            decompress_block(CompressionCodec.ZSTD,
+                             b"\x12\x34\x56\x78garbage", 100)
+        c = compress_block(CompressionCodec.ZSTD, b"x" * 1000)
+        with pytest.raises(CompressionError):
+            decompress_block(CompressionCodec.ZSTD, c[:len(c) // 2], 1000)
+
+    def test_gzip_corrupt_raises(self):
+        with pytest.raises(CompressionError):
+            decompress_block(CompressionCodec.GZIP, b"not gzip at all", 10)
+        c = compress_block(CompressionCodec.GZIP, b"y" * 1000)
+        with pytest.raises(CompressionError):
+            decompress_block(CompressionCodec.GZIP, c[:len(c) // 2], 1000)
+
+    def test_zstd_level_knob(self, monkeypatch):
+        if not HAVE_ZSTD:
+            pytest.skip("no zstd backend")
+        d = (b"level knob payload " * 3000)
+        monkeypatch.setenv("TPQ_ZSTD_LEVEL", "1")
+        c1 = compress_block(CompressionCodec.ZSTD, d)
+        monkeypatch.setenv("TPQ_ZSTD_LEVEL", "19")
+        c19 = compress_block(CompressionCodec.ZSTD, d)
+        for c in (c1, c19):
+            assert bytes(decompress_block(
+                CompressionCodec.ZSTD, c, len(d))) == d
+        assert len(c19) <= len(c1)
+
+
+class TestNativeCodecsDisabled:
+    """TPQ_NATIVE_CODECS=0 pins the fallbacks; output must still
+    round-trip and interop with the native side."""
+
+    @pytest.mark.parametrize("codec", [
+        CompressionCodec.SNAPPY,
+        CompressionCodec.GZIP,
+        CompressionCodec.LZ4_RAW,
+    ])
+    def test_fallback_roundtrip_and_cross(self, codec, monkeypatch):
+        d = np.arange(20_000, dtype=np.int64).tobytes()
+        monkeypatch.setenv("TPQ_NATIVE_CODECS", "0")
+        pure = compress_block(codec, d)
+        assert bytes(decompress_block(codec, pure, len(d))) == d
+        monkeypatch.setenv("TPQ_NATIVE_CODECS", "1")
+        nat = compress_block(codec, d)
+        # cross-decode: native decodes pure output and vice versa
+        assert bytes(decompress_block(codec, pure, len(d))) == d
+        monkeypatch.setenv("TPQ_NATIVE_CODECS", "0")
+        assert bytes(decompress_block(codec, nat, len(d))) == d
+
+    def test_lz4_bytes_identical_across_gate(self, monkeypatch):
+        # LZ4 is the byte-parity codec: gate on/off emits SAME bytes
+        d = np.arange(9_000, dtype=np.int32).tobytes()
+        monkeypatch.setenv("TPQ_NATIVE_CODECS", "0")
+        pure = compress_block(CompressionCodec.LZ4_RAW, d)
+        monkeypatch.setenv("TPQ_NATIVE_CODECS", "1")
+        nat = compress_block(CompressionCodec.LZ4_RAW, d)
+        from tpuparquet.native import lz4_native
+
+        if lz4_native() is not None:
+            assert pure == nat
+
+    def test_page_ctx_disabled(self, monkeypatch):
+        monkeypatch.setenv("TPQ_NATIVE_CODECS", "0")
+        for codec in (CompressionCodec.SNAPPY, CompressionCodec.GZIP,
+                      CompressionCodec.LZ4_RAW, CompressionCodec.ZSTD):
+            assert page_codec_settings(codec) is None
+
+
+class TestBlockParallelSplit:
+    """page_compress_into: the frame split is deterministic in block
+    size (not worker count), engages only for concatenation-safe codecs
+    past the 2-block threshold, and always decodes back to the input."""
+
+    def _ctx(self, codec):
+        ctx = page_codec_settings(codec)
+        if ctx is None:
+            pytest.skip(f"no native page ctx for {codec.name}")
+        return ctx
+
+    @pytest.mark.parametrize("codec", [
+        CompressionCodec.GZIP,
+        pytest.param(CompressionCodec.ZSTD, marks=needs_zstd),
+    ])
+    def test_split_decodes_identically(self, codec, monkeypatch):
+        monkeypatch.setenv("TPQ_COMPRESS_BLOCK_KB", "64")
+        ctx = self._ctx(codec)
+        d = np.arange(80_000, dtype=np.int64).tobytes()  # 640 KB
+        src = np.frombuffer(d, dtype=np.uint8)
+        for w in (1, 2, 4):
+            out = np.empty(page_compress_bound(ctx, src.size, w),
+                           dtype=np.uint8)
+            n = page_compress_into(ctx, src, out, workers=w)
+            got = decompress_block(codec, out[:n].tobytes(), len(d))
+            assert bytes(got) == d
+        # multi-worker widths emit identical bytes (boundaries depend
+        # only on the block size)
+        out2 = np.empty(page_compress_bound(ctx, src.size, 2),
+                        dtype=np.uint8)
+        n2 = page_compress_into(ctx, src, out2, workers=2)
+        out4 = np.empty(page_compress_bound(ctx, src.size, 4),
+                        dtype=np.uint8)
+        n4 = page_compress_into(ctx, src, out4, workers=4)
+        assert n2 == n4 and np.array_equal(out2[:n2], out4[:n4])
+
+    def test_one_worker_single_frame(self, monkeypatch):
+        monkeypatch.setenv("TPQ_COMPRESS_BLOCK_KB", "64")
+        ctx = self._ctx(CompressionCodec.GZIP)
+        d = np.zeros(500_000, dtype=np.uint8)
+        out = np.empty(page_compress_bound(ctx, d.size, 1), dtype=np.uint8)
+        n = page_compress_into(ctx, d, out, workers=1)
+        # single gzip member == exactly what compress_block produces
+        assert out[:n].tobytes() == compress_block(
+            CompressionCodec.GZIP, d.tobytes())
+
+    def test_unsplittable_codecs_stay_single(self, monkeypatch):
+        monkeypatch.setenv("TPQ_COMPRESS_BLOCK_KB", "64")
+        for codec in (CompressionCodec.SNAPPY, CompressionCodec.LZ4_RAW):
+            ctx = self._ctx(codec)
+            assert not ctx.splittable
+            d = np.zeros(500_000, dtype=np.uint8)
+            out = np.empty(page_compress_bound(ctx, d.size, 8),
+                           dtype=np.uint8)
+            n = page_compress_into(ctx, d, out, workers=8)
+            assert bytes(decompress_block(
+                codec, out[:n].tobytes(), d.size)) == bytes(d.tobytes())
+
+    @needs_zstd
+    def test_zstd_frame_parallel_decode(self):
+        from tpuparquet.kernels.arena import lease_arena, return_arena
+        from tpuparquet.compress import decompress_block_into
+        from tpuparquet.native.syslibs import zstd_native
+        from tpuparquet.stats import collect_stats
+
+        nat = zstd_native()
+        if nat is None:
+            pytest.skip("system libzstd not loadable")
+        d = np.arange(60_000, dtype=np.int64).tobytes()
+        multi = nat.compress(d[:240_000]) + nat.compress(d[240_000:])
+        arena = lease_arena()
+        try:
+            with collect_stats() as st:
+                out = decompress_block_into(
+                    CompressionCodec.ZSTD,
+                    np.frombuffer(multi, dtype=np.uint8),
+                    len(d), arena, workers=4)
+            assert out.tobytes() == d
+            assert st.codec_split_frames == 2
+        finally:
+            arena.release_all()
+            return_arena(arena)

@@ -4,7 +4,8 @@ Replaces the reference's Java parquet-mr Docker harness
 (``compatibility/``, ``run_tests.bash:14-19``): instead of shelling out
 to ``parquet-tools cat --json`` we round-trip through pyarrow in-process.
 
-Direction A: our writer x {none,gzip,snappy,zstd} x {v1,v2} -> pyarrow
+Direction A: our writer x {none,gzip,snappy,lz4_raw,zstd} x {v1,v2} ->
+pyarrow
 reads identical data (= "other readers vs our writer").
 Direction B: pyarrow writer (dict, delta, byte-stream-split, nested,
 nulls) -> our reader reads identical data (= "our reader vs other
@@ -23,16 +24,19 @@ import pytest
 from tpuparquet import CompressionCodec, FileReader, FileWriter
 from tpuparquet.compress import registered_codecs
 
-# ZSTD is pluggable (registers only when the optional `zstandard`
-# module is importable): skip, don't fail, on images without the wheel.
+# ZSTD registers when EITHER backend exists: the system libzstd (found
+# via dlopen) or the optional `zstandard` wheel.  Boxes with neither
+# skip, don't fail.
 HAVE_ZSTD = CompressionCodec.ZSTD in registered_codecs()
 needs_zstd = pytest.mark.skipif(
-    not HAVE_ZSTD, reason="zstandard not installed in this image")
+    not HAVE_ZSTD,
+    reason="no zstd backend (system libzstd or zstandard wheel)")
 
 CODECS = [
     pytest.param(CompressionCodec.UNCOMPRESSED, id="UNCOMPRESSED"),
     pytest.param(CompressionCodec.SNAPPY, id="SNAPPY"),
     pytest.param(CompressionCodec.GZIP, id="GZIP"),
+    pytest.param(CompressionCodec.LZ4_RAW, id="LZ4_RAW"),
     pytest.param(CompressionCodec.ZSTD, marks=needs_zstd, id="ZSTD"),
 ]
 
@@ -40,6 +44,9 @@ PA_CODEC = {
     CompressionCodec.UNCOMPRESSED: "none",
     CompressionCodec.SNAPPY: "snappy",
     CompressionCodec.GZIP: "gzip",
+    # pyarrow's "lz4" write param emits the LZ4_RAW codec id on modern
+    # arrow (the Hadoop-framed legacy format is read-only there)
+    CompressionCodec.LZ4_RAW: "lz4",
     CompressionCodec.ZSTD: "zstd",
 }
 

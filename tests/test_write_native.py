@@ -101,16 +101,33 @@ class TestByteParity:
         pure = _build(cols, masks, codec=codec, data_page_v2=v2)
         assert native == pure
 
-    def test_parity_gzip_is_pure_both_ways(self, corpus, monkeypatch):
-        """An unsupported codec never takes the native page path (the
-        registered compressor keeps full control of the bytes)."""
+    def test_parity_gzip_native_and_gated(self, corpus, monkeypatch):
+        """GZIP rides the native page path since round 24 (the system
+        zlib binding, ``native/syslibs.py``) and flipping
+        ``TPQ_WRITE_NATIVE`` still never changes the bytes; gating the
+        native codecs off (``TPQ_NATIVE_CODECS=0``) hands the
+        registered pure compressor back full control of the page
+        bodies."""
         cols, masks = corpus
+        from tpuparquet.compress import native_codecs_enabled
         with collect_stats() as st:
             a = _build(cols, masks, codec=CompressionCodec.GZIP)
-        assert st.pages_assembled_native == 0
+        if _NATIVE_ON and native_codecs_enabled():
+            assert st.pages_assembled_native > 0
         assert st.pages_written > 0
         monkeypatch.setenv("TPQ_WRITE_NATIVE", "0")
         assert a == _build(cols, masks, codec=CompressionCodec.GZIP)
+        monkeypatch.setenv("TPQ_WRITE_NATIVE", "1")
+        monkeypatch.setenv("TPQ_NATIVE_CODECS", "0")
+        with collect_stats() as st2:
+            b = _build(cols, masks, codec=CompressionCodec.GZIP)
+        assert st2.pages_assembled_native == 0
+        assert st2.pages_written > 0
+        ra = FileReader(io.BytesIO(a)).read_row_group_arrays(0)
+        rb = FileReader(io.BytesIO(b)).read_row_group_arrays(0)
+        assert np.array_equal(ra["pickup_ts"].values,
+                              rb["pickup_ts"].values)
+        assert np.array_equal(ra["tip"].values, rb["tip"].values)
 
     def test_parity_row_path(self, monkeypatch):
         """add_data -> flush_row_group (null_count derived in the chunk

@@ -1,9 +1,10 @@
 #!/bin/bash
 # Native sanitizer + static-analysis leg of tpq-analyze.
 #
-# The seven C codecs (delta.c, hybrid.c, intern.c, pack.c, page.c,
-# plane.c, snappy.c) run with the GIL released on attacker-influenced
-# bytes (and, on the write side, on whole column bodies);
+# The eight C codecs (delta.c, hybrid.c, intern.c, lz4raw.c, pack.c,
+# page.c, plane.c, snappy.c) run with the GIL released on
+# attacker-influenced bytes (and, on the write side, on whole column
+# bodies);
 # Python-level tests structurally cannot see a heap overrun that
 # happens to land in mapped memory, or UB the optimizer hasn't
 # punished yet.  This script:
@@ -28,8 +29,8 @@ cd "$(dirname "$0")/../.."
 
 SRC_DIR=tpuparquet/native
 SRCS=("$SRC_DIR"/delta.c "$SRC_DIR"/hybrid.c "$SRC_DIR"/intern.c \
-      "$SRC_DIR"/pack.c "$SRC_DIR"/page.c "$SRC_DIR"/plane.c \
-      "$SRC_DIR"/snappy.c)
+      "$SRC_DIR"/lz4raw.c "$SRC_DIR"/pack.c "$SRC_DIR"/page.c \
+      "$SRC_DIR"/plane.c "$SRC_DIR"/snappy.c)
 
 # coverage check: the pinned SRCS list must name every native/*.c on
 # disk — a codec added without updating this script would otherwise
@@ -117,8 +118,8 @@ env JAX_PLATFORMS=cpu \
     ASAN_OPTIONS=detect_leaks=0:abort_on_error=1 \
     UBSAN_OPTIONS=halt_on_error=1:print_stacktrace=1 \
     timeout -k 10 600 python -m pytest \
-      tests/test_native.py tests/test_codecs.py tests/test_fuzz.py \
-      tests/test_write_native.py \
+      tests/test_native.py tests/test_codecs.py tests/test_compress.py \
+      tests/test_fuzz.py tests/test_write_native.py \
       "tests/test_corpus.py::TestCrashRegressions" \
       -q -p no:cacheprovider \
   || fail "sanitized test run (a failure here that does not reproduce \

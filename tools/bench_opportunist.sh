@@ -64,13 +64,14 @@ while true; do
         echo "$(date -Is) scan-scale sweep FAILED (rc=$?)"
       fi
     fi
-    # write-pipeline bench (VERDICT item 3 / round-15 write wall):
-    # CPU-bound, but queued here so every session leaves a record on
-    # the same box the ladder ran on (per-stage split + pyarrow
-    # anchors + thread sweep -> WRITE_r01.json)
-    if [ ! -f WRITE_r01.json ]; then
+    # write-pipeline bench (VERDICT item 3 / round-15 write wall,
+    # round-24 codec matrix): CPU-bound, but queued here so every
+    # session leaves a record on the same box the ladder ran on
+    # (per-stage split + per-codec legs + pyarrow anchors + thread
+    # sweep -> WRITE_r02.json)
+    if [ ! -f WRITE_r02.json ]; then
       echo "$(date -Is) running write-pipeline bench"
-      if timeout 1800 python tools/bench_write.py; then
+      if timeout 2400 python tools/bench_write.py; then
         echo "$(date -Is) write bench OK"
       else
         echo "$(date -Is) write bench FAILED (rc=$?)"

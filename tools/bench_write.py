@@ -23,7 +23,16 @@ pages pipelined on the serial path), a native-off leg
 (``TPQ_WRITE_NATIVE=0``) for the pipeline's own speedup, and — for
 config2 — a ``TPQ_PAGE_ROWS`` leg exercising the multi-page pipeline.
 Counters must account for every page written (asserted here, not just
-reported).  Emits ``WRITE_r01.json`` in the repo root (or ``--out``).
+reported).
+
+Round 24 adds the **codec matrix**: the config2 taxi shape written
+under every registered codec (uncompressed/snappy/gzip/zstd/lz4_raw) ×
+native codecs on/off (``TPQ_NATIVE_CODECS``) × a
+``TPQ_COMPRESS_BLOCK_KB`` block-parallel sweep for the splittable
+codecs (gzip/zstd), each against pyarrow writing the same data with the
+matching compression.
+
+Emits ``WRITE_r02.json`` in the repo root (or ``--out``).
 ``TPQ_BENCH_TARGET`` scales the corpus for smoke runs.
 
 Usage: JAX_PLATFORMS=cpu python tools/bench_write.py [--out PATH]
@@ -180,6 +189,127 @@ def _build_config3():
 _BUILDERS = {"config1": _build_config1, "config2": _build_config2,
              "config3": _build_config3}
 
+# ---- round 24: per-codec matrix on the config2 taxi shape -------------
+
+_PA_COMP = {
+    "uncompressed": "none",
+    "snappy": "snappy",
+    "gzip": "gzip",
+    "zstd": "zstd",
+    "lz4_raw": "lz4",  # pyarrow's "lz4" writes the LZ4_RAW codec id
+}
+_SPLITTABLE = {"gzip", "zstd"}  # framed: safe to emit as N members/frames
+
+
+def _codec_matrix() -> dict:
+    """config2's taxi columns under every registered codec: threads
+    sweep, native-codecs-off leg, block-split sweep (splittable codecs),
+    pyarrow anchor with matching compression."""
+    from tpuparquet import CompressionCodec, FileWriter
+    from tpuparquet.cli import CODECS
+    from tpuparquet.compress import registered_codecs
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(52)
+    per = TARGET // 5
+    pay_mask = rng.random(per) >= 0.05
+    cols = {
+        "pickup_ts": 1_700_000_000_000
+        + rng.integers(0, 3_600_000, size=per).cumsum(),
+        "passenger_count": rng.integers(1, 7, size=per, dtype=np.int32),
+        "rate_code": rng.integers(1, 6, size=per, dtype=np.int32),
+        "trip_distance_mm": rng.integers(100, 50_000, size=per),
+        "payment_type": rng.integers(0, 5, size=int(pay_mask.sum()),
+                                     dtype=np.int32),
+    }
+    schema = """message taxi {
+        required int64 pickup_ts;
+        required int32 passenger_count;
+        required int32 rate_code;
+        required int64 trip_distance_mm;
+        optional int32 payment_type;
+    }"""
+    pay_full = np.zeros(per, dtype=np.int32)
+    pay_full[pay_mask] = cols["payment_type"]
+    table = pa.table({
+        "pickup_ts": cols["pickup_ts"],
+        "passenger_count": cols["passenger_count"],
+        "rate_code": cols["rate_code"],
+        "trip_distance_mm": cols["trip_distance_mm"],
+        "payment_type": pa.array(pay_full, mask=~pay_mask),
+    })
+
+    registered = registered_codecs()
+    out: dict = {}
+    for name, codec in CODECS.items():
+        key = "uncompressed" if codec is CompressionCodec.UNCOMPRESSED \
+            else name
+        if codec not in registered:
+            out[key] = {"skipped": "codec not registered on this box"}
+            continue
+
+        def ours(_c=codec):
+            buf = io.BytesIO()
+            w = FileWriter(buf, schema, codec=_c)
+            w.write_columns(cols, masks={"payment_type": pay_mask})
+            w.close()
+            return buf
+
+        leg: dict = {}
+        blob = ours()
+        leg["file_bytes"] = blob.getbuffer().nbytes
+        sweep = {}
+        for t in THREADS:
+            os.environ["TPQ_WRITE_THREADS"] = str(t)
+            sweep[str(t)] = round(_best(ours), 6)
+        os.environ.pop("TPQ_WRITE_THREADS", None)
+        best_us = min(sweep.values())
+        leg["threads_sweep_s"] = sweep
+        leg["write_s"] = round(best_us, 6)
+        leg["stages"] = _staged_run(ours)
+
+        os.environ["TPQ_NATIVE_CODECS"] = "0"
+        try:
+            leg["native_codecs_off_s"] = round(_best(ours), 6)
+            leg["native_codec_speedup"] = round(
+                leg["native_codecs_off_s"] / best_us, 3)
+        except Exception as e:
+            # zstd has no pure-Python fallback: with the wheel absent,
+            # disabling the native codec leaves no backend at all
+            leg["native_codecs_off_s"] = None
+            leg["native_codecs_off_skipped"] = str(e)
+        finally:
+            del os.environ["TPQ_NATIVE_CODECS"]
+
+        if key in _SPLITTABLE:
+            # block-parallel split: worth wall-clock only with spare
+            # cores, but the sweep also pins the split's overhead when
+            # cores are scarce (the regression this leg watches)
+            blocks = {}
+            os.environ["TPQ_WRITE_THREADS"] = str(max(THREADS))
+            try:
+                for kb in (256, 1024):
+                    os.environ["TPQ_COMPRESS_BLOCK_KB"] = str(kb)
+                    blocks[str(kb)] = round(_best(ours), 6)
+            finally:
+                os.environ.pop("TPQ_COMPRESS_BLOCK_KB", None)
+                os.environ.pop("TPQ_WRITE_THREADS", None)
+            leg["block_kb_sweep_s"] = blocks
+
+        def theirs():
+            pq.write_table(table, io.BytesIO(),
+                           compression=_PA_COMP[key],
+                           use_dictionary=True)
+
+        best_pa = _best(theirs)
+        leg["pyarrow_write_s"] = round(best_pa, 6)
+        leg["write_vs_pyarrow"] = round(best_pa / best_us, 3)
+        out[key] = leg
+        print(json.dumps({key: leg}, indent=None), flush=True)
+    return out
+
 
 def _best(fn, reps=REPS) -> float:
     best = float("inf")
@@ -257,7 +387,7 @@ def bench_one(name: str) -> dict:
 
 def main(argv=None) -> int:
     args = argv if argv is not None else sys.argv[1:]
-    out_path = "WRITE_r01.json"
+    out_path = "WRITE_r02.json"
     if "--out" in args:
         out_path = args[args.index("--out") + 1]
     rec = {
@@ -274,6 +404,8 @@ def main(argv=None) -> int:
         rec["configs"][name] = bench_one(name)
         print(json.dumps({name: rec["configs"][name]}, indent=None),
               flush=True)
+    print("[bench_write] codec matrix ...", flush=True)
+    rec["codecs"] = _codec_matrix()
     with open(out_path, "w") as f:
         json.dump(rec, f, indent=2, sort_keys=True)
         f.write("\n")

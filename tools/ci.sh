@@ -1,7 +1,7 @@
 #!/bin/bash
 # In-repo CI gate (counterpart of the reference's .circleci/config.yml,
 # which pins go versions and runs `go test ./...` + the compatibility
-# corpus per commit).  Nineteen stages, pinned env:
+# corpus per commit).  Twenty stages, pinned env:
 #
 #   1. tier-1 suite   — the ROADMAP.md verify command, gated on a PASS
 #                       FLOOR rather than rc: optional deps (zstandard,
@@ -169,6 +169,22 @@
 #                       flip, zero quarantines, byte identity to the
 #                       local control) under TPQ_LOCKCHECK=strict
 #                       across three chaos seeds
+#  20. codec parity     — strict (rc=0): the round-24 codec-matrix
+#                       gate.  The block-codec suite
+#                       (tests/test_compress.py), re-run under
+#                       TPQ_WRITE_NATIVE=0 and under
+#                       TPQ_NATIVE_CODECS=0 (pure fallbacks can never
+#                       silently rot), then a whole-file equivalence
+#                       sweep over every registered codec: native-on
+#                       vs native-off files byte-identical where the
+#                       two sides are pinned deterministic
+#                       (uncompressed always; lz4_raw via the
+#                       pure==C mirror; gzip when the runtime probe
+#                       shows the bound zlib matches the stdlib
+#                       byte-for-byte) and decoded-identical
+#                       elsewhere, plus 1-thread vs N-thread
+#                       block-split writes decoded-identical under
+#                       chaos seeds with TPQ_LOCKCHECK=strict
 #
 # Usage: bash tools/ci.sh            (exit 0 = gate passed)
 # The tier-1 stage mirrors ROADMAP.md exactly — if you change one,
@@ -191,7 +207,7 @@ CI_PASS_FLOOR=${CI_PASS_FLOOR:-1000}
 
 fail() { echo "ci.sh: FAILED at stage $1" >&2; exit 1; }
 
-echo "=== stage 1/19: tier-1 suite (pass floor $CI_PASS_FLOOR) ==="
+echo "=== stage 1/20: tier-1 suite (pass floor $CI_PASS_FLOOR) ==="
 rm -f /tmp/_t1.log
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
@@ -205,25 +221,25 @@ echo "DOTS_PASSED=$passed"
 [ "$passed" -ge "$CI_PASS_FLOOR" ] \
   || fail "tier-1 ($passed passed < floor $CI_PASS_FLOOR)"
 
-echo "=== stage 2/19: smoke bench (CPU backend, tiny target) ==="
+echo "=== stage 2/20: smoke bench (CPU backend, tiny target) ==="
 TPQ_BENCH_TARGET=60000 TPQ_BENCH_CPU=1 timeout -k 10 600 \
   python bench.py > /tmp/_ci_bench.json || fail "smoke bench"
 tail -1 /tmp/_ci_bench.json
 
-echo "=== stage 3/19: crash corpus + fault-injection matrix (strict) ==="
+echo "=== stage 3/20: crash corpus + fault-injection matrix (strict) ==="
 timeout -k 10 600 python -m pytest \
   "tests/test_corpus.py::TestCrashRegressions" tests/test_faults.py \
   -q -p no:cacheprovider || fail "corpus/faults"
 
-echo "=== stage 4/19: salvage + strict metadata (strict) ==="
+echo "=== stage 4/20: salvage + strict metadata (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_salvage.py \
   -q -p no:cacheprovider || fail "salvage"
 
-echo "=== stage 5/19: deadlines/hedging + kill-resume checkpoints (strict) ==="
+echo "=== stage 5/20: deadlines/hedging + kill-resume checkpoints (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_deadline.py \
   tests/test_checkpoint.py -q -p no:cacheprovider || fail "time/crash"
 
-echo "=== stage 6/19: plan matrix: serial vs parallel, cache on (strict) ==="
+echo "=== stage 6/20: plan matrix: serial vs parallel, cache on (strict) ==="
 # leg A: pinned-serial planning (the TPQ_PLAN_THREADS=1 reference path)
 TPQ_PLAN_THREADS=1 timeout -k 10 600 python -m pytest \
   tests/test_plan_parallel.py tests/test_plan_cache.py \
@@ -234,7 +250,7 @@ TPQ_PLAN_CACHE_MB=64 timeout -k 10 600 python -m pytest \
   tests/test_plan_parallel.py tests/test_fallback_matrix.py \
   -q -p no:cacheprovider || fail "plan matrix (cache-on leg)"
 
-echo "=== stage 7/19: live obs gate + overhead guard (strict) ==="
+echo "=== stage 7/20: live obs gate + overhead guard (strict) ==="
 timeout -k 10 600 python -m pytest tests/test_live_obs.py \
   tests/test_env_docs.py -q -p no:cacheprovider || fail "live obs"
 # overhead guard: the always-on default must stay within a generous
@@ -245,7 +261,7 @@ timeout -k 10 600 python tools/bench_obs.py --values 2000000 \
   || fail "obs overhead guard"
 tail -5 /tmp/_ci_obs.json
 
-echo "=== stage 8/19: pruning parity gate (strict) ==="
+echo "=== stage 8/20: pruning parity gate (strict) ==="
 # leg A: the whole pushdown suite (write/read page index + bloom,
 # verdicts, late materialization, counter exactness, corrupt-index
 # degrade, pyarrow interop) on the default pool width
@@ -258,13 +274,13 @@ TPQ_PLAN_THREADS=1 TPQ_PRUNE=0 timeout -k 10 600 python -m pytest \
   "tests/test_prune.py::TestParity" \
   -q -p no:cacheprovider || fail "pruning parity (prune-off leg)"
 
-echo "=== stage 9/19: tpq-analyze invariant passes + sanitizer leg (strict) ==="
+echo "=== stage 9/20: tpq-analyze invariant passes + sanitizer leg (strict) ==="
 timeout -k 10 300 python -m tools.analyze || fail "tpq-analyze"
 timeout -k 10 600 python -m pytest tests/test_analyze.py \
   -q -p no:cacheprovider || fail "analyzer self-test"
 timeout -k 10 900 bash tools/analyze/native.sh || fail "native sanitizers"
 
-echo "=== stage 10/19: gather placement parity gate (strict) ==="
+echo "=== stage 10/20: gather placement parity gate (strict) ==="
 # leg A: the placement suite — byte parity placed vs replicated across
 # filter/quarantine/salvage/resume/multi-host, placement + counter pins,
 # mesh-mismatch errors
@@ -277,7 +293,7 @@ TPQ_GATHER_TO=0 timeout -k 10 600 python -m pytest \
   tests/test_gather_placement.py \
   -q -p no:cacheprovider || fail "gather placement (env leg)"
 
-echo "=== stage 11/19: write-pipeline parity gate (strict) ==="
+echo "=== stage 11/20: write-pipeline parity gate (strict) ==="
 # leg A: the whole native-write suite on the default knobs
 timeout -k 10 600 python -m pytest tests/test_write_native.py \
   -q -p no:cacheprovider || fail "write parity"
@@ -288,7 +304,7 @@ TPQ_WRITE_NATIVE=0 timeout -k 10 600 python -m pytest \
   tests/test_write_native.py -q -p no:cacheprovider \
   || fail "write parity (native-off leg)"
 
-echo "=== stage 12/19: causal tracing + attribution + bench sentinel (strict) ==="
+echo "=== stage 12/20: causal tracing + attribution + bench sentinel (strict) ==="
 # leg A: the trace/attribution suite on the default (trace-off) env —
 # span-tree connectivity, adversity-matrix propagation, ledger
 # conservation, doctor goldens
@@ -308,7 +324,7 @@ TPQ_TRACE=1 timeout -k 10 900 python -m pytest \
 timeout -k 10 600 python tools/bench_sentinel.py --check \
   || fail "bench sentinel"
 
-echo "=== stage 13/19: soak smoke: faults -> alerts, exact sums, byte identity (strict) ==="
+echo "=== stage 13/20: soak smoke: faults -> alerts, exact sums, byte identity (strict) ==="
 # N=4 concurrent labeled scans with the deterministic fault plan
 # (CorruptPage on one tenant's unique column, hang + unit deadline on
 # another tenant's file).  Asserts the whole longitudinal contract:
@@ -317,7 +333,7 @@ echo "=== stage 13/19: soak smoke: faults -> alerts, exact sums, byte identity (
 timeout -k 10 600 python -m tools.soak --scans 4 \
   || fail "soak smoke"
 
-echo "=== stage 14/19: remote emulator: parity over an unreliable store (strict) ==="
+echo "=== stage 14/20: remote emulator: parity over an unreliable store (strict) ==="
 # leg A: the dedicated remote suite — URI routing, coalescer property
 # sweep, tiered-cache conservation + poisoning + torn-file restart,
 # emu parity with the cache on AND off, hedged slow replicas
@@ -342,7 +358,7 @@ TPQ_SOURCE=emu TPQ_CACHE_DISK_MB=0 TPQ_CACHE_MEM_MB=0 \
   tests/test_checkpoint.py -q -p no:cacheprovider \
   || fail "remote emulator (cache-off leg)"
 
-echo "=== stage 15/19: schedule chaos + runtime lock-order validation (strict) ==="
+echo "=== stage 15/20: schedule chaos + runtime lock-order validation (strict) ==="
 # leg A: one chaos seed over the plan-parallel and soak-parity suites
 # — the seeded schedule perturbation must reproduce the unperturbed
 # baseline exactly (tests/test_chaos.py runs the full 3-seed sweep in
@@ -355,7 +371,7 @@ timeout -k 10 600 python -m tools.chaos --seeds 101 \
 TPQ_LOCKCHECK=1 timeout -k 10 600 python -m tools.soak --scans 4 \
   --chaos-seed 101 || fail "lockcheck soak leg"
 
-echo "=== stage 16/19: sampling profiler: armed parity + flame/doctor smoke (strict) ==="
+echo "=== stage 16/20: sampling profiler: armed parity + flame/doctor smoke (strict) ==="
 # leg A: profiler-ENABLED scan paths — the real sampler thread walks
 # sys._current_frames() through the whole scan suite and must not
 # change a byte of output (the byte-parity pins inside these suites
@@ -449,7 +465,7 @@ echo "$_CI_DOC" | grep -q "WARNING" \
   && fail "doctor --profile (consistency warning)"
 rm -rf "$_CI_PROF"
 
-echo "=== stage 17/19: scan server: arbiter + admission + drain (strict) ==="
+echo "=== stage 17/20: scan server: arbiter + admission + drain (strict) ==="
 # leg A: the serve suite — arbiter apportionment (anti-starvation
 # floors, bounded boosts), admission load-shedding, the in-process
 # server path, and the SIGTERM/SIGKILL drain-resume sweep
@@ -474,7 +490,7 @@ TPQ_PLAN_THREADS=2 TPQ_WRITE_THREADS=2 timeout -k 10 600 \
   python -m pytest tests/test_shard.py tests/test_plan_parallel.py \
   -q -p no:cacheprovider || fail "legacy-knob leg"
 
-echo "=== stage 18/19: partitioned datasets: atomic commits + kill sweep (strict) ==="
+echo "=== stage 18/20: partitioned datasets: atomic commits + kill sweep (strict) ==="
 # leg A: the dataset suite with the slow marker INCLUDED — the
 # kill-at-every-step sweep, the first-commit snapshot-or-nothing pin,
 # pruning/quarantine/compaction/interop, and the chaos kill/resume
@@ -493,7 +509,7 @@ for _ci_seed in 101 202 303; do
     || fail "dataset soak leg (seed $_ci_seed)"
 done
 
-echo "=== stage 19/19: http(s) backend: fault server + shared cache (strict) ==="
+echo "=== stage 19/20: http(s) backend: fault server + shared cache (strict) ==="
 # leg A: the dedicated suites — the HTTP range source against the
 # deterministic fault server (status taxonomy, retry ladder, ETag
 # flips, bounded pool) and the cross-process shared disk cache (two
@@ -537,5 +553,142 @@ for _ci_seed in 101 202 303; do
     --http --scans 4 --chaos-seed "$_ci_seed" \
     || fail "http soak leg (seed $_ci_seed)"
 done
+
+echo "=== stage 20/20: codec parity: native matrix + fallbacks + file equivalence (strict) ==="
+# leg A: the block-codec suite on the default knobs — cross-impl
+# oracles (pyarrow), the LZ4 pure==C byte-parity pin, malformed-frame
+# fuzz, block-split determinism, multi-member/multi-frame decode
+timeout -k 10 600 python -m pytest tests/test_compress.py \
+  -q -p no:cacheprovider || fail "codec suite"
+# leg B: the same suite under the page-pipeline native gate off AND
+# under the codec native gate off — both pure paths must keep every
+# semantics and parity pin (the cross-impl oracles catch a pure-side
+# format drift the native path would have masked)
+TPQ_WRITE_NATIVE=0 timeout -k 10 600 python -m pytest \
+  tests/test_compress.py -q -p no:cacheprovider \
+  || fail "codec suite (TPQ_WRITE_NATIVE=0 leg)"
+TPQ_NATIVE_CODECS=0 timeout -k 10 600 python -m pytest \
+  tests/test_compress.py -q -p no:cacheprovider \
+  || fail "codec suite (TPQ_NATIVE_CODECS=0 leg)"
+# leg C: whole-file equivalence sweep — for every registered codec:
+# native-on vs native-off writes byte-identical where deterministic
+# (uncompressed always; lz4_raw via the pure==C mirror pin; gzip when
+# the runtime probe shows bound-zlib == stdlib-zlib bytes) and
+# decoded-identical elsewhere; then 1-thread vs N-thread block-split
+# writes decoded-identical under chaos seeds with the lock-order
+# recorder armed
+TPQ_LOCKCHECK=strict timeout -k 10 600 python - <<'PYEOF' \
+  || fail "codec file-equivalence sweep"
+import io
+import os
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+from tpuparquet import CompressionCodec, FileReader, FileWriter
+from tpuparquet.compress import registered_codecs
+from tpuparquet.faults import chaos_scope
+
+SCHEMA = ("message m { required int64 a; required double x; "
+          "optional binary s (STRING); }")
+N = 200_000
+rng = np.random.default_rng(24)
+A = rng.integers(0, 1 << 40, N)
+X = (A % 9973) * 0.25
+MASK = rng.random(N) >= 0.1
+VOCAB = [f"city-{i:03d}".encode() for i in range(180)]
+S = [VOCAB[i] for i in rng.integers(0, len(VOCAB), int(MASK.sum()))]
+
+
+def write_file():
+    from tpuparquet.cpu.plain import ByteArrayColumn
+
+    buf = io.BytesIO()
+    w = FileWriter(buf, SCHEMA, codec=CODEC)
+    w.write_columns(
+        {"a": A, "x": X, "s": ByteArrayColumn.from_list(S)},
+        masks={"s": MASK})
+    w.close()
+    return buf.getvalue()
+
+
+def decoded(blob):
+    out = []
+    with FileReader(io.BytesIO(blob)) as r:
+        for rg in range(r.row_group_count()):
+            for path, cd in sorted(r.read_row_group_arrays(rg).items()):
+                v = cd.values
+                out.append(v if isinstance(v, (bytes, list)) else
+                           np.asarray(v).tobytes())
+                out.append(np.asarray(cd.def_levels).tobytes()
+                           if cd.def_levels is not None else b"")
+    return out
+
+
+def gzip_deterministic():
+    """True when the bound zlib emits the same bytes as the stdlib
+    module (same vendored zlib: the common case, but not guaranteed
+    across e.g. zlib-ng boxes)."""
+    import zlib
+
+    from tpuparquet.native.syslibs import zlib_native
+
+    nat = zlib_native()
+    if nat is None:
+        return False
+    probe = bytes(range(256)) * 64
+    co = zlib.compressobj(wbits=31)
+    return nat.compress(probe) == co.compress(probe) + co.flush()
+
+
+for CODEC in sorted(registered_codecs()):
+    if CODEC == CompressionCodec.LZO:
+        continue  # test-registered plugins have no writer contract
+    name = CompressionCodec(CODEC).name
+    base = write_file()
+    base_dec = decoded(base)
+
+    # native-off leg (zstd without the wheel has no fallback: skip)
+    os.environ["TPQ_NATIVE_CODECS"] = "0"
+    try:
+        pure = write_file()
+    except Exception:
+        pure = None
+    finally:
+        del os.environ["TPQ_NATIVE_CODECS"]
+    if pure is not None:
+        byte_pinned = (
+            CODEC == CompressionCodec.UNCOMPRESSED
+            or CODEC == CompressionCodec.LZ4_RAW
+            or (CODEC == CompressionCodec.GZIP and gzip_deterministic()))
+        if byte_pinned:
+            assert pure == base, f"{name}: native-off bytes diverged"
+        assert decoded(pure) == base_dec, f"{name}: native-off decode"
+
+    # 1-thread vs N-thread block-split writes under chaos seeds: the
+    # split must stay deterministic in block size, and every width
+    # must decode identically to the serial file
+    os.environ["TPQ_COMPRESS_BLOCK_KB"] = "64"
+    os.environ["TPQ_WRITE_THREADS"] = "1"
+    try:
+        one = write_file()
+        assert decoded(one) == base_dec, f"{name}: 1-thread decode"
+        wide = {}
+        for seed in (101, 202, 303):
+            os.environ["TPQ_WRITE_THREADS"] = "4"
+            with chaos_scope(seed):
+                blob = write_file()
+            wide[seed] = blob
+            assert decoded(blob) == base_dec, \
+                f"{name}: 4-thread decode (seed {seed})"
+        assert len({wide[s] for s in wide}) == 1, \
+            f"{name}: multi-thread bytes vary across chaos seeds"
+    finally:
+        del os.environ["TPQ_COMPRESS_BLOCK_KB"]
+        del os.environ["TPQ_WRITE_THREADS"]
+    print(f"codec parity OK: {name}")
+PYEOF
 
 echo "ci.sh: gate PASSED"

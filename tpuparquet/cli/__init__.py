@@ -7,5 +7,6 @@ CODECS = {
     "snappy": CompressionCodec.SNAPPY,
     "gzip": CompressionCodec.GZIP,
     "zstd": CompressionCodec.ZSTD,
+    "lz4_raw": CompressionCodec.LZ4_RAW,
     "none": CompressionCodec.UNCOMPRESSED,
 }

@@ -1,4 +1,5 @@
-"""Block compression registry + codecs (UNCOMPRESSED, SNAPPY, GZIP, ZSTD).
+"""Block compression registry + codecs (UNCOMPRESSED, SNAPPY, GZIP,
+ZSTD, LZ4_RAW).
 
 API parity with the reference's ``compress.go``: a process-wide registry of
 :class:`BlockCompressor` objects keyed by ``CompressionCodec``, with
@@ -7,16 +8,28 @@ API parity with the reference's ``compress.go``: a process-wide registry of
 (``compress.go:152-156``).  ``decompress_block`` validates the decoded size
 like ``newBlockReader`` (``compress.go:102-122``).
 
-Snappy is implemented from scratch (the Python image has no snappy
-library): the format is a varint uncompressed-length header followed by
-literal/copy tokens.  The decoder parses the token stream into (literal,
-copy) operations and resolves copies — the same two-pass structure the
-TPU-side decompressor uses (token parse on host, copy resolution on
-device), per SURVEY.md §7 stage 5d.
+Snappy and LZ4_RAW are implemented from scratch (the Python image has
+neither library): both pair a C fast path (``native/snappy.c``,
+``native/lz4raw.c``) with a pure-Python mirror of the same algorithm.
+GZIP and ZSTD bind the system libraries via ctypes
+(``native/syslibs.py``) with the stdlib ``zlib`` module and the
+optional ``zstandard`` wheel as fallbacks; ``TPQ_NATIVE_CODECS=0``
+forces every codec onto its fallback for parity legs.
+
+Write-side page compression exposes a zero-copy ``compress_into``
+context per codec (:func:`page_codec_settings`) plus block-splitting
+for the concatenation-safe frame formats (GZIP multi-member, ZSTD
+multi-frame): :func:`page_compress_into` splits bodies >= 2×
+``TPQ_COMPRESS_BLOCK_KB`` into independently compressed frames when
+the caller holds more than one worker — same decoded bytes, parallel
+wall-clock.  The read side reverses it in :func:`decompress_block_into`
+(ZSTD frames decode concurrently; gzip members stream through one
+inflate loop).
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import zlib
 
@@ -36,8 +49,21 @@ __all__ = [
     "snappy_decompress",
     "snappy_parse_tokens",
     "snappy_single_literal_view",
+    "lz4_compress",
+    "lz4_decompress",
+    "page_codec_settings",
+    "page_compress_bound",
+    "page_compress_into",
     "CompressionError",
 ]
+
+
+def native_codecs_enabled() -> bool:
+    """``TPQ_NATIVE_CODECS=0`` pins every codec to its pure-Python /
+    stdlib / wheel fallback (and disables the native page-compression
+    contexts) — the ci.sh parity leg.  Read per call: tests flip it
+    mid-process."""
+    return os.environ.get("TPQ_NATIVE_CODECS", "1") != "0"
 
 
 class CompressionError(ValueError):
@@ -132,14 +158,87 @@ def snappy_single_literal_view(block) -> "np.ndarray | None":
     return buf[pos : pos + ln]
 
 
+def _zstd_decompress_frames(nat, block, decompressed_size, out,
+                            workers: int):
+    """Decode a multi-frame zstd stream with one worker per frame when
+    the caller holds spare budget — the read-side mirror of the write
+    path's block split.  Returns the produced length, or None when the
+    stream is single-frame / unsplittable (caller one-shots it)."""
+    if workers <= 1:
+        return None
+    try:
+        spans = nat.frame_spans(block)
+    except ValueError as e:
+        raise CompressionError(str(e)) from None
+    if spans is None or len(spans) < 2:
+        return None
+    total = sum(s[2] for s in spans)
+    if total != decompressed_size:
+        raise CompressionError(
+            f"decompressed size {total} != expected {decompressed_size}")
+    src = block if isinstance(block, np.ndarray) else np.frombuffer(
+        block, dtype=np.uint8)
+    dst_offs = []
+    pos = 0
+    for _, _, ulen in spans:
+        dst_offs.append(pos)
+        pos += ulen
+
+    def one(i):
+        off, clen, ulen = spans[i]
+        return nat.decompress_into(
+            src[off:off + clen], out[dst_offs[i]:dst_offs[i] + ulen], ulen)
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=min(workers, len(spans))) as ex:
+        try:
+            list(ex.map(one, range(len(spans))))
+        except ValueError as e:
+            raise CompressionError(str(e)) from None
+    from .stats import current_stats
+
+    st = current_stats()
+    if st is not None:
+        st.codec_split_frames += len(spans)
+    return total
+
+
+_affinity_workers: int | None = None
+
+
+def _shared_decode_budget() -> int:
+    """Worker budget for frame-parallel decode: the arbiter's plan
+    budget when a scan server is arbitrating, else the process CPU
+    affinity — the same shared-budget rule the write side follows."""
+    global _affinity_workers
+    try:
+        from .serve.arbiter import plan_budget
+
+        b = plan_budget()
+        if b:
+            return max(1, int(b))
+    except Exception:
+        pass
+    if _affinity_workers is None:
+        try:
+            _affinity_workers = len(os.sched_getaffinity(0))
+        except (AttributeError, OSError):
+            _affinity_workers = os.cpu_count() or 1
+    return _affinity_workers
+
+
 def decompress_block_into(codec: CompressionCodec, block,
-                          decompressed_size: int, arena):
+                          decompressed_size: int, arena,
+                          workers: int | None = None):
     """Device-path decompress: zero input copy and a recycled output
-    slab when the native snappy codec is available; otherwise falls back
-    to :func:`decompress_block`.  Returns a u8 numpy view either way —
+    slab when a native codec is available; otherwise falls back to
+    :func:`decompress_block`.  Returns a u8 numpy view either way —
     arena-backed outputs are only valid until ``arena.release_all()``
     (single-literal snappy blocks come back as views of ``block``
-    itself, valid as long as the caller's buffer)."""
+    itself, valid as long as the caller's buffer).  ``workers > 1``
+    lets multi-frame ZSTD bodies (the write-side block split) decode
+    frame-parallel; None resolves the shared arbiter/affinity budget."""
     import numpy as np
 
     if decompressed_size is None or decompressed_size < 0:
@@ -178,6 +277,53 @@ def decompress_block_into(codec: CompressionCodec, block,
                     f"{decompressed_size}"
                 )
             return got
+    elif codec == CompressionCodec.LZ4_RAW and native_codecs_enabled():
+        from .native import lz4_native
+
+        nat = lz4_native()
+        if nat is not None:
+            out = arena.borrow(decompressed_size + 16)
+            try:
+                return nat.decompress_np(block, decompressed_size, out=out)
+            except ValueError as e:
+                raise CompressionError(str(e)) from None
+    elif codec == CompressionCodec.GZIP and native_codecs_enabled():
+        from .native.syslibs import zlib_native
+
+        nat = zlib_native()
+        if nat is not None:
+            out = arena.borrow(decompressed_size + 16)
+            try:
+                got = nat.decompress_into(block, out, decompressed_size)
+            except ValueError as e:
+                raise CompressionError(str(e)) from None
+            if got != decompressed_size:
+                raise CompressionError(
+                    f"decompressed size {got} != expected "
+                    f"{decompressed_size}"
+                )
+            return out[:got]
+    elif codec == CompressionCodec.ZSTD and native_codecs_enabled():
+        from .native.syslibs import zstd_native
+
+        nat = zstd_native()
+        if nat is not None:
+            out = arena.borrow(decompressed_size + 16)
+            got = _zstd_decompress_frames(
+                nat, block, decompressed_size, out,
+                workers if workers is not None
+                else _shared_decode_budget())
+            if got is None:
+                try:
+                    got = nat.decompress_into(block, out, decompressed_size)
+                except ValueError as e:
+                    raise CompressionError(str(e)) from None
+            if got != decompressed_size:
+                raise CompressionError(
+                    f"decompressed size {got} != expected "
+                    f"{decompressed_size}"
+                )
+            return out[:got]
     return np.frombuffer(
         decompress_block(codec, block, decompressed_size), dtype=np.uint8
     )
@@ -196,42 +342,124 @@ class _Uncompressed(BlockCompressor):
 
 
 class _Gzip(BlockCompressor):
+    """GZIP through the ctypes libz binding when loadable, else the
+    stdlib ``zlib`` module.  Both call the same system libz with the
+    same parameters (default level, memLevel 8, wbits 31), so the two
+    paths produce the SAME bytes on a normal install — the gzip
+    byte-parity anchor in ci.sh.  Decompression accepts multi-member
+    streams either way (the write-side block split concatenates
+    members per RFC 1952)."""
+
     def compress_block(self, block):
+        if native_codecs_enabled():
+            from .native.syslibs import zlib_native
+
+            nat = zlib_native()
+            if nat is not None:
+                return nat.compress(block)
         co = zlib.compressobj(wbits=16 + zlib.MAX_WBITS)  # gzip framing
         return co.compress(block) + co.flush()
 
     def decompress_block(self, block, decompressed_size):
+        if native_codecs_enabled():
+            from .native.syslibs import zlib_native
+
+            nat = zlib_native()
+            if nat is not None:
+                try:
+                    return nat.decompress(block, decompressed_size)
+                except ValueError as e:
+                    raise CompressionError(str(e)) from None
+        # stdlib fallback: loop decompressobj over trailing members
+        out = []
+        buf = bytes(block)
         try:
-            return zlib.decompress(block, wbits=16 + zlib.MAX_WBITS)
+            while buf:
+                d = zlib.decompressobj(wbits=16 + zlib.MAX_WBITS)
+                out.append(d.decompress(buf))
+                if not d.eof:
+                    raise CompressionError("gzip: truncated member")
+                buf = d.unused_data
         except zlib.error as e:
             raise CompressionError(f"gzip: {e}") from e
+        return b"".join(out)
+
+
+def _zstd_level() -> int:
+    try:
+        return int(os.environ.get("TPQ_ZSTD_LEVEL", "1"))
+    except ValueError:
+        return 1
 
 
 class _Zstd(BlockCompressor):
-    def __init__(self):
-        import zstandard
+    """ZSTD through the ctypes libzstd binding when loadable, else the
+    optional ``zstandard`` wheel.  Registered only when at least one
+    backend exists; with ``TPQ_NATIVE_CODECS=0`` and no wheel, calls
+    raise (the parity leg must then skip zstd, loudly).
+    ``TPQ_ZSTD_LEVEL`` sets the compression level for both backends
+    (default 1, Arrow's write-side default — the write bench is
+    anchored against pyarrow, so the default must race the same
+    speed/ratio point; raise it when file size matters more)."""
 
+    def __init__(self):
+        try:
+            import zstandard
+        except ImportError:
+            zstandard = None
         self._zstd = zstandard
         # ZstdCompressor/ZstdDecompressor contexts are documented as not
         # shareable across concurrent calls; keep them thread-local.
         self._local = threading.local()
 
-    def _ctx(self):
-        if not hasattr(self._local, "c"):
-            self._local.c = self._zstd.ZstdCompressor()
+    def _nat(self):
+        if not native_codecs_enabled():
+            return None
+        from .native.syslibs import zstd_native
+
+        return zstd_native()
+
+    def _ctx(self, level):
+        if self._zstd is None:
+            raise CompressionError(
+                "zstd: native codecs disabled and the zstandard wheel "
+                "is not installed")
+        if getattr(self._local, "level", None) != level:
+            self._local.c = self._zstd.ZstdCompressor(level=level)
             self._local.d = self._zstd.ZstdDecompressor()
+            self._local.level = level
         return self._local
 
     def compress_block(self, block):
-        return self._ctx().c.compress(block)
+        level = _zstd_level()
+        nat = self._nat()
+        if nat is not None:
+            return nat.compress(block, level)
+        return self._ctx(level).c.compress(block)
 
     def decompress_block(self, block, decompressed_size):
+        nat = self._nat()
+        if nat is not None:
+            try:
+                return nat.decompress(block, decompressed_size)
+            except ValueError as e:
+                raise CompressionError(str(e)) from None
+        ctx = self._ctx(_zstd_level())
         try:
-            return self._ctx().d.decompress(
-                block, max_output_size=decompressed_size
-            )
+            return ctx.d.decompress(block, max_output_size=decompressed_size)
+        except CompressionError:
+            raise
         except Exception as e:
-            raise CompressionError(f"zstd: {e}") from e
+            # the wheel's one-shot API stops at the first frame; a
+            # block-split body is concatenated frames — stream across
+            import io
+
+            try:
+                with ctx.d.stream_reader(io.BytesIO(bytes(block)),
+                                         read_across_frames=True) as r:
+                    return r.read(decompressed_size + 1)
+            except Exception:
+                raise CompressionError(f"zstd: {e}") from e
 
 
 # --------------------------------------------------------------------------
@@ -436,6 +664,8 @@ class _Snappy(BlockCompressor):
         self.min_match = min_match
 
     def _nat(self):
+        if not native_codecs_enabled():
+            return None
         if self._native is False:
             from .native import snappy_native
 
@@ -461,6 +691,199 @@ class _Snappy(BlockCompressor):
             except ValueError as e:
                 raise CompressionError(str(e)) from None
         return snappy_decompress(block, decompressed_size)
+
+
+# --------------------------------------------------------------------------
+# LZ4 raw block format (Parquet's LZ4_RAW, from scratch)
+# --------------------------------------------------------------------------
+
+def _lz4_emit_literals(out: bytearray, data, lo: int, lit: int,
+                       mcode: int) -> None:
+    if lit >= 15:
+        out.append((15 << 4) | mcode)
+        rem = lit - 15
+        while rem >= 255:
+            out.append(255)
+            rem -= 255
+        out.append(rem)
+    else:
+        out.append((lit << 4) | mcode)
+    out += data[lo:lo + lit]
+
+
+def lz4_compress(data) -> bytes:
+    """Greedy hash-match LZ4 block encoder — a step-for-step Python
+    mirror of ``native/lz4raw.c`` (same 64 KiB blocks, same
+    zero-initialized table semantics, same skip acceleration, same end
+    rules), so pure and native output are byte-identical and the ci.sh
+    parity leg can pin file equality for LZ4_RAW."""
+    data = bytes(data)
+    n = len(data)
+    if n == 0:
+        return b"\x00"  # canonical empty block: one zero token
+    out = bytearray()
+    lit_start = 0  # absolute: pending literals span blocks
+    for base in range(0, n, 65536):
+        blen = min(n - base, 65536)
+        # matches may neither start past blen-4 nor within the input's
+        # last 12 bytes (format end rule)
+        if n < 13 or base + 12 > n:
+            continue
+        if blen < 4:
+            continue  # tail rides the final literal flush
+        limit = min(blen - 4, n - 12 - base)
+        table = [0] * 16384  # zero-init: position-0 candidates resolve
+        # through the 4-byte compare, exactly like the C uint16 table
+        pos = 0
+        skip = 32
+        while pos <= limit:
+            key = data[base + pos:base + pos + 4]
+            h = ((int.from_bytes(key, "little") * 2654435761)
+                 & 0xFFFFFFFF) >> 18
+            cand = table[h]
+            table[h] = pos
+            if cand < pos and data[base + cand:base + cand + 4] == key:
+                length = 4
+                # extend to block end; matches stop 5 bytes before the
+                # end of the whole input
+                maxlen = min(blen - pos, (n - 5) - (base + pos))
+                while (length < maxlen
+                       and data[base + cand + length]
+                       == data[base + pos + length]):
+                    length += 1
+                if length < 4:  # end-rule clamp ate the match
+                    step = skip >> 5
+                    pos += step
+                    skip += step
+                    continue
+                lit = base + pos - lit_start
+                mext = length - 4
+                off = pos - cand
+                _lz4_emit_literals(out, data, lit_start, lit,
+                                   15 if mext >= 15 else mext)
+                out.append(off & 0xFF)
+                out.append(off >> 8)
+                if mext >= 15:
+                    rem = mext - 15
+                    while rem >= 255:
+                        out.append(255)
+                        rem -= 255
+                    out.append(rem)
+                end = pos + length
+                if end <= limit and end >= 1:
+                    seed = end - 1
+                    table[((int.from_bytes(
+                        data[base + seed:base + seed + 4], "little")
+                        * 2654435761) & 0xFFFFFFFF) >> 18] = seed
+                pos = end
+                lit_start = base + pos
+                skip = 32
+            else:
+                step = skip >> 5
+                pos += step
+                skip += step
+    out2 = bytearray()
+    _lz4_emit_literals(out2, data, lit_start, n - lit_start, 0)
+    return bytes(out + out2)
+
+
+def lz4_decompress(block, expected_size: int) -> bytes:
+    """Safe pure-Python LZ4 block decoder (token loop mirroring
+    ``tpq_lz4_decompress``); raises :class:`CompressionError` on any
+    malformed stream."""
+    src = bytes(block)
+    n = len(src)
+    if n == 0:
+        if expected_size:
+            raise CompressionError("lz4: empty stream, nonzero expected")
+        return b""
+    out = bytearray()
+    ip = 0
+    while True:
+        if ip >= n:
+            raise CompressionError("lz4: stream ends between sequences")
+        token = src[ip]
+        ip += 1
+        lit = token >> 4
+        if lit == 15:
+            while True:
+                if ip >= n:
+                    raise CompressionError("lz4: truncated literal length")
+                b = src[ip]
+                ip += 1
+                lit += b
+                if lit > expected_size:
+                    raise CompressionError("lz4: literal length overflow")
+                if b != 255:
+                    break
+        if ip + lit > n:
+            raise CompressionError("lz4: literal overruns input")
+        out += src[ip:ip + lit]
+        ip += lit
+        if ip == n:
+            break  # final sequence: literals only
+        if ip + 2 > n:
+            raise CompressionError("lz4: truncated match offset")
+        off = src[ip] | (src[ip + 1] << 8)
+        ip += 2
+        if off == 0 or off > len(out):
+            raise CompressionError(
+                f"lz4: match offset {off} out of range at {len(out)}")
+        mlen = token & 0xF
+        if mlen == 15:
+            while True:
+                if ip >= n:
+                    raise CompressionError("lz4: truncated match length")
+                b = src[ip]
+                ip += 1
+                mlen += b
+                if mlen > expected_size:
+                    raise CompressionError("lz4: match length overflow")
+                if b != 255:
+                    break
+        mlen += 4
+        if len(out) + mlen > expected_size:
+            raise CompressionError("lz4: output overruns expected size")
+        start = len(out) - off
+        if off >= mlen:
+            out += out[start:start + mlen]
+        else:  # overlapping copy: periodic extension of the window
+            for i in range(mlen):
+                out.append(out[start + i])
+    if len(out) != expected_size:
+        raise CompressionError(
+            f"lz4: decoded {len(out)} bytes, expected {expected_size}")
+    return bytes(out)
+
+
+class _Lz4Raw(BlockCompressor):
+    """LZ4_RAW with the native C fast path (``native/lz4raw.c``) and the
+    byte-identical pure-Python mirror as fallback — files are
+    bit-interchangeable whichever side produced them."""
+
+    def _nat(self):
+        if not native_codecs_enabled():
+            return None
+        from .native import lz4_native
+
+        return lz4_native()
+
+    def compress_block(self, block):
+        nat = self._nat()
+        if nat is not None:
+            return nat.compress(bytes(block))
+        return lz4_compress(block)
+
+    def decompress_block(self, block, decompressed_size):
+        nat = self._nat()
+        if nat is not None:
+            try:
+                return memoryview(
+                    nat.decompress_np(bytes(block), decompressed_size)
+                )
+            except ValueError as e:
+                raise CompressionError(str(e)) from None
+        return lz4_decompress(block, decompressed_size)
 
 
 def builtin_uncompressed_registered() -> bool:
@@ -492,10 +915,175 @@ def snappy_native_settings():
     return None
 
 
+# --------------------------------------------------------------------------
+# Write-side page compression contexts + block-parallel split
+# --------------------------------------------------------------------------
+
+class PageCodecCtx:
+    """Zero-copy page-compression handle for the native page pipeline
+    (``io/pages.py``): a worst-case :meth:`bound` and a
+    :meth:`compress_into` writing straight into an arena slab.  Only
+    handed out (:func:`page_codec_settings`) when the REGISTERED block
+    compressor is the builtin backed by the same native codec, so the
+    native page path produces exactly the bytes ``compress_block``
+    would.  ``splittable`` marks the concatenation-safe frame formats
+    (GZIP multi-member, ZSTD multi-frame) eligible for the
+    block-parallel split."""
+
+    __slots__ = ("codec", "splittable", "_bound", "_into")
+
+    def __init__(self, codec, bound, into, splittable=False):
+        self.codec = codec
+        self.splittable = splittable
+        self._bound = bound
+        self._into = into
+
+    def bound(self, n: int) -> int:
+        return self._bound(n)
+
+    def compress_into(self, src, out) -> int:
+        return self._into(src, out)
+
+
+def page_codec_settings(codec: CompressionCodec) -> PageCodecCtx | None:
+    """The write-side native compression context for ``codec``, or None
+    when the native page pipeline must not compress this codec itself
+    (user-registered compressor, native codec unavailable, or
+    ``TPQ_NATIVE_CODECS=0``) — callers then take the pure page path."""
+    if not native_codecs_enabled():
+        return None
+    with _registry_lock:
+        c = _registry.get(int(codec))
+    if codec == CompressionCodec.SNAPPY:
+        if type(c) is not _Snappy:
+            return None
+        nat = c._nat()
+        if nat is None:
+            return None
+        mm = c.min_match
+        return PageCodecCtx(
+            codec, lambda n: 32 + n + n // 6,
+            lambda src, out: nat.compress_into(src, out, mm))
+    if codec == CompressionCodec.LZ4_RAW:
+        if type(c) is not _Lz4Raw:
+            return None
+        from .native import lz4_native
+
+        nat = lz4_native()
+        if nat is None:
+            return None
+        return PageCodecCtx(codec, nat.max_compressed_length,
+                            nat.compress_into)
+    if codec == CompressionCodec.GZIP:
+        if type(c) is not _Gzip:
+            return None
+        from .native.syslibs import zlib_native
+
+        nat = zlib_native()
+        if nat is None:
+            return None
+        return PageCodecCtx(codec, nat.compress_bound, nat.compress_into,
+                            splittable=True)
+    if codec == CompressionCodec.ZSTD:
+        if type(c) is not _Zstd:
+            return None
+        from .native.syslibs import zstd_native
+
+        nat = zstd_native()
+        if nat is None:
+            return None
+        level = _zstd_level()
+        return PageCodecCtx(
+            codec, nat.compress_bound,
+            lambda src, out: nat.compress_into(src, out, level),
+            splittable=True)
+    return None
+
+
+def _split_block_bytes() -> int:
+    """Sub-block size for block-parallel compression
+    (``TPQ_COMPRESS_BLOCK_KB``, default 1 MiB; floored at 64 KiB —
+    smaller frames are all header overhead)."""
+    try:
+        kb = int(os.environ.get("TPQ_COMPRESS_BLOCK_KB", "1024"))
+    except ValueError:
+        kb = 1024
+    return max(64, kb) * 1024
+
+
+def page_compress_bound(ctx: PageCodecCtx, n: int,
+                        workers: int = 1) -> int:
+    """Output capacity needed by :func:`page_compress_into` — the
+    per-frame worst cases when the split engages, the plain codec bound
+    otherwise."""
+    block = _split_block_bytes()
+    if not (ctx.splittable and workers > 1 and n >= 2 * block):
+        return ctx.bound(n)
+    nb = -(-n // block)
+    return (nb - 1) * ctx.bound(block) + ctx.bound(n - (nb - 1) * block)
+
+
+def page_compress_into(ctx: PageCodecCtx, src, out,
+                       workers: int = 1) -> int:
+    """Compress ``src`` into ``out`` (sized by
+    :func:`page_compress_bound`), splitting into independently
+    compressed frames when the codec is concatenation-safe, the caller
+    holds more than one worker, and the body spans at least two split
+    blocks.  Frame boundaries depend only on ``TPQ_COMPRESS_BLOCK_KB``
+    — every multi-worker width emits the same bytes; one worker emits
+    the single frame the serial path always wrote.  Returns the
+    produced length."""
+    n = src.size
+    block = _split_block_bytes()
+    if not (ctx.splittable and workers > 1 and n >= 2 * block):
+        return ctx.compress_into(src, out)
+    nb = -(-n // block)
+    offs = [0]
+    for i in range(nb):
+        offs.append(offs[-1] + ctx.bound(min(block, n - i * block)))
+
+    def one(i):
+        a = i * block
+        b = min(n, a + block)
+        return ctx.compress_into(src[a:b], out[offs[i]:offs[i + 1]])
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    # scoped executor: split compression is rare enough (large pages
+    # only) that pool spin-up noise loses to lifecycle simplicity
+    with ThreadPoolExecutor(max_workers=min(workers, nb)) as ex:
+        lens = list(ex.map(one, range(nb)))
+    pos = lens[0]
+    for i in range(1, nb):  # compact frames down to one stream
+        li = lens[i]
+        if offs[i] != pos:
+            out[pos:pos + li] = out[offs[i]:offs[i] + li].copy()
+        pos += li
+    from .stats import current_stats
+
+    st = current_stats()
+    if st is not None:
+        st.codec_split_blocks += nb
+    return pos
+
+
 register_block_compressor(CompressionCodec.UNCOMPRESSED, _Uncompressed())
 register_block_compressor(CompressionCodec.GZIP, _Gzip())
 register_block_compressor(CompressionCodec.SNAPPY, _Snappy())
-try:
+register_block_compressor(CompressionCodec.LZ4_RAW, _Lz4Raw())
+
+
+def _zstd_backend_available() -> bool:
+    from .native.syslibs import zstd_native
+
+    if zstd_native() is not None:
+        return True
+    try:
+        import zstandard  # noqa: F401
+    except ImportError:
+        return False
+    return True
+
+
+if _zstd_backend_available():
     register_block_compressor(CompressionCodec.ZSTD, _Zstd())
-except ImportError:  # zstandard not in this environment: stay pluggable
-    pass

@@ -102,8 +102,9 @@ class CompressionCodec(enum.IntEnum):
     GZIP = 2
     LZO = 3
     BROTLI = 4
-    LZ4 = 5
+    LZ4 = 5  # deprecated Hadoop-framed LZ4 (undocumented framing)
     ZSTD = 6
+    LZ4_RAW = 7  # raw LZ4 block format (what modern writers emit)
 
 
 class PageType(enum.IntEnum):

@@ -565,7 +565,9 @@ def write_chunk(out, node: SchemaNode, column, rep, dl, *,
         nat_ctx = _native_page_ctx(codec)
         if len(bounds) == 1:
             # the single-page fast path: whole arrays, chunk stats in
-            # the page header (byte-identical to the pre-split writer)
+            # the page header (byte-identical to the pre-split writer).
+            # With no page split to pipeline, spare workers go to the
+            # block-parallel codec split inside the one page.
             if page_version == 2:
                 c, u = write_data_page_v2(
                     out, node, page_column, rep, dl, codec, encoding,
@@ -574,12 +576,14 @@ def write_chunk(out, node: SchemaNode, column, rep, dl, *,
                     null_count=null_count, dictionary_size=dict_size,
                     statistics=stats, page_crc=page_crc, arena=arena,
                     native_ctx=nat_ctx,
+                    compress_workers=pipeline_workers,
                 )
             else:
                 c, u = write_data_page_v1(
                     out, node, page_column, rep, dl, codec, encoding,
                     dictionary_size=dict_size, statistics=stats,
                     page_crc=page_crc, arena=arena, native_ctx=nat_ctx,
+                    compress_workers=pipeline_workers,
                 )
             total_comp += c
             total_uncomp += u

@@ -400,13 +400,15 @@ def write_native_default() -> bool:
 
 
 def _native_page_ctx(codec: CompressionCodec):
-    """``(page_native, snappy_native_or_None, min_match)`` when the
-    native page pipeline can produce byte-identical output for this
-    codec, else None (unsupported codec, a user-registered compressor
-    on the codec id, natives unbuildable, or ``TPQ_WRITE_NATIVE=0``).
-    Invariant per chunk — ``write_chunk`` resolves it once and threads
-    it through ``native_ctx=`` so a multi-page column does not pay the
-    env read + registry lock per page."""
+    """``(page_native, page_codec_ctx_or_None)`` when the native page
+    pipeline can produce byte-identical output for this codec, else
+    None (unsupported codec, a user-registered compressor on the codec
+    id, natives unbuildable, or ``TPQ_WRITE_NATIVE=0``).  The codec
+    half is a :class:`~tpuparquet.compress.PageCodecCtx` (None for
+    UNCOMPRESSED — the compressor is skipped outright).  Invariant per
+    chunk — ``write_chunk`` resolves it once and threads it through
+    ``native_ctx=`` so a multi-page column does not pay the env read +
+    registry lock per page."""
     if not write_native_default():
         return None
     from ..native import page_native
@@ -419,15 +421,13 @@ def _native_page_ctx(codec: CompressionCodec):
 
         if not builtin_uncompressed_registered():
             return None
-        return pg, None, 0
-    if codec == CompressionCodec.SNAPPY:
-        from ..compress import snappy_native_settings
+        return pg, None
+    from ..compress import page_codec_settings
 
-        s = snappy_native_settings()
-        if s is None:
-            return None
-        return pg, s[0], s[1]
-    return None
+    pc = page_codec_settings(codec)
+    if pc is None:
+        return None
+    return pg, pc
 
 
 def _hybrid_worst_case(count: int, width: int) -> int:
@@ -461,7 +461,7 @@ def _native_values_view(node, column, encoding):
 def _write_page_native(out, node, column, rep, dl, codec, encoding, ctx,
                        *, v2: bool, num_rows=None, null_count=None,
                        dictionary_size=None, statistics=None,
-                       page_crc=True, arena=None):
+                       page_crc=True, arena=None, workers: int = 1):
     """One data page through the native pipeline: encode the whole body
     into a single arena-backed buffer (levels + dict-index/values, one
     C pass), block-compress it in place, CRC it, then write header +
@@ -470,7 +470,8 @@ def _write_page_native(out, node, column, rep, dl, codec, encoding, ctx,
     must take the pure path (capacity shortfall, injected fault, or a
     value the native encoder refuses) — falling back is always safe
     because nothing has been written yet."""
-    pg, snat, min_match = ctx
+    pg, pcodec = ctx
+    from ..compress import page_compress_bound, page_compress_into
     from ..stats import current_stats
 
     st = current_stats()
@@ -519,17 +520,20 @@ def _write_page_native(out, node, column, rep, dl, codec, encoding, ctx,
         # compress stage: V1 compresses the whole body, V2 only the
         # values segment (levels stay raw on file)
         lev = rep_len + dl_len
-        if snat is None:  # UNCOMPRESSED
+        if pcodec is None:  # UNCOMPRESSED
             segs = [scratch[:uncomp]]
         elif v2:
             vals_seg = scratch[lev:uncomp]
-            outbuf = _comp_buffer(arena, val_len)
-            comp_vals = snat.compress_into(vals_seg, outbuf, min_match)
+            outbuf = _comp_buffer(
+                arena, page_compress_bound(pcodec, val_len, workers))
+            comp_vals = page_compress_into(pcodec, vals_seg, outbuf,
+                                           workers)
             segs = [scratch[:lev], outbuf[:comp_vals]]
         else:
-            outbuf = _comp_buffer(arena, uncomp)
-            comp = snat.compress_into(scratch[:uncomp], outbuf,
-                                      min_match)
+            outbuf = _comp_buffer(
+                arena, page_compress_bound(pcodec, uncomp, workers))
+            comp = page_compress_into(pcodec, scratch[:uncomp], outbuf,
+                                      workers)
             segs = [outbuf[:comp]]
         crc = None
         if page_crc:
@@ -588,9 +592,9 @@ def _write_page_native(out, node, column, rep, dl, codec, encoding, ctx,
     return len(hdr) + comp_total, len(hdr) + uncomp
 
 
-def _comp_buffer(arena, uncomp_len: int) -> np.ndarray:
-    """Compression output buffer sized to the codec's worst case."""
-    cap = 32 + uncomp_len + uncomp_len // 6
+def _comp_buffer(arena, cap: int) -> np.ndarray:
+    """Compression output buffer of the codec-computed worst case
+    (``compress.page_compress_bound``)."""
     return arena.borrow(cap) if arena is not None \
         else np.empty(cap, dtype=np.uint8)
 
@@ -598,12 +602,15 @@ def _comp_buffer(arena, uncomp_len: int) -> np.ndarray:
 def write_data_page_v1(out, node, column, rep, dl, codec, encoding,
                        dictionary_size=None, statistics=None,
                        page_crc=True, arena=None,
-                       native_ctx="auto") -> tuple[int, int]:
+                       native_ctx="auto",
+                       compress_workers: int = 1) -> tuple[int, int]:
     """Append a V1 data page; returns (compressed_size, uncompressed_size)
     including the header bytes (ColumnMetaData counts headers —
     ``chunk_writer.go:209-251``).  ``native_ctx`` is the chunk-resolved
     :func:`_native_page_ctx` (None = pure path); the default resolves
-    it here for direct callers."""
+    it here for direct callers.  ``compress_workers > 1`` lets the
+    native path block-split large bodies for the concatenation-safe
+    codecs (the pure path always writes the single serial frame)."""
     n = len(dl)
     res = None
     ctx = _native_page_ctx(codec) if native_ctx == "auto" else native_ctx
@@ -611,7 +618,7 @@ def write_data_page_v1(out, node, column, rep, dl, codec, encoding,
         res = _write_page_native(
             out, node, column, rep, dl, codec, encoding, ctx, v2=False,
             dictionary_size=dictionary_size, statistics=statistics,
-            page_crc=page_crc, arena=arena)
+            page_crc=page_crc, arena=arena, workers=compress_workers)
     if res is None:
         body = bytearray()
         if node.max_rep_level:
@@ -652,7 +659,8 @@ def write_data_page_v1(out, node, column, rep, dl, codec, encoding,
 def write_data_page_v2(out, node, column, rep, dl, codec, encoding,
                        num_rows, null_count, dictionary_size=None,
                        statistics=None, page_crc=True, arena=None,
-                       native_ctx="auto") -> tuple[int, int]:
+                       native_ctx="auto",
+                       compress_workers: int = 1) -> tuple[int, int]:
     n = len(dl)
     res = None
     ctx = _native_page_ctx(codec) if native_ctx == "auto" else native_ctx
@@ -661,7 +669,7 @@ def write_data_page_v2(out, node, column, rep, dl, codec, encoding,
             out, node, column, rep, dl, codec, encoding, ctx, v2=True,
             num_rows=num_rows, null_count=null_count,
             dictionary_size=dictionary_size, statistics=statistics,
-            page_crc=page_crc, arena=arena)
+            page_crc=page_crc, arena=arena, workers=compress_workers)
     if res is None:
         rep_b = encode_levels_v2(rep, node.max_rep_level) \
             if node.max_rep_level else b""

@@ -20,13 +20,14 @@ import numpy as np
 
 __all__ = ["snappy_native", "NativeSnappy", "hybrid_native", "NativeHybrid",
            "plane_native", "NativePlane", "delta_native", "NativeDelta",
-           "pack_native", "NativePack", "page_native", "NativePage"]
+           "pack_native", "NativePack", "page_native", "NativePage",
+           "lz4_native", "NativeLz4"]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRCS = [os.path.join(_DIR, "snappy.c"), os.path.join(_DIR, "hybrid.c"),
          os.path.join(_DIR, "plane.c"), os.path.join(_DIR, "delta.c"),
          os.path.join(_DIR, "pack.c"), os.path.join(_DIR, "intern.c"),
-         os.path.join(_DIR, "page.c")]
+         os.path.join(_DIR, "page.c"), os.path.join(_DIR, "lz4raw.c")]
 _SO = os.path.join(_DIR, "_tpq_native.so")
 
 _lock = threading.Lock()
@@ -934,6 +935,87 @@ class NativePage:
         return int(rep_len.value), int(dl_len.value), int(val_len.value)
 
 
+class NativeLz4:
+    """ctypes bindings over the C LZ4 raw-block codec (lz4raw.c) —
+    Parquet's LZ4_RAW.  Same buffer discipline as :class:`NativeSnappy`:
+    ``compress_into``/``decompress_np`` take caller (arena) buffers so
+    the write/read hot paths pay no scratch copies."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        comp = getattr(lib, "tpq_lz4_compress", None)
+        dec = getattr(lib, "tpq_lz4_decompress", None)
+        bound = getattr(lib, "tpq_lz4_max_compressed_length", None)
+        if None in (comp, dec, bound):
+            raise RuntimeError("native library too old; rebuild")
+        comp.restype = ctypes.c_int
+        comp.argtypes = [
+            ctypes.c_void_p, ctypes.c_size_t,
+            ctypes.c_void_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        dec.restype = ctypes.c_int
+        dec.argtypes = [
+            ctypes.c_void_p, ctypes.c_size_t,
+            ctypes.c_void_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        bound.restype = ctypes.c_uint64
+        bound.argtypes = [ctypes.c_uint64]
+        self._comp = comp
+        self._dec = dec
+        self._bound = bound
+
+    def max_compressed_length(self, n: int) -> int:
+        return int(self._bound(n))
+
+    def compress_into(self, src, out: np.ndarray) -> int:
+        """Compress ``src`` into the caller's u8 buffer; returns the
+        produced length.  ``out`` must hold max_compressed_length."""
+        buf = _as_u8(src)
+        if out.size < self.max_compressed_length(buf.size):
+            raise ValueError("lz4: output buffer too small")
+        produced = ctypes.c_size_t()
+        rc = self._comp(buf.ctypes.data, buf.size, out.ctypes.data,
+                        out.size, ctypes.byref(produced))
+        if rc != 0:
+            raise ValueError(f"lz4: compress failed (rc={rc})")
+        return int(produced.value)
+
+    def compress(self, data) -> bytes:
+        buf = _as_u8(data)
+        out = np.empty(self.max_compressed_length(buf.size),
+                       dtype=np.uint8)
+        return out[: self.compress_into(buf, out)].tobytes()
+
+    def decompress_np(self, block, expected_size: int,
+                      out: np.ndarray | None = None) -> np.ndarray:
+        """Decompress into a numpy buffer sized by the caller's
+        ``expected_size`` (LZ4 raw blocks carry no length header; the
+        Parquet page header supplies it)."""
+        buf = _as_u8(block)
+        if expected_size < 0:
+            raise ValueError("lz4: missing decompressed size")
+        if out is None:
+            out = np.empty(max(expected_size, 1), dtype=np.uint8)
+        elif out.size < expected_size:
+            raise ValueError("lz4: output buffer too small")
+        produced = ctypes.c_size_t()
+        rc = self._dec(buf.ctypes.data, buf.size, out.ctypes.data,
+                       ctypes.c_size_t(expected_size),
+                       ctypes.byref(produced))
+        if rc != 0:
+            raise ValueError(f"lz4: corrupt block (rc={rc})")
+        if int(produced.value) != expected_size:
+            raise ValueError(
+                f"lz4: stream produced {int(produced.value)} bytes, "
+                f"expected {expected_size}")
+        return out[:expected_size]
+
+    def decompress(self, block, expected_size: int) -> bytes:
+        return self.decompress_np(block, expected_size).tobytes()
+
+
 # sentinel: the interner hit its distinct-value cap (callers compare
 # with ``is``; a string literal here invited silent typo mismatches)
 TOO_MANY_DISTINCT = object()
@@ -1047,6 +1129,8 @@ _INTERN_UNAVAILABLE = object()
 _intern_inst = None
 _PAGE_UNAVAILABLE = object()
 _page_inst = None
+_LZ4_UNAVAILABLE = object()
+_lz4_inst = None
 
 
 def snappy_native() -> NativeSnappy | None:
@@ -1154,6 +1238,28 @@ def page_native() -> NativePage | None:
             st.native_fallbacks += 1
         return None
     return _page_inst
+
+
+def lz4_native() -> NativeLz4 | None:
+    """The process-wide native LZ4 raw-block codec, or None if
+    unbuildable."""
+    global _lz4_inst
+    if _lz4_inst is not None:
+        return None if _lz4_inst is _LZ4_UNAVAILABLE else _lz4_inst
+    lib = _lib()
+    if lib is None:
+        return None
+    try:
+        _lz4_inst = NativeLz4(lib)
+    except RuntimeError:  # stale .so predating lz4raw.c: cache the miss
+        _lz4_inst = _LZ4_UNAVAILABLE
+        from ..stats import current_stats
+
+        st = current_stats()
+        if st is not None:
+            st.native_fallbacks += 1
+        return None
+    return _lz4_inst
 
 
 def plane_native() -> NativePlane | None:

@@ -1,0 +1,353 @@
+"""System-library codec bindings discovered via ctypes ``dlopen``.
+
+GZIP binds the ubiquitous system zlib and ZSTD binds system libzstd —
+neither is linked into ``_tpq_native.so`` (the build stays
+dependency-free); both are resolved at runtime from the usual soname
+candidates, overridable with ``TPQ_ZLIB_LIB``/``TPQ_ZSTD_LIB`` for
+pinned or exotic installs.  Every accessor degrades to None when the
+library is absent; ``compress.py`` then falls back to the ``zlib``
+module (GZIP — same libz, byte-identical output) or the ``zstandard``
+wheel (ZSTD) so the codec matrix stays loadable without either.
+
+All entry points release the GIL across the library call (ctypes
+CDLL semantics), so block-parallel compression gets real concurrency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import os
+import threading
+
+import numpy as np
+
+from . import _as_u8
+
+__all__ = ["NativeZlib", "NativeZstd", "zlib_native", "zstd_native"]
+
+
+def _dlopen(env_var: str, candidates: tuple[str, ...]):
+    """First loadable library among the env override + sonames, or
+    None.  An explicit override that fails to load is an error the
+    user asked for (loudly), not a silent fallback."""
+    override = os.environ.get(env_var)
+    if override:
+        return ctypes.CDLL(override)  # raises OSError: surface it
+    for name in candidates:
+        try:
+            return ctypes.CDLL(name)
+        except OSError:
+            continue
+    found = ctypes.util.find_library(candidates[0].split(".")[0][3:])
+    if found:
+        try:
+            return ctypes.CDLL(found)
+        except OSError:
+            return None
+    return None
+
+
+# ----------------------------------------------------------------------
+# zlib (GZIP framing)
+# ----------------------------------------------------------------------
+
+_Z_OK = 0
+_Z_STREAM_END = 1
+_Z_FINISH = 4
+_Z_DEFLATED = 8
+_Z_DEFAULT_LEVEL = -1  # maps to 6 inside zlib, same as zlib.compressobj
+_GZIP_WBITS = 31  # 15-bit window + gzip header/trailer
+_DEF_MEM_LEVEL = 8  # zlib's DEF_MEM_LEVEL, what zlib.compressobj uses
+
+
+class _ZStream(ctypes.Structure):
+    _fields_ = [
+        ("next_in", ctypes.c_void_p),
+        ("avail_in", ctypes.c_uint),
+        ("total_in", ctypes.c_ulong),
+        ("next_out", ctypes.c_void_p),
+        ("avail_out", ctypes.c_uint),
+        ("total_out", ctypes.c_ulong),
+        ("msg", ctypes.c_char_p),
+        ("state", ctypes.c_void_p),
+        ("zalloc", ctypes.c_void_p),
+        ("zfree", ctypes.c_void_p),
+        ("opaque", ctypes.c_void_p),
+        ("data_type", ctypes.c_int),
+        ("adler", ctypes.c_ulong),
+        ("reserved", ctypes.c_ulong),
+    ]
+
+
+class NativeZlib:
+    """Direct libz binding with gzip framing, caller-buffer I/O.
+
+    ``compress_into`` runs deflate with exactly the parameters
+    ``zlib.compressobj(wbits=31)`` uses (default level, memLevel 8,
+    default strategy), so the native and module paths produce the SAME
+    bytes from the same libz — the write-side parity anchor.
+    ``decompress_into`` inflates multi-member streams (RFC 1952
+    concatenation — what block-parallel compression emits)."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        ver = lib.zlibVersion
+        ver.restype = ctypes.c_char_p
+        ver.argtypes = []
+        self._version = ver()
+        for name in ("deflateInit2_", "inflateInit2_"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int
+        lib.deflateInit2_.argtypes = [
+            ctypes.POINTER(_ZStream), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.inflateInit2_.argtypes = [
+            ctypes.POINTER(_ZStream), ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int,
+        ]
+        for name in ("deflate", "inflate", "deflateEnd", "inflateEnd",
+                     "inflateReset"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int
+        lib.deflate.argtypes = [ctypes.POINTER(_ZStream), ctypes.c_int]
+        lib.inflate.argtypes = [ctypes.POINTER(_ZStream), ctypes.c_int]
+        lib.deflateEnd.argtypes = [ctypes.POINTER(_ZStream)]
+        lib.inflateEnd.argtypes = [ctypes.POINTER(_ZStream)]
+        lib.inflateReset.argtypes = [ctypes.POINTER(_ZStream)]
+
+    def compress_bound(self, n: int) -> int:
+        """Worst-case gzip size for ``n`` input bytes: deflate's stored
+        blocks (5 bytes per 16 KiB window) + gzip header/trailer."""
+        return n + (n >> 12) + (n >> 14) + (n >> 25) + 13 + 18
+
+    def compress_into(self, src, out: np.ndarray,
+                      level: int = _Z_DEFAULT_LEVEL) -> int:
+        buf = _as_u8(src)
+        if out.size < self.compress_bound(buf.size):
+            raise ValueError("gzip: output buffer too small")
+        strm = _ZStream()
+        rc = self._lib.deflateInit2_(
+            ctypes.byref(strm), level, _Z_DEFLATED, _GZIP_WBITS,
+            _DEF_MEM_LEVEL, 0, self._version,
+            ctypes.sizeof(_ZStream))
+        if rc != _Z_OK:
+            raise ValueError(f"gzip: deflateInit failed (rc={rc})")
+        try:
+            strm.next_in = ctypes.c_void_p(buf.ctypes.data)
+            strm.avail_in = buf.size
+            strm.next_out = ctypes.c_void_p(out.ctypes.data)
+            strm.avail_out = out.size
+            rc = self._lib.deflate(ctypes.byref(strm), _Z_FINISH)
+            if rc != _Z_STREAM_END:
+                raise ValueError(f"gzip: deflate failed (rc={rc})")
+            return int(strm.total_out)
+        finally:
+            self._lib.deflateEnd(ctypes.byref(strm))
+
+    def compress(self, data, level: int = _Z_DEFAULT_LEVEL) -> bytes:
+        buf = _as_u8(data)
+        out = np.empty(self.compress_bound(buf.size), dtype=np.uint8)
+        return out[: self.compress_into(buf, out, level)].tobytes()
+
+    def decompress_into(self, src, out: np.ndarray,
+                        expected_size: int) -> int:
+        """Inflate a (possibly multi-member) gzip stream into ``out``;
+        returns the produced length (== ``expected_size`` on success)."""
+        buf = _as_u8(src)
+        if out.size < expected_size:
+            raise ValueError("gzip: output buffer too small")
+        strm = _ZStream()
+        rc = self._lib.inflateInit2_(
+            ctypes.byref(strm), _GZIP_WBITS, self._version,
+            ctypes.sizeof(_ZStream))
+        if rc != _Z_OK:
+            raise ValueError(f"gzip: inflateInit failed (rc={rc})")
+        produced = 0
+        consumed = 0
+        try:
+            while True:
+                strm.next_in = ctypes.c_void_p(buf.ctypes.data + consumed)
+                strm.avail_in = buf.size - consumed
+                strm.next_out = ctypes.c_void_p(out.ctypes.data + produced)
+                # cap at expected: a lying stream must not scribble past
+                # the caller's slab
+                strm.avail_out = expected_size - produced
+                strm.total_in = 0
+                strm.total_out = 0
+                rc = self._lib.inflate(ctypes.byref(strm), _Z_FINISH)
+                produced += int(strm.total_out)
+                consumed += int(strm.total_in)
+                if rc == _Z_STREAM_END:
+                    if consumed >= buf.size:
+                        return produced
+                    # multi-member stream: next member follows (a member
+                    # overflowing expected_size dies on avail_out == 0)
+                    rc = self._lib.inflateReset(ctypes.byref(strm))
+                    if rc != _Z_OK:
+                        raise ValueError(
+                            f"gzip: inflateReset failed (rc={rc})")
+                    continue
+                raise ValueError(f"gzip: inflate failed (rc={rc})")
+        finally:
+            self._lib.inflateEnd(ctypes.byref(strm))
+
+    def decompress(self, src, expected_size: int) -> bytes:
+        out = np.empty(max(expected_size, 1), dtype=np.uint8)
+        n = self.decompress_into(src, out, expected_size)
+        return out[:n].tobytes()
+
+
+# ----------------------------------------------------------------------
+# zstd
+# ----------------------------------------------------------------------
+
+_ZSTD_CONTENTSIZE_UNKNOWN = 2**64 - 1
+_ZSTD_CONTENTSIZE_ERROR = 2**64 - 2
+
+
+class NativeZstd:
+    """Direct libzstd binding (simple one-shot API), caller-buffer I/O.
+
+    One-shot ``ZSTD_compress``/``ZSTD_decompress`` are thread-safe
+    (each call uses its own implicit context) and ``ZSTD_decompress``
+    decodes concatenated frames in one call — exactly the property
+    block-parallel compression leans on.  ``frame_spans`` exposes the
+    frame boundaries so the read side can decompress frames
+    concurrently."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        names = ("ZSTD_compress", "ZSTD_decompress",
+                 "ZSTD_compressBound", "ZSTD_isError",
+                 "ZSTD_getFrameContentSize",
+                 "ZSTD_findFrameCompressedSize")
+        for name in names:
+            if not hasattr(lib, name):
+                raise RuntimeError(f"libzstd too old: missing {name}")
+        lib.ZSTD_compress.restype = ctypes.c_size_t
+        lib.ZSTD_compress.argtypes = [
+            ctypes.c_void_p, ctypes.c_size_t,
+            ctypes.c_void_p, ctypes.c_size_t, ctypes.c_int,
+        ]
+        lib.ZSTD_decompress.restype = ctypes.c_size_t
+        lib.ZSTD_decompress.argtypes = [
+            ctypes.c_void_p, ctypes.c_size_t,
+            ctypes.c_void_p, ctypes.c_size_t,
+        ]
+        lib.ZSTD_compressBound.restype = ctypes.c_size_t
+        lib.ZSTD_compressBound.argtypes = [ctypes.c_size_t]
+        lib.ZSTD_isError.restype = ctypes.c_uint
+        lib.ZSTD_isError.argtypes = [ctypes.c_size_t]
+        lib.ZSTD_getFrameContentSize.restype = ctypes.c_ulonglong
+        lib.ZSTD_getFrameContentSize.argtypes = [
+            ctypes.c_void_p, ctypes.c_size_t]
+        lib.ZSTD_findFrameCompressedSize.restype = ctypes.c_size_t
+        lib.ZSTD_findFrameCompressedSize.argtypes = [
+            ctypes.c_void_p, ctypes.c_size_t]
+        self._err_name = getattr(lib, "ZSTD_getErrorName", None)
+        if self._err_name is not None:
+            self._err_name.restype = ctypes.c_char_p
+            self._err_name.argtypes = [ctypes.c_size_t]
+
+    def _check(self, code: int, what: str) -> int:
+        if self._lib.ZSTD_isError(ctypes.c_size_t(code)):
+            detail = ""
+            if self._err_name is not None:
+                name = self._err_name(ctypes.c_size_t(code))
+                detail = f": {name.decode()}" if name else ""
+            raise ValueError(f"zstd: {what} failed{detail}")
+        return code
+
+    def compress_bound(self, n: int) -> int:
+        return int(self._lib.ZSTD_compressBound(n))
+
+    def compress_into(self, src, out: np.ndarray, level: int = 3) -> int:
+        buf = _as_u8(src)
+        if out.size < self.compress_bound(buf.size):
+            raise ValueError("zstd: output buffer too small")
+        rc = self._lib.ZSTD_compress(out.ctypes.data, out.size,
+                                     buf.ctypes.data, buf.size, level)
+        return self._check(int(rc), "compress")
+
+    def compress(self, data, level: int = 3) -> bytes:
+        buf = _as_u8(data)
+        out = np.empty(self.compress_bound(buf.size), dtype=np.uint8)
+        return out[: self.compress_into(buf, out, level)].tobytes()
+
+    def decompress_into(self, src, out: np.ndarray,
+                        expected_size: int) -> int:
+        """One-shot decompress (handles concatenated frames); returns
+        the produced length.  ``out`` is capped at ``expected_size`` so
+        a lying stream cannot scribble past the caller's slab."""
+        buf = _as_u8(src)
+        if out.size < expected_size:
+            raise ValueError("zstd: output buffer too small")
+        rc = self._lib.ZSTD_decompress(
+            out.ctypes.data, ctypes.c_size_t(expected_size),
+            buf.ctypes.data, buf.size)
+        return self._check(int(rc), "decompress")
+
+    def decompress(self, src, expected_size: int) -> bytes:
+        out = np.empty(max(expected_size, 1), dtype=np.uint8)
+        n = self.decompress_into(src, out, expected_size)
+        return out[:n].tobytes()
+
+    def frame_spans(self, src):
+        """``[(offset, compressed_len, content_len), ...]`` for each
+        frame of a (possibly concatenated) zstd stream, or None when
+        any frame's content size is unrecorded (the parallel read path
+        then falls back to the one-shot multi-frame decompress)."""
+        buf = _as_u8(src)
+        spans = []
+        pos = 0
+        while pos < buf.size:
+            view = buf[pos:]
+            clen = self._lib.ZSTD_findFrameCompressedSize(
+                view.ctypes.data, view.size)
+            if self._lib.ZSTD_isError(ctypes.c_size_t(clen)):
+                raise ValueError("zstd: corrupt frame header")
+            ulen = int(self._lib.ZSTD_getFrameContentSize(
+                view.ctypes.data, view.size))
+            if ulen in (_ZSTD_CONTENTSIZE_UNKNOWN,
+                        _ZSTD_CONTENTSIZE_ERROR):
+                return None
+            spans.append((pos, int(clen), ulen))
+            pos += int(clen)
+        return spans
+
+
+_lock = threading.Lock()
+_zlib_inst: "NativeZlib | None | bool" = False  # False = not tried yet
+_zstd_inst: "NativeZstd | None | bool" = False
+
+
+def zlib_native() -> NativeZlib | None:
+    """The process-wide libz binding, or None when unloadable."""
+    global _zlib_inst
+    with _lock:
+        if _zlib_inst is False:
+            try:
+                lib = _dlopen("TPQ_ZLIB_LIB",
+                              ("libz.so.1", "libz.so", "libz.dylib"))
+                _zlib_inst = NativeZlib(lib) if lib is not None else None
+            except (OSError, AttributeError):
+                _zlib_inst = None
+        return _zlib_inst
+
+
+def zstd_native() -> NativeZstd | None:
+    """The process-wide libzstd binding, or None when unloadable."""
+    global _zstd_inst
+    with _lock:
+        if _zstd_inst is False:
+            try:
+                lib = _dlopen("TPQ_ZSTD_LIB",
+                              ("libzstd.so.1", "libzstd.so",
+                               "libzstd.dylib"))
+                _zstd_inst = NativeZstd(lib) if lib is not None else None
+            except (OSError, RuntimeError, AttributeError):
+                _zstd_inst = None
+        return _zstd_inst

@@ -155,6 +155,12 @@ class DecodeStats:
     write_encode_s: float = 0.0
     write_compress_s: float = 0.0
     write_assemble_s: float = 0.0
+    # block-parallel codec split: sub-blocks compressed as independent
+    # frames on write (compress.page_compress_into) and frames decoded
+    # concurrently on read (multi-frame ZSTD bodies).  Zero whenever
+    # pages stay single-frame — the 1-worker byte-parity mode.
+    codec_split_blocks: int = 0
+    codec_split_frames: int = 0
     # -- predicate pushdown / pruning (tpuparquet/filter.py) --
     # row groups skipped entirely by a filter verdict (chunk Statistics,
     # bloom filters, or the page index proving no row can match) — the
@@ -272,6 +278,7 @@ class DecodeStats:
         "checkpoints_written",
         "pages_written", "pages_assembled_native",
         "write_encode_s", "write_compress_s", "write_assemble_s",
+        "codec_split_blocks", "codec_split_frames",
         "row_groups_pruned", "pages_pruned", "rows_pruned",
         "bloom_hits", "filter_rows_in", "filter_rows_out",
         "dataset_files_pruned", "dataset_orphans_swept",
@@ -354,6 +361,8 @@ class DecodeStats:
             "write_encode_s": round(self.write_encode_s, 6),
             "write_compress_s": round(self.write_compress_s, 6),
             "write_assemble_s": round(self.write_assemble_s, 6),
+            "codec_split_blocks": self.codec_split_blocks,
+            "codec_split_frames": self.codec_split_frames,
             "row_groups_pruned": self.row_groups_pruned,
             "pages_pruned": self.pages_pruned,
             "rows_pruned": self.rows_pruned,
@@ -427,7 +436,11 @@ class DecodeStats:
                f"encode {d['write_encode_s']:.3f}s / compress "
                f"{d['write_compress_s']:.3f}s / assemble "
                f"{d['write_assemble_s']:.3f}s"
+               + (f", {d['codec_split_blocks']} split blocks"
+                  if d["codec_split_blocks"] else "")
                if d["pages_written"] else "")
+            + (f"; {d['codec_split_frames']} codec frames "
+               f"decoded parallel" if d["codec_split_frames"] else "")
             + (f"; PRUNE: {d['row_groups_pruned']} row groups / "
                f"{d['pages_pruned']} pages / {d['rows_pruned']} rows "
                f"pruned, {d['bloom_hits']} bloom hits"
